@@ -1,0 +1,26 @@
+"""edl_tpu — a TPU-native elastic deep-learning framework.
+
+A brand-new framework with the capabilities of PaddlePaddle EDL
+(reference: denkensk/edl): an elastic cluster controller + autoscaler that
+treats every training job's worker count as a dial between min and max
+instances, plus the fault-tolerant runtime that makes resizing safe.
+
+Instead of GPU pods + parameter servers + etcd, this build targets Cloud TPU
+slices scheduled as contiguous ICI meshes, JAX/pjit training steps with
+collectives over ICI/DCN, elastic resharding + Orbax checkpointing across mesh
+resizes, and a C++ coordination/task-queue core.
+
+Layer map (mirrors reference SURVEY §1):
+  api/           resource model (TrainingJob spec/status)      ~ pkg/resource, pkg/apis
+  cluster/       inventory snapshot + fake/k8s backends        ~ pkg/cluster.go
+  scheduler/     pure elastic planner + autoscaler loop        ~ pkg/autoscaler.go
+  controller/    reconciler + per-job lifecycle actors         ~ pkg/controller.go, pkg/updater
+  coord/         C++ task-lease queue + membership epochs      ~ external Go master/pserver
+  runtime/       elastic pjit trainer runtime                  ~ docker/paddle_k8s + train_ft.py
+  parallel/      mesh / sharding / collectives / ring attn     (TPU-native substrate)
+  models/        flagship model zoo (MLP..Llama)               ~ example/
+  ops/           pallas kernels                                (TPU-native substrate)
+  observability/ collector + tracing                           ~ example/collector.py
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,30 @@
+"""Resource model: TrainingJob spec/status types and quantity arithmetic."""
+
+from edl_tpu.api.quantity import Quantity
+from edl_tpu.api.types import (
+    JobPhase,
+    MasterSpec,
+    PserverSpec,
+    ResourceRequirements,
+    TrainerSpec,
+    TrainingJob,
+    TrainingJobSpec,
+    TrainingJobStatus,
+    TpuTopology,
+)
+from edl_tpu.api.validation import ValidationError, set_defaults_and_validate
+
+__all__ = [
+    "Quantity",
+    "JobPhase",
+    "MasterSpec",
+    "PserverSpec",
+    "ResourceRequirements",
+    "TrainerSpec",
+    "TrainingJob",
+    "TrainingJobSpec",
+    "TrainingJobStatus",
+    "TpuTopology",
+    "ValidationError",
+    "set_defaults_and_validate",
+]

@@ -1,0 +1,223 @@
+"""TrainingJob resource model.
+
+TPU-native re-design of the reference's job CRD:
+
+* Gen-1 TPR shape — reference pkg/resource/training_job.go:109-159
+  (spec: image/port/ports_num/fault_tolerant/passes + Trainer/Pserver/Master)
+* Gen-2 CRD status machine — reference pkg/apis/paddlepaddle/v1/types.go:92-162
+  (phase None/Creating/Running/Succeeded/Failed + per-resource states)
+* helpers Elastic()/NeedGPU() — reference pkg/resource/training_job.go:189-207
+
+Differences from the reference, by design (TPU-first):
+
+* The accelerator resource is ``tpu`` chips (``google.com/tpu``), not
+  ``alpha.kubernetes.io/nvidia-gpu``; jobs additionally carry a
+  :class:`TpuTopology` so the scheduler can keep ICI meshes contiguous.
+* The ``pserver`` role survives in the spec for migration parity, but in the
+  TPU runtime parameters live sharded in device memory via jax/pjit — a job
+  may simply omit the role.  The ``master`` role maps to our coordination
+  service (task-lease queue + membership epochs, see edl_tpu.coord).
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Optional
+
+from edl_tpu.api.quantity import Quantity
+
+# Resource-list keys (reference uses v1.ResourceList with the nvidia-gpu key,
+# pkg/resource/training_job.go:196-206; ours is the TPU chip resource).
+RESOURCE_CPU = "cpu"
+RESOURCE_MEMORY = "memory"
+RESOURCE_TPU = "google.com/tpu"
+
+DEFAULT_PORT = 7164  # reference pkg/jobparser.go:50-52
+DEFAULT_IMAGE = "edl-tpu/job:latest"  # role of paddlepaddle/paddlecloud-job, jobparser.go:61-63
+DEFAULT_PASSES = 1  # reference pkg/jobparser.go:58-60
+
+
+def _as_qmap(m: "dict[str, Quantity | str | int] | None") -> dict[str, Quantity]:
+    return {k: Quantity(v) for k, v in (m or {}).items()}
+
+
+@dataclass
+class ResourceRequirements:
+    """requests/limits lists, mirroring v1.ResourceRequirements."""
+
+    requests: dict[str, Quantity] = field(default_factory=dict)
+    limits: dict[str, Quantity] = field(default_factory=dict)
+
+    def __post_init__(self) -> None:
+        self.requests = _as_qmap(self.requests)
+        self.limits = _as_qmap(self.limits)
+
+    def _get(self, which: dict[str, Quantity], key: str) -> Quantity:
+        return which.get(key, Quantity(0))
+
+    def cpu_request(self) -> Quantity:
+        return self._get(self.requests, RESOURCE_CPU)
+
+    def memory_request(self) -> Quantity:
+        return self._get(self.requests, RESOURCE_MEMORY)
+
+    def cpu_limit(self) -> Quantity:
+        return self._get(self.limits, RESOURCE_CPU)
+
+    def memory_limit(self) -> Quantity:
+        return self._get(self.limits, RESOURCE_MEMORY)
+
+    def tpu_limit(self) -> Quantity:
+        """Accelerator chips; role of Limits.NvidiaGPU() (autoscaler.go:40-42)."""
+        return self._get(self.limits, RESOURCE_TPU)
+
+
+@dataclass
+class TpuTopology:
+    """Requested TPU slice topology for one worker (e.g. "2x2x1").
+
+    The reference has no equivalent (GPUs are an unstructured count); TPU
+    slices are discrete ICI meshes, so elasticity must move between *valid*
+    shapes.  ``None`` axes mean "any".
+    """
+
+    shape: tuple[int, ...] = ()
+
+    @classmethod
+    def parse(cls, text: str) -> "TpuTopology":
+        return cls(tuple(int(x) for x in text.lower().split("x") if x))
+
+    @property
+    def chips(self) -> int:
+        n = 1
+        for d in self.shape:
+            n *= d
+        return n if self.shape else 0
+
+    def __str__(self) -> str:
+        return "x".join(str(d) for d in self.shape)
+
+
+@dataclass
+class TrainerSpec:
+    """reference pkg/resource/training_job.go:133-145."""
+
+    entrypoint: str = ""
+    workspace: str = ""
+    min_instance: int = 1
+    max_instance: int = 1
+    resources: ResourceRequirements = field(default_factory=ResourceRequirements)
+    topology: Optional[TpuTopology] = None
+
+
+@dataclass
+class PserverSpec:
+    """reference pkg/resource/training_job.go:147-152.
+
+    Kept for spec-surface parity; the TPU runtime shards parameters across
+    the trainer mesh itself, so most jobs leave min/max at 0.
+    """
+
+    min_instance: int = 0
+    max_instance: int = 0
+    resources: ResourceRequirements = field(default_factory=ResourceRequirements)
+
+
+@dataclass
+class MasterSpec:
+    """reference pkg/resource/training_job.go:154-159 — maps to the
+    edl_tpu.coord service (task queue + membership) instead of etcd+master."""
+
+    etcd_endpoint: str = ""  # retained name for migration; our coord endpoint
+    resources: ResourceRequirements = field(default_factory=ResourceRequirements)
+
+
+@dataclass
+class TrainingJobSpec:
+    """reference pkg/resource/training_job.go:109-131."""
+
+    image: str = ""
+    port: int = 0
+    ports_num: int = 0
+    ports_num_for_sparse: int = 0
+    fault_tolerant: bool = False
+    passes: int = 0
+    host_network: bool = False
+    node_selector: dict[str, str] = field(default_factory=dict)
+    trainer: TrainerSpec = field(default_factory=TrainerSpec)
+    pserver: PserverSpec = field(default_factory=PserverSpec)
+    master: MasterSpec = field(default_factory=MasterSpec)
+
+
+class JobPhase(str, enum.Enum):
+    """reference pkg/apis/paddlepaddle/v1/types.go:95-111."""
+
+    NONE = "None"
+    CREATING = "Creating"
+    RUNNING = "Running"
+    SCALING = "Scaling"  # TPU addition: mesh resize in flight
+    SUCCEEDED = "Succeeded"
+    FAILED = "Failed"
+
+    def terminal(self) -> bool:
+        return self in (JobPhase.SUCCEEDED, JobPhase.FAILED)
+
+
+class ResourceState(str, enum.Enum):
+    """reference pkg/apis/paddlepaddle/v1/types.go:139-152."""
+
+    NONE = "None"
+    STARTING = "Starting"
+    RUNNING = "Running"
+    FAILED = "Failed"
+    SUCCEEDED = "Succeeded"
+
+
+@dataclass
+class TrainingResourceStatus:
+    """reference pkg/apis/paddlepaddle/v1/types.go:154-162."""
+
+    resource_type: str = ""  # MASTER | PSERVER | TRAINER
+    state: ResourceState = ResourceState.NONE
+    resource_states: dict[str, ResourceState] = field(default_factory=dict)
+
+
+@dataclass
+class TrainingJobStatus:
+    """reference pkg/apis/paddlepaddle/v1/types.go:113-137."""
+
+    phase: JobPhase = JobPhase.NONE
+    reason: str = ""
+    replica_statuses: list[TrainingResourceStatus] = field(default_factory=list)
+
+
+@dataclass
+class TrainingJob:
+    """The user-facing job object (metadata + spec + status)."""
+
+    name: str = ""
+    namespace: str = "default"
+    labels: dict[str, str] = field(default_factory=dict)
+    spec: TrainingJobSpec = field(default_factory=TrainingJobSpec)
+    status: TrainingJobStatus = field(default_factory=TrainingJobStatus)
+
+    # -- helpers, reference pkg/resource/training_job.go:185-207 -----------
+
+    def elastic(self) -> bool:
+        """min < max ⇒ trainer count is a dial (training_job.go:189-191)."""
+        return self.spec.trainer.min_instance < self.spec.trainer.max_instance
+
+    def tpu_chips_per_trainer(self) -> int:
+        """Chips one trainer replica occupies (role of GPU(), :194-200)."""
+        if self.spec.trainer.topology is not None and self.spec.trainer.topology.chips:
+            return self.spec.trainer.topology.chips
+        return self.spec.trainer.resources.tpu_limit().value()
+
+    def need_tpu(self) -> bool:
+        """role of NeedGPU() (training_job.go:203-207)."""
+        return self.tpu_chips_per_trainer() > 0
+
+    @property
+    def full_name(self) -> str:
+        return f"{self.namespace}/{self.name}"

@@ -1,0 +1,67 @@
+"""Spec defaulting + validation.
+
+Behavioral port of the reference's ``DefaultJobParser.Validate`` /
+``setDefaultAndValidate`` (reference pkg/jobparser.go:47-71,
+pkg/updater/jobparser.go:40-64): fill defaults for port / ports_num /
+ports_num_for_sparse / image / passes, and reject elastic jobs that are not
+fault-tolerant.  TPU additions: topology sanity and min-instance floor.
+"""
+
+from __future__ import annotations
+
+from edl_tpu.api import types as T
+
+
+class ValidationError(ValueError):
+    pass
+
+
+def set_defaults_and_validate(job: T.TrainingJob) -> T.TrainingJob:
+    """Mutates ``job`` in place (defaults), raises ValidationError on bad spec."""
+    spec = job.spec
+
+    if not job.name:
+        raise ValidationError("job name must not be empty")
+
+    # Defaults — reference pkg/jobparser.go:49-64.
+    if spec.port == 0:
+        spec.port = T.DEFAULT_PORT
+    if spec.ports_num == 0:
+        spec.ports_num = 1
+    if spec.ports_num_for_sparse == 0:
+        spec.ports_num_for_sparse = 1
+    if not spec.image:
+        spec.image = T.DEFAULT_IMAGE
+    if spec.passes == 0:
+        spec.passes = T.DEFAULT_PASSES
+
+    t = spec.trainer
+    if t.min_instance < 1:
+        raise ValidationError("trainer.min_instance must be >= 1")
+    if t.max_instance < t.min_instance:
+        raise ValidationError(
+            f"trainer.max_instance ({t.max_instance}) must be >= "
+            f"min_instance ({t.min_instance})"
+        )
+    if spec.pserver.max_instance < spec.pserver.min_instance:
+        raise ValidationError("pserver.max_instance must be >= min_instance")
+
+    # Elastic requires fault tolerance — reference pkg/jobparser.go:66-68.
+    if job.elastic() and not spec.fault_tolerant:
+        raise ValidationError(
+            "elastic jobs (min_instance < max_instance) require fault_tolerant"
+        )
+
+    # TPU additions: a declared topology must describe at least one chip and
+    # agree with an explicit chip limit if both are present.
+    if t.topology is not None:
+        if t.topology.chips < 1:
+            raise ValidationError(f"invalid TPU topology {t.topology}")
+        lim = t.resources.tpu_limit().value()
+        if lim and lim != t.topology.chips:
+            raise ValidationError(
+                f"tpu limit ({lim}) disagrees with topology {t.topology} "
+                f"({t.topology.chips} chips)"
+            )
+
+    return job

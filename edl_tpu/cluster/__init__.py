@@ -1,0 +1,15 @@
+"""Cluster inventory & actuation layer (role of reference pkg/cluster.go)."""
+
+from edl_tpu.cluster.resource import ClusterResource, NodeResources
+from edl_tpu.cluster.base import Cluster, PodPhase, PodCounts
+from edl_tpu.cluster.fake import FakeCluster, FakeNode
+
+__all__ = [
+    "ClusterResource",
+    "NodeResources",
+    "Cluster",
+    "PodPhase",
+    "PodCounts",
+    "FakeCluster",
+    "FakeNode",
+]

@@ -1,0 +1,182 @@
+"""Kubernetes/GKE cluster backend.
+
+Role of the reference's real k8s façade (reference pkg/cluster.go:31-291):
+node/pod inventory via the apiserver, trainer-group actuation via a
+Job-like resource's parallelism, TPU capacity read from the
+``google.com/tpu`` allocatable (where the reference read
+``alpha.kubernetes.io/nvidia-gpu``, cluster.go:224).
+
+Gated on the ``kubernetes`` client package, which is not part of this
+build's baked-in dependency set — constructing :class:`K8sCluster` without
+it raises a clear error, and everything else in edl_tpu (controller,
+scheduler, runtime, tests) runs against :class:`~edl_tpu.cluster.fake.FakeCluster`.
+The class documents the full mapping so wiring it to a live cluster is
+mechanical.
+"""
+
+from __future__ import annotations
+
+from edl_tpu.api.types import RESOURCE_TPU, TrainingJob
+from edl_tpu.cluster.base import Cluster, PodCounts
+from edl_tpu.cluster.resource import ClusterResource, NodeResources
+
+try:  # pragma: no cover - not installed in the build image
+    import kubernetes  # type: ignore
+
+    _HAVE_K8S = True
+except ImportError:
+    _HAVE_K8S = False
+
+#: label selecting a job's trainer pods (role of ``paddle-job=<name>``,
+#: reference pkg/cluster.go:119).
+TRAINER_LABEL = "edl-tpu-job"
+
+
+class K8sCluster(Cluster):
+    """Live-cluster backend; requires the ``kubernetes`` package."""
+
+    def __init__(self, kubeconfig: str | None = None, namespace: str = "default"):
+        if not _HAVE_K8S:
+            raise RuntimeError(
+                "K8sCluster requires the 'kubernetes' package; this build "
+                "image does not include it — use FakeCluster, or install "
+                "kubernetes in a deployment image"
+            )
+        if kubeconfig:  # pragma: no cover
+            kubernetes.config.load_kube_config(kubeconfig)
+        else:  # pragma: no cover
+            kubernetes.config.load_incluster_config()
+        self._core = kubernetes.client.CoreV1Api()  # pragma: no cover
+        self._batch = kubernetes.client.BatchV1Api()  # pragma: no cover
+        self.namespace = namespace  # pragma: no cover
+
+    # The method bodies below mirror reference pkg/cluster.go behavior and
+    # only run with the kubernetes package present.
+
+    def inquiry_resource(self) -> ClusterResource:  # pragma: no cover
+        r = ClusterResource()
+        nodes = NodeResources()
+        for node in self._core.list_node().items:
+            alloc = node.status.allocatable or {}
+            cpu = _milli(alloc.get("cpu", "0"))
+            mem = _mega(alloc.get("memory", "0"))
+            tpu = int(alloc.get(RESOURCE_TPU, "0"))
+            r.node_count += 1
+            r.cpu_total_milli += cpu
+            r.memory_total_mega += mem
+            r.tpu_total += tpu
+            nodes.nodes_cpu_idle_milli[node.metadata.name] = cpu
+            nodes.nodes_memory_free_mega[node.metadata.name] = mem
+            nodes.nodes_tpu_free[node.metadata.name] = tpu
+        # all non-terminal pods hold their requests (cluster.go:202-242)
+        pods = self._core.list_pod_for_all_namespaces(
+            field_selector="status.phase!=Succeeded,status.phase!=Failed"
+        )
+        for pod in pods.items:
+            creq, cl, mreq, ml, tl = _pod_resources(pod)
+            r.cpu_request_milli += creq
+            r.cpu_limit_milli += cl
+            r.memory_request_mega += mreq
+            r.memory_limit_mega += ml
+            r.tpu_request += tl
+            r.tpu_limit += tl
+            nn = pod.spec.node_name
+            if nn in nodes.nodes_cpu_idle_milli:
+                nodes.nodes_cpu_idle_milli[nn] -= creq
+                nodes.nodes_memory_free_mega[nn] -= mreq
+                nodes.nodes_tpu_free[nn] -= tl
+        r.nodes = nodes
+        return r
+
+    def get_trainer_parallelism(self, job: TrainingJob) -> int:  # pragma: no cover
+        tj = self._batch.read_namespaced_job(_trainer_name(job), job.namespace)
+        return int(tj.spec.parallelism or 0)
+
+    def update_trainer_parallelism(self, job: TrainingJob, parallelism: int
+                                   ) -> None:  # pragma: no cover
+        tj = self._batch.read_namespaced_job(_trainer_name(job), job.namespace)
+        tj.spec.parallelism = parallelism
+        self._batch.replace_namespaced_job(_trainer_name(job), job.namespace, tj)
+
+    def job_pods(self, job: TrainingJob) -> PodCounts:  # pragma: no cover
+        sel = f"{TRAINER_LABEL}={job.name}"
+        total = running = pending = succeeded = failed = 0
+        for pod in self._core.list_namespaced_pod(
+            job.namespace, label_selector=sel
+        ).items:
+            total += 1
+            if pod.metadata.deletion_timestamp is not None:
+                continue
+            if pod.status.phase == "Running":
+                running += 1
+            elif pod.status.phase == "Pending":
+                pending += 1
+            elif pod.status.phase == "Succeeded":
+                succeeded += 1
+            elif pod.status.phase == "Failed":
+                failed += 1
+        return PodCounts(total, running, pending, succeeded, failed)
+
+    def create_resources(self, job: TrainingJob) -> None:  # pragma: no cover
+        from edl_tpu.controller.jobparser import parse_to_manifests
+
+        apps = kubernetes.client.AppsV1Api()
+        for manifest in parse_to_manifests(job):
+            if manifest["kind"] == "Job":
+                self._batch.create_namespaced_job(job.namespace, manifest)
+            elif manifest["kind"] == "ReplicaSet":
+                apps.create_namespaced_replica_set(job.namespace, manifest)
+
+    def delete_resources(self, job: TrainingJob) -> None:  # pragma: no cover
+        apps = kubernetes.client.AppsV1Api()
+        for rs in (f"{job.name}-coordinator", f"{job.name}-pserver"):
+            try:
+                apps.delete_namespaced_replica_set(
+                    rs, job.namespace, propagation_policy="Foreground"
+                )
+            except kubernetes.client.exceptions.ApiException as exc:
+                if exc.status != 404:
+                    raise
+        try:
+            self._batch.delete_namespaced_job(
+                _trainer_name(job), job.namespace,
+                propagation_policy="Foreground",
+            )
+        except kubernetes.client.exceptions.ApiException as exc:
+            if exc.status != 404:
+                raise
+
+
+def _trainer_name(job: TrainingJob) -> str:
+    return f"{job.name}-trainer"
+
+
+def _milli(q: str) -> int:  # pragma: no cover
+    from edl_tpu.api.quantity import Quantity
+
+    return Quantity(q).milli_value()
+
+
+def _mega(q: str) -> int:  # pragma: no cover
+    from edl_tpu.api.quantity import Quantity
+
+    return Quantity(q).scaled_value(6)
+
+
+def _pod_resources(pod):  # pragma: no cover
+    creq = cl = mreq = ml = tl = 0
+    containers = list(pod.spec.containers or []) + list(
+        pod.spec.init_containers or []
+    )
+    for c in containers:
+        res = c.resources
+        if res is None:
+            continue
+        req = res.requests or {}
+        lim = res.limits or {}
+        creq += _milli(req.get("cpu", "0"))
+        cl += _milli(lim.get("cpu", "0"))
+        mreq += _mega(req.get("memory", "0"))
+        ml += _mega(lim.get("memory", "0"))
+        tl += int(lim.get(RESOURCE_TPU, "0"))
+    return creq, cl, mreq, ml, tl

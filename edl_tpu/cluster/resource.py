@@ -1,0 +1,72 @@
+"""Cluster resource snapshot — the value type the planner plans over.
+
+Port of the reference's ``ClusterResource`` / ``Nodes`` structs
+(reference pkg/cluster.go:32-61), with the accelerator dimension renamed
+GPU → TPU chips and extended with per-node free-chip tracking so the planner
+can keep slice allocations node-local (an ICI mesh cannot span hosts that are
+not ICI-linked).
+
+The snapshot is deliberately a plain mutable value type: the planner mutates
+a *copy* during its dry run and the real cluster is never touched
+(reference pkg/autoscaler.go:296 passes ClusterResource by value — the
+property its whole unit-test suite relies on).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+@dataclass
+class NodeResources:
+    """Per-node idle/free maps — reference pkg/cluster.go:56-61 (``Nodes``),
+    plus TPU chip-freeness per node."""
+
+    nodes_cpu_idle_milli: dict[str, int] = field(default_factory=dict)
+    nodes_memory_free_mega: dict[str, int] = field(default_factory=dict)
+    nodes_tpu_free: dict[str, int] = field(default_factory=dict)
+
+    def copy(self) -> "NodeResources":
+        return NodeResources(
+            dict(self.nodes_cpu_idle_milli),
+            dict(self.nodes_memory_free_mega),
+            dict(self.nodes_tpu_free),
+        )
+
+
+@dataclass
+class ClusterResource:
+    """Whole-cluster totals + requested/limited sums — reference
+    pkg/cluster.go:32-54."""
+
+    node_count: int = 0
+
+    # Accelerator chips (role of GPURequest/GPULimit/GPUTotal).
+    tpu_request: int = 0
+    tpu_limit: int = 0
+    tpu_total: int = 0
+
+    cpu_request_milli: int = 0
+    cpu_limit_milli: int = 0
+    cpu_total_milli: int = 0
+
+    memory_request_mega: int = 0
+    memory_limit_mega: int = 0
+    memory_total_mega: int = 0
+
+    nodes: NodeResources = field(default_factory=NodeResources)
+
+    def copy(self) -> "ClusterResource":
+        """Value-semantics copy handed to the dry-run planner
+        (role of Go's pass-by-value at reference pkg/autoscaler.go:296)."""
+        c = ClusterResource(**{k: v for k, v in self.__dict__.items() if k != "nodes"})
+        c.nodes = self.nodes.copy()
+        return c
+
+    def utilization(self) -> float:
+        """Chip utilization if the cluster has chips, else CPU utilization."""
+        if self.tpu_total > 0:
+            return self.tpu_limit / self.tpu_total
+        if self.cpu_total_milli > 0:
+            return self.cpu_request_milli / self.cpu_total_milli
+        return 0.0

@@ -1,0 +1,8 @@
+"""Controller layer: job compilation + per-job lifecycle actors + reconciler
+(role of reference pkg/controller.go, pkg/jobparser.go, pkg/updater/)."""
+
+from edl_tpu.controller.jobparser import parse_to_manifests
+from edl_tpu.controller.updater import TrainingJobUpdater
+from edl_tpu.controller.controller import Controller
+
+__all__ = ["parse_to_manifests", "TrainingJobUpdater", "Controller"]

@@ -1,0 +1,124 @@
+"""The controller: job registry → per-job actors + autoscaler feed.
+
+Role of the reference's Gen-1 controller (reference pkg/controller.go:44-161)
+with the Gen-2 per-job-actor design it was migrating toward (SURVEY §0):
+where the reference watches a k8s informer, this controller exposes an
+explicit ``submit``/``modify``/``delete`` API (the local/in-process
+equivalent of TrainingJob CRUD) and fans events out to
+
+* a :class:`TrainingJobUpdater` actor per job (lifecycle, phases,
+  ready-confirmation, teardown — the Gen-2 semantics), and
+* the :class:`~edl_tpu.scheduler.autoscaler.Autoscaler` (elastic planning —
+  the Gen-1 semantics),
+
+fixing the Gen-1 quirks: resources are created via the updater only after
+validation, and master/pserver groups are only created when the spec calls
+for them (contrast reference pkg/controller.go:134-141, which creates both
+unconditionally and never validates).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from edl_tpu.api.types import JobPhase, TrainingJob
+from edl_tpu.api.validation import ValidationError, set_defaults_and_validate
+from edl_tpu.cluster.base import Cluster
+from edl_tpu.controller.updater import TrainingJobUpdater
+from edl_tpu.observability.logging import get_logger
+from edl_tpu.scheduler.autoscaler import Autoscaler
+from edl_tpu.scheduler.topology import SliceShapePolicy, UNIT_POLICY
+
+log = get_logger("controller")
+
+
+class Controller:
+    """One per cluster; owns the autoscaler and all job actors."""
+
+    def __init__(
+        self,
+        cluster: Cluster,
+        max_load_desired: float = 0.97,  # reference default (cmd/edl/edl.go:19)
+        shape_policy: SliceShapePolicy = UNIT_POLICY,
+        autoscaler_loop_seconds: float = 5.0,
+        updater_convert_seconds: float = 10.0,
+        updater_confirm_seconds: float = 5.0,
+    ) -> None:
+        self.cluster = cluster
+        self.autoscaler = Autoscaler(
+            cluster,
+            max_load_desired=max_load_desired,
+            shape_policy=shape_policy,
+            loop_seconds=autoscaler_loop_seconds,
+        )
+        self._updater_convert_seconds = updater_convert_seconds
+        self._updater_confirm_seconds = updater_confirm_seconds
+        self._updaters: dict[str, TrainingJobUpdater] = {}
+        self._lock = threading.Lock()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        """Run the scaling loop in the background
+        (role of Controller.Run, reference pkg/controller.go:64-76)."""
+        self.autoscaler.start()
+
+    def stop(self) -> None:
+        self.autoscaler.stop()
+        with self._lock:
+            updaters = list(self._updaters.values())
+        for u in updaters:
+            u.stop()
+
+    # -- TrainingJob CRUD (role of onAdd/onUpdate/onDelete,
+    #    reference pkg/controller.go:110-161) ------------------------------
+
+    def submit(self, job: TrainingJob) -> TrainingJobUpdater:
+        """Validate, spawn the job's actor, register with the autoscaler."""
+        set_defaults_and_validate(job)  # raises ValidationError on bad spec
+        with self._lock:
+            if job.full_name in self._updaters:
+                raise ValidationError(f"job {job.full_name} already submitted")
+            updater = TrainingJobUpdater(
+                job,
+                self.cluster,
+                convert_seconds=self._updater_convert_seconds,
+                confirm_seconds=self._updater_confirm_seconds,
+            )
+            self._updaters[job.full_name] = updater
+        self.autoscaler.on_add(job)
+        log.info("job submitted", job=job.full_name)
+        return updater
+
+    def modify(self, job: TrainingJob) -> None:
+        set_defaults_and_validate(job)  # same gate as submit
+        with self._lock:
+            updater = self._updaters.get(job.full_name)
+        if updater is None:
+            raise KeyError(f"job {job.full_name} not found")
+        updater.modify(job)
+        self.autoscaler.on_update(job)
+
+    def delete(self, job: TrainingJob) -> None:
+        with self._lock:
+            updater = self._updaters.pop(job.full_name, None)
+        if updater is not None:
+            updater.notify_delete()
+            updater.join(timeout=10)
+        self.autoscaler.on_del(job)
+        log.info("job deleted", job=job.full_name)
+
+    # -- introspection -----------------------------------------------------
+
+    def get_updater(self, job: TrainingJob) -> Optional[TrainingJobUpdater]:
+        with self._lock:
+            return self._updaters.get(job.full_name)
+
+    def phase(self, job: TrainingJob) -> JobPhase:
+        u = self.get_updater(job)
+        return u.phase if u is not None else JobPhase.NONE
+
+    def jobs(self) -> list[TrainingJob]:
+        with self._lock:
+            return [u.job for u in self._updaters.values()]

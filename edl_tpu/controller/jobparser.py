@@ -1,0 +1,198 @@
+"""Job compilation: TrainingJob → runnable worker-group specs.
+
+Role of the reference's DefaultJobParser (reference pkg/jobparser.go:30-315,
+pkg/updater/jobparser.go:35-335), which compiles a TrainingJob into a
+trainer batch Job, a pserver ReplicaSet, and a master ReplicaSet with an
+etcd sidecar.  The TPU-native compilation differs by design:
+
+* the **master** role becomes one *coordinator* pod running the edl_tpu
+  coordination service (task-lease queue + membership epochs, C++ core) —
+  no etcd sidecar; the coord service holds the state the reference kept in
+  etcd (reference pkg/jobparser.go:167-184).
+* the **pserver** role is only materialized when the spec asks for it
+  (migration compatibility); TPU jobs shard parameters across the trainer
+  mesh via jax/pjit instead.
+* the **env contract** (role of PADDLE_INIT_*, reference
+  pkg/jobparser.go:263-311) becomes EDL_* + JAX distributed variables.
+* port fan-out (reference podPorts, jobparser.go:232-247) collapses to one
+  coordinator port: collectives ride ICI/DCN via XLA, not a TCP port range.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from edl_tpu.api.types import DEFAULT_PORT, TrainingJob
+
+COORDINATOR_PORT = DEFAULT_PORT  # single source of truth (api/types.py)
+HEALTH_PORT = 8080  # role of the master's 8080 (reference jobparser.go:249-261)
+
+
+def pod_env(job: TrainingJob, role: str) -> dict[str, str]:
+    """Environment contract consumed by the elastic runtime entrypoint
+    (role of podEnv, reference pkg/jobparser.go:263-311; consumed by
+    docker/paddle_k8s + trainers in the reference, by
+    edl_tpu.runtime.entrypoint here)."""
+    spec = job.spec
+    env = {
+        "EDL_JOB_NAME": job.name,
+        "EDL_NAMESPACE": job.namespace,
+        "EDL_ROLE": role,
+        "EDL_FAULT_TOLERANT": "1" if spec.fault_tolerant else "0",
+        "EDL_TRAINER_MIN": str(spec.trainer.min_instance),
+        "EDL_TRAINER_MAX": str(spec.trainer.max_instance),
+        "EDL_PASSES": str(spec.passes),
+        "EDL_ENTRY": spec.trainer.entrypoint,
+        "EDL_TRAINER_PACKAGE": spec.trainer.workspace,
+        # role of ETCD_IP / MASTER_IP discovery (paddle_k8s:119-141): the
+        # runtime resolves the coordinator endpoint itself, but a fixed
+        # port is part of the contract.
+        "EDL_COORD_PORT": str(spec.port or COORDINATOR_PORT),
+        "EDL_TPU_CHIPS_PER_TRAINER": str(job.tpu_chips_per_trainer()),
+    }
+    if spec.trainer.topology is not None:
+        env["EDL_TPU_TOPOLOGY"] = str(spec.trainer.topology)
+    if spec.master.etcd_endpoint:
+        env["EDL_COORD_ENDPOINT"] = spec.master.etcd_endpoint
+    return env
+
+
+def _resources_dict(res) -> dict[str, dict[str, str]]:
+    return {
+        "requests": {k: str(v) for k, v in res.requests.items()},
+        "limits": {k: str(v) for k, v in res.limits.items()},
+    }
+
+
+def parse_to_trainer(job: TrainingJob) -> dict[str, Any]:
+    """Trainer group manifest (role of ParseToTrainer,
+    reference pkg/jobparser.go:120-165): parallelism starts at min_instance,
+    restart-policy Never — failures are survived by elasticity, not pod
+    restarts."""
+    spec = job.spec
+    return {
+        "kind": "Job",
+        "apiVersion": "batch/v1",
+        "metadata": {
+            "name": f"{job.name}-trainer",
+            "namespace": job.namespace,
+            "labels": {"edl-tpu-job": job.name},
+        },
+        "spec": {
+            "parallelism": spec.trainer.min_instance,
+            "template": {
+                "metadata": {"labels": {"edl-tpu-job": job.name}},
+                "spec": {
+                    "restartPolicy": "Never",
+                    "nodeSelector": dict(spec.node_selector),
+                    "hostNetwork": spec.host_network,
+                    "containers": [
+                        {
+                            "name": "trainer",
+                            "image": spec.image,
+                            "command": ["python", "-m",
+                                        "edl_tpu.runtime.entrypoint"],
+                            "env": [
+                                {"name": k, "value": v}
+                                for k, v in pod_env(job, "trainer").items()
+                            ],
+                            "resources": _resources_dict(spec.trainer.resources),
+                        }
+                    ],
+                },
+            },
+        },
+    }
+
+
+def parse_to_coordinator(job: TrainingJob) -> dict[str, Any]:
+    """Coordinator manifest (role of ParseToMaster,
+    reference pkg/jobparser.go:167-227, minus the etcd sidecar — the coord
+    service subsumes it)."""
+    spec = job.spec
+    return {
+        "kind": "ReplicaSet",
+        "apiVersion": "apps/v1",
+        "metadata": {
+            "name": f"{job.name}-coordinator",
+            "namespace": job.namespace,
+            "labels": {"edl-tpu-job-coordinator": job.name},
+        },
+        "spec": {
+            "replicas": 1,
+            "template": {
+                "metadata": {"labels": {"edl-tpu-job-coordinator": job.name}},
+                "spec": {
+                    "containers": [
+                        {
+                            "name": "coordinator",
+                            "image": spec.image,
+                            "command": ["python", "-m", "edl_tpu.coord.server"],
+                            "ports": [
+                                {"containerPort": spec.port or COORDINATOR_PORT,
+                                 "name": "coord"},
+                                {"containerPort": HEALTH_PORT, "name": "health"},
+                            ],
+                            "env": [
+                                {"name": k, "value": v}
+                                for k, v in pod_env(job, "coordinator").items()
+                            ],
+                            "resources": _resources_dict(spec.master.resources),
+                        }
+                    ],
+                },
+            },
+        },
+    }
+
+
+def parse_to_pserver(job: TrainingJob) -> dict[str, Any] | None:
+    """Parameter-server manifest (role of ParseToPserver, reference
+    pkg/jobparser.go:74-117) — only for migration-mode jobs that request it;
+    returns None when the spec leaves the role empty (the TPU-native path)."""
+    spec = job.spec
+    if spec.pserver.min_instance <= 0:
+        return None
+    return {
+        "kind": "ReplicaSet",
+        "apiVersion": "apps/v1",
+        "metadata": {
+            "name": f"{job.name}-pserver",
+            "namespace": job.namespace,
+            "labels": {"edl-tpu-job-pserver": job.name},
+        },
+        "spec": {
+            "replicas": spec.pserver.min_instance,
+            "template": {
+                "metadata": {"labels": {"edl-tpu-job-pserver": job.name}},
+                "spec": {
+                    "containers": [
+                        {
+                            "name": "pserver",
+                            "image": spec.image,
+                            "command": ["python", "-m", "edl_tpu.coord.pserver"],
+                            "env": [
+                                {"name": k, "value": v}
+                                for k, v in pod_env(job, "pserver").items()
+                            ],
+                            "resources": _resources_dict(spec.pserver.resources),
+                        }
+                    ],
+                },
+            },
+        },
+    }
+
+
+def parse_to_manifests(job: TrainingJob) -> list[dict[str, Any]]:
+    """All worker-group manifests for a job, coordinator first (the
+    Gen-2 create order: master → pserver → trainer,
+    reference pkg/updater/trainingJobUpdater.go:282-293)."""
+    out: list[dict[str, Any]] = []
+    if job.spec.fault_tolerant:
+        out.append(parse_to_coordinator(job))
+    ps = parse_to_pserver(job)
+    if ps is not None:
+        out.append(ps)
+    out.append(parse_to_trainer(job))
+    return out

@@ -1,0 +1,5 @@
+"""Observability: structured logging, metrics collector, step tracing."""
+
+from edl_tpu.observability.logging import get_logger
+
+__all__ = ["get_logger"]

@@ -1,0 +1,50 @@
+"""Structured key=value logging.
+
+Role of the reference's log15 setup (reference cmd/edl/edl.go:23-28):
+leveled, structured, with caller annotation.  Built on stdlib logging so the
+host application controls handlers/levels.
+"""
+
+from __future__ import annotations
+
+import logging
+
+
+class StructuredLogger:
+    """log15-style API: ``log.info("msg", key=value, ...)``."""
+
+    def __init__(self, name: str) -> None:
+        self._log = logging.getLogger(f"edl_tpu.{name}")
+
+    @staticmethod
+    def _fmt(msg: str, kv: dict) -> str:
+        if not kv:
+            return msg
+        pairs = " ".join(f"{k}={v!r}" for k, v in kv.items())
+        return f"{msg} {pairs}"
+
+    def debug(self, msg: str, **kv) -> None:
+        self._log.debug(self._fmt(msg, kv), stacklevel=2)
+
+    def info(self, msg: str, **kv) -> None:
+        self._log.info(self._fmt(msg, kv), stacklevel=2)
+
+    def warn(self, msg: str, **kv) -> None:
+        self._log.warning(self._fmt(msg, kv), stacklevel=2)
+
+    warning = warn
+
+    def error(self, msg: str, **kv) -> None:
+        self._log.error(self._fmt(msg, kv), stacklevel=2)
+
+
+def get_logger(name: str) -> StructuredLogger:
+    return StructuredLogger(name)
+
+
+def setup(level: str = "info") -> None:
+    """CLI convenience (role of the -log_level flag, cmd/edl/edl.go:18)."""
+    logging.basicConfig(
+        level=getattr(logging, level.upper(), logging.INFO),
+        format="%(asctime)s %(levelname)-5s %(name)s %(message)s",
+    )

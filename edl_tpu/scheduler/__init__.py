@@ -1,0 +1,21 @@
+"""Elastic scheduler: pure dry-run planner + autoscaler loop
+(role of reference pkg/autoscaler.go)."""
+
+from edl_tpu.scheduler.planner import (
+    PlannedJob,
+    scale_all_jobs_dry_run,
+    scale_dry_run,
+    sorted_jobs,
+)
+from edl_tpu.scheduler.topology import SliceShapePolicy, POW2_POLICY
+from edl_tpu.scheduler.autoscaler import Autoscaler
+
+__all__ = [
+    "PlannedJob",
+    "scale_all_jobs_dry_run",
+    "scale_dry_run",
+    "sorted_jobs",
+    "SliceShapePolicy",
+    "POW2_POLICY",
+    "Autoscaler",
+]

@@ -1,0 +1,269 @@
+"""The pure elastic planner.
+
+Behavioral port of the reference's dry-run scaling core
+(reference pkg/autoscaler.go:191-337):
+
+* ``scale_dry_run``       ~ scaleDryRun        (autoscaler.go:201-291)
+* ``scale_all_jobs_dry_run`` ~ scaleAllJobsDryRun (autoscaler.go:296-337)
+* ``sorted_jobs``         ~ sortedJobs + jobs.Less (autoscaler.go:99-125, 175-189)
+* ``PlannedJob.fulfillment`` ~ job.Fulfillment  (autoscaler.go:54-64)
+* ``search_assignable_nodes`` ~ searchAssignableNode (autoscaler.go:191-199)
+
+The planner is a pure function over a value-type :class:`ClusterResource`
+snapshot — the reference's single best design decision (it takes the snapshot
+by value at autoscaler.go:296), which makes the whole scheduling policy
+unit-testable with zero infrastructure.  All accounting is done in the same
+units (CPU milli-cores, memory megabytes, whole accelerator chips), with the
+reference's GPU dimension replaced by TPU chips.
+
+TPU extension: each job may carry a :class:`SliceShapePolicy` quantizing its
+instance-count walk to valid mesh sizes (see edl_tpu.scheduler.topology).
+With the default unit policy the behavior is identical to the reference,
+which is what tests/test_planner.py's port of pkg/autoscaler_internal_test.go
+verifies case by case.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Iterable, Optional
+
+from edl_tpu.api.types import TrainingJob
+from edl_tpu.cluster.resource import ClusterResource
+from edl_tpu.scheduler.topology import SliceShapePolicy, UNIT_POLICY
+
+
+@dataclass
+class PlannedJob:
+    """A job as the planner sees it: config + current parallelism.
+
+    Role of the reference's ``job`` struct (autoscaler.go:34-37), with the
+    live batch ``Job``'s Parallelism flattened to an int.
+    """
+
+    config: TrainingJob
+    parallelism: int = 0
+    shape_policy: SliceShapePolicy = field(default=UNIT_POLICY)
+
+    @property
+    def name(self) -> str:
+        return self.config.name
+
+    @property
+    def uid(self) -> str:
+        """namespace/name — the key all planner/autoscaler maps use, so
+        same-named jobs in different namespaces never collide."""
+        return self.config.full_name
+
+    # Accounting accessors — reference autoscaler.go:39-52.
+    def tpu_chip_limit(self) -> int:
+        return self.config.tpu_chips_per_trainer()
+
+    def cpu_request_milli(self) -> int:
+        return self.config.spec.trainer.resources.cpu_request().milli_value()
+
+    def mem_request_mega(self) -> int:
+        return self.config.spec.trainer.resources.memory_request().scaled_value(6)
+
+    def fulfillment(self) -> float:
+        """How satisfied the job is in [0, 1] — reference autoscaler.go:54-64."""
+        lo = self.config.spec.trainer.min_instance
+        hi = self.config.spec.trainer.max_instance
+        if lo == hi:
+            return 1.0
+        return (self.parallelism - lo) / (hi - lo)
+
+    def elastic(self) -> bool:
+        return self.config.elastic()
+
+    def need_tpu(self) -> bool:
+        return self.config.need_tpu()
+
+
+def sorted_jobs(jobs: Iterable[PlannedJob], *filters) -> list[PlannedJob]:
+    """Ascending by fulfillment, tiebroken by chip limit, then CPU request,
+    then memory request (reference autoscaler.go:103-125, 175-189): the
+    *least* fulfilled, *cheapest* job scales up first."""
+    out = [j for j in jobs if all(f(j) for f in filters)]
+    out.sort(
+        key=lambda j: (
+            j.fulfillment(),
+            j.tpu_chip_limit(),  # same accessor the accounting path uses
+            j.config.spec.trainer.resources.cpu_request().exact,
+            j.config.spec.trainer.resources.memory_request().exact,
+        )
+    )
+    return out
+
+
+def elastic(j: PlannedJob) -> bool:
+    """Filter: elastic jobs only (reference autoscaler.go:132-134)."""
+    return j.elastic()
+
+
+def need_tpu(j: PlannedJob) -> bool:
+    """Filter: accelerator jobs only (role of gpu(), autoscaler.go:137-139)."""
+    return j.need_tpu()
+
+
+def search_assignable_nodes(
+    r: ClusterResource, j: PlannedJob, count: int
+) -> Optional[list[str]]:
+    """Find nodes with headroom for ``count`` more instances of ``j``
+    (generalizes searchAssignableNode, reference autoscaler.go:191-199).
+
+    Greedy: instances may land on the same node while it has headroom.
+    Returns the chosen node per instance, or None if any instance does not
+    fit.  Does NOT mutate ``r``.
+    """
+    cpu = j.cpu_request_milli()
+    mem = j.mem_request_mega()
+    chips = j.tpu_chip_limit()
+    idle_cpu = dict(r.nodes.nodes_cpu_idle_milli)
+    free_mem = dict(r.nodes.nodes_memory_free_mega)
+    free_tpu = dict(r.nodes.nodes_tpu_free)
+    chosen: list[str] = []
+    for _ in range(count):
+        placed = False
+        for name, idle in idle_cpu.items():
+            if cpu <= idle and mem <= free_mem.get(name, 0):
+                # Chip-aware placement: only enforced when the snapshot
+                # tracks chips for this node (reference tracked CPU/mem only).
+                if chips and name in free_tpu and free_tpu[name] < chips:
+                    continue
+                idle_cpu[name] = idle - cpu
+                free_mem[name] -= mem
+                if name in free_tpu:
+                    free_tpu[name] -= chips
+                chosen.append(name)
+                placed = True
+                break
+        if not placed:
+            return None
+    return chosen
+
+
+def scale_dry_run(
+    r: ClusterResource,
+    j: PlannedJob,
+    cur_diff: int,
+    max_load_desired: float,
+    scale_down: bool,
+) -> int:
+    """One planning step for one job; mutates ``r``'s accounting by the
+    returned delta.  Port of scaleDryRun (reference autoscaler.go:201-291),
+    generalized from ±1 steps to the job's slice-shape policy steps.
+    """
+    cpu = j.cpu_request_milli()
+    mem = j.mem_request_mega()
+    chips = j.tpu_chip_limit()
+    policy = j.shape_policy
+
+    planned = j.parallelism + cur_diff
+    lo = j.config.spec.trainer.min_instance
+    hi = j.config.spec.trainer.max_instance
+
+    additional = 0
+    assigned_nodes: list[str] = []
+
+    def account() -> int:
+        # Adjust-resource-upon-return block (reference autoscaler.go:209-217).
+        r.tpu_limit += chips * additional
+        r.cpu_request_milli += cpu * additional
+        r.memory_request_mega += mem * additional
+        for node in assigned_nodes:
+            r.nodes.nodes_cpu_idle_milli[node] -= cpu
+            r.nodes.nodes_memory_free_mega[node] -= mem
+            if node in r.nodes.nodes_tpu_free:
+                r.nodes.nodes_tpu_free[node] -= chips
+        return additional
+
+    # ===================== scale down (autoscaler.go:230-248) =============
+    if scale_down:
+        if planned > hi:
+            # Forced over max: step down to the next valid count (the
+            # reference's unconditional -1, quantized).
+            additional = policy.next_down(planned, lo) - planned
+            return account()
+        over_tpu = r.tpu_limit > r.tpu_total * max_load_desired
+        over_cpu = r.cpu_request_milli > r.cpu_total_milli * max_load_desired
+        if over_tpu or over_cpu:
+            if planned > lo:
+                # next_down floors at lo; returns planned ("no step") when
+                # no valid count exists in [lo, planned).
+                additional = policy.next_down(planned, lo) - planned
+                return account()
+            return 0  # cannot scale down further
+        return 0  # not overloaded: a down pass never scales up
+
+    # ===================== scale up (autoscaler.go:252-290) ===============
+    if planned >= hi:
+        # At (or forced over) max: clamp to the largest *valid* count <= max,
+        # never grow (reference jumps to max; we additionally re-quantize so
+        # e.g. a POW2 job whose max was lowered to 6 lands on 4, not 6).
+        if planned > hi:
+            target = policy.clamp(hi, lo)
+            if target > 0:  # no valid count in [lo, hi] → take no step
+                additional = target - planned
+        return account()
+
+    target = policy.next_up(planned, hi)
+    step = target - planned
+    if step <= 0:
+        return 0  # no valid mesh size between planned and max
+
+    if r.memory_total_mega - r.memory_request_mega <= mem * step:
+        return 0  # insufficient memory headroom (autoscaler.go:259-263)
+
+    nodes = search_assignable_nodes(r, j, step)
+    if nodes is None:
+        return 0  # no node fits (autoscaler.go:264-267)
+
+    # CPU is capped at max_load_desired of the cluster; accelerators may be
+    # packed to 100% (autoscaler.go:269-278).
+    cpu_ok = r.cpu_total_milli * max_load_desired - r.cpu_request_milli >= cpu * step
+    tpu_ok = (not chips) or (r.tpu_total - r.tpu_limit >= chips * step)
+
+    if cpu_ok and tpu_ok:
+        additional = step
+        assigned_nodes = nodes
+    return account()
+
+
+def scale_all_jobs_dry_run(
+    jobs: Iterable[PlannedJob],
+    r: ClusterResource,
+    max_load_desired: float = 1.0,
+) -> dict[str, int]:
+    """Compute the per-job instance delta for the whole cluster, keyed by
+    job uid (namespace/name).
+
+    Port of scaleAllJobsDryRun (reference autoscaler.go:296-337): iterate to
+    a fixpoint; each round does an up-pass over elastic jobs neediest-first,
+    then a down-pass least-needy-first.  Operates on a *copy* of ``r``.
+    """
+    r = r.copy()
+    diff: dict[str, int] = {}
+
+    while True:
+        no_change = True
+        ordered = sorted_jobs(jobs, elastic)
+
+        def dry_run(j: PlannedJob, is_scale_down: bool) -> None:
+            nonlocal no_change
+            additional = scale_dry_run(
+                r, j, diff.get(j.uid, 0), max_load_desired, is_scale_down
+            )
+            diff[j.uid] = diff.get(j.uid, 0) + additional
+            if additional != 0:
+                no_change = False
+
+        for j in ordered:  # scale up the neediest first
+            dry_run(j, False)
+        for j in reversed(ordered):  # scale down the least needy first
+            dry_run(j, True)
+
+        if no_change:
+            break
+
+    return diff

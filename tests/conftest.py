@@ -1,0 +1,21 @@
+"""Test harness config.
+
+Forces jax onto a virtual 8-device CPU platform *before* jax is imported
+anywhere, so every sharding/pjit test exercises real multi-device meshes
+without TPU hardware (see SURVEY §4 implication 3: the reference had no way
+to test multi-node behavior in CI; we do).
+"""
+
+import os
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def fake_cluster():
+    from edl_tpu.cluster.fake import FakeCluster
+
+    return FakeCluster()

@@ -1,0 +1,86 @@
+"""Resource-model tests: helpers + validation/defaulting parity
+(reference pkg/resource/training_job_test.go:27-46, pkg/jobparser.go:47-71)."""
+
+import pytest
+
+from edl_tpu.api import (
+    ResourceRequirements,
+    TrainerSpec,
+    TrainingJob,
+    TrainingJobSpec,
+    TpuTopology,
+    ValidationError,
+    set_defaults_and_validate,
+)
+from edl_tpu.api.types import DEFAULT_IMAGE, DEFAULT_PORT, RESOURCE_TPU
+
+
+def mk(min_i=1, max_i=1, ft=False, tpu="0", topology=None, name="j"):
+    return TrainingJob(
+        name=name,
+        spec=TrainingJobSpec(
+            fault_tolerant=ft,
+            trainer=TrainerSpec(
+                min_instance=min_i,
+                max_instance=max_i,
+                topology=topology,
+                resources=ResourceRequirements(limits={RESOURCE_TPU: tpu}),
+            ),
+        ),
+    )
+
+
+def test_need_tpu():
+    # reference training_job_test.go:27-37 (NeedGPU → need_tpu)
+    assert not mk(tpu="0").need_tpu()
+    assert mk(tpu="1").need_tpu()
+
+
+def test_elastic():
+    # reference training_job_test.go:39-46
+    assert mk(1, 2, ft=True).elastic()
+    assert not mk(2, 2).elastic()
+
+
+def test_topology_chips():
+    t = TpuTopology.parse("2x2x1")
+    assert t.chips == 4
+    assert str(t) == "2x2x1"
+    job = mk(topology=t)
+    assert job.tpu_chips_per_trainer() == 4
+    assert job.need_tpu()
+
+
+def test_defaults():
+    # reference jobparser.go:49-64
+    job = set_defaults_and_validate(mk())
+    assert job.spec.port == DEFAULT_PORT
+    assert job.spec.ports_num == 1
+    assert job.spec.ports_num_for_sparse == 1
+    assert job.spec.image == DEFAULT_IMAGE
+    assert job.spec.passes == 1
+
+
+def test_elastic_requires_fault_tolerant():
+    # reference jobparser.go:66-68
+    with pytest.raises(ValidationError):
+        set_defaults_and_validate(mk(1, 4, ft=False))
+    set_defaults_and_validate(mk(1, 4, ft=True))  # ok
+
+
+def test_bad_instances():
+    with pytest.raises(ValidationError):
+        set_defaults_and_validate(mk(0, 0))
+    with pytest.raises(ValidationError):
+        set_defaults_and_validate(mk(3, 2))
+
+
+def test_topology_chip_limit_mismatch():
+    job = mk(tpu="8", topology=TpuTopology.parse("2x2"))
+    with pytest.raises(ValidationError):
+        set_defaults_and_validate(job)
+
+
+def test_empty_name_rejected():
+    with pytest.raises(ValidationError):
+        set_defaults_and_validate(mk(name=""))

@@ -1,0 +1,139 @@
+"""Autoscaler loop against the FakeCluster: the reference's whole-system
+behavior (reference autoscaler.go:339-511 + the BOSS-tutorial elastic trace,
+doc/boss_tutorial.md:246-301) reproduced in-process and deterministic."""
+
+from edl_tpu.api.types import (
+    RESOURCE_CPU,
+    RESOURCE_MEMORY,
+    ResourceRequirements,
+    TrainerSpec,
+    TrainingJob,
+    TrainingJobSpec,
+)
+from edl_tpu.cluster.fake import FakeCluster
+from edl_tpu.scheduler.autoscaler import Autoscaler
+
+
+def mk_job(name, lo, hi, cpu="1", mem="100M"):
+    return TrainingJob(
+        name=name,
+        spec=TrainingJobSpec(
+            fault_tolerant=True,
+            trainer=TrainerSpec(
+                min_instance=lo, max_instance=hi,
+                resources=ResourceRequirements(
+                    requests={RESOURCE_CPU: cpu, RESOURCE_MEMORY: mem},
+                    limits={RESOURCE_CPU: cpu, RESOURCE_MEMORY: mem},
+                ),
+            ),
+        ),
+    )
+
+
+def cluster_with(cpu_milli=10_000, mem=100_000):
+    c = FakeCluster()
+    c.add_node("n0", cpu_milli=cpu_milli, memory_mega=mem)
+    return c
+
+
+def submit(cluster, scaler, job):
+    cluster.create_resources(job)
+    scaler.on_add(job)
+
+
+def test_single_job_scales_to_max():
+    c = cluster_with(cpu_milli=10_000)
+    a = Autoscaler(c, max_load_desired=1.0)
+    job = mk_job("example", lo=2, hi=10)
+    submit(c, a, job)
+    for _ in range(12):
+        a.tick()
+    assert c.get_trainer_parallelism(job) == 10
+    assert c.job_pods(job).running == 10
+
+
+def test_max_load_desired_ceiling():
+    c = cluster_with(cpu_milli=10_000)
+    a = Autoscaler(c, max_load_desired=0.8)
+    job = mk_job("example", lo=2, hi=10)
+    submit(c, a, job)
+    for _ in range(12):
+        a.tick()
+    assert c.get_trainer_parallelism(job) == 8  # 80% of 10 CPUs
+
+
+def test_second_job_forces_rebalance():
+    # The BOSS-tutorial scenario: a maxed-out job shrinks to admit another.
+    c = cluster_with(cpu_milli=10_000)
+    a = Autoscaler(c, max_load_desired=1.0)
+    j1 = mk_job("example", lo=2, hi=10)
+    submit(c, a, j1)
+    for _ in range(10):
+        a.tick()
+    assert c.get_trainer_parallelism(j1) == 10
+
+    j2 = mk_job("example1", lo=2, hi=8)
+    submit(c, a, j2)
+    for _ in range(20):
+        a.tick()
+    p1 = c.get_trainer_parallelism(j1)
+    p2 = c.get_trainer_parallelism(j2)
+    assert p1 + p2 <= 10
+    assert p2 >= j2.spec.trainer.min_instance
+    assert c.job_pods(j2).pending == 0
+
+
+def test_job_deletion_returns_capacity():
+    c = cluster_with(cpu_milli=4_000)
+    a = Autoscaler(c)
+    j1 = mk_job("one", lo=2, hi=4)
+    j2 = mk_job("two", lo=2, hi=4)
+    submit(c, a, j1)
+    submit(c, a, j2)
+    for _ in range(10):
+        a.tick()
+    assert c.get_trainer_parallelism(j1) + c.get_trainer_parallelism(j2) == 4
+
+    c.delete_resources(j2)
+    a.on_del(j2)
+    for _ in range(10):
+        a.tick()
+    assert c.get_trainer_parallelism(j1) == 4
+
+
+def test_actuation_retries_on_conflict():
+    c = cluster_with(cpu_milli=10_000)
+    a = Autoscaler(c)
+    job = mk_job("example", lo=2, hi=4)
+    submit(c, a, job)
+    c.fail_next_updates = 2  # two conflicts, then success (5 retries allowed)
+    for _ in range(6):
+        a.tick()
+    assert c.get_trainer_parallelism(job) == 4
+
+
+def test_non_elastic_job_untouched():
+    c = cluster_with(cpu_milli=10_000)
+    a = Autoscaler(c)
+    job = mk_job("fixed", lo=3, hi=3)
+    submit(c, a, job)
+    for _ in range(5):
+        a.tick()
+    assert c.get_trainer_parallelism(job) == 3
+
+
+def test_background_thread_smoke():
+    # reference autoscaler_test.go:29-45 (Run blocks forever) made useful:
+    # start/stop the loop thread and ensure it actuated.
+    c = cluster_with(cpu_milli=5_000)
+    a = Autoscaler(c, loop_seconds=0.01)
+    job = mk_job("example", lo=1, hi=5)
+    submit(c, a, job)
+    a.start()
+    import time
+
+    deadline = time.time() + 5
+    while time.time() < deadline and c.get_trainer_parallelism(job) < 5:
+        time.sleep(0.02)
+    a.stop()
+    assert c.get_trainer_parallelism(job) == 5

@@ -1,0 +1,218 @@
+"""Controller + per-job updater lifecycle
+(reference pkg/controller.go + pkg/updater/trainingJobUpdater.go semantics).
+
+All timers are shrunk so the actor loops run at test speed; phases are
+polled with deadlines rather than sleeps.
+"""
+
+import time
+
+import pytest
+
+from edl_tpu.api.types import (
+    JobPhase,
+    RESOURCE_CPU,
+    RESOURCE_MEMORY,
+    ResourceRequirements,
+    TrainerSpec,
+    TrainingJob,
+    TrainingJobSpec,
+)
+from edl_tpu.api.validation import ValidationError
+from edl_tpu.cluster.fake import FakeCluster
+from edl_tpu.controller.controller import Controller
+from edl_tpu.controller.jobparser import parse_to_manifests, pod_env
+from edl_tpu.controller.updater import TrainingJobUpdater
+
+
+def mk_job(name="j", lo=2, hi=4, ft=True, cpu="1", mem="100M"):
+    return TrainingJob(
+        name=name,
+        spec=TrainingJobSpec(
+            fault_tolerant=ft,
+            trainer=TrainerSpec(
+                entrypoint="python train.py", workspace="/workspace",
+                min_instance=lo, max_instance=hi,
+                resources=ResourceRequirements(
+                    requests={RESOURCE_CPU: cpu, RESOURCE_MEMORY: mem},
+                    limits={RESOURCE_CPU: cpu, RESOURCE_MEMORY: mem},
+                ),
+            ),
+        ),
+    )
+
+
+def wait_phase(get_phase, want, timeout=5.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if get_phase() == want:
+            return True
+        time.sleep(0.01)
+    return get_phase() == want
+
+
+def fast_controller(cluster, **kw):
+    kw.setdefault("autoscaler_loop_seconds", 0.02)
+    kw.setdefault("updater_convert_seconds", 0.02)
+    kw.setdefault("updater_confirm_seconds", 0.01)
+    return Controller(cluster, **kw)
+
+
+# -- updater actor -----------------------------------------------------------
+
+
+def test_updater_reaches_running():
+    c = FakeCluster()
+    c.add_node("n0", cpu_milli=8000, memory_mega=8000)
+    job = mk_job()
+    u = TrainingJobUpdater(job, c, convert_seconds=0.02, confirm_seconds=0.01)
+    assert wait_phase(lambda: u.phase, JobPhase.RUNNING)
+    u.stop()
+
+
+def test_updater_invalid_spec_fails_fast():
+    c = FakeCluster()
+    job = mk_job(lo=3, hi=2)  # max < min
+    u = TrainingJobUpdater(job, c, convert_seconds=0.02, confirm_seconds=0.01)
+    assert wait_phase(lambda: u.phase, JobPhase.FAILED)
+    assert "max_instance" in job.status.reason
+
+
+def test_updater_create_timeout_fails_and_releases():
+    c = FakeCluster()  # no nodes: pods never run
+    job = mk_job()
+    u = TrainingJobUpdater(job, c, convert_seconds=0.02, confirm_seconds=0.01,
+                           create_timeout=0.1)
+    assert wait_phase(lambda: u.phase, JobPhase.FAILED)
+    assert c.job_pods(job).total == 0  # resources released
+
+
+def test_updater_non_ft_fails_on_any_trainer_failure():
+    c = FakeCluster()
+    c.add_node("n0", cpu_milli=8000, memory_mega=8000)
+    job = mk_job(ft=False, lo=2, hi=2)
+    u = TrainingJobUpdater(job, c, convert_seconds=0.02, confirm_seconds=0.01)
+    assert wait_phase(lambda: u.phase, JobPhase.RUNNING)
+    victim = c.list_pods(job_uid=job.full_name, role="trainer")[0]
+    # fail the pod and prevent the fake job-controller from replacing it
+    # before convert() observes the failure
+    with c._lock:
+        from edl_tpu.cluster.base import PodPhase
+
+        c._pods[victim.name].phase = PodPhase.FAILED
+    assert wait_phase(lambda: u.phase, JobPhase.FAILED)
+
+
+def test_updater_ft_survives_single_failure():
+    c = FakeCluster()
+    c.add_node("n0", cpu_milli=8000, memory_mega=8000)
+    job = mk_job(ft=True, lo=2, hi=4)
+    u = TrainingJobUpdater(job, c, convert_seconds=0.02, confirm_seconds=0.01)
+    assert wait_phase(lambda: u.phase, JobPhase.RUNNING)
+    victim = c.list_pods(job_uid=job.full_name, role="trainer")[0]
+    c.kill_pod(victim.name)  # replacement spawns via reconcile
+    time.sleep(0.2)
+    assert u.phase == JobPhase.RUNNING
+    u.stop()
+
+
+def test_updater_success_when_pod_succeeds():
+    from edl_tpu.cluster.base import PodPhase
+
+    c = FakeCluster()
+    c.add_node("n0", cpu_milli=8000, memory_mega=8000)
+    job = mk_job(lo=1, hi=1, ft=False)
+    u = TrainingJobUpdater(job, c, convert_seconds=0.02, confirm_seconds=0.01)
+    assert wait_phase(lambda: u.phase, JobPhase.RUNNING)
+    pod = c.list_pods(job_uid=job.full_name, role="trainer")[0]
+    c.kill_pod(pod.name, PodPhase.SUCCEEDED)
+    assert wait_phase(lambda: u.phase, JobPhase.SUCCEEDED)
+
+
+# -- controller --------------------------------------------------------------
+
+
+def test_controller_end_to_end_scales_job():
+    c = FakeCluster()
+    c.add_node("n0", cpu_milli=10_000, memory_mega=100_000)
+    ctl = fast_controller(c, max_load_desired=1.0)
+    ctl.start()
+    job = mk_job(lo=2, hi=8)
+    ctl.submit(job)
+    assert wait_phase(lambda: ctl.phase(job), JobPhase.RUNNING)
+    deadline = time.time() + 5
+    while time.time() < deadline and c.get_trainer_parallelism(job) < 8:
+        time.sleep(0.02)
+    assert c.get_trainer_parallelism(job) == 8
+    ctl.stop()
+
+
+def test_controller_rejects_invalid_and_duplicate():
+    c = FakeCluster()
+    c.add_node("n0", cpu_milli=8000, memory_mega=8000)
+    ctl = fast_controller(c)
+    with pytest.raises(ValidationError):
+        ctl.submit(mk_job(lo=1, hi=4, ft=False))  # elastic needs FT
+    job = mk_job()
+    ctl.submit(job)
+    with pytest.raises(ValidationError):
+        ctl.submit(mk_job())  # duplicate name
+    ctl.stop()
+
+
+def test_controller_delete_tears_down():
+    c = FakeCluster()
+    c.add_node("n0", cpu_milli=8000, memory_mega=8000)
+    ctl = fast_controller(c)
+    ctl.start()
+    job = mk_job()
+    ctl.submit(job)
+    assert wait_phase(lambda: ctl.phase(job), JobPhase.RUNNING)
+    ctl.delete(job)
+    assert c.job_pods(job).total == 0
+    assert ctl.get_updater(job) is None
+    ctl.stop()
+
+
+# -- jobparser ---------------------------------------------------------------
+
+
+def test_manifests_order_and_shape():
+    from edl_tpu.api.validation import set_defaults_and_validate
+
+    job = set_defaults_and_validate(mk_job())
+    manifests = parse_to_manifests(job)
+    kinds = [(m["kind"], m["metadata"]["name"]) for m in manifests]
+    # FT job: coordinator first, then trainer (create order, reference
+    # trainingJobUpdater.go:282-293); no pserver unless requested
+    assert kinds == [("ReplicaSet", "j-coordinator"), ("Job", "j-trainer")]
+    trainer = manifests[-1]
+    assert trainer["spec"]["parallelism"] == 2
+    pod = trainer["spec"]["template"]["spec"]
+    assert pod["restartPolicy"] == "Never"
+    assert pod["containers"][0]["resources"]["requests"]["cpu"] == "1"
+
+
+def test_manifests_pserver_only_on_request():
+    from edl_tpu.api.validation import set_defaults_and_validate
+
+    job = mk_job(ft=False, lo=2, hi=2)
+    job.spec.pserver.min_instance = 2
+    job.spec.pserver.max_instance = 2
+    set_defaults_and_validate(job)
+    kinds = [m["metadata"]["name"] for m in parse_to_manifests(job)]
+    assert kinds == ["j-pserver", "j-trainer"]  # non-FT: no coordinator
+
+
+def test_pod_env_contract():
+    from edl_tpu.api.validation import set_defaults_and_validate
+
+    job = set_defaults_and_validate(mk_job())
+    env = pod_env(job, "trainer")
+    assert env["EDL_JOB_NAME"] == "j"
+    assert env["EDL_ROLE"] == "trainer"
+    assert env["EDL_FAULT_TOLERANT"] == "1"
+    assert env["EDL_TRAINER_MIN"] == "2"
+    assert env["EDL_TRAINER_MAX"] == "4"
+    assert env["EDL_COORD_PORT"] == "7164"
+    assert env["EDL_ENTRY"] == "python train.py"

@@ -1,0 +1,150 @@
+"""FakeCluster behavior: inventory accounting (reference cluster.go:176-242),
+pod counting (cluster.go:117-136), parallelism actuation, chaos hook."""
+
+import pytest
+
+from edl_tpu.api.types import (
+    RESOURCE_CPU,
+    RESOURCE_MEMORY,
+    RESOURCE_TPU,
+    ResourceRequirements,
+    TrainerSpec,
+    TrainingJob,
+    TrainingJobSpec,
+)
+from edl_tpu.cluster.base import ConflictError, PodPhase
+from edl_tpu.cluster.fake import FakeCluster
+
+
+def mk_job(name="j", lo=2, hi=8, cpu="1", mem="100M", tpu="0"):
+    return TrainingJob(
+        name=name,
+        spec=TrainingJobSpec(
+            fault_tolerant=True,
+            trainer=TrainerSpec(
+                min_instance=lo, max_instance=hi,
+                resources=ResourceRequirements(
+                    requests={RESOURCE_CPU: cpu, RESOURCE_MEMORY: mem},
+                    limits={RESOURCE_CPU: cpu, RESOURCE_MEMORY: mem,
+                            RESOURCE_TPU: tpu},
+                ),
+            ),
+        ),
+    )
+
+
+def test_inquiry_totals_and_idle(fake_cluster):
+    fake_cluster.add_node("n0", cpu_milli=4000, memory_mega=8000, tpu_chips=4)
+    fake_cluster.add_node("n1", cpu_milli=4000, memory_mega=8000, tpu_chips=4)
+    fake_cluster.add_system_pod("sys", "n0", cpu_request_milli=500,
+                                memory_request_mega=100)
+    r = fake_cluster.inquiry_resource()
+    assert r.node_count == 2
+    assert r.cpu_total_milli == 8000
+    assert r.tpu_total == 8
+    assert r.cpu_request_milli == 500
+    assert r.nodes.nodes_cpu_idle_milli["n0"] == 3500
+    assert r.nodes.nodes_cpu_idle_milli["n1"] == 4000
+    assert r.nodes.nodes_memory_free_mega["n0"] == 7900
+
+
+def test_create_resources_runs_min_instances(fake_cluster):
+    fake_cluster.add_node("n0", cpu_milli=4000, memory_mega=8000)
+    job = mk_job(lo=2)
+    fake_cluster.create_resources(job)
+    counts = fake_cluster.job_pods(job)
+    assert counts.total == 2 and counts.running == 2 and counts.pending == 0
+    assert fake_cluster.get_trainer_parallelism(job) == 2
+
+
+def test_pods_pend_when_cluster_full(fake_cluster):
+    fake_cluster.add_node("n0", cpu_milli=1000, memory_mega=8000)
+    job = mk_job(lo=3, cpu="1")
+    fake_cluster.create_resources(job)
+    counts = fake_cluster.job_pods(job)
+    assert counts.total == 3 and counts.running == 1 and counts.pending == 2
+
+
+def test_scale_up_and_down(fake_cluster):
+    fake_cluster.add_node("n0", cpu_milli=8000, memory_mega=8000)
+    job = mk_job(lo=2, hi=8)
+    fake_cluster.create_resources(job)
+    fake_cluster.update_trainer_parallelism(job, 5)
+    assert fake_cluster.job_pods(job).running == 5
+    fake_cluster.update_trainer_parallelism(job, 3)
+    assert fake_cluster.job_pods(job).running == 3
+    # inventory reflects the pods
+    r = fake_cluster.inquiry_resource()
+    assert r.cpu_request_milli == 3000
+
+
+def test_conflict_injection(fake_cluster):
+    fake_cluster.add_node("n0", cpu_milli=8000, memory_mega=8000)
+    job = mk_job()
+    fake_cluster.create_resources(job)
+    fake_cluster.fail_next_updates = 1
+    with pytest.raises(ConflictError):
+        fake_cluster.update_trainer_parallelism(job, 4)
+    fake_cluster.update_trainer_parallelism(job, 4)  # retry succeeds
+
+
+def test_kill_pod_gets_replaced(fake_cluster):
+    fake_cluster.add_node("n0", cpu_milli=8000, memory_mega=8000)
+    job = mk_job(lo=2)
+    fake_cluster.create_resources(job)
+    victim = fake_cluster.list_pods(job_uid="default/j", role="trainer")[0]
+    fake_cluster.kill_pod(victim.name)
+    counts = fake_cluster.job_pods(job)
+    # Failed pod still counted in total; a fresh replacement is Running.
+    assert counts.running == 2
+
+
+def test_pod_event_hook(fake_cluster):
+    events = []
+    fake_cluster.pod_event_hook = lambda pod, what: events.append((pod.name, what))
+    fake_cluster.add_node("n0", cpu_milli=8000, memory_mega=8000)
+    job = mk_job(lo=2)
+    fake_cluster.create_resources(job)
+    assert [w for _, w in events] == ["start", "start"]
+    fake_cluster.delete_resources(job)
+    assert [w for _, w in events].count("stop") == 2
+
+
+def test_delete_resources_frees_capacity(fake_cluster):
+    fake_cluster.add_node("n0", cpu_milli=8000, memory_mega=8000)
+    job = mk_job(lo=4)
+    fake_cluster.create_resources(job)
+    assert fake_cluster.inquiry_resource().cpu_request_milli == 4000
+    fake_cluster.delete_resources(job)
+    assert fake_cluster.inquiry_resource().cpu_request_milli == 0
+    assert fake_cluster.job_pods(job).total == 0
+
+
+def test_succeeded_pod_marks_work_done(fake_cluster):
+    # Work-queue Job semantics: one success = job complete, no replacement,
+    # and terminal pods hold no resources (cluster.go:202-210).
+    fake_cluster.add_node("n0", cpu_milli=8000, memory_mega=8000)
+    job = mk_job(lo=1, hi=1)
+    fake_cluster.create_resources(job)
+    pod = fake_cluster.list_pods(job_uid="default/j")[0]
+    fake_cluster.kill_pod(pod.name, PodPhase.SUCCEEDED)
+    r = fake_cluster.inquiry_resource()
+    assert r.cpu_request_milli == 0
+    counts = fake_cluster.job_pods(job)
+    assert counts.succeeded == 1 and counts.running == 0
+
+
+def test_ici_domain_keeps_tpu_job_together(fake_cluster):
+    # Two 4-chip nodes in different ICI domains: a 3-pod 1-chip-each job
+    # must not straddle domains — the third pod pends rather than cross.
+    fake_cluster.add_node("a0", cpu_milli=2000, memory_mega=8000, tpu_chips=2,
+                          ici_domain="podA")
+    fake_cluster.add_node("b0", cpu_milli=2000, memory_mega=8000, tpu_chips=2,
+                          ici_domain="podB")
+    job = mk_job(lo=3, hi=3, cpu="100m", tpu="1")
+    fake_cluster.create_resources(job)
+    counts = fake_cluster.job_pods(job)
+    assert counts.running == 2 and counts.pending == 1
+    nodes = {p.node for p in fake_cluster.list_pods(job_uid="default/j")
+             if p.node is not None}
+    assert len(nodes) == 1  # all placed pods share one domain

@@ -1,0 +1,378 @@
+"""Port of the reference planner test suite
+(reference pkg/autoscaler_internal_test.go:96-438), case by case, plus
+TPU slice-shape policy extensions.
+
+The fixtures build the same cluster snapshots and jobs; the assertions are
+identical.  GPU limits map to TPU chip limits.
+"""
+
+from edl_tpu.api.types import (
+    RESOURCE_CPU,
+    RESOURCE_MEMORY,
+    RESOURCE_TPU,
+    ResourceRequirements,
+    TrainerSpec,
+    TrainingJob,
+    TrainingJobSpec,
+)
+from edl_tpu.cluster.resource import ClusterResource, NodeResources
+from edl_tpu.scheduler.planner import (
+    PlannedJob,
+    elastic,
+    need_tpu,
+    scale_all_jobs_dry_run,
+    scale_dry_run,
+    sorted_jobs,
+)
+from edl_tpu.scheduler.topology import POW2_POLICY, UNIT_POLICY, explicit_policy
+
+
+def make_job(name, cpu_req, cpu_lim, mem_req, mem_lim, tpu_lim, lo, hi, p,
+             policy=UNIT_POLICY):
+    """Mirror of makeJob (reference autoscaler_internal_test.go:56-94)."""
+    job = TrainingJob(
+        name=name,
+        spec=TrainingJobSpec(
+            trainer=TrainerSpec(
+                min_instance=lo,
+                max_instance=hi,
+                resources=ResourceRequirements(
+                    requests={RESOURCE_CPU: cpu_req, RESOURCE_MEMORY: mem_req},
+                    limits={
+                        RESOURCE_CPU: cpu_lim,
+                        RESOURCE_MEMORY: mem_lim,
+                        RESOURCE_TPU: tpu_lim,
+                    },
+                ),
+            )
+        ),
+    )
+    return PlannedJob(config=job, parallelism=p, shape_policy=policy)
+
+
+def all_idle_nodes():
+    # reference autoscaler_internal_test.go:109-112
+    return NodeResources(
+        nodes_cpu_idle_milli={"node0": 99999},
+        nodes_memory_free_mega={"node0": 99999},
+    )
+
+
+def test_trainer_request_limit():
+    # reference :96-101
+    j = make_job("name", "1k", "1k", "100Mi", "100Mi", "10", 1, 1, 1)
+    assert j.cpu_request_milli() == 1_000_000
+    assert j.mem_request_mega() == 105
+    assert j.tpu_chip_limit() == 10
+
+
+def test_scale_dry_run_satisfied():
+    # reference :103-107
+    r = ClusterResource(cpu_total_milli=2000, memory_total_mega=1000)
+    j = make_job("name", "1000Mi", "1000Mi", "100Mi", "100Mi", "0", 1, 2, 2)
+    assert scale_dry_run(r, j, 0, 1.0, False) == 0
+
+
+def test_scale_dry_run_more_cpu():
+    # reference :114-126
+    r = ClusterResource(
+        cpu_limit_milli=100, cpu_request_milli=100, cpu_total_milli=3000,
+        memory_request_mega=100, memory_limit_mega=100, memory_total_mega=1000,
+        nodes=all_idle_nodes(),
+    )
+    j = make_job("name", "1", "1", "100Mi", "100Mi", "0", 1, 3, 1)
+    assert scale_dry_run(r, j, 0, 1.0, False) == 1
+
+
+def test_scale_dry_run_no_more_cpu():
+    # reference :128-141
+    r = ClusterResource(
+        cpu_limit_milli=1000, cpu_request_milli=1000, cpu_total_milli=1000,
+        memory_request_mega=100, memory_limit_mega=100, memory_total_mega=1000,
+        nodes=all_idle_nodes(),
+    )
+    j = make_job("name", "1", "1", "100Mi", "100Mi", "0", 1, 3, 1)
+    assert scale_dry_run(r, j, 0, 1.0, False) == 0
+
+
+def test_scale_dry_run_more_tpu():
+    # reference :143-159 (GPU → TPU chips)
+    r = ClusterResource(
+        cpu_total_milli=2000,
+        memory_request_mega=100, memory_limit_mega=100, memory_total_mega=1000,
+        tpu_limit=0, tpu_request=0, tpu_total=10,
+        nodes=all_idle_nodes(),
+    )
+    j = make_job("name", "1", "1", "10Mi", "10Mi", "1", 1, 3, 1)
+    assert scale_dry_run(r, j, 0, 1.0, False) == 1
+    # "should not scale up if the scale down parameter is true"
+    assert scale_dry_run(r, j, 0, 1.0, True) == 0
+
+
+def test_scale_dry_run_no_more_tpu():
+    # reference :161-177
+    r = ClusterResource(
+        cpu_total_milli=2000,
+        memory_request_mega=100, memory_limit_mega=100, memory_total_mega=1000,
+        tpu_limit=10, tpu_request=10, tpu_total=10,
+        nodes=all_idle_nodes(),
+    )
+    j = make_job("name", "1", "1", "10Mi", "10Mi", "1", 1, 3, 1)
+    assert scale_dry_run(r, j, 0, 1.0, False) == 0
+
+
+def test_scale_dry_run_scale_down_more_than_expected():
+    # reference :179-197 — parallelism 6 with max 3: forced down one per step
+    r = ClusterResource(
+        cpu_limit_milli=1000, cpu_request_milli=1000, cpu_total_milli=1000,
+        memory_request_mega=1000, memory_limit_mega=1000, memory_total_mega=1000,
+        tpu_limit=10, tpu_request=10, tpu_total=10,
+    )
+    j = make_job("name", "1", "1", "10Mi", "10Mi", "0", 1, 3, 6)
+    assert scale_dry_run(r, j, 0, 1.0, True) == -1
+    assert scale_dry_run(r, j, -1, 1.0, True) == -1
+    assert scale_dry_run(r, j, -2, 1.0, True) == -1
+    assert scale_dry_run(r, j, -3, 1.0, True) == 0
+
+
+def test_scale_dry_run_scale_down_to_min():
+    # reference :199-217
+    r = ClusterResource(
+        cpu_limit_milli=5000, cpu_request_milli=5000, cpu_total_milli=3000,
+        memory_request_mega=1000, memory_limit_mega=1000, memory_total_mega=1000,
+        tpu_limit=10, tpu_request=10, tpu_total=10,
+        nodes=all_idle_nodes(),
+    )
+    j = make_job("name", "1", "1", "10Mi", "10Mi", "0", 1, 3, 3)
+    assert scale_dry_run(r, j, 0, 1.0, True) == -1
+    assert scale_dry_run(r, j, -1, 1.0, True) == -1
+    assert scale_dry_run(r, j, -2, 1.0, True) == 0
+
+
+def test_scale_dry_run_scale_down_full_cluster():
+    # reference :219-236
+    r = ClusterResource(
+        cpu_limit_milli=2000, cpu_request_milli=2000, cpu_total_milli=1000,
+        memory_request_mega=1000, memory_limit_mega=1000, memory_total_mega=1000,
+        tpu_limit=10, tpu_request=10, tpu_total=10,
+        nodes=all_idle_nodes(),
+    )
+    j = make_job("name", "1", "1", "10Mi", "10Mi", "0", 1, 3, 3)
+    assert scale_dry_run(r, j, 0, 1.0, True) == -1
+    # "should not scale down if the scale down parameter is false"
+    assert scale_dry_run(r, j, 0, 1.0, False) == 0
+
+
+def test_scale_dry_run_no_mem():
+    # reference :238-254
+    r = ClusterResource(
+        cpu_limit_milli=1000, cpu_request_milli=1000, cpu_total_milli=1000,
+        memory_request_mega=1000, memory_limit_mega=1000, memory_total_mega=1000,
+        tpu_limit=10, tpu_request=10, tpu_total=10,
+        nodes=all_idle_nodes(),
+    )
+    j = make_job("name", "1", "1", "100Mi", "100Mi", "0", 1, 3, 1)
+    assert scale_dry_run(r, j, 0, 1.0, False) == 0
+
+
+def test_scale_all_dry_run_no_mem():
+    # reference :256-269
+    r = ClusterResource(
+        cpu_total_milli=1000,
+        memory_request_mega=1000, memory_limit_mega=1000, memory_total_mega=1000,
+        tpu_total=10,
+        nodes=all_idle_nodes(),
+    )
+    j = make_job("name", "1", "1", "1", "1", "1", 1, 3, 1)
+    assert scale_all_jobs_dry_run([j], r, 1.0)["default/name"] == 0
+
+
+def test_scale_all_dry_run():
+    # reference :271-288
+    r = ClusterResource(
+        cpu_limit_milli=1000, cpu_request_milli=1000, cpu_total_milli=4000,
+        memory_request_mega=100, memory_limit_mega=100, memory_total_mega=1000,
+        tpu_limit=8, tpu_request=8, tpu_total=10,
+        nodes=all_idle_nodes(),
+    )
+    j = make_job("name", "1", "1", "100Mi", "100Mi", "0", 1, 3, 1)
+    assert scale_all_jobs_dry_run([j], r, 1.0)["default/name"] == 2
+
+
+def test_scale_all_dry_run_not_full():
+    # reference :290-307 — maxLoadDesired 0.8 leaves headroom unused
+    r = ClusterResource(
+        cpu_limit_milli=1000, cpu_request_milli=1000, cpu_total_milli=3000,
+        memory_request_mega=100, memory_limit_mega=100, memory_total_mega=1000,
+        tpu_total=10,
+        nodes=all_idle_nodes(),
+    )
+    j = make_job("name", "1", "1", "100Mi", "100Mi", "0", 1, 3, 1)
+    assert scale_all_jobs_dry_run([j], r, 0.8)["default/name"] == 1
+
+
+def test_scale_all_dry_run_down_not_full():
+    # reference :309-326 — over the 0.8 ceiling: scale down
+    r = ClusterResource(
+        cpu_limit_milli=3000, cpu_request_milli=3000, cpu_total_milli=3000,
+        memory_request_mega=100, memory_limit_mega=100, memory_total_mega=1000,
+        tpu_total=10,
+        nodes=all_idle_nodes(),
+    )
+    j = make_job("name", "1", "1", "100Mi", "100Mi", "0", 1, 3, 3)
+    assert scale_all_jobs_dry_run([j], r, 0.8)["default/name"] == -1
+
+
+def test_scale_all_dry_run_less_cpu():
+    # reference :328-345 — CPU runs out before chips
+    r = ClusterResource(
+        cpu_limit_milli=2000, cpu_request_milli=2000, cpu_total_milli=3000,
+        memory_request_mega=100, memory_limit_mega=100, memory_total_mega=1000,
+        tpu_limit=8, tpu_request=8, tpu_total=10,
+        nodes=all_idle_nodes(),
+    )
+    j = make_job("name", "1", "1", "1", "1", "1", 1, 3, 1)
+    assert scale_all_jobs_dry_run([j], r, 1.0)["default/name"] == 1
+
+
+def test_scale_all_dry_run_less_tpu():
+    # reference :347-364 — chips run out before CPU
+    r = ClusterResource(
+        cpu_limit_milli=990, cpu_request_milli=990, cpu_total_milli=2000,
+        memory_request_mega=100, memory_limit_mega=100, memory_total_mega=1000,
+        tpu_limit=9, tpu_request=9, tpu_total=10,
+        nodes=all_idle_nodes(),
+    )
+    j = make_job("name", "1", "1", "1", "1", "1", 1, 3, 1)
+    assert scale_all_jobs_dry_run([j], r, 1.0)["default/name"] == 1
+
+
+def test_fulfillment():
+    # reference :366-375
+    assert make_job("name", "1", "1", "1", "1", "1", 1, 2, 2).fulfillment() == 1.0
+    assert make_job("name", "1", "1", "1", "1", "1", 1, 2, 1).fulfillment() == 0.0
+    assert make_job("name", "1", "1", "1", "1", "1", 1, 3, 2).fulfillment() == 0.5
+
+
+def test_sorted_jobs():
+    # reference :377-398 — 'd' dropped by elastic filter; needy first
+    jobs = [
+        make_job("a", "1", "1", "1", "1", "1", 1, 2, 2),
+        make_job("b", "1", "1", "1", "1", "1", 1, 20, 2),
+        make_job("c", "1", "1", "1", "1", "1", 1, 10, 2),
+        make_job("d", "1", "1", "1", "1", "1", 1, 1, 2),
+    ]
+    assert [j.name for j in sorted_jobs(jobs, elastic)] == ["b", "c", "a"]
+
+
+def test_sorted_jobs_tpu_only():
+    # reference :400-420 — accelerator filter
+    jobs = [
+        make_job("a", "1", "1", "1", "1", "1", 1, 2, 2),
+        make_job("b", "1", "1", "1", "1", "0", 1, 20, 2),
+        make_job("c", "1", "1", "1", "1", "0", 1, 10, 2),
+        make_job("d", "1", "1", "1", "1", "0", 1, 1, 2),
+    ]
+    assert [j.name for j in sorted_jobs(jobs, need_tpu)] == ["a"]
+
+
+def test_sorted_jobs_with_tie():
+    # reference :422-438 — equal fulfillment, tiebreak chips→CPU→mem
+    jobs = [
+        make_job("a", "1", "0", "1", "1", "1", 1, 2, 1),
+        make_job("b", "1", "1", "1", "1", "0", 1, 2, 1),
+        make_job("c", "10", "10", "1", "1", "0", 1, 2, 1),
+        make_job("d", "1", "1", "2", "2", "0", 1, 2, 1),
+    ]
+    assert [j.name for j in sorted_jobs(jobs, elastic)] == ["b", "d", "c", "a"]
+
+
+# ---------------------------------------------------------------------------
+# TPU slice-shape policy extensions (no reference equivalent: GPU workers
+# scale ±1; TPU meshes scale between valid shapes).
+# ---------------------------------------------------------------------------
+
+
+def big_cluster(cpu=64_000, mem=64_000, tpu=0):
+    return ClusterResource(
+        cpu_total_milli=cpu, memory_total_mega=mem, tpu_total=tpu,
+        nodes=NodeResources(
+            nodes_cpu_idle_milli={"node0": cpu},
+            nodes_memory_free_mega={"node0": mem},
+        ),
+    )
+
+
+def test_pow2_policy_steps_through_valid_counts():
+    j = make_job("j", "1", "1", "1Mi", "1Mi", "0", 1, 8, 1, policy=POW2_POLICY)
+    diff = scale_all_jobs_dry_run([j], big_cluster(), 1.0)
+    assert diff["default/j"] == 7  # 1 → 2 → 4 → 8, total +7
+
+
+def test_pow2_policy_stops_at_largest_valid_count_below_max():
+    # max 6 is not a power of two: the planner stops at 4 (the largest valid
+    # count <= max) and never actuates an invalid mesh size.
+    j = make_job("j", "1", "1", "1Mi", "1Mi", "0", 1, 6, 1, policy=POW2_POLICY)
+    diff = scale_all_jobs_dry_run([j], big_cluster(), 1.0)
+    assert 1 + diff["default/j"] == 4
+
+
+def test_pow2_policy_rejects_partial_steps():
+    # Room for only 1 more instance: the 2→4 step (needs 2) must not happen.
+    r = ClusterResource(
+        cpu_total_milli=3000, cpu_request_milli=2000,
+        memory_total_mega=64_000,
+        nodes=NodeResources(
+            nodes_cpu_idle_milli={"node0": 1000},
+            nodes_memory_free_mega={"node0": 64_000},
+        ),
+    )
+    j = make_job("j", "1", "1", "1Mi", "1Mi", "0", 1, 8, 2, policy=POW2_POLICY)
+    diff = scale_all_jobs_dry_run([j], r, 1.0)
+    assert diff["default/j"] == 0
+
+
+def test_pow2_policy_scale_down_steps():
+    # Overloaded cluster: 8 → 4 in one policy step.
+    r = ClusterResource(
+        cpu_total_milli=1000, cpu_request_milli=8000, memory_total_mega=1000,
+    )
+    j = make_job("j", "1", "1", "1Mi", "1Mi", "0", 2, 8, 8, policy=POW2_POLICY)
+    assert scale_dry_run(r, j, 0, 1.0, True) == -4
+    assert scale_dry_run(r, j, -4, 1.0, True) == -2
+    # at min=2: stop
+    assert scale_dry_run(r, j, -6, 1.0, True) == 0
+
+
+def test_explicit_policy_snaps_to_slice_worker_counts():
+    pol = explicit_policy([1, 4, 8, 16])
+    j = make_job("j", "1", "1", "1Mi", "1Mi", "0", 1, 16, 1, policy=pol)
+    diff = scale_all_jobs_dry_run([j], big_cluster(), 1.0)
+    assert 1 + diff["default/j"] == 16
+
+
+def test_planner_does_not_mutate_input_snapshot():
+    # The reference relies on pass-by-value (autoscaler.go:296); we copy.
+    r = big_cluster()
+    before = (r.cpu_request_milli, dict(r.nodes.nodes_cpu_idle_milli))
+    j = make_job("j", "1", "1", "1Mi", "1Mi", "0", 1, 4, 1)
+    scale_all_jobs_dry_run([j], r, 1.0)
+    assert (r.cpu_request_milli, dict(r.nodes.nodes_cpu_idle_milli)) == before
+
+
+def test_two_jobs_share_cluster_fairly():
+    # Two identical elastic jobs on a cluster with room for 6 trainers total:
+    # the fixpoint should land them at equal-ish fulfillment, both >= min.
+    # snapshot already accounts the two running trainers (one per job)
+    r = ClusterResource(
+        cpu_total_milli=6000, cpu_request_milli=2000, memory_total_mega=64_000,
+        nodes=NodeResources(
+            nodes_cpu_idle_milli={"node0": 4000},
+            nodes_memory_free_mega={"node0": 64_000},
+        ),
+    )
+    a = make_job("a", "1", "1", "1Mi", "1Mi", "0", 1, 10, 1)
+    b = make_job("b", "1", "1", "1Mi", "1Mi", "0", 1, 10, 1)
+    diff = scale_all_jobs_dry_run([a, b], r, 1.0)
+    assert diff["default/a"] + diff["default/b"] == 4  # all 6 CPUs in use
+    assert abs((1 + diff["default/a"]) - (1 + diff["default/b"])) <= 1

@@ -1,0 +1,70 @@
+"""Quantity parsing/scaling parity with k8s resource.Quantity
+(assertions from reference pkg/autoscaler_internal_test.go:96-101 et al.)."""
+
+from fractions import Fraction
+
+import pytest
+
+from edl_tpu.api.quantity import MEGA, MILLI, Quantity
+
+
+def test_trainer_request_limit_units():
+    # reference autoscaler_internal_test.go:96-101
+    assert Quantity("1k").milli_value() == 1_000_000
+    assert Quantity("100Mi").scaled_value(MEGA) == 105
+    assert Quantity("10").value() == 10
+
+
+def test_plain_and_milli():
+    assert Quantity("1").milli_value() == 1000
+    assert Quantity("250m").milli_value() == 250
+    assert Quantity("1.5").milli_value() == 1500
+    assert Quantity("2500m").value() == 3  # rounds up like k8s Value()
+
+
+def test_binary_suffixes():
+    assert Quantity("1Ki").exact == 1024
+    assert Quantity("10Mi").scaled_value(MEGA) == 11  # 10.48576 MB rounds up
+    assert Quantity("1Gi").scaled_value(MEGA) == 1074
+
+
+def test_decimal_suffixes_and_exponent():
+    assert Quantity("1M").exact == 10**6
+    assert Quantity("2e3").exact == 2000
+    assert Quantity("1E").exact == 10**18
+
+
+def test_small_quantities():
+    assert Quantity("100n").exact == Fraction(100, 10**9)
+    assert Quantity("1u").milli_value() == 1  # rounds up to one milli
+
+
+def test_arithmetic_and_comparison():
+    assert Quantity("1") + Quantity("500m") == Quantity("1500m")
+    assert Quantity("2") - Quantity("1") == Quantity("1")
+    assert Quantity("1") < Quantity("10")
+    assert Quantity("1024") > Quantity("1Ki") - Quantity("1")
+    assert Quantity("1Ki") == Quantity("1024")
+    assert sorted([Quantity("10"), Quantity("1"), Quantity("2")])[0] == Quantity("1")
+
+
+def test_zero_and_bool():
+    assert Quantity("0").is_zero()
+    assert not Quantity("0")
+    assert Quantity("1m")
+
+
+def test_negative():
+    assert Quantity("-1500m").value() == -2  # rounds away from zero
+    assert Quantity("-1").milli_value() == -1000
+
+
+def test_invalid():
+    for bad in ["", "abc", "1x", "--1", "1.2.3"]:
+        with pytest.raises(ValueError):
+            Quantity(bad)
+
+
+def test_str_roundtrip():
+    for s in ["1", "250m", "1024", "1500m"]:
+        assert Quantity(str(Quantity(s))) == Quantity(s)
