@@ -1,0 +1,50 @@
+"""Coordination service: task-lease queue + membership epochs + KV.
+
+Native C++ core (edl_tpu/coord/native/) replacing the reference's external
+Go master task-queue server and etcd sidecar (reference docker/paddle_k8s:26-32,
+pkg/jobparser.go:167-184).  Three ways to hold it:
+
+* :func:`local_service` — in-process via ctypes (tests, single-host runs);
+* :class:`CoordClient` — TCP client to an ``edl-coord-server`` process
+  (multi-process / multi-host; ``python -m edl_tpu.coord.server``);
+* :class:`PyCoordService` — pure-Python fallback when no C++ toolchain
+  exists (same semantics, same tests).
+
+All three expose the same method surface (see :class:`PyCoordService` for
+the canonical signatures).
+"""
+
+from edl_tpu.coord.service import (
+    DEFAULT_MEMBER_TTL_MS,
+    DEFAULT_TASK_TIMEOUT_MS,
+    LeaseStatus,
+    PyCoordService,
+    QueueStats,
+)
+from edl_tpu.coord.bindings import NativeCoordService, native_available
+from edl_tpu.coord.client import CoordClient
+from edl_tpu.coord.server import spawn_server
+
+
+def local_service(task_timeout_ms: int = DEFAULT_TASK_TIMEOUT_MS,
+                  passes: int = 1,
+                  member_ttl_ms: int = DEFAULT_MEMBER_TTL_MS,
+                  prefer_native: bool = True):
+    """In-process coordination service: native if buildable, else Python."""
+    if prefer_native and native_available():
+        return NativeCoordService(task_timeout_ms, passes, member_ttl_ms)
+    return PyCoordService(task_timeout_ms, passes, member_ttl_ms)
+
+
+__all__ = [
+    "CoordClient",
+    "DEFAULT_MEMBER_TTL_MS",
+    "DEFAULT_TASK_TIMEOUT_MS",
+    "LeaseStatus",
+    "NativeCoordService",
+    "PyCoordService",
+    "QueueStats",
+    "local_service",
+    "native_available",
+    "spawn_server",
+]

@@ -1,0 +1,254 @@
+"""ctypes bindings to the native coordination core (libedlcoord.so).
+
+Builds the library on demand via the Makefile (g++ is part of the build
+image); :func:`native_available` gates callers so environments without a
+toolchain fall back to :class:`~edl_tpu.coord.service.PyCoordService`.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import subprocess
+import threading
+import time
+from pathlib import Path
+from typing import Optional
+
+from edl_tpu.coord.service import (
+    DEFAULT_MEMBER_TTL_MS,
+    DEFAULT_TASK_TIMEOUT_MS,
+    LeaseStatus,
+    QueueStats,
+)
+from edl_tpu.observability.logging import get_logger
+
+log = get_logger("coord.bindings")
+
+NATIVE_DIR = Path(__file__).resolve().parent / "native"
+LIB_PATH = NATIVE_DIR / "build" / "libedlcoord.so"
+SERVER_PATH = NATIVE_DIR / "build" / "edl-coord-server"
+
+_build_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+
+
+def ensure_built() -> bool:
+    """(Re)build the native core.  Always invokes make — it is incremental
+    and near-free when up to date — so source edits are never shadowed by
+    stale artifacts; falls back to existing artifacts if make is missing."""
+    with _build_lock:
+        try:
+            subprocess.run(
+                ["make", "-C", str(NATIVE_DIR)],
+                check=True, capture_output=True, text=True, timeout=300,
+            )
+        except (OSError, subprocess.SubprocessError) as exc:
+            if LIB_PATH.exists() and SERVER_PATH.exists():
+                log.warn("make failed; using existing native artifacts",
+                         error=str(exc))
+                return True
+            log.warn("native coord build failed; using Python fallback",
+                     error=str(exc))
+            return False
+    return LIB_PATH.exists()
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib
+    if _lib is not None:
+        return _lib
+    if not ensure_built():
+        return None
+    lib = ctypes.CDLL(str(LIB_PATH))
+    i64, i32, vp, cp = (ctypes.c_int64, ctypes.c_int, ctypes.c_void_p,
+                        ctypes.c_char_p)
+    pi64 = ctypes.POINTER(i64)
+    sigs = {
+        "edl_service_new": ([i64, i32, i64], vp),
+        "edl_service_free": ([vp], None),
+        "edl_now_ms": ([], i64),
+        "edl_tq_add": ([vp, cp, i64], i64),
+        "edl_tq_lease": ([vp, cp, i64, pi64, cp, i64, pi64], i32),
+        "edl_tq_complete": ([vp, i64, cp], i32),
+        "edl_tq_fail": ([vp, i64, cp], i32),
+        "edl_tq_peek_leased": ([vp, i64, cp, i64], i64),
+        "edl_tq_redispatch": ([vp, i64], i32),
+        "edl_tq_release_worker": ([vp, cp], i32),
+        "edl_tq_all_done": ([vp], i32),
+        "edl_tq_pass": ([vp], i32),
+        "edl_tq_stats": ([vp, pi64, pi64, pi64, pi64], None),
+        "edl_mb_join": ([vp, cp, cp, i64], i64),
+        "edl_mb_heartbeat": ([vp, cp, i64], i32),
+        "edl_mb_leave": ([vp, cp], i32),
+        "edl_mb_expire": ([vp, i64], i32),
+        "edl_mb_epoch": ([vp], i64),
+        "edl_mb_members": ([vp, i64, cp, i64], i64),
+        "edl_kv_set": ([vp, cp, cp, i64], None),
+        "edl_kv_get": ([vp, cp, cp, i64], i64),
+        "edl_kv_del": ([vp, cp], i32),
+        "edl_kv_cas": ([vp, cp, cp, i64, cp, i64], i32),
+        "edl_kv_keys": ([vp, cp, cp, i64], i64),
+    }
+    for name, (argtypes, restype) in sigs.items():
+        fn = getattr(lib, name)
+        fn.argtypes = argtypes
+        fn.restype = restype
+    _lib = lib
+    return lib
+
+
+def native_available() -> bool:
+    try:
+        return _load() is not None
+    except OSError:
+        return False
+
+
+def _default_clock() -> int:
+    return time.monotonic_ns() // 1_000_000
+
+
+class NativeCoordService:
+    """In-process handle over the C++ core; method surface identical to
+    :class:`~edl_tpu.coord.service.PyCoordService` (the canonical spec)."""
+
+    _INITIAL_BUF = 1 << 16
+
+    def __init__(
+        self,
+        task_timeout_ms: int = DEFAULT_TASK_TIMEOUT_MS,
+        passes: int = 1,
+        member_ttl_ms: int = DEFAULT_MEMBER_TTL_MS,
+        clock=_default_clock,
+    ) -> None:
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native coord core unavailable")
+        self._lib = lib
+        self._clock = clock
+        self._buf_cap = self._INITIAL_BUF
+        self._h = lib.edl_service_new(task_timeout_ms, passes, member_ttl_ms)
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.edl_service_free(self._h)
+            self._h = None
+
+    def __del__(self) -> None:  # best-effort
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    # -- task queue --------------------------------------------------------
+
+    def add_task(self, payload: bytes) -> int:
+        return self._lib.edl_tq_add(self._h, payload, len(payload))
+
+    def lease(self, worker: str) -> tuple[LeaseStatus, int, bytes]:
+        task_id = ctypes.c_int64(-1)
+        plen = ctypes.c_int64(0)
+        buf = ctypes.create_string_buffer(self._buf_cap)
+        rc = self._lib.edl_tq_lease(
+            self._h, worker.encode(), self._clock(),
+            ctypes.byref(task_id), buf, self._buf_cap, ctypes.byref(plen),
+        )
+        if rc != 0:
+            return (LeaseStatus(rc), -1, b"")
+        if plen.value > self._buf_cap:
+            # Payload didn't fit: the task is leased to us, so re-read it
+            # through the peek API with a big-enough buffer.
+            self._buf_cap = max(self._buf_cap * 2, plen.value)
+            buf = ctypes.create_string_buffer(self._buf_cap)
+            n = self._lib.edl_tq_peek_leased(self._h, task_id.value, buf,
+                                             self._buf_cap)
+            return (LeaseStatus.OK, task_id.value, buf.raw[:max(n, 0)])
+        return (LeaseStatus.OK, task_id.value, buf.raw[: plen.value])
+
+    def complete(self, task_id: int, worker: str | None = None) -> bool:
+        w = (worker or "").encode()
+        return bool(self._lib.edl_tq_complete(self._h, task_id, w))
+
+    def fail(self, task_id: int, worker: str | None = None) -> bool:
+        w = (worker or "").encode()
+        return bool(self._lib.edl_tq_fail(self._h, task_id, w))
+
+    def redispatch(self) -> int:
+        return self._lib.edl_tq_redispatch(self._h, self._clock())
+
+    def release_worker(self, worker: str) -> int:
+        return self._lib.edl_tq_release_worker(self._h, worker.encode())
+
+    def all_done(self) -> bool:
+        return bool(self._lib.edl_tq_all_done(self._h))
+
+    def current_pass(self) -> int:
+        return self._lib.edl_tq_pass(self._h)
+
+    def stats(self) -> QueueStats:
+        vals = [ctypes.c_int64(0) for _ in range(4)]
+        self._lib.edl_tq_stats(self._h, *[ctypes.byref(v) for v in vals])
+        return QueueStats(vals[0].value, vals[1].value, vals[2].value,
+                          vals[3].value, self.current_pass())
+
+    # -- membership --------------------------------------------------------
+
+    def join(self, name: str, address: str = "") -> int:
+        return self._lib.edl_mb_join(self._h, name.encode(), address.encode(),
+                                     self._clock())
+
+    def heartbeat(self, name: str) -> bool:
+        return bool(self._lib.edl_mb_heartbeat(self._h, name.encode(),
+                                               self._clock()))
+
+    def leave(self, name: str) -> bool:
+        return bool(self._lib.edl_mb_leave(self._h, name.encode()))
+
+    def expire_members(self) -> int:
+        return self._lib.edl_mb_expire(self._h, self._clock())
+
+    def epoch(self) -> int:
+        return self._lib.edl_mb_epoch(self._h)
+
+    def members(self) -> tuple[int, list[tuple[str, str]]]:
+        n, buf = self._grown(lambda b, cap: self._lib.edl_mb_members(
+            self._h, self._clock(), b, cap))
+        out = []
+        for line in buf.raw[:n].decode().splitlines():
+            if "=" in line:
+                name, addr = line.split("=", 1)
+                out.append((name, addr))
+        return self.epoch(), out
+
+    # -- kv ----------------------------------------------------------------
+
+    def kv_set(self, key: str, value: bytes) -> None:
+        self._lib.edl_kv_set(self._h, key.encode(), value, len(value))
+
+    def kv_get(self, key: str) -> Optional[bytes]:
+        n, buf = self._grown(lambda b, cap: self._lib.edl_kv_get(
+            self._h, key.encode(), b, cap))
+        if n < 0:
+            return None
+        return buf.raw[:n]
+
+    def kv_del(self, key: str) -> bool:
+        return bool(self._lib.edl_kv_del(self._h, key.encode()))
+
+    def kv_cas(self, key: str, expect: bytes, value: bytes) -> bool:
+        return bool(self._lib.edl_kv_cas(self._h, key.encode(), expect,
+                                         len(expect), value, len(value)))
+
+    def kv_keys(self, prefix: str = "") -> list[str]:
+        n, buf = self._grown(lambda b, cap: self._lib.edl_kv_keys(
+            self._h, prefix.encode(), b, cap))
+        return [k for k in buf.raw[:max(n, 0)].decode().splitlines() if k]
+
+    def _grown(self, call):
+        """Run a fill-buffer C call, growing the buffer until it fits."""
+        while True:
+            buf = ctypes.create_string_buffer(self._buf_cap)
+            n = call(buf, self._buf_cap)
+            if n <= self._buf_cap:
+                return n, buf
+            self._buf_cap = max(self._buf_cap * 2, n)

@@ -1,0 +1,151 @@
+"""TCP client for the edl-coord-server (multi-process / multi-host path).
+
+Speaks the newline protocol documented in native/server.cc; same method
+surface as PyCoordService/NativeCoordService, so trainers are agnostic to
+whether coordination is in-process or remote.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+from typing import Optional
+
+from edl_tpu.coord.service import LeaseStatus, QueueStats
+
+
+class CoordError(RuntimeError):
+    pass
+
+
+class CoordClient:
+    def __init__(self, host: str, port: int, timeout: float = 10.0) -> None:
+        self.host = host
+        self.port = port
+        self._lock = threading.Lock()
+        self._sock = socket.create_connection((host, port), timeout=timeout)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._rfile = self._sock.makefile("rb")
+
+    def close(self) -> None:
+        try:
+            self._rfile.close()
+            self._sock.close()
+        except OSError:
+            pass
+
+    def _call(self, *parts: str) -> list[str]:
+        line = (" ".join(parts) + "\n").encode()
+        with self._lock:
+            self._sock.sendall(line)
+            resp = self._rfile.readline()
+        if not resp:
+            raise CoordError("coordination server closed the connection")
+        return resp.decode().strip().split(" ")
+
+    # -- task queue --------------------------------------------------------
+
+    def add_task(self, payload: bytes) -> int:
+        r = self._call("ADD", payload.hex() or "-")
+        if r[0] != "OK":
+            raise CoordError(" ".join(r))
+        return int(r[1])
+
+    def lease(self, worker: str) -> tuple[LeaseStatus, int, bytes]:
+        r = self._call("LEASE", worker)
+        if r[0] == "OK":
+            payload = bytes.fromhex(r[2]) if len(r) > 2 else b""
+            return (LeaseStatus.OK, int(r[1]), payload)
+        if r[0] == "EMPTY":
+            return (LeaseStatus.EMPTY, -1, b"")
+        if r[0] == "DONE":
+            return (LeaseStatus.DONE, -1, b"")
+        raise CoordError(" ".join(r))
+
+    def complete(self, task_id: int, worker: str | None = None) -> bool:
+        args = ["COMPLETE", str(task_id)] + ([worker] if worker else [])
+        return self._call(*args)[0] == "OK"
+
+    def fail(self, task_id: int, worker: str | None = None) -> bool:
+        args = ["FAIL", str(task_id)] + ([worker] if worker else [])
+        return self._call(*args)[0] == "OK"
+
+    def release_worker(self, worker: str) -> int:
+        r = self._call("RELEASE", worker)
+        return int(r[1]) if r[0] == "OK" else 0
+
+    def stats(self) -> QueueStats:
+        r = self._call("STATS")
+        if r[0] != "OK":
+            raise CoordError(" ".join(r))
+        return QueueStats(int(r[1]), int(r[2]), int(r[3]), int(r[4]), int(r[5]))
+
+    def all_done(self) -> bool:
+        s = self.stats()
+        # DONE is only authoritative from LEASE; stats approximates it.
+        return s.todo == 0 and s.leased == 0
+
+    def current_pass(self) -> int:
+        return self.stats().current_pass
+
+    # -- membership --------------------------------------------------------
+
+    def join(self, name: str, address: str = "") -> int:
+        r = self._call("JOIN", name, address or "-")
+        if r[0] != "OK":
+            raise CoordError(" ".join(r))
+        return int(r[1])
+
+    def heartbeat(self, name: str) -> bool:
+        return self._call("HB", name)[0] == "OK"
+
+    def leave(self, name: str) -> bool:
+        return self._call("LEAVE", name)[0] == "OK"
+
+    def epoch(self) -> int:
+        return self.members()[0]
+
+    def members(self) -> tuple[int, list[tuple[str, str]]]:
+        r = self._call("MEMBERS")
+        if r[0] != "OK":
+            raise CoordError(" ".join(r))
+        epoch = int(r[1])
+        out: list[tuple[str, str]] = []
+        if len(r) > 2 and r[2]:
+            for item in r[2].split(","):
+                if "=" in item:
+                    name, addr = item.split("=", 1)
+                    out.append((name, "" if addr == "-" else addr))
+        return epoch, out
+
+    # -- kv ----------------------------------------------------------------
+
+    def kv_set(self, key: str, value: bytes) -> None:
+        r = self._call("KVSET", key, value.hex() or "-")
+        if r[0] != "OK":
+            raise CoordError(" ".join(r))
+
+    def kv_get(self, key: str) -> Optional[bytes]:
+        r = self._call("KVGET", key)
+        if r[0] == "NONE":
+            return None
+        return bytes.fromhex(r[1]) if len(r) > 1 else b""
+
+    def kv_del(self, key: str) -> bool:
+        return self._call("KVDEL", key)[0] == "OK"
+
+    def kv_cas(self, key: str, expect: bytes, value: bytes) -> bool:
+        exp = expect.hex() if expect else "-"
+        return self._call("KVCAS", key, exp, value.hex() or "-")[0] == "OK"
+
+    def kv_keys(self, prefix: str = "") -> list[str]:
+        r = self._call("KEYS", prefix) if prefix else self._call("KEYS")
+        if r[0] != "OK":
+            raise CoordError(" ".join(r))
+        return [k for k in (r[1].split(",") if len(r) > 1 and r[1] else [])]
+
+    def ping(self) -> bool:
+        try:
+            return self._call("PING")[0] == "PONG"
+        except (CoordError, OSError):
+            return False

@@ -1,0 +1,268 @@
+// Implementation of the edl_tpu coordination core. See coord.hpp.
+
+#include "coord.hpp"
+
+#include <algorithm>
+
+namespace edlcoord {
+
+// ---------------------------------------------------------------- TaskQueue
+
+TaskQueue::TaskQueue(int64_t timeout_ms, int passes, int max_failures)
+    : timeout_ms_(timeout_ms),
+      total_passes_(passes < 1 ? 1 : passes),
+      max_failures_(max_failures) {}
+
+int64_t TaskQueue::AddTask(const std::string& payload) {
+  std::lock_guard<std::mutex> lock(mu_);
+  Task t;
+  t.id = next_id_++;
+  t.payload = payload;
+  todo_.push_back(std::move(t));
+  return next_id_ - 1;
+}
+
+LeaseResult TaskQueue::LeaseTask(const std::string& worker, int64_t now_ms,
+                                 Lease* out) {
+  std::lock_guard<std::mutex> lock(mu_);
+  // Reclaim expired leases first so a dead trainer's tasks flow to the
+  // living (the master's 16 s re-dispatch semantics).
+  for (auto it = leased_.begin(); it != leased_.end();) {
+    if (it->second.deadline_ms <= now_ms) {
+      todo_.push_back(std::move(it->second.task));
+      it = leased_.erase(it);
+    } else {
+      ++it;
+    }
+  }
+  MaybeAdvancePass();
+  if (todo_.empty()) {
+    bool finished = leased_.empty() && pass_ + 1 >= total_passes_;
+    return finished ? LeaseResult::kAllDone : LeaseResult::kEmpty;
+  }
+  Task t = std::move(todo_.front());
+  todo_.pop_front();
+  Leased l;
+  l.worker = worker;
+  l.deadline_ms = now_ms + timeout_ms_;
+  out->task_id = t.id;
+  out->payload = t.payload;
+  l.task = std::move(t);
+  leased_[out->task_id] = std::move(l);
+  return LeaseResult::kOk;
+}
+
+bool TaskQueue::Complete(int64_t task_id, const std::string& worker) {
+  std::lock_guard<std::mutex> lock(mu_);
+  auto it = leased_.find(task_id);
+  if (it == leased_.end()) return false;  // late completion after re-dispatch
+  if (!worker.empty() && it->second.worker != worker) return false;
+  done_.push_back(std::move(it->second.task));
+  leased_.erase(it);
+  MaybeAdvancePass();
+  return true;
+}
+
+bool TaskQueue::Fail(int64_t task_id, const std::string& worker) {
+  std::lock_guard<std::mutex> lock(mu_);
+  auto it = leased_.find(task_id);
+  if (it == leased_.end()) return false;
+  if (!worker.empty() && it->second.worker != worker) return false;
+  Task t = std::move(it->second.task);
+  leased_.erase(it);
+  t.failures += 1;
+  if (t.failures >= max_failures_) {
+    dropped_ += 1;  // poison pill: drop rather than wedge the pass
+  } else {
+    todo_.push_back(std::move(t));
+  }
+  MaybeAdvancePass();
+  return true;
+}
+
+bool TaskQueue::PeekLeased(int64_t task_id, std::string* payload) const {
+  std::lock_guard<std::mutex> lock(mu_);
+  auto it = leased_.find(task_id);
+  if (it == leased_.end()) return false;
+  *payload = it->second.task.payload;
+  return true;
+}
+
+int TaskQueue::Redispatch(int64_t now_ms) {
+  std::lock_guard<std::mutex> lock(mu_);
+  int n = 0;
+  for (auto it = leased_.begin(); it != leased_.end();) {
+    if (it->second.deadline_ms <= now_ms) {
+      todo_.push_back(std::move(it->second.task));
+      it = leased_.erase(it);
+      ++n;
+    } else {
+      ++it;
+    }
+  }
+  return n;
+}
+
+int TaskQueue::ReleaseWorker(const std::string& worker) {
+  std::lock_guard<std::mutex> lock(mu_);
+  int n = 0;
+  for (auto it = leased_.begin(); it != leased_.end();) {
+    if (it->second.worker == worker) {
+      todo_.push_back(std::move(it->second.task));
+      it = leased_.erase(it);
+      ++n;
+    } else {
+      ++it;
+    }
+  }
+  return n;
+}
+
+void TaskQueue::MaybeAdvancePass() {
+  // Called with mu_ held. A pass ends when nothing is waiting or leased;
+  // earlier passes recycle the done tasks (multi-pass training,
+  // `passes` in the job spec — reference pkg/resource/training_job.go:125).
+  if (!todo_.empty() || !leased_.empty()) return;
+  if (pass_ + 1 < total_passes_) {
+    if (!done_.empty()) {
+      for (auto& t : done_) {
+        t.failures = 0;
+        todo_.push_back(std::move(t));
+      }
+      done_.clear();
+      pass_ += 1;
+    } else {
+      // Nothing survives to recycle (zero tasks, or every task dropped as
+      // a poison pill): later passes would be empty too — finish now
+      // instead of livelocking every LeaseTask on kEmpty.
+      pass_ = total_passes_ - 1;
+    }
+  }
+}
+
+bool TaskQueue::AllDone() const {
+  std::lock_guard<std::mutex> lock(mu_);
+  return todo_.empty() && leased_.empty() && pass_ + 1 >= total_passes_;
+}
+
+int TaskQueue::CurrentPass() const {
+  std::lock_guard<std::mutex> lock(mu_);
+  return pass_;
+}
+
+void TaskQueue::Stats(int64_t* todo, int64_t* leased, int64_t* done,
+                      int64_t* dropped) const {
+  std::lock_guard<std::mutex> lock(mu_);
+  *todo = static_cast<int64_t>(todo_.size());
+  *leased = static_cast<int64_t>(leased_.size());
+  *done = static_cast<int64_t>(done_.size());
+  *dropped = dropped_;
+}
+
+// --------------------------------------------------------------- Membership
+
+Membership::Membership(int64_t ttl_ms) : ttl_ms_(ttl_ms) {}
+
+int64_t Membership::Join(const std::string& name, const std::string& address,
+                         int64_t now_ms) {
+  std::lock_guard<std::mutex> lock(mu_);
+  auto it = members_.find(name);
+  bool change = it == members_.end() || it->second.address != address;
+  MemberInfo& m = members_[name];
+  m.name = name;
+  m.address = address;
+  m.deadline_ms = now_ms + ttl_ms_;
+  if (change) epoch_ += 1;
+  return epoch_;
+}
+
+bool Membership::Heartbeat(const std::string& name, int64_t now_ms) {
+  std::lock_guard<std::mutex> lock(mu_);
+  auto it = members_.find(name);
+  if (it == members_.end()) return false;
+  it->second.deadline_ms = now_ms + ttl_ms_;
+  return true;
+}
+
+bool Membership::Leave(const std::string& name) {
+  std::lock_guard<std::mutex> lock(mu_);
+  if (members_.erase(name) == 0) return false;
+  epoch_ += 1;
+  return true;
+}
+
+int Membership::Expire(int64_t now_ms) {
+  std::lock_guard<std::mutex> lock(mu_);
+  int n = 0;
+  for (auto it = members_.begin(); it != members_.end();) {
+    if (it->second.deadline_ms <= now_ms) {
+      it = members_.erase(it);
+      ++n;
+    } else {
+      ++it;
+    }
+  }
+  if (n > 0) epoch_ += 1;
+  return n;
+}
+
+int64_t Membership::Epoch() const {
+  std::lock_guard<std::mutex> lock(mu_);
+  return epoch_;
+}
+
+std::vector<MemberInfo> Membership::Members(int64_t now_ms) {
+  Expire(now_ms);
+  std::lock_guard<std::mutex> lock(mu_);
+  std::vector<MemberInfo> out;
+  out.reserve(members_.size());
+  for (const auto& kv : members_) out.push_back(kv.second);
+  // std::map is already name-sorted: deterministic rank order.
+  return out;
+}
+
+// ------------------------------------------------------------------ KvStore
+
+void KvStore::Set(const std::string& key, const std::string& value) {
+  std::lock_guard<std::mutex> lock(mu_);
+  kv_[key] = value;
+}
+
+bool KvStore::Get(const std::string& key, std::string* value) const {
+  std::lock_guard<std::mutex> lock(mu_);
+  auto it = kv_.find(key);
+  if (it == kv_.end()) return false;
+  *value = it->second;
+  return true;
+}
+
+bool KvStore::Del(const std::string& key) {
+  std::lock_guard<std::mutex> lock(mu_);
+  return kv_.erase(key) > 0;
+}
+
+bool KvStore::Cas(const std::string& key, const std::string& expect,
+                  const std::string& value) {
+  std::lock_guard<std::mutex> lock(mu_);
+  auto it = kv_.find(key);
+  if (expect.empty()) {
+    if (it != kv_.end()) return false;
+    kv_[key] = value;
+    return true;
+  }
+  if (it == kv_.end() || it->second != expect) return false;
+  it->second = value;
+  return true;
+}
+
+std::vector<std::string> KvStore::Keys(const std::string& prefix) const {
+  std::lock_guard<std::mutex> lock(mu_);
+  std::vector<std::string> out;
+  for (const auto& kv : kv_) {
+    if (kv.first.compare(0, prefix.size(), prefix) == 0) out.push_back(kv.first);
+  }
+  std::sort(out.begin(), out.end());
+  return out;
+}
+
+}  // namespace edlcoord

@@ -1,0 +1,165 @@
+// edl_tpu coordination core: task-lease queue + membership epochs + KV.
+//
+// Native (C++) replacement for the reference's external Go services:
+//  * the master task-queue server (invoked at /usr/bin/master,
+//    reference docker/paddle_k8s:26-32): data tasks are leased to trainers
+//    and re-dispatched if not completed within a timeout
+//    (-task-timout-dur=16s, paddle_k8s:30), so a dead trainer's work is
+//    recovered without restarting the job;
+//  * etcd (sidecar, reference pkg/jobparser.go:167-184): membership,
+//    discovery and small-state KV. Here membership is epoch-versioned —
+//    every join/leave/expiry bumps the epoch, which is what the elastic
+//    JAX runtime watches to trigger a reshard.
+//
+// The core is header-declared / coord.cc-implemented, wrapped by
+//  * capi.cc  — flat C ABI for in-process use via Python ctypes, and
+//  * server.cc — a TCP server speaking a newline-delimited protocol for
+//    multi-process / multi-host use.
+//
+// All operations take an explicit `now_ms` so tests control time; the
+// wrappers pass a monotonic clock.
+
+#pragma once
+
+#include <cstdint>
+#include <deque>
+#include <map>
+#include <mutex>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace edlcoord {
+
+// Dead-trainer work re-dispatch bound (reference docker/paddle_k8s:30).
+constexpr int64_t kDefaultTaskTimeoutMs = 16000;
+// A task failing this often is dropped (poison-pill guard).
+constexpr int kDefaultMaxTaskFailures = 3;
+// Liveness TTL for members; ~3 missed 5s heartbeats.
+constexpr int64_t kDefaultMemberTtlMs = 15000;
+
+struct Task {
+  int64_t id = 0;
+  std::string payload;
+  int failures = 0;
+};
+
+struct Lease {
+  int64_t task_id = -1;
+  std::string payload;
+};
+
+enum class LeaseResult { kOk, kEmpty, kAllDone };
+
+// Task-lease queue with timeout re-dispatch and multi-pass support.
+class TaskQueue {
+ public:
+  TaskQueue(int64_t timeout_ms = kDefaultTaskTimeoutMs,
+            int passes = 1,
+            int max_failures = kDefaultMaxTaskFailures);
+
+  int64_t AddTask(const std::string& payload);
+  LeaseResult LeaseTask(const std::string& worker, int64_t now_ms, Lease* out);
+  // If `worker` is non-empty, completion/failure is rejected unless that
+  // worker still holds the lease (guards against a timed-out straggler's
+  // late call voiding a re-dispatched lease).
+  bool Complete(int64_t task_id, const std::string& worker = "");
+  // Payload of a currently-leased task (for buffer grow-and-retry in the
+  // C ABI); false if the task is not leased.
+  bool PeekLeased(int64_t task_id, std::string* payload) const;
+  bool Fail(int64_t task_id, const std::string& worker = "");
+  // Return timed-out leases to the todo queue; called inline by LeaseTask
+  // but also usable standalone. Returns number re-dispatched.
+  int Redispatch(int64_t now_ms);
+  // Drop all leases held by a worker back to todo (explicit worker death).
+  int ReleaseWorker(const std::string& worker);
+
+  bool AllDone() const;
+  int CurrentPass() const;
+  // pending (todo), leased, done, dropped counts
+  void Stats(int64_t* todo, int64_t* leased, int64_t* done,
+             int64_t* dropped) const;
+
+ private:
+  struct Leased {
+    Task task;
+    std::string worker;
+    int64_t deadline_ms = 0;
+  };
+
+  void MaybeAdvancePass();
+
+  mutable std::mutex mu_;
+  int64_t timeout_ms_;
+  int total_passes_;
+  int max_failures_;
+  int pass_ = 0;
+  int64_t next_id_ = 0;
+  int64_t dropped_ = 0;
+  std::deque<Task> todo_;
+  std::map<int64_t, Leased> leased_;
+  std::vector<Task> done_;
+};
+
+struct MemberInfo {
+  std::string name;
+  std::string address;  // opaque contact string (host:port etc.)
+  int64_t deadline_ms = 0;
+};
+
+// Epoch-versioned membership. Any composition change bumps the epoch.
+class Membership {
+ public:
+  explicit Membership(int64_t ttl_ms = kDefaultMemberTtlMs);
+
+  // Join (or refresh) a member; returns the current epoch.
+  int64_t Join(const std::string& name, const std::string& address,
+               int64_t now_ms);
+  // Heartbeat; false if the member is unknown (it must re-Join).
+  bool Heartbeat(const std::string& name, int64_t now_ms);
+  // Graceful leave; bumps epoch if the member existed.
+  bool Leave(const std::string& name);
+  // Expire members whose TTL lapsed; returns number expired.
+  int Expire(int64_t now_ms);
+
+  int64_t Epoch() const;
+  // Sorted by name — this order IS the rank assignment for an epoch
+  // (replacing the reference's IP-sort ranks, docker/k8s_tools.py:113-121,
+  // with an explicit, coordinator-owned ordering).
+  std::vector<MemberInfo> Members(int64_t now_ms);
+
+ private:
+  mutable std::mutex mu_;
+  int64_t ttl_ms_;
+  int64_t epoch_ = 0;
+  std::map<std::string, MemberInfo> members_;
+};
+
+// Tiny etcd-role KV store (discovery, checkpoints metadata, barriers).
+class KvStore {
+ public:
+  void Set(const std::string& key, const std::string& value);
+  bool Get(const std::string& key, std::string* value) const;
+  bool Del(const std::string& key);
+  // Compare-and-swap: set to `value` iff current == `expect` (empty expect
+  // means "must not exist"). The pserver slot-claim primitive.
+  bool Cas(const std::string& key, const std::string& expect,
+           const std::string& value);
+  std::vector<std::string> Keys(const std::string& prefix) const;
+
+ private:
+  mutable std::mutex mu_;
+  std::unordered_map<std::string, std::string> kv_;
+};
+
+// One job's coordination state: queue + membership + kv.
+struct Service {
+  TaskQueue queue;
+  Membership membership;
+  KvStore kv;
+
+  Service(int64_t task_timeout_ms, int passes, int64_t member_ttl_ms)
+      : queue(task_timeout_ms, passes), membership(member_ttl_ms) {}
+};
+
+}  // namespace edlcoord

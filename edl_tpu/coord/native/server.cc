@@ -1,0 +1,266 @@
+// TCP coordination server: the process trainers/coordinators talk to in
+// multi-process and multi-host deployments (role of the reference's
+// master RPC on :8080 + etcd on :2379, docker/paddle_k8s:26-32 and
+// pkg/jobparser.go:249-261, collapsed into one endpoint).
+//
+// Newline-delimited text protocol, hex-encoded binary fields:
+//   LEASE <worker>                 -> OK <id> <hex> | EMPTY | DONE
+//   ADD <hex>                      -> OK <id>
+//   COMPLETE <id> [worker]         -> OK | ERR (worker: ownership check)
+//   FAIL <id> [worker]             -> OK | ERR
+//   RELEASE <worker>               -> OK <n>
+//   STATS                          -> OK <todo> <leased> <done> <dropped> <pass>
+//   JOIN <name> <addr>             -> OK <epoch>
+//   HB <name>                      -> OK | ERR rejoin
+//   LEAVE <name>                   -> OK | ERR
+//   MEMBERS                        -> OK <epoch> <name=addr,...>
+//   KVSET <k> <hex>                -> OK
+//   KVGET <k>                      -> OK <hex> | NONE
+//   KVDEL <k>                      -> OK | NONE
+//   KVCAS <k> <hex-expect|-> <hex> -> OK | FAIL
+//   KEYS <prefix?>                 -> OK <k1,k2,...>
+//   PING                           -> PONG
+//
+// Thread-per-connection; the core is mutex-guarded so this scales to the
+// O(100) workers a single job needs.
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <chrono>
+#include <csignal>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <sstream>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "coord.hpp"
+
+namespace {
+
+edlcoord::Service* g_service = nullptr;
+
+int64_t NowMs() {
+  return std::chrono::duration_cast<std::chrono::milliseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+std::string HexEncode(const std::string& in) {
+  static const char* d = "0123456789abcdef";
+  std::string out;
+  out.reserve(in.size() * 2);
+  for (unsigned char c : in) {
+    out += d[c >> 4];
+    out += d[c & 0xf];
+  }
+  return out;
+}
+
+int HexVal(char c) {
+  if (c >= '0' && c <= '9') return c - '0';
+  if (c >= 'a' && c <= 'f') return c - 'a' + 10;
+  if (c >= 'A' && c <= 'F') return c - 'A' + 10;
+  return -1;
+}
+
+bool HexDecode(const std::string& in, std::string* out) {
+  if (in.size() % 2 != 0) return false;
+  out->clear();
+  out->reserve(in.size() / 2);
+  for (size_t i = 0; i < in.size(); i += 2) {
+    int hi = HexVal(in[i]), lo = HexVal(in[i + 1]);
+    if (hi < 0 || lo < 0) return false;
+    out->push_back(static_cast<char>((hi << 4) | lo));
+  }
+  return true;
+}
+
+std::vector<std::string> Split(const std::string& line) {
+  std::vector<std::string> out;
+  std::istringstream ss(line);
+  std::string tok;
+  while (ss >> tok) out.push_back(tok);
+  return out;
+}
+
+std::string HandleImpl(const std::string& line);
+
+// One bad line must never take down the coordinator for the whole job.
+std::string Handle(const std::string& line) {
+  try {
+    return HandleImpl(line);
+  } catch (const std::exception& e) {
+    return std::string("ERR bad-arg ") + e.what();
+  }
+}
+
+std::string HandleImpl(const std::string& line) {
+  std::vector<std::string> args = Split(line);
+  if (args.empty()) return "ERR empty";
+  const std::string& cmd = args[0];
+  edlcoord::Service& s = *g_service;
+
+  if (cmd == "PING") return "PONG";
+
+  if (cmd == "LEASE" && args.size() == 2) {
+    edlcoord::Lease lease;
+    switch (s.queue.LeaseTask(args[1], NowMs(), &lease)) {
+      case edlcoord::LeaseResult::kOk:
+        return "OK " + std::to_string(lease.task_id) + " " +
+               HexEncode(lease.payload);
+      case edlcoord::LeaseResult::kEmpty:
+        return "EMPTY";
+      case edlcoord::LeaseResult::kAllDone:
+        return "DONE";
+    }
+  }
+  if (cmd == "ADD" && args.size() == 2) {
+    std::string payload;
+    if (args[1] != "-" && !HexDecode(args[1], &payload)) return "ERR hex";
+    return "OK " + std::to_string(s.queue.AddTask(payload));
+  }
+  if (cmd == "COMPLETE" && (args.size() == 2 || args.size() == 3))
+    return s.queue.Complete(std::stoll(args[1]),
+                            args.size() == 3 ? args[2] : "")
+               ? "OK"
+               : "ERR";
+  if (cmd == "FAIL" && (args.size() == 2 || args.size() == 3))
+    return s.queue.Fail(std::stoll(args[1]), args.size() == 3 ? args[2] : "")
+               ? "OK"
+               : "ERR";
+  if (cmd == "RELEASE" && args.size() == 2)
+    return "OK " + std::to_string(s.queue.ReleaseWorker(args[1]));
+  if (cmd == "STATS") {
+    int64_t todo, leased, done, dropped;
+    s.queue.Stats(&todo, &leased, &done, &dropped);
+    return "OK " + std::to_string(todo) + " " + std::to_string(leased) + " " +
+           std::to_string(done) + " " + std::to_string(dropped) + " " +
+           std::to_string(s.queue.CurrentPass());
+  }
+
+  if (cmd == "JOIN" && args.size() == 3)
+    return "OK " + std::to_string(s.membership.Join(
+               args[1], args[2] == "-" ? "" : args[2], NowMs()));
+  if (cmd == "HB" && args.size() == 2)
+    return s.membership.Heartbeat(args[1], NowMs()) ? "OK" : "ERR rejoin";
+  if (cmd == "LEAVE" && args.size() == 2)
+    return s.membership.Leave(args[1]) ? "OK" : "ERR";
+  if (cmd == "MEMBERS") {
+    std::string list;
+    for (const auto& m : s.membership.Members(NowMs())) {
+      if (!list.empty()) list += ',';
+      list += m.name + "=" + m.address;
+    }
+    return "OK " + std::to_string(s.membership.Epoch()) + " " + list;
+  }
+
+  if (cmd == "KVSET" && args.size() == 3) {
+    std::string v;
+    if (args[2] != "-" && !HexDecode(args[2], &v)) return "ERR hex";
+    s.kv.Set(args[1], v);
+    return "OK";
+  }
+  if (cmd == "KVGET" && args.size() == 2) {
+    std::string v;
+    if (!s.kv.Get(args[1], &v)) return "NONE";
+    return "OK " + HexEncode(v);
+  }
+  if (cmd == "KVDEL" && args.size() == 2)
+    return s.kv.Del(args[1]) ? "OK" : "NONE";
+  if (cmd == "KVCAS" && args.size() == 4) {
+    std::string expect, v;
+    if (args[2] != "-" && !HexDecode(args[2], &expect)) return "ERR hex";
+    if (args[3] != "-" && !HexDecode(args[3], &v)) return "ERR hex";
+    return s.kv.Cas(args[1], expect, v) ? "OK" : "FAIL";
+  }
+  if (cmd == "KEYS") {
+    std::string prefix = args.size() > 1 ? args[1] : "";
+    std::string list;
+    for (const auto& k : s.kv.Keys(prefix)) {
+      if (!list.empty()) list += ',';
+      list += k;
+    }
+    return "OK " + list;
+  }
+  return "ERR unknown";
+}
+
+void Serve(int fd) {
+  std::string buf;
+  char chunk[4096];
+  for (;;) {
+    ssize_t n = read(fd, chunk, sizeof(chunk));
+    if (n <= 0) break;
+    buf.append(chunk, static_cast<size_t>(n));
+    size_t pos;
+    while ((pos = buf.find('\n')) != std::string::npos) {
+      std::string line = buf.substr(0, pos);
+      buf.erase(0, pos + 1);
+      if (!line.empty() && line.back() == '\r') line.pop_back();
+      std::string resp = Handle(line) + "\n";
+      size_t off = 0;
+      while (off < resp.size()) {
+        ssize_t w = write(fd, resp.data() + off, resp.size() - off);
+        if (w <= 0) {
+          close(fd);
+          return;
+        }
+        off += static_cast<size_t>(w);
+      }
+    }
+  }
+  close(fd);
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  int port = 7164;
+  int64_t task_timeout_ms = edlcoord::kDefaultTaskTimeoutMs;
+  int passes = 1;
+  int64_t member_ttl_ms = edlcoord::kDefaultMemberTtlMs;
+  for (int i = 1; i + 1 < argc; i += 2) {
+    std::string flag = argv[i];
+    if (flag == "--port") port = std::atoi(argv[i + 1]);
+    if (flag == "--task-timeout-ms") task_timeout_ms = std::atoll(argv[i + 1]);
+    if (flag == "--passes") passes = std::atoi(argv[i + 1]);
+    if (flag == "--member-ttl-ms") member_ttl_ms = std::atoll(argv[i + 1]);
+  }
+  signal(SIGPIPE, SIG_IGN);
+  g_service = new edlcoord::Service(task_timeout_ms, passes, member_ttl_ms);
+
+  int srv = socket(AF_INET, SOCK_STREAM, 0);
+  int one = 1;
+  setsockopt(srv, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (bind(srv, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0) {
+    perror("bind");
+    return 1;
+  }
+  if (listen(srv, 128) != 0) {
+    perror("listen");
+    return 1;
+  }
+  // Report the actually-bound port (supports --port 0 for tests).
+  socklen_t alen = sizeof(addr);
+  getsockname(srv, reinterpret_cast<sockaddr*>(&addr), &alen);
+  std::printf("edl-coord listening on %d\n", ntohs(addr.sin_port));
+  std::fflush(stdout);
+
+  for (;;) {
+    int fd = accept(srv, nullptr, nullptr);
+    if (fd < 0) continue;
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    std::thread(Serve, fd).detach();
+  }
+}

@@ -1,0 +1,118 @@
+"""Coordination-server launcher.
+
+``python -m edl_tpu.coord.server --port 7164`` runs the native C++ server
+(building it first if needed) — the coordinator pod's entrypoint in the
+compiled job manifests (edl_tpu/controller/jobparser.py, role of the
+reference's start_master, docker/paddle_k8s:26-32).
+
+:func:`spawn_server` starts one as a child process and returns a handle —
+used by the elastic runtime and tests.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import subprocess
+import sys
+import time
+from dataclasses import dataclass
+
+from edl_tpu.coord.bindings import SERVER_PATH, ensure_built
+from edl_tpu.coord.client import CoordClient
+from edl_tpu.coord.service import DEFAULT_MEMBER_TTL_MS, DEFAULT_TASK_TIMEOUT_MS
+
+_LISTEN_RE = re.compile(rb"listening on (\d+)")
+
+
+@dataclass
+class ServerHandle:
+    process: subprocess.Popen
+    port: int
+
+    def client(self, timeout: float = 10.0) -> CoordClient:
+        return CoordClient("127.0.0.1", self.port, timeout=timeout)
+
+    def stop(self) -> None:
+        if self.process.poll() is None:
+            self.process.terminate()
+            try:
+                self.process.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                self.process.kill()
+
+
+def spawn_server(
+    port: int = 0,
+    task_timeout_ms: int = DEFAULT_TASK_TIMEOUT_MS,
+    passes: int = 1,
+    member_ttl_ms: int = DEFAULT_MEMBER_TTL_MS,
+    startup_timeout: float = 10.0,
+) -> ServerHandle:
+    """Start edl-coord-server (port 0 = ephemeral) and wait until it
+    reports its listening port."""
+    if not ensure_built():
+        raise RuntimeError("cannot build the native coordination server "
+                           "(g++ unavailable?)")
+    proc = subprocess.Popen(
+        [
+            str(SERVER_PATH),
+            "--port", str(port),
+            "--task-timeout-ms", str(task_timeout_ms),
+            "--passes", str(passes),
+            "--member-ttl-ms", str(member_ttl_ms),
+        ],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+    )
+    import queue as _queue
+    import threading as _threading
+
+    banner: "_queue.Queue[bytes]" = _queue.Queue()
+    _threading.Thread(
+        target=lambda: banner.put(proc.stdout.readline()), daemon=True
+    ).start()
+    try:
+        line = banner.get(timeout=startup_timeout)
+    except _queue.Empty:
+        proc.kill()
+        raise RuntimeError(
+            f"coord server printed no banner within {startup_timeout}s")
+    if not line and proc.poll() is not None:
+        raise RuntimeError("coord server exited at startup")
+    m = _LISTEN_RE.search(line)
+    if not m:
+        proc.kill()
+        raise RuntimeError(f"unexpected coord server banner: {line!r}")
+    return ServerHandle(process=proc, port=int(m.group(1)))
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description="edl_tpu coordination server")
+    ap.add_argument("--port", type=int,
+                    default=int(os.environ.get("EDL_COORD_PORT", "7164")))
+    ap.add_argument("--task-timeout-ms", type=int,
+                    default=DEFAULT_TASK_TIMEOUT_MS)
+    ap.add_argument("--passes", type=int,
+                    default=int(os.environ.get("EDL_PASSES", "1")))
+    ap.add_argument("--member-ttl-ms", type=int, default=DEFAULT_MEMBER_TTL_MS)
+    args = ap.parse_args(argv)
+    if not ensure_built():
+        print("error: cannot build native coord server", file=sys.stderr)
+        return 1
+    os.execv(
+        str(SERVER_PATH),
+        [
+            str(SERVER_PATH),
+            "--port", str(args.port),
+            "--task-timeout-ms", str(args.task_timeout_ms),
+            "--passes", str(args.passes),
+            "--member-ttl-ms", str(args.member_ttl_ms),
+        ],
+    )
+    return 0  # unreachable
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,271 @@
+"""Pure-Python coordination service + the canonical interface definition.
+
+Same semantics as the C++ core (edl_tpu/coord/native/coord.cc); used when no
+toolchain is available and as the executable specification the native tests
+cross-check against.  The task-lease behavior mirrors the reference master:
+leased-but-unfinished tasks are re-dispatched after a timeout (16 s,
+reference docker/paddle_k8s:30) so a dead trainer's work flows to the living.
+"""
+
+from __future__ import annotations
+
+import enum
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Optional
+
+DEFAULT_TASK_TIMEOUT_MS = 16_000  # reference docker/paddle_k8s:30
+DEFAULT_MAX_TASK_FAILURES = 3
+DEFAULT_MEMBER_TTL_MS = 15_000
+
+
+class LeaseStatus(enum.Enum):
+    OK = 0
+    EMPTY = 1  # nothing leasable right now, but work is in flight
+    DONE = 2  # every task of every pass is complete
+
+
+@dataclass(frozen=True)
+class QueueStats:
+    todo: int
+    leased: int
+    done: int
+    dropped: int
+    current_pass: int
+
+
+@dataclass
+class _Task:
+    id: int
+    payload: bytes
+    failures: int = 0
+
+
+@dataclass
+class _Leased:
+    task: _Task
+    worker: str
+    deadline_ms: int
+
+
+def _now_ms() -> int:
+    return time.monotonic_ns() // 1_000_000
+
+
+class PyCoordService:
+    """One job's coordination state: queue + membership + kv."""
+
+    def __init__(
+        self,
+        task_timeout_ms: int = DEFAULT_TASK_TIMEOUT_MS,
+        passes: int = 1,
+        member_ttl_ms: int = DEFAULT_MEMBER_TTL_MS,
+        max_task_failures: int = DEFAULT_MAX_TASK_FAILURES,
+        clock=_now_ms,
+    ) -> None:
+        self._lock = threading.RLock()
+        self._clock = clock
+        # queue
+        self._timeout_ms = task_timeout_ms
+        self._total_passes = max(passes, 1)
+        self._max_failures = max_task_failures
+        self._pass = 0
+        self._next_id = 0
+        self._dropped = 0
+        self._todo: deque[_Task] = deque()
+        self._leased: dict[int, _Leased] = {}
+        self._done: list[_Task] = []
+        # membership
+        self._ttl_ms = member_ttl_ms
+        self._epoch = 0
+        self._members: dict[str, tuple[str, int]] = {}  # name -> (addr, deadline)
+        # kv
+        self._kv: dict[str, bytes] = {}
+
+    # -- task queue --------------------------------------------------------
+
+    def add_task(self, payload: bytes) -> int:
+        with self._lock:
+            t = _Task(self._next_id, bytes(payload))
+            self._next_id += 1
+            self._todo.append(t)
+            return t.id
+
+    def lease(self, worker: str) -> tuple[LeaseStatus, int, bytes]:
+        now = self._clock()
+        with self._lock:
+            self._redispatch_locked(now)
+            self._maybe_advance_pass()
+            if not self._todo:
+                finished = not self._leased and self._pass + 1 >= self._total_passes
+                status = LeaseStatus.DONE if finished else LeaseStatus.EMPTY
+                return (status, -1, b"")
+            t = self._todo.popleft()
+            self._leased[t.id] = _Leased(t, worker, now + self._timeout_ms)
+            return (LeaseStatus.OK, t.id, t.payload)
+
+    def complete(self, task_id: int, worker: Optional[str] = None) -> bool:
+        """Mark a leased task done.  If ``worker`` is given, the completion
+        is rejected unless that worker still holds the lease — so a timed-out
+        straggler's late completion can't void a re-dispatched lease."""
+        with self._lock:
+            leased = self._leased.get(task_id)
+            if leased is None:
+                return False  # late completion after re-dispatch
+            if worker is not None and leased.worker != worker:
+                return False  # lease moved to another worker
+            del self._leased[task_id]
+            self._done.append(leased.task)
+            self._maybe_advance_pass()
+            return True
+
+    def fail(self, task_id: int, worker: Optional[str] = None) -> bool:
+        with self._lock:
+            leased = self._leased.get(task_id)
+            if leased is None:
+                return False
+            if worker is not None and leased.worker != worker:
+                return False
+            del self._leased[task_id]
+            t = leased.task
+            t.failures += 1
+            if t.failures >= self._max_failures:
+                self._dropped += 1  # poison pill: drop, don't wedge the pass
+            else:
+                self._todo.append(t)
+            self._maybe_advance_pass()
+            return True
+
+    def redispatch(self) -> int:
+        with self._lock:
+            return self._redispatch_locked(self._clock())
+
+    def release_worker(self, worker: str) -> int:
+        with self._lock:
+            mine = [tid for tid, l in self._leased.items() if l.worker == worker]
+            for tid in mine:
+                self._todo.append(self._leased.pop(tid).task)
+            return len(mine)
+
+    def all_done(self) -> bool:
+        with self._lock:
+            return (not self._todo and not self._leased
+                    and self._pass + 1 >= self._total_passes)
+
+    def current_pass(self) -> int:
+        with self._lock:
+            return self._pass
+
+    def stats(self) -> QueueStats:
+        with self._lock:
+            return QueueStats(len(self._todo), len(self._leased),
+                              len(self._done), self._dropped, self._pass)
+
+    def _redispatch_locked(self, now: int) -> int:
+        expired = [tid for tid, l in self._leased.items()
+                   if l.deadline_ms <= now]
+        for tid in expired:
+            self._todo.append(self._leased.pop(tid).task)
+        return len(expired)
+
+    def _maybe_advance_pass(self) -> None:
+        if self._todo or self._leased:
+            return
+        if self._pass + 1 < self._total_passes:
+            if self._done:
+                for t in self._done:
+                    t.failures = 0
+                    self._todo.append(t)
+                self._done.clear()
+                self._pass += 1
+            else:
+                # Nothing survives to recycle (zero tasks, or every task
+                # dropped as a poison pill): later passes would be empty
+                # too — finish now instead of livelocking on EMPTY.
+                self._pass = self._total_passes - 1
+
+    # -- membership --------------------------------------------------------
+
+    def join(self, name: str, address: str = "") -> int:
+        now = self._clock()
+        with self._lock:
+            prev = self._members.get(name)
+            change = prev is None or prev[0] != address
+            self._members[name] = (address, now + self._ttl_ms)
+            if change:
+                self._epoch += 1
+            return self._epoch
+
+    def heartbeat(self, name: str) -> bool:
+        now = self._clock()
+        with self._lock:
+            if name not in self._members:
+                return False
+            addr, _ = self._members[name]
+            self._members[name] = (addr, now + self._ttl_ms)
+            return True
+
+    def leave(self, name: str) -> bool:
+        with self._lock:
+            if self._members.pop(name, None) is None:
+                return False
+            self._epoch += 1
+            return True
+
+    def expire_members(self) -> int:
+        now = self._clock()
+        with self._lock:
+            dead = [n for n, (_, dl) in self._members.items() if dl <= now]
+            for n in dead:
+                del self._members[n]
+            if dead:
+                self._epoch += 1
+            return len(dead)
+
+    def epoch(self) -> int:
+        with self._lock:
+            return self._epoch
+
+    def members(self) -> tuple[int, list[tuple[str, str]]]:
+        """(epoch, [(name, address)]) name-sorted — this order IS the rank
+        assignment (replacing IP-sort ranks, reference k8s_tools.py:113-121)."""
+        self.expire_members()
+        with self._lock:
+            out = sorted((n, a) for n, (a, _) in self._members.items())
+            return self._epoch, out
+
+    # -- kv ----------------------------------------------------------------
+
+    def kv_set(self, key: str, value: bytes) -> None:
+        with self._lock:
+            self._kv[key] = bytes(value)
+
+    def kv_get(self, key: str) -> Optional[bytes]:
+        with self._lock:
+            return self._kv.get(key)
+
+    def kv_del(self, key: str) -> bool:
+        with self._lock:
+            return self._kv.pop(key, None) is not None
+
+    def kv_cas(self, key: str, expect: bytes, value: bytes) -> bool:
+        """Set iff current == expect (empty expect: must not exist) — the
+        slot-claim primitive (role of etcd pserver slots)."""
+        with self._lock:
+            cur = self._kv.get(key)
+            if expect == b"":
+                if cur is not None:
+                    return False
+            elif cur != expect:
+                return False
+            self._kv[key] = bytes(value)
+            return True
+
+    def kv_keys(self, prefix: str = "") -> list[str]:
+        with self._lock:
+            return sorted(k for k in self._kv if k.startswith(prefix))
+
+    def close(self) -> None:  # interface parity with the native handle
+        pass

@@ -1,0 +1,16 @@
+"""Model zoo mirroring the reference's example trainers plus the
+BASELINE.json benchmark configs:
+
+* mlp      — MNIST-class classifier (role of example/fluid/recognize_digits.py)
+* word2vec — skip-window embedding model (role of example/train_ft.py)
+* resnet   — ResNet-50-class conv net (BASELINE config 2)
+* bert     — BERT-base-class encoder (BASELINE config 3)
+* llama    — Llama-3-8B-class decoder, FSDP/TP/SP shardable (BASELINE config 4)
+
+All models are plain pytree params + pure apply/loss functions so they
+compose with ElasticTrainer and pjit without framework glue.
+"""
+
+from edl_tpu.models import mlp, word2vec
+
+__all__ = ["mlp", "word2vec"]

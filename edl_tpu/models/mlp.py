@@ -1,0 +1,45 @@
+"""MLP classifier — the MNIST-class model of the reference examples
+(reference example/fluid/recognize_digits.py:20-61 builds a conv/MLP MNIST
+net; this is the minimal end-to-end-slice model from SURVEY §7 stage 6).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+
+def init(key: jax.Array, sizes: Sequence[int]) -> dict:
+    """Params for an MLP with layer ``sizes`` (e.g. [784, 256, 10])."""
+    params = {}
+    keys = jax.random.split(key, len(sizes) - 1)
+    for i, (fan_in, fan_out) in enumerate(zip(sizes[:-1], sizes[1:])):
+        params[f"w{i}"] = jax.random.normal(
+            keys[i], (fan_in, fan_out), dtype=jnp.float32
+        ) * jnp.sqrt(2.0 / fan_in)
+        params[f"b{i}"] = jnp.zeros((fan_out,), dtype=jnp.float32)
+    return params
+
+
+def apply(params: dict, x: jax.Array) -> jax.Array:
+    n_layers = len(params) // 2
+    for i in range(n_layers):
+        x = x @ params[f"w{i}"] + params[f"b{i}"]
+        if i < n_layers - 1:
+            x = jax.nn.relu(x)
+    return x
+
+
+def loss_fn(params: dict, batch: tuple[jax.Array, jax.Array]) -> jax.Array:
+    """Mean softmax cross-entropy over the (global) batch."""
+    x, y = batch
+    logits = apply(params, x)
+    logp = jax.nn.log_softmax(logits)
+    return -jnp.mean(jnp.take_along_axis(logp, y[:, None], axis=1))
+
+
+def accuracy(params: dict, batch: tuple[jax.Array, jax.Array]) -> jax.Array:
+    x, y = batch
+    return jnp.mean(jnp.argmax(apply(params, x), axis=-1) == y)

@@ -1,0 +1,40 @@
+"""Word2vec (CBOW-style N-gram) embedding model — the reference's
+fault-tolerant example trainer's model (reference example/train_ft.py:41-100:
+imikolov N-gram word embedding with concatenated context projected to a
+softmax over the vocabulary)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+EMB_DIM_DEFAULT = 32  # reference train_ft.py:15 (embsize)
+
+
+def init(key: jax.Array, vocab_size: int, context: int = 4,
+         emb_dim: int = EMB_DIM_DEFAULT, hidden: int = 256) -> dict:
+    k1, k2, k3 = jax.random.split(key, 3)
+    scale = 1.0 / jnp.sqrt(emb_dim)
+    return {
+        "emb": jax.random.normal(k1, (vocab_size, emb_dim)) * scale,
+        "w_h": jax.random.normal(k2, (context * emb_dim, hidden))
+        * jnp.sqrt(2.0 / (context * emb_dim)),
+        "b_h": jnp.zeros((hidden,)),
+        "w_o": jax.random.normal(k3, (hidden, vocab_size))
+        * jnp.sqrt(1.0 / hidden),
+        "b_o": jnp.zeros((vocab_size,)),
+    }
+
+
+def apply(params: dict, context_ids: jax.Array) -> jax.Array:
+    """context_ids: [batch, context] int32 → logits [batch, vocab]."""
+    emb = params["emb"][context_ids]  # [b, ctx, d]
+    flat = emb.reshape(emb.shape[0], -1)
+    h = jax.nn.relu(flat @ params["w_h"] + params["b_h"])
+    return h @ params["w_o"] + params["b_o"]
+
+
+def loss_fn(params: dict, batch: tuple[jax.Array, jax.Array]) -> jax.Array:
+    ctx, target = batch
+    logp = jax.nn.log_softmax(apply(params, ctx))
+    return -jnp.mean(jnp.take_along_axis(logp, target[:, None], axis=1))

@@ -1,0 +1,25 @@
+"""Parallelism substrate: meshes, shardings, collectives, ring attention.
+
+This is the TPU-native replacement for the reference's pserver data plane
+(SURVEY §2.4): instead of trainers pushing gradients to parameter servers
+over TCP (reference docker/paddle_k8s:4-11), a jax device mesh carries the
+model, XLA collectives ride ICI within a slice and DCN across slices, and
+elasticity is a *mesh resize + reshard* instead of a pserver membership
+change.
+"""
+
+from edl_tpu.parallel.mesh import (
+    MeshSpec,
+    make_mesh,
+    dp_sharding,
+    replicated,
+    fsdp_sharding,
+)
+
+__all__ = [
+    "MeshSpec",
+    "make_mesh",
+    "dp_sharding",
+    "replicated",
+    "fsdp_sharding",
+]

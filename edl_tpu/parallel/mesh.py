@@ -1,0 +1,140 @@
+"""Mesh construction and canonical shardings.
+
+The elastic unit of this framework is a ``jax.sharding.Mesh`` over a
+*prefix* of the job's devices: the autoscaler dials the trainer count, the
+runtime rebuilds the mesh over that many devices and reshards state onto it
+(contrast the reference, where the elastic unit is a k8s Job's parallelism,
+reference pkg/autoscaler.go:361).
+
+Axis conventions (used across models/, runtime/, ops/):
+
+* ``dp``   — data parallel (batch dimension; gradients all-reduced)
+* ``fsdp`` — fully-sharded data parallel (params/opt-state sharded too)
+* ``tp``   — tensor parallel (hidden dims sharded; matmul collectives)
+* ``sp``   — sequence/context parallel (sequence dim sharded; ring attention)
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+AXIS_DP = "dp"
+AXIS_FSDP = "fsdp"
+AXIS_TP = "tp"
+AXIS_SP = "sp"
+
+
+@dataclass(frozen=True)
+class MeshSpec:
+    """A named mesh shape, e.g. ``MeshSpec(dp=4, tp=2)``.
+
+    ``-1`` on exactly one axis means "absorb all remaining devices" (like a
+    reshape wildcard), so elastic resizes only touch that axis.
+    """
+
+    dp: int = 1
+    fsdp: int = 1
+    tp: int = 1
+    sp: int = 1
+    ep: int = 1
+
+    def axis_sizes(self) -> dict[str, int]:
+        return {
+            AXIS_DP: self.dp,
+            AXIS_FSDP: self.fsdp,
+            AXIS_TP: self.tp,
+            AXIS_SP: self.sp,
+            "ep": self.ep,
+        }
+
+    def resolve(self, n_devices: int) -> dict[str, int]:
+        sizes = self.axis_sizes()
+        wilds = [a for a, s in sizes.items() if s == -1]
+        if len(wilds) > 1:
+            raise ValueError("at most one mesh axis may be -1")
+        fixed = 1
+        for a, s in sizes.items():
+            if s != -1:
+                fixed *= s
+        if wilds:
+            if n_devices % fixed != 0:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by fixed axes {fixed}")
+            sizes[wilds[0]] = n_devices // fixed
+        else:
+            total = fixed
+            if total != n_devices:
+                raise ValueError(
+                    f"mesh spec wants {total} devices, got {n_devices}")
+        return sizes
+
+
+def make_mesh(
+    n_devices: Optional[int] = None,
+    spec: Optional[MeshSpec] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a Mesh over the first ``n_devices`` devices.
+
+    Axes with size 1 are kept (so PartitionSpecs referencing them are always
+    valid); the device array is reshaped row-major in axis declaration
+    order, which on real TPU slices keeps ``dp`` outermost (DCN/ICI-major)
+    and ``tp``/``sp`` innermost (ICI-minor) — the layout that makes the
+    hot collectives ride the fastest links.
+    """
+    devs = list(devices) if devices is not None else list(jax.devices())
+    if n_devices is not None:
+        if n_devices > len(devs):
+            raise ValueError(f"want {n_devices} devices, have {len(devs)}")
+        devs = devs[:n_devices]
+    spec = spec or MeshSpec(dp=-1)
+    sizes = spec.resolve(len(devs))
+    axis_names = tuple(sizes.keys())
+    shape = tuple(sizes.values())
+    arr = np.array(devs, dtype=object).reshape(shape)
+    return Mesh(arr, axis_names)
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def dp_sharding(mesh: Mesh, batch_axes: Sequence[str] = (AXIS_DP, AXIS_FSDP)
+                ) -> NamedSharding:
+    """Batch sharded over the data axes, rest replicated."""
+    axes = tuple(a for a in batch_axes if a in mesh.axis_names
+                 and mesh.shape[a] > 1) or None
+    if axes is not None and len(axes) == 1:
+        axes = axes[0]
+    return NamedSharding(mesh, P(axes))
+
+
+def fsdp_sharding(mesh: Mesh, x: jax.ShapeDtypeStruct | jax.Array
+                  ) -> NamedSharding:
+    """Shard the largest divisible dimension of ``x`` over the fsdp axis
+    (ZeRO-3-style param sharding); replicate scalars/invisible shapes."""
+    n = mesh.shape.get(AXIS_FSDP, 1)
+    if n <= 1 or not getattr(x, "shape", ()):
+        return replicated(mesh)
+    dims = list(x.shape)
+    # largest dim divisible by the axis size wins
+    best = max(range(len(dims)), key=lambda i: dims[i] if dims[i] % n == 0 else -1)
+    if dims[best] % n != 0:
+        return replicated(mesh)
+    spec = [None] * len(dims)
+    spec[best] = AXIS_FSDP
+    return NamedSharding(mesh, P(*spec))
+
+
+def tree_shardings(mesh: Mesh, tree, kind: str = "replicated"):
+    """Per-leaf shardings for a pytree: 'replicated' or 'fsdp'."""
+    if kind == "replicated":
+        return jax.tree.map(lambda _: replicated(mesh), tree)
+    if kind == "fsdp":
+        return jax.tree.map(lambda x: fsdp_sharding(mesh, x), tree)
+    raise ValueError(f"unknown sharding kind {kind!r}")

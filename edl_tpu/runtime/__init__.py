@@ -1,0 +1,20 @@
+"""Elastic trainer runtime.
+
+Role of the reference's node runtime + FT trainer (docker/paddle_k8s +
+example/train_ft.py): discover peers, join the job, lease data tasks, run
+training steps, and survive membership changes.  The TPU-native version
+replaces pserver RPC with a jax device mesh: a membership change is a mesh
+resize + reshard, not a pserver reconnect.
+"""
+
+from edl_tpu.runtime.elastic import ElasticTrainer, TrainState
+from edl_tpu.runtime.data import ShardRegistry, TaskLeaseBatches
+from edl_tpu.runtime.checkpoint import ElasticCheckpointer
+
+__all__ = [
+    "ElasticTrainer",
+    "TrainState",
+    "ShardRegistry",
+    "TaskLeaseBatches",
+    "ElasticCheckpointer",
+]

@@ -1,0 +1,70 @@
+"""Checkpoint/restore across mesh resizes, via Orbax.
+
+The reference delegated checkpointing to the Paddle stack (pserver state in
+etcd + per-pass parameter tars, SURVEY §5.4 — train_local.py:95-96,
+paddle_k8s:205).  Here Orbax owns it: state is saved with its shardings and
+restored *onto a different mesh* — the piece that lets a job survive a full
+slice preemption or a cross-host resize, not just an in-process reshard.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Any, Optional
+
+import jax
+import orbax.checkpoint as ocp
+
+from edl_tpu.observability.logging import get_logger
+
+log = get_logger("runtime.checkpoint")
+
+
+class ElasticCheckpointer:
+    """Thin CheckpointManager wrapper keyed by step."""
+
+    def __init__(self, directory: str | Path, max_to_keep: int = 3) -> None:
+        self.directory = Path(directory).resolve()
+        self.directory.mkdir(parents=True, exist_ok=True)
+        self._mgr = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep, create=True
+            ),
+        )
+
+    def save(self, step: int, tree: Any, wait: bool = True) -> None:
+        self._mgr.save(step, args=ocp.args.StandardSave(tree))
+        if wait:
+            self._mgr.wait_until_finished()
+
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def restore(self, tree_like: Any, step: Optional[int] = None,
+                shardings: Any = None) -> Any:
+        """Restore onto the shardings of ``tree_like`` (or explicit
+        ``shardings``) — the target mesh may differ from the one that saved.
+        ``tree_like`` supplies shapes/dtypes (live arrays are fine)."""
+        step = step if step is not None else self._mgr.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints in {self.directory}")
+
+        def to_abstract(x, s):
+            if hasattr(x, "shape") and hasattr(x, "dtype"):
+                sharding = s if s is not None else getattr(x, "sharding", None)
+                return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=sharding)
+            return x
+
+        if shardings is None:
+            abstract = jax.tree.map(lambda x: to_abstract(x, None), tree_like)
+        else:
+            abstract = jax.tree.map(to_abstract, tree_like, shardings)
+        restored = self._mgr.restore(
+            step, args=ocp.args.StandardRestore(abstract)
+        )
+        log.info("restored checkpoint", step=step, dir=str(self.directory))
+        return restored
+
+    def close(self) -> None:
+        self._mgr.close()

@@ -1,0 +1,90 @@
+"""Task-lease data dispatch.
+
+Role of the reference's master task queue + ``cloud_reader``
+(example/train_ft.py:112: trainers lease RecordIO chunks from the master;
+a dead trainer's chunks are re-dispatched after 16 s): data shards are
+tasks in the coordination service's queue; trainers lease one, emit its
+batches, and mark it complete.  Elasticity falls out: shard assignment is
+dynamic leases, so trainer count appears nowhere (SURVEY §3.4 — the
+property that makes kill/add-a-trainer a non-event).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Callable, Iterator, Optional
+
+import numpy as np
+
+from edl_tpu.coord.service import LeaseStatus
+
+
+class ShardRegistry:
+    """Registers in-memory array shards as queue tasks and resolves leases
+    back to data (the local stand-in for RecordIO files on GCS)."""
+
+    def __init__(self) -> None:
+        self._shards: dict[int, tuple[np.ndarray, ...]] = {}
+
+    def add_arrays(self, coord, arrays: tuple[np.ndarray, ...],
+                   num_shards: int) -> None:
+        """Split arrays row-wise into ``num_shards`` tasks on ``coord``."""
+        n = arrays[0].shape[0]
+        for a in arrays:
+            if a.shape[0] != n:
+                raise ValueError("all arrays must share the leading dim")
+        splits = np.array_split(np.arange(n), num_shards)
+        for idx in splits:
+            shard_id = len(self._shards)
+            self._shards[shard_id] = tuple(a[idx] for a in arrays)
+            coord.add_task(json.dumps({"shard": shard_id}).encode())
+
+    def fetch(self, payload: bytes) -> tuple[np.ndarray, ...]:
+        shard_id = json.loads(payload.decode())["shard"]
+        return self._shards[shard_id]
+
+
+class TaskLeaseBatches:
+    """Iterate minibatches by leasing shards from the coordination service.
+
+    ``fetch`` maps a task payload to arrays (ShardRegistry.fetch locally; a
+    GCS/grain reader in production).  EMPTY (work in flight elsewhere) polls;
+    DONE ends the epoch/pass stream.
+    """
+
+    def __init__(
+        self,
+        coord,
+        worker: str,
+        fetch: Callable[[bytes], tuple[np.ndarray, ...]],
+        batch_size: int,
+        poll_seconds: float = 0.05,
+        drop_remainder: bool = True,
+        on_task_done: Optional[Callable[[int], None]] = None,
+    ) -> None:
+        self.coord = coord
+        self.worker = worker
+        self.fetch = fetch
+        self.batch_size = batch_size
+        self.poll_seconds = poll_seconds
+        self.drop_remainder = drop_remainder
+        self.on_task_done = on_task_done
+
+    def __iter__(self) -> Iterator[tuple[np.ndarray, ...]]:
+        while True:
+            status, task_id, payload = self.coord.lease(self.worker)
+            if status == LeaseStatus.DONE:
+                return
+            if status == LeaseStatus.EMPTY:
+                time.sleep(self.poll_seconds)
+                continue
+            arrays = self.fetch(payload)
+            n = arrays[0].shape[0]
+            stop = (n // self.batch_size) * self.batch_size \
+                if self.drop_remainder else n
+            for lo in range(0, stop, self.batch_size):
+                yield tuple(a[lo:lo + self.batch_size] for a in arrays)
+            self.coord.complete(task_id, self.worker)
+            if self.on_task_done is not None:
+                self.on_task_done(task_id)

@@ -1,0 +1,112 @@
+"""Local end-to-end elastic job harness.
+
+The "minimum end-to-end slice" of SURVEY §7: submit a TrainingJob → the
+controller materializes trainer pods on the (fake) cluster → the autoscaler
+dials parallelism against live capacity → and HERE the dial becomes a mesh:
+each running trainer pod corresponds to one mesh slot, so a parallelism
+change is observed by the training loop and applied as an
+ElasticTrainer.resize() at the next step boundary, while the task-lease
+queue keeps data flowing exactly-once through every resize.
+
+This is the in-process analogue of the reference's elastic demo
+(doc/boss_tutorial.md:246-301: jobs growing/shrinking while training
+continues), with the pserver/etcd machinery replaced by mesh + coord.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from edl_tpu.api.types import TrainingJob
+from edl_tpu.cluster.base import Cluster
+from edl_tpu.observability.logging import get_logger
+from edl_tpu.runtime.data import TaskLeaseBatches
+from edl_tpu.runtime.elastic import ElasticTrainer
+
+log = get_logger("runtime.local")
+
+
+@dataclass
+class RunReport:
+    steps: int = 0
+    losses: list[float] = field(default_factory=list)
+    world_sizes: list[int] = field(default_factory=list)
+    resizes: int = 0
+
+    @property
+    def first_loss(self) -> float:
+        return self.losses[0] if self.losses else float("nan")
+
+    @property
+    def last_loss(self) -> float:
+        return self.losses[-1] if self.losses else float("nan")
+
+
+class LocalElasticJob:
+    """Drives one job's training loop against the control plane."""
+
+    def __init__(
+        self,
+        job: TrainingJob,
+        cluster: Cluster,
+        trainer: ElasticTrainer,
+        coord,
+        fetch: Callable,
+        batch_size: int,
+        max_devices: Optional[int] = None,
+    ) -> None:
+        self.job = job
+        self.cluster = cluster
+        self.trainer = trainer
+        self.coord = coord
+        self.fetch = fetch
+        self.batch_size = batch_size
+        self.max_devices = max_devices or len(trainer._devices)
+
+    def desired_world_size(self) -> int:
+        """Running trainer pods, clamped to available devices and snapped
+        down to a divisor of the global batch (a DP mesh must divide the
+        batch; the scheduler's SliceShapePolicy normally guarantees this —
+        the snap is a belt-and-braces guard for unit-policy jobs)."""
+        counts = self.cluster.job_pods(self.job)
+        n = min(max(counts.running, 1), self.max_devices)
+        while n > 1 and self.batch_size % n != 0:
+            n -= 1
+        return n
+
+    def run(
+        self,
+        max_steps: Optional[int] = None,
+        on_step: Optional[Callable[[int, float, int], None]] = None,
+    ) -> RunReport:
+        """Train until the task queue is drained (all passes) or max_steps.
+
+        Membership changes are applied at step boundaries: jit steps are
+        atomic, so there is never a half-resized step — the reshard dance
+        the reference never had to do (pservers held the params) collapses
+        to one device_put between steps.
+        """
+        report = RunReport()
+        batches = TaskLeaseBatches(
+            self.coord, worker=f"{self.job.full_name}/driver",
+            fetch=self.fetch, batch_size=self.batch_size,
+        )
+        for batch in batches:
+            want = self.desired_world_size()
+            if want != self.trainer.world_size:
+                before = self.trainer.world_size
+                self.trainer.resize(want)
+                report.resizes += 1
+                log.info("elastic resize applied", job=self.job.full_name,
+                         from_size=before, to_size=want,
+                         step=self.trainer.state.step)
+            loss = self.trainer.step(batch)
+            report.steps += 1
+            report.losses.append(loss)
+            report.world_sizes.append(self.trainer.world_size)
+            if on_step is not None:
+                on_step(report.steps, loss, self.trainer.world_size)
+            if max_steps is not None and report.steps >= max_steps:
+                break
+        return report

@@ -8,8 +8,19 @@ to test multi-node behavior in CI; we do).
 
 import os
 
-os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force, don't setdefault: the environment presets JAX_PLATFORMS=axon (the
+# real TPU tunnel), but tests always run on the virtual CPU mesh.  The
+# jaxtyping pytest plugin imports jax before this conftest runs, so setting
+# the env var alone is not enough — update jax's config directly (the
+# backend itself initializes lazily, at the first jax.devices() call).
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

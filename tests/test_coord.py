@@ -1,0 +1,318 @@
+"""Coordination core tests, run against BOTH the pure-Python spec and the
+native C++ core through ctypes — the same behavioral contract
+(role of the reference master's task queue, docker/paddle_k8s:26-32, and
+etcd membership/KV, pkg/jobparser.go:167-184).
+"""
+
+import pytest
+
+from edl_tpu.coord import (
+    CoordClient,
+    LeaseStatus,
+    NativeCoordService,
+    PyCoordService,
+    native_available,
+    spawn_server,
+)
+
+
+class FakeClock:
+    def __init__(self) -> None:
+        self.ms = 1_000_000
+
+    def __call__(self) -> int:
+        return self.ms
+
+    def advance(self, ms: int) -> None:
+        self.ms += ms
+
+
+def make_service(kind, **kw):
+    clock = FakeClock()
+    if kind == "native":
+        if not native_available():
+            pytest.skip("native coord core unavailable")
+        return NativeCoordService(clock=clock, **kw), clock
+    return PyCoordService(clock=clock, **kw), clock
+
+
+@pytest.fixture(params=["python", "native"])
+def kind(request):
+    return request.param
+
+
+def test_lease_complete_done(kind):
+    s, _ = make_service(kind)
+    ids = [s.add_task(f"shard-{i}".encode()) for i in range(3)]
+    seen = set()
+    for _ in range(3):
+        status, tid, payload = s.lease("w0")
+        assert status == LeaseStatus.OK
+        assert payload.startswith(b"shard-")
+        seen.add(tid)
+        assert s.complete(tid)
+    assert seen == set(ids)
+    status, _, _ = s.lease("w0")
+    assert status == LeaseStatus.DONE
+    assert s.all_done()
+
+
+def test_timeout_redispatch(kind):
+    # The 16 s dead-trainer re-dispatch bound (reference paddle_k8s:30).
+    s, clock = make_service(kind, task_timeout_ms=16_000)
+    s.add_task(b"t")
+    status, tid, _ = s.lease("dead-worker")
+    assert status == LeaseStatus.OK
+    # Not yet timed out: nothing leasable, but not done either.
+    status, _, _ = s.lease("w1")
+    assert status == LeaseStatus.EMPTY
+    clock.advance(16_001)
+    status, tid2, payload = s.lease("w1")
+    assert status == LeaseStatus.OK and payload == b"t"
+    assert tid2 == tid  # same task, re-dispatched
+    assert s.complete(tid2)
+    # A duplicate/late completion is rejected once the lease is gone.
+    assert not s.complete(tid)
+    assert s.all_done()
+
+
+def test_fail_requeues_then_drops_poison(kind):
+    s, _ = make_service(kind)
+    s.add_task(b"poison")
+    for i in range(3):  # max failures = 3
+        status, tid, _ = s.lease("w")
+        assert status == LeaseStatus.OK
+        assert s.fail(tid)
+    status, _, _ = s.lease("w")
+    assert status == LeaseStatus.DONE  # dropped, not wedged
+    assert s.stats().dropped == 1
+
+
+def test_release_worker_returns_leases(kind):
+    s, _ = make_service(kind)
+    s.add_task(b"a")
+    s.add_task(b"b")
+    s.lease("w0")
+    s.lease("w0")
+    assert s.release_worker("w0") == 2
+    st = s.stats()
+    assert st.todo == 2 and st.leased == 0
+
+
+def test_multi_pass_recycles_tasks(kind):
+    s, _ = make_service(kind, passes=2)
+    s.add_task(b"x")
+    status, tid, _ = s.lease("w")
+    s.complete(tid)
+    assert s.current_pass() == 0 or s.current_pass() == 1
+    # pass 2: the task comes back
+    status, tid, payload = s.lease("w")
+    assert status == LeaseStatus.OK and payload == b"x"
+    s.complete(tid)
+    status, _, _ = s.lease("w")
+    assert status == LeaseStatus.DONE
+    assert s.current_pass() == 1
+
+
+def test_membership_epochs(kind):
+    s, clock = make_service(kind, member_ttl_ms=15_000)
+    e1 = s.join("w0", "host0:1")
+    e2 = s.join("w1", "host1:1")
+    assert e2 > e1
+    epoch, members = s.members()
+    assert [m[0] for m in members] == ["w0", "w1"]  # name-sorted = ranks
+    # heartbeats keep members alive through a TTL window
+    clock.advance(10_000)
+    assert s.heartbeat("w0")
+    assert s.heartbeat("w1")
+    clock.advance(10_000)
+    assert s.heartbeat("w1")
+    clock.advance(6_000)
+    # w0 missed its heartbeats: expired, epoch bumps
+    epoch2, members2 = s.members()
+    assert [m[0] for m in members2] == ["w1"]
+    assert epoch2 > epoch
+    # graceful leave bumps again
+    assert s.leave("w1")
+    assert s.epoch() > epoch2
+    # re-join after expiry works
+    assert not s.heartbeat("w0")
+    s.join("w0", "host0:1")
+    assert s.members()[1] == [("w0", "host0:1")]
+
+
+def test_kv_and_cas(kind):
+    s, _ = make_service(kind)
+    assert s.kv_get("k") is None
+    s.kv_set("k", b"v1")
+    assert s.kv_get("k") == b"v1"
+    # CAS: claim-if-absent (pserver slot semantics)
+    assert s.kv_cas("slot/0", b"", b"w0")
+    assert not s.kv_cas("slot/0", b"", b"w1")  # already claimed
+    assert s.kv_cas("slot/0", b"w0", b"w1")  # handoff with correct expect
+    assert s.kv_get("slot/0") == b"w1"
+    assert s.kv_keys("slot/") == ["slot/0"]
+    assert s.kv_del("k")
+    assert s.kv_get("k") is None
+
+
+def test_empty_payload_task(kind):
+    s, _ = make_service(kind)
+    tid = s.add_task(b"")
+    status, got, payload = s.lease("w")
+    assert status == LeaseStatus.OK and got == tid and payload == b""
+    assert s.complete(tid)
+
+
+# ---------------------------------------------------------------------------
+# TCP server integration (native binary + Python client)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def server():
+    h = spawn_server(port=0, task_timeout_ms=300)
+    yield h
+    h.stop()
+
+
+def test_server_roundtrip(server):
+    c = server.client()
+    assert c.ping()
+    tid = c.add_task(b"hello \x00 binary")
+    status, got, payload = c.lease("w0")
+    assert status == LeaseStatus.OK and got == tid
+    assert payload == b"hello \x00 binary"
+    assert c.complete(tid)
+    status, _, _ = c.lease("w0")
+    assert status == LeaseStatus.DONE
+    c.close()
+
+
+def test_server_timeout_redispatch_realtime(server):
+    import time
+
+    c = server.client()
+    tid = c.add_task(b"work")
+    status, t1, _ = c.lease("dead")
+    assert status == LeaseStatus.OK
+    time.sleep(0.4)  # server runs with task_timeout_ms=300
+    status, t2, payload = c.lease("alive")
+    assert status == LeaseStatus.OK and payload == b"work"
+    assert c.complete(t2)
+    c.close()
+
+
+def test_server_membership_and_kv(server):
+    c1 = server.client()
+    c2 = server.client()
+    e1 = c1.join("trainer-0", "10.0.0.1:7164")
+    e2 = c2.join("trainer-1", "10.0.0.2:7164")
+    assert e2 > e1
+    epoch, members = c1.members()
+    assert ("trainer-0", "10.0.0.1:7164") in members
+    assert ("trainer-1", "10.0.0.2:7164") in members
+    assert c1.kv_cas("ckpt/latest", b"", b"step-100")
+    assert c2.kv_get("ckpt/latest") == b"step-100"
+    assert c2.heartbeat("trainer-1")
+    assert c1.leave("trainer-0")
+    c1.close()
+    c2.close()
+
+
+def test_server_concurrent_lease_no_double_grant(server):
+    import threading
+
+    c = server.client()
+    n = 50
+    for i in range(n):
+        c.add_task(f"task-{i}".encode())
+    granted: list[int] = []
+    lock = threading.Lock()
+
+    def worker(wid):
+        cc = server.client()
+        while True:
+            status, tid, _ = cc.lease(f"w{wid}")
+            if status != LeaseStatus.OK:
+                break
+            with lock:
+                granted.append(tid)
+            cc.complete(tid)
+        cc.close()
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert sorted(granted)[-n:] == sorted(set(granted))[-n:]
+    assert len(set(granted)) == len(granted)  # every task granted exactly once
+    c.close()
+
+
+# ---------------------------------------------------------------------------
+# Regression tests for review findings
+# ---------------------------------------------------------------------------
+
+
+def test_all_tasks_dropped_multi_pass_terminates(kind):
+    # Poison pills across a multi-pass queue must finish, not livelock.
+    s, _ = make_service(kind, passes=3)
+    s.add_task(b"poison")
+    for _ in range(3):
+        status, tid, _ = s.lease("w")
+        assert status == LeaseStatus.OK
+        s.fail(tid)
+    status, _, _ = s.lease("w")
+    assert status == LeaseStatus.DONE
+    assert s.all_done()
+
+
+def test_zero_task_multi_pass_terminates(kind):
+    s, _ = make_service(kind, passes=5)
+    status, _, _ = s.lease("w")
+    assert status == LeaseStatus.DONE
+
+
+def test_large_payload_roundtrip(kind):
+    # > the bindings' initial 64 KiB buffer: grow-and-retry must kick in.
+    s, _ = make_service(kind)
+    blob = bytes(range(256)) * 1024  # 256 KiB
+    s.kv_set("big", blob)
+    assert s.kv_get("big") == blob
+    s.add_task(blob)
+    status, tid, payload = s.lease("w")
+    assert status == LeaseStatus.OK and payload == blob
+    assert s.complete(tid)
+
+
+def test_server_survives_malformed_commands(server):
+    import socket
+
+    raw = socket.create_connection(("127.0.0.1", server.port))
+    raw.sendall(b"COMPLETE abc\nFAIL 99999999999999999999999\nPING\n")
+    f = raw.makefile("rb")
+    l1, l2, l3 = f.readline(), f.readline(), f.readline()
+    assert l1.startswith(b"ERR")
+    assert l2.startswith(b"ERR")
+    assert l3.strip() == b"PONG"  # server alive
+    raw.close()
+
+
+def test_server_empty_kv_value(server):
+    c = server.client()
+    c.kv_set("empty", b"")
+    assert c.kv_get("empty") == b""
+    assert c.kv_cas("empty2", b"", b"")
+    assert c.kv_get("empty2") == b""
+    c.close()
+
+
+def test_server_join_empty_address_roundtrip(server):
+    c = server.client()
+    c.join("bare-worker")
+    _, members = c.members()
+    assert ("bare-worker", "") in members
+    c.leave("bare-worker")
+    c.close()
