@@ -71,6 +71,7 @@ def _load() -> Optional[ctypes.CDLL]:
         "edl_tq_lease": ([vp, cp, i64, pi64, cp, i64, pi64], i32),
         "edl_tq_complete": ([vp, i64, cp], i32),
         "edl_tq_fail": ([vp, i64, cp], i32),
+        "edl_tq_renew": ([vp, i64, cp, i64], i32),
         "edl_tq_peek_leased": ([vp, i64, cp, i64], i64),
         "edl_tq_redispatch": ([vp, i64], i32),
         "edl_tq_release_worker": ([vp, cp], i32),
@@ -172,6 +173,10 @@ class NativeCoordService:
     def fail(self, task_id: int, worker: str | None = None) -> bool:
         w = (worker or "").encode()
         return bool(self._lib.edl_tq_fail(self._h, task_id, w))
+
+    def renew(self, task_id: int, worker: str = "") -> bool:
+        return bool(self._lib.edl_tq_renew(self._h, task_id, worker.encode(),
+                                           self._clock()))
 
     def redispatch(self) -> int:
         return self._lib.edl_tq_redispatch(self._h, self._clock())

@@ -70,6 +70,10 @@ class CoordClient:
         args = ["FAIL", str(task_id)] + ([worker] if worker else [])
         return self._call(*args)[0] == "OK"
 
+    def renew(self, task_id: int, worker: str = "") -> bool:
+        args = ["RENEW", str(task_id)] + ([worker] if worker else [])
+        return self._call(*args)[0] == "OK"
+
     def release_worker(self, worker: str) -> int:
         r = self._call("RELEASE", worker)
         return int(r[1]) if r[0] == "OK" else 0

@@ -70,6 +70,14 @@ int edl_tq_fail(void* h, int64_t task_id, const char* worker) {
              : 0;
 }
 
+int edl_tq_renew(void* h, int64_t task_id, const char* worker,
+                 int64_t now_ms) {
+  return static_cast<Service*>(h)->queue.Renew(task_id, worker ? worker : "",
+                                               now_ms)
+             ? 1
+             : 0;
+}
+
 // Payload of a currently-leased task: returns length (copy if cap fits),
 // or -1 if not leased.  Lets bindings retry with a bigger buffer after a
 // truncated edl_tq_lease.

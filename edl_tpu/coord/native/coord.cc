@@ -80,6 +80,16 @@ bool TaskQueue::Fail(int64_t task_id, const std::string& worker) {
   return true;
 }
 
+bool TaskQueue::Renew(int64_t task_id, const std::string& worker,
+                      int64_t now_ms) {
+  std::lock_guard<std::mutex> lock(mu_);
+  auto it = leased_.find(task_id);
+  if (it == leased_.end()) return false;
+  if (!worker.empty() && it->second.worker != worker) return false;
+  it->second.deadline_ms = now_ms + timeout_ms_;
+  return true;
+}
+
 bool TaskQueue::PeekLeased(int64_t task_id, std::string* payload) const {
   std::lock_guard<std::mutex> lock(mu_);
   auto it = leased_.find(task_id);

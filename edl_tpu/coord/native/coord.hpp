@@ -68,6 +68,8 @@ class TaskQueue {
   // C ABI); false if the task is not leased.
   bool PeekLeased(int64_t task_id, std::string* payload) const;
   bool Fail(int64_t task_id, const std::string& worker = "");
+  // Extend a held lease's deadline (long-running shard keep-alive).
+  bool Renew(int64_t task_id, const std::string& worker, int64_t now_ms);
   // Return timed-out leases to the todo queue; called inline by LeaseTask
   // but also usable standalone. Returns number re-dispatched.
   int Redispatch(int64_t now_ms);

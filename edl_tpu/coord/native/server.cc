@@ -8,6 +8,7 @@
 //   ADD <hex>                      -> OK <id>
 //   COMPLETE <id> [worker]         -> OK | ERR (worker: ownership check)
 //   FAIL <id> [worker]             -> OK | ERR
+//   RENEW <id> [worker]            -> OK | ERR (lease keep-alive)
 //   RELEASE <worker>               -> OK <n>
 //   STATS                          -> OK <todo> <leased> <done> <dropped> <pass>
 //   JOIN <name> <addr>             -> OK <epoch>
@@ -133,6 +134,11 @@ std::string HandleImpl(const std::string& line) {
                : "ERR";
   if (cmd == "FAIL" && (args.size() == 2 || args.size() == 3))
     return s.queue.Fail(std::stoll(args[1]), args.size() == 3 ? args[2] : "")
+               ? "OK"
+               : "ERR";
+  if (cmd == "RENEW" && (args.size() == 2 || args.size() == 3))
+    return s.queue.Renew(std::stoll(args[1]),
+                         args.size() == 3 ? args[2] : "", NowMs())
                ? "OK"
                : "ERR";
   if (cmd == "RELEASE" && args.size() == 2)

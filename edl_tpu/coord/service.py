@@ -114,7 +114,7 @@ class PyCoordService:
             leased = self._leased.get(task_id)
             if leased is None:
                 return False  # late completion after re-dispatch
-            if worker is not None and leased.worker != worker:
+            if worker is not None and worker != "" and leased.worker != worker:
                 return False  # lease moved to another worker
             del self._leased[task_id]
             self._done.append(leased.task)
@@ -126,7 +126,7 @@ class PyCoordService:
             leased = self._leased.get(task_id)
             if leased is None:
                 return False
-            if worker is not None and leased.worker != worker:
+            if worker is not None and worker != "" and leased.worker != worker:
                 return False
             del self._leased[task_id]
             t = leased.task
@@ -136,6 +136,17 @@ class PyCoordService:
             else:
                 self._todo.append(t)
             self._maybe_advance_pass()
+            return True
+
+    def renew(self, task_id: int, worker: str) -> bool:
+        """Extend a held lease's deadline (call while working a long shard
+        so the 16 s re-dispatch clock measures *silence*, not shard size)."""
+        now = self._clock()
+        with self._lock:
+            leased = self._leased.get(task_id)
+            if leased is None or (worker and leased.worker != worker):
+                return False
+            leased.deadline_ms = now + self._timeout_ms
             return True
 
     def redispatch(self) -> int:

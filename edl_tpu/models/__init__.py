@@ -11,6 +11,6 @@ All models are plain pytree params + pure apply/loss functions so they
 compose with ElasticTrainer and pjit without framework glue.
 """
 
-from edl_tpu.models import mlp, word2vec
+from edl_tpu.models import bert, mlp, resnet, transformer, word2vec
 
-__all__ = ["mlp", "word2vec"]
+__all__ = ["bert", "mlp", "resnet", "transformer", "word2vec"]

@@ -1,0 +1,128 @@
+"""BERT-base-class bidirectional encoder with an MLM objective
+(BASELINE.json config 3: "BERT-base pretrain with elastic reshard across
+TPU slice resize").
+
+Reuses the transformer core's attention/MLP machinery with causal=False,
+learned position embeddings, and pre-LN blocks.  Params are plain pytrees;
+partition specs follow the same column/row-parallel scheme as the decoder.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from edl_tpu.models.transformer import _maybe_constrain, rms_norm
+from edl_tpu.ops.flash_attention import attention
+
+
+@dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30_522
+    d_model: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    d_ff: int = 3072
+    max_seq_len: int = 512
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    use_flash: bool = True
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+
+BERT_BASE = BertConfig()
+TINY = BertConfig(vocab_size=256, d_model=64, n_layers=2, n_heads=4,
+                  d_ff=128, max_seq_len=64, dtype=jnp.float32,
+                  use_flash=False)
+
+
+def init(key: jax.Array, cfg: BertConfig) -> dict:
+    d, h, hd, ff = cfg.d_model, cfg.n_heads, cfg.head_dim, cfg.d_ff
+    k_emb, k_pos, *k_layers = jax.random.split(key, cfg.n_layers + 2)
+
+    def dense(k, shape, fan_in):
+        return (jax.random.normal(k, shape, dtype=jnp.float32)
+                * (2.0 / fan_in) ** 0.5)
+
+    def layer(k):
+        ks = jax.random.split(k, 6)
+        return {
+            "attn_norm": jnp.ones((d,), jnp.float32),
+            "wq": dense(ks[0], (d, h * hd), d),
+            "wk": dense(ks[1], (d, h * hd), d),
+            "wv": dense(ks[2], (d, h * hd), d),
+            "wo": dense(ks[3], (h * hd, d), h * hd),
+            "mlp_norm": jnp.ones((d,), jnp.float32),
+            "w1": dense(ks[4], (d, ff), d),
+            "w2": dense(ks[5], (ff, d), ff),
+        }
+
+    return {
+        "embed": jax.random.normal(k_emb, (cfg.vocab_size, d),
+                                   dtype=jnp.float32) * 0.02,
+        "pos": jax.random.normal(k_pos, (cfg.max_seq_len, d),
+                                 dtype=jnp.float32) * 0.02,
+        "layers": [layer(k) for k in k_layers],
+        "norm": jnp.ones((d,), jnp.float32),
+    }
+
+
+def param_partition_specs(cfg: BertConfig) -> dict:
+    layer = {
+        "attn_norm": P(), "wq": P("fsdp", "tp"), "wk": P("fsdp", "tp"),
+        "wv": P("fsdp", "tp"), "wo": P("tp", "fsdp"), "mlp_norm": P(),
+        "w1": P("fsdp", "tp"), "w2": P("tp", "fsdp"),
+    }
+    return {
+        "embed": P("tp", "fsdp"),
+        "pos": P(),
+        "layers": [dict(layer) for _ in range(cfg.n_layers)],
+        "norm": P(),
+    }
+
+
+def apply(params: dict, tokens: jax.Array, cfg: BertConfig) -> jax.Array:
+    """tokens [b, s] → contextual embeddings [b, s, d]."""
+    b, s = tokens.shape
+    dt = cfg.dtype
+    x = (params["embed"].astype(dt)[tokens]
+         + params["pos"][:s].astype(dt)[None])
+    x = _maybe_constrain(x, P(("dp", "fsdp"), "sp", None))
+    h, hd = cfg.n_heads, cfg.head_dim
+    for p in params["layers"]:
+        xn = rms_norm(x, p["attn_norm"], cfg.norm_eps)
+        q = (xn @ p["wq"].astype(dt)).reshape(b, s, h, hd)
+        k = (xn @ p["wk"].astype(dt)).reshape(b, s, h, hd)
+        v = (xn @ p["wv"].astype(dt)).reshape(b, s, h, hd)
+        o = attention(q, k, v, causal=False, use_pallas=cfg.use_flash)
+        x = x + o.reshape(b, s, h * hd) @ p["wo"].astype(dt)
+        xn = rms_norm(x, p["mlp_norm"], cfg.norm_eps)
+        x = x + (jax.nn.gelu(xn @ p["w1"].astype(dt)) @ p["w2"].astype(dt))
+        x = _maybe_constrain(x, P(("dp", "fsdp"), "sp", None))
+    return rms_norm(x, params["norm"], cfg.norm_eps)
+
+
+def mlm_loss_fn(params: dict, batch, cfg: BertConfig) -> jax.Array:
+    """batch = (masked_tokens[b,s], targets[b,s], mask[b,s] 0/1).
+
+    Loss over masked positions only, with the untied-by-default decoder
+    being the (tied) embedding transpose."""
+    masked, targets, mask = batch
+    hdn = apply(params, masked, cfg)
+    logits = (hdn @ params["embed"].astype(hdn.dtype).T).astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    denom = jnp.maximum(jnp.sum(mask), 1.0)
+    return jnp.sum(nll * mask) / denom
+
+
+def make_loss_fn(cfg: BertConfig):
+    return partial(mlm_loss_fn, cfg=cfg)

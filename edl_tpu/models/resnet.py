@@ -1,0 +1,133 @@
+"""ResNet-50-class conv net (BASELINE.json config 2: "ResNet-50 / ImageNet
+elastic data-parallel, scale 2→8 trainers").
+
+Plain-pytree params over ``lax.conv_general_dilated`` in NHWC (the TPU-
+friendly layout: channels on the lane dimension feed the MXU as implicit
+matmuls).  BatchNorm is replaced by GroupNorm so the model is invariant to
+the per-device batch slicing that elastic DP resizing changes — a running-
+stats BN would see different per-device batch statistics before and after
+every resize.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclass(frozen=True)
+class ResNetConfig:
+    stage_sizes: Sequence[int] = (3, 4, 6, 3)  # ResNet-50
+    width: int = 64
+    num_classes: int = 1000
+    groups: int = 32  # GroupNorm groups
+    dtype: Any = jnp.bfloat16
+
+
+RESNET50 = ResNetConfig()
+TINY = ResNetConfig(stage_sizes=(1, 1), width=8, num_classes=10, groups=4,
+                    dtype=jnp.float32)
+
+
+def _conv_init(key, kh, kw, cin, cout):
+    fan_in = kh * kw * cin
+    return (jax.random.normal(key, (kh, kw, cin, cout), dtype=jnp.float32)
+            * (2.0 / fan_in) ** 0.5)
+
+
+def _gn_init(c):
+    return {"scale": jnp.ones((c,), jnp.float32),
+            "bias": jnp.zeros((c,), jnp.float32)}
+
+
+def init(key: jax.Array, cfg: ResNetConfig) -> dict:
+    keys = iter(jax.random.split(key, 4 * sum(cfg.stage_sizes) * 3 + 16))
+    params: dict = {
+        "stem": _conv_init(next(keys), 7, 7, 3, cfg.width),
+        "stem_norm": _gn_init(cfg.width),
+        "stages": [],
+    }
+    cin = cfg.width
+    for stage, n_blocks in enumerate(cfg.stage_sizes):
+        cmid = cfg.width * (2 ** stage)
+        cout = cmid * 4
+        blocks = []
+        for b in range(n_blocks):
+            blk = {
+                "conv1": _conv_init(next(keys), 1, 1, cin, cmid),
+                "norm1": _gn_init(cmid),
+                "conv2": _conv_init(next(keys), 3, 3, cmid, cmid),
+                "norm2": _gn_init(cmid),
+                "conv3": _conv_init(next(keys), 1, 1, cmid, cout),
+                "norm3": _gn_init(cout),
+            }
+            if cin != cout:
+                blk["proj"] = _conv_init(next(keys), 1, 1, cin, cout)
+                blk["proj_norm"] = _gn_init(cout)
+            blocks.append(blk)
+            cin = cout
+        params["stages"].append(blocks)
+    params["head"] = (jax.random.normal(next(keys), (cin, cfg.num_classes),
+                                        dtype=jnp.float32)
+                      * (1.0 / cin) ** 0.5)
+    params["head_bias"] = jnp.zeros((cfg.num_classes,), jnp.float32)
+    return params
+
+
+def _conv(x, w, stride=1):
+    return jax.lax.conv_general_dilated(
+        x, w.astype(x.dtype), window_strides=(stride, stride),
+        padding="SAME", dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        preferred_element_type=jnp.float32).astype(x.dtype)
+
+
+def _group_norm(x, p, groups, eps=1e-5):
+    b, h, w, c = x.shape
+    orig = x.dtype
+    g = x.astype(jnp.float32).reshape(b, h, w, groups, c // groups)
+    mean = jnp.mean(g, axis=(1, 2, 4), keepdims=True)
+    var = jnp.var(g, axis=(1, 2, 4), keepdims=True)
+    g = (g - mean) * jax.lax.rsqrt(var + eps)
+    x = g.reshape(b, h, w, c) * p["scale"] + p["bias"]
+    return x.astype(orig)
+
+
+def _bottleneck(x, blk, groups, stride):
+    y = jax.nn.relu(_group_norm(_conv(x, blk["conv1"]), blk["norm1"], groups))
+    y = jax.nn.relu(_group_norm(_conv(y, blk["conv2"], stride), blk["norm2"],
+                                groups))
+    y = _group_norm(_conv(y, blk["conv3"]), blk["norm3"], groups)
+    if "proj" in blk:
+        x = _group_norm(_conv(x, blk["proj"], stride), blk["proj_norm"],
+                        groups)
+    return jax.nn.relu(x + y)
+
+
+def apply(params: dict, images: jax.Array, cfg: ResNetConfig) -> jax.Array:
+    """images [b, h, w, 3] → logits [b, num_classes]."""
+    x = images.astype(cfg.dtype)
+    x = _conv(x, params["stem"], stride=2)
+    x = jax.nn.relu(_group_norm(x, params["stem_norm"], cfg.groups))
+    x = jax.lax.reduce_window(x, -jnp.inf, jax.lax.max, (1, 3, 3, 1),
+                              (1, 2, 2, 1), "SAME")
+    for stage, blocks in enumerate(params["stages"]):
+        for b, blk in enumerate(blocks):
+            stride = 2 if (stage > 0 and b == 0) else 1
+            x = _bottleneck(x, blk, cfg.groups, stride)
+    x = jnp.mean(x, axis=(1, 2))  # global average pool
+    return (x @ params["head"].astype(x.dtype)
+            + params["head_bias"]).astype(jnp.float32)
+
+
+def loss_fn(params: dict, batch, cfg: ResNetConfig) -> jax.Array:
+    images, labels = batch
+    logp = jax.nn.log_softmax(apply(params, images, cfg), axis=-1)
+    return -jnp.mean(jnp.take_along_axis(logp, labels[:, None], axis=1))
+
+
+def make_loss_fn(cfg: ResNetConfig):
+    return partial(loss_fn, cfg=cfg)

@@ -1,0 +1,270 @@
+"""Decoder transformer core (Llama-family): RMSNorm, RoPE, GQA attention,
+SwiGLU MLP — written TPU-first.
+
+Design notes (why this shape):
+
+* **MXU**: every FLOP-heavy op is a large batched matmul in bfloat16 with
+  fp32 accumulation (``preferred_element_type``); no data-dependent Python
+  control flow, static shapes throughout, `lax.scan`-free because the layer
+  stack is unrolled at trace time over a static list.
+* **Sharding**: :func:`param_partition_specs` gives per-parameter
+  PartitionSpecs over the canonical mesh axes (fsdp for ZeRO-3-style
+  sharding, tp for megatron-style tensor parallel: column-parallel
+  wq/wk/wv/w1/w3, row-parallel wo/w2 — so each transformer block needs only
+  two all-reduces, which XLA inserts automatically from the specs).
+  Activations get sequence-parallel (sp) constraints so long sequences
+  shard over the mesh; attention over an sp>1 mesh routes through ring
+  attention (edl_tpu.parallel.ring_attention).
+* **Attention kernel**: uses the pallas flash-attention kernel on TPU
+  (edl_tpu.ops.flash_attention) and a reference jnp path elsewhere.
+
+The reference has no model code at all (SURVEY §0: models live in external
+Paddle binaries) — this zoo exists to satisfy BASELINE.json's benchmark
+configs on the TPU-native stack.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from edl_tpu.ops.flash_attention import attention as flash_attention
+
+
+def _maybe_constrain(x: jax.Array, spec: P) -> jax.Array:
+    """with_sharding_constraint iff a mesh context is active — the model
+    works unchanged single-device and sharded."""
+    from jax.sharding import get_abstract_mesh
+
+    mesh = get_abstract_mesh()
+    if mesh is None or mesh.empty:
+        return x
+    # only constrain axes the mesh actually has
+    if any(ax not in mesh.axis_names
+           for part in spec if part is not None
+           for ax in ((part,) if isinstance(part, str) else part)):
+        return x
+    return jax.lax.with_sharding_constraint(x, spec)
+
+
+@dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32_000
+    d_model: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8  # GQA (Llama-3 style)
+    d_ff: int = 14_336  # SwiGLU hidden
+    max_seq_len: int = 8192
+    rope_theta: float = 500_000.0
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16  # compute dtype; params live in fp32
+    use_flash: bool = True
+    # remat the block fn: trade FLOPs for HBM (jax.checkpoint)
+    remat: bool = True
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+
+# Llama-3-8B-class config (BASELINE.json config 4)
+LLAMA3_8B = TransformerConfig()
+
+# Tiny config for tests / compile checks
+TINY = TransformerConfig(
+    vocab_size=256, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
+    d_ff=128, max_seq_len=128, dtype=jnp.float32, use_flash=False,
+    remat=False,
+)
+
+
+# -- init --------------------------------------------------------------------
+
+
+def init(key: jax.Array, cfg: TransformerConfig) -> dict:
+    """Params as a flat-ish pytree: {embed, layers: [...], norm, lm_head}."""
+    k_emb, k_out, *k_layers = jax.random.split(key, cfg.n_layers + 2)
+    d, h, kv, hd, ff = (cfg.d_model, cfg.n_heads, cfg.n_kv_heads,
+                        cfg.head_dim, cfg.d_ff)
+
+    def dense(k, shape, fan_in):
+        return (jax.random.normal(k, shape, dtype=jnp.float32)
+                * (2.0 / fan_in) ** 0.5)
+
+    def layer(k):
+        ks = jax.random.split(k, 7)
+        return {
+            "attn_norm": jnp.ones((d,), jnp.float32),
+            "wq": dense(ks[0], (d, h * hd), d),
+            "wk": dense(ks[1], (d, kv * hd), d),
+            "wv": dense(ks[2], (d, kv * hd), d),
+            "wo": dense(ks[3], (h * hd, d), h * hd),
+            "mlp_norm": jnp.ones((d,), jnp.float32),
+            "w1": dense(ks[4], (d, ff), d),  # gate
+            "w3": dense(ks[5], (d, ff), d),  # up
+            "w2": dense(ks[6], (ff, d), ff),  # down
+        }
+
+    return {
+        "embed": jax.random.normal(k_emb, (cfg.vocab_size, d),
+                                   dtype=jnp.float32) * 0.02,
+        "layers": [layer(k) for k in k_layers],
+        "norm": jnp.ones((d,), jnp.float32),
+        "lm_head": dense(k_out, (d, cfg.vocab_size), d),
+    }
+
+
+# -- sharding rules ----------------------------------------------------------
+
+
+def param_partition_specs(cfg: TransformerConfig) -> dict:
+    """PartitionSpecs per parameter over the canonical axes.
+
+    Column-parallel (output dim over tp): wq/wk/wv, w1/w3.
+    Row-parallel (input dim over tp): wo, w2 — XLA then inserts exactly one
+    all-reduce after attention and one after the MLP per block, riding ICI.
+    The fsdp axis shards the other dim (ZeRO-3); embed/lm_head shard vocab
+    over tp.
+    """
+    layer = {
+        "attn_norm": P(),
+        "wq": P("fsdp", "tp"),
+        "wk": P("fsdp", "tp"),
+        "wv": P("fsdp", "tp"),
+        "wo": P("tp", "fsdp"),
+        "mlp_norm": P(),
+        "w1": P("fsdp", "tp"),
+        "w3": P("fsdp", "tp"),
+        "w2": P("tp", "fsdp"),
+    }
+    return {
+        "embed": P("tp", "fsdp"),
+        "layers": [dict(layer) for _ in range(cfg.n_layers)],
+        "norm": P(),
+        "lm_head": P("fsdp", "tp"),
+    }
+
+
+def batch_partition_spec() -> P:
+    """[batch, seq] inputs: batch over dp+fsdp, sequence over sp."""
+    return P(("dp", "fsdp"), "sp")
+
+
+def activation_spec() -> P:
+    """[batch, seq, d] activations."""
+    return P(("dp", "fsdp"), "sp", None)
+
+
+# -- building blocks ---------------------------------------------------------
+
+
+def rms_norm(x: jax.Array, weight: jax.Array, eps: float) -> jax.Array:
+    orig = x.dtype
+    x = x.astype(jnp.float32)
+    x = x * jax.lax.rsqrt(jnp.mean(x * x, axis=-1, keepdims=True) + eps)
+    return (x * weight).astype(orig)
+
+
+def rope_freqs(cfg: TransformerConfig, positions: jax.Array) -> jax.Array:
+    """[seq, head_dim/2] complex rotation angles."""
+    inv = 1.0 / (cfg.rope_theta ** (
+        jnp.arange(0, cfg.head_dim, 2, dtype=jnp.float32) / cfg.head_dim))
+    return jnp.einsum("s,d->sd", positions.astype(jnp.float32), inv)
+
+
+def apply_rope(x: jax.Array, angles: jax.Array) -> jax.Array:
+    """x: [b, s, heads, head_dim]; angles: [s, head_dim/2]."""
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    cos = jnp.cos(angles)[None, :, None, :]
+    sin = jnp.sin(angles)[None, :, None, :]
+    return jnp.concatenate(
+        [x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1
+    )
+
+
+def _attention_block(p: dict, x: jax.Array, angles: jax.Array,
+                     cfg: TransformerConfig) -> jax.Array:
+    b, s, d = x.shape
+    h, kv, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    dt = cfg.dtype
+
+    xn = rms_norm(x, p["attn_norm"], cfg.norm_eps)
+    q = (xn @ p["wq"].astype(dt)).reshape(b, s, h, hd)
+    k = (xn @ p["wk"].astype(dt)).reshape(b, s, kv, hd)
+    v = (xn @ p["wv"].astype(dt)).reshape(b, s, kv, hd)
+
+    q = apply_rope(q, angles).astype(dt)
+    k = apply_rope(k, angles).astype(dt)
+
+    if kv != h:  # GQA: repeat kv heads
+        rep = h // kv
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+
+    # Long-context routing: on an sp>1 mesh, the sequence dimension is
+    # sharded and attention rings the k/v chunks over ICI; otherwise the
+    # flash kernel (TPU) or reference path handles the full sequence.
+    from jax.sharding import get_abstract_mesh
+
+    mesh = get_abstract_mesh()
+    if (mesh is not None and not mesh.empty
+            and "sp" in mesh.axis_names and mesh.shape["sp"] > 1):
+        from edl_tpu.parallel.ring_attention import ring_attention_sharded
+
+        o = ring_attention_sharded(q, k, v, causal=True)
+    else:
+        o = flash_attention(q, k, v, causal=True, use_pallas=cfg.use_flash)
+    o = o.reshape(b, s, h * hd)
+    return x + (o @ p["wo"].astype(dt))
+
+
+def _mlp_block(p: dict, x: jax.Array, cfg: TransformerConfig) -> jax.Array:
+    dt = cfg.dtype
+    xn = rms_norm(x, p["mlp_norm"], cfg.norm_eps)
+    gate = jax.nn.silu(xn @ p["w1"].astype(dt))
+    up = xn @ p["w3"].astype(dt)
+    return x + ((gate * up) @ p["w2"].astype(dt))
+
+
+def _block(p: dict, x: jax.Array, angles: jax.Array,
+           cfg: TransformerConfig) -> jax.Array:
+    x = _attention_block(p, x, angles, cfg)
+    x = _mlp_block(p, x, cfg)
+    # keep activations sequence-parallel across blocks
+    return _maybe_constrain(x, activation_spec())
+
+
+def apply(params: dict, tokens: jax.Array,
+          cfg: TransformerConfig) -> jax.Array:
+    """tokens [b, s] int32 → logits [b, s, vocab] (fp32)."""
+    x = params["embed"].astype(cfg.dtype)[tokens]
+    x = _maybe_constrain(x, activation_spec())
+    positions = jnp.arange(tokens.shape[1])
+    angles = rope_freqs(cfg, positions)
+    block = _block
+    if cfg.remat:
+        block = jax.checkpoint(_block, static_argnums=(3,))
+    for p in params["layers"]:
+        x = block(p, x, angles, cfg)
+    x = rms_norm(x, params["norm"], cfg.norm_eps)
+    return (x @ params["lm_head"].astype(cfg.dtype)).astype(jnp.float32)
+
+
+def loss_fn(params: dict, batch: tuple[jax.Array, jax.Array],
+            cfg: TransformerConfig) -> jax.Array:
+    """Next-token cross entropy; batch = (tokens[b,s], targets[b,s])."""
+    tokens, targets = batch
+    logits = apply(params, tokens, cfg)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
+    return jnp.mean(nll)
+
+
+def make_loss_fn(cfg: TransformerConfig):
+    return partial(loss_fn, cfg=cfg)

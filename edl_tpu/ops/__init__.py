@@ -1,0 +1,5 @@
+"""Pallas TPU kernels for the hot ops (+ reference jnp fallbacks)."""
+
+from edl_tpu.ops.flash_attention import attention
+
+__all__ = ["attention"]

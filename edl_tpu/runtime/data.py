@@ -18,6 +18,9 @@ from typing import Callable, Iterator, Optional
 import numpy as np
 
 from edl_tpu.coord.service import LeaseStatus
+from edl_tpu.observability.logging import get_logger
+
+log = get_logger("runtime.data")
 
 
 class ShardRegistry:
@@ -85,6 +88,17 @@ class TaskLeaseBatches:
                 if self.drop_remainder else n
             for lo in range(0, stop, self.batch_size):
                 yield tuple(a[lo:lo + self.batch_size] for a in arrays)
-            self.coord.complete(task_id, self.worker)
-            if self.on_task_done is not None:
+                # Keep-alive: a long shard must not look like a dead worker
+                # (the 16 s clock measures silence, not shard size).
+                renew = getattr(self.coord, "renew", None)
+                if renew is not None:
+                    renew(task_id, self.worker)
+            if not self.coord.complete(task_id, self.worker):
+                # Lease expired and moved despite renewals (e.g. a stall
+                # longer than the timeout): the shard will be re-trained
+                # by another worker — log it, losing the race is safe but
+                # duplicate gradients deserve a trace.
+                log.warn("lease lost before completion; shard may be "
+                         "trained twice", task_id=task_id, worker=self.worker)
+            elif self.on_task_done is not None:
                 self.on_task_done(task_id)
