@@ -1,0 +1,11 @@
+#!/usr/bin/env bash
+# Build the controller + job images — role of the reference's
+# docker/build.sh (which writes a Dockerfile on the fly over a Paddle base
+# and ADDs the k8s glue; ours are checked in).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+TAG="${TAG:-latest}"
+docker build -f docker/Dockerfile.controller -t "edl-tpu/controller:${TAG}" .
+docker build -f docker/Dockerfile.job        -t "edl-tpu/job:${TAG}" .
+echo "built edl-tpu/controller:${TAG} and edl-tpu/job:${TAG}"

@@ -1,0 +1,150 @@
+"""TrainingJob YAML/dict (de)serialization.
+
+Role of the reference's CRD decode path: users submit a ``TrainingJob``
+manifest (reference example/examplejob.yaml; schema
+pkg/resource/training_job.go:109-159) and the controller materializes it.
+The manifest shape is kept deliberately close to the reference's so a
+reference job YAML ports by changing ``apiVersion`` and swapping GPU
+limits for ``google.com/tpu`` chips / a ``topology``.
+
+Both snake_case and the reference's kebab-case keys are accepted
+(``min-instance`` / ``min_instance``).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from edl_tpu.api.types import (
+    MasterSpec,
+    PserverSpec,
+    ResourceRequirements,
+    TpuTopology,
+    TrainerSpec,
+    TrainingJob,
+    TrainingJobSpec,
+)
+
+API_VERSION = "edl.tpu/v1"
+KIND = "TrainingJob"
+
+
+def _norm(d: dict[str, Any]) -> dict[str, Any]:
+    return {k.replace("-", "_"): v for k, v in d.items()}
+
+
+def _resources(d: dict[str, Any] | None) -> ResourceRequirements:
+    d = _norm(d or {})
+    return ResourceRequirements(
+        requests={k: str(v) for k, v in (d.get("requests") or {}).items()},
+        limits={k: str(v) for k, v in (d.get("limits") or {}).items()},
+    )
+
+
+def job_from_dict(doc: dict[str, Any]) -> TrainingJob:
+    if doc.get("kind", KIND) != KIND:
+        raise ValueError(f"not a {KIND} manifest: kind={doc.get('kind')!r}")
+    meta = _norm(doc.get("metadata") or {})
+    spec = _norm(doc.get("spec") or {})
+
+    t = _norm(spec.get("trainer") or {})
+    trainer = TrainerSpec(
+        entrypoint=t.get("entrypoint", ""),
+        workspace=t.get("workspace", ""),
+        min_instance=int(t.get("min_instance", 1)),
+        max_instance=int(t.get("max_instance", 1)),
+        resources=_resources(t.get("resources")),
+        topology=(TpuTopology.parse(str(t["topology"]))
+                  if t.get("topology") else None),
+    )
+    p = _norm(spec.get("pserver") or {})
+    pserver = PserverSpec(
+        min_instance=int(p.get("min_instance", 0)),
+        max_instance=int(p.get("max_instance", 0)),
+        resources=_resources(p.get("resources")),
+    )
+    m = _norm(spec.get("master") or {})
+    master = MasterSpec(
+        etcd_endpoint=m.get("etcd_endpoint", m.get("coord_endpoint", "")),
+        resources=_resources(m.get("resources")),
+    )
+    return TrainingJob(
+        name=meta.get("name", ""),
+        namespace=meta.get("namespace", "default"),
+        labels=dict(meta.get("labels") or {}),
+        spec=TrainingJobSpec(
+            image=spec.get("image", ""),
+            port=int(spec.get("port", 0)),
+            ports_num=int(spec.get("ports_num", 0)),
+            ports_num_for_sparse=int(spec.get("ports_num_for_sparse", 0)),
+            fault_tolerant=bool(spec.get("fault_tolerant", False)),
+            passes=int(spec.get("passes", 0)),
+            host_network=bool(spec.get("host_network", False)),
+            node_selector=dict(spec.get("node_selector") or {}),
+            trainer=trainer,
+            pserver=pserver,
+            master=master,
+        ),
+    )
+
+
+def job_to_dict(job: TrainingJob) -> dict[str, Any]:
+    def res(r: ResourceRequirements) -> dict[str, Any]:
+        return {
+            "requests": {k: str(v) for k, v in r.requests.items()},
+            "limits": {k: str(v) for k, v in r.limits.items()},
+        }
+
+    t = job.spec.trainer
+    doc: dict[str, Any] = {
+        "apiVersion": API_VERSION,
+        "kind": KIND,
+        "metadata": {"name": job.name, "namespace": job.namespace,
+                     "labels": dict(job.labels)},
+        "spec": {
+            "image": job.spec.image,
+            "port": job.spec.port,
+            "ports_num": job.spec.ports_num,
+            "ports_num_for_sparse": job.spec.ports_num_for_sparse,
+            "fault_tolerant": job.spec.fault_tolerant,
+            "passes": job.spec.passes,
+            "host_network": job.spec.host_network,
+            "node_selector": dict(job.spec.node_selector),
+            "trainer": {
+                "entrypoint": t.entrypoint,
+                "workspace": t.workspace,
+                "min_instance": t.min_instance,
+                "max_instance": t.max_instance,
+                "resources": res(t.resources),
+            },
+            "pserver": {
+                "min_instance": job.spec.pserver.min_instance,
+                "max_instance": job.spec.pserver.max_instance,
+                "resources": res(job.spec.pserver.resources),
+            },
+            "master": {
+                "etcd_endpoint": job.spec.master.etcd_endpoint,
+                "resources": res(job.spec.master.resources),
+            },
+        },
+    }
+    if t.topology is not None:
+        doc["spec"]["trainer"]["topology"] = str(t.topology)
+    return doc
+
+
+def job_from_yaml(text: str) -> TrainingJob:
+    import yaml
+
+    return job_from_dict(yaml.safe_load(text))
+
+
+def job_to_yaml(job: TrainingJob) -> str:
+    import yaml
+
+    return yaml.safe_dump(job_to_dict(job), sort_keys=False)
+
+
+def load_job_file(path: str) -> TrainingJob:
+    with open(path) as f:
+        return job_from_yaml(f.read())

@@ -1,0 +1,191 @@
+"""``edl-tpu`` — the operator CLI.
+
+Role of the reference's ``edl`` binary (reference cmd/edl/edl.go:16-51):
+parse flags, build the cluster backend, construct the controller, run
+forever.  The reference's three flags survive verbatim
+(``--kubeconfig``, ``--log-level``, ``--max-load-desired``,
+edl.go:17-20); further verbs cover the rest of the reference's operator
+surface:
+
+  controller    run the control plane (controller + autoscaler loop)
+  collector     cluster metrics TSV (role of example/collector.py)
+  coordinator   run the coordination server (role of the Go master+etcd)
+  launch        pod-role entrypoint dispatch (role of docker/paddle_k8s)
+  submit        submit a TrainingJob manifest
+  delete        delete a job (role of example/del_jobs.sh for one job)
+  validate      parse+default+validate a manifest, print the result
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+from edl_tpu.observability.logging import get_logger, setup as setup_logging
+
+log = get_logger("cli")
+
+
+def _build_cluster(args):
+    if getattr(args, "fake", False):
+        from edl_tpu.cluster.fake import FakeCluster
+
+        return FakeCluster()
+    from edl_tpu.cluster.k8s import K8sCluster
+
+    return K8sCluster(kubeconfig=args.kubeconfig, namespace=args.namespace)
+
+
+def cmd_controller(args) -> int:
+    from edl_tpu.controller.controller import Controller
+    from edl_tpu.scheduler.topology import POW2_POLICY, UNIT_POLICY
+
+    cluster = _build_cluster(args)
+    controller = Controller(
+        cluster,
+        max_load_desired=args.max_load_desired,
+        shape_policy=POW2_POLICY if args.pow2_shapes else UNIT_POLICY,
+        autoscaler_loop_seconds=args.loop_seconds,
+    )
+    log.info("controller starting", max_load_desired=args.max_load_desired,
+             loop_seconds=args.loop_seconds)
+    controller.start()
+    try:
+        while True:  # role of the select{} park in edl.go:50
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        controller.stop()
+    return 0
+
+
+def cmd_collector(args) -> int:
+    from edl_tpu.observability.collector import Collector
+
+    cluster = _build_cluster(args)
+    Collector(cluster, interval_s=args.interval).run(
+        max_samples=args.samples if args.samples > 0 else None)
+    return 0
+
+
+def cmd_coordinator(args) -> int:
+    from edl_tpu.coord import server as coord_server
+
+    return coord_server.main(["--port", str(args.port)])
+
+
+def cmd_launch(args) -> int:
+    from edl_tpu.runtime import launcher
+
+    return launcher.main([args.verb] + args.rest)
+
+
+def cmd_submit(args) -> int:
+    from edl_tpu.api.serde import load_job_file
+    from edl_tpu.api.validation import set_defaults_and_validate
+
+    job = load_job_file(args.manifest)
+    set_defaults_and_validate(job)
+    cluster = _build_cluster(args)
+    cluster.create_resources(job)
+    log.info("job submitted", job=job.full_name,
+             trainers=f"{job.spec.trainer.min_instance}"
+                      f"-{job.spec.trainer.max_instance}",
+             elastic=job.elastic())
+    return 0
+
+
+def cmd_delete(args) -> int:
+    from edl_tpu.api.types import TrainingJob
+
+    cluster = _build_cluster(args)
+    cluster.delete_resources(
+        TrainingJob(name=args.name, namespace=args.namespace))
+    log.info("job deleted", job=f"{args.namespace}/{args.name}")
+    return 0
+
+
+def cmd_validate(args) -> int:
+    from edl_tpu.api.serde import job_to_yaml, load_job_file
+    from edl_tpu.api.validation import ValidationError, set_defaults_and_validate
+
+    try:
+        job = load_job_file(args.manifest)
+        set_defaults_and_validate(job)
+    except (ValidationError, ValueError, OSError) as exc:
+        print(f"INVALID: {exc}", file=sys.stderr)
+        return 1
+    print(job_to_yaml(job), end="")
+    return 0
+
+
+def _add_cluster_flags(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--kubeconfig", default=None,
+                   help="path to kubeconfig; in-cluster config if omitted "
+                        "(reference cmd/edl/edl.go:17, 31-36)")
+    p.add_argument("--namespace", default="default")
+    p.add_argument("--fake", action="store_true",
+                   help="use the in-memory cluster backend (demos/tests)")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="edl-tpu",
+                                description="TPU-native elastic deep learning")
+    p.add_argument("--log-level", default="info",
+                   choices=["debug", "info", "warn", "error"],
+                   help="reference cmd/edl/edl.go:18")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    c = sub.add_parser("controller", help="run the control plane")
+    _add_cluster_flags(c)
+    c.add_argument("--max-load-desired", type=float, default=0.97,
+                   help="cluster load ceiling (reference cmd/edl/edl.go:19)")
+    c.add_argument("--loop-seconds", type=float, default=5.0,
+                   help="autoscaler cadence (reference pkg/autoscaler.go:31)")
+    c.add_argument("--pow2-shapes", action="store_true",
+                   help="scale trainer counts in powers of two (TPU slice "
+                        "shape policy)")
+    c.set_defaults(fn=cmd_controller)
+
+    c = sub.add_parser("collector", help="cluster metrics TSV")
+    _add_cluster_flags(c)
+    c.add_argument("--interval", type=float, default=10.0,
+                   help="sampling cadence (reference example/collector.py:226)")
+    c.add_argument("--samples", type=int, default=0,
+                   help="stop after N samples (0 = forever)")
+    c.set_defaults(fn=cmd_collector)
+
+    c = sub.add_parser("coordinator", help="run the coordination server")
+    c.add_argument("--port", type=int, default=7164)
+    c.set_defaults(fn=cmd_coordinator)
+
+    c = sub.add_parser("launch", help="pod-role entrypoint")
+    c.add_argument("verb",
+                   choices=["start_coordinator", "start_trainer"])
+    c.add_argument("rest", nargs="*")
+    c.set_defaults(fn=cmd_launch)
+
+    c = sub.add_parser("submit", help="submit a TrainingJob manifest")
+    _add_cluster_flags(c)
+    c.add_argument("manifest")
+    c.set_defaults(fn=cmd_submit)
+
+    c = sub.add_parser("delete", help="delete a job")
+    _add_cluster_flags(c)
+    c.add_argument("name")
+    c.set_defaults(fn=cmd_delete)
+
+    c = sub.add_parser("validate", help="validate a manifest")
+    c.add_argument("manifest")
+    c.set_defaults(fn=cmd_validate)
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    setup_logging(args.log_level)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

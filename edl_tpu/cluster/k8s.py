@@ -16,9 +16,26 @@ mechanical.
 
 from __future__ import annotations
 
+from dataclasses import dataclass
+
 from edl_tpu.api.types import RESOURCE_TPU, TrainingJob
-from edl_tpu.cluster.base import Cluster, PodCounts
+from edl_tpu.cluster.base import Cluster, PodCounts, PodPhase
 from edl_tpu.cluster.resource import ClusterResource, NodeResources
+
+
+@dataclass(frozen=True)
+class PodView:
+    """Read-only pod record matching the FakePod attribute surface."""
+
+    name: str
+    job_uid: str
+    role: str
+    phase: PodPhase
+    node: str | None = None
+    deletion_timestamp: bool = False
+    cpu_request_milli: int = 0
+    memory_request_mega: int = 0
+    tpu_limit: int = 0
 
 try:  # pragma: no cover - not installed in the build image
     import kubernetes  # type: ignore
@@ -126,6 +143,18 @@ class K8sCluster(Cluster):
                 self._batch.create_namespaced_job(job.namespace, manifest)
             elif manifest["kind"] == "ReplicaSet":
                 apps.create_namespaced_replica_set(job.namespace, manifest)
+            elif manifest["kind"] == "Service":
+                self._core.create_namespaced_service(job.namespace, manifest)
+
+    def list_training_jobs(self) -> list[str]:  # pragma: no cover
+        """Names of jobs with a trainer group in this namespace (role of
+        the TrainingJob list the reference's del_jobs.sh iterates)."""
+        names = []
+        for j in self._batch.list_namespaced_job(self.namespace).items:
+            labels = j.metadata.labels or {}
+            if TRAINER_LABEL in labels:
+                names.append(labels[TRAINER_LABEL])
+        return sorted(set(names))
 
     def delete_resources(self, job: TrainingJob) -> None:  # pragma: no cover
         apps = kubernetes.client.AppsV1Api()
@@ -145,6 +174,45 @@ class K8sCluster(Cluster):
         except kubernetes.client.exceptions.ApiException as exc:
             if exc.status != 404:
                 raise
+        try:
+            self._core.delete_namespaced_service(
+                f"{job.name}-coordinator", job.namespace)
+        except kubernetes.client.exceptions.ApiException as exc:
+            if exc.status != 404:
+                raise
+
+    def list_pods(self, job_uid: str | None = None, role: str | None = None
+                  ) -> list["PodView"]:  # pragma: no cover
+        """Pods as lightweight records with the FakePod attribute surface
+        (what the Collector and PodDiscovery consume)."""
+        out = []
+        role_labels = {"trainer": TRAINER_LABEL,
+                       "master": "edl-tpu-job-coordinator",
+                       "pserver": "edl-tpu-job-pserver"}
+        for pod in self._core.list_namespaced_pod(self.namespace).items:
+            labels = pod.metadata.labels or {}
+            pod_role, pod_job = "system", ""
+            for r, label in role_labels.items():
+                if label in labels:
+                    pod_role, pod_job = r, f"{self.namespace}/{labels[label]}"
+                    break
+            if job_uid is not None and pod_job != job_uid:
+                continue
+            if role is not None and pod_role != role:
+                continue
+            creq, _, mreq, _, tl = _pod_resources(pod)
+            out.append(PodView(
+                name=pod.metadata.name,
+                job_uid=pod_job,
+                role=pod_role,
+                phase=PodPhase(pod.status.phase or "Pending"),
+                node=pod.spec.node_name,
+                deletion_timestamp=pod.metadata.deletion_timestamp is not None,
+                cpu_request_milli=creq,
+                memory_request_mega=mreq,
+                tpu_limit=tl,
+            ))
+        return out
 
 
 def _trainer_name(job: TrainingJob) -> str:

@@ -32,7 +32,7 @@ def pod_env(job: TrainingJob, role: str) -> dict[str, str]:
     """Environment contract consumed by the elastic runtime entrypoint
     (role of podEnv, reference pkg/jobparser.go:263-311; consumed by
     docker/paddle_k8s + trainers in the reference, by
-    edl_tpu.runtime.entrypoint here)."""
+    edl_tpu.runtime.launcher here)."""
     spec = job.spec
     env = {
         "EDL_JOB_NAME": job.name,
@@ -54,6 +54,13 @@ def pod_env(job: TrainingJob, role: str) -> dict[str, str]:
         env["EDL_TPU_TOPOLOGY"] = str(spec.trainer.topology)
     if spec.master.etcd_endpoint:
         env["EDL_COORD_ENDPOINT"] = spec.master.etcd_endpoint
+    elif spec.fault_tolerant:
+        # Default endpoint = the coordinator Service's cluster DNS name
+        # (role of the MASTER_IP discovery the reference did by polling
+        # pods, paddle_k8s:128-129 — a Service is the k8s-idiomatic form).
+        env["EDL_COORD_ENDPOINT"] = (
+            f"{job.name}-coordinator.{job.namespace}.svc"
+            f":{spec.port or COORDINATOR_PORT}")
     return env
 
 
@@ -91,7 +98,8 @@ def parse_to_trainer(job: TrainingJob) -> dict[str, Any]:
                             "name": "trainer",
                             "image": spec.image,
                             "command": ["python", "-m",
-                                        "edl_tpu.runtime.entrypoint"],
+                                        "edl_tpu.runtime.launcher",
+                                        "start_trainer"],
                             "env": [
                                 {"name": k, "value": v}
                                 for k, v in pod_env(job, "trainer").items()
@@ -170,7 +178,9 @@ def parse_to_pserver(job: TrainingJob) -> dict[str, Any] | None:
                         {
                             "name": "pserver",
                             "image": spec.image,
-                            "command": ["python", "-m", "edl_tpu.coord.pserver"],
+                            "command": ["python", "-m",
+                                        "edl_tpu.runtime.launcher",
+                                        "start_pserver"],
                             "env": [
                                 {"name": k, "value": v}
                                 for k, v in pod_env(job, "pserver").items()
@@ -184,6 +194,30 @@ def parse_to_pserver(job: TrainingJob) -> dict[str, Any] | None:
     }
 
 
+def parse_to_coordinator_service(job: TrainingJob) -> dict[str, Any]:
+    """Stable DNS name for the coordinator (role of the master's
+    discoverability — the reference resolved the master pod IP by polling,
+    paddle_k8s:128-129; a Service is the k8s-idiomatic equivalent and what
+    pod_env's default EDL_COORD_ENDPOINT points at)."""
+    spec = job.spec
+    return {
+        "kind": "Service",
+        "apiVersion": "v1",
+        "metadata": {
+            "name": f"{job.name}-coordinator",
+            "namespace": job.namespace,
+            "labels": {"edl-tpu-job-coordinator": job.name},
+        },
+        "spec": {
+            "selector": {"edl-tpu-job-coordinator": job.name},
+            "ports": [
+                {"name": "coord", "port": spec.port or COORDINATOR_PORT},
+                {"name": "health", "port": HEALTH_PORT},
+            ],
+        },
+    }
+
+
 def parse_to_manifests(job: TrainingJob) -> list[dict[str, Any]]:
     """All worker-group manifests for a job, coordinator first (the
     Gen-2 create order: master → pserver → trainer,
@@ -191,6 +225,7 @@ def parse_to_manifests(job: TrainingJob) -> list[dict[str, Any]]:
     out: list[dict[str, Any]] = []
     if job.spec.fault_tolerant:
         out.append(parse_to_coordinator(job))
+        out.append(parse_to_coordinator_service(job))
     ps = parse_to_pserver(job)
     if ps is not None:
         out.append(ps)

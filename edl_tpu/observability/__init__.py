@@ -1,5 +1,8 @@
 """Observability: structured logging, metrics collector, step tracing."""
 
+from edl_tpu.observability.collector import Collector, JobInfo, Sample
 from edl_tpu.observability.logging import get_logger
+from edl_tpu.observability.tracing import Tracer, get_tracer, profile_step
 
-__all__ = ["get_logger"]
+__all__ = ["Collector", "JobInfo", "Sample", "Tracer", "get_logger",
+           "get_tracer", "profile_step"]

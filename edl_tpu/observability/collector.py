@@ -1,0 +1,163 @@
+"""Cluster metrics collector.
+
+TPU-native port of the reference's metrics collector
+(reference example/collector.py:20-226): poll the cluster on a fixed
+cadence (10 s, collector.py:226), classify every job's pods by role and
+phase (collector.py:95-118), and emit one TSV line per sample with the
+reference's four metric columns (collector.py:215-226):
+
+  * ``SUBMITTED-JOBS``   — jobs with any pod present (collector.py:194)
+  * ``PENDING-JOBS``     — jobs whose master/pserver is pending, or whose
+    trainers are absent or all pending (collector.py:194-202)
+  * ``RUNNING-TRAINERS`` — ``job:count|job:count`` (collector.py:137-154)
+  * ``CPU-UTILS`` / ``CHIP-UTILS`` — Σ running-pod requests (chip limits
+    for the accelerator, like the reference's GPU limits) over allocatable
+    (collector.py:156-179); ``CHIP-UTILS`` replaces ``GPU-UTILS`` — the
+    accelerator dimension here is TPU chips.
+
+Works over any backend exposing ``inquiry_resource()`` and
+``list_pods()`` (the :class:`~edl_tpu.cluster.fake.FakeCluster` contract);
+utilization is computed from the pods directly, not from the snapshot's
+request sums, so the collector observes exactly what is *running* — the
+same choice the reference makes by summing only Running pods.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+from dataclasses import dataclass, field
+from typing import Callable, TextIO
+
+from edl_tpu.cluster.base import PodPhase
+
+#: Reference sampling cadence (example/collector.py:226).
+DEFAULT_INTERVAL_S = 10.0
+
+_HEADER = ("TIMESTAMP", "SUBMITTED-JOBS", "PENDING-JOBS",
+           "RUNNING-TRAINERS", "CPU-UTILS", "CHIP-UTILS")
+
+
+@dataclass
+class JobInfo:
+    """Per-job pod phase lists — reference example/collector.py:95-118."""
+
+    name: str
+    masters: list[PodPhase] = field(default_factory=list)
+    pservers: list[PodPhase] = field(default_factory=list)
+    trainers: list[PodPhase] = field(default_factory=list)
+
+    def running_trainers(self) -> int:
+        return sum(1 for p in self.trainers if p == PodPhase.RUNNING)
+
+    def pending(self) -> bool:
+        """Reference pending rule (example/collector.py:194-202): the job
+        counts as pending if any master/pserver pod is pending, or it has
+        no trainer pods yet, or every trainer pod is pending."""
+        if any(p == PodPhase.PENDING for p in self.masters + self.pservers):
+            return True
+        if not self.trainers:
+            return True
+        return all(p == PodPhase.PENDING for p in self.trainers)
+
+
+@dataclass(frozen=True)
+class Sample:
+    """One collector sample = one TSV line."""
+
+    timestamp: float
+    submitted_jobs: int
+    pending_jobs: int
+    running_trainers: dict[str, int]
+    cpu_utils_pct: float
+    chip_utils_pct: float
+
+    def tsv(self) -> str:
+        trainers = "|".join(
+            f"{name}:{n}" for name, n in sorted(self.running_trainers.items()))
+        return "\t".join([
+            time.strftime("%Y-%m-%d %H:%M:%S", time.localtime(self.timestamp)),
+            str(self.submitted_jobs),
+            str(self.pending_jobs),
+            trainers or "-",
+            f"{self.cpu_utils_pct:.2f}",
+            f"{self.chip_utils_pct:.2f}",
+        ])
+
+
+class Collector:
+    """Polling metrics collector (reference example/collector.py `Collector`)."""
+
+    def __init__(self, cluster, interval_s: float = DEFAULT_INTERVAL_S,
+                 out: TextIO | None = None,
+                 clock: Callable[[], float] = time.time) -> None:
+        self._cluster = cluster
+        self._interval_s = interval_s
+        self._out = out  # None = current sys.stdout at write time
+        self._clock = clock
+        self._header_written = False
+
+    # -- classification (reference collector.py:95-118) --------------------
+
+    def job_infos(self, pods=None) -> dict[str, JobInfo]:
+        if pods is None:
+            pods = self._cluster.list_pods()
+        infos: dict[str, JobInfo] = {}
+        for pod in pods:
+            if not pod.job_uid:  # system pods carry no job label
+                continue
+            info = infos.setdefault(pod.job_uid, JobInfo(pod.job_uid))
+            bucket = {"master": info.masters, "pserver": info.pservers,
+                      "trainer": info.trainers}.get(pod.role)
+            if bucket is None:
+                continue
+            phase = (PodPhase.TERMINATING if pod.deletion_timestamp
+                     else pod.phase)
+            bucket.append(phase)
+        return infos
+
+    # -- one sample (reference collector.py:120-213) ------------------------
+
+    def run_once(self) -> Sample:
+        r = self._cluster.inquiry_resource()
+        pods = self._cluster.list_pods()  # one LIST serves both aggregates
+        infos = self.job_infos(pods)
+
+        cpu_running = 0
+        chips_running = 0
+        for pod in pods:
+            if pod.phase != PodPhase.RUNNING:
+                continue  # only Running pods count (collector.py:156-179)
+            cpu_running += pod.cpu_request_milli
+            chips_running += pod.tpu_limit
+
+        sample = Sample(
+            timestamp=self._clock(),
+            submitted_jobs=len(infos),
+            pending_jobs=sum(1 for i in infos.values() if i.pending()),
+            running_trainers={n: i.running_trainers() for n, i in infos.items()},
+            cpu_utils_pct=(100.0 * cpu_running / r.cpu_total_milli
+                           if r.cpu_total_milli else 0.0),
+            chip_utils_pct=(100.0 * chips_running / r.tpu_total
+                            if r.tpu_total else 0.0),
+        )
+        self._write(sample)
+        return sample
+
+    def run(self, max_samples: int | None = None) -> None:
+        """Poll forever (reference collector.py:215-226); ``max_samples``
+        bounds the loop for tests/CLI dry runs."""
+        n = 0
+        while max_samples is None or n < max_samples:
+            self.run_once()
+            n += 1
+            if max_samples is not None and n >= max_samples:
+                break
+            time.sleep(self._interval_s)
+
+    def _write(self, sample: Sample) -> None:
+        out = self._out if self._out is not None else sys.stdout
+        if not self._header_written:
+            print("\t".join(_HEADER), file=out)
+            self._header_written = True
+        print(sample.tsv(), file=out, flush=True)

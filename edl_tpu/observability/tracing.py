@@ -1,0 +1,120 @@
+"""Lightweight event tracing for steps and scale events.
+
+The reference has no tracing at all (SURVEY §5.1 — nothing beyond log
+lines with caller annotation, reference cmd/edl/edl.go:26-28).  This build
+adds the two things an elastic-training operator actually needs:
+
+  * a **trace ring** of timestamped events (train steps, scale decisions,
+    membership epochs, checkpoint saves/restores) that is cheap enough to
+    leave on, queryable in-process, and dumpable as Chrome
+    ``chrome://tracing`` JSON for offline inspection, and
+  * a **jax profiler surface** — ``profile_step()`` wraps a step in a
+    ``jax.profiler.TraceAnnotation`` and ``start_server()`` exposes the
+    live profiler so TensorBoard/XProf can attach to a running trainer.
+
+Events are recorded into a bounded deque so a week-long job cannot OOM the
+host from tracing.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import deque
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Iterator
+
+
+@dataclass(frozen=True)
+class TraceEvent:
+    name: str          # e.g. "train_step", "scale_plan", "epoch_change"
+    category: str      # "step" | "scale" | "membership" | "checkpoint" | ...
+    start_s: float
+    duration_s: float
+    args: dict = field(default_factory=dict)
+
+
+class Tracer:
+    """Bounded in-process event trace."""
+
+    def __init__(self, capacity: int = 65536,
+                 clock=time.perf_counter) -> None:
+        self._events: deque[TraceEvent] = deque(maxlen=capacity)
+        self._lock = threading.Lock()
+        self._clock = clock
+
+    def instant(self, name: str, category: str = "event", **args) -> None:
+        """Zero-duration marker (scale decision, epoch bump, ...)."""
+        with self._lock:
+            self._events.append(
+                TraceEvent(name, category, self._clock(), 0.0, args))
+
+    @contextmanager
+    def span(self, name: str, category: str = "step", **args) -> Iterator[None]:
+        """Timed region; the event is recorded when the region exits."""
+        t0 = self._clock()
+        try:
+            yield
+        finally:
+            with self._lock:
+                self._events.append(
+                    TraceEvent(name, category, t0, self._clock() - t0, args))
+
+    def events(self, category: str | None = None) -> list[TraceEvent]:
+        with self._lock:
+            evs = list(self._events)
+        if category is not None:
+            evs = [e for e in evs if e.category == category]
+        return evs
+
+    def clear(self) -> None:
+        with self._lock:
+            self._events.clear()
+
+    # -- export -------------------------------------------------------------
+
+    def to_chrome_trace(self) -> str:
+        """Chrome trace-event JSON (load in chrome://tracing / Perfetto)."""
+        out = []
+        for e in self.events():
+            out.append({
+                "name": e.name, "cat": e.category,
+                "ph": "X" if e.duration_s > 0 else "i",
+                "ts": e.start_s * 1e6, "dur": e.duration_s * 1e6,
+                "pid": 0, "tid": 0, "args": e.args,
+            })
+        return json.dumps({"traceEvents": out})
+
+    def dump(self, path: str) -> None:
+        with open(path, "w") as f:
+            f.write(self.to_chrome_trace())
+
+
+#: Process-wide default tracer — what the runtime and scheduler record into.
+_default = Tracer()
+
+
+def get_tracer() -> Tracer:
+    return _default
+
+
+# -- jax profiler surface ----------------------------------------------------
+
+@contextmanager
+def profile_step(name: str = "train_step") -> Iterator[None]:
+    """Annotate a step region in the XLA/jax device profile (shows up in
+    XProf/TensorBoard timelines) while also recording it in the tracer."""
+    import jax.profiler
+
+    with get_tracer().span(name, category="step"):
+        with jax.profiler.TraceAnnotation(name):
+            yield
+
+
+def start_server(port: int = 9999):
+    """Expose the live jax profiler so TensorBoard can attach."""
+    import jax.profiler
+
+    return jax.profiler.start_server(port)

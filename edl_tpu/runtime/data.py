@@ -30,18 +30,33 @@ class ShardRegistry:
     def __init__(self) -> None:
         self._shards: dict[int, tuple[np.ndarray, ...]] = {}
 
-    def add_arrays(self, coord, arrays: tuple[np.ndarray, ...],
-                   num_shards: int) -> None:
-        """Split arrays row-wise into ``num_shards`` tasks on ``coord``."""
+    def register_arrays(self, arrays: tuple[np.ndarray, ...],
+                        num_shards: int) -> list[int]:
+        """Split arrays row-wise into ``num_shards`` locally-resolvable
+        shards (no queue interaction).  Every worker registers the same
+        deterministic split; only one worker enqueues the tasks — the same
+        separation as RecordIO files on shared storage vs. the master's
+        task list (reference example/train_ft.py:112)."""
         n = arrays[0].shape[0]
         for a in arrays:
             if a.shape[0] != n:
                 raise ValueError("all arrays must share the leading dim")
         splits = np.array_split(np.arange(n), num_shards)
+        ids = []
         for idx in splits:
             shard_id = len(self._shards)
             self._shards[shard_id] = tuple(a[idx] for a in arrays)
+            ids.append(shard_id)
+        return ids
+
+    def enqueue(self, coord, shard_ids: list[int]) -> None:
+        for shard_id in shard_ids:
             coord.add_task(json.dumps({"shard": shard_id}).encode())
+
+    def add_arrays(self, coord, arrays: tuple[np.ndarray, ...],
+                   num_shards: int) -> None:
+        """Register + enqueue in one go (single-worker convenience)."""
+        self.enqueue(coord, self.register_arrays(arrays, num_shards))
 
     def fetch(self, payload: bytes) -> tuple[np.ndarray, ...]:
         shard_id = json.loads(payload.decode())["shard"]

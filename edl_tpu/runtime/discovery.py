@@ -1,0 +1,145 @@
+"""Peer discovery and rendezvous for worker pods.
+
+TPU-native port of the reference's pod-discovery tool
+(reference docker/k8s_tools.py:1-151) with the idiom upgrade called for in
+SURVEY §7: **rank comes from the coordination service, not from sorting
+pod IPs** (the reference's ``fetch_id`` = index of my IP in the sorted
+Running-pod IP list, k8s_tools.py:113-121, breaks the moment a pod is
+replaced with a lower IP — fine for its static non-FT path, wrong for an
+elastic mesh).
+
+Two discovery backends:
+
+* :class:`CoordDiscovery` — membership via the coordination service
+  (``edl_tpu.coord``): join with a stable worker name, ranks are the
+  sorted-by-name member index *within an epoch*.  Every join/leave bumps
+  the epoch, which is exactly the signal the elastic runtime reshards on.
+* :class:`PodDiscovery` — behavioral equivalents of the reference verbs
+  (``wait_pods_running``, ``count_pods_by_phase``, ``fetch_addresses``,
+  ``fetch_rank``) over any backend exposing ``list_pods()`` (the
+  :class:`~edl_tpu.cluster.fake.FakeCluster` contract; a live k8s backend
+  lists pods by label selector the same way, k8s_tools.py:95-110).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from edl_tpu.cluster.base import PodPhase
+
+#: Reference poll cadence (k8s_tools.py:70-78 sleeps 5 s between checks).
+POLL_INTERVAL_S = 5.0
+
+
+class DiscoveryTimeout(TimeoutError):
+    pass
+
+
+class CoordDiscovery:
+    """Rendezvous through the coordination service's membership epochs."""
+
+    def __init__(self, client, name: str, address: str = "") -> None:
+        self._client = client
+        self.name = name
+        self.address = address
+        self.member_id: Optional[int] = None
+
+    def join(self) -> int:
+        """Register this worker; returns the membership epoch after join."""
+        self.member_id = self._client.join(self.name, self.address)
+        return self._client.epoch()
+
+    def leave(self) -> None:
+        self._client.leave(self.name)
+        self.member_id = None
+
+    def heartbeat(self) -> bool:
+        return self._client.heartbeat(self.name)
+
+    def epoch(self) -> int:
+        return self._client.epoch()
+
+    def peers(self) -> list[tuple[str, str]]:
+        """(name, address) of every live member, sorted by name — the
+        stable total order ranks are derived from."""
+        _, members = self._client.members()
+        return sorted(members)
+
+    def rank_and_world(self) -> tuple[int, int]:
+        """My rank = index of my name in the sorted live-member list.
+
+        Stable under pod replacement (a rejoining worker keeps its name →
+        keeps its slot) — unlike the reference's IP-sort rank
+        (k8s_tools.py:113-121)."""
+        peers = self.peers()
+        names = [n for n, _ in peers]
+        if self.name not in names:
+            raise RuntimeError(
+                f"worker {self.name!r} not in membership; call join() first")
+        return names.index(self.name), len(peers)
+
+    def wait_members(self, n: int, timeout_s: float = 300.0,
+                     poll_s: float = 0.1) -> list[tuple[str, str]]:
+        """Barrier until ≥ n members are live (role of wait_pods_running,
+        k8s_tools.py:70-78 — ``≥`` not ``==`` because "pods may be
+        scaled")."""
+        deadline = time.monotonic() + timeout_s
+        while True:
+            peers = self.peers()
+            if len(peers) >= n:
+                return peers
+            if time.monotonic() >= deadline:
+                raise DiscoveryTimeout(
+                    f"waited {timeout_s}s for {n} members, have {len(peers)}")
+            time.sleep(poll_s)
+
+
+class PodDiscovery:
+    """Reference-verb equivalents over a pod-listing backend."""
+
+    def __init__(self, lister, job_uid: str, role: str = "trainer",
+                 poll_s: float = POLL_INTERVAL_S) -> None:
+        self._lister = lister
+        self._job_uid = job_uid
+        self._role = role
+        self._poll_s = poll_s
+
+    def _pods(self):
+        return self._lister.list_pods(job_uid=self._job_uid, role=self._role)
+
+    def count_pods_by_phase(self, phase: PodPhase) -> int:
+        """Reference k8s_tools.py:90-92 (Terminating counted via
+        deletion_timestamp, k8s_tools.py:29-36)."""
+        n = 0
+        for p in self._pods():
+            eff = PodPhase.TERMINATING if p.deletion_timestamp else p.phase
+            n += eff == phase
+        return n
+
+    def wait_pods_running(self, n: int, timeout_s: float = 600.0) -> int:
+        """Poll until ≥ n pods Running (k8s_tools.py:70-78)."""
+        deadline = time.monotonic() + timeout_s
+        while True:
+            running = self.count_pods_by_phase(PodPhase.RUNNING)
+            if running >= n:
+                return running
+            if time.monotonic() >= deadline:
+                raise DiscoveryTimeout(
+                    f"waited {timeout_s}s for {n} running pods, have {running}")
+            time.sleep(self._poll_s)
+
+    def fetch_addresses(self) -> list[str]:
+        """Sorted Running-pod names/addresses (k8s_tools.py:95-110)."""
+        return sorted(
+            p.name for p in self._pods() if p.phase == PodPhase.RUNNING)
+
+    def fetch_rank(self, my_name: str) -> int:
+        """Reference fetch_id semantics (k8s_tools.py:113-121) — kept for
+        the static (non-fault-tolerant) path only; elastic jobs use
+        :meth:`CoordDiscovery.rank_and_world`."""
+        addrs = self.fetch_addresses()
+        try:
+            return addrs.index(my_name)
+        except ValueError:
+            raise RuntimeError(f"{my_name!r} not among running pods {addrs}")

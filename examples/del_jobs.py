@@ -1,0 +1,33 @@
+"""Bulk job deleter — role of the reference's example/del_jobs.sh
+(delete every TrainingJob and its worker groups).
+
+    python examples/del_jobs.py [--namespace default] [--kubeconfig ...]
+"""
+
+from __future__ import annotations
+
+import argparse
+
+from edl_tpu.api.types import TrainingJob
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--namespace", default="default")
+    ap.add_argument("--kubeconfig", default=None)
+    args = ap.parse_args()
+
+    from edl_tpu.cluster.k8s import K8sCluster
+
+    cluster = K8sCluster(kubeconfig=args.kubeconfig, namespace=args.namespace)
+    names = cluster.list_training_jobs()
+    for name in names:
+        cluster.delete_resources(TrainingJob(name=name,
+                                             namespace=args.namespace))
+        print(f"deleted {args.namespace}/{name}")
+    if not names:
+        print("no TrainingJobs found")
+
+
+if __name__ == "__main__":
+    main()

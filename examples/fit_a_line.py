@@ -1,0 +1,66 @@
+"""Linear-regression example (fit a line).
+
+Port of the reference's simplest Fluid example (reference
+example/fluid/fit_a_line.py:76-93: linear regression on the UCI housing
+features, role-split via the DistributeTranspiler).  TPU-native shape: a
+jitted least-squares step; distribution, when run under the launcher's
+static path, is the same EDL_TRAINER_ID shard rule as mnist.py.
+
+    python examples/fit_a_line.py
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+FEATURES = 13  # UCI housing dimensionality (fit_a_line.py:20)
+BATCH, STEPS = 32, 400
+
+
+def synthetic_housing(n=2048, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(0, 1, (n, FEATURES)).astype(np.float32)
+    w_true = rng.normal(0, 1, (FEATURES, 1)).astype(np.float32)
+    y = x @ w_true + 0.1 * rng.normal(0, 1, (n, 1)).astype(np.float32)
+    return x, y
+
+
+def main() -> None:
+    trainer_id = int(os.environ.get("EDL_TRAINER_ID", "0"))
+    trainers = int(os.environ.get("EDL_TRAINERS", "1"))
+    x, y = synthetic_housing()
+    x, y = x[trainer_id::trainers], y[trainer_id::trainers]
+
+    params = {"w": jnp.zeros((FEATURES, 1)), "b": jnp.zeros(())}
+    optimizer = optax.sgd(1e-2)
+    opt_state = optimizer.init(params)
+
+    def loss_fn(params, batch):
+        xb, yb = batch
+        pred = xb @ params["w"] + params["b"]
+        return jnp.mean((pred - yb) ** 2)
+
+    @jax.jit
+    def step(params, opt_state, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+        updates, opt_state = optimizer.update(grads, opt_state)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    first = None
+    for i in range(STEPS):
+        lo = (i * BATCH) % (len(x) - BATCH)
+        params, opt_state, loss = step(
+            params, opt_state, (x[lo:lo + BATCH], y[lo:lo + BATCH]))
+        first = float(loss) if first is None else first
+    print(f"trainer {trainer_id}/{trainers}: "
+          f"mse {first:.4f} -> {float(loss):.4f}")
+    assert float(loss) < first
+
+
+if __name__ == "__main__":
+    main()

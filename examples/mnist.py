@@ -1,0 +1,136 @@
+"""Distributed MNIST-class example with prepare/train/infer subcommands.
+
+Port of the reference's Fluid example (reference
+example/fluid/recognize_digits.py:176-189 — ``prepare`` shards the dataset
+to pickles, ``train`` runs the transpiled distributed loop, ``infer``
+loads the saved model; static shard assignment
+``idx % trainers == trainer_id``, example/fluid/common.py:24-40).
+
+TPU-native shape: the DistributeTranspiler's pserver/trainer program split
+is gone — the "distributed" part is a jit-sharded data-parallel step, and
+the static shard rule survives as the non-elastic data path
+(``EDL_TRAINER_ID``/``EDL_TRAINERS`` env, exported by the launcher's
+static path).
+
+    python examples/mnist.py prepare [data_dir]
+    python examples/mnist.py train   [data_dir]
+    python examples/mnist.py infer   [data_dir]
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import sys
+import tempfile
+from pathlib import Path
+
+import jax
+import numpy as np
+import optax
+
+from edl_tpu.models import mlp
+from edl_tpu.runtime.checkpoint import ElasticCheckpointer
+
+SIZES = [784, 256, 128, 10]
+BATCH, EPOCHS, SHARDS = 64, 6, 8
+
+
+def _default_dir() -> str:
+    return os.environ.get("EDL_DATA_DIR",
+                          str(Path(tempfile.gettempdir()) / "edl-tpu-mnist"))
+
+
+def synthetic_mnist(n=4096, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(0, 1, (n, 784)).astype(np.float32)
+    # one fixed labeling matrix across all seeds, so train and holdout
+    # share the target function
+    w = np.random.default_rng(42).normal(0, 1, (784, 10)).astype(np.float32)
+    y = (x @ w).argmax(axis=1).astype(np.int32)  # linearly separable labels
+    return x, y
+
+
+def prepare(data_dir: str) -> None:
+    """Shard the dataset to pickle files (role of prepare_dataset,
+    reference example/fluid/common.py:6-22)."""
+    out = Path(data_dir)
+    out.mkdir(parents=True, exist_ok=True)
+    x, y = synthetic_mnist()
+    for i, idx in enumerate(np.array_split(np.arange(len(x)), SHARDS)):
+        with open(out / f"shard-{i:03d}.pkl", "wb") as f:
+            pickle.dump((x[idx], y[idx]), f)
+    print(f"wrote {SHARDS} shards to {out}")
+
+
+def cluster_reader(data_dir: str, trainer_id: int, trainers: int):
+    """Static shard assignment idx % trainers == trainer_id
+    (reference example/fluid/common.py:24-40)."""
+    shards = sorted(Path(data_dir).glob("shard-*.pkl"))
+    for i, path in enumerate(shards):
+        if i % trainers != trainer_id:
+            continue
+        with open(path, "rb") as f:
+            yield pickle.load(f)
+
+
+def train(data_dir: str) -> None:
+    trainer_id = int(os.environ.get("EDL_TRAINER_ID", "0"))
+    trainers = int(os.environ.get("EDL_TRAINERS", "1"))
+    params = mlp.init(jax.random.key(0), SIZES)
+    optimizer = optax.adam(1e-3)
+    opt_state = optimizer.init(params)
+
+    @jax.jit
+    def step(params, opt_state, batch):
+        loss, grads = jax.value_and_grad(mlp.loss_fn)(params, batch)
+        updates, opt_state = optimizer.update(grads, opt_state)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    n_steps, loss = 0, None
+    for _ in range(EPOCHS):
+        for x, y in cluster_reader(data_dir, trainer_id, trainers):
+            for lo in range(0, len(x) - BATCH + 1, BATCH):
+                params, opt_state, loss = step(
+                    params, opt_state, (x[lo:lo + BATCH], y[lo:lo + BATCH]))
+                n_steps += 1
+    ckpt = ElasticCheckpointer(str(Path(data_dir) / "model"))
+    ckpt.save(n_steps, {"params": params})
+    ckpt.close()
+    x, y = synthetic_mnist(512, seed=1)
+    acc = float(mlp.accuracy(params, (x, y)))
+    print(f"trainer {trainer_id}/{trainers}: {n_steps} steps, "
+          f"loss {float(loss):.4f}, holdout acc {acc:.3f}")
+
+
+def infer(data_dir: str) -> None:
+    """Load the saved model and classify a batch (role of the ``infer``
+    subcommand, reference example/fluid/recognize_digits.py:150-174)."""
+    params = mlp.init(jax.random.key(0), SIZES)  # shape template
+    ckpt = ElasticCheckpointer(str(Path(data_dir) / "model"))
+    state = ckpt.restore({"params": params})
+    ckpt.close()
+    x, y = synthetic_mnist(64, seed=2)
+    pred = np.asarray(mlp.apply(state["params"], x).argmax(axis=1))
+    print(f"inferred {len(pred)} samples, acc "
+          f"{float((pred == y).mean()):.3f}")
+
+
+def main() -> None:
+    cmd = sys.argv[1] if len(sys.argv) > 1 else "train"
+    data_dir = sys.argv[2] if len(sys.argv) > 2 else _default_dir()
+    if cmd == "prepare":
+        prepare(data_dir)
+    elif cmd == "train":
+        if not list(Path(data_dir).glob("shard-*.pkl")):
+            prepare(data_dir)
+        train(data_dir)
+    elif cmd == "infer":
+        infer(data_dir)
+    else:
+        raise SystemExit(f"unknown subcommand {cmd!r} "
+                         "(want prepare|train|infer)")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,62 @@
+"""Local (single-process) word2vec training with per-pass checkpoints.
+
+Port of the reference's local example (reference example/train_local.py:
+1-109: same model as train_ft, local SGD, parameters saved to a tar each
+pass).  Here the per-pass tar becomes an Orbax checkpoint
+(ElasticCheckpointer), which is also what survives a mesh resize in the
+elastic path.
+
+    python examples/train_local.py [checkpoint_dir]
+"""
+
+from __future__ import annotations
+
+import sys
+import tempfile
+
+import jax
+import numpy as np
+import optax
+
+from edl_tpu.models import word2vec
+from edl_tpu.runtime.checkpoint import ElasticCheckpointer
+
+VOCAB, CONTEXT, EMBED, BATCH, PASSES = 2048, 4, 32, 32, 2
+
+
+def main() -> None:
+    ckpt_dir = sys.argv[1] if len(sys.argv) > 1 else tempfile.mkdtemp(
+        prefix="edl-tpu-w2v-")
+    rng = np.random.default_rng(0)
+    ctx = rng.integers(0, VOCAB, (4096, CONTEXT), dtype=np.int32)
+    tgt = (ctx.sum(axis=1) % VOCAB).astype(np.int32)
+
+    params = word2vec.init(jax.random.key(0), VOCAB, CONTEXT, EMBED)
+    optimizer = optax.adam(3e-3)
+    opt_state = optimizer.init(params)
+
+    @jax.jit
+    def step(params, opt_state, batch):
+        loss, grads = jax.value_and_grad(word2vec.loss_fn)(params, batch)
+        updates, opt_state = optimizer.update(grads, opt_state)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    ckpt = ElasticCheckpointer(ckpt_dir)
+    global_step, first = 0, None
+    for p in range(PASSES):
+        for lo in range(0, len(ctx) - BATCH + 1, BATCH):
+            batch = (ctx[lo:lo + BATCH], tgt[lo:lo + BATCH])
+            params, opt_state, loss = step(params, opt_state, batch)
+            first = float(loss) if first is None else first
+            global_step += 1
+        # per-pass save (role of save_parameter_to_tar, train_local.py:95-96)
+        ckpt.save(global_step, {"params": params, "opt_state": opt_state})
+        print(f"pass {p}: step {global_step} loss {float(loss):.4f} "
+              f"-> checkpoint {ckpt_dir}")
+    ckpt.close()
+    print(f"loss {first:.4f} -> {float(loss):.4f}")
+    assert float(loss) < first
+
+
+if __name__ == "__main__":
+    main()

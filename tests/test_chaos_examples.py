@@ -1,0 +1,127 @@
+"""ChaosMonkey fixture + example-script smoke tests."""
+
+import runpy
+import time
+from pathlib import Path
+
+import jax
+import numpy as np
+import optax
+import pytest
+
+from edl_tpu.api.types import (
+    JobPhase, RESOURCE_CPU, RESOURCE_MEMORY,
+    ResourceRequirements, TrainerSpec, TrainingJob, TrainingJobSpec,
+)
+from edl_tpu.cluster.fake import FakeCluster
+from edl_tpu.controller.controller import Controller
+from edl_tpu.coord import local_service
+from edl_tpu.models import mlp
+from edl_tpu.runtime.chaos import ChaosMonkey
+from edl_tpu.runtime.data import ShardRegistry
+from edl_tpu.runtime.elastic import ElasticTrainer
+from edl_tpu.runtime.local import LocalElasticJob
+
+EXAMPLES = Path(__file__).resolve().parent.parent / "examples"
+
+
+def _wait_until(pred, timeout=10.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(0.01)
+    return pred()
+
+
+def test_chaos_monkey_repeated_kills_job_survives():
+    """Kill a trainer every 8 steps; training still drains both passes and
+    the FT job stays Running (SURVEY §5.3 build note)."""
+    rng = np.random.default_rng(2)
+    y = rng.integers(0, 4, size=2048).astype(np.int32)
+    x = rng.normal(size=(2048, 16)).astype(np.float32)
+    coord = local_service(passes=2)
+    reg = ShardRegistry()
+    reg.add_arrays(coord, (x, y), num_shards=8)
+
+    cluster = FakeCluster()
+    cluster.add_node("n0", cpu_milli=8_000, memory_mega=100_000)
+    ctl = Controller(cluster, autoscaler_loop_seconds=0.02,
+                     updater_convert_seconds=0.02,
+                     updater_confirm_seconds=0.01)
+    ctl.start()
+    job = TrainingJob(
+        name="chaos",
+        spec=TrainingJobSpec(
+            fault_tolerant=True,
+            trainer=TrainerSpec(
+                min_instance=2, max_instance=4,
+                resources=ResourceRequirements(
+                    requests={RESOURCE_CPU: "1", RESOURCE_MEMORY: "100M"},
+                    limits={RESOURCE_CPU: "1", RESOURCE_MEMORY: "100M"},
+                ),
+            ),
+        ),
+    )
+    ctl.submit(job)
+    assert _wait_until(lambda: ctl.phase(job) == JobPhase.RUNNING)
+
+    params = mlp.init(jax.random.key(2), [16, 32, 4])
+    trainer = ElasticTrainer(mlp.loss_fn, params, optax.adam(1e-2),
+                             initial_world_size=2)
+    runner = LocalElasticJob(job, cluster, trainer, coord, reg.fetch,
+                             batch_size=64)
+    monkey = ChaosMonkey(cluster, job, every_n_steps=8, max_kills=4)
+
+    def on_step(step, loss, world):
+        monkey(step, loss, world)
+        time.sleep(0.002)
+
+    report = runner.run(on_step=on_step)
+    ctl.stop()
+    assert len(monkey.kills) >= 3  # the monkey actually struck repeatedly
+    assert report.steps == 2 * (2048 // 64)  # nothing lost, both passes
+    assert ctl.phase(job) == JobPhase.RUNNING
+
+
+def test_chaos_monkey_respects_max_kills():
+    cluster = FakeCluster()
+    cluster.add_node("n0", cpu_milli=8_000, memory_mega=100_000)
+    job = TrainingJob(
+        name="j",
+        spec=TrainingJobSpec(fault_tolerant=True, trainer=TrainerSpec(
+            min_instance=2, max_instance=2,
+            resources=ResourceRequirements(
+                requests={RESOURCE_CPU: "1", RESOURCE_MEMORY: "10M"},
+                limits={RESOURCE_CPU: "1", RESOURCE_MEMORY: "10M"}))),
+    )
+    cluster.create_resources(job)
+    cluster.reconcile()
+    monkey = ChaosMonkey(cluster, job, every_n_steps=1, max_kills=2)
+    for step in range(1, 10):
+        monkey(step)
+    assert len(monkey.kills) == 2
+
+
+class TestExampleScripts:
+    """Smoke-run the cheap examples in-process (the jax-heavy ones are
+    exercised via their building blocks in the e2e/runtime tests)."""
+
+    def test_elastic_demo(self, capsys):
+        runpy.run_path(str(EXAMPLES / "elastic_demo.py"), run_name="__main__")
+        out = capsys.readouterr().out
+        assert "pending jobs: 0" in out
+        assert "chip utilization" in out
+
+    def test_fit_a_line(self, capsys):
+        runpy.run_path(str(EXAMPLES / "fit_a_line.py"), run_name="__main__")
+        assert "mse" in capsys.readouterr().out
+
+    def test_examplejob_manifest_valid(self):
+        from edl_tpu.api.serde import load_job_file
+        from edl_tpu.api.validation import set_defaults_and_validate
+
+        job = load_job_file(str(EXAMPLES / "examplejob.yaml"))
+        set_defaults_and_validate(job)
+        assert job.elastic() and job.spec.fault_tolerant
+        assert job.tpu_chips_per_trainer() == 4

@@ -183,14 +183,23 @@ def test_manifests_order_and_shape():
     job = set_defaults_and_validate(mk_job())
     manifests = parse_to_manifests(job)
     kinds = [(m["kind"], m["metadata"]["name"]) for m in manifests]
-    # FT job: coordinator first, then trainer (create order, reference
-    # trainingJobUpdater.go:282-293); no pserver unless requested
-    assert kinds == [("ReplicaSet", "j-coordinator"), ("Job", "j-trainer")]
+    # FT job: coordinator (+ its Service) first, then trainer (create
+    # order, reference trainingJobUpdater.go:282-293); no pserver unless
+    # requested
+    assert kinds == [("ReplicaSet", "j-coordinator"),
+                     ("Service", "j-coordinator"),
+                     ("Job", "j-trainer")]
     trainer = manifests[-1]
     assert trainer["spec"]["parallelism"] == 2
     pod = trainer["spec"]["template"]["spec"]
     assert pod["restartPolicy"] == "Never"
     assert pod["containers"][0]["resources"]["requests"]["cpu"] == "1"
+    # trainer command is the launcher's FT verb, and the env contract
+    # points it at the coordinator Service
+    assert pod["containers"][0]["command"][-2:] == \
+        ["edl_tpu.runtime.launcher", "start_trainer"]
+    env = {e["name"]: e["value"] for e in pod["containers"][0]["env"]}
+    assert env["EDL_COORD_ENDPOINT"].startswith("j-coordinator.default.svc:")
 
 
 def test_manifests_pserver_only_on_request():

@@ -1,0 +1,229 @@
+"""Launcher + discovery tests (reference docker/paddle_k8s, k8s_tools.py)."""
+
+import threading
+import time
+
+import pytest
+
+from edl_tpu.api.types import (
+    RESOURCE_CPU, RESOURCE_MEMORY, RESOURCE_TPU,
+    ResourceRequirements, TrainerSpec, TrainingJob, TrainingJobSpec,
+)
+from edl_tpu.cluster.base import PodPhase
+from edl_tpu.cluster.fake import FakeCluster
+from edl_tpu.coord.service import PyCoordService
+from edl_tpu.runtime.discovery import (
+    CoordDiscovery, DiscoveryTimeout, PodDiscovery,
+)
+from edl_tpu.runtime import launcher
+
+
+def _submit(c, name="j1", lo=3, hi=3):
+    job = TrainingJob(
+        name=name,
+        spec=TrainingJobSpec(
+            fault_tolerant=True,
+            trainer=TrainerSpec(
+                min_instance=lo, max_instance=hi,
+                resources=ResourceRequirements(
+                    requests={RESOURCE_CPU: "1", RESOURCE_MEMORY: "1G"},
+                    limits={RESOURCE_TPU: "1"},
+                ),
+            ),
+        ),
+    )
+    c.create_resources(job)
+    c.reconcile()
+    return job
+
+
+def _cluster():
+    c = FakeCluster()
+    c.add_node("n0", cpu_milli=64000, memory_mega=64000, tpu_chips=8)
+    return c
+
+
+class TestPodDiscovery:
+    def test_count_and_wait(self):
+        c = _cluster()
+        job = _submit(c)
+        d = PodDiscovery(c, job.full_name, poll_s=0.0)
+        assert d.count_pods_by_phase(PodPhase.RUNNING) == 3
+        assert d.wait_pods_running(3, timeout_s=1.0) == 3
+
+    def test_wait_timeout(self):
+        c = _cluster()
+        job = _submit(c)
+        d = PodDiscovery(c, job.full_name, poll_s=0.01)
+        with pytest.raises(DiscoveryTimeout):
+            d.wait_pods_running(10, timeout_s=0.05)
+
+    def test_rank_from_sorted_names(self):
+        c = _cluster()
+        job = _submit(c)
+        d = PodDiscovery(c, job.full_name, poll_s=0.0)
+        addrs = d.fetch_addresses()
+        assert addrs == sorted(addrs) and len(addrs) == 3
+        assert d.fetch_rank(addrs[1]) == 1
+        with pytest.raises(RuntimeError):
+            d.fetch_rank("nonexistent")
+
+    def test_terminating_counted(self):
+        c = _cluster()
+        job = _submit(c)
+        pod = c.list_pods(job_uid=job.full_name)[0]
+        pod.deletion_timestamp = True
+        d = PodDiscovery(c, job.full_name, poll_s=0.0)
+        assert d.count_pods_by_phase(PodPhase.TERMINATING) == 1
+        assert d.count_pods_by_phase(PodPhase.RUNNING) == 2
+
+
+class TestCoordDiscovery:
+    def test_rank_stable_under_rejoin(self):
+        svc = PyCoordService()
+        a = CoordDiscovery(svc, "worker-a", "10.0.0.9")
+        b = CoordDiscovery(svc, "worker-b", "10.0.0.1")
+        a.join(), b.join()
+        assert a.rank_and_world() == (0, 2)
+        assert b.rank_and_world() == (1, 2)
+        # replacement pod for a rejoins with the same name → same rank,
+        # unlike IP-sort (b's lower IP would have stolen rank 0)
+        a.leave()
+        a2 = CoordDiscovery(svc, "worker-a", "10.0.0.200")
+        a2.join()
+        assert a2.rank_and_world() == (0, 2)
+
+    def test_epoch_bumps_on_membership_change(self):
+        svc = PyCoordService()
+        a = CoordDiscovery(svc, "a")
+        e0 = a.join()
+        b = CoordDiscovery(svc, "b")
+        e1 = b.join()
+        assert e1 > e0
+        b.leave()
+        assert a.epoch() > e1
+
+    def test_wait_members(self):
+        svc = PyCoordService()
+        a = CoordDiscovery(svc, "a")
+        a.join()
+
+        def late_join():
+            time.sleep(0.05)
+            CoordDiscovery(svc, "b").join()
+
+        t = threading.Thread(target=late_join)
+        t.start()
+        peers = a.wait_members(2, timeout_s=2.0, poll_s=0.01)
+        t.join()
+        assert [n for n, _ in peers] == ["a", "b"]
+
+    def test_rank_requires_join(self):
+        svc = PyCoordService()
+        d = CoordDiscovery(svc, "ghost")
+        with pytest.raises(RuntimeError):
+            d.rank_and_world()
+
+
+class TestLauncher:
+    def test_classify_exit(self):
+        assert launcher.classify_exit(139) == "Segmentation fault (core dumped)"
+        assert launcher.classify_exit(136).startswith("Floating point")
+        assert launcher.classify_exit(134).startswith("Aborted")
+        assert launcher.classify_exit(0) is None
+        assert launcher.classify_exit(1) is None
+
+    def test_termination_log(self, tmp_path):
+        p = tmp_path / "term.log"
+        launcher.write_termination_log(139, str(p))
+        assert "Segmentation fault" in p.read_text()
+        launcher.write_termination_log(0, str(p / "never"))  # no-op
+
+    def test_check_failed_cnt(self):
+        c = _cluster()
+        job = _submit(c)
+        d = PodDiscovery(c, job.full_name, poll_s=0.0)
+        assert not launcher.check_failed_cnt(d, 0)
+        # FakeCluster's Job controller re-creates failed pods; count both
+        pod = c.list_pods(job_uid=job.full_name)[0]
+        pod.phase = PodPhase.FAILED  # fail without reconcile
+        assert launcher.check_failed_cnt(d, 0)
+        assert not launcher.check_failed_cnt(d, 3)
+
+    def test_run_entry_ok_and_crash(self, tmp_path):
+        assert launcher.run_entry("true") == 0
+        marker = tmp_path / "ws" ; marker.mkdir()
+        code = launcher.run_entry("pwd > out.txt", workspace=str(marker))
+        assert code == 0
+        assert str(marker) in (marker / "out.txt").read_text()
+        assert launcher.run_entry("exit 7") == 7
+
+    def test_start_trainer_end_to_end(self, tmp_path):
+        """FT trainer startup against a live coordination server."""
+        from edl_tpu.coord.server import spawn_server
+
+        handle = spawn_server(port=0)
+        try:
+            out = tmp_path / "env.txt"
+            code = launcher.start_trainer(
+                coord_host="127.0.0.1", coord_port=handle.port,
+                entry=f'echo "$EDL_COORD_HOST:$EDL_COORD_PORT '
+                      f'$EDL_WORKER_NAME" > {out}',
+                worker_name="trainer-0", wait_timeout_s=10.0,
+            )
+            assert code == 0
+            text = out.read_text()
+            assert f"127.0.0.1:{handle.port}" in text
+            assert "trainer-0" in text
+            # worker left membership on exit
+            client = handle.client()
+            _, members = client.members()
+            assert members == []
+            client.close()
+        finally:
+            handle.stop()
+
+    def test_main_dispatch_unknown(self, capsys):
+        assert launcher.main(["bogus"]) == 2
+        assert launcher.main([]) == 2
+
+    def test_main_trainer_without_coord_env_fails_loudly(self, monkeypatch,
+                                                         capsys):
+        monkeypatch.delenv("EDL_COORD_ENDPOINT", raising=False)
+        monkeypatch.delenv("EDL_COORD_HOST", raising=False)
+        assert launcher.main(["start_trainer"]) == 2
+        assert "no coordinator address" in capsys.readouterr().err
+
+    def test_resolve_coordinator_endpoint(self):
+        r = launcher.resolve_coordinator_endpoint
+        assert r({"EDL_COORD_ENDPOINT": "svc:9000"}, 7164) == ("svc", 9000)
+        assert r({"EDL_COORD_ENDPOINT": "svc"}, 7164) == ("svc", 7164)
+        assert r({"EDL_COORD_HOST": "h"}, 7164) == ("h", 7164)
+        # endpoint wins over host
+        assert r({"EDL_COORD_ENDPOINT": "a:1", "EDL_COORD_HOST": "b"},
+                 7164) == ("a", 1)
+        with pytest.raises(ValueError):
+            r({}, 7164)
+
+    def test_start_pserver_joins_and_leaves(self):
+        from edl_tpu.coord.server import spawn_server
+
+        handle = spawn_server(port=0)
+        try:
+            client = handle.client()
+            seen = []
+
+            def park():
+                _, members = client.members()
+                seen.append(members)
+
+            code = launcher.start_pserver(
+                coord_host="127.0.0.1", coord_port=handle.port,
+                worker_name="ps0", wait_timeout_s=10.0, park=park)
+            assert code == 0
+            assert seen and seen[0][0][0] == "pserver/ps0"
+            _, members = client.members()
+            assert members == []  # left on exit
+            client.close()
+        finally:
+            handle.stop()

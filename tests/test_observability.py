@@ -1,0 +1,127 @@
+"""Collector + tracing tests (reference example/collector.py behavior)."""
+
+import io
+
+from edl_tpu.api.types import (
+    RESOURCE_CPU, RESOURCE_MEMORY, RESOURCE_TPU,
+    ResourceRequirements, TrainerSpec, TrainingJob, TrainingJobSpec,
+)
+from edl_tpu.cluster.fake import FakeCluster
+from edl_tpu.observability.collector import Collector
+from edl_tpu.observability.tracing import Tracer
+
+
+def _job(name, chips=1, lo=2, hi=4):
+    return TrainingJob(
+        name=name,
+        spec=TrainingJobSpec(
+            fault_tolerant=True,
+            trainer=TrainerSpec(
+                min_instance=lo, max_instance=hi,
+                resources=ResourceRequirements(
+                    requests={RESOURCE_CPU: "1", RESOURCE_MEMORY: "1G"},
+                    limits={RESOURCE_CPU: "1", RESOURCE_MEMORY: "1G",
+                            RESOURCE_TPU: str(chips)},
+                ),
+            ),
+        ),
+    )
+
+
+def _cluster(chips_per_node=4, nodes=4):
+    c = FakeCluster()
+    for i in range(nodes):
+        c.add_node(f"n{i}", cpu_milli=16000, memory_mega=64000,
+                   tpu_chips=chips_per_node, ici_domain="pod0")
+    return c
+
+
+class TestCollector:
+    def test_empty_cluster(self):
+        out = io.StringIO()
+        s = Collector(_cluster(), out=out).run_once()
+        assert s.submitted_jobs == 0
+        assert s.pending_jobs == 0
+        assert s.chip_utils_pct == 0.0
+        header, line = out.getvalue().strip().split("\n")
+        assert header.startswith("TIMESTAMP\tSUBMITTED-JOBS")
+
+    def test_running_job_counted(self):
+        c = _cluster()
+        job = _job("j1", chips=1)
+        c.create_resources(job)
+        c.reconcile()
+        s = Collector(c, out=io.StringIO()).run_once()
+        assert s.submitted_jobs == 1
+        assert s.pending_jobs == 0
+        assert s.running_trainers["default/j1"] == 2
+        # 2 trainers x 1 chip / 16 chips
+        assert abs(s.chip_utils_pct - 100.0 * 2 / 16) < 1e-9
+
+    def test_pending_rule(self):
+        # Job too big for the cluster -> all trainers pending -> job pending
+        c = _cluster(chips_per_node=0)
+        job = _job("big", chips=8, lo=2, hi=2)
+        c.create_resources(job)
+        c.reconcile()
+        s = Collector(c, out=io.StringIO()).run_once()
+        assert s.pending_jobs == 1
+        assert s.running_trainers["default/big"] == 0
+
+    def test_tsv_format(self):
+        out = io.StringIO()
+        c = _cluster()
+        c.create_resources(_job("j1"))
+        c.reconcile()
+        Collector(c, out=out).run_once()
+        line = out.getvalue().strip().split("\n")[1]
+        cols = line.split("\t")
+        assert len(cols) == 6
+        assert cols[1] == "1"  # SUBMITTED-JOBS
+        assert "default/j1:2" in cols[3]
+
+    def test_run_bounded(self):
+        out = io.StringIO()
+        Collector(_cluster(), interval_s=0.0, out=out).run(max_samples=3)
+        assert len(out.getvalue().strip().split("\n")) == 4  # header + 3
+
+
+class TestTracer:
+    def test_span_and_instant(self):
+        t = Tracer()
+        t.instant("epoch_change", category="membership", epoch=3)
+        with t.span("train_step", step=1):
+            pass
+        evs = t.events()
+        assert [e.name for e in evs] == ["epoch_change", "train_step"]
+        assert evs[0].duration_s == 0.0
+        assert evs[1].duration_s >= 0.0
+        assert t.events(category="membership")[0].args == {"epoch": 3}
+
+    def test_bounded(self):
+        t = Tracer(capacity=10)
+        for i in range(100):
+            t.instant(f"e{i}")
+        assert len(t.events()) == 10
+        assert t.events()[0].name == "e90"
+
+    def test_chrome_trace(self, tmp_path):
+        import json
+
+        t = Tracer()
+        with t.span("s"):
+            pass
+        p = tmp_path / "trace.json"
+        t.dump(str(p))
+        doc = json.loads(p.read_text())
+        assert doc["traceEvents"][0]["name"] == "s"
+        assert doc["traceEvents"][0]["ph"] == "X"
+
+    def test_profile_step_cpu(self):
+        # jax TraceAnnotation is a no-op outside a profile; must not raise.
+        from edl_tpu.observability.tracing import get_tracer, profile_step
+
+        get_tracer().clear()
+        with profile_step("unit_step"):
+            pass
+        assert any(e.name == "unit_step" for e in get_tracer().events())

@@ -1,0 +1,117 @@
+"""Manifest serde + CLI tests (reference example/examplejob.yaml shape,
+cmd/edl/edl.go flag surface)."""
+
+import io
+import sys
+
+import pytest
+
+from edl_tpu.api.serde import (
+    job_from_dict, job_from_yaml, job_to_dict, job_to_yaml, load_job_file,
+)
+from edl_tpu.api.types import RESOURCE_TPU
+from edl_tpu import cli
+
+EXAMPLE_YAML = """
+apiVersion: edl.tpu/v1
+kind: TrainingJob
+metadata:
+  name: example
+  namespace: default
+spec:
+  fault_tolerant: true
+  passes: 2
+  trainer:
+    entrypoint: "python train.py"
+    workspace: "/workspace"
+    min-instance: 2
+    max-instance: 10
+    resources:
+      requests:
+        cpu: "4"
+        memory: "8G"
+      limits:
+        cpu: "4"
+        memory: "8G"
+        google.com/tpu: "4"
+    topology: 2x2
+  pserver:
+    min-instance: 0
+    max-instance: 0
+  master:
+    etcd_endpoint: ""
+"""
+
+
+class TestSerde:
+    def test_round_trip(self):
+        job = job_from_yaml(EXAMPLE_YAML)
+        assert job.name == "example"
+        assert job.spec.fault_tolerant
+        assert job.spec.trainer.min_instance == 2
+        assert job.spec.trainer.max_instance == 10
+        assert job.elastic()
+        assert job.tpu_chips_per_trainer() == 4  # topology 2x2
+        assert str(job.spec.trainer.topology) == "2x2"
+        assert job.spec.trainer.resources.limits[RESOURCE_TPU].value() == 4
+
+        job2 = job_from_dict(job_to_dict(job))
+        assert job2.spec.trainer.min_instance == 2
+        assert str(job2.spec.trainer.topology) == "2x2"
+        assert job_to_yaml(job2)  # serializes cleanly
+
+    def test_kebab_and_snake_equivalent(self):
+        a = job_from_dict({"metadata": {"name": "j"},
+                           "spec": {"trainer": {"min-instance": 3,
+                                                "max-instance": 5}}})
+        b = job_from_dict({"metadata": {"name": "j"},
+                           "spec": {"trainer": {"min_instance": 3,
+                                                "max_instance": 5}}})
+        assert (a.spec.trainer.min_instance, a.spec.trainer.max_instance) == \
+               (b.spec.trainer.min_instance, b.spec.trainer.max_instance)
+
+    def test_wrong_kind_rejected(self):
+        with pytest.raises(ValueError):
+            job_from_dict({"kind": "Deployment"})
+
+    def test_load_file(self, tmp_path):
+        p = tmp_path / "job.yaml"
+        p.write_text(EXAMPLE_YAML)
+        assert load_job_file(str(p)).name == "example"
+
+
+class TestCli:
+    def test_validate_ok(self, tmp_path, capsys):
+        p = tmp_path / "job.yaml"
+        p.write_text(EXAMPLE_YAML)
+        assert cli.main(["validate", str(p)]) == 0
+        out = capsys.readouterr().out
+        assert "example" in out and "fault_tolerant: true" in out
+
+    def test_validate_rejects_elastic_without_ft(self, tmp_path, capsys):
+        # elastic requires fault_tolerant (reference pkg/jobparser.go:66-68)
+        bad = EXAMPLE_YAML.replace("fault_tolerant: true",
+                                   "fault_tolerant: false")
+        p = tmp_path / "bad.yaml"
+        p.write_text(bad)
+        assert cli.main(["validate", str(p)]) == 1
+        assert "INVALID" in capsys.readouterr().err
+
+    def test_submit_and_delete_fake(self, tmp_path):
+        p = tmp_path / "job.yaml"
+        p.write_text(EXAMPLE_YAML)
+        assert cli.main(["submit", "--fake", str(p)]) == 0
+        assert cli.main(["delete", "--fake", "example"]) == 0
+
+    def test_collector_fake(self, capsys):
+        assert cli.main(["collector", "--fake", "--interval", "0",
+                         "--samples", "2"]) == 0
+        out = capsys.readouterr().out
+        assert out.count("\n") == 3  # header + 2 samples
+
+    def test_parser_flags_match_reference(self):
+        p = cli.build_parser()
+        args = p.parse_args(["controller", "--fake",
+                             "--max-load-desired", "0.9"])
+        assert args.max_load_desired == 0.9
+        assert args.loop_seconds == 5.0  # reference pkg/autoscaler.go:31
