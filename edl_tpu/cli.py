@@ -161,7 +161,8 @@ def build_parser() -> argparse.ArgumentParser:
 
     c = sub.add_parser("launch", help="pod-role entrypoint")
     c.add_argument("verb",
-                   choices=["start_coordinator", "start_trainer"])
+                   choices=["start_coordinator", "start_trainer",
+                            "start_pserver"])
     c.add_argument("rest", nargs="*")
     c.set_defaults(fn=cmd_launch)
 

@@ -27,6 +27,7 @@ class PodPhase(str, enum.Enum):
     SUCCEEDED = "Succeeded"
     FAILED = "Failed"
     TERMINATING = "Terminating"  # deletion_timestamp set (k8s_tools.py:29-36)
+    UNKNOWN = "Unknown"  # kubelet unreachable; standard k8s phase
 
 
 @dataclass(frozen=True)

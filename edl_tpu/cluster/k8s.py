@@ -189,12 +189,33 @@ class K8sCluster(Cluster):
         role_labels = {"trainer": TRAINER_LABEL,
                        "master": "edl-tpu-job-coordinator",
                        "pserver": "edl-tpu-job-pserver"}
-        for pod in self._core.list_namespaced_pod(self.namespace).items:
+        if job_uid is not None or role is not None:
+            # Job-scoped callers (PodDiscovery polls every 5 s): a
+            # namespaced LIST with a label selector, not a full-cluster
+            # scan.  job_uid is "namespace/name".
+            ns, _, jname = (job_uid or "").partition("/")
+            ns = ns if jname else self.namespace
+            if role in role_labels:
+                sel = (f"{role_labels[role]}={jname}" if jname
+                       else role_labels[role])
+            else:
+                sel = None  # any role of the job; filtered client-side
+            items = self._core.list_namespaced_pod(
+                ns, label_selector=sel).items
+        else:
+            # Full scan (the Collector): all namespaces, so the
+            # utilization numerator covers the same pod set as the
+            # inquiry_resource denominator (system pods included — the
+            # reference counts every Running pod's requests,
+            # example/collector.py:156-179).
+            items = self._core.list_pod_for_all_namespaces().items
+        for pod in items:
             labels = pod.metadata.labels or {}
             pod_role, pod_job = "system", ""
             for r, label in role_labels.items():
                 if label in labels:
-                    pod_role, pod_job = r, f"{self.namespace}/{labels[label]}"
+                    pod_role = r
+                    pod_job = f"{pod.metadata.namespace}/{labels[label]}"
                     break
             if job_uid is not None and pod_job != job_uid:
                 continue

@@ -129,6 +129,10 @@ class NativeCoordService:
         self._clock = clock
         self._buf_cap = self._INITIAL_BUF
         self._h = lib.edl_service_new(task_timeout_ms, passes, member_ttl_ms)
+        self._member_ttl_ms = member_ttl_ms
+
+    def member_ttl_ms(self) -> int:
+        return self._member_ttl_ms
 
     def close(self) -> None:
         if self._h:

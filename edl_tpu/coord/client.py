@@ -11,7 +11,9 @@ import socket
 import threading
 from typing import Optional
 
-from edl_tpu.coord.service import LeaseStatus, QueueStats
+from edl_tpu.coord.service import (
+    DEFAULT_MEMBER_TTL_MS, DEFAULT_TASK_TIMEOUT_MS, LeaseStatus, QueueStats,
+)
 
 
 class CoordError(RuntimeError):
@@ -153,3 +155,18 @@ class CoordClient:
             return self._call("PING")[0] == "PONG"
         except (CoordError, OSError):
             return False
+
+    def config(self) -> dict:
+        """Server config: task_timeout_ms, passes, member_ttl_ms.
+
+        Older servers without CONFIG get the defaults — callers use this
+        to derive heartbeat cadence, where a default is safe."""
+        r = self._call("CONFIG")
+        if r[0] != "OK" or len(r) < 4:
+            return {"task_timeout_ms": DEFAULT_TASK_TIMEOUT_MS,
+                    "passes": 1, "member_ttl_ms": DEFAULT_MEMBER_TTL_MS}
+        return {"task_timeout_ms": int(r[1]), "passes": int(r[2]),
+                "member_ttl_ms": int(r[3])}
+
+    def member_ttl_ms(self) -> int:
+        return self.config()["member_ttl_ms"]

@@ -21,6 +21,7 @@
 //   KVCAS <k> <hex-expect|-> <hex> -> OK | FAIL
 //   KEYS <prefix?>                 -> OK <k1,k2,...>
 //   PING                           -> PONG
+//   CONFIG                         -> OK <task_timeout_ms> <passes> <member_ttl_ms>
 //
 // Thread-per-connection; the core is mutex-guarded so this scales to the
 // O(100) workers a single job needs.
@@ -46,6 +47,9 @@
 namespace {
 
 edlcoord::Service* g_service = nullptr;
+int64_t g_task_timeout_ms = edlcoord::kDefaultTaskTimeoutMs;
+int g_passes = 1;
+int64_t g_member_ttl_ms = edlcoord::kDefaultMemberTtlMs;
 
 int64_t NowMs() {
   return std::chrono::duration_cast<std::chrono::milliseconds>(
@@ -109,6 +113,12 @@ std::string HandleImpl(const std::string& line) {
   edlcoord::Service& s = *g_service;
 
   if (cmd == "PING") return "PONG";
+
+  // Lets workers derive their heartbeat cadence from the server's actual
+  // TTL instead of assuming the default.
+  if (cmd == "CONFIG")
+    return "OK " + std::to_string(g_task_timeout_ms) + " " +
+           std::to_string(g_passes) + " " + std::to_string(g_member_ttl_ms);
 
   if (cmd == "LEASE" && args.size() == 2) {
     edlcoord::Lease lease;
@@ -240,6 +250,9 @@ int main(int argc, char** argv) {
     if (flag == "--member-ttl-ms") member_ttl_ms = std::atoll(argv[i + 1]);
   }
   signal(SIGPIPE, SIG_IGN);
+  g_task_timeout_ms = task_timeout_ms;
+  g_passes = passes;
+  g_member_ttl_ms = member_ttl_ms;
   g_service = new edlcoord::Service(task_timeout_ms, passes, member_ttl_ms);
 
   int srv = socket(AF_INET, SOCK_STREAM, 0);

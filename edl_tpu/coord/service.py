@@ -84,6 +84,9 @@ class PyCoordService:
         # kv
         self._kv: dict[str, bytes] = {}
 
+    def member_ttl_ms(self) -> int:
+        return self._ttl_ms
+
     # -- task queue --------------------------------------------------------
 
     def add_task(self, payload: bytes) -> int:

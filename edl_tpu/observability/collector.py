@@ -149,11 +149,10 @@ class Collector:
         bounds the loop for tests/CLI dry runs."""
         n = 0
         while max_samples is None or n < max_samples:
+            if n:
+                time.sleep(self._interval_s)
             self.run_once()
             n += 1
-            if max_samples is not None and n >= max_samples:
-                break
-            time.sleep(self._interval_s)
 
     def _write(self, sample: Sample) -> None:
         out = self._out if self._out is not None else sys.stdout

@@ -23,6 +23,8 @@ Two discovery backends:
 
 from __future__ import annotations
 
+import contextlib
+import threading
 import time
 from typing import Optional
 
@@ -56,6 +58,48 @@ class CoordDiscovery:
 
     def heartbeat(self) -> bool:
         return self._client.heartbeat(self.name)
+
+    @contextlib.contextmanager
+    def keepalive(self, interval_s: float | None = None):
+        """Background heartbeat for the duration of a ``with`` block.
+
+        The membership TTL assumes someone is heartbeating; a launcher
+        that joins and then blocks in the user entrypoint for hours would
+        otherwise expire and spuriously bump the epoch, which every peer
+        reads as a scale-down.  The cadence defaults to TTL/3 read from
+        the server (CONFIG op), so a short-TTL deployment beats faster
+        automatically."""
+        from edl_tpu.coord.client import CoordError
+
+        if interval_s is None:
+            try:
+                interval_s = max(self._client.member_ttl_ms() / 3000.0, 0.01)
+            except (AttributeError, OSError, CoordError):
+                interval_s = 5.0  # DEFAULT_MEMBER_TTL_MS / 3
+        stop = threading.Event()
+
+        def beat():
+            while not stop.wait(interval_s):
+                try:
+                    if not self._client.heartbeat(self.name) \
+                            and not stop.is_set():
+                        # Expired (ERR rejoin): the server pruned us after
+                        # a blip longer than the TTL — rejoin rather than
+                        # staying out of membership forever.  The stop
+                        # check keeps a late beat from re-registering a
+                        # worker that is deliberately leaving.
+                        self._client.join(self.name, self.address)
+                except (OSError, CoordError):
+                    pass  # coordinator briefly unreachable; retry next tick
+
+        t = threading.Thread(target=beat, daemon=True,
+                             name=f"keepalive-{self.name}")
+        t.start()
+        try:
+            yield self
+        finally:
+            stop.set()
+            t.join(timeout=interval_s + 1.0)
 
     def epoch(self) -> int:
         return self._client.epoch()

@@ -157,11 +157,15 @@ def start_trainer(
     member = CoordDiscovery(client, name, worker_address)
     member.join()
     try:
-        return run_entry(entry, workspace, {
-            "EDL_COORD_HOST": coord_host,
-            "EDL_COORD_PORT": str(coord_port),
-            "EDL_WORKER_NAME": name,
-        })
+        # Heartbeat in the background while the user entrypoint runs —
+        # without it the member expires after the 15 s TTL and the epoch
+        # bump looks like a scale-down to every peer.
+        with member.keepalive():
+            return run_entry(entry, workspace, {
+                "EDL_COORD_HOST": coord_host,
+                "EDL_COORD_PORT": str(coord_port),
+                "EDL_WORKER_NAME": name,
+            })
     finally:
         try:
             member.leave()
@@ -191,12 +195,12 @@ def start_pserver(
     log.info("pserver joined membership (parameters live on the trainer "
              "mesh; this role is migration-mode only)", name=name)
     try:
-        if park is not None:
-            park()
-        else:  # pragma: no cover - infinite loop
-            while True:
-                time.sleep(5.0)
-                member.heartbeat()
+        with member.keepalive():
+            if park is not None:
+                park()
+            else:  # pragma: no cover - infinite loop
+                while True:
+                    time.sleep(60.0)
         return 0
     finally:
         try:

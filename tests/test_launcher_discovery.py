@@ -124,6 +124,37 @@ class TestCoordDiscovery:
         with pytest.raises(RuntimeError):
             d.rank_and_world()
 
+    def test_keepalive_outlives_member_ttl(self):
+        """A member inside keepalive() must not expire even when the
+        block outlasts the TTL (the launcher runs user entrypoints for
+        hours; without background heartbeats the epoch would bump and
+        peers would see a phantom scale-down).
+
+        The service runs on a fake clock advanced in sub-TTL steps, with
+        a generous real-time window for the beat thread to refresh the
+        deadline, so a loaded CI machine can't flake this."""
+        now = [0]
+        svc = PyCoordService(member_ttl_ms=100, clock=lambda: now[0])
+        a = CoordDiscovery(svc, "a")
+        epoch_after_join = a.join()
+        with a.keepalive(interval_s=0.002):
+            for _ in range(10):  # 6 TTLs of fake time in total
+                now[0] += 60
+                time.sleep(0.05)  # ≥ ~20 beats refresh at the new time
+                assert [n for n, _ in a.peers()] == ["a"]
+            assert a.epoch() == epoch_after_join
+        a.leave()
+
+    def test_no_keepalive_expires_after_ttl(self):
+        """Control for the test above: without keepalive the TTL fires
+        (deterministic: fake clock, no heartbeats anywhere)."""
+        now = [0]
+        svc = PyCoordService(member_ttl_ms=100, clock=lambda: now[0])
+        a = CoordDiscovery(svc, "a")
+        a.join()
+        now[0] += 150
+        assert a.peers() == []
+
 
 class TestLauncher:
     def test_classify_exit(self):
