@@ -1,0 +1,20 @@
+"""Shared bootstrap for the example scripts (run as ``python examples/x.py``).
+
+Puts the repo root on sys.path (the scripts live one level below it), and —
+for images whose sitecustomize pins jax onto an accelerator platform — honors
+an explicit ``JAX_PLATFORMS=cpu`` request by re-pinning via the config API,
+which wins as long as the backend hasn't initialized yet.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+if "cpu" in [p.strip() for p in os.environ.get("JAX_PLATFORMS", "").split(",")]:
+    try:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    except ImportError:
+        pass

@@ -6,6 +6,8 @@
 
 from __future__ import annotations
 
+import _bootstrap  # noqa: F401  (repo-root sys.path + platform pin)
+
 import argparse
 
 from edl_tpu.api.types import TrainingJob

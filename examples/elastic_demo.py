@@ -14,6 +14,8 @@ collector trace (SUBMITTED/PENDING/RUNNING-TRAINERS/UTILS).
 
 from __future__ import annotations
 
+import _bootstrap  # noqa: F401  (repo-root sys.path + platform pin)
+
 from edl_tpu.api.types import (
     RESOURCE_CPU, RESOURCE_MEMORY, RESOURCE_TPU,
     ResourceRequirements, TrainerSpec, TrainingJob, TrainingJobSpec,

@@ -11,6 +11,8 @@ static path, is the same EDL_TRAINER_ID shard rule as mnist.py.
 
 from __future__ import annotations
 
+import _bootstrap  # noqa: F401  (repo-root sys.path + platform pin)
+
 import os
 
 import jax

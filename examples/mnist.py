@@ -19,6 +19,8 @@ static path).
 
 from __future__ import annotations
 
+import _bootstrap  # noqa: F401  (repo-root sys.path + platform pin)
+
 import os
 import pickle
 import sys

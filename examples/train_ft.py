@@ -24,6 +24,8 @@ EDL_COORD_HOST/EDL_COORD_PORT/EDL_WORKER_NAME:
 
 from __future__ import annotations
 
+import _bootstrap  # noqa: F401  (repo-root sys.path + platform pin)
+
 import os
 
 import jax

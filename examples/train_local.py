@@ -11,6 +11,8 @@ elastic path.
 
 from __future__ import annotations
 
+import _bootstrap  # noqa: F401  (repo-root sys.path + platform pin)
+
 import sys
 import tempfile
 

@@ -1,0 +1,27 @@
+#!/usr/bin/env bash
+# CI entry point (role of the reference's .travis.yml + pre-commit hooks:
+# style checks then the full test run).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== compile check"
+python -m compileall -q edl_tpu tests examples bench.py __graft_entry__.py
+
+echo "== native core"
+make -C edl_tpu/coord/native -s
+
+echo "== tests (virtual 8-device CPU mesh)"
+python -m pytest tests/ -q
+
+echo "== elastic demo"
+python examples/elastic_demo.py > /dev/null
+
+echo "== bench smoke (scheduler only, no accelerator dependence)"
+python - <<'EOF'
+import bench
+r = bench.scheduler_utilization_bench()
+assert r["pending_jobs"] == 0, r
+assert r["chip_utilization_pct"] >= 88.4, r  # reference peak
+EOF
+
+echo "CI OK"

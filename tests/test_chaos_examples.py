@@ -1,6 +1,7 @@
 """ChaosMonkey fixture + example-script smoke tests."""
 
 import runpy
+import sys
 import time
 from pathlib import Path
 
@@ -23,6 +24,11 @@ from edl_tpu.runtime.elastic import ElasticTrainer
 from edl_tpu.runtime.local import LocalElasticJob
 
 EXAMPLES = Path(__file__).resolve().parent.parent / "examples"
+
+# ``python examples/x.py`` puts examples/ on sys.path (for _bootstrap);
+# runpy.run_path does not — mirror the script environment here.
+if str(EXAMPLES) not in sys.path:
+    sys.path.insert(0, str(EXAMPLES))
 
 
 def _wait_until(pred, timeout=10.0):
