@@ -1,0 +1,440 @@
+"""Elastic multi-host runtime: membership epochs → jax.distributed worlds.
+
+This is the piece SURVEY §7 lists as hard part 4: jax's distributed runtime
+is **static** — world size is fixed at ``jax.distributed.initialize``.  The
+reference sidestepped the equivalent problem because its trainers never
+formed a world at all (parameters lived in pservers, reference
+example/train_ft.py:105-114).  Here trainers DO form a world (the device
+mesh is the parameter store), so elasticity becomes *epochs of static
+worlds*:
+
+    1. every worker joins coordination-service membership and heartbeats;
+    2. a world forms from a **stable membership snapshot**: rank = index in
+       the name-sorted member list, world size = member count;
+    3. rank 0 claims the jax coordinator endpoint for this epoch via a KV
+       compare-and-swap (the etcd-slot-claim idiom, SURVEY §2.4) and
+       everyone calls ``jax.distributed.initialize(endpoint, n, rank)``;
+    4. training runs pjit/shard_map steps over the global mesh, leasing
+       data shards from the task queue — each step polls the membership
+       epoch (one cheap RPC);
+    5. on an epoch change (join/leave/death): survivors pull state to host,
+       one CAS-elected writer persists it, everyone tears the backend down
+       (``jax.distributed.shutdown`` + ``clear_backends``) and loops to 2.
+       The queue re-dispatches dead workers' leased shards after the task
+       timeout (the reference's 16 s bound, docker/paddle_k8s:30), so no
+       data is lost or double-counted across the resize.
+
+State flows through generation-tagged checkpoints (``ckpt/<epoch>`` KV
+pointers): a fresh joiner — or a world with no survivors — restores the
+highest generation ≤ its epoch; the cold start is covered by deterministic
+seeded init, which every process computes identically.
+
+On real TPU pods the same code path applies per *host* (each process owns
+its local chips; the global mesh spans all of them over ICI/DCN); tests
+exercise it with N single-device CPU processes and gloo collectives —
+multi-process behavior the reference could never test in CI (SURVEY §4).
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import time
+from dataclasses import dataclass
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+from edl_tpu.observability.logging import get_logger
+from edl_tpu.runtime.discovery import CoordDiscovery
+
+log = get_logger("runtime.multihost")
+
+#: KV namespaces (one coordination service per job).
+_JAX_COORD_KEY = "jax-coordinator/{epoch}"
+_CKPT_KEY = "ckpt/{epoch}"
+_CKPT_WRITER_KEY = "ckpt-writer/{epoch}"
+_LEAVE_KEY = "leave-intent/{epoch}"
+
+
+@dataclass(frozen=True)
+class WorldHandle:
+    """One static jax.distributed world (one membership epoch)."""
+
+    epoch: int
+    rank: int
+    world_size: int
+    coordinator: str
+    members: tuple[str, ...]
+
+    @property
+    def is_leader(self) -> bool:
+        return self.rank == 0
+
+
+def free_port(host: str = "127.0.0.1") -> int:
+    with socket.socket() as s:
+        s.bind((host, 0))
+        return s.getsockname()[1]
+
+
+def _teardown_backend() -> None:
+    """Tear down jax.distributed + the XLA backend so initialize() can run
+    again at a different world size (verified against jax 0.8: shutdown +
+    clear_backends + clear_caches permits re-initialization)."""
+    import jax
+
+    try:
+        jax.distributed.shutdown()
+    except (RuntimeError, ValueError):
+        pass  # not initialized — first world in this process
+    try:
+        import jax.extend.backend
+
+        jax.extend.backend.clear_backends()
+    except (RuntimeError, ValueError):  # pragma: no cover - best effort
+        pass
+    jax.clear_caches()
+
+
+class ElasticWorld:
+    """Forms successive jax.distributed worlds from membership epochs."""
+
+    def __init__(
+        self,
+        coord,
+        name: str,
+        address: str = "127.0.0.1",
+        settle_s: float = 0.5,
+        poll_s: float = 0.05,
+        init_timeout_s: float = 60.0,
+        heartbeat_timeout_s: int = 10,
+    ) -> None:
+        self._coord = coord
+        self.member = CoordDiscovery(coord, name, address)
+        self.name = name
+        self.address = address
+        self._settle_s = settle_s
+        self._poll_s = poll_s
+        self._init_timeout_s = init_timeout_s
+        #: how fast jax's runtime declares a silent peer dead (a crashed
+        #: peer leaves survivors blocked in a collective until then)
+        self._heartbeat_timeout_s = heartbeat_timeout_s
+        self._initialized_once = False
+
+    # -- membership --------------------------------------------------------
+
+    def join(self) -> int:
+        return self.member.join()
+
+    def leave(self) -> None:
+        self.member.leave()
+
+    def epoch(self) -> int:
+        return self.member.epoch()
+
+    # -- graceful scale-down -----------------------------------------------
+    #
+    # A collective needs every process: if a leaver simply stopped stepping,
+    # the survivors' next psum would block forever.  Because every step IS a
+    # collective, all workers sit at the same global step — so a leaver
+    # announces intent via KV, everyone (leaver included) stops at the same
+    # step boundary, and only then does the leaver drop its membership.
+
+    def announce_leave(self, epoch: int) -> None:
+        self._coord.kv_set(_LEAVE_KEY.format(epoch=epoch), self.name.encode())
+
+    def leave_announced(self, epoch: int) -> bool:
+        return self._coord.kv_get(_LEAVE_KEY.format(epoch=epoch)) is not None
+
+    def wait_epoch_past(self, epoch: int, timeout_s: float = 60.0) -> None:
+        """Block until membership moves past ``epoch`` (a leaver deregisters
+        or the TTL prunes a dead one)."""
+        deadline = time.monotonic() + timeout_s
+        while self._coord.epoch() == epoch:
+            if time.monotonic() >= deadline:
+                raise TimeoutError(f"membership stuck at epoch {epoch}")
+            time.sleep(self._poll_s)
+
+    def wait_stable(self, min_members: int = 1, timeout_s: float = 120.0
+                    ) -> tuple[int, list[str]]:
+        """Snapshot membership once it has ≥ min_members and hasn't changed
+        for settle_s (a joining wave lands as ONE world, not several)."""
+        deadline = time.monotonic() + timeout_s
+        last_epoch, stable_since = -1, time.monotonic()
+        while True:
+            epoch, members = self._coord.members()
+            names = sorted(n for n, _ in members)
+            now = time.monotonic()
+            if epoch != last_epoch:
+                last_epoch, stable_since = epoch, now
+            elif (len(names) >= min_members
+                  and now - stable_since >= self._settle_s
+                  and self.name in names):
+                return epoch, names
+            if now >= deadline:
+                raise TimeoutError(
+                    f"membership never stabilized at ≥{min_members} "
+                    f"members within {timeout_s}s (have {names})")
+            time.sleep(self._poll_s)
+
+    # -- world formation ---------------------------------------------------
+
+    def form(self, min_members: int = 1, timeout_s: float = 120.0
+             ) -> WorldHandle:
+        """Block until a stable world forms, initialize jax.distributed in
+        it, and return the handle.  Retries with a fresh snapshot if the
+        membership shifts mid-handshake."""
+        deadline = time.monotonic() + timeout_s
+        while True:
+            epoch, names = self.wait_stable(
+                min_members, max(deadline - time.monotonic(), 0.01))
+            rank = names.index(self.name)
+            endpoint = self._claim_coordinator(epoch, rank,
+                                               deadline - time.monotonic())
+            if endpoint is None:  # epoch moved under us; re-snapshot
+                continue
+            if self._initialized_once:
+                _teardown_backend()
+            import jax
+
+            try:
+                jax.distributed.initialize(
+                    coordinator_address=endpoint,
+                    num_processes=len(names),
+                    process_id=rank,
+                    initialization_timeout=max(
+                        int(min(self._init_timeout_s,
+                                deadline - time.monotonic())), 1),
+                    heartbeat_timeout_seconds=self._heartbeat_timeout_s,
+                )
+            except Exception as exc:  # peer died mid-handshake → retry
+                log.warn("world init failed; reforming", epoch=epoch,
+                         err=str(exc)[:200])
+                _teardown_backend()
+                if time.monotonic() >= deadline:
+                    raise
+                continue
+            self._initialized_once = True
+            handle = WorldHandle(epoch=epoch, rank=rank,
+                                 world_size=len(names),
+                                 coordinator=endpoint,
+                                 members=tuple(names))
+            log.info("world formed", epoch=epoch, rank=rank,
+                     world=len(names), coordinator=endpoint)
+            return handle
+
+    def _claim_coordinator(self, epoch: int, rank: int, budget_s: float
+                           ) -> Optional[str]:
+        """Rank 0 publishes host:port for this epoch; others poll for it.
+        Returns None if the epoch advances while waiting (stale world)."""
+        key = _JAX_COORD_KEY.format(epoch=epoch)
+        if rank == 0:
+            endpoint = f"{self.address}:{free_port(self.address)}"
+            # CAS so a re-formed world at the same epoch reuses one claim
+            if not self._coord.kv_cas(key, b"", endpoint.encode()):
+                raw = self._coord.kv_get(key)
+                endpoint = raw.decode() if raw else endpoint
+            return endpoint
+        deadline = time.monotonic() + max(budget_s, 0.01)
+        while time.monotonic() < deadline:
+            raw = self._coord.kv_get(key)
+            if raw:
+                return raw.decode()
+            if self._coord.epoch() != epoch:
+                return None
+            time.sleep(self._poll_s)
+        return None
+
+    # -- state generations -------------------------------------------------
+
+    def publish_state(self, epoch: int, save: Callable[[], str]) -> bool:
+        """CAS-elect one writer for generation ``epoch``; the winner calls
+        ``save()`` (→ checkpoint path) and publishes the pointer.  Returns
+        True if this worker was the writer."""
+        wkey = _CKPT_WRITER_KEY.format(epoch=epoch)
+        if self._coord.kv_cas(wkey, b"", self.name.encode()):
+            path = save()
+            self._coord.kv_set(_CKPT_KEY.format(epoch=epoch), path.encode())
+            return True
+        return False
+
+    def broadcast_state(self, epoch: int, save: Callable[[], str]) -> None:
+        """Publish generation ``epoch`` unconditionally (the world leader's
+        authoritative rebroadcast — the leader is unique per world)."""
+        path = save()
+        self._coord.kv_set(_CKPT_KEY.format(epoch=epoch), path.encode())
+
+    def latest_state(self, upto_epoch: int) -> Optional[tuple[int, str]]:
+        """Highest published generation ≤ upto_epoch, as (epoch, path)."""
+        best: Optional[tuple[int, str]] = None
+        for key in self._coord.kv_keys("ckpt/"):
+            try:
+                gen = int(key.split("/", 1)[1])
+            except (IndexError, ValueError):
+                continue
+            if gen <= upto_epoch and (best is None or gen > best[0]):
+                raw = self._coord.kv_get(key)
+                if raw:
+                    best = (gen, raw.decode())
+        return best
+
+    def wait_state(self, epoch: int, timeout_s: float = 30.0
+                   ) -> Optional[tuple[int, str]]:
+        """Wait for the generation written at ``epoch`` (reform sync point);
+        falls back to the latest earlier generation at timeout."""
+        deadline = time.monotonic() + timeout_s
+        key = _CKPT_KEY.format(epoch=epoch)
+        while time.monotonic() < deadline:
+            raw = self._coord.kv_get(key)
+            if raw:
+                return epoch, raw.decode()
+            time.sleep(self._poll_s)
+        return self.latest_state(epoch)
+
+
+# -- the worker loop ---------------------------------------------------------
+
+def run_elastic_worker(
+    coord,
+    name: str,
+    *,
+    init_state: Callable[[], Any],
+    train_world: Callable[["WorldHandle", Any, Callable[[], bool]], Any],
+    save_state: Callable[[Any, str], str],
+    load_state: Callable[[str], Any],
+    ckpt_dir: str,
+    address: str = "127.0.0.1",
+    min_members: int = 1,
+    settle_s: float = 0.5,
+    max_worlds: int = 100,
+    leave_requested: Optional[Callable[[], bool]] = None,
+    heartbeat_timeout_s: int = 10,
+) -> Any:
+    """The full elastic dance for one worker process.
+
+    ``train_world(world, state, should_stop) -> (state, stopped)`` trains
+    until the world collectively stops (membership change / leave intent —
+    ``stopped=True``) or the task queue is drained everywhere
+    (``stopped=False``), returning host-resident state (numpy pytree —
+    device arrays do not survive backend teardown).  ``should_stop()`` is
+    the worker's *local* observation (epoch moved, leave announced, or our
+    own leave request — announcing it as a side effect); the callback's
+    verdict must be fed into the step so the world stops unanimously at
+    one boundary (see multihost_worker for the canonical loop).
+    ``save_state``/``load_state`` persist state (checkpoint files on
+    shared storage; the KV holds only pointers).  Returns the final state.
+
+    State-consistency protocol (race-free across joins/leaves):
+
+    * At every world start the **leader rebroadcasts** its state as the
+      authoritative generation for this epoch, and everyone loads it — so
+      a fresh joiner can never cold-start into a world whose survivors
+      carry trained state.
+    * At teardown the survivors **publish** the carried state (one
+      CAS-elected writer saves inline; the rest block on the pointer), so
+      a generation is on shared storage *before* any survivor enters the
+      next world's handshake — which is what makes the leader's
+      ``latest_state`` read well-ordered even when the new leader is a
+      brand-new process.
+    * Cold start (no generations at all) is deterministic seeded init,
+      identical in every process.
+    """
+    ew = ElasticWorld(coord, name, address=address, settle_s=settle_s,
+                      heartbeat_timeout_s=heartbeat_timeout_s)
+    ew.join()
+    state = None
+    try:
+        with ew.member.keepalive():
+            for _ in range(max_worlds):
+                world = ew.form(min_members=min_members)
+
+                # Leader restores (fresh leader) or carries, then
+                # rebroadcasts; everyone syncs to that generation.
+                if world.is_leader:
+                    if state is None:
+                        found = ew.latest_state(world.epoch)
+                        state = (load_state(found[1]) if found
+                                 else init_state())
+                    ew.broadcast_state(
+                        world.epoch,
+                        lambda: save_state(state, os.path.join(
+                            ckpt_dir, f"gen-{world.epoch}")))
+                found = ew.wait_state(world.epoch)
+                if found:
+                    state = load_state(found[1])
+                elif state is None:
+                    # leader died before publishing; the epoch is about to
+                    # bump — cold-init and let the reform pick up sync.
+                    state = init_state()
+
+                announced = [False]
+
+                def should_stop() -> bool:
+                    if leave_requested is not None and leave_requested():
+                        if not announced[0]:
+                            ew.announce_leave(world.epoch)
+                            announced[0] = True
+                        return True
+                    return (ew.epoch() != world.epoch
+                            or ew.leave_announced(world.epoch))
+
+                try:
+                    state, stopped = train_world(world, state, should_stop)
+                except Exception as exc:
+                    # A peer crashed mid-collective: jax's runtime errors
+                    # out after heartbeat_timeout.  Progress since the last
+                    # generation is lost (bounded by world length); reform.
+                    log.warn("train step failed mid-world; reforming",
+                             epoch=world.epoch, err=str(exc)[:200])
+                    _teardown_backend()
+                    ew.wait_epoch_past(world.epoch)
+                    continue
+
+                if not stopped:  # queue drained everywhere — job done
+                    ew.publish_state(
+                        world.epoch + 1,
+                        lambda: save_state(
+                            state, os.path.join(ckpt_dir, "final")))
+                    return state
+
+                # Persist this generation before anyone re-enters formation
+                # (see protocol above).  gen = world.epoch + 1 is unique per
+                # world and ≤ the next membership epoch.
+                gen = world.epoch + 1
+                if not ew.publish_state(
+                        gen,
+                        lambda: save_state(state, os.path.join(
+                            ckpt_dir, f"gen-{gen}"))):
+                    ew.wait_state(gen)
+                if announced[0] or (leave_requested is not None
+                                    and leave_requested()):
+                    return state  # the finally below deregisters us
+                ew.wait_epoch_past(world.epoch)
+            raise RuntimeError(f"exceeded {max_worlds} world reformations")
+    finally:
+        try:
+            ew.leave()
+        except Exception:
+            pass
+        _teardown_backend()
+
+
+# -- numpy-tree state helpers (the default save/load for DP-replicated
+#    state; FSDP-scale jobs use runtime.checkpoint's Orbax path) -------------
+
+def save_numpy_tree(tree: Any, path: str) -> str:
+    import jax
+
+    flat, _ = jax.tree.flatten(tree)
+    np.savez(path + ".npz", *[np.asarray(x) for x in flat])
+    return path + ".npz"
+
+
+def load_numpy_tree(path: str, like: Any) -> Any:
+    import jax
+
+    with np.load(path) as z:
+        flat = [z[k] for k in z.files]
+    _, treedef = jax.tree.flatten(like)
+    return jax.tree.unflatten(treedef, flat)

@@ -1,0 +1,302 @@
+"""Runnable elastic multi-host worker (one process = one host).
+
+``python -m edl_tpu.runtime.multihost_worker --coord HOST:PORT --name w0
+--ckpt-dir DIR`` joins the job's membership, forms successive
+jax.distributed worlds with whoever else is live (see runtime.multihost),
+and trains a deterministic synthetic regression MLP with data-parallel
+pjit steps over the global mesh, leasing data shards from the task queue.
+
+This is the subprocess body for the multi-process elastic tests and the
+multihost demo — the TPU equivalent of the reference's trainer pod body
+(docker/paddle_k8s:119-141 → example/train_ft.py): replace the synthetic
+objective with your model and keep the world dance.
+
+Exit codes: 0 = queue drained (job complete), >0 = error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import os
+import sys
+
+import numpy as np
+
+# honor an explicit cpu request before any jax backend init (the test
+# harness runs N CPU processes; the axon sitecustomize pins otherwise)
+if "cpu" in os.environ.get("JAX_PLATFORMS", ""):
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+from edl_tpu.runtime.data import ShardRegistry, TaskLeaseBatches
+from edl_tpu.runtime.multihost import (
+    WorldHandle,
+    load_numpy_tree,
+    run_elastic_worker,
+    save_numpy_tree,
+)
+
+# deterministic synthetic regression: y = W* x with fixed W*
+IN_DIM, OUT_DIM, HIDDEN = 16, 4, 64
+N_EXAMPLES, SHARDS, LOCAL_BATCH = 4096, 32, 32
+SEED = 7
+
+
+def make_dataset() -> tuple[np.ndarray, np.ndarray]:
+    rng = np.random.default_rng(SEED)
+    x = rng.normal(size=(N_EXAMPLES, IN_DIM)).astype(np.float32)
+    w_true = rng.normal(size=(IN_DIM, OUT_DIM)).astype(np.float32)
+    return x, x @ w_true
+
+
+def init_state():
+    import jax
+    import optax
+
+    params = _mlp_init(jax.random.key(0))
+    opt_state = _optimizer().init(params)
+    return {"params": params, "opt": opt_state, "step": np.zeros((), np.int32)}
+
+
+def _mlp_init(key):
+    import jax
+    import jax.numpy as jnp
+
+    k1, k2 = jax.random.split(key)
+    s1 = 1.0 / np.sqrt(IN_DIM)
+    s2 = 1.0 / np.sqrt(HIDDEN)
+    return {
+        "w1": jax.random.uniform(k1, (IN_DIM, HIDDEN), jnp.float32, -s1, s1),
+        "b1": jnp.zeros((HIDDEN,)),
+        "w2": jax.random.uniform(k2, (HIDDEN, OUT_DIM), jnp.float32, -s2, s2),
+        "b2": jnp.zeros((OUT_DIM,)),
+    }
+
+
+def _optimizer():
+    import optax
+
+    return optax.adam(1e-2)
+
+
+def _loss(params, batch):
+    import jax.numpy as jnp
+
+    x, y = batch
+    h = jnp.tanh(x @ params["w1"] + params["b1"])
+    pred = h @ params["w2"] + params["b2"]
+    return jnp.mean((pred - y) ** 2)
+
+
+def _compiled_step():
+    """Build the DP train step over the *current* backend's devices.
+
+    Rebuilt per world on purpose: backend teardown between worlds
+    invalidates device objects, so caching a mesh across worlds would pin
+    dead devices.  On TPU the persistent XLA compilation cache absorbs the
+    recompile; on the CPU test mesh it's milliseconds."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    mesh = Mesh(np.array(jax.devices()), ("dp",))
+    rep = NamedSharding(mesh, P())
+    data_sh = NamedSharding(mesh, P("dp"))
+    optimizer = _optimizer()
+
+    def weighted_loss(params, x, y, w):
+        import jax.numpy as jnp
+
+        h = jnp.tanh(x @ params["w1"] + params["b1"])
+        pred = h @ params["w2"] + params["b2"]
+        per_example = jnp.sum((pred - y) ** 2, axis=-1)
+        return jnp.sum(per_example * w) / jnp.maximum(jnp.sum(w), 1.0)
+
+    @functools.partial(
+        jax.jit,
+        in_shardings=(rep, rep,
+                      (data_sh, data_sh, data_sh, data_sh, data_sh)),
+        out_shardings=(rep, rep, None, None, None))
+    def step(params, opt_state, batch):
+        """One collective step with in-band consensus.
+
+        Every step is a collective, so every live process must execute it —
+        including processes that currently hold no data (their rows carry
+        weight 0) — and the decisions to stop (membership change) or finish
+        (queue drained everywhere) must be unanimous AT THE SAME STEP.
+        Both are computed inside the step from per-process flags, so every
+        worker reads the identical replicated verdict and no one enters a
+        collective its peers have abandoned."""
+        import jax.numpy as jnp
+        import optax
+
+        x, y, w, stop_flags, done_flags = batch
+        loss, grads = jax.value_and_grad(weighted_loss)(params, x, y, w)
+        updates, new_opt = optimizer.update(grads, opt_state, params)
+        new_params = optax.apply_updates(params, updates)
+        # a data-less step must be a no-op (adam moves params even on zero
+        # gradients — the decayed momentum keeps pushing)
+        has_data = jnp.sum(w) > 0
+        keep = lambda new, old: jax.tree.map(
+            lambda a, b: jnp.where(has_data, a, b), new, old)
+        any_stop = jnp.sum(stop_flags) > 0
+        all_done = jnp.sum(done_flags) >= done_flags.shape[0]
+        return (keep(new_params, params), keep(new_opt, opt_state),
+                loss, any_stop, all_done)
+
+    return mesh, rep, data_sh, step
+
+
+class LeasedBatchSource:
+    """Non-blocking local batch source over task leases.
+
+    Unlike :class:`~edl_tpu.runtime.data.TaskLeaseBatches` (which sleeps on
+    EMPTY), this never blocks: a worker with no shard still has to execute
+    the collective step with a zero-weight batch, or its peers would hang.
+    """
+
+    def __init__(self, coord, worker: str, fetch, batch_size: int) -> None:
+        self._coord = coord
+        self._worker = worker
+        self._fetch = fetch
+        self._bs = batch_size
+        self._arrays = None
+        self._off = 0
+        self._task_id = -1
+        self.queue_done = False
+
+    def next_local(self) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """(x, y, weights) — zero-weight batch when no data is available."""
+        from edl_tpu.coord.service import LeaseStatus
+
+        if self._arrays is None and not self.queue_done:
+            status, task_id, payload = self._coord.lease(self._worker)
+            if status == LeaseStatus.DONE:
+                self.queue_done = True
+            elif status == LeaseStatus.OK:
+                self._arrays = self._fetch(payload)
+                self._off = 0
+                self._task_id = task_id
+        if self._arrays is None:
+            return (np.zeros((self._bs, IN_DIM), np.float32),
+                    np.zeros((self._bs, OUT_DIM), np.float32),
+                    np.zeros((self._bs,), np.float32))
+        x, y = self._arrays
+        lo, hi = self._off, min(self._off + self._bs, x.shape[0])
+        n = hi - lo
+        bx = np.zeros((self._bs, IN_DIM), np.float32)
+        by = np.zeros((self._bs, OUT_DIM), np.float32)
+        bw = np.zeros((self._bs,), np.float32)
+        bx[:n], by[:n], bw[:n] = x[lo:hi], y[lo:hi], 1.0
+        self._off = hi
+        self._coord.renew(self._task_id, self._worker)
+        if hi >= x.shape[0]:
+            self._coord.complete(self._task_id, self._worker)
+            self._arrays = None
+        return bx, by, bw
+
+    def release(self) -> None:
+        """Return any held lease to the queue (stop/teardown path)."""
+        if self._arrays is not None:
+            self._coord.release_worker(self._worker)
+            self._arrays = None
+
+
+def train_world(world: WorldHandle, state, should_stop, *, coord, name,
+                registry, verbose=True):
+    import jax
+
+    mesh, rep, data_sh, step = _compiled_step()
+    params = jax.device_put(state["params"], rep)
+    opt_state = jax.device_put(state["opt"], rep)
+    nstep = int(state["step"])
+
+    src = LeasedBatchSource(coord, name, registry.fetch, LOCAL_BATCH)
+    # one flag row per local device so P("dp") tiles evenly on multi-chip
+    # hosts (each process replicates its flag across its own devices)
+    flag_dim = jax.local_device_count()
+    last_loss, stopped = None, False
+    while True:
+        local_stop = np.full((flag_dim,), float(should_stop()), np.float32)
+        local_done = np.full((flag_dim,), float(src.queue_done), np.float32)
+        bx, by, bw = src.next_local()
+        gbatch = tuple(
+            jax.make_array_from_process_local_data(data_sh, a)
+            for a in (bx, by, bw, local_stop, local_done))
+        params, opt_state, loss, any_stop, all_done = step(
+            params, opt_state, gbatch)
+        nstep += 1
+        if verbose and nstep % 20 == 0:
+            print(f"[{name}] step {nstep} world={world.world_size} "
+                  f"loss={float(loss):.5f}", flush=True)
+        last_loss = float(loss)
+        if bool(any_stop):
+            stopped = True
+            src.release()
+            break
+        if bool(all_done):
+            break
+    if verbose:
+        print(f"[{name}] leaving world epoch={world.epoch} step={nstep} "
+              f"stopped={stopped} last_loss={last_loss}", flush=True)
+    return {
+        "params": jax.device_get(params),
+        "opt": jax.device_get(opt_state),
+        "step": np.asarray(nstep, np.int32),
+    }, stopped
+
+
+def main(argv=None) -> int:
+    import signal
+    import threading
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--coord", required=True, help="host:port")
+    ap.add_argument("--name", required=True)
+    ap.add_argument("--ckpt-dir", required=True)
+    ap.add_argument("--min-members", type=int, default=1)
+    ap.add_argument("--settle-s", type=float, default=0.5)
+    ap.add_argument("--heartbeat-timeout-s", type=int, default=10)
+    ap.add_argument("--quiet", action="store_true")
+    args = ap.parse_args(argv)
+
+    # SIGTERM = graceful scale-down: stop at a step boundary in concert
+    # with the other workers (see ElasticWorld.announce_leave), then exit.
+    leave = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: leave.set())
+
+    from edl_tpu.coord.client import CoordClient
+
+    host, _, port = args.coord.rpartition(":")
+    coord = CoordClient(host, int(port))
+
+    registry = ShardRegistry()
+    shard_ids = registry.register_arrays(make_dataset(), SHARDS)
+    if coord.kv_cas("data-seeder", b"", args.name.encode()):
+        registry.enqueue(coord, shard_ids)
+
+    os.makedirs(args.ckpt_dir, exist_ok=True)
+    state = run_elastic_worker(
+        coord,
+        args.name,
+        init_state=init_state,
+        train_world=functools.partial(
+            train_world, coord=coord, name=args.name, registry=registry,
+            verbose=not args.quiet),
+        save_state=save_numpy_tree,
+        load_state=lambda p: load_numpy_tree(p, init_state()),
+        ckpt_dir=args.ckpt_dir,
+        min_members=args.min_members,
+        settle_s=args.settle_s,
+        leave_requested=leave.is_set,
+        heartbeat_timeout_s=args.heartbeat_timeout_s,
+    )
+    outcome = "left" if leave.is_set() else "done"
+    print(f"[{args.name}] {outcome} at step {int(state['step'])}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
