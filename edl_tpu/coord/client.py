@@ -24,10 +24,21 @@ class CoordClient:
     def __init__(self, host: str, port: int, timeout: float = 10.0) -> None:
         self.host = host
         self.port = port
+        self.timeout = timeout
         self._lock = threading.Lock()
         self._sock = socket.create_connection((host, port), timeout=timeout)
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._rfile = self._sock.makefile("rb")
+
+    # Picklable by address: a deserialized client opens its own connection.
+    # This is what lets the elastic supervisor hand a coord handle to its
+    # per-world child processes (runtime.multihost) — sockets can't cross
+    # a process boundary, addresses can.
+    def __getstate__(self) -> dict:
+        return {"host": self.host, "port": self.port, "timeout": self.timeout}
+
+    def __setstate__(self, state: dict) -> None:
+        self.__init__(state["host"], state["port"], state["timeout"])
 
     def close(self) -> None:
         try:

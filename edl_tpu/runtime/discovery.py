@@ -46,6 +46,7 @@ class CoordDiscovery:
         self.name = name
         self.address = address
         self.member_id: Optional[int] = None
+        self._beat_thread: Optional[threading.Thread] = None
 
     def join(self) -> int:
         """Register this worker; returns the membership epoch after join."""
@@ -53,7 +54,17 @@ class CoordDiscovery:
         return self._client.epoch()
 
     def leave(self) -> None:
+        # An expiry-rejoin RPC from the keepalive thread can still be in
+        # flight when leave() is called; if it lands after our LEAVE the
+        # departed worker re-registers as a phantom member until the TTL
+        # prunes it (one spurious epoch bump for every peer).  Wait for the
+        # beat thread to die and leave again — LEAVE on a non-member is a
+        # harmless no-op, so the second call only matters when the race hit.
+        t = self._beat_thread
         self._client.leave(self.name)
+        if t is not None and t.is_alive():
+            t.join(timeout=10.0)
+            self._client.leave(self.name)
         self.member_id = None
 
     def heartbeat(self) -> bool:
@@ -94,6 +105,7 @@ class CoordDiscovery:
 
         t = threading.Thread(target=beat, daemon=True,
                              name=f"keepalive-{self.name}")
+        self._beat_thread = t
         t.start()
         try:
             yield self

@@ -15,6 +15,7 @@ continues), with the pserver/etcd machinery replaced by mesh + coord.
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
@@ -33,6 +34,9 @@ class RunReport:
     losses: list[float] = field(default_factory=list)
     world_sizes: list[int] = field(default_factory=list)
     resizes: int = 0
+    #: wall-clock cost of each reshard: the resize() call plus the first
+    #: step on the new mesh (which includes its compile on a cache miss)
+    resize_seconds: list[float] = field(default_factory=list)
 
     @property
     def first_loss(self) -> float:
@@ -94,14 +98,19 @@ class LocalElasticJob:
         )
         for batch in batches:
             want = self.desired_world_size()
+            resized_at = None
             if want != self.trainer.world_size:
                 before = self.trainer.world_size
+                resized_at = time.perf_counter()
                 self.trainer.resize(want)
                 report.resizes += 1
                 log.info("elastic resize applied", job=self.job.full_name,
                          from_size=before, to_size=want,
                          step=self.trainer.state.step)
             loss = self.trainer.step(batch)
+            if resized_at is not None:
+                report.resize_seconds.append(
+                    time.perf_counter() - resized_at)
             report.steps += 1
             report.losses.append(loss)
             report.world_sizes.append(self.trainer.world_size)

@@ -1,4 +1,4 @@
-"""Elastic multi-host runtime: membership epochs → jax.distributed worlds.
+"""Elastic multi-host runtime: membership epochs → supervised jax worlds.
 
 This is the piece SURVEY §7 lists as hard part 4: jax's distributed runtime
 is **static** — world size is fixed at ``jax.distributed.initialize``.  The
@@ -6,39 +6,56 @@ reference sidestepped the equivalent problem because its trainers never
 formed a world at all (parameters lived in pservers, reference
 example/train_ft.py:105-114).  Here trainers DO form a world (the device
 mesh is the parameter store), so elasticity becomes *epochs of static
-worlds*:
+worlds* — and, crucially, each world runs in a **supervised child
+process**:
 
-    1. every worker joins coordination-service membership and heartbeats;
-    2. a world forms from a **stable membership snapshot**: rank = index in
-       the name-sorted member list, world size = member count;
-    3. rank 0 claims the jax coordinator endpoint for this epoch via a KV
-       compare-and-swap (the etcd-slot-claim idiom, SURVEY §2.4) and
-       everyone calls ``jax.distributed.initialize(endpoint, n, rank)``;
-    4. training runs pjit/shard_map steps over the global mesh, leasing
-       data shards from the task queue — each step polls the membership
-       epoch (one cheap RPC);
-    5. on an epoch change (join/leave/death): survivors pull state to host,
-       one CAS-elected writer persists it, everyone tears the backend down
-       (``jax.distributed.shutdown`` + ``clear_backends``) and loops to 2.
-       The queue re-dispatches dead workers' leased shards after the task
-       timeout (the reference's 16 s bound, docker/paddle_k8s:30), so no
-       data is lost or double-counted across the resize.
+    supervisor (one per host, long-lived)          world child (one per epoch)
+    ───────────────────────────────────────        ───────────────────────────
+    joins membership, heartbeats                   never joins membership
+    plans the world: stable snapshot →             jax.distributed.initialize
+      rank = index in name-sorted members,         syncs state to the epoch's
+      rank 0 claims the coordinator                  published generation
+      endpoint via KV CAS                          pjit train steps, leasing
+    spawns the child with the plan                   data shards from the task
+    watches for SIGTERM → announces                  queue, polling the epoch
+      leave intent in KV                           publishes the next state
+    child exit 0 → read result, continue             generation, writes a
+    child died     → wait for the epoch              result file, exits 0
+      to prune the dead peer, re-plan
+
+Why the child process is load-bearing: when a peer is SIGKILL'd
+mid-collective, XLA's coordination service aborts the *process* with
+``LOG(FATAL)`` — no Python ``except`` can catch it.  In round 1 that abort
+took the whole worker down with the killed peer (the exact failure the
+reference's architecture makes a non-event: a dead trainer only loses its
+leased-but-unfinished tasks, re-dispatched after the 16 s timeout —
+reference docker/paddle_k8s:30,119-141).  With the world quarantined in a
+child, the abort kills one epoch's child; the supervisor — which never
+initializes jax — turns the death into a reform.
 
 State flows through generation-tagged checkpoints (``ckpt/<epoch>`` KV
-pointers): a fresh joiner — or a world with no survivors — restores the
-highest generation ≤ its epoch; the cold start is covered by deterministic
-seeded init, which every process computes identically.
+pointers to files on shared storage): every world starts by loading the
+generation its leader published for the epoch, and ends by publishing the
+next one (one CAS-elected writer saves; the rest block on the pointer).
+A fresh joiner therefore can never cold-start into a world whose peers
+carry trained state, and a world with no survivors restores the highest
+generation ≤ its epoch.  Cold start is deterministic seeded init.
 
-On real TPU pods the same code path applies per *host* (each process owns
-its local chips; the global mesh spans all of them over ICI/DCN); tests
-exercise it with N single-device CPU processes and gloo collectives —
-multi-process behavior the reference could never test in CI (SURVEY §4).
+On real TPU pods the same code path applies per *host* (each child owns
+the host's local chips; the global mesh spans all of them over ICI/DCN);
+tests exercise it with N single-device CPU processes
+(tests/test_multihost.py) — multi-process behavior the reference could
+never test in CI (SURVEY §4).
 """
 
 from __future__ import annotations
 
+import json
+import multiprocessing as mp
 import os
 import socket
+import sys
+import tempfile
 import time
 from dataclasses import dataclass
 from typing import Any, Callable, Optional
@@ -56,10 +73,25 @@ _CKPT_KEY = "ckpt/{epoch}"
 _CKPT_WRITER_KEY = "ckpt-writer/{epoch}"
 _LEAVE_KEY = "leave-intent/{epoch}"
 
+#: Child exit code for "world aborted, reform" (a Python-visible failure;
+#: XLA coordination-service aborts arrive as negative signal codes).
+WORLD_ABORTED = 3
+
+
+@dataclass(frozen=True)
+class WorldPlan:
+    """A planned (not yet initialized) world: the supervisor's output."""
+
+    epoch: int
+    rank: int
+    world_size: int
+    coordinator: str
+    members: tuple[str, ...]
+
 
 @dataclass(frozen=True)
 class WorldHandle:
-    """One static jax.distributed world (one membership epoch)."""
+    """One live jax.distributed world (one membership epoch)."""
 
     epoch: int
     rank: int
@@ -79,15 +111,13 @@ def free_port(host: str = "127.0.0.1") -> int:
 
 
 def _teardown_backend() -> None:
-    """Tear down jax.distributed + the XLA backend so initialize() can run
-    again at a different world size (verified against jax 0.8: shutdown +
-    clear_backends + clear_caches permits re-initialization)."""
+    """Best-effort jax.distributed + backend teardown (child exit hygiene)."""
     import jax
 
     try:
         jax.distributed.shutdown()
     except (RuntimeError, ValueError):
-        pass  # not initialized — first world in this process
+        pass  # not initialized
     try:
         import jax.extend.backend
 
@@ -97,8 +127,40 @@ def _teardown_backend() -> None:
     jax.clear_caches()
 
 
+def _die_with_parent(parent_pid: int) -> None:
+    """Arrange for this (child) process to be SIGKILL'd when its supervisor
+    dies, so a killed worker takes its world child down with it and the
+    surviving peers' reform logic sees exactly one death."""
+    import ctypes
+    import signal
+
+    try:
+        libc = ctypes.CDLL("libc.so.6", use_errno=True)
+        PR_SET_PDEATHSIG = 1
+        libc.prctl(PR_SET_PDEATHSIG, signal.SIGKILL)
+    except OSError:  # pragma: no cover - non-glibc platform
+        pass
+    if os.getppid() != parent_pid:  # parent already gone before prctl landed
+        os._exit(1)
+
+
+def _pin_platform_from_env() -> None:
+    """Honor an explicit CPU-first JAX_PLATFORMS before backend init.
+
+    Only when the FIRST entry is exactly ``cpu`` — ``tpu,cpu`` means "cpu
+    as fallback" and must still pick the TPU (ADVICE r1)."""
+    first = os.environ.get("JAX_PLATFORMS", "").split(",")[0].strip()
+    if first == "cpu":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+
 class ElasticWorld:
-    """Forms successive jax.distributed worlds from membership epochs."""
+    """Membership, world planning, and the state-generation protocol.
+
+    Used from two places: the supervisor (joins membership, plans worlds)
+    and each world child (state generations + epoch polls — never joins)."""
 
     def __init__(
         self,
@@ -107,8 +169,6 @@ class ElasticWorld:
         address: str = "127.0.0.1",
         settle_s: float = 0.5,
         poll_s: float = 0.05,
-        init_timeout_s: float = 60.0,
-        heartbeat_timeout_s: int = 10,
     ) -> None:
         self._coord = coord
         self.member = CoordDiscovery(coord, name, address)
@@ -116,11 +176,6 @@ class ElasticWorld:
         self.address = address
         self._settle_s = settle_s
         self._poll_s = poll_s
-        self._init_timeout_s = init_timeout_s
-        #: how fast jax's runtime declares a silent peer dead (a crashed
-        #: peer leaves survivors blocked in a collective until then)
-        self._heartbeat_timeout_s = heartbeat_timeout_s
-        self._initialized_once = False
 
     # -- membership --------------------------------------------------------
 
@@ -137,9 +192,10 @@ class ElasticWorld:
     #
     # A collective needs every process: if a leaver simply stopped stepping,
     # the survivors' next psum would block forever.  Because every step IS a
-    # collective, all workers sit at the same global step — so a leaver
-    # announces intent via KV, everyone (leaver included) stops at the same
-    # step boundary, and only then does the leaver drop its membership.
+    # collective, all workers sit at the same global step — so the leaver's
+    # supervisor announces intent via KV, every child (leaver's included)
+    # stops at the same step boundary, and only then does the leaver drop
+    # its membership.
 
     def announce_leave(self, epoch: int) -> None:
         self._coord.kv_set(_LEAVE_KEY.format(epoch=epoch), self.name.encode())
@@ -178,13 +234,13 @@ class ElasticWorld:
                     f"members within {timeout_s}s (have {names})")
             time.sleep(self._poll_s)
 
-    # -- world formation ---------------------------------------------------
+    # -- world planning ----------------------------------------------------
 
-    def form(self, min_members: int = 1, timeout_s: float = 120.0
-             ) -> WorldHandle:
-        """Block until a stable world forms, initialize jax.distributed in
-        it, and return the handle.  Retries with a fresh snapshot if the
-        membership shifts mid-handshake."""
+    def plan(self, min_members: int = 1, timeout_s: float = 120.0
+             ) -> WorldPlan:
+        """Block until a stable world can form and return its plan — rank,
+        size, and the coordinator endpoint rank 0 claimed for the epoch.
+        No jax state is touched; the supervisor stays abort-proof."""
         deadline = time.monotonic() + timeout_s
         while True:
             epoch, names = self.wait_stable(
@@ -194,35 +250,8 @@ class ElasticWorld:
                                                deadline - time.monotonic())
             if endpoint is None:  # epoch moved under us; re-snapshot
                 continue
-            if self._initialized_once:
-                _teardown_backend()
-            import jax
-
-            try:
-                jax.distributed.initialize(
-                    coordinator_address=endpoint,
-                    num_processes=len(names),
-                    process_id=rank,
-                    initialization_timeout=max(
-                        int(min(self._init_timeout_s,
-                                deadline - time.monotonic())), 1),
-                    heartbeat_timeout_seconds=self._heartbeat_timeout_s,
-                )
-            except Exception as exc:  # peer died mid-handshake → retry
-                log.warn("world init failed; reforming", epoch=epoch,
-                         err=str(exc)[:200])
-                _teardown_backend()
-                if time.monotonic() >= deadline:
-                    raise
-                continue
-            self._initialized_once = True
-            handle = WorldHandle(epoch=epoch, rank=rank,
-                                 world_size=len(names),
-                                 coordinator=endpoint,
-                                 members=tuple(names))
-            log.info("world formed", epoch=epoch, rank=rank,
-                     world=len(names), coordinator=endpoint)
-            return handle
+            return WorldPlan(epoch=epoch, rank=rank, world_size=len(names),
+                             coordinator=endpoint, members=tuple(names))
 
     def _claim_coordinator(self, epoch: int, rank: int, budget_s: float
                            ) -> Optional[str]:
@@ -259,9 +288,15 @@ class ElasticWorld:
             return True
         return False
 
+    def state_published(self, epoch: int) -> bool:
+        return self._coord.kv_get(_CKPT_KEY.format(epoch=epoch)) is not None
+
     def broadcast_state(self, epoch: int, save: Callable[[], str]) -> None:
-        """Publish generation ``epoch`` unconditionally (the world leader's
-        authoritative rebroadcast — the leader is unique per world)."""
+        """Publish generation ``epoch`` as the world leader (unique per
+        world).  Skipped by callers when the pointer already exists — after
+        a single membership change the new epoch equals the previous
+        teardown generation, and rewriting an already-published file races
+        readers mid-load (ADVICE r1)."""
         path = save()
         self._coord.kv_set(_CKPT_KEY.format(epoch=epoch), path.encode())
 
@@ -293,14 +328,144 @@ class ElasticWorld:
         return self.latest_state(epoch)
 
 
-# -- the worker loop ---------------------------------------------------------
+# -- the per-world child body ------------------------------------------------
+
+@dataclass
+class WorkerConfig:
+    """Everything a world child needs; must be picklable (spawn context).
+
+    The callables must be module-level functions or partials of them —
+    ``coord`` crosses the process boundary by reconnecting
+    (CoordClient.__getstate__)."""
+
+    coord: Any
+    name: str
+    init_state: Callable[[], Any]
+    train_world: Callable[[WorldHandle, Any, Callable[[], bool]], Any]
+    save_state: Callable[[Any, str], str]
+    load_state: Callable[[str], Any]
+    ckpt_dir: str
+    init_timeout_s: float = 60.0
+    heartbeat_timeout_s: int = 10
+    state_wait_s: float = 30.0
+
+
+def _write_result(path: str, result: dict) -> None:
+    fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path) or ".",
+                               prefix=".result-")
+    with os.fdopen(fd, "w") as f:
+        json.dump(result, f)
+    os.rename(tmp, path)
+
+
+def _world_child(plan: WorldPlan, cfg: WorkerConfig, result_path: str,
+                 parent_pid: int) -> None:
+    """One world, one process: initialize jax.distributed, sync state to
+    the epoch's generation, train until the world unanimously stops,
+    publish the next generation, report, exit.
+
+    Any failure here — including the XLA coordination service's
+    ``LOG(FATAL)`` abort when a peer dies — kills only this process; the
+    supervisor reforms."""
+    _die_with_parent(parent_pid)
+    _pin_platform_from_env()
+    import faulthandler
+    import signal as _signal
+
+    faulthandler.register(_signal.SIGUSR1)  # live stack dumps for debugging
+    ew = ElasticWorld(cfg.coord, cfg.name)
+    import jax
+
+    try:
+        jax.distributed.initialize(
+            coordinator_address=plan.coordinator,
+            num_processes=plan.world_size,
+            process_id=plan.rank,
+            initialization_timeout=max(int(cfg.init_timeout_s), 1),
+            heartbeat_timeout_seconds=cfg.heartbeat_timeout_s,
+        )
+    except Exception as exc:  # peer died mid-handshake → supervisor reforms
+        print(f"[{cfg.name}] world init failed at epoch {plan.epoch}: "
+              f"{str(exc)[:200]}", file=sys.stderr, flush=True)
+        sys.exit(WORLD_ABORTED)
+
+    world = WorldHandle(epoch=plan.epoch, rank=plan.rank,
+                        world_size=plan.world_size,
+                        coordinator=plan.coordinator, members=plan.members)
+    try:
+        # Backend creation in a multi-process world is itself a collective
+        # (every process exchanges device topology through the coordination
+        # service).  Force it HERE, while all ranks are at the same point —
+        # the first jax computation otherwise happens at rank-divergent
+        # times (the leader inits state while the rest poll KV) and
+        # deadlocks in make_*_client until someone times out.
+        jax.devices()
+        # World-start sync: the leader ensures a generation is published
+        # for this epoch (loading the latest earlier one, or cold init);
+        # everyone then loads exactly that generation.  If it is already
+        # published — the common single-change reform, where this epoch
+        # equals the previous teardown generation — the leader must NOT
+        # rewrite it (readers may be mid-load; ADVICE r1).
+        state = None
+        if world.is_leader and not ew.state_published(world.epoch):
+            found = ew.latest_state(world.epoch)
+            state = cfg.load_state(found[1]) if found else cfg.init_state()
+            ew.broadcast_state(
+                world.epoch,
+                lambda: cfg.save_state(state, os.path.join(
+                    cfg.ckpt_dir, f"gen-{world.epoch}")))
+            # the publisher keeps its in-memory copy — reloading the file
+            # it just wrote would double world-start latency while every
+            # peer is blocked in wait_state
+        if state is None:
+            found = ew.wait_state(world.epoch, timeout_s=cfg.state_wait_s)
+            state = cfg.load_state(found[1]) if found else cfg.init_state()
+
+        def should_stop() -> bool:
+            return (ew.epoch() != world.epoch
+                    or ew.leave_announced(world.epoch))
+
+        state, stopped = cfg.train_world(world, state, should_stop)
+
+        # Persist this generation before any supervisor re-enters planning.
+        # gen = epoch + 1 is unique per world and ≤ the next membership
+        # epoch, which is what makes the next leader's latest_state read
+        # well-ordered even when that leader is a brand-new process.
+        gen = world.epoch + 1
+        dest = "final" if not stopped else f"gen-{gen}"
+        save = lambda: cfg.save_state(state, os.path.join(cfg.ckpt_dir, dest))
+        if not ew.publish_state(gen, save):
+            found = ew.wait_state(gen, timeout_s=cfg.state_wait_s)
+            if found is None or found[0] != gen:
+                # The CAS winner died between claiming the writer key and
+                # setting the pointer (its largest crash window — a peer-
+                # death abort can land mid-save).  Take over: every child
+                # of this world holds identical state by protocol and the
+                # save is atomic (temp + rename to the same dest), so
+                # concurrent takeovers publish the same bytes.
+                ew.broadcast_state(gen, save)
+        raw = cfg.coord.kv_get(_CKPT_KEY.format(epoch=gen))
+        _write_result(result_path, {
+            "stopped": stopped,
+            "state_path": raw.decode() if raw else None,
+            "epoch": world.epoch,
+        })
+    except Exception as exc:
+        print(f"[{cfg.name}] world {plan.epoch} aborted: {str(exc)[:300]}",
+              file=sys.stderr, flush=True)
+        sys.exit(WORLD_ABORTED)
+    finally:
+        _teardown_backend()
+
+
+# -- the supervisor ----------------------------------------------------------
 
 def run_elastic_worker(
     coord,
     name: str,
     *,
     init_state: Callable[[], Any],
-    train_world: Callable[["WorldHandle", Any, Callable[[], bool]], Any],
+    train_world: Callable[[WorldHandle, Any, Callable[[], bool]], Any],
     save_state: Callable[[Any, str], str],
     load_state: Callable[[str], Any],
     ckpt_dir: str,
@@ -310,125 +475,152 @@ def run_elastic_worker(
     max_worlds: int = 100,
     leave_requested: Optional[Callable[[], bool]] = None,
     heartbeat_timeout_s: int = 10,
-) -> Any:
-    """The full elastic dance for one worker process.
+    init_timeout_s: float = 60.0,
+    reform_grace_s: Optional[float] = None,
+) -> str:
+    """The full elastic dance for one worker host: supervise one world
+    child per membership epoch (see module docstring for the protocol).
 
-    ``train_world(world, state, should_stop) -> (state, stopped)`` trains
-    until the world collectively stops (membership change / leave intent —
-    ``stopped=True``) or the task queue is drained everywhere
-    (``stopped=False``), returning host-resident state (numpy pytree —
-    device arrays do not survive backend teardown).  ``should_stop()`` is
-    the worker's *local* observation (epoch moved, leave announced, or our
-    own leave request — announcing it as a side effect); the callback's
-    verdict must be fed into the step so the world stops unanimously at
-    one boundary (see multihost_worker for the canonical loop).
-    ``save_state``/``load_state`` persist state (checkpoint files on
-    shared storage; the KV holds only pointers).  Returns the final state.
+    ``train_world(world, state, should_stop) -> (state, stopped)`` runs IN
+    THE CHILD and trains until the world collectively stops (membership
+    change / leave intent — ``stopped=True``) or the task queue is drained
+    everywhere (``stopped=False``), returning host-resident state (numpy
+    pytree).  ``should_stop()`` is the child's local observation; its
+    verdict must be fed into the step so the world stops unanimously at one
+    boundary (see multihost_worker for the canonical loop).  All callables
+    must be picklable (module-level functions / partials).
 
-    State-consistency protocol (race-free across joins/leaves):
+    ``leave_requested`` is polled IN THE SUPERVISOR (e.g. a SIGTERM flag);
+    when it fires the supervisor announces leave intent for the running
+    epoch, the world stops at a step boundary, and this function returns.
 
-    * At every world start the **leader rebroadcasts** its state as the
-      authoritative generation for this epoch, and everyone loads it — so
-      a fresh joiner can never cold-start into a world whose survivors
-      carry trained state.
-    * At teardown the survivors **publish** the carried state (one
-      CAS-elected writer saves inline; the rest block on the pointer), so
-      a generation is on shared storage *before* any survivor enters the
-      next world's handshake — which is what makes the leader's
-      ``latest_state`` read well-ordered even when the new leader is a
-      brand-new process.
-    * Cold start (no generations at all) is deterministic seeded init,
-      identical in every process.
-    """
-    ew = ElasticWorld(coord, name, address=address, settle_s=settle_s,
-                      heartbeat_timeout_s=heartbeat_timeout_s)
+    Returns the PATH of the final published state generation — not the
+    loaded pytree: loading would initialize a jax backend inside the
+    supervisor (acquiring TPU chips in the process that must stay
+    abort-proof and device-free).  Callers load it with ``load_state`` in
+    whatever process should own the result.  Raises RuntimeError if no
+    generation was ever published (the trained state could not be located
+    — never silently cold-starts).
+
+    ``min_members`` gates only the FIRST world (the initial quorum — the
+    reference starts the trainer Job at Parallelism=MinInstance,
+    pkg/jobparser.go:131); later worlds form with whoever is live, which
+    is what lets survivors of a crash reform below the initial quorum."""
+    ew = ElasticWorld(coord, name, address=address, settle_s=settle_s)
+    cfg = WorkerConfig(
+        coord=coord, name=name, init_state=init_state,
+        train_world=train_world, save_state=save_state,
+        load_state=load_state, ckpt_dir=ckpt_dir,
+        init_timeout_s=init_timeout_s,
+        heartbeat_timeout_s=heartbeat_timeout_s,
+    )
+    if reform_grace_s is None:
+        # a crashed peer is pruned from membership after the TTL; wait a
+        # little longer than that before reforming at the same epoch
+        try:
+            reform_grace_s = coord.member_ttl_ms() / 1000.0 * 2 + 5.0
+        except Exception:
+            reform_grace_s = 35.0
+    ctx = mp.get_context("spawn")
+    os.makedirs(ckpt_dir, exist_ok=True)
     ew.join()
-    state = None
+    last_path: Optional[str] = None
     try:
         with ew.member.keepalive():
-            for _ in range(max_worlds):
-                world = ew.form(min_members=min_members)
-
-                # Leader restores (fresh leader) or carries, then
-                # rebroadcasts; everyone syncs to that generation.
-                if world.is_leader:
-                    if state is None:
-                        found = ew.latest_state(world.epoch)
-                        state = (load_state(found[1]) if found
-                                 else init_state())
-                    ew.broadcast_state(
-                        world.epoch,
-                        lambda: save_state(state, os.path.join(
-                            ckpt_dir, f"gen-{world.epoch}")))
-                found = ew.wait_state(world.epoch)
-                if found:
-                    state = load_state(found[1])
-                elif state is None:
-                    # leader died before publishing; the epoch is about to
-                    # bump — cold-init and let the reform pick up sync.
-                    state = init_state()
-
-                announced = [False]
-
-                def should_stop() -> bool:
-                    if leave_requested is not None and leave_requested():
-                        if not announced[0]:
-                            ew.announce_leave(world.epoch)
-                            announced[0] = True
-                        return True
-                    return (ew.epoch() != world.epoch
-                            or ew.leave_announced(world.epoch))
-
-                try:
-                    state, stopped = train_world(world, state, should_stop)
-                except Exception as exc:
-                    # A peer crashed mid-collective: jax's runtime errors
-                    # out after heartbeat_timeout.  Progress since the last
-                    # generation is lost (bounded by world length); reform.
-                    log.warn("train step failed mid-world; reforming",
-                             epoch=world.epoch, err=str(exc)[:200])
-                    _teardown_backend()
-                    ew.wait_epoch_past(world.epoch)
+            for n_world in range(max_worlds):
+                if leave_requested is not None and leave_requested():
+                    break
+                plan = ew.plan(min_members=min_members if n_world == 0 else 1)
+                result_path = os.path.join(
+                    ckpt_dir, f"result-{name}-{plan.epoch}.json")
+                if os.path.exists(result_path):
+                    os.remove(result_path)  # stale attempt at this epoch
+                child = ctx.Process(
+                    target=_world_child,
+                    args=(plan, cfg, result_path, os.getpid()),
+                    name=f"world-{plan.epoch}-{name}")
+                child.start()
+                log.info("world child started", epoch=plan.epoch,
+                         rank=plan.rank, world=plan.world_size,
+                         pid=child.pid)
+                announced = False
+                while child.exitcode is None:
+                    child.join(timeout=0.1)
+                    if (not announced and leave_requested is not None
+                            and leave_requested()):
+                        ew.announce_leave(plan.epoch)
+                        announced = True
+                if child.exitcode == 0 and os.path.exists(result_path):
+                    with open(result_path) as f:
+                        result = json.load(f)
+                    last_path = result.get("state_path") or last_path
+                    if not result["stopped"]:  # queue drained — job done
+                        break
+                    if announced:  # our own graceful leave completed
+                        break
+                    # stopped on a membership change: wait for it to land
+                    try:
+                        ew.wait_epoch_past(plan.epoch,
+                                           timeout_s=reform_grace_s)
+                    except TimeoutError:  # pragma: no cover - races only
+                        pass
                     continue
-
-                if not stopped:  # queue drained everywhere — job done
-                    ew.publish_state(
-                        world.epoch + 1,
-                        lambda: save_state(
-                            state, os.path.join(ckpt_dir, "final")))
-                    return state
-
-                # Persist this generation before anyone re-enters formation
-                # (see protocol above).  gen = world.epoch + 1 is unique per
-                # world and ≤ the next membership epoch.
-                gen = world.epoch + 1
-                if not ew.publish_state(
-                        gen,
-                        lambda: save_state(state, os.path.join(
-                            ckpt_dir, f"gen-{gen}"))):
-                    ew.wait_state(gen)
-                if announced[0] or (leave_requested is not None
-                                    and leave_requested()):
-                    return state  # the finally below deregisters us
-                ew.wait_epoch_past(world.epoch)
-            raise RuntimeError(f"exceeded {max_worlds} world reformations")
+                # Child died: a peer crashed mid-collective (XLA abort),
+                # init raced a membership change, or the child itself was
+                # killed.  Progress since the last generation is lost
+                # (bounded by world length).  Wait for the membership to
+                # prune the dead peer, then re-plan.
+                log.warn("world child died; reforming", epoch=plan.epoch,
+                         exitcode=child.exitcode)
+                if plan.rank == 0:
+                    # The coordinator endpoint died with our child; clear
+                    # the epoch's claim so a same-epoch reform binds a
+                    # fresh port instead of reusing a dead (or collided)
+                    # one forever.  Peers that already read the stale
+                    # value fail one init round and re-plan.
+                    coord.kv_del(_JAX_COORD_KEY.format(epoch=plan.epoch))
+                try:
+                    ew.wait_epoch_past(plan.epoch, timeout_s=reform_grace_s)
+                except TimeoutError:
+                    pass  # epoch unmoved — reform at the same epoch
+            else:
+                raise RuntimeError(
+                    f"exceeded {max_worlds} world reformations")
     finally:
         try:
             ew.leave()
         except Exception:
             pass
-        _teardown_backend()
+    if last_path is None:
+        found = ew.latest_state(ew.epoch() + 1)
+        last_path = found[1] if found else None
+    if last_path is None:
+        raise RuntimeError(
+            "no state generation was ever published — trained state lost")
+    return last_path
 
 
 # -- numpy-tree state helpers (the default save/load for DP-replicated
 #    state; FSDP-scale jobs use runtime.checkpoint's Orbax path) -------------
 
 def save_numpy_tree(tree: Any, path: str) -> str:
+    """Atomic npz save: a concurrent reader of the published path can never
+    see a truncated archive (temp file + rename; ADVICE r1)."""
     import jax
 
     flat, _ = jax.tree.flatten(tree)
-    np.savez(path + ".npz", *[np.asarray(x) for x in flat])
-    return path + ".npz"
+    final = path + ".npz"
+    fd, tmp = tempfile.mkstemp(dir=os.path.dirname(final) or ".",
+                               prefix=".ckpt-", suffix=".npz")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            np.savez(f, *[np.asarray(x) for x in flat])
+        os.rename(tmp, final)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.remove(tmp)
+        raise
+    return final
 
 
 def load_numpy_tree(path: str, like: Any) -> Any:

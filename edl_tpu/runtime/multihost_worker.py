@@ -23,14 +23,13 @@ import sys
 
 import numpy as np
 
-# honor an explicit cpu request before any jax backend init (the test
+from edl_tpu.runtime.multihost import _pin_platform_from_env
+
+# honor an explicit cpu-FIRST request before any jax backend init (the test
 # harness runs N CPU processes; the axon sitecustomize pins otherwise)
-if "cpu" in os.environ.get("JAX_PLATFORMS", ""):
-    import jax
+_pin_platform_from_env()
 
-    jax.config.update("jax_platforms", "cpu")
-
-from edl_tpu.runtime.data import ShardRegistry, TaskLeaseBatches
+from edl_tpu.runtime.data import ShardRegistry
 from edl_tpu.runtime.multihost import (
     WorldHandle,
     load_numpy_tree,
@@ -38,9 +37,16 @@ from edl_tpu.runtime.multihost import (
     save_numpy_tree,
 )
 
-# deterministic synthetic regression: y = W* x with fixed W*
+# deterministic synthetic regression: y = W* x with fixed W*.  Scale knobs
+# come from env so the multi-process tests can shrink the job without
+# plumbing flags through every layer (tests/test_multihost.py).
 IN_DIM, OUT_DIM, HIDDEN = 16, 4, 64
-N_EXAMPLES, SHARDS, LOCAL_BATCH = 4096, 32, 32
+N_EXAMPLES = int(os.environ.get("EDL_MH_EXAMPLES", "4096"))
+SHARDS = int(os.environ.get("EDL_MH_SHARDS", "32"))
+LOCAL_BATCH = int(os.environ.get("EDL_MH_BATCH", "32"))
+#: per-step sleep — lets tests pace the queue drain so mid-job events
+#: (joins, kills) land deterministically while the job is still running
+STEP_SLEEP_S = float(os.environ.get("EDL_MH_STEP_SLEEP", "0"))
 SEED = 7
 
 
@@ -53,11 +59,15 @@ def make_dataset() -> tuple[np.ndarray, np.ndarray]:
 
 def init_state():
     import jax
-    import optax
 
     params = _mlp_init(jax.random.key(0))
     opt_state = _optimizer().init(params)
     return {"params": params, "opt": opt_state, "step": np.zeros((), np.int32)}
+
+
+def load_state(path: str):
+    """Module-level (picklable) load for the supervisor's world children."""
+    return load_numpy_tree(path, init_state())
 
 
 def _mlp_init(key):
@@ -212,6 +222,11 @@ def train_world(world: WorldHandle, state, should_stop, *, coord, name,
     params = jax.device_put(state["params"], rep)
     opt_state = jax.device_put(state["opt"], rep)
     nstep = int(state["step"])
+    if verbose:
+        # the entering-step line is what lets tests assert a late joiner
+        # inherited trained state (step > 0) instead of cold-starting
+        print(f"[{name}] entering world epoch={world.epoch} "
+              f"world={world.world_size} at step={nstep}", flush=True)
 
     src = LeasedBatchSource(coord, name, registry.fetch, LOCAL_BATCH)
     # one flag row per local device so P("dp") tiles evenly on multi-chip
@@ -227,8 +242,12 @@ def train_world(world: WorldHandle, state, should_stop, *, coord, name,
             for a in (bx, by, bw, local_stop, local_done))
         params, opt_state, loss, any_stop, all_done = step(
             params, opt_state, gbatch)
+        if STEP_SLEEP_S:
+            import time
+
+            time.sleep(STEP_SLEEP_S)
         nstep += 1
-        if verbose and nstep % 20 == 0:
+        if verbose and (nstep % 20 == 0 or nstep == 1):
             print(f"[{name}] step {nstep} world={world.world_size} "
                   f"loss={float(loss):.5f}", flush=True)
         last_loss = float(loss)
@@ -262,8 +281,9 @@ def main(argv=None) -> int:
     ap.add_argument("--quiet", action="store_true")
     args = ap.parse_args(argv)
 
-    # SIGTERM = graceful scale-down: stop at a step boundary in concert
-    # with the other workers (see ElasticWorld.announce_leave), then exit.
+    # SIGTERM = graceful scale-down: the supervisor announces leave intent,
+    # every world child stops at the same step boundary (see
+    # ElasticWorld.announce_leave), then we deregister and exit.
     leave = threading.Event()
     signal.signal(signal.SIGTERM, lambda *_: leave.set())
 
@@ -278,7 +298,7 @@ def main(argv=None) -> int:
         registry.enqueue(coord, shard_ids)
 
     os.makedirs(args.ckpt_dir, exist_ok=True)
-    state = run_elastic_worker(
+    state_path = run_elastic_worker(
         coord,
         args.name,
         init_state=init_state,
@@ -286,15 +306,19 @@ def main(argv=None) -> int:
             train_world, coord=coord, name=args.name, registry=registry,
             verbose=not args.quiet),
         save_state=save_numpy_tree,
-        load_state=lambda p: load_numpy_tree(p, init_state()),
+        load_state=load_state,
         ckpt_dir=args.ckpt_dir,
         min_members=args.min_members,
         settle_s=args.settle_s,
         leave_requested=leave.is_set,
         heartbeat_timeout_s=args.heartbeat_timeout_s,
     )
+    # The worker's own exit report may load the state (children are done;
+    # the supervisor core stayed jax-free throughout the dance).
+    step = int(load_state(state_path)["step"])
     outcome = "left" if leave.is_set() else "done"
-    print(f"[{args.name}] {outcome} at step {int(state['step'])}", flush=True)
+    print(f"[{args.name}] {outcome} at step {step} state={state_path}",
+          flush=True)
     return 0
 
 

@@ -11,7 +11,9 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-if "cpu" in [p.strip() for p in os.environ.get("JAX_PLATFORMS", "").split(",")]:
+# Only when cpu is the FIRST entry: "tpu,cpu" means cpu-as-fallback and
+# must still pick the accelerator (ADVICE r1).
+if os.environ.get("JAX_PLATFORMS", "").split(",")[0].strip() == "cpu":
     try:
         import jax
 
