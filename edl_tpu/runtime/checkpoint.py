@@ -29,7 +29,11 @@ class ElasticCheckpointer:
         self._mgr = ocp.CheckpointManager(
             self.directory,
             options=ocp.CheckpointManagerOptions(
-                max_to_keep=max_to_keep, create=True
+                max_to_keep=max_to_keep, create=True,
+                # a save aborted mid-write (peer crash during the elastic
+                # collective save) leaves a tmp dir; clear it so the
+                # retried save of the same step can proceed
+                cleanup_tmp_directories=True,
             ),
         )
 

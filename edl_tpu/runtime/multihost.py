@@ -336,7 +336,22 @@ class WorkerConfig:
 
     The callables must be module-level functions or partials of them —
     ``coord`` crosses the process boundary by reconnecting
-    (CoordClient.__getstate__)."""
+    (CoordClient.__getstate__).
+
+    ``collective_ckpt`` switches the state protocol for SHARDED state
+    (FSDP: every process holds a different shard, so no single writer can
+    persist a generation): ``save_state`` is then a collective — every
+    rank calls it with the same path and the checkpoint library
+    coordinates the multi-host write (Orbax over jax.distributed) — and
+    ``load_state`` collectively restores onto the current world's mesh,
+    resharding as the device count changes.  The leader-rebroadcast at
+    world start disappears in this mode: state always lives on shared
+    storage, so a fresh joiner reads the same generation as everyone.
+    Consequence: with no generation published yet, EVERY rank calls
+    ``init_state()`` locally, so in this mode init_state MUST be
+    deterministic and identical across processes (the jax idiom — seeded
+    PRNG — satisfies this; entropy/time-seeded inits that were safe under
+    the replicated leader-broadcast protocol are not)."""
 
     coord: Any
     name: str
@@ -348,6 +363,17 @@ class WorkerConfig:
     init_timeout_s: float = 60.0
     heartbeat_timeout_s: int = 10
     state_wait_s: float = 30.0
+    collective_ckpt: bool = False
+
+
+@dataclass(frozen=True)
+class WorkerOutcome:
+    """What the supervisor learned without ever touching devices: where
+    the final generation lives and (when the state tree reports one) the
+    step it stopped at."""
+
+    state_path: str
+    step: Optional[int] = None
 
 
 def _write_result(path: str, result: dict) -> None:
@@ -407,7 +433,14 @@ def _world_child(plan: WorldPlan, cfg: WorkerConfig, result_path: str,
         # equals the previous teardown generation — the leader must NOT
         # rewrite it (readers may be mid-load; ADVICE r1).
         state = None
-        if world.is_leader and not ew.state_published(world.epoch):
+        if cfg.collective_ckpt:
+            # Sharded state lives on shared storage in full: everyone
+            # restores the latest generation onto THIS world's mesh
+            # (Orbax reshards across a different device count), no
+            # rebroadcast needed.
+            found = ew.latest_state(world.epoch)
+            state = cfg.load_state(found[1]) if found else cfg.init_state()
+        elif world.is_leader and not ew.state_published(world.epoch):
             found = ew.latest_state(world.epoch)
             state = cfg.load_state(found[1]) if found else cfg.init_state()
             ew.broadcast_state(
@@ -434,7 +467,14 @@ def _world_child(plan: WorldPlan, cfg: WorkerConfig, result_path: str,
         gen = world.epoch + 1
         dest = "final" if not stopped else f"gen-{gen}"
         save = lambda: cfg.save_state(state, os.path.join(cfg.ckpt_dir, dest))
-        if not ew.publish_state(gen, save):
+        if cfg.collective_ckpt:
+            # Every rank participates in the sharded save (a barrier —
+            # the world is intact here, stopped at one step boundary),
+            # then every rank publishes the SAME pointer bytes (idempotent
+            # kv_set): a leader dying between the save barrier and its
+            # publish can no longer strand a fully-written generation.
+            ew.broadcast_state(gen, save)
+        elif not ew.publish_state(gen, save):
             found = ew.wait_state(gen, timeout_s=cfg.state_wait_s)
             if found is None or found[0] != gen:
                 # The CAS winner died between claiming the writer key and
@@ -445,10 +485,20 @@ def _world_child(plan: WorldPlan, cfg: WorkerConfig, result_path: str,
                 # concurrent takeovers publish the same bytes.
                 ew.broadcast_state(gen, save)
         raw = cfg.coord.kv_get(_CKPT_KEY.format(epoch=gen))
+        # Duck-typed progress report: the canonical state trees carry a
+        # scalar "step"; surfacing it here lets the supervisor report
+        # final progress without ever loading the checkpoint (which for
+        # sharded state would drag a jax backend into the abort-proof
+        # supervisor process).
+        try:
+            step = int(state["step"])
+        except Exception:
+            step = None
         _write_result(result_path, {
             "stopped": stopped,
             "state_path": raw.decode() if raw else None,
             "epoch": world.epoch,
+            "step": step,
         })
     except Exception as exc:
         print(f"[{cfg.name}] world {plan.epoch} aborted: {str(exc)[:300]}",
@@ -477,7 +527,8 @@ def run_elastic_worker(
     heartbeat_timeout_s: int = 10,
     init_timeout_s: float = 60.0,
     reform_grace_s: Optional[float] = None,
-) -> str:
+    collective_ckpt: bool = False,
+) -> "WorkerOutcome":
     """The full elastic dance for one worker host: supervise one world
     child per membership epoch (see module docstring for the protocol).
 
@@ -494,7 +545,8 @@ def run_elastic_worker(
     when it fires the supervisor announces leave intent for the running
     epoch, the world stops at a step boundary, and this function returns.
 
-    Returns the PATH of the final published state generation — not the
+    Returns a :class:`WorkerOutcome` carrying the PATH of the final
+    published state generation (plus the last reported step) — not the
     loaded pytree: loading would initialize a jax backend inside the
     supervisor (acquiring TPU chips in the process that must stay
     abort-proof and device-free).  Callers load it with ``load_state`` in
@@ -513,6 +565,7 @@ def run_elastic_worker(
         load_state=load_state, ckpt_dir=ckpt_dir,
         init_timeout_s=init_timeout_s,
         heartbeat_timeout_s=heartbeat_timeout_s,
+        collective_ckpt=collective_ckpt,
     )
     if reform_grace_s is None:
         # a crashed peer is pruned from membership after the TTL; wait a
@@ -525,6 +578,7 @@ def run_elastic_worker(
     os.makedirs(ckpt_dir, exist_ok=True)
     ew.join()
     last_path: Optional[str] = None
+    last_step: Optional[int] = None
     try:
         with ew.member.keepalive():
             for n_world in range(max_worlds):
@@ -554,6 +608,8 @@ def run_elastic_worker(
                     with open(result_path) as f:
                         result = json.load(f)
                     last_path = result.get("state_path") or last_path
+                    if result.get("step") is not None:
+                        last_step = result["step"]
                     if not result["stopped"]:  # queue drained — job done
                         break
                     if announced:  # our own graceful leave completed
@@ -597,7 +653,7 @@ def run_elastic_worker(
     if last_path is None:
         raise RuntimeError(
             "no state generation was ever published — trained state lost")
-    return last_path
+    return WorkerOutcome(state_path=last_path, step=last_step)
 
 
 # -- numpy-tree state helpers (the default save/load for DP-replicated

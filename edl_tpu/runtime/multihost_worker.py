@@ -100,20 +100,29 @@ def _loss(params, batch):
     return jnp.mean((pred - y) ** 2)
 
 
-def _compiled_step():
-    """Build the DP train step over the *current* backend's devices.
+def _compiled_step(kind: str = "replicated"):
+    """Build the train step over the *current* backend's devices.
+
+    ``kind``: "replicated" = pure DP (params live everywhere);
+    "fsdp" = params/opt-state sharded over the device axis (ZeRO-3-style;
+    batch still data-parallel over the same axis).
 
     Rebuilt per world on purpose: backend teardown between worlds
     invalidates device objects, so caching a mesh across worlds would pin
     dead devices.  On TPU the persistent XLA compilation cache absorbs the
     recompile; on the CPU test mesh it's milliseconds."""
     import jax
-    import jax.numpy as jnp
-    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-    mesh = Mesh(np.array(jax.devices()), ("dp",))
-    rep = NamedSharding(mesh, P())
-    data_sh = NamedSharding(mesh, P("dp"))
+    from edl_tpu.parallel.mesh import (
+        MeshSpec, dp_sharding, make_mesh, tree_shardings,
+    )
+
+    spec = MeshSpec(dp=-1) if kind == "replicated" else MeshSpec(fsdp=-1)
+    mesh = make_mesh(len(jax.devices()), spec)
+    data_sh = dp_sharding(mesh)
+    abstract = jax.eval_shape(init_state)
+    param_sh = tree_shardings(mesh, abstract["params"], kind)
+    opt_sh = tree_shardings(mesh, abstract["opt"], kind)
     optimizer = _optimizer()
 
     def weighted_loss(params, x, y, w):
@@ -126,9 +135,9 @@ def _compiled_step():
 
     @functools.partial(
         jax.jit,
-        in_shardings=(rep, rep,
+        in_shardings=(param_sh, opt_sh,
                       (data_sh, data_sh, data_sh, data_sh, data_sh)),
-        out_shardings=(rep, rep, None, None, None))
+        out_shardings=(param_sh, opt_sh, None, None, None))
     def step(params, opt_state, batch):
         """One collective step with in-band consensus.
 
@@ -156,7 +165,7 @@ def _compiled_step():
         return (keep(new_params, params), keep(new_opt, opt_state),
                 loss, any_stop, all_done)
 
-    return mesh, rep, data_sh, step
+    return mesh, param_sh, opt_sh, data_sh, step
 
 
 class LeasedBatchSource:
@@ -215,12 +224,15 @@ class LeasedBatchSource:
 
 
 def train_world(world: WorldHandle, state, should_stop, *, coord, name,
-                registry, verbose=True):
+                registry, verbose=True, sharding="replicated"):
     import jax
 
-    mesh, rep, data_sh, step = _compiled_step()
-    params = jax.device_put(state["params"], rep)
-    opt_state = jax.device_put(state["opt"], rep)
+    mesh, param_sh, opt_sh, data_sh, step = _compiled_step(sharding)
+    # State arrives either process-local (cold init / npz load — identical
+    # on every process) or already global+sharded (Orbax restore onto this
+    # world's mesh); device_put handles both, resharding only what moved.
+    params = jax.device_put(state["params"], param_sh)
+    opt_state = jax.device_put(state["opt"], opt_sh)
     nstep = int(state["step"])
     if verbose:
         # the entering-step line is what lets tests assert a late joiner
@@ -260,11 +272,62 @@ def train_world(world: WorldHandle, state, should_stop, *, coord, name,
     if verbose:
         print(f"[{name}] leaving world epoch={world.epoch} step={nstep} "
               f"stopped={stopped} last_loss={last_loss}", flush=True)
+    if sharding == "fsdp":
+        # sharded state stays on device — no single process holds it all;
+        # the collective Orbax save in the world child persists it
+        return {"params": params, "opt": opt_state,
+                "step": np.asarray(nstep, np.int32)}, stopped
     return {
         "params": jax.device_get(params),
         "opt": jax.device_get(opt_state),
         "step": np.asarray(nstep, np.int32),
     }, stopped
+
+
+# -- Orbax (collective, sharded) save/load for the fsdp mode -----------------
+
+def orbax_save_state(state, path: str) -> str:
+    """Collective sharded save: every rank calls this with the same path
+    (the world child's teardown barrier); Orbax coordinates the write over
+    jax.distributed.  Role of the reference's pserver+etcd state residency
+    (SURVEY §5.4), done TPU-natively for mesh-sharded state.
+
+    Idempotent: a same-epoch reform produces the same generation path, and
+    Orbax refuses to overwrite a finalized step — matching the replicated
+    path's semantics (the ckpt-writer CAS loses and the already-published
+    generation wins), the existing finalized save is kept as-is."""
+    from edl_tpu.runtime.checkpoint import ElasticCheckpointer
+
+    ckpt = ElasticCheckpointer(path, max_to_keep=1)
+    if ckpt.latest_step() is None:
+        ckpt.save(0, state)
+    ckpt.close()
+    return path
+
+
+def orbax_load_state(path: str):
+    """Collective sharded restore ONTO THE CURRENT WORLD'S MESH — the
+    saved world may have had a different process/device count; Orbax
+    reshards from the global on-disk array (probed: 2-proc save →
+    1-proc restore works on CPU and TPU)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from edl_tpu.runtime.checkpoint import ElasticCheckpointer
+    from edl_tpu.parallel.mesh import MeshSpec, make_mesh, tree_shardings
+
+    mesh = make_mesh(len(jax.devices()), MeshSpec(fsdp=-1))
+    abstract = jax.eval_shape(init_state)
+    shardings = {
+        "params": tree_shardings(mesh, abstract["params"], "fsdp"),
+        "opt": tree_shardings(mesh, abstract["opt"], "fsdp"),
+        "step": NamedSharding(mesh, P()),
+    }
+    ckpt = ElasticCheckpointer(path, max_to_keep=1)
+    try:
+        return ckpt.restore(abstract, shardings=shardings)
+    finally:
+        ckpt.close()
 
 
 def main(argv=None) -> int:
@@ -278,6 +341,11 @@ def main(argv=None) -> int:
     ap.add_argument("--min-members", type=int, default=1)
     ap.add_argument("--settle-s", type=float, default=0.5)
     ap.add_argument("--heartbeat-timeout-s", type=int, default=10)
+    ap.add_argument("--param-sharding", choices=("replicated", "fsdp"),
+                    default=os.environ.get("EDL_MH_SHARDING", "replicated"),
+                    help="replicated = pure DP with npz generations; "
+                         "fsdp = ZeRO-3-sharded state with collective "
+                         "Orbax generations")
     ap.add_argument("--quiet", action="store_true")
     args = ap.parse_args(argv)
 
@@ -297,28 +365,35 @@ def main(argv=None) -> int:
     if coord.kv_cas("data-seeder", b"", args.name.encode()):
         registry.enqueue(coord, shard_ids)
 
+    fsdp = args.param_sharding == "fsdp"
     os.makedirs(args.ckpt_dir, exist_ok=True)
-    state_path = run_elastic_worker(
+    outcome = run_elastic_worker(
         coord,
         args.name,
         init_state=init_state,
         train_world=functools.partial(
             train_world, coord=coord, name=args.name, registry=registry,
-            verbose=not args.quiet),
-        save_state=save_numpy_tree,
-        load_state=load_state,
+            verbose=not args.quiet, sharding=args.param_sharding),
+        save_state=orbax_save_state if fsdp else save_numpy_tree,
+        load_state=orbax_load_state if fsdp else load_state,
         ckpt_dir=args.ckpt_dir,
         min_members=args.min_members,
         settle_s=args.settle_s,
         leave_requested=leave.is_set,
         heartbeat_timeout_s=args.heartbeat_timeout_s,
+        collective_ckpt=fsdp,
     )
-    # The worker's own exit report may load the state (children are done;
-    # the supervisor core stayed jax-free throughout the dance).
-    step = int(load_state(state_path)["step"])
-    outcome = "left" if leave.is_set() else "done"
-    print(f"[{args.name}] {outcome} at step {step} state={state_path}",
-          flush=True)
+    # The world children report their final step through the supervisor
+    # (no checkpoint load here — the supervisor process stays device-free);
+    # only the rare fallback path, where the state was located by a KV
+    # scan rather than a child report, has to load the tree to know it.
+    step = outcome.step
+    if step is None:
+        loader = orbax_load_state if fsdp else load_state
+        step = int(loader(outcome.state_path)["step"])
+    verdict = "left" if leave.is_set() else "done"
+    print(f"[{args.name}] {verdict} at step {step} "
+          f"state={outcome.state_path}", flush=True)
     return 0
 
 

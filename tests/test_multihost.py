@@ -52,13 +52,13 @@ def _worker_env(examples: int, shards: int) -> dict:
 
 
 def _spawn_worker(port: int, name: str, ckpt_dir, min_members: int,
-                  env: dict, log_path) -> subprocess.Popen:
+                  env: dict, log_path, *, extra=()) -> subprocess.Popen:
     log = open(log_path, "w")
     return subprocess.Popen(
         [sys.executable, "-m", "edl_tpu.runtime.multihost_worker",
          "--coord", f"127.0.0.1:{port}", "--name", name,
          "--ckpt-dir", str(ckpt_dir), "--min-members", str(min_members),
-         "--settle-s", "0.3", "--heartbeat-timeout-s", "5"],
+         "--settle-s", "0.3", "--heartbeat-timeout-s", "5", *extra],
         stdout=log, stderr=subprocess.STDOUT, env=env)
 
 
@@ -192,4 +192,52 @@ def test_late_joiner_inherits_trained_state(coord_server, tmp_path):
     joined_step = int(first_entry.rsplit("step=", 1)[1])
     assert joined_step >= 20, first_entry
     assert "world=3" in (tmp_path / "w2.log").read_text()
+    _assert_exactly_once(coord_server.client(), 4 * SHARDS)
+
+
+def _losses(text: str) -> list:
+    """[(step, loss)] from 'step N world=W loss=L' progress lines."""
+    out = []
+    for line in text.splitlines():
+        if " loss=" in line and " step " in line:
+            step = int(line.split(" step ", 1)[1].split()[0])
+            out.append((step, float(line.rsplit("loss=", 1)[1])))
+    return out
+
+
+@pytest.mark.slow
+def test_fsdp_resize_restores_sharded_state(coord_server, tmp_path):
+    """BASELINE config 4 in miniature: an FSDP-sharded (ZeRO-3) model
+    resizes across a world change with the sharded state persisted and
+    restored COLLECTIVELY via Orbax — no single process ever holds the
+    full state (role of the reference's pserver param residency,
+    SURVEY §5.4, done TPU-natively).  Loss must be continuous through
+    the resize: the joiner's world restores the previous generation
+    instead of cold-starting."""
+    env = _worker_env(4 * EXAMPLES, 4 * SHARDS)
+    env["EDL_MH_STEP_SLEEP"] = "0.04"
+    fsdp = ("--param-sharding", "fsdp")
+    procs = {
+        n: _spawn_worker(coord_server.port, n, tmp_path, 2, env,
+                         tmp_path / f"{n}.log", extra=fsdp)
+        for n in ("w0", "w1")
+    }
+    # let the 2-world make real progress, then grow it to 3
+    _wait_for_line(tmp_path / "w0.log", "step 20 ", timeout_s=180)
+    procs["w2"] = _spawn_worker(coord_server.port, "w2", tmp_path, 1, env,
+                                tmp_path / "w2.log", extra=fsdp)
+    rcs = _wait_all(procs, timeout_s=300)
+    assert rcs == {"w0": 0, "w1": 0, "w2": 0}
+    w2 = (tmp_path / "w2.log").read_text()
+    first_entry = _wait_for_line(tmp_path / "w2.log", "entering world",
+                                 timeout_s=1)
+    joined_step = int(first_entry.rsplit("step=", 1)[1])
+    assert joined_step >= 20, first_entry  # inherited, not cold-started
+    assert "world=3" in w2
+    # loss continuity: every loss in the resized world is below the
+    # cold-start loss of the original world (state survived the reshard)
+    cold = _losses((tmp_path / "w0.log").read_text())[0]
+    assert cold[0] == 1
+    post = [l for s, l in _losses(w2)]
+    assert post and max(post) < cold[1], (cold, post)
     _assert_exactly_once(coord_server.client(), 4 * SHARDS)
