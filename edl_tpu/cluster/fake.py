@@ -130,6 +130,7 @@ class FakeCluster(Cluster):
                 nodes.nodes_cpu_idle_milli[n.name] = n.cpu_milli
                 nodes.nodes_memory_free_mega[n.name] = n.memory_mega
                 nodes.nodes_tpu_free[n.name] = n.tpu_chips
+                nodes.nodes_ici_domain[n.name] = n.ici_domain
             for p in self._pods.values():
                 if p.phase in (PodPhase.SUCCEEDED, PodPhase.FAILED):
                     continue  # terminal pods hold nothing (cluster.go:202-210)
@@ -143,6 +144,11 @@ class FakeCluster(Cluster):
                     nodes.nodes_cpu_idle_milli[p.node] -= p.cpu_request_milli
                     nodes.nodes_memory_free_mega[p.node] -= p.memory_request_mega
                     nodes.nodes_tpu_free[p.node] -= p.tpu_limit
+                if p.tpu_limit > 0 and p.job_uid and p.node in self._nodes:
+                    # chip pods pin their job to the domain they run in —
+                    # the planner must keep growing the mesh there
+                    r.jobs_ici_domain.setdefault(
+                        p.job_uid, self._nodes[p.node].ici_domain)
             r.nodes = nodes
             return r
 

@@ -20,18 +20,25 @@ from dataclasses import dataclass, field
 @dataclass
 class NodeResources:
     """Per-node idle/free maps — reference pkg/cluster.go:56-61 (``Nodes``),
-    plus TPU chip-freeness per node."""
+    plus TPU chip-freeness and ICI-domain membership per node."""
 
     nodes_cpu_idle_milli: dict[str, int] = field(default_factory=dict)
     nodes_memory_free_mega: dict[str, int] = field(default_factory=dict)
     nodes_tpu_free: dict[str, int] = field(default_factory=dict)
+    #: node → ICI domain (hosts wired into one ICI fabric).  A node absent
+    #: here is its own domain: a single-host mesh is always ICI-local.
+    nodes_ici_domain: dict[str, str] = field(default_factory=dict)
 
     def copy(self) -> "NodeResources":
         return NodeResources(
             dict(self.nodes_cpu_idle_milli),
             dict(self.nodes_memory_free_mega),
             dict(self.nodes_tpu_free),
+            dict(self.nodes_ici_domain),
         )
+
+    def domain_of(self, node: str) -> str:
+        return self.nodes_ici_domain.get(node) or node
 
 
 @dataclass
@@ -56,11 +63,20 @@ class ClusterResource:
 
     nodes: NodeResources = field(default_factory=NodeResources)
 
+    #: job uid → the ICI domain its running chip pods occupy.  Written by
+    #: ``inquiry_resource`` (from live pods) and by the planner's dry run
+    #: (pinning the domain it chose, so later fixpoint rounds keep growing
+    #: the job in the same fabric instead of re-choosing per round).
+    jobs_ici_domain: dict[str, str] = field(default_factory=dict)
+
     def copy(self) -> "ClusterResource":
         """Value-semantics copy handed to the dry-run planner
         (role of Go's pass-by-value at reference pkg/autoscaler.go:296)."""
-        c = ClusterResource(**{k: v for k, v in self.__dict__.items() if k != "nodes"})
+        c = ClusterResource(**{
+            k: v for k, v in self.__dict__.items()
+            if k not in ("nodes", "jobs_ici_domain")})
         c.nodes = self.nodes.copy()
+        c.jobs_ici_domain = dict(self.jobs_ici_domain)
         return c
 
     def utilization(self) -> float:

@@ -108,39 +108,83 @@ def need_tpu(j: PlannedJob) -> bool:
 
 def search_assignable_nodes(
     r: ClusterResource, j: PlannedJob, count: int
-) -> Optional[list[str]]:
+) -> Optional[tuple[list[str], Optional[str]]]:
     """Find nodes with headroom for ``count`` more instances of ``j``
     (generalizes searchAssignableNode, reference autoscaler.go:191-199).
 
     Greedy: instances may land on the same node while it has headroom.
-    Returns the chosen node per instance, or None if any instance does not
-    fit.  Does NOT mutate ``r``.
+    Returns ``(chosen_node_per_instance, ici_domain)`` or None if the
+    instances do not fit.  Does NOT mutate ``r``.
+
+    ICI contiguity (the TPU extension the reference had no need for): a
+    chip job's mesh must ride ICI, so every chip instance — existing and
+    planned — must live in ONE ICI domain.  A job already running (or
+    already grown in an earlier fixpoint round — ``r.jobs_ici_domain``)
+    is pinned to its domain; an unpinned job considers each domain whole,
+    preferring the one with the most free chips (best packing headroom),
+    name-tiebroken for determinism.  The kubelet enforces the same rule at
+    placement time (cluster/fake.py), so a plan accepted here can never
+    strand Pending pods on a domain boundary.
     """
     cpu = j.cpu_request_milli()
     mem = j.mem_request_mega()
     chips = j.tpu_chip_limit()
-    idle_cpu = dict(r.nodes.nodes_cpu_idle_milli)
-    free_mem = dict(r.nodes.nodes_memory_free_mega)
-    free_tpu = dict(r.nodes.nodes_tpu_free)
-    chosen: list[str] = []
-    for _ in range(count):
-        placed = False
-        for name, idle in idle_cpu.items():
-            if cpu <= idle and mem <= free_mem.get(name, 0):
-                # Chip-aware placement: only enforced when the snapshot
-                # tracks chips for this node (reference tracked CPU/mem only).
-                if chips and name in free_tpu and free_tpu[name] < chips:
-                    continue
-                idle_cpu[name] = idle - cpu
-                free_mem[name] -= mem
-                if name in free_tpu:
-                    free_tpu[name] -= chips
-                chosen.append(name)
-                placed = True
-                break
-        if not placed:
-            return None
-    return chosen
+
+    def try_nodes(allowed: Optional[list[str]]) -> Optional[list[str]]:
+        # copy/scan only the candidate nodes: on a fleet of single-host
+        # domains an unpinned job tries many domains, and full-cluster
+        # copies per attempt would make this O(domains x nodes)
+        names = (r.nodes.nodes_cpu_idle_milli if allowed is None
+                 else allowed)
+        idle_cpu = {n: r.nodes.nodes_cpu_idle_milli[n] for n in names}
+        free_mem = {n: r.nodes.nodes_memory_free_mega.get(n, 0)
+                    for n in names}
+        free_tpu = {n: r.nodes.nodes_tpu_free[n] for n in names
+                    if n in r.nodes.nodes_tpu_free}
+        chosen: list[str] = []
+        for _ in range(count):
+            placed = False
+            for name, idle in idle_cpu.items():
+                if cpu <= idle and mem <= free_mem.get(name, 0):
+                    # Chip-aware placement: only enforced when the snapshot
+                    # tracks chips for this node (the reference tracked
+                    # CPU/mem only).
+                    if chips and name in free_tpu and free_tpu[name] < chips:
+                        continue
+                    idle_cpu[name] = idle - cpu
+                    free_mem[name] -= mem
+                    if name in free_tpu:
+                        free_tpu[name] -= chips
+                    chosen.append(name)
+                    placed = True
+                    break
+            if not placed:
+                return None
+        return chosen
+
+    if not chips:
+        nodes = try_nodes(None)
+        return (nodes, None) if nodes is not None else None
+
+    # insertion-ordered node lists keep placement deterministic (the same
+    # snapshot always yields the same plan, the property every planner test
+    # relies on)
+    by_domain: dict[str, list[str]] = {}
+    for name in r.nodes.nodes_cpu_idle_milli:
+        by_domain.setdefault(r.nodes.domain_of(name), []).append(name)
+
+    pinned = r.jobs_ici_domain.get(j.uid)
+    if pinned is not None:
+        candidates = [pinned] if pinned in by_domain else []
+    else:
+        free_chips = lambda d: sum(
+            r.nodes.nodes_tpu_free.get(n, 0) for n in by_domain[d])
+        candidates = sorted(by_domain, key=lambda d: (-free_chips(d), d))
+    for domain in candidates:
+        nodes = try_nodes(by_domain[domain])
+        if nodes is not None:
+            return nodes, domain
+    return None
 
 
 def scale_dry_run(
@@ -165,6 +209,7 @@ def scale_dry_run(
 
     additional = 0
     assigned_nodes: list[str] = []
+    assigned_domain: Optional[str] = None
 
     def account() -> int:
         # Adjust-resource-upon-return block (reference autoscaler.go:209-217).
@@ -176,6 +221,10 @@ def scale_dry_run(
             r.nodes.nodes_memory_free_mega[node] -= mem
             if node in r.nodes.nodes_tpu_free:
                 r.nodes.nodes_tpu_free[node] -= chips
+        if assigned_nodes and assigned_domain is not None:
+            # Pin the dry-run's domain choice so later fixpoint rounds keep
+            # growing this job in the same ICI fabric.
+            r.jobs_ici_domain.setdefault(j.uid, assigned_domain)
         return additional
 
     # ===================== scale down (autoscaler.go:230-248) =============
@@ -215,9 +264,10 @@ def scale_dry_run(
     if r.memory_total_mega - r.memory_request_mega <= mem * step:
         return 0  # insufficient memory headroom (autoscaler.go:259-263)
 
-    nodes = search_assignable_nodes(r, j, step)
-    if nodes is None:
+    found = search_assignable_nodes(r, j, step)
+    if found is None:
         return 0  # no node fits (autoscaler.go:264-267)
+    nodes, domain = found
 
     # CPU is capped at max_load_desired of the cluster; accelerators may be
     # packed to 100% (autoscaler.go:269-278).
@@ -227,6 +277,7 @@ def scale_dry_run(
     if cpu_ok and tpu_ok:
         additional = step
         assigned_nodes = nodes
+        assigned_domain = domain
     return account()
 
 

@@ -376,3 +376,92 @@ def test_two_jobs_share_cluster_fairly():
     diff = scale_all_jobs_dry_run([a, b], r, 1.0)
     assert diff["default/a"] + diff["default/b"] == 4  # all 6 CPUs in use
     assert abs((1 + diff["default/a"]) - (1 + diff["default/b"])) <= 1
+
+
+# -- ICI-domain contiguity (TPU extension; VERDICT r1 #5) --------------------
+#
+# A chip job's mesh must ride ICI, so the planner may never plan instances
+# of one job across ICI domains — previously only the fake kubelet enforced
+# this (post-plan, stranding the overflow Pending).
+
+
+def two_domain_cluster():
+    """Two ICI domains of 2 nodes x 2 chips each (4 chips per domain)."""
+    nodes = NodeResources(
+        nodes_cpu_idle_milli={n: 8000 for n in ("a0", "a1", "b0", "b1")},
+        nodes_memory_free_mega={n: 16000 for n in ("a0", "a1", "b0", "b1")},
+        nodes_tpu_free={n: 2 for n in ("a0", "a1", "b0", "b1")},
+        nodes_ici_domain={"a0": "A", "a1": "A", "b0": "B", "b1": "B"},
+    )
+    return ClusterResource(
+        cpu_total_milli=32_000, memory_total_mega=64_000, tpu_total=8,
+        nodes=nodes,
+    )
+
+
+def test_planner_caps_chip_job_at_one_ici_domain():
+    # 2 chips per trainer, wants up to 4 trainers (8 chips) — but one domain
+    # holds only 4 chips: the plan must stop at 2 trainers, not split 2+2
+    # across domains for the kubelet to strand.
+    j = make_job("j", "1", "1", "1Mi", "1Mi", "2", 0, 4, 0)
+    diff = scale_all_jobs_dry_run([j], two_domain_cluster(), 1.0)
+    assert diff["default/j"] == 2
+
+
+def test_planner_respects_existing_domain_pin():
+    # The job already runs a chip pod in domain B: growth stays in B even
+    # though A has equal headroom.
+    r = two_domain_cluster()
+    r.jobs_ici_domain["default/j"] = "B"
+    r.nodes.nodes_tpu_free["b1"] = 0  # b1 chips already in use elsewhere
+    r.tpu_limit = 2
+    j = make_job("j", "1", "1", "1Mi", "1Mi", "2", 0, 4, 0)
+    diff = scale_all_jobs_dry_run([j], r, 1.0)
+    assert diff["default/j"] == 1  # only b0's 2 chips remain in domain B
+
+
+def test_planner_prefers_roomier_domain():
+    # Unpinned job, domain A has 2 free chips, domain B has 4: the single
+    # +1 step (2 chips) must land in B so a later step can still grow there.
+    r = two_domain_cluster()
+    r.nodes.nodes_tpu_free["a0"] = 0
+    r.nodes.nodes_tpu_free["a1"] = 0
+    r.tpu_limit = 4
+    j = make_job("j", "1", "1", "1Mi", "1Mi", "2", 0, 2, 0)
+    diff = scale_all_jobs_dry_run([j], r, 1.0)
+    assert diff["default/j"] == 2
+    assert r.jobs_ici_domain == {}  # dry-run pins only its own copy
+
+
+def test_two_chip_jobs_land_in_distinct_domains():
+    # Two jobs of 2x2-chip trainers: each fills one whole domain; neither
+    # spans, and together they pack the cluster to 100%.
+    a = make_job("a", "1", "1", "1Mi", "1Mi", "2", 0, 2, 0)
+    b = make_job("b", "1", "1", "1Mi", "1Mi", "2", 0, 2, 0)
+    r = two_domain_cluster()
+    diff = scale_all_jobs_dry_run([a, b], r, 1.0)
+    assert diff["default/a"] == 2 and diff["default/b"] == 2
+    assert r.tpu_total == 8
+
+
+def test_planner_and_fake_kubelet_agree_on_domains():
+    # End-to-end agreement: actuating the domain-aware plan on the fake
+    # cluster leaves NO pod stranded Pending on a domain boundary.
+    from edl_tpu.cluster.fake import FakeCluster
+
+    cluster = FakeCluster()
+    for name, dom in (("a0", "A"), ("a1", "A"), ("b0", "B"), ("b1", "B")):
+        cluster.add_node(name, cpu_milli=8000, memory_mega=16000,
+                         tpu_chips=2, ici_domain=dom)
+    j = make_job("j", "1", "1", "1Mi", "1Mi", "2", 1, 4, 1)
+    cluster.create_resources(j.config)
+    cluster.reconcile()
+    r = cluster.inquiry_resource()
+    assert r.jobs_ici_domain  # the running chip pod pinned its domain
+    diff = scale_all_jobs_dry_run([j], r, 1.0)
+    target = j.parallelism + diff["default/j"]
+    assert target == 2  # one domain's 4 chips = 2 trainers
+    cluster.update_trainer_parallelism(j.config, target)
+    cluster.reconcile()
+    counts = cluster.job_pods(j.config)
+    assert counts.pending == 0 and counts.running == target
