@@ -19,7 +19,7 @@ from __future__ import annotations
 from dataclasses import dataclass
 
 from edl_tpu.api.types import RESOURCE_TPU, TrainingJob
-from edl_tpu.cluster.base import Cluster, PodCounts, PodPhase
+from edl_tpu.cluster.base import Cluster, ConflictError, PodCounts, PodPhase
 from edl_tpu.cluster.resource import ClusterResource, NodeResources
 
 
@@ -37,7 +37,7 @@ class PodView:
     memory_request_mega: int = 0
     tpu_limit: int = 0
 
-try:  # pragma: no cover - not installed in the build image
+try:
     import kubernetes  # type: ignore
 
     _HAVE_K8S = True
@@ -47,6 +47,15 @@ except ImportError:
 #: label selecting a job's trainer pods (role of ``paddle-job=<name>``,
 #: reference pkg/cluster.go:119).
 TRAINER_LABEL = "edl-tpu-job"
+
+#: Node labels that identify the ICI fabric a TPU node belongs to, in
+#: preference order.  On GKE every node of a multi-host slice carries the
+#: slice's topology labels; nodes without any of these are their own domain
+#: (single-host ICI).
+ICI_DOMAIN_LABELS = (
+    "edl-tpu/ici-domain",
+    "cloud.google.com/gke-tpu-slice",  # nodepool slice identity
+)
 
 
 class K8sCluster(Cluster):
@@ -59,18 +68,18 @@ class K8sCluster(Cluster):
                 "image does not include it — use FakeCluster, or install "
                 "kubernetes in a deployment image"
             )
-        if kubeconfig:  # pragma: no cover
+        if kubeconfig:
             kubernetes.config.load_kube_config(kubeconfig)
-        else:  # pragma: no cover
+        else:
             kubernetes.config.load_incluster_config()
-        self._core = kubernetes.client.CoreV1Api()  # pragma: no cover
-        self._batch = kubernetes.client.BatchV1Api()  # pragma: no cover
-        self.namespace = namespace  # pragma: no cover
+        self._core = kubernetes.client.CoreV1Api()
+        self._batch = kubernetes.client.BatchV1Api()
+        self.namespace = namespace
 
     # The method bodies below mirror reference pkg/cluster.go behavior and
     # only run with the kubernetes package present.
 
-    def inquiry_resource(self) -> ClusterResource:  # pragma: no cover
+    def inquiry_resource(self) -> ClusterResource:
         r = ClusterResource()
         nodes = NodeResources()
         for node in self._core.list_node().items:
@@ -85,6 +94,11 @@ class K8sCluster(Cluster):
             nodes.nodes_cpu_idle_milli[node.metadata.name] = cpu
             nodes.nodes_memory_free_mega[node.metadata.name] = mem
             nodes.nodes_tpu_free[node.metadata.name] = tpu
+            labels = node.metadata.labels or {}
+            for key in ICI_DOMAIN_LABELS:
+                if labels.get(key):
+                    nodes.nodes_ici_domain[node.metadata.name] = labels[key]
+                    break
         # all non-terminal pods hold their requests (cluster.go:202-242)
         pods = self._core.list_pod_for_all_namespaces(
             field_selector="status.phase!=Succeeded,status.phase!=Failed"
@@ -102,20 +116,41 @@ class K8sCluster(Cluster):
                 nodes.nodes_cpu_idle_milli[nn] -= creq
                 nodes.nodes_memory_free_mega[nn] -= mreq
                 nodes.nodes_tpu_free[nn] -= tl
+            labels = pod.metadata.labels or {}
+            # Pin only to LIVE nodes: a non-terminal pod lingering on a
+            # deleted/drained node must not pin its job to a domain that no
+            # longer exists (the planner would find no candidate nodes and
+            # freeze the job's scale-up until the stale pod is reaped).
+            if (tl > 0 and TRAINER_LABEL in labels
+                    and nn in nodes.nodes_cpu_idle_milli):
+                uid = f"{pod.metadata.namespace}/{labels[TRAINER_LABEL]}"
+                r.jobs_ici_domain.setdefault(
+                    uid, nodes.nodes_ici_domain.get(nn, nn))
         r.nodes = nodes
         return r
 
-    def get_trainer_parallelism(self, job: TrainingJob) -> int:  # pragma: no cover
+    def get_trainer_parallelism(self, job: TrainingJob) -> int:
         tj = self._batch.read_namespaced_job(_trainer_name(job), job.namespace)
         return int(tj.spec.parallelism or 0)
 
     def update_trainer_parallelism(self, job: TrainingJob, parallelism: int
-                                   ) -> None:  # pragma: no cover
-        tj = self._batch.read_namespaced_job(_trainer_name(job), job.namespace)
+                                   ) -> None:
+        """Fresh-read then replace; a 409 (stale resourceVersion — someone
+        wrote between our read and replace) surfaces as ConflictError so the
+        autoscaler's bounded retry re-reads and tries again (reference
+        autoscaler.go:339-376 does the same 5-retry refresh-then-write)."""
+        name = _trainer_name(job)
+        tj = self._batch.read_namespaced_job(name, job.namespace)
         tj.spec.parallelism = parallelism
-        self._batch.replace_namespaced_job(_trainer_name(job), job.namespace, tj)
+        try:
+            self._batch.replace_namespaced_job(name, job.namespace, tj)
+        except kubernetes.client.exceptions.ApiException as exc:
+            if exc.status == 409:
+                raise ConflictError(
+                    f"resourceVersion conflict updating {name}") from exc
+            raise
 
-    def job_pods(self, job: TrainingJob) -> PodCounts:  # pragma: no cover
+    def job_pods(self, job: TrainingJob) -> PodCounts:
         sel = f"{TRAINER_LABEL}={job.name}"
         total = running = pending = succeeded = failed = 0
         for pod in self._core.list_namespaced_pod(
@@ -134,7 +169,7 @@ class K8sCluster(Cluster):
                 failed += 1
         return PodCounts(total, running, pending, succeeded, failed)
 
-    def create_resources(self, job: TrainingJob) -> None:  # pragma: no cover
+    def create_resources(self, job: TrainingJob) -> None:
         from edl_tpu.controller.jobparser import parse_to_manifests
 
         apps = kubernetes.client.AppsV1Api()
@@ -146,7 +181,7 @@ class K8sCluster(Cluster):
             elif manifest["kind"] == "Service":
                 self._core.create_namespaced_service(job.namespace, manifest)
 
-    def list_training_jobs(self) -> list[str]:  # pragma: no cover
+    def list_training_jobs(self) -> list[str]:
         """Names of jobs with a trainer group in this namespace (role of
         the TrainingJob list the reference's del_jobs.sh iterates)."""
         names = []
@@ -156,7 +191,7 @@ class K8sCluster(Cluster):
                 names.append(labels[TRAINER_LABEL])
         return sorted(set(names))
 
-    def delete_resources(self, job: TrainingJob) -> None:  # pragma: no cover
+    def delete_resources(self, job: TrainingJob) -> None:
         apps = kubernetes.client.AppsV1Api()
         for rs in (f"{job.name}-coordinator", f"{job.name}-pserver"):
             try:
@@ -182,7 +217,7 @@ class K8sCluster(Cluster):
                 raise
 
     def list_pods(self, job_uid: str | None = None, role: str | None = None
-                  ) -> list["PodView"]:  # pragma: no cover
+                  ) -> list["PodView"]:
         """Pods as lightweight records with the FakePod attribute surface
         (what the Collector and PodDiscovery consume)."""
         out = []
@@ -240,19 +275,19 @@ def _trainer_name(job: TrainingJob) -> str:
     return f"{job.name}-trainer"
 
 
-def _milli(q: str) -> int:  # pragma: no cover
+def _milli(q: str) -> int:
     from edl_tpu.api.quantity import Quantity
 
     return Quantity(q).milli_value()
 
 
-def _mega(q: str) -> int:  # pragma: no cover
+def _mega(q: str) -> int:
     from edl_tpu.api.quantity import Quantity
 
     return Quantity(q).scaled_value(6)
 
 
-def _pod_resources(pod):  # pragma: no cover
+def _pod_resources(pod):
     creq = cl = mreq = ml = tl = 0
     containers = list(pod.spec.containers or []) + list(
         pod.spec.init_containers or []

@@ -1,0 +1,210 @@
+"""In-memory stub of the ``kubernetes`` client package.
+
+Role of the reference's generated fake clientset
+(reference pkg/client/clientset/versioned/fake/fake_trainingjob.go:29-124):
+an object-tracker-backed API surface so the real :class:`K8sCluster` method
+bodies execute in tests without an apiserver.  The stub models exactly what
+those bodies touch — typed nodes/pods with attribute access, batch Jobs with
+resourceVersion semantics (including 409 on stale replaces), ReplicaSets and
+Services — plus a conflict-injection hook for the autoscaler's retry path.
+
+Install with :func:`install` (returns the shared state) and pass
+``sys.modules`` patching to the ``stub_kubernetes`` fixture in
+tests/test_k8s_cluster.py; nothing here imports edl_tpu.
+"""
+
+from __future__ import annotations
+
+import copy
+import types
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+
+class ApiException(Exception):
+    def __init__(self, status: int, reason: str = ""):
+        super().__init__(f"({status}) {reason}")
+        self.status = status
+        self.reason = reason
+
+
+class _Obj:
+    """Attribute bag with dict-style construction (role of the kubernetes
+    client's typed models, which the real code reads via attributes)."""
+
+    def __init__(self, **kw: Any):
+        for k, v in kw.items():
+            setattr(self, k, v)
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"_Obj({self.__dict__!r})"
+
+
+def make_node(name: str, cpu: str = "8", memory: str = "16Gi",
+              tpu: int = 0, labels: Optional[dict] = None) -> _Obj:
+    alloc = {"cpu": cpu, "memory": memory}
+    if tpu:
+        alloc["google.com/tpu"] = str(tpu)
+    return _Obj(
+        metadata=_Obj(name=name, labels=dict(labels or {})),
+        status=_Obj(allocatable=alloc),
+    )
+
+
+def make_pod(name: str, namespace: str = "default", phase: str = "Running",
+             node: Optional[str] = None, labels: Optional[dict] = None,
+             cpu: str = "0", memory: str = "0", tpu: int = 0,
+             terminating: bool = False) -> _Obj:
+    limits = {"cpu": cpu, "memory": memory}
+    if tpu:
+        limits["google.com/tpu"] = str(tpu)
+    container = _Obj(resources=_Obj(
+        requests={"cpu": cpu, "memory": memory}, limits=limits))
+    return _Obj(
+        metadata=_Obj(name=name, namespace=namespace,
+                      labels=dict(labels or {}),
+                      deletion_timestamp=("now" if terminating else None)),
+        spec=_Obj(node_name=node, containers=[container],
+                  init_containers=[]),
+        status=_Obj(phase=phase),
+    )
+
+
+@dataclass
+class StubState:
+    """The 'etcd' behind the stub apiserver."""
+
+    nodes: list = field(default_factory=list)
+    pods: list = field(default_factory=list)
+    #: (namespace, name) → Job object (spec.parallelism,
+    #: metadata.resource_version as int, metadata.labels)
+    jobs: dict = field(default_factory=dict)
+    replicasets: dict = field(default_factory=dict)
+    services: dict = field(default_factory=dict)
+    #: next N replace_namespaced_job calls fail 409 (concurrent-writer
+    #: simulation for the ConflictError mapping test)
+    conflicts_to_inject: int = 0
+
+    # mutation helpers the real apiserver would do itself
+    def put_job(self, namespace: str, name: str, parallelism: int,
+                labels: Optional[dict] = None) -> None:
+        self.jobs[(namespace, name)] = _Obj(
+            metadata=_Obj(name=name, namespace=namespace,
+                          labels=dict(labels or {}), resource_version=1),
+            spec=_Obj(parallelism=parallelism),
+        )
+
+
+class _CoreV1Api:
+    def __init__(self, state: StubState):
+        self._s = state
+
+    def list_node(self):
+        return _Obj(items=list(self._s.nodes))
+
+    def list_pod_for_all_namespaces(self, field_selector: str = ""):
+        items = self._s.pods
+        if "status.phase!=Succeeded" in (field_selector or ""):
+            items = [p for p in items
+                     if p.status.phase not in ("Succeeded", "Failed")]
+        return _Obj(items=list(items))
+
+    def list_namespaced_pod(self, namespace: str,
+                            label_selector: Optional[str] = None):
+        items = [p for p in self._s.pods if p.metadata.namespace == namespace]
+        if label_selector:
+            key, _, value = label_selector.partition("=")
+            items = [p for p in items
+                     if (p.metadata.labels or {}).get(key) == value
+                     or (not value and key in (p.metadata.labels or {}))]
+        return _Obj(items=items)
+
+    def create_namespaced_service(self, namespace: str, manifest: dict):
+        self._s.services[(namespace, manifest["metadata"]["name"])] = manifest
+
+    def delete_namespaced_service(self, name: str, namespace: str):
+        if (namespace, name) not in self._s.services:
+            raise ApiException(404, f"service {name}")
+        del self._s.services[(namespace, name)]
+
+
+class _BatchV1Api:
+    def __init__(self, state: StubState):
+        self._s = state
+
+    def _get(self, namespace: str, name: str) -> _Obj:
+        try:
+            return self._s.jobs[(namespace, name)]
+        except KeyError:
+            raise ApiException(404, f"job {name}") from None
+
+    def read_namespaced_job(self, name: str, namespace: str) -> _Obj:
+        # a fresh copy each read: mutating the returned object must not
+        # write through to the 'server' (the real client deserializes)
+        return copy.deepcopy(self._get(namespace, name))
+
+    def replace_namespaced_job(self, name: str, namespace: str, body: _Obj):
+        if self._s.conflicts_to_inject > 0:
+            self._s.conflicts_to_inject -= 1
+            # a concurrent writer bumped the version since our read
+            cur = self._get(namespace, name)
+            cur.metadata.resource_version += 1
+            raise ApiException(409, "resourceVersion conflict")
+        cur = self._get(namespace, name)
+        if body.metadata.resource_version != cur.metadata.resource_version:
+            raise ApiException(409, "resourceVersion conflict")
+        body = copy.deepcopy(body)
+        body.metadata.resource_version += 1
+        self._s.jobs[(namespace, name)] = body
+
+    def create_namespaced_job(self, namespace: str, manifest: dict):
+        name = manifest["metadata"]["name"]
+        if (namespace, name) in self._s.jobs:
+            raise ApiException(409, f"job {name} exists")
+        self._s.put_job(namespace, name,
+                        manifest["spec"].get("parallelism", 0),
+                        manifest["metadata"].get("labels"))
+
+    def list_namespaced_job(self, namespace: str):
+        return _Obj(items=[j for (ns, _), j in self._s.jobs.items()
+                           if ns == namespace])
+
+    def delete_namespaced_job(self, name: str, namespace: str,
+                              propagation_policy: str = ""):
+        if (namespace, name) not in self._s.jobs:
+            raise ApiException(404, f"job {name}")
+        del self._s.jobs[(namespace, name)]
+
+
+class _AppsV1Api:
+    def __init__(self, state: StubState):
+        self._s = state
+
+    def create_namespaced_replica_set(self, namespace: str, manifest: dict):
+        self._s.replicasets[(namespace, manifest["metadata"]["name"])] = manifest
+
+    def delete_namespaced_replica_set(self, name: str, namespace: str,
+                                      propagation_policy: str = ""):
+        if (namespace, name) not in self._s.replicasets:
+            raise ApiException(404, f"replicaset {name}")
+        del self._s.replicasets[(namespace, name)]
+
+
+def build_module(state: StubState) -> types.ModuleType:
+    """A module object that satisfies every ``kubernetes.*`` attribute
+    K8sCluster touches."""
+    kubernetes = types.ModuleType("kubernetes")
+    client = types.ModuleType("kubernetes.client")
+    config = types.ModuleType("kubernetes.config")
+    exceptions = types.ModuleType("kubernetes.client.exceptions")
+
+    exceptions.ApiException = ApiException
+    client.exceptions = exceptions
+    client.CoreV1Api = lambda: _CoreV1Api(state)
+    client.BatchV1Api = lambda: _BatchV1Api(state)
+    client.AppsV1Api = lambda: _AppsV1Api(state)
+    config.load_kube_config = lambda *_a, **_k: None
+    config.load_incluster_config = lambda: None
+    kubernetes.client = client
+    kubernetes.config = config
+    return kubernetes
