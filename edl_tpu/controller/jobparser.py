@@ -144,9 +144,25 @@ def parse_to_coordinator(job: TrainingJob) -> dict[str, Any]:
                             "env": [
                                 {"name": k, "value": v}
                                 for k, v in pod_env(job, "coordinator").items()
+                            ] + [
+                                # durability across pod restarts (role of
+                                # the reference's etcd sidecar persistence,
+                                # pkg/jobparser.go:167-184): write-through
+                                # state on the pod volume; swap the
+                                # emptyDir for a PVC to also survive node
+                                # loss
+                                {"name": "EDL_COORD_STATE_FILE",
+                                 "value": "/var/edl-coord/state"},
+                            ],
+                            "volumeMounts": [
+                                {"name": "coord-state",
+                                 "mountPath": "/var/edl-coord"},
                             ],
                             "resources": _resources_dict(spec.master.resources),
                         }
+                    ],
+                    "volumes": [
+                        {"name": "coord-state", "emptyDir": {}},
                     ],
                 },
             },

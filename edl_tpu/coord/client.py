@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import socket
 import threading
+import time
 from typing import Optional
 
 from edl_tpu.coord.service import (
@@ -21,12 +22,38 @@ class CoordError(RuntimeError):
 
 
 class CoordClient:
-    def __init__(self, host: str, port: int, timeout: float = 10.0) -> None:
+    """``reconnect_window_s`` bounds how long a call rides out a
+    coordinator restart: on a broken connection the client redials and
+    retries until the window lapses.  Safe because every protocol command
+    composes with at-least-once delivery — a request that executed but
+    whose response was lost behaves like a lease that timed out (the
+    durable server persists BEFORE acking, so an acked op is never lost,
+    and an unacked op is retried or re-dispatched)."""
+
+    def __init__(self, host: str, port: int, timeout: float = 10.0,
+                 reconnect_window_s: float = 20.0) -> None:
         self.host = host
         self.port = port
         self.timeout = timeout
+        self.reconnect_window_s = reconnect_window_s
         self._lock = threading.Lock()
-        self._sock = socket.create_connection((host, port), timeout=timeout)
+        # The FIRST dial also rides the window: clients are routinely
+        # (un)pickled into fresh processes during the elastic dance, and a
+        # world child spawned while the coordinator pod restarts must not
+        # die on ConnectionRefused when a 2 s wait would have connected.
+        deadline = time.monotonic() + max(self.reconnect_window_s, 0.0)
+        while True:
+            try:
+                self._connect()
+                break
+            except OSError:
+                if time.monotonic() >= deadline:
+                    raise
+                time.sleep(0.3)
+
+    def _connect(self) -> None:
+        self._sock = socket.create_connection((self.host, self.port),
+                                              timeout=self.timeout)
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._rfile = self._sock.makefile("rb")
 
@@ -35,10 +62,11 @@ class CoordClient:
     # per-world child processes (runtime.multihost) — sockets can't cross
     # a process boundary, addresses can.
     def __getstate__(self) -> dict:
-        return {"host": self.host, "port": self.port, "timeout": self.timeout}
+        return {"host": self.host, "port": self.port, "timeout": self.timeout,
+                "reconnect_window_s": self.reconnect_window_s}
 
     def __setstate__(self, state: dict) -> None:
-        self.__init__(state["host"], state["port"], state["timeout"])
+        self.__init__(**state)
 
     def close(self) -> None:
         try:
@@ -50,11 +78,24 @@ class CoordClient:
     def _call(self, *parts: str) -> list[str]:
         line = (" ".join(parts) + "\n").encode()
         with self._lock:
-            self._sock.sendall(line)
-            resp = self._rfile.readline()
-        if not resp:
-            raise CoordError("coordination server closed the connection")
-        return resp.decode().strip().split(" ")
+            deadline = time.monotonic() + self.reconnect_window_s
+            while True:
+                try:
+                    self._sock.sendall(line)
+                    resp = self._rfile.readline()
+                    if not resp:
+                        raise CoordError(
+                            "coordination server closed the connection")
+                    return resp.decode().strip().split(" ")
+                except (OSError, CoordError):
+                    if time.monotonic() >= deadline:
+                        raise
+                    time.sleep(0.3)
+                    try:
+                        self.close()
+                        self._connect()
+                    except OSError:
+                        pass  # server still down; keep retrying
 
     # -- task queue --------------------------------------------------------
 
@@ -152,8 +193,17 @@ class CoordClient:
         return self._call("KVDEL", key)[0] == "OK"
 
     def kv_cas(self, key: str, expect: bytes, value: bytes) -> bool:
+        """CAS with retry-safe claim semantics.  A CAS that executed but
+        whose ack was lost (coordinator crash in the ack window) reports
+        FAIL when the reconnect loop re-sends it — the key now holds our
+        own value, so the plain response would tell the rightful winner it
+        lost (and e.g. no one would seed the data queue).  Every CAS in
+        the protocol is a claim with a claimant-unique value (worker names,
+        endpoints), so 'current value == ours' is exactly 'we won'."""
         exp = expect.hex() if expect else "-"
-        return self._call("KVCAS", key, exp, value.hex() or "-")[0] == "OK"
+        if self._call("KVCAS", key, exp, value.hex() or "-")[0] == "OK":
+            return True
+        return self.kv_get(key) == value
 
     def kv_keys(self, prefix: str = "") -> list[str]:
         r = self._call("KEYS", prefix) if prefix else self._call("KEYS")

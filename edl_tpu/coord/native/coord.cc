@@ -2,9 +2,47 @@
 
 #include "coord.hpp"
 
+#include <unistd.h>
+
 #include <algorithm>
+#include <cstdio>
+#include <sstream>
 
 namespace edlcoord {
+
+std::string HexEncode(const std::string& in) {
+  static const char* d = "0123456789abcdef";
+  std::string out;
+  out.reserve(in.size() * 2);
+  for (unsigned char c : in) {
+    out += d[c >> 4];
+    out += d[c & 0xf];
+  }
+  return out;
+}
+
+namespace {
+
+int HexVal(char c) {
+  if (c >= '0' && c <= '9') return c - '0';
+  if (c >= 'a' && c <= 'f') return c - 'a' + 10;
+  if (c >= 'A' && c <= 'F') return c - 'A' + 10;
+  return -1;
+}
+
+}  // namespace
+
+bool HexDecode(const std::string& in, std::string* out) {
+  if (in.size() % 2 != 0) return false;
+  out->clear();
+  out->reserve(in.size() / 2);
+  for (size_t i = 0; i < in.size(); i += 2) {
+    int hi = HexVal(in[i]), lo = HexVal(in[i + 1]);
+    if (hi < 0 || lo < 0) return false;
+    out->push_back(static_cast<char>((hi << 4) | lo));
+  }
+  return true;
+}
 
 // ---------------------------------------------------------------- TaskQueue
 
@@ -169,6 +207,59 @@ void TaskQueue::Stats(int64_t* todo, int64_t* leased, int64_t* done,
   *dropped = dropped_;
 }
 
+void TaskQueue::SerializeTo(std::string* out) const {
+  std::lock_guard<std::mutex> lock(mu_);
+  *out += "Q " + std::to_string(pass_) + " " + std::to_string(next_id_) +
+          " " + std::to_string(dropped_) + "\n";
+  // todo + leased serialize as one id-sorted T section: a restarted
+  // coordinator does not know which workers still live, so leased tasks
+  // come back as todo and re-dispatch (at-least-once, the lease-timeout
+  // contract).  Sorting by id makes the snapshot insensitive to HOW work
+  // is currently split between todo and leases — a LEASE/RENEW/RELEASE
+  // leaves the serialized form byte-identical, keeping the hot dispatch
+  // path free of disk writes (the server persists on content change).
+  std::vector<const Task*> pending;
+  pending.reserve(todo_.size() + leased_.size());
+  for (const auto& t : todo_) pending.push_back(&t);
+  for (const auto& kv : leased_) pending.push_back(&kv.second.task);
+  std::sort(pending.begin(), pending.end(),
+            [](const Task* a, const Task* b) { return a->id < b->id; });
+  for (const Task* t : pending)
+    *out += "T " + std::to_string(t->id) + " " + std::to_string(t->failures) +
+            " " + HexEncode(t->payload) + "\n";
+  for (const auto& t : done_)
+    *out += "D " + std::to_string(t.id) + " " + std::to_string(t.failures) +
+            " " + HexEncode(t.payload) + "\n";
+}
+
+void TaskQueue::RestoreLine(const std::string& line) {
+  std::istringstream ss(line);
+  std::string tag;
+  ss >> tag;
+  std::lock_guard<std::mutex> lock(mu_);
+  if (tag == "Q") {
+    int pass;
+    int64_t next_id, dropped;
+    ss >> pass >> next_id >> dropped;
+    if (!ss.fail()) {
+      pass_ = pass;
+      next_id_ = next_id;
+      dropped_ = dropped;
+    }
+    return;
+  }
+  if (tag == "T" || tag == "D") {
+    Task t;
+    std::string hex;
+    ss >> t.id >> t.failures >> hex;
+    if (ss.fail() || !HexDecode(hex, &t.payload)) return;
+    if (tag == "T")
+      todo_.push_back(std::move(t));
+    else
+      done_.push_back(std::move(t));
+  }
+}
+
 // --------------------------------------------------------------- Membership
 
 Membership::Membership(int64_t ttl_ms) : ttl_ms_(ttl_ms) {}
@@ -219,6 +310,11 @@ int Membership::Expire(int64_t now_ms) {
 int64_t Membership::Epoch() const {
   std::lock_guard<std::mutex> lock(mu_);
   return epoch_;
+}
+
+void Membership::ForceEpoch(int64_t epoch) {
+  std::lock_guard<std::mutex> lock(mu_);
+  if (epoch > epoch_) epoch_ = epoch;
 }
 
 std::vector<MemberInfo> Membership::Members(int64_t now_ms) {
@@ -273,6 +369,92 @@ std::vector<std::string> KvStore::Keys(const std::string& prefix) const {
   }
   std::sort(out.begin(), out.end());
   return out;
+}
+
+std::vector<std::pair<std::string, std::string>> KvStore::Items() const {
+  std::lock_guard<std::mutex> lock(mu_);
+  std::vector<std::pair<std::string, std::string>> out(kv_.begin(), kv_.end());
+  std::sort(out.begin(), out.end());  // deterministic snapshots
+  return out;
+}
+
+// ------------------------------------------------------------------ Service
+
+std::string Service::Snapshot() const {
+  std::string out = "EDLCOORD1\n";
+  queue.SerializeTo(&out);
+  out += "E " + std::to_string(membership.Epoch()) + "\n";
+  for (const auto& kv : kv.Items())
+    out += "K " + HexEncode(kv.first) + " " + HexEncode(kv.second) + "\n";
+  out += ".\n";
+  return out;
+}
+
+bool Service::Restore(const std::string& blob) {
+  // Validate framing BEFORE applying anything: a truncated blob (crash
+  // mid-write would need to defeat the atomic rename, but be defensive)
+  // must not leave a half-restored service, and a malformed line must
+  // never throw out of here (LoadFrom runs before the server listens — an
+  // exception would crash-loop the coordinator pod on one bad file).
+  if (blob.rfind("EDLCOORD1\n", 0) != 0) return false;
+  if (blob.size() < 13 ||
+      blob.compare(blob.size() - 3, 3, "\n.\n") != 0)
+    return false;  // no terminator: incomplete snapshot
+  std::istringstream ss(blob);
+  std::string line;
+  std::getline(ss, line);  // magic, checked above
+  while (std::getline(ss, line)) {
+    if (line.empty() || line == ".") continue;
+    switch (line[0]) {
+      case 'Q':
+      case 'T':
+      case 'D':
+        queue.RestoreLine(line);
+        break;
+      case 'E': {
+        std::istringstream ls(line);
+        std::string tag;
+        int64_t epoch = 0;
+        ls >> tag >> epoch;
+        if (!ls.fail()) membership.ForceEpoch(epoch);
+        break;
+      }
+      case 'K': {
+        std::istringstream ls(line);
+        std::string tag, hk, hv, k, v;
+        ls >> tag >> hk >> hv;
+        if (HexDecode(hk, &k) && HexDecode(hv, &v)) kv.Set(k, v);
+        break;
+      }
+      default:
+        break;  // forward compatibility: skip unknown sections
+    }
+  }
+  return true;
+}
+
+bool Service::SaveTo(const std::string& path) const {
+  const std::string tmp = path + ".tmp";
+  std::string blob = Snapshot();
+  FILE* f = std::fopen(tmp.c_str(), "wb");
+  if (f == nullptr) return false;
+  bool ok = std::fwrite(blob.data(), 1, blob.size(), f) == blob.size();
+  ok = std::fflush(f) == 0 && ok;
+  ok = (fsync(fileno(f)) == 0) && ok;
+  std::fclose(f);
+  if (!ok) return false;
+  return std::rename(tmp.c_str(), path.c_str()) == 0;
+}
+
+bool Service::LoadFrom(const std::string& path) {
+  FILE* f = std::fopen(path.c_str(), "rb");
+  if (f == nullptr) return false;
+  std::string blob;
+  char buf[4096];
+  size_t n;
+  while ((n = std::fread(buf, 1, sizeof(buf), f)) > 0) blob.append(buf, n);
+  std::fclose(f);
+  return Restore(blob);
 }
 
 }  // namespace edlcoord

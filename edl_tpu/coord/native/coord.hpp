@@ -31,6 +31,11 @@
 
 namespace edlcoord {
 
+// Binary-safe hex framing shared by the wire protocol (server.cc) and the
+// snapshot format (Service::Snapshot) — one codec, one behavior.
+std::string HexEncode(const std::string& in);
+bool HexDecode(const std::string& in, std::string* out);
+
 // Dead-trainer work re-dispatch bound (reference docker/paddle_k8s:30).
 constexpr int64_t kDefaultTaskTimeoutMs = 16000;
 // A task failing this often is dropped (poison-pill guard).
@@ -82,6 +87,16 @@ class TaskQueue {
   void Stats(int64_t* todo, int64_t* leased, int64_t* done,
              int64_t* dropped) const;
 
+  // Durability (the etcd-sidecar role, reference pkg/jobparser.go:167-184):
+  // append this queue's section to a snapshot / restore it.  Leased tasks
+  // serialize as todo — after a coordinator restart the lease owners are
+  // unknown, so the tasks re-dispatch (the same at-least-once contract as
+  // the 16 s lease timeout).
+  void SerializeTo(std::string* out) const;
+  // Restore one snapshot line ("Q ..."/"T ..."/"D ..."); unknown tags are
+  // ignored so the format can grow.
+  void RestoreLine(const std::string& line);
+
  private:
   struct Leased {
     Task task;
@@ -125,6 +140,11 @@ class Membership {
   int Expire(int64_t now_ms);
 
   int64_t Epoch() const;
+  // Restore path only: epoch monotonicity must survive a coordinator
+  // restart (state generations are keyed gen = epoch + 1; a reset epoch
+  // would mis-order them).  Members are NOT restored — they re-Join when
+  // their heartbeats bounce, each bumping the epoch further.
+  void ForceEpoch(int64_t epoch);
   // Sorted by name — this order IS the rank assignment for an epoch
   // (replacing the reference's IP-sort ranks, docker/k8s_tools.py:113-121,
   // with an explicit, coordinator-owned ordering).
@@ -148,6 +168,7 @@ class KvStore {
   bool Cas(const std::string& key, const std::string& expect,
            const std::string& value);
   std::vector<std::string> Keys(const std::string& prefix) const;
+  std::vector<std::pair<std::string, std::string>> Items() const;
 
  private:
   mutable std::mutex mu_;
@@ -162,6 +183,17 @@ struct Service {
 
   Service(int64_t task_timeout_ms, int passes, int64_t member_ttl_ms)
       : queue(task_timeout_ms, passes), membership(member_ttl_ms) {}
+
+  // Whole-service snapshot (queue + membership epoch + KV) as a
+  // versioned, binary-safe text blob; Restore applies one.  Used by the
+  // server's write-through persistence so a coordinator pod restart keeps
+  // the job's accounting, checkpoint pointers and epoch ordering — the
+  // role of the reference's etcd sidecar (pkg/jobparser.go:167-184).
+  std::string Snapshot() const;
+  bool Restore(const std::string& blob);
+  // Atomic file write-through (temp + rename) / startup load.
+  bool SaveTo(const std::string& path) const;
+  bool LoadFrom(const std::string& path);
 };
 
 }  // namespace edlcoord

@@ -51,41 +51,58 @@ int64_t g_task_timeout_ms = edlcoord::kDefaultTaskTimeoutMs;
 int g_passes = 1;
 int64_t g_member_ttl_ms = edlcoord::kDefaultMemberTtlMs;
 
+// Write-through durability (role of the reference's etcd sidecar,
+// pkg/jobparser.go:167-184): after every command that may change durable
+// state, snapshot to --state-file if the content differs from the last
+// write.  Lease ownership and heartbeat deadlines are deliberately not
+// durable (the snapshot id-sorts pending tasks, so LEASE/RENEW/RELEASE
+// leave it byte-identical), keeping the hot dispatch path write-free.
+// A failed write degrades to in-memory mode LOUDLY: it cannot un-apply the
+// op, but the operator sees every failure on stderr and the next
+// successful write re-covers the backlog (the snapshot is always total).
+std::string g_state_file;
+std::string g_last_snapshot;
+std::mutex g_persist_mu;
+
+void MaybePersist() {
+  if (g_state_file.empty()) return;
+  std::lock_guard<std::mutex> lock(g_persist_mu);
+  std::string snap = g_service->Snapshot();
+  if (snap == g_last_snapshot) return;
+  if (g_service->SaveTo(g_state_file)) {
+    g_last_snapshot = std::move(snap);
+  } else {
+    std::fprintf(stderr,
+                 "edl-coord: PERSIST FAILED for %s — state is in-memory "
+                 "only until a write succeeds\n",
+                 g_state_file.c_str());
+  }
+}
+
+// Commands whose success can change durable state (queue accounting,
+// KV, membership epoch).  MEMBERS is included because its expiry sweep
+// can bump the epoch.
+bool IsDurableMutation(const std::string& line) {
+  static const char* kPrefixes[] = {"ADD",   "COMPLETE", "FAIL",  "JOIN",
+                                    "LEAVE", "MEMBERS",  "KVSET", "KVDEL",
+                                    "KVCAS"};
+  for (const char* p : kPrefixes) {
+    size_t n = std::strlen(p);
+    if (line.compare(0, n, p) == 0 &&
+        (line.size() == n || line[n] == ' '))
+      return true;
+  }
+  return false;
+}
+
 int64_t NowMs() {
   return std::chrono::duration_cast<std::chrono::milliseconds>(
              std::chrono::steady_clock::now().time_since_epoch())
       .count();
 }
 
-std::string HexEncode(const std::string& in) {
-  static const char* d = "0123456789abcdef";
-  std::string out;
-  out.reserve(in.size() * 2);
-  for (unsigned char c : in) {
-    out += d[c >> 4];
-    out += d[c & 0xf];
-  }
-  return out;
-}
-
-int HexVal(char c) {
-  if (c >= '0' && c <= '9') return c - '0';
-  if (c >= 'a' && c <= 'f') return c - 'a' + 10;
-  if (c >= 'A' && c <= 'F') return c - 'A' + 10;
-  return -1;
-}
-
-bool HexDecode(const std::string& in, std::string* out) {
-  if (in.size() % 2 != 0) return false;
-  out->clear();
-  out->reserve(in.size() / 2);
-  for (size_t i = 0; i < in.size(); i += 2) {
-    int hi = HexVal(in[i]), lo = HexVal(in[i + 1]);
-    if (hi < 0 || lo < 0) return false;
-    out->push_back(static_cast<char>((hi << 4) | lo));
-  }
-  return true;
-}
+using edlcoord::HexDecode;
+using edlcoord::HexEncode;
 
 std::vector<std::string> Split(const std::string& line) {
   std::vector<std::string> out;
@@ -99,11 +116,16 @@ std::string HandleImpl(const std::string& line);
 
 // One bad line must never take down the coordinator for the whole job.
 std::string Handle(const std::string& line) {
+  std::string resp;
   try {
-    return HandleImpl(line);
+    resp = HandleImpl(line);
   } catch (const std::exception& e) {
     return std::string("ERR bad-arg ") + e.what();
   }
+  // Persist BEFORE acking: once a worker sees OK for a COMPLETE or KVSET,
+  // a coordinator restart must not forget it.
+  if (IsDurableMutation(line)) MaybePersist();
+  return resp;
 }
 
 std::string HandleImpl(const std::string& line) {
@@ -242,18 +264,31 @@ int main(int argc, char** argv) {
   int64_t task_timeout_ms = edlcoord::kDefaultTaskTimeoutMs;
   int passes = 1;
   int64_t member_ttl_ms = edlcoord::kDefaultMemberTtlMs;
+  std::string state_file;
   for (int i = 1; i + 1 < argc; i += 2) {
     std::string flag = argv[i];
     if (flag == "--port") port = std::atoi(argv[i + 1]);
     if (flag == "--task-timeout-ms") task_timeout_ms = std::atoll(argv[i + 1]);
     if (flag == "--passes") passes = std::atoi(argv[i + 1]);
     if (flag == "--member-ttl-ms") member_ttl_ms = std::atoll(argv[i + 1]);
+    if (flag == "--state-file") state_file = argv[i + 1];
   }
   signal(SIGPIPE, SIG_IGN);
   g_task_timeout_ms = task_timeout_ms;
   g_passes = passes;
   g_member_ttl_ms = member_ttl_ms;
   g_service = new edlcoord::Service(task_timeout_ms, passes, member_ttl_ms);
+  g_state_file = state_file;
+  bool restored = !state_file.empty() && g_service->LoadFrom(state_file);
+  if (!state_file.empty() && !restored &&
+      access(state_file.c_str(), F_OK) == 0) {
+    // a present-but-unloadable file is a serious event — start fresh (a
+    // crash-loop would be worse: no coordinator at all), but say so
+    std::fprintf(stderr,
+                 "edl-coord: state file %s exists but could not be "
+                 "restored; starting with empty state\n",
+                 state_file.c_str());
+  }
 
   int srv = socket(AF_INET, SOCK_STREAM, 0);
   int one = 1;
@@ -273,7 +308,15 @@ int main(int argc, char** argv) {
   // Report the actually-bound port (supports --port 0 for tests).
   socklen_t alen = sizeof(addr);
   getsockname(srv, reinterpret_cast<sockaddr*>(&addr), &alen);
+  // the listen banner must stay the FIRST line: spawn_server parses it
   std::printf("edl-coord listening on %d\n", ntohs(addr.sin_port));
+  if (restored) {
+    int64_t todo, leased, done, dropped;
+    g_service->queue.Stats(&todo, &leased, &done, &dropped);
+    std::printf("edl-coord restored state: todo=%lld done=%lld epoch=%lld\n",
+                static_cast<long long>(todo), static_cast<long long>(done),
+                static_cast<long long>(g_service->membership.Epoch()));
+  }
   std::fflush(stdout);
 
   for (;;) {

@@ -49,20 +49,26 @@ def spawn_server(
     passes: int = 1,
     member_ttl_ms: int = DEFAULT_MEMBER_TTL_MS,
     startup_timeout: float = 10.0,
+    state_file: str | None = None,
 ) -> ServerHandle:
     """Start edl-coord-server (port 0 = ephemeral) and wait until it
-    reports its listening port."""
+    reports its listening port.  ``state_file`` enables write-through
+    durability: restart the server with the same file and it resumes the
+    job's queue accounting, KV and epoch (the etcd-sidecar role)."""
     if not ensure_built():
         raise RuntimeError("cannot build the native coordination server "
                            "(g++ unavailable?)")
+    cmd = [
+        str(SERVER_PATH),
+        "--port", str(port),
+        "--task-timeout-ms", str(task_timeout_ms),
+        "--passes", str(passes),
+        "--member-ttl-ms", str(member_ttl_ms),
+    ]
+    if state_file:
+        cmd += ["--state-file", str(state_file)]
     proc = subprocess.Popen(
-        [
-            str(SERVER_PATH),
-            "--port", str(port),
-            "--task-timeout-ms", str(task_timeout_ms),
-            "--passes", str(passes),
-            "--member-ttl-ms", str(member_ttl_ms),
-        ],
+        cmd,
         stdout=subprocess.PIPE,
         stderr=subprocess.STDOUT,
     )
@@ -97,20 +103,24 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--passes", type=int,
                     default=int(os.environ.get("EDL_PASSES", "1")))
     ap.add_argument("--member-ttl-ms", type=int, default=DEFAULT_MEMBER_TTL_MS)
+    ap.add_argument("--state-file",
+                    default=os.environ.get("EDL_COORD_STATE_FILE", ""),
+                    help="write-through durability file; restart with the "
+                         "same path to resume the job's coordination state")
     args = ap.parse_args(argv)
     if not ensure_built():
         print("error: cannot build native coord server", file=sys.stderr)
         return 1
-    os.execv(
+    cmd = [
         str(SERVER_PATH),
-        [
-            str(SERVER_PATH),
-            "--port", str(args.port),
-            "--task-timeout-ms", str(args.task_timeout_ms),
-            "--passes", str(args.passes),
-            "--member-ttl-ms", str(args.member_ttl_ms),
-        ],
-    )
+        "--port", str(args.port),
+        "--task-timeout-ms", str(args.task_timeout_ms),
+        "--passes", str(args.passes),
+        "--member-ttl-ms", str(args.member_ttl_ms),
+    ]
+    if args.state_file:
+        cmd += ["--state-file", args.state_file]
+    os.execv(str(SERVER_PATH), cmd)
     return 0  # unreachable
 
 

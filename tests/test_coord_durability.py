@@ -1,0 +1,157 @@
+"""Coordinator durability: state survives a coordinator crash/restart.
+
+The reference kept coordination state in an etcd sidecar
+(reference pkg/jobparser.go:167-184), so a master pod restart did not
+forget the job.  Here the native server write-through-persists its state
+(queue accounting, KV — checkpoint pointers! — and the membership epoch)
+to --state-file before acking, and restores it at startup; the TCP client
+rides out the restart by redialing.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+from edl_tpu.coord.server import spawn_server
+
+pytestmark = pytest.mark.multihost
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _kill9(handle) -> None:
+    handle.process.send_signal(signal.SIGKILL)
+    handle.process.wait(timeout=10)
+
+
+def test_state_survives_kill9_restart(tmp_path):
+    state = str(tmp_path / "coord.state")
+    srv = spawn_server(member_ttl_ms=3000, task_timeout_ms=60000,
+                       state_file=state)
+    try:
+        c = srv.client()
+        for i in range(8):
+            c.add_task(f"shard-{i}".encode())
+        st1, id1, p1 = c.lease("w0")
+        st2, id2, p2 = c.lease("w0")
+        assert c.complete(id1, "w0")
+        c.kv_set("ckpt/3", b"/ckpt/gen-3")
+        assert c.join("w0") == 1
+        assert c.join("w1") == 2
+        pre = c.stats()
+        assert (pre.todo, pre.leased, pre.done) == (6, 1, 1)
+    finally:
+        _kill9(srv)
+
+    srv2 = spawn_server(member_ttl_ms=3000, task_timeout_ms=60000,
+                        state_file=state)
+    try:
+        c = srv2.client()
+        s = c.stats()
+        # the completed task stays done; the in-flight lease re-dispatches
+        # (leased -> todo: the restarted coordinator cannot know the owner
+        # lives — at-least-once, same as the lease timeout)
+        assert (s.todo, s.leased, s.done, s.dropped) == (7, 0, 1, 0)
+        # an acked KV write is never lost
+        assert c.kv_get("ckpt/3") == b"/ckpt/gen-3"
+        # epoch ordering survives even though members must re-join
+        epoch, members = c.members()
+        assert epoch >= 2 and members == []
+        # the pre-crash leaseholder's late COMPLETE is rejected (its lease
+        # did not survive), so the shard re-executes exactly once
+        assert not c.complete(id2, "w0")
+        # drain: every shard completes exactly once across the restart
+        seen = set()
+        while True:
+            st, tid, payload = c.lease("w1")
+            if st.name != "OK":
+                break
+            assert payload not in seen
+            seen.add(payload)
+            assert c.complete(tid, "w1")
+        s = c.stats()
+        assert s.done == 8 and s.todo == 0 and s.dropped == 0
+    finally:
+        _kill9(srv2)
+
+
+def test_client_reconnects_across_restart(tmp_path):
+    state = str(tmp_path / "coord.state")
+    port = _free_port()
+    srv = spawn_server(port=port, state_file=state)
+    c = srv.client()
+    c.kv_set("k", b"v1")
+    _kill9(srv)
+    srv2 = spawn_server(port=port, state_file=state)
+    try:
+        # same client object, same address: the call redials transparently
+        assert c.kv_get("k") == b"v1"
+        c.kv_set("k", b"v2")
+        assert c.kv_get("k") == b"v2"
+    finally:
+        _kill9(srv2)
+
+
+@pytest.mark.slow
+def test_workers_survive_coordinator_restart(tmp_path):
+    """The VERDICT r1 #7 'done' bar: kill/restart the coordinator mid-run;
+    the workers reconnect and the job finishes with exactly-once shard
+    accounting."""
+    state = str(tmp_path / "coord.state")
+    port = _free_port()
+    srv = spawn_server(port=port, member_ttl_ms=3000, task_timeout_ms=4000,
+                       state_file=state)
+    env = dict(os.environ)
+    env.update(
+        JAX_PLATFORMS="cpu",
+        XLA_FLAGS="--xla_force_host_platform_device_count=1",
+        EDL_MH_EXAMPLES=str(64 * 1024),
+        EDL_MH_SHARDS="256",
+        EDL_MH_BATCH="32",
+        EDL_MH_STEP_SLEEP="0.04",
+    )
+    procs = {}
+    logs = {}
+    for n in ("w0", "w1"):
+        logs[n] = tmp_path / f"{n}.log"
+        procs[n] = subprocess.Popen(
+            [sys.executable, "-m", "edl_tpu.runtime.multihost_worker",
+             "--coord", f"127.0.0.1:{port}", "--name", n,
+             "--ckpt-dir", str(tmp_path), "--min-members", "2",
+             "--settle-s", "0.3", "--heartbeat-timeout-s", "5"],
+            stdout=open(logs[n], "w"), stderr=subprocess.STDOUT, env=env)
+    # let the world actually train, then crash the coordinator
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline:
+        if logs["w0"].exists() and "step 20 " in logs["w0"].read_text():
+            break
+        time.sleep(0.25)
+    else:
+        raise TimeoutError("workers never started training")
+    _kill9(srv)
+    time.sleep(1.0)  # real downtime, inside the clients' redial window
+    srv2 = spawn_server(port=port, member_ttl_ms=3000, task_timeout_ms=4000,
+                        state_file=state)
+    try:
+        rcs = {n: p.wait(timeout=300) for n, p in procs.items()}
+        assert rcs == {"w0": 0, "w1": 0}
+        for n in procs:
+            assert "done at step" in logs[n].read_text()
+        s = srv2.client().stats()
+        assert s.todo == 0 and s.leased == 0 and s.dropped == 0
+        assert s.done == 256
+    finally:
+        _kill9(srv2)
