@@ -18,6 +18,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from edl_tpu.models.transformer import _maybe_constrain, rms_norm
+from edl_tpu.ops.embedding import embed_lookup
 from edl_tpu.ops.flash_attention import attention
 
 
@@ -32,6 +33,9 @@ class BertConfig:
     norm_eps: float = 1e-5
     dtype: Any = jnp.bfloat16
     use_flash: bool = True
+    # True when the embed table is tp/fsdp-sharded (see ops/embedding.py);
+    # False (gather) is the single-chip default.
+    one_hot_embed: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -93,7 +97,8 @@ def apply(params: dict, tokens: jax.Array, cfg: BertConfig) -> jax.Array:
     """tokens [b, s] → contextual embeddings [b, s, d]."""
     b, s = tokens.shape
     dt = cfg.dtype
-    x = (params["embed"].astype(dt)[tokens]
+    x = (embed_lookup(params["embed"], tokens, one_hot=cfg.one_hot_embed,
+                      dtype=dt)
          + params["pos"][:s].astype(dt)[None])
     x = _maybe_constrain(x, P(("dp", "fsdp"), "sp", None))
     h, hd = cfg.n_heads, cfg.head_dim

@@ -33,6 +33,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from edl_tpu.ops.embedding import embed_lookup
 from edl_tpu.ops.flash_attention import attention as flash_attention
 
 
@@ -67,6 +68,9 @@ class TransformerConfig:
     use_flash: bool = True
     # remat the block fn: trade FLOPs for HBM (jax.checkpoint)
     remat: bool = True
+    # True when the embed table is tp/fsdp-sharded (see ops/embedding.py);
+    # False (gather) is the single-chip default.
+    one_hot_embed: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -243,7 +247,8 @@ def _block(p: dict, x: jax.Array, angles: jax.Array,
 def apply(params: dict, tokens: jax.Array,
           cfg: TransformerConfig) -> jax.Array:
     """tokens [b, s] int32 → logits [b, s, vocab] (fp32)."""
-    x = params["embed"].astype(cfg.dtype)[tokens]
+    x = embed_lookup(params["embed"], tokens, one_hot=cfg.one_hot_embed,
+                     dtype=cfg.dtype)
     x = _maybe_constrain(x, activation_spec())
     positions = jnp.arange(tokens.shape[1])
     angles = rope_freqs(cfg, positions)

@@ -1,0 +1,32 @@
+"""Sharding-aware embedding lookup.
+
+One helper for every model in the zoo, because the right lookup depends on
+how the table is laid out, not on the model:
+
+* **gather** (``one_hot=False``) — free on an unsharded table; the
+  single-chip default.
+* **one-hot matmul** (``one_hot=True``) — for tables sharded
+  ``P(tp, fsdp)``: a gather's output inherits the table layout, and XLA's
+  SPMD partitioner can only reach batch-sharded activations by
+  "involuntary full rematerialization" (replicate, then repartition — on
+  both the forward gather and the backward scatter-add).  The matmul form
+  partitions cleanly — the contraction over the tp-sharded vocab dim
+  lowers to one psum — and rides the MXU, at ~2·b·s·v·d extra FLOPs: the
+  standard TPU trade for sharded embeddings.
+
+The reference has no counterpart (its models were word2vec/MNIST MLPs on
+parameter servers, SURVEY §5.7); this is TPU-mesh machinery.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def embed_lookup(table: jax.Array, tokens: jax.Array, *, one_hot: bool,
+                 dtype) -> jax.Array:
+    """``table[vocab, d]``, ``tokens[...] int`` → ``[..., d]`` in ``dtype``."""
+    if one_hot:
+        hot = jax.nn.one_hot(tokens, table.shape[0], dtype=dtype)
+        return hot @ table.astype(dtype)
+    return table.astype(dtype)[tokens]

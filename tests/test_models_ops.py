@@ -2,6 +2,8 @@
 attention — all validated against the reference attention math on the
 virtual CPU mesh."""
 
+from pathlib import Path
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -265,3 +267,51 @@ def test_transformer_ring_attention_on_sp_mesh():
             sp_params, sp_tokens)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                atol=2e-4, rtol=2e-4)
+
+
+@pytest.mark.slow
+def test_dryrun_multichip_has_no_remat_warnings():
+    """VERDICT r1 #8: the sharded train step must compile without SPMD
+    'involuntary full rematerialization' — every such warning is a
+    replicate-then-repartition hop that would be real HBM/ICI waste on
+    hardware.  Run in a subprocess because the warnings are emitted from
+    XLA's C++ logging, not Python."""
+    import subprocess
+    import sys
+
+    import os
+    import subprocess
+    import sys
+
+    # make sure XLA's C++ warnings are actually observable — a quieted log
+    # level would make the assertion below pass vacuously
+    env = dict(os.environ, TF_CPP_MIN_LOG_LEVEL="0")
+    for n in (8, 16):
+        # one subprocess per size: the virtual device count is fixed at
+        # backend init, so the two sizes cannot share a process
+        out = subprocess.run(
+            [sys.executable, "-c",
+             f"import __graft_entry__ as g; g.dryrun_multichip({n})"],
+            capture_output=True, text=True, timeout=600, env=env,
+            cwd=str(Path(__file__).resolve().parent.parent),
+        )
+        assert out.returncode == 0, (n, out.stderr[-2000:])
+        assert "rematerialization" not in out.stderr, (n, out.stderr[-2000:])
+    # negative control: with the gather path forced on the same mesh the
+    # warning DOES appear, proving the channel is live and the one-hot
+    # path is what keeps it clean
+    probe = (
+        "import __graft_entry__ as g;"
+        "from edl_tpu.models import transformer as tfm;"
+        "tfm.embed_lookup = (lambda table, tokens, *, one_hot, dtype:"
+        " table.astype(dtype)[tokens]);"  # force the gather path
+        "g.dryrun_multichip(8)"
+    )
+    out = subprocess.run(
+        [sys.executable, "-c", probe],
+        capture_output=True, text=True, timeout=600, env=env,
+        cwd=str(Path(__file__).resolve().parent.parent),
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "rematerialization" in out.stderr, (
+        "warning channel dead: gather on a sharded table should warn")
