@@ -13,6 +13,8 @@ surface:
   launch        pod-role entrypoint dispatch (role of docker/paddle_k8s)
   submit        submit a TrainingJob manifest
   delete        delete a job (role of example/del_jobs.sh for one job)
+  status        per-role / per-pod job status (the CRD status detail,
+                pkg/apis/paddlepaddle/v1/types.go:154-162)
   validate      parse+default+validate a manifest, print the result
 """
 
@@ -105,6 +107,31 @@ def cmd_delete(args) -> int:
     return 0
 
 
+def format_status(cluster, namespace: str, name: str) -> str:
+    """Per-role / per-pod state table for one job (role of the reference's
+    CRD status detail, pkg/apis/paddlepaddle/v1/types.go:154-162, surfaced
+    the way `kubectl get tj` would have)."""
+    from edl_tpu.controller.updater import compute_replica_statuses
+
+    uid = f"{namespace}/{name}"
+    lines = [f"job {uid}"]
+    any_pod = False
+    for st in compute_replica_statuses(cluster, uid):
+        lines.append(f"  {st.resource_type:<8} {st.state.value}")
+        for pod, state in sorted(st.resource_states.items()):
+            any_pod = True
+            lines.append(f"    {pod:<28} {state.value}")
+    if not any_pod:
+        lines.append("  (no pods found — job absent or fully torn down)")
+    return "\n".join(lines)
+
+
+def cmd_status(args) -> int:
+    cluster = _build_cluster(args)
+    print(format_status(cluster, args.namespace, args.name))
+    return 0
+
+
 def cmd_validate(args) -> int:
     from edl_tpu.api.serde import job_to_yaml, load_job_file
     from edl_tpu.api.validation import ValidationError, set_defaults_and_validate
@@ -175,6 +202,11 @@ def build_parser() -> argparse.ArgumentParser:
     _add_cluster_flags(c)
     c.add_argument("name")
     c.set_defaults(fn=cmd_delete)
+
+    c = sub.add_parser("status", help="per-role / per-pod job status")
+    _add_cluster_flags(c)
+    c.add_argument("name")
+    c.set_defaults(fn=cmd_status)
 
     c = sub.add_parser("validate", help="validate a manifest")
     c.add_argument("manifest")

@@ -69,6 +69,12 @@ class Cluster(abc.ABC):
     def job_pods(self, job: TrainingJob) -> PodCounts:
         """Count the job's trainer pods by phase (cluster.go:117-136)."""
 
+    @abc.abstractmethod
+    def list_pods(self, job_uid: str | None = None, role: str | None = None):
+        """Pod records (FakePod attribute surface: name/job_uid/role/phase/
+        node/...), optionally scoped to one job and/or role — what the
+        collector, pod discovery and per-role status reporting consume."""
+
     # -- resource lifecycle (role of CreateJob/DeleteJob/Create|DeleteReplicaSet,
     #    cluster.go:245-291) ----------------------------------------------
 

@@ -23,9 +23,14 @@ import queue
 import threading
 from typing import Optional
 
-from edl_tpu.api.types import JobPhase, TrainingJob
+from edl_tpu.api.types import (
+    JobPhase,
+    ResourceState,
+    TrainingJob,
+    TrainingResourceStatus,
+)
 from edl_tpu.api.validation import ValidationError, set_defaults_and_validate
-from edl_tpu.cluster.base import Cluster
+from edl_tpu.cluster.base import Cluster, PodPhase
 from edl_tpu.observability.logging import get_logger
 
 EVENT_QUEUE_SIZE = 1000  # reference trainingJobUpdater.go:19-25
@@ -34,6 +39,58 @@ CONFIRM_SECONDS = 5.0  # reference trainingJobUpdater.go:24 (5 s ready confirm)
 CREATE_TIMEOUT_SECONDS = 120.0
 
 log = get_logger("updater")
+
+#: cluster pod-role name → TrainingResourceType (reference
+#: pkg/apis/paddlepaddle/v1/types.go:139-147).
+ROLE_TYPES = (("master", "MASTER"), ("pserver", "PSERVER"),
+              ("trainer", "TRAINER"))
+
+_POD_TO_RESOURCE_STATE = {
+    PodPhase.PENDING: ResourceState.STARTING,
+    PodPhase.RUNNING: ResourceState.RUNNING,
+    PodPhase.SUCCEEDED: ResourceState.SUCCEEDED,
+    PodPhase.FAILED: ResourceState.FAILED,
+    PodPhase.TERMINATING: ResourceState.NONE,
+    PodPhase.UNKNOWN: ResourceState.NONE,
+}
+
+
+def compute_replica_statuses(cluster: Cluster, job_uid: str
+                             ) -> list[TrainingResourceStatus]:
+    """Per-role, per-pod states from live pods (the detail the reference
+    declares in TrainingResourceStatus, pkg/apis/paddlepaddle/v1/
+    types.go:154-162, and fills from the updater).  Shared by the updater
+    (which writes it into job.status each convert tick) and the CLI's
+    ``status`` verb (which computes the same view statelessly).
+
+    One LIST for the whole job, bucketed by role client-side — per-role
+    LISTs would be 3 API calls per convert tick per job on a live
+    apiserver."""
+    by_role: dict[str, list] = {}
+    for p in cluster.list_pods(job_uid=job_uid):
+        by_role.setdefault(p.role, []).append(p)
+    statuses: list[TrainingResourceStatus] = []
+    for role, rtype in ROLE_TYPES:
+        states = {
+            p.name: _POD_TO_RESOURCE_STATE.get(p.phase, ResourceState.NONE)
+            for p in by_role.get(role, ())
+        }
+        vals = list(states.values())
+        if not vals:
+            agg = ResourceState.NONE
+        elif all(s == ResourceState.SUCCEEDED for s in vals):
+            agg = ResourceState.SUCCEEDED
+        elif any(s == ResourceState.RUNNING for s in vals):
+            agg = ResourceState.RUNNING
+        elif any(s == ResourceState.STARTING for s in vals):
+            agg = ResourceState.STARTING
+        elif any(s == ResourceState.FAILED for s in vals):
+            agg = ResourceState.FAILED
+        else:
+            agg = ResourceState.NONE
+        statuses.append(TrainingResourceStatus(
+            resource_type=rtype, state=agg, resource_states=states))
+    return statuses
 
 
 class TrainingJobUpdater:
@@ -118,6 +175,7 @@ class TrainingJobUpdater:
                 counts = None
             if counts is not None:
                 if counts.running >= self.job.spec.trainer.min_instance:
+                    self._refresh_replica_statuses()
                     self._set_phase(JobPhase.RUNNING)
                     return
                 if self._now() > deadline:
@@ -138,7 +196,9 @@ class TrainingJobUpdater:
                 return
 
     def convert(self) -> None:
-        """Recompute phase from pod counts (reference :343-414)."""
+        """Recompute phase + per-role replica statuses from live pods
+        (reference :343-414 and the Gen-2 TrainingResourceStatus detail
+        nothing populated in round 1)."""
         if self.phase not in (JobPhase.RUNNING, JobPhase.SCALING):
             return
         try:
@@ -147,6 +207,7 @@ class TrainingJobUpdater:
             log.error("convert: job_pods failed", job=self.job.full_name,
                       error=str(exc))
             return
+        self._refresh_replica_statuses()
 
         active = counts.running + counts.pending
         if self.job.spec.fault_tolerant:
@@ -204,6 +265,17 @@ class TrainingJobUpdater:
         except queue.Full:
             log.error("event queue full, dropping event",
                       job=self.job.full_name, event=evt)
+
+    def _refresh_replica_statuses(self) -> None:
+        """Status DETAIL only — a failure here must never block the phase
+        machine (the CRD's phase is load-bearing; replica_statuses is
+        operator information)."""
+        try:
+            self.job.status.replica_statuses = compute_replica_statuses(
+                self.cluster, self.job.full_name)
+        except Exception as exc:
+            log.warn("replica-status refresh failed",
+                     job=self.job.full_name, error=str(exc))
 
     def _release(self) -> None:
         """Release the job's cluster resources once (role of
