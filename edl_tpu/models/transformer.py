@@ -206,14 +206,12 @@ def _attention_block(p: dict, x: jax.Array, angles: jax.Array,
     q = apply_rope(q, angles).astype(dt)
     k = apply_rope(k, angles).astype(dt)
 
-    if kv != h:  # GQA: repeat kv heads
-        rep = h // kv
-        k = jnp.repeat(k, rep, axis=2)
-        v = jnp.repeat(v, rep, axis=2)
-
     # Long-context routing: on an sp>1 mesh, the sequence dimension is
     # sharded and attention rings the k/v chunks over ICI; otherwise the
     # flash kernel (TPU) or reference path handles the full sequence.
+    # GQA: the flash path takes the UNREPEATED kv heads (the kernel maps
+    # each kv head to its query group — the repeat never hits HBM); the
+    # ring path still wants matched heads.
     from jax.sharding import get_abstract_mesh
 
     mesh = get_abstract_mesh()
@@ -221,6 +219,9 @@ def _attention_block(p: dict, x: jax.Array, angles: jax.Array,
             and "sp" in mesh.axis_names and mesh.shape["sp"] > 1):
         from edl_tpu.parallel.ring_attention import ring_attention_sharded
 
+        if kv != h:  # GQA: repeat kv heads for the ring
+            k = jnp.repeat(k, h // kv, axis=2)
+            v = jnp.repeat(v, h // kv, axis=2)
         o = ring_attention_sharded(q, k, v, causal=True)
     else:
         o = flash_attention(q, k, v, causal=True, use_pallas=cfg.use_flash)

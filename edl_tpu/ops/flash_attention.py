@@ -128,14 +128,24 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_state, l_state,
         lse_ref[0] = m_state[:] + jnp.log(l_state[:])
 
 
+def _kv_head_map(h: int, hk: int):
+    """Folded-q index [b·h] → folded-kv index [b·hk] — how the kernels read
+    GQA directly: each kv head serves ``h // hk`` query heads through the
+    BlockSpec index map, so the repeated K/V never exist in HBM
+    (jnp.repeat would materialize them, 4x for a Llama-3-8B-class model)."""
+    rep = h // hk
+    return lambda bh: (bh // h) * hk + (bh % h) // rep
+
+
 def _flash_forward(q: jax.Array, k: jax.Array, v: jax.Array, causal: bool,
-                   block_q: int, block_k: int,
+                   block_q: int, block_k: int, h: int, hk: int,
                    interpret: bool) -> tuple[jax.Array, jax.Array]:
-    """q,k,v: [bh, s, d] (heads already folded into batch) →
-    (out [bh, s, d], lse [bh, s, 1] fp32)."""
+    """q: [b·h, s, d]; k,v: [b·hk, s, d] (heads folded into batch; GQA via
+    the kv index map) → (out [b·h, s, d], lse [b·h, s, 1] fp32)."""
     bh, s, d = q.shape
     scale = 1.0 / (d ** 0.5)
     grid = (bh, s // block_q, s // block_k)
+    kvm = _kv_head_map(h, hk)
     kernel = functools.partial(
         _flash_kernel, block_q=block_q, block_k=block_k, causal=causal,
         scale=scale,
@@ -145,8 +155,10 @@ def _flash_forward(q: jax.Array, k: jax.Array, v: jax.Array, causal: bool,
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, qi, ki: (b, qi, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, qi, ki: (b, ki, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, qi, ki: (b, ki, 0)),
+            pl.BlockSpec((1, block_k, d),
+                         lambda b, qi, ki: (kvm(b), ki, 0)),
+            pl.BlockSpec((1, block_k, d),
+                         lambda b, qi, ki: (kvm(b), ki, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, qi, ki: (b, qi, 0)),
@@ -220,16 +232,20 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                           dk_ref, dv_ref, dk_acc, dv_acc, *, block_q: int,
-                          block_k: int, causal: bool, scale: float):
-    qi = pl.program_id(2)
-    num_q = pl.num_programs(2)
+                          block_k: int, causal: bool, scale: float,
+                          n_q_blocks: int):
+    # inner = g * n_q_blocks + qi: one kv head accumulates over every
+    # query block of every one of its GQA group's query heads
+    inner = pl.program_id(2)
+    num_inner = pl.num_programs(2)
 
-    @pl.when(qi == 0)
+    @pl.when(inner == 0)
     def _init():
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
 
     ki = pl.program_id(1)
+    qi = inner % n_q_blocks
     q_start = qi * block_q
     k_start = ki * block_k
 
@@ -259,24 +275,27 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
-    @pl.when(qi == num_q - 1)
+    @pl.when(inner == num_inner - 1)
     def _finish():
         dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
 
 
-def _flash_backward(q, k, v, out, lse, g, causal, block_q, block_k,
+def _flash_backward(q, k, v, out, lse, g, causal, block_q, block_k, h, hk,
                     interpret):
     bh, s, d = q.shape
     scale = 1.0 / (d ** 0.5)
+    rep = h // hk
+    nqb = s // block_q
+    kvm = _kv_head_map(h, hk)
     # delta = rowsum(dO ∘ O): tiny elementwise pass, XLA fuses it
     delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1, keepdims=True)  # [bh, s, 1]
 
     qkv_spec = [
         pl.BlockSpec((1, block_q, d), lambda b, qi, ki: (b, qi, 0)),
-        pl.BlockSpec((1, block_k, d), lambda b, qi, ki: (b, ki, 0)),
-        pl.BlockSpec((1, block_k, d), lambda b, qi, ki: (b, ki, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, qi, ki: (kvm(b), ki, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, qi, ki: (kvm(b), ki, 0)),
         pl.BlockSpec((1, block_q, d), lambda b, qi, ki: (b, qi, 0)),  # dO
         pl.BlockSpec((1, block_q, 1), lambda b, qi, ki: (b, qi, 0)),  # lse
         pl.BlockSpec((1, block_q, 1), lambda b, qi, ki: (b, qi, 0)),  # delta
@@ -292,26 +311,38 @@ def _flash_backward(q, k, v, out, lse, g, causal, block_q, block_k,
         interpret=interpret,
     )(q, k, v, g, lse, delta)
 
+    # dK/dV: one program per KV head; the inner grid dim walks every
+    # (group member, query block) pair, so the accumulators sum over the
+    # whole GQA group — the sum jnp.repeat's backward would have formed
+    def qrow(bkh, inner):
+        return (bkh // hk) * h + (bkh % hk) * rep + inner // nqb
+
     kv_spec = [
-        pl.BlockSpec((1, block_q, d), lambda b, ki, qi: (b, qi, 0)),
-        pl.BlockSpec((1, block_k, d), lambda b, ki, qi: (b, ki, 0)),
-        pl.BlockSpec((1, block_k, d), lambda b, ki, qi: (b, ki, 0)),
-        pl.BlockSpec((1, block_q, d), lambda b, ki, qi: (b, qi, 0)),  # dO
-        pl.BlockSpec((1, block_q, 1), lambda b, ki, qi: (b, qi, 0)),  # lse
-        pl.BlockSpec((1, block_q, 1), lambda b, ki, qi: (b, qi, 0)),  # delta
+        pl.BlockSpec((1, block_q, d),
+                     lambda b, ki, nn: (qrow(b, nn), nn % nqb, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, ki, nn: (b, ki, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, ki, nn: (b, ki, 0)),
+        pl.BlockSpec((1, block_q, d),
+                     lambda b, ki, nn: (qrow(b, nn), nn % nqb, 0)),  # dO
+        pl.BlockSpec((1, block_q, 1),
+                     lambda b, ki, nn: (qrow(b, nn), nn % nqb, 0)),  # lse
+        pl.BlockSpec((1, block_q, 1),
+                     lambda b, ki, nn: (qrow(b, nn), nn % nqb, 0)),  # delta
     ]
+    bkh = (bh // h) * hk
     dk, dv = pl.pallas_call(
         functools.partial(_flash_bwd_dkv_kernel, block_q=block_q,
-                          block_k=block_k, causal=causal, scale=scale),
-        grid=(bh, s // block_k, s // block_q),
+                          block_k=block_k, causal=causal, scale=scale,
+                          n_q_blocks=nqb),
+        grid=(bkh, s // block_k, rep * nqb),
         in_specs=kv_spec,
         out_specs=[
-            pl.BlockSpec((1, block_k, d), lambda b, ki, qi: (b, ki, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, ki, qi: (b, ki, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, ki, nn: (b, ki, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, ki, nn: (b, ki, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, s, d), k.dtype),
-            jax.ShapeDtypeStruct((bh, s, d), v.dtype),
+            jax.ShapeDtypeStruct((bkh, s, d), k.dtype),
+            jax.ShapeDtypeStruct((bkh, s, d), v.dtype),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_k, d), jnp.float32),
@@ -322,21 +353,23 @@ def _flash_backward(q, k, v, out, lse, g, causal, block_q, block_k,
     return dq, dk, dv
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash_attention(q, k, v, causal, block_q, block_k, interpret):
-    out, _ = _flash_forward(q, k, v, causal, block_q, block_k, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash_attention(q, k, v, causal, block_q, block_k, h, hk, interpret):
+    out, _ = _flash_forward(q, k, v, causal, block_q, block_k, h, hk,
+                            interpret)
     return out
 
 
-def _fwd(q, k, v, causal, block_q, block_k, interpret):
-    out, lse = _flash_forward(q, k, v, causal, block_q, block_k, interpret)
+def _fwd(q, k, v, causal, block_q, block_k, h, hk, interpret):
+    out, lse = _flash_forward(q, k, v, causal, block_q, block_k, h, hk,
+                              interpret)
     return out, (q, k, v, out, lse)
 
 
-def _bwd(causal, block_q, block_k, interpret, res, g):
+def _bwd(causal, block_q, block_k, h, hk, interpret, res, g):
     q, k, v, out, lse = res
     return _flash_backward(q, k, v, out, lse, g, causal, block_q, block_k,
-                           interpret)
+                           h, hk, interpret)
 
 
 _flash_attention.defvjp(_fwd, _bwd)
@@ -356,12 +389,25 @@ def attention(q: jax.Array, k: jax.Array, v: jax.Array, causal: bool = True,
               use_pallas: bool = True, block_q: int = DEFAULT_BLOCK_Q,
               block_k: int = DEFAULT_BLOCK_K,
               interpret: bool = False) -> jax.Array:
-    """Multi-head attention, q/k/v: [b, s, h, d] → [b, s, h, d].
+    """Multi-head attention, q: [b, s, h, d], k/v: [b, s, hk, d] with
+    hk | h → [b, s, h, d].
+
+    GQA is native: pass the UNREPEATED k/v heads and the kernel reads each
+    kv head for its whole query group through the block index maps —
+    the h/hk-repeated K/V (and their gradients) never exist in HBM.
 
     Dispatches to the pallas flash kernel on TPU when shapes allow
-    (s divisible by the block sizes), else to the reference path.
+    (128-aligned s divisible by the — shape-adapted — block sizes), else
+    to the reference path.
     """
     b, s, h, d = q.shape
+    hk = k.shape[2]
+    if h % hk != 0:
+        raise ValueError(f"q heads {h} not a multiple of kv heads {hk}")
+    if v.shape[2] != hk:
+        # the -1 fold below would silently accept it and the kernel would
+        # read misaligned v rows — fail loudly instead
+        raise ValueError(f"k has {hk} heads but v has {v.shape[2]}")
     # shape-adaptive blocks: shrink for short sequences instead of
     # falling back (a 128-token test sequence should still go through
     # the kernel path), keep the big defaults for long ones
@@ -378,10 +424,13 @@ def attention(q: jax.Array, k: jax.Array, v: jax.Array, causal: bool = True,
         and s % block_k == 0
     )
     if not eligible:
+        if hk != h:
+            k = jnp.repeat(k, h // hk, axis=2)
+            v = jnp.repeat(v, h // hk, axis=2)
         return reference_attention(q, k, v, causal=causal)
     # fold heads into batch: [b, s, h, d] → [b*h, s, d]
-    fold = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+    fold = lambda x: x.transpose(0, 2, 1, 3).reshape(-1, s, d)
     unfold = lambda x: x.reshape(b, h, s, d).transpose(0, 2, 1, 3)
     out = _flash_attention(fold(q), fold(k), fold(v), causal, block_q,
-                           block_k, interpret)
+                           block_k, h, hk, interpret)
     return unfold(out)
