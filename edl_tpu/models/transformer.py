@@ -217,12 +217,20 @@ def _attention_block(p: dict, x: jax.Array, angles: jax.Array,
     mesh = get_abstract_mesh()
     if (mesh is not None and not mesh.empty
             and "sp" in mesh.axis_names and mesh.shape["sp"] > 1):
-        from edl_tpu.parallel.ring_attention import ring_attention_sharded
+        from edl_tpu.ops.flash_attention import _on_tpu
+        from edl_tpu.parallel.ring_attention import (
+            ring_attention_sharded,
+            ring_flash_attention_sharded,
+        )
 
-        if kv != h:  # GQA: repeat kv heads for the ring
-            k = jnp.repeat(k, h // kv, axis=2)
-            v = jnp.repeat(v, h // kv, axis=2)
-        o = ring_attention_sharded(q, k, v, causal=True)
+        if cfg.use_flash and _on_tpu():
+            # per-chunk pallas kernels inside the ring; GQA kv unrepeated
+            o = ring_flash_attention_sharded(q, k, v, causal=True)
+        else:
+            if kv != h:  # GQA: repeat kv heads for the jnp ring
+                k = jnp.repeat(k, h // kv, axis=2)
+                v = jnp.repeat(v, h // kv, axis=2)
+            o = ring_attention_sharded(q, k, v, causal=True)
     else:
         o = flash_attention(q, k, v, causal=True, use_pallas=cfg.use_flash)
     o = o.reshape(b, s, h * hd)

@@ -79,6 +79,206 @@ def _ring_local(q_loc, k_loc, v_loc, axis: str, n: int, causal: bool):
     return out.astype(q_loc.dtype)
 
 
+# -- flash-kernel ring -------------------------------------------------------
+#
+# The same ring, with each visiting chunk handled by the pallas flash
+# kernels (edl_tpu.ops.flash_attention) instead of a materialized
+# [sc, sc] jnp score block:
+#
+# * forward: per chunk, the flash FORWARD returns (out_c, lse_c); chunks
+#   combine by logsumexp — out = Σ_c out_c · exp(lse_c − lse) — so the
+#   running state is one normalized tile + one lse row per query, exactly
+#   the flash recurrence lifted to ring hops.
+# * backward (custom VJP at the ring level): with the GLOBAL lse saved,
+#   the per-chunk flash BACKWARD computes this device's dQ contribution
+#   and the visiting chunk's dK/dV exactly (p = exp(s − lse_global) are
+#   the true probabilities); dK/dV ride the ring WITH their k/v chunk and
+#   are home after n hops.
+#
+# Chunk classification under causality is dynamic (src vs idx is traced),
+# so each hop lax.switches between three compiled kernels: diagonal
+# (causal), below-diagonal (full), above-diagonal (skip).
+
+
+def _ring_flash_local(q_loc, k_loc, v_loc, axis: str, n: int, causal: bool,
+                      interpret: bool):
+    """Shard-local flash ring: q_loc [b, sc, h, d]; k/v [b, sc, hk, d]."""
+    from edl_tpu.ops.flash_attention import DEFAULT_BLOCK_K, DEFAULT_BLOCK_Q
+
+    b, sc, h, d = q_loc.shape
+    hk = k_loc.shape[2]
+    block_q = min(DEFAULT_BLOCK_Q, sc)
+    block_k = min(DEFAULT_BLOCK_K, sc)
+    fold = lambda x: x.transpose(0, 2, 1, 3).reshape(-1, sc, d)
+    unfold_h = lambda x: x.reshape(b, h, sc, d).transpose(0, 2, 1, 3)
+
+    @functools.partial(jax.custom_vjp, nondiff_argnums=())
+    def ring(qf, kf, vf):
+        out, _ = _ring_flash_fwd(qf, kf, vf)
+        return out
+
+    def _chunk_fwd(qf, kc, vc, case):
+        """case 0=diagonal (causal), 1=below (full), 2=above (skip)."""
+        from edl_tpu.ops.flash_attention import _flash_forward
+
+        def diag(qf, kc, vc):
+            return _flash_forward(qf, kc, vc, True, block_q, block_k,
+                                  h, hk, interpret)
+
+        def full(qf, kc, vc):
+            return _flash_forward(qf, kc, vc, False, block_q, block_k,
+                                  h, hk, interpret)
+
+        def skip(qf, kc, vc):
+            return (jnp.zeros_like(qf),
+                    jnp.full((qf.shape[0], sc, 1), _NEG_INF, jnp.float32))
+
+        return jax.lax.switch(case, (diag, full, skip), qf, kc, vc)
+
+    def _case(idx, src):
+        if not causal:
+            return jnp.int32(1)  # every chunk is a full block
+        return jnp.where(src == idx, 0, jnp.where(src < idx, 1, 2))
+
+    def _ring_flash_fwd(qf, kf, vf):
+        idx = jax.lax.axis_index(axis)
+        out = jnp.zeros(qf.shape, jnp.float32)
+        lse = jnp.full((qf.shape[0], sc, 1), _NEG_INF, jnp.float32)
+        k_cur, v_cur = kf, vf
+        for step in range(n):
+            src = (idx - step) % n
+            out_c, lse_c = _chunk_fwd(qf, k_cur, v_cur, _case(idx, src))
+            lse_new = jnp.logaddexp(lse, lse_c)
+            # a row that has seen nothing yet sits at the _NEG_INF
+            # sentinel (not a literal -inf); keep such rows at zero
+            # instead of exp(sentinel - sentinel) = 1 garbage
+            dead = lse_new < _NEG_INF * 0.5
+            keep = jnp.where(dead, 0.0, jnp.exp(lse - lse_new))
+            add = jnp.where(dead, 0.0, jnp.exp(lse_c - lse_new))
+            out = out * keep + out_c.astype(jnp.float32) * add
+            lse = lse_new
+            if step + 1 < n:
+                perm = [(i, (i + 1) % n) for i in range(n)]
+                k_cur = jax.lax.ppermute(k_cur, axis, perm)
+                v_cur = jax.lax.ppermute(v_cur, axis, perm)
+        return out.astype(qf.dtype), lse
+
+    def _fwd(qf, kf, vf):
+        out, lse = _ring_flash_fwd(qf, kf, vf)
+        return out, (qf, kf, vf, out, lse)
+
+    def _bwd(res, g):
+        from edl_tpu.ops.flash_attention import _flash_backward
+
+        qf, kf, vf, out, lse = res
+        idx = jax.lax.axis_index(axis)
+        dq = jnp.zeros(qf.shape, jnp.float32)
+        # dk/dv accumulate in f32 and ride the ring with their chunk;
+        # after the final hop's rotation they are back home
+        k_cur, v_cur = kf, vf
+        dk_cur = jnp.zeros(kf.shape, jnp.float32)
+        dv_cur = jnp.zeros(vf.shape, jnp.float32)
+
+        def chunk_bwd(qf, kc, vc, case):
+            def diag(qf, kc, vc):
+                return _flash_backward(qf, kc, vc, out, lse, g, True,
+                                       block_q, block_k, h, hk, interpret)
+
+            def full(qf, kc, vc):
+                return _flash_backward(qf, kc, vc, out, lse, g, False,
+                                       block_q, block_k, h, hk, interpret)
+
+            def skip(qf, kc, vc):
+                return (jnp.zeros_like(qf), jnp.zeros_like(kc),
+                        jnp.zeros_like(vc))
+
+            return jax.lax.switch(case, (diag, full, skip), qf, kc, vc)
+
+        for step in range(n):
+            src = (idx - step) % n
+            dq_c, dk_c, dv_c = chunk_bwd(qf, k_cur, v_cur, _case(idx, src))
+            dq = dq + dq_c.astype(jnp.float32)
+            dk_cur = dk_cur + dk_c.astype(jnp.float32)
+            dv_cur = dv_cur + dv_c.astype(jnp.float32)
+            if step + 1 < n:
+                perm = [(i, (i + 1) % n) for i in range(n)]
+                k_cur = jax.lax.ppermute(k_cur, axis, perm)
+                v_cur = jax.lax.ppermute(v_cur, axis, perm)
+                dk_cur = jax.lax.ppermute(dk_cur, axis, perm)
+                dv_cur = jax.lax.ppermute(dv_cur, axis, perm)
+        # one final hop brings every chunk's gradient home
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        dk_cur = jax.lax.ppermute(dk_cur, axis, perm)
+        dv_cur = jax.lax.ppermute(dv_cur, axis, perm)
+        return (dq.astype(qf.dtype), dk_cur.astype(kf.dtype),
+                dv_cur.astype(vf.dtype))
+
+    ring.defvjp(_fwd, _bwd)
+    return unfold_h(ring(fold(q_loc), fold(k_loc), fold(v_loc)))
+
+
+def ring_flash_attention_sharded(
+    q: jax.Array, k: jax.Array, v: jax.Array, causal: bool = True,
+    seq_axis: str = "sp", batch_axes: tuple[str, ...] = ("dp", "fsdp"),
+    head_axis: str = "tp", interpret: bool = False,
+) -> jax.Array:
+    """Ring attention whose per-chunk math runs in the pallas flash
+    kernels — long-context AND sequence-parallel at once.  Same contract
+    as :func:`ring_attention_sharded`; GQA kv heads pass unrepeated."""
+    from jax.sharding import get_abstract_mesh
+
+    mesh = get_abstract_mesh()
+    if mesh is None or mesh.empty:
+        raise RuntimeError(
+            "ring_flash_attention_sharded requires a mesh context")
+    n = mesh.shape[seq_axis]
+    batch = tuple(a for a in batch_axes if a in mesh.axis_names)
+    head = head_axis if head_axis in mesh.axis_names else None
+
+    # Eligibility mirrors attention(): per-device chunks must be
+    # 128-aligned and divisible by the (shape-adapted) blocks — a pallas
+    # grid of sc // block would silently TRUNCATE otherwise, never
+    # writing the tail query rows.  Ineligible shapes take the jnp ring.
+    from edl_tpu.ops.flash_attention import DEFAULT_BLOCK_K, DEFAULT_BLOCK_Q
+
+    s = q.shape[1]
+    sc = s // n
+    eligible = (
+        s % n == 0
+        and sc % 128 == 0
+        and sc % min(DEFAULT_BLOCK_Q, sc) == 0
+        and sc % min(DEFAULT_BLOCK_K, sc) == 0
+    )
+    h, hk = q.shape[2], k.shape[2]
+    tp_size = mesh.shape[head_axis] if head is not None else 1
+    if hk != h and hk % tp_size != 0:
+        # tp shards the head axis; unrepeated kv heads don't divide it
+        # (the pre-GQA-native path repeated to h first, which always
+        # divides) — repeat here, still through the flash kernels
+        k = jnp.repeat(k, h // hk, axis=2)
+        v = jnp.repeat(v, h // hk, axis=2)
+    if not eligible:
+        if k.shape[2] != h:
+            k = jnp.repeat(k, h // k.shape[2], axis=2)
+            v = jnp.repeat(v, h // v.shape[2], axis=2)
+        return ring_attention_sharded(q, k, v, causal=causal,
+                                      seq_axis=seq_axis,
+                                      batch_axes=batch_axes,
+                                      head_axis=head_axis)
+    spec = P(batch or None, seq_axis, head, None)
+    ring = shard_map(
+        functools.partial(_ring_flash_local, axis=seq_axis, n=n,
+                          causal=causal, interpret=interpret),
+        in_specs=(spec, spec, spec), out_specs=spec,
+        # pallas_call's out_shape carries no varying-mesh-axes annotation,
+        # which the vma checker requires of everything inside a shard_map;
+        # the ring's data flow is fully explicit (ppermute), so the check
+        # buys nothing here
+        check_vma=False,
+    )
+    return ring(q, k, v)
+
+
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
                    axis: str = "sp", causal: bool = True) -> jax.Array:
     """q,k,v: [b, s, h, d] GLOBAL arrays, sequence-sharded over ``axis``.
