@@ -271,7 +271,9 @@ def throughput_leg(small: bool = False) -> dict:
             vocab_size=16_384, d_model=1024, n_layers=8, n_heads=8,
             n_kv_heads=8, d_ff=4096, max_seq_len=1024, dtype=jnp.bfloat16,
             use_flash=on_tpu, remat=False)
-        batch, seq, n_steps = (8, 1024, 20) if on_tpu else (2, 256, 3)
+        # batch 16 sustains ~7% more tokens/s than 8 on v5e (measured;
+        # 32 regresses — HBM working set)
+        batch, seq, n_steps = (16, 1024, 20) if on_tpu else (2, 256, 3)
 
     m = _timed_train_step(cfg, batch, seq, n_steps, count_flops=True)
     flops_per_step = m["flops_per_step"]
