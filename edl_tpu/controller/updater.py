@@ -226,6 +226,30 @@ class TrainingJobUpdater:
         if counts.succeeded > 0 and active == 0:
             self._set_phase(JobPhase.SUCCEEDED)
             self._release()
+            return
+        # Resize in flight (the TPU addition to the reference's phases):
+        # the autoscaler rewrote the desired parallelism and the pod set
+        # hasn't caught up — surface it so operators can tell "scaling"
+        # from "steady" (kubectl-visible, like the reference's phases).
+        # Only when the count gap is actually a resize: early successes
+        # (wind-down) and FT failure recovery also diverge running from
+        # desired and must keep their own phase/reason.
+        if counts.succeeded > 0 or counts.failed > 0:
+            return
+        try:
+            desired = self.cluster.get_trainer_parallelism(self.job)
+        except Exception as exc:
+            # keep the current phase, but a persistent fault (e.g. the
+            # trainer group deleted out-of-band) must not be silent
+            log.error("convert: get_trainer_parallelism failed",
+                      job=self.job.full_name, error=str(exc))
+            return
+        if counts.running != desired:
+            self._set_phase(
+                JobPhase.SCALING,
+                f"trainers {counts.running} -> {desired}")
+        else:
+            self._set_phase(JobPhase.RUNNING)
 
     def delete(self) -> None:
         """Full teardown (reference deleteTrainingJob, :99-207)."""

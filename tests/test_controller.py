@@ -263,3 +263,23 @@ def test_cli_status_verb(capsys):
     assert "statusjob-trainer" in out
     # absent job renders a clear empty message, not a crash
     assert "no pods found" in cli.format_status(cluster, "default", "nope")
+
+
+def test_updater_surfaces_scaling_phase():
+    # the TPU addition to the reference's phase set: a resize in flight
+    # (desired parallelism != running pods) shows as SCALING, then settles
+    # back to RUNNING when the pod set catches up
+    c = FakeCluster()
+    c.add_node("n0", cpu_milli=2500, memory_mega=16000)  # room for 2
+    job = mk_job(lo=2, hi=6)
+    u = TrainingJobUpdater(job, c, convert_seconds=0.02, confirm_seconds=0.01)
+    assert wait_phase(lambda: u.phase, JobPhase.RUNNING)
+    # the autoscaler grows the job beyond current capacity: two new pods
+    # sit Pending, so the resize is visibly in flight
+    c.update_trainer_parallelism(job, 4)
+    assert wait_phase(lambda: u.phase, JobPhase.SCALING)
+    assert "2 -> 4" in job.status.reason
+    c.add_node("n1", cpu_milli=2500, memory_mega=16000)
+    c.reconcile()  # capacity arrives; the kubelet places the pods
+    assert wait_phase(lambda: u.phase, JobPhase.RUNNING)
+    u.stop()
