@@ -577,6 +577,12 @@ def run_elastic_worker(
     ctx = mp.get_context("spawn")
     os.makedirs(ckpt_dir, exist_ok=True)
     ew.join()
+    # Reform timeline into the process tracer (the reference had no
+    # tracing at all, SURVEY §5.1); EDL_MH_TRACE=<dir> dumps a chrome
+    # trace per worker at exit for offline inspection of the dance.
+    from edl_tpu.observability.tracing import get_tracer
+
+    tracer = get_tracer()
     last_path: Optional[str] = None
     last_step: Optional[int] = None
     try:
@@ -593,6 +599,7 @@ def run_elastic_worker(
                     target=_world_child,
                     args=(plan, cfg, result_path, os.getpid()),
                     name=f"world-{plan.epoch}-{name}")
+                world_t0 = time.monotonic()
                 child.start()
                 log.info("world child started", epoch=plan.epoch,
                          rank=plan.rank, world=plan.world_size,
@@ -604,6 +611,11 @@ def run_elastic_worker(
                             and leave_requested()):
                         ew.announce_leave(plan.epoch)
                         announced = True
+                tracer.instant(
+                    "world_exit", category="membership", epoch=plan.epoch,
+                    rank=plan.rank, world=plan.world_size,
+                    exitcode=child.exitcode,
+                    lifetime_s=round(time.monotonic() - world_t0, 3))
                 if child.exitcode == 0 and os.path.exists(result_path):
                     with open(result_path) as f:
                         result = json.load(f)
@@ -628,6 +640,8 @@ def run_elastic_worker(
                 # prune the dead peer, then re-plan.
                 log.warn("world child died; reforming", epoch=plan.epoch,
                          exitcode=child.exitcode)
+                tracer.instant("world_reform", category="membership",
+                               epoch=plan.epoch, exitcode=child.exitcode)
                 if plan.rank == 0:
                     # The coordinator endpoint died with our child; clear
                     # the epoch's claim so a same-epoch reform binds a
@@ -647,6 +661,13 @@ def run_elastic_worker(
             ew.leave()
         except Exception:
             pass
+        trace_dir = os.environ.get("EDL_MH_TRACE")
+        if trace_dir:
+            try:
+                os.makedirs(trace_dir, exist_ok=True)
+                tracer.dump(os.path.join(trace_dir, f"trace-{name}.json"))
+            except Exception as exc:  # tracing never fails the worker
+                log.warn("trace dump failed", error=str(exc))
     if last_path is None:
         found = ew.latest_state(ew.epoch() + 1)
         last_path = found[1] if found else None

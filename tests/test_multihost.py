@@ -103,6 +103,7 @@ def _assert_exactly_once(client, shards: int) -> None:
 @pytest.mark.slow
 def test_join_wave_forms_one_world_and_drains(coord_server, tmp_path):
     env = _worker_env(SMALL_EXAMPLES, SMALL_SHARDS)
+    env["EDL_MH_TRACE"] = str(tmp_path / "traces")
     procs = {
         n: _spawn_worker(coord_server.port, n, tmp_path, 2, env,
                          tmp_path / f"{n}.log")
@@ -116,6 +117,12 @@ def test_join_wave_forms_one_world_and_drains(coord_server, tmp_path):
         # the settle window merged the join wave into one 2-world
         assert "world=2" in text and "world=1" not in text
     _assert_exactly_once(coord_server.client(), SMALL_SHARDS)
+    # the supervisor dumped a chrome trace of its world timeline
+    import json as _json
+
+    trace = _json.loads((tmp_path / "traces" / "trace-w0.json").read_text())
+    names = {e.get("name") for e in trace.get("traceEvents", trace)}
+    assert "world_exit" in names
 
 
 @pytest.mark.slow
