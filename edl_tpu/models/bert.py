@@ -123,10 +123,12 @@ def mlm_loss_fn(params: dict, batch, cfg: BertConfig) -> jax.Array:
     masked, targets, mask = batch
     hdn = apply(params, masked, cfg)
     logits = (hdn @ params["embed"].astype(hdn.dtype).T).astype(jnp.float32)
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    # lse − target-logit form: the fp32 log-probability tensor never
+    # materializes (see transformer.loss_fn)
+    lse = jax.nn.logsumexp(logits, axis=-1)
+    tgt = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
     denom = jnp.maximum(jnp.sum(mask), 1.0)
-    return jnp.sum(nll * mask) / denom
+    return jnp.sum((lse - tgt) * mask) / denom
 
 
 def make_loss_fn(cfg: BertConfig):
