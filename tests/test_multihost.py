@@ -148,16 +148,20 @@ def test_sigterm_leaver_and_survivors_finish(coord_server, tmp_path):
 
 
 @pytest.mark.slow
-def test_sigkill_crash_survivors_reform_and_finish(coord_server, tmp_path):
+@pytest.mark.parametrize("sharding", ["replicated", "fsdp"])
+def test_sigkill_crash_survivors_reform_and_finish(coord_server, tmp_path,
+                                                   sharding):
     """The headline fault-tolerance property: kill -9 a worker mid-world
     and the survivors must NOT die with it (round-1 regression: XLA's
     coordination service aborted the whole process; the supervised child
-    quarantines the abort)."""
+    quarantines the abort).  In fsdp mode the reform additionally restores
+    ZeRO-3-sharded state collectively via Orbax onto the smaller world."""
     env = _worker_env(4 * EXAMPLES, 4 * SHARDS)
     env["EDL_MH_STEP_SLEEP"] = "0.04"  # keep the job alive past the kill
+    extra = ("--param-sharding", sharding)
     procs = {
         n: _spawn_worker(coord_server.port, n, tmp_path, 3, env,
-                         tmp_path / f"{n}.log")
+                         tmp_path / f"{n}.log", extra=extra)
         for n in ("w0", "w1", "w2")
     }
     _wait_for_line(tmp_path / "w0.log", "step 1 ", timeout_s=120)
