@@ -23,6 +23,17 @@ from edl_tpu.observability.logging import get_logger
 log = get_logger("runtime.data")
 
 
+def _row_splits(arrays: tuple[np.ndarray, ...],
+                num_shards: int) -> list[np.ndarray]:
+    """The one sharding contract both publication modes share: row-split
+    index sets for ``num_shards`` shards (deterministic, order-preserving)."""
+    n = arrays[0].shape[0]
+    for a in arrays:
+        if a.shape[0] != n:
+            raise ValueError("all arrays must share the leading dim")
+    return np.array_split(np.arange(n), num_shards)
+
+
 class ShardRegistry:
     """Registers in-memory array shards as queue tasks and resolves leases
     back to data (the local stand-in for RecordIO files on GCS)."""
@@ -37,17 +48,15 @@ class ShardRegistry:
         deterministic split; only one worker enqueues the tasks — the same
         separation as RecordIO files on shared storage vs. the master's
         task list (reference example/train_ft.py:112)."""
-        n = arrays[0].shape[0]
-        for a in arrays:
-            if a.shape[0] != n:
-                raise ValueError("all arrays must share the leading dim")
-        splits = np.array_split(np.arange(n), num_shards)
         ids = []
-        for idx in splits:
+        for idx in _row_splits(arrays, num_shards):
             shard_id = len(self._shards)
             self._shards[shard_id] = tuple(a[idx] for a in arrays)
             ids.append(shard_id)
         return ids
+
+    def get(self, shard_id: int) -> tuple[np.ndarray, ...]:
+        return self._shards[shard_id]
 
     def enqueue(self, coord, shard_ids: list[int]) -> None:
         for shard_id in shard_ids:
@@ -59,8 +68,137 @@ class ShardRegistry:
         self.enqueue(coord, self.register_arrays(arrays, num_shards))
 
     def fetch(self, payload: bytes) -> tuple[np.ndarray, ...]:
-        shard_id = json.loads(payload.decode())["shard"]
-        return self._shards[shard_id]
+        return self.get(json.loads(payload.decode())["shard"])
+
+
+class FileShardStore:
+    """Shard FILES on (shared) storage, leased through the queue — the
+    role of the reference's RecordIO chunk files + master task list
+    (example/train_ft.py:112: ``cloud_reader([shards], etcd)``): writers
+    shard a dataset into files once; any number of trainers — joining and
+    leaving freely — lease file payloads and stream them.  Unlike
+    :class:`ShardRegistry`, nothing about the dataset lives in trainer
+    memory until a shard is leased, so datasets scale past RAM and a
+    fresh joiner needs no registration step.
+
+    Format: one ``.npz`` per shard, arrays stored in batch order under
+    keys ``a0..aN`` (numpy's own container — portable, seekable,
+    compression-free for mmap-friendly reads)."""
+
+    @staticmethod
+    def write_shards(directory: str, arrays: tuple[np.ndarray, ...],
+                     num_shards: int, prefix: str = "shard",
+                     on_shard: Optional[Callable[[], None]] = None
+                     ) -> list[str]:
+        """Row-shard ``arrays`` into ``num_shards`` files; returns paths.
+        Atomic per file (tmp + rename) so a concurrent reader can never
+        see a truncated shard, and idempotent (same inputs → same bytes at
+        the same paths) so a takeover re-write after a seeder crash is
+        safe.  ``on_shard`` fires after each file — the seeding claim's
+        liveness heartbeat."""
+        import os
+
+        os.makedirs(directory, exist_ok=True)
+        paths = []
+        for i, idx in enumerate(_row_splits(arrays, num_shards)):
+            path = os.path.join(directory, f"{prefix}-{i:05d}.npz")
+            tmp = path + ".tmp.npz"
+            np.savez(tmp, **{f"a{j}": a[idx]
+                             for j, a in enumerate(arrays)})
+            os.replace(tmp, path)
+            paths.append(path)
+            if on_shard is not None:
+                on_shard()
+        return paths
+
+    @staticmethod
+    def enqueue(coord, paths: list[str]) -> None:
+        for path in paths:
+            coord.add_task(json.dumps({"file": path}).encode())
+
+    @staticmethod
+    def fetch_path(path: str) -> tuple[np.ndarray, ...]:
+        with np.load(path) as z:
+            return tuple(z[k] for k in sorted(z.files,
+                                              key=lambda s: int(s[1:])))
+
+    @staticmethod
+    def fetch(payload: bytes) -> tuple[np.ndarray, ...]:
+        return FileShardStore.fetch_path(
+            json.loads(payload.decode())["file"])
+
+
+def fetch_payload(payload: bytes,
+                  registry: Optional[ShardRegistry] = None
+                  ) -> tuple[np.ndarray, ...]:
+    """Resolve either payload kind: ``{"shard": id}`` via the in-memory
+    registry, ``{"file": path}`` via the file store — so one consumer
+    iterates a queue regardless of how the dataset was published."""
+    kind = json.loads(payload.decode())
+    if "file" in kind:
+        return FileShardStore.fetch_path(kind["file"])
+    if registry is None:
+        raise ValueError("shard-id payload without a registry")
+    return registry.get(kind["shard"])
+
+
+#: seeding-claim liveness: a claim not renewed for this long, with a
+#: completely untouched queue, is a dead seeder and may be taken over
+SEED_STALE_MS = 30_000
+
+
+def ensure_seeded(coord, name: str, seed_fn: Callable[[Callable[[], None]],
+                                                      None],
+                  stale_ms: int = SEED_STALE_MS,
+                  poll_s: float = 0.5) -> None:
+    """Crash-safe one-time data seeding (closes the window a bare CAS
+    leaves: a seeder dying between claiming and enqueueing would hang the
+    job forever with an empty queue).
+
+    Protocol on the ``data-seeder`` KV key: claim with a renewable
+    ``seeding:<name>:<ms>`` marker, run ``seed_fn(beat)`` — which must
+    call ``beat()`` periodically during long writes and enqueue the tasks
+    as its LAST step — then flip the marker to ``seeded``.  Everyone else
+    blocks here until the flip; a claim gone stale while the queue is
+    still completely untouched is taken over (the file writes are
+    idempotent).  Residual window: a seeder dying MID-ENQUEUE leaves a
+    partially-filled queue that blocks takeover — but the enqueue is a
+    few fast RPCs (the long dataset write happens before it), the same
+    exposure the in-memory protocol always had."""
+    import time as _time
+
+    def now_ms() -> int:
+        return int(_time.time() * 1000)
+
+    def claim_bytes() -> bytes:
+        return f"seeding:{name}:{now_ms()}".encode()
+
+    while True:
+        raw = coord.kv_get("data-seeder")
+        if raw == b"seeded":
+            return
+        if raw is None:
+            if not coord.kv_cas("data-seeder", b"", claim_bytes()):
+                continue  # lost the race; re-read
+        else:
+            try:
+                _, _, ts = raw.decode().split(":")
+                age = now_ms() - int(ts)
+            except ValueError:
+                return  # unknown marker owner; leave it alone
+            s = coord.stats()
+            touched = s.todo or s.leased or s.done
+            if age < stale_ms or touched:
+                _time.sleep(poll_s)
+                continue
+            if not coord.kv_cas("data-seeder", raw, claim_bytes()):
+                continue  # someone else took over first
+            log.warn("taking over stale seeding claim", stale=raw.decode())
+        # we hold the claim
+        beat = lambda: coord.kv_set("data-seeder", claim_bytes())
+        seed_fn(beat)
+        coord.kv_set("data-seeder", b"seeded")
+        return
 
 
 class TaskLeaseBatches:

@@ -29,7 +29,8 @@ from edl_tpu.runtime.multihost import _pin_platform_from_env
 # harness runs N CPU processes; the axon sitecustomize pins otherwise)
 _pin_platform_from_env()
 
-from edl_tpu.runtime.data import ShardRegistry
+from edl_tpu.runtime.data import (FileShardStore, ShardRegistry,
+                                  ensure_seeded, fetch_payload)
 from edl_tpu.runtime.multihost import (
     WorldHandle,
     load_numpy_tree,
@@ -240,7 +241,8 @@ def train_world(world: WorldHandle, state, should_stop, *, coord, name,
         print(f"[{name}] entering world epoch={world.epoch} "
               f"world={world.world_size} at step={nstep}", flush=True)
 
-    src = LeasedBatchSource(coord, name, registry.fetch, LOCAL_BATCH)
+    fetch = functools.partial(fetch_payload, registry=registry)
+    src = LeasedBatchSource(coord, name, fetch, LOCAL_BATCH)
     # one flag row per local device so P("dp") tiles evenly on multi-chip
     # hosts (each process replicates its flag across its own devices)
     flag_dim = jax.local_device_count()
@@ -360,10 +362,27 @@ def main(argv=None) -> int:
     host, _, port = args.coord.rpartition(":")
     coord = CoordClient(host, int(port))
 
+    # Data publication: EDL_MH_DATA_DIR switches from in-memory shards
+    # (every worker re-derives the same split) to REAL shard files on
+    # shared storage (the reference's RecordIO chunks) — written once by
+    # the claim-elected seeder, streamed by everyone on lease.  The claim
+    # is renewable and takeover-able, so a seeder crashing mid-write
+    # cannot hang the job (runtime.data.ensure_seeded).
+    data_dir = os.environ.get("EDL_MH_DATA_DIR", "")
     registry = ShardRegistry()
-    shard_ids = registry.register_arrays(make_dataset(), SHARDS)
-    if coord.kv_cas("data-seeder", b"", args.name.encode()):
-        registry.enqueue(coord, shard_ids)
+    if not data_dir:
+        shard_ids = registry.register_arrays(make_dataset(), SHARDS)
+
+    def seed(beat):
+        if data_dir:
+            FileShardStore.enqueue(
+                coord,
+                FileShardStore.write_shards(data_dir, make_dataset(),
+                                            SHARDS, on_shard=beat))
+        else:
+            registry.enqueue(coord, shard_ids)
+
+    ensure_seeded(coord, args.name, seed)
 
     fsdp = args.param_sharding == "fsdp"
     os.makedirs(args.ckpt_dir, exist_ok=True)

@@ -101,9 +101,16 @@ def _assert_exactly_once(client, shards: int) -> None:
 
 
 @pytest.mark.slow
-def test_join_wave_forms_one_world_and_drains(coord_server, tmp_path):
+@pytest.mark.parametrize("data_mode", ["memory", "files"])
+def test_join_wave_forms_one_world_and_drains(coord_server, tmp_path,
+                                              data_mode):
     env = _worker_env(SMALL_EXAMPLES, SMALL_SHARDS)
     env["EDL_MH_TRACE"] = str(tmp_path / "traces")
+    if data_mode == "files":
+        # REAL shard files on shared storage (the reference's RecordIO
+        # chunks): the seeder writes them once, every worker streams on
+        # lease — nothing dataset-sized in worker memory up front
+        env["EDL_MH_DATA_DIR"] = str(tmp_path / "shards")
     procs = {
         n: _spawn_worker(coord_server.port, n, tmp_path, 2, env,
                          tmp_path / f"{n}.log")
@@ -123,6 +130,9 @@ def test_join_wave_forms_one_world_and_drains(coord_server, tmp_path):
     trace = _json.loads((tmp_path / "traces" / "trace-w0.json").read_text())
     names = {e.get("name") for e in trace.get("traceEvents", trace)}
     assert "world_exit" in names
+    if data_mode == "files":
+        shards = list((tmp_path / "shards").glob("shard-*.npz"))
+        assert len(shards) == SMALL_SHARDS
 
 
 @pytest.mark.slow

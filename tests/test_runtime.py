@@ -225,3 +225,94 @@ def test_checkpoint_restore_onto_different_mesh(tmp_path):
         t2.step((x[:64], y[:64]))
     assert t2.eval_loss((x, y)) < l0
     ckpt.close()
+
+
+def test_file_shard_store_round_trip(tmp_path):
+    """Shard files on storage (the reference's RecordIO chunks): write
+    once, lease file payloads, stream back exactly the original rows."""
+    import json
+
+    import numpy as np
+
+    from edl_tpu.coord.service import PyCoordService
+    from edl_tpu.runtime.data import (FileShardStore, ShardRegistry,
+                                      fetch_payload)
+
+    x = np.arange(40, dtype=np.float32).reshape(10, 4)
+    y = np.arange(10, dtype=np.float32)
+    paths = FileShardStore.write_shards(str(tmp_path), (x, y), 3)
+    assert len(paths) == 3 and all(p.endswith(".npz") for p in paths)
+    coord = PyCoordService()
+    FileShardStore.enqueue(coord, paths)
+    rows = []
+    while True:
+        status, tid, payload = coord.lease("w0")
+        if status.name != "OK":
+            break
+        sx, sy = fetch_payload(payload)
+        assert sx.shape[0] == sy.shape[0]
+        rows.extend(sy.tolist())
+        coord.complete(tid, "w0")
+    assert sorted(rows) == y.tolist()  # every row exactly once
+    # dispatch still resolves in-memory payloads through the registry
+    reg = ShardRegistry()
+    reg.register_arrays((x, y), 2)
+    got = fetch_payload(json.dumps({"shard": 0}).encode(), registry=reg)
+    assert got[0].shape[0] == 5
+
+
+def test_ensure_seeded_survives_dead_seeder():
+    """The seeding claim is renewable and takeover-able: a seeder that
+    died after claiming (even mid-dataset-write) cannot hang the job —
+    a live worker takes the stale claim over and seeds idempotently."""
+    from edl_tpu.coord.service import PyCoordService
+    from edl_tpu.runtime.data import ensure_seeded
+
+    coord = PyCoordService()
+    seeded_by = []
+
+    def seed(name):
+        def fn(beat):
+            beat()  # liveness renewal during the 'write'
+            coord.add_task(b"t0")
+            coord.add_task(b"t1")
+            seeded_by.append(name)
+        return fn
+
+    # w0 claims then DIES before enqueueing anything (stale marker,
+    # untouched queue)
+    assert coord.kv_cas("data-seeder", b"", b"seeding:w0:0")
+    ensure_seeded(coord, "w1", seed("w1"), stale_ms=1, poll_s=0.01)
+    assert seeded_by == ["w1"]
+    assert coord.kv_get("data-seeder") == b"seeded"
+    s = coord.stats()
+    assert s.todo == 2
+    # later joiners see 'seeded' and do nothing
+    ensure_seeded(coord, "w2", seed("w2"))
+    assert seeded_by == ["w1"]
+
+
+def test_ensure_seeded_does_not_steal_live_claim():
+    """A FRESH claim (the seeder is alive, mid-write) must not be taken
+    over; the waiter blocks until the flip."""
+    import threading
+    import time
+
+    from edl_tpu.coord.service import PyCoordService
+    from edl_tpu.runtime.data import ensure_seeded
+
+    coord = PyCoordService()
+    now = int(time.time() * 1000)
+    assert coord.kv_cas("data-seeder", b"", f"seeding:w0:{now}".encode())
+    stolen = []
+    t = threading.Thread(
+        target=lambda: (ensure_seeded(coord, "w1",
+                                      lambda beat: stolen.append(1),
+                                      stale_ms=60_000, poll_s=0.01)),
+        daemon=True)
+    t.start()
+    time.sleep(0.2)
+    assert not stolen and t.is_alive()  # waiting, not stealing
+    coord.kv_set("data-seeder", b"seeded")  # the live seeder finishes
+    t.join(timeout=5)
+    assert not t.is_alive() and not stolen
