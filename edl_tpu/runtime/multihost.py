@@ -73,6 +73,15 @@ _CKPT_KEY = "ckpt/{epoch}"
 _CKPT_WRITER_KEY = "ckpt-writer/{epoch}"
 _LEAVE_KEY = "leave-intent/{epoch}"
 
+
+def _gen_from_key(key: str) -> Optional[int]:
+    """Epoch number from a per-generation KV key ('<prefix>/<n>'); the one
+    parser latest_state and the GC share."""
+    try:
+        return int(key.rsplit("/", 1)[1])
+    except (IndexError, ValueError):
+        return None
+
 #: Child exit code for "world aborted, reform" (a Python-visible failure;
 #: XLA coordination-service aborts arrive as negative signal codes).
 WORLD_ABORTED = 3
@@ -304,9 +313,8 @@ class ElasticWorld:
         """Highest published generation ≤ upto_epoch, as (epoch, path)."""
         best: Optional[tuple[int, str]] = None
         for key in self._coord.kv_keys("ckpt/"):
-            try:
-                gen = int(key.split("/", 1)[1])
-            except (IndexError, ValueError):
+            gen = _gen_from_key(key)
+            if gen is None:
                 continue
             if gen <= upto_epoch and (best is None or gen > best[0]):
                 raw = self._coord.kv_get(key)
@@ -364,6 +372,63 @@ class WorkerConfig:
     heartbeat_timeout_s: int = 10
     state_wait_s: float = 30.0
     collective_ckpt: bool = False
+
+
+#: exactly how many of the newest state generations survive GC.  The
+#: newest is load-bearing and peers can be mid-load of the one before it
+#: during a reform; one more is margin.  Anything older is unreachable by
+#: protocol (latest_state always resolves the newest ≤ epoch).
+KEEP_GENERATIONS = 3
+
+
+def prune_generations(coord, ckpt_dir: str, upto_gen: int,
+                      keep: int = KEEP_GENERATIONS) -> int:
+    """GC everything per-generation older than the ``keep`` newest: the
+    gen files (npz) or directories (Orbax), per-epoch result reports,
+    their KV pointers, and the writer/endpoint claims.  Without this, a
+    long-running elastic job grows one full checkpoint plus bookkeeping
+    per membership change forever (the reference never hit this — pserver
+    state lived in place).  Idempotent and concurrency-safe: every
+    supervisor prunes; deletes of already-missing things are no-ops."""
+    import shutil
+
+    cutoff = upto_gen - keep + 1  # keep exactly the `keep` newest
+    if cutoff <= 0:
+        return 0
+    pruned = 0
+    for key in list(coord.kv_keys("ckpt/")) + list(
+            coord.kv_keys("ckpt-writer/")) + list(
+            coord.kv_keys("jax-coordinator/")):
+        gen = _gen_from_key(key)
+        if gen is not None and gen < cutoff:
+            coord.kv_del(key)
+    try:
+        entries = os.listdir(ckpt_dir)
+    except OSError:
+        return pruned
+    for entry in entries:
+        if entry.startswith("gen-"):
+            stem = entry[4:].split(".", 1)[0]
+        elif entry.startswith("result-") and entry.endswith(".json"):
+            stem = entry[:-5].rsplit("-", 1)[1]
+        else:
+            continue
+        try:
+            gen = int(stem)
+        except ValueError:
+            continue
+        if gen >= cutoff:
+            continue
+        path = os.path.join(ckpt_dir, entry)
+        try:
+            if os.path.isdir(path):
+                shutil.rmtree(path)
+            else:
+                os.remove(path)
+            pruned += 1
+        except OSError:
+            pass  # a peer pruned it first
+    return pruned
 
 
 @dataclass(frozen=True)
@@ -622,6 +687,10 @@ def run_elastic_worker(
                     last_path = result.get("state_path") or last_path
                     if result.get("step") is not None:
                         last_step = result["step"]
+                    try:
+                        prune_generations(coord, ckpt_dir, plan.epoch + 1)
+                    except Exception as exc:  # GC must never kill a worker
+                        log.warn("generation prune failed", error=str(exc))
                     if not result["stopped"]:  # queue drained — job done
                         break
                     if announced:  # our own graceful leave completed

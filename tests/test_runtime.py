@@ -316,3 +316,37 @@ def test_ensure_seeded_does_not_steal_live_claim():
     coord.kv_set("data-seeder", b"seeded")  # the live seeder finishes
     t.join(timeout=5)
     assert not t.is_alive() and not stolen
+
+
+def test_prune_generations(tmp_path):
+    """Old state generations (files, Orbax dirs, KV pointers, per-epoch
+    claims) are GC'd past the keep window; recent ones and 'final' stay."""
+    import os
+
+    from edl_tpu.coord.service import PyCoordService
+    from edl_tpu.runtime.multihost import prune_generations
+
+    coord = PyCoordService()
+    for gen in range(1, 9):
+        coord.kv_set(f"ckpt/{gen}", f"gen-{gen}".encode())
+        coord.kv_set(f"ckpt-writer/{gen}", b"w0")
+        coord.kv_set(f"jax-coordinator/{gen}", b"h:1")
+        (tmp_path / f"gen-{gen}.npz").write_bytes(b"x")
+        (tmp_path / f"result-w0-{gen}.json").write_text("{}")
+    os.makedirs(tmp_path / "gen-2" / "0")  # an Orbax-style gen dir
+    (tmp_path / "final.npz").write_bytes(b"x")
+
+    pruned = prune_generations(coord, str(tmp_path), upto_gen=8, keep=3)
+    assert pruned > 0
+    kept = set(p.name for p in tmp_path.iterdir())
+    assert "final.npz" in kept
+    # exactly the `keep` newest generations survive
+    assert {"gen-6.npz", "gen-7.npz", "gen-8.npz"} <= kept
+    assert not any(n in kept for n in ("gen-1.npz", "gen-2", "gen-5.npz"))
+    # per-epoch result reports are bounded by the same window
+    assert "result-w0-8.json" in kept and "result-w0-2.json" not in kept
+    assert coord.kv_get("ckpt/5") is None
+    assert coord.kv_get("ckpt/6") is not None
+    assert coord.kv_get("jax-coordinator/3") is None
+    # idempotent / concurrency-safe: a second pruner is a no-op
+    assert prune_generations(coord, str(tmp_path), upto_gen=8, keep=3) == 0
