@@ -21,6 +21,7 @@ surface:
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 import time
 
@@ -73,7 +74,10 @@ def cmd_collector(args) -> int:
 def cmd_coordinator(args) -> int:
     from edl_tpu.coord import server as coord_server
 
-    return coord_server.main(["--port", str(args.port)])
+    argv = ["--port", str(args.port)]
+    if args.state_file:
+        argv += ["--state-file", args.state_file]
+    return coord_server.main(argv)
 
 
 def cmd_launch(args) -> int:
@@ -184,6 +188,10 @@ def build_parser() -> argparse.ArgumentParser:
 
     c = sub.add_parser("coordinator", help="run the coordination server")
     c.add_argument("--port", type=int, default=7164)
+    c.add_argument("--state-file",
+                   default=os.environ.get("EDL_COORD_STATE_FILE", ""),
+                   help="write-through durability file (restart with the "
+                        "same path to resume queue/KV/epoch state)")
     c.set_defaults(fn=cmd_coordinator)
 
     c = sub.add_parser("launch", help="pod-role entrypoint")
