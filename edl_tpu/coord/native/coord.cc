@@ -2,7 +2,10 @@
 
 #include "coord.hpp"
 
+#include <fcntl.h>
 #include <unistd.h>
+
+#include <cerrno>
 
 #include <algorithm>
 #include <cstdio>
@@ -57,6 +60,7 @@ int64_t TaskQueue::AddTask(const std::string& payload) {
   t.id = next_id_++;
   t.payload = payload;
   todo_.push_back(std::move(t));
+  version_.fetch_add(1);
   return next_id_ - 1;
 }
 
@@ -97,6 +101,7 @@ bool TaskQueue::Complete(int64_t task_id, const std::string& worker) {
   if (!worker.empty() && it->second.worker != worker) return false;
   done_.push_back(std::move(it->second.task));
   leased_.erase(it);
+  version_.fetch_add(1);  // pending→done is a snapshot-visible move
   MaybeAdvancePass();
   return true;
 }
@@ -114,6 +119,7 @@ bool TaskQueue::Fail(int64_t task_id, const std::string& worker) {
   } else {
     todo_.push_back(std::move(t));
   }
+  version_.fetch_add(1);  // failure count / dropped counter changed
   MaybeAdvancePass();
   return true;
 }
@@ -185,6 +191,10 @@ void TaskQueue::MaybeAdvancePass() {
       // instead of livelocking every LeaseTask on kEmpty.
       pass_ = total_passes_ - 1;
     }
+    // Reached from LeaseTask too (a lease can trigger rollover): bump so
+    // the server persists even though LEASE itself is not a "mutating"
+    // command — a crash after the rollover must not replay the old pass.
+    version_.fetch_add(1);
   }
 }
 
@@ -273,7 +283,10 @@ int64_t Membership::Join(const std::string& name, const std::string& address,
   m.name = name;
   m.address = address;
   m.deadline_ms = now_ms + ttl_ms_;
-  if (change) epoch_ += 1;
+  if (change) {
+    epoch_ += 1;
+    version_.fetch_add(1);
+  }
   return epoch_;
 }
 
@@ -289,6 +302,7 @@ bool Membership::Leave(const std::string& name) {
   std::lock_guard<std::mutex> lock(mu_);
   if (members_.erase(name) == 0) return false;
   epoch_ += 1;
+  version_.fetch_add(1);
   return true;
 }
 
@@ -303,7 +317,10 @@ int Membership::Expire(int64_t now_ms) {
       ++it;
     }
   }
-  if (n > 0) epoch_ += 1;
+  if (n > 0) {
+    epoch_ += 1;
+    version_.fetch_add(1);
+  }
   return n;
 }
 
@@ -314,7 +331,10 @@ int64_t Membership::Epoch() const {
 
 void Membership::ForceEpoch(int64_t epoch) {
   std::lock_guard<std::mutex> lock(mu_);
-  if (epoch > epoch_) epoch_ = epoch;
+  if (epoch > epoch_) {
+    epoch_ = epoch;
+    version_.fetch_add(1);
+  }
 }
 
 std::vector<MemberInfo> Membership::Members(int64_t now_ms) {
@@ -332,6 +352,7 @@ std::vector<MemberInfo> Membership::Members(int64_t now_ms) {
 void KvStore::Set(const std::string& key, const std::string& value) {
   std::lock_guard<std::mutex> lock(mu_);
   kv_[key] = value;
+  version_.fetch_add(1);
 }
 
 bool KvStore::Get(const std::string& key, std::string* value) const {
@@ -344,7 +365,9 @@ bool KvStore::Get(const std::string& key, std::string* value) const {
 
 bool KvStore::Del(const std::string& key) {
   std::lock_guard<std::mutex> lock(mu_);
-  return kv_.erase(key) > 0;
+  if (kv_.erase(key) == 0) return false;
+  version_.fetch_add(1);
+  return true;
 }
 
 bool KvStore::Cas(const std::string& key, const std::string& expect,
@@ -354,10 +377,12 @@ bool KvStore::Cas(const std::string& key, const std::string& expect,
   if (expect.empty()) {
     if (it != kv_.end()) return false;
     kv_[key] = value;
+    version_.fetch_add(1);
     return true;
   }
   if (it == kv_.end() || it->second != expect) return false;
   it->second = value;
+  version_.fetch_add(1);
   return true;
 }
 
@@ -443,7 +468,25 @@ bool Service::SaveTo(const std::string& path) const {
   ok = (fsync(fileno(f)) == 0) && ok;
   std::fclose(f);
   if (!ok) return false;
-  return std::rename(tmp.c_str(), path.c_str()) == 0;
+  if (std::rename(tmp.c_str(), path.c_str()) != 0) return false;
+  // The rename itself must survive a host power loss: fsync the parent
+  // directory so the new directory entry is on disk before the caller
+  // acks (etcd's WAL discipline — the role the reference's etcd sidecar
+  // played, pkg/jobparser.go:167-184).  Policy: a real fsync error means
+  // the entry may not be durable → do not ack (return false; the caller
+  // retries on the next mutation).  EINVAL/ENOTSUP (filesystems that do
+  // not support directory fsync) and an unopenable directory degrade to
+  // best-effort: the content is fsynced and the rename applied, the only
+  // exposure is the OLD complete snapshot reappearing after a power loss
+  // — refusing to ack forever on such filesystems would be worse.
+  std::string dir = ".";
+  size_t slash = path.find_last_of('/');
+  if (slash != std::string::npos) dir = path.substr(0, slash);
+  int dfd = open(dir.c_str(), O_RDONLY | O_DIRECTORY);
+  if (dfd < 0) return true;
+  bool synced = fsync(dfd) == 0 || errno == EINVAL || errno == ENOTSUP;
+  close(dfd);
+  return synced;
 }
 
 bool Service::LoadFrom(const std::string& path) {

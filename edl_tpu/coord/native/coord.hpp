@@ -21,6 +21,7 @@
 
 #pragma once
 
+#include <atomic>
 #include <cstdint>
 #include <deque>
 #include <map>
@@ -97,6 +98,12 @@ class TaskQueue {
   // ignored so the format can grow.
   void RestoreLine(const std::string& line);
 
+  // Bumped whenever a field that appears in SerializeTo changes — including
+  // the lease-driven paths (pass rollover, poison-pill drop) that no
+  // explicit client command announces.  The server persists when this
+  // moves, so a LEASE that rolls the pass over is durable before its ack.
+  int64_t DurableVersion() const { return version_.load(); }
+
  private:
   struct Leased {
     Task task;
@@ -107,6 +114,7 @@ class TaskQueue {
   void MaybeAdvancePass();
 
   mutable std::mutex mu_;
+  std::atomic<int64_t> version_{0};
   int64_t timeout_ms_;
   int total_passes_;
   int max_failures_;
@@ -150,8 +158,13 @@ class Membership {
   // with an explicit, coordinator-owned ordering).
   std::vector<MemberInfo> Members(int64_t now_ms);
 
+  // Bumped on every epoch change (the only membership field a snapshot
+  // carries).
+  int64_t DurableVersion() const { return version_.load(); }
+
  private:
   mutable std::mutex mu_;
+  std::atomic<int64_t> version_{0};
   int64_t ttl_ms_;
   int64_t epoch_ = 0;
   std::map<std::string, MemberInfo> members_;
@@ -170,8 +183,11 @@ class KvStore {
   std::vector<std::string> Keys(const std::string& prefix) const;
   std::vector<std::pair<std::string, std::string>> Items() const;
 
+  int64_t DurableVersion() const { return version_.load(); }
+
  private:
   mutable std::mutex mu_;
+  std::atomic<int64_t> version_{0};
   std::unordered_map<std::string, std::string> kv_;
 };
 
@@ -191,9 +207,18 @@ struct Service {
   // role of the reference's etcd sidecar (pkg/jobparser.go:167-184).
   std::string Snapshot() const;
   bool Restore(const std::string& blob);
-  // Atomic file write-through (temp + rename) / startup load.
+  // Atomic, host-crash-durable file write-through (temp + fsync + rename +
+  // directory fsync) / startup load.
   bool SaveTo(const std::string& path) const;
   bool LoadFrom(const std::string& path);
+
+  // Sum of the components' durable-state versions: cheap change detection
+  // for the server's persist gate (no O(state) serialize-and-compare on
+  // read-mostly commands like the per-step MEMBERS poll).
+  int64_t DurableVersion() const {
+    return queue.DurableVersion() + membership.DurableVersion() +
+           kv.DurableVersion();
+  }
 };
 
 }  // namespace edlcoord

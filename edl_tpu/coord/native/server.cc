@@ -32,6 +32,7 @@
 #include <sys/socket.h>
 #include <unistd.h>
 
+#include <atomic>
 #include <chrono>
 #include <csignal>
 #include <cstdio>
@@ -52,47 +53,39 @@ int g_passes = 1;
 int64_t g_member_ttl_ms = edlcoord::kDefaultMemberTtlMs;
 
 // Write-through durability (role of the reference's etcd sidecar,
-// pkg/jobparser.go:167-184): after every command that may change durable
-// state, snapshot to --state-file if the content differs from the last
-// write.  Lease ownership and heartbeat deadlines are deliberately not
-// durable (the snapshot id-sorts pending tasks, so LEASE/RENEW/RELEASE
-// leave it byte-identical), keeping the hot dispatch path write-free.
+// pkg/jobparser.go:167-184): after ANY command, if the service's
+// durable-state version moved, snapshot to --state-file.  The version
+// counter is bumped by the actual mutation sites in the core — including
+// the ones no mutating client command announces (pass rollover/finish
+// inside LEASE, epoch bump from MEMBERS' expiry sweep) —
+// so the persist gate is a single atomic compare per command, not an
+// O(state) serialize-and-compare, and nothing durable can slip past it.
+// Lease ownership and heartbeat deadlines are deliberately not durable
+// (the snapshot id-sorts pending tasks, so a plain LEASE/RENEW/RELEASE
+// does not bump the version), keeping the hot dispatch path write-free.
 // A failed write degrades to in-memory mode LOUDLY: it cannot un-apply the
 // op, but the operator sees every failure on stderr and the next
 // successful write re-covers the backlog (the snapshot is always total).
 std::string g_state_file;
-std::string g_last_snapshot;
+std::atomic<int64_t> g_persisted_version{-1};
 std::mutex g_persist_mu;
 
 void MaybePersist() {
   if (g_state_file.empty()) return;
   std::lock_guard<std::mutex> lock(g_persist_mu);
-  std::string snap = g_service->Snapshot();
-  if (snap == g_last_snapshot) return;
+  // Read the version BEFORE snapshotting: a concurrent mutation landing
+  // mid-snapshot then re-triggers persistence on its own command, never
+  // the reverse (recording a version whose state was not yet written).
+  int64_t version = g_service->DurableVersion();
+  if (version == g_persisted_version.load()) return;
   if (g_service->SaveTo(g_state_file)) {
-    g_last_snapshot = std::move(snap);
+    g_persisted_version.store(version);
   } else {
     std::fprintf(stderr,
                  "edl-coord: PERSIST FAILED for %s — state is in-memory "
                  "only until a write succeeds\n",
                  g_state_file.c_str());
   }
-}
-
-// Commands whose success can change durable state (queue accounting,
-// KV, membership epoch).  MEMBERS is included because its expiry sweep
-// can bump the epoch.
-bool IsDurableMutation(const std::string& line) {
-  static const char* kPrefixes[] = {"ADD",   "COMPLETE", "FAIL",  "JOIN",
-                                    "LEAVE", "MEMBERS",  "KVSET", "KVDEL",
-                                    "KVCAS"};
-  for (const char* p : kPrefixes) {
-    size_t n = std::strlen(p);
-    if (line.compare(0, n, p) == 0 &&
-        (line.size() == n || line[n] == ' '))
-      return true;
-  }
-  return false;
 }
 
 int64_t NowMs() {
@@ -122,9 +115,11 @@ std::string Handle(const std::string& line) {
   } catch (const std::exception& e) {
     return std::string("ERR bad-arg ") + e.what();
   }
-  // Persist BEFORE acking: once a worker sees OK for a COMPLETE or KVSET,
-  // a coordinator restart must not forget it.
-  if (IsDurableMutation(line)) MaybePersist();
+  // Persist BEFORE acking: once a worker sees OK for a COMPLETE or KVSET
+  // — or an OK LEASE whose side effect rolled the pass over — a
+  // coordinator restart must not forget it.
+  if (g_service->DurableVersion() != g_persisted_version.load())
+    MaybePersist();
   return resp;
 }
 
@@ -280,6 +275,12 @@ int main(int argc, char** argv) {
   g_service = new edlcoord::Service(task_timeout_ms, passes, member_ttl_ms);
   g_state_file = state_file;
   bool restored = !state_file.empty() && g_service->LoadFrom(state_file);
+  // Baseline the persist gate in every case: after a restore, what's on
+  // disk IS the current state; on a fresh start (or a present-but-
+  // unloadable file) only an actual mutation may write — a read-only
+  // command like PING must never replace an unloadable file the operator
+  // may still want to inspect with an empty snapshot.
+  g_persisted_version.store(g_service->DurableVersion());
   if (!state_file.empty() && !restored &&
       access(state_file.c_str(), F_OK) == 0) {
     // a present-but-unloadable file is a serious event — start fresh (a

@@ -88,6 +88,41 @@ def test_state_survives_kill9_restart(tmp_path):
         _kill9(srv2)
 
 
+def test_lease_driven_rollover_survives_kill9(tmp_path):
+    """A LEASE is not a mutating command — but its side effects can be
+    (multi-pass rollover recycles every done task and bumps the pass,
+    coord.cc MaybeAdvancePass).  A crash between that LEASE and the next
+    explicit mutation must not restore the pre-rollover snapshot, or the
+    finished pass replays (the round-2 advisor's medium finding)."""
+    state = str(tmp_path / "coord.state")
+    srv = spawn_server(task_timeout_ms=60000, state_file=state, passes=2)
+    try:
+        c = srv.client()
+        for i in range(2):
+            c.add_task(f"shard-{i}".encode())
+        for _ in range(2):
+            st, tid, _ = c.lease("w0")
+            assert st.name == "OK"
+            assert c.complete(tid, "w0")
+        # pass 0 done; this LEASE rolls the pass over AND hands out a task
+        st, tid, _ = c.lease("w0")
+        assert st.name == "OK"
+        assert c.stats().current_pass == 1
+    finally:
+        _kill9(srv)  # no durable command ran after the rollover lease
+
+    srv2 = spawn_server(task_timeout_ms=60000, state_file=state, passes=2)
+    try:
+        s = srv2.client().stats()
+        # the rollover is durable: pass 1 with both tasks pending again
+        # (the in-flight lease re-dispatches), done reset — NOT the stale
+        # pre-rollover snapshot (pass 0, done=2)
+        assert s.current_pass == 1
+        assert (s.todo, s.leased, s.done, s.dropped) == (2, 0, 0, 0)
+    finally:
+        _kill9(srv2)
+
+
 def test_client_reconnects_across_restart(tmp_path):
     state = str(tmp_path / "coord.state")
     port = _free_port()
