@@ -23,10 +23,17 @@ from edl_tpu.api.types import (
     TrainerSpec,
     TrainingJob,
     TrainingJobSpec,
+    TrainingJobStatus,
 )
 
 API_VERSION = "edl.tpu/v1"
 KIND = "TrainingJob"
+
+#: CRD coordinates (k8s/crd.yaml; role of the reference's
+#: pkg/apis/paddlepaddle/v1/types.go:12-28 constants).
+CRD_GROUP = "edl.tpu"
+CRD_VERSION = "v1"
+CRD_PLURAL = "trainingjobs"
 
 
 def _norm(d: dict[str, Any]) -> dict[str, Any]:
@@ -131,6 +138,58 @@ def job_to_dict(job: TrainingJob) -> dict[str, Any]:
     if t.topology is not None:
         doc["spec"]["trainer"]["topology"] = str(t.topology)
     return doc
+
+
+def status_to_dict(status: "TrainingJobStatus") -> dict[str, Any]:
+    """Status → the CR ``status`` subresource shape (reference
+    pkg/apis/paddlepaddle/v1/types.go:113-162; written back by
+    updateCRDStatus, pkg/updater/trainingJobUpdater.go:295-307)."""
+    return {
+        "phase": status.phase.value,
+        "reason": status.reason,
+        "replica_statuses": [
+            {
+                "resource_type": rs.resource_type,
+                "state": rs.state.value,
+                "resource_states": {k: v.value
+                                    for k, v in sorted(rs.resource_states.items())},
+            }
+            for rs in status.replica_statuses
+        ],
+    }
+
+
+def status_from_dict(doc: dict[str, Any] | None) -> "TrainingJobStatus":
+    from edl_tpu.api.types import (
+        JobPhase,
+        ResourceState,
+        TrainingJobStatus,
+        TrainingResourceStatus,
+    )
+
+    doc = doc or {}
+    try:
+        phase = JobPhase(doc.get("phase", "None"))
+    except ValueError:
+        phase = JobPhase.NONE
+    replica_statuses = []
+    for rs in doc.get("replica_statuses") or []:
+        try:
+            state = ResourceState(rs.get("state", "None"))
+            states = {k: ResourceState(v)
+                      for k, v in (rs.get("resource_states") or {}).items()}
+        except ValueError:
+            continue  # a future state value: skip the entry, keep the phase
+        replica_statuses.append(TrainingResourceStatus(
+            resource_type=rs.get("resource_type", ""),
+            state=state,
+            resource_states=states,
+        ))
+    return TrainingJobStatus(
+        phase=phase,
+        reason=doc.get("reason", ""),
+        replica_statuses=replica_statuses,
+    )
 
 
 def job_from_yaml(text: str) -> TrainingJob:

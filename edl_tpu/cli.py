@@ -54,10 +54,23 @@ def cmd_controller(args) -> int:
     log.info("controller starting", max_load_desired=args.max_load_desired,
              loop_seconds=args.loop_seconds)
     controller.start()
+    sync = None
+    if not getattr(args, "fake", False):
+        # The deployed watch: TrainingJob CRs drive the controller (role
+        # of WatchTrainingJobs, reference pkg/controller.go:79-108).  The
+        # fake backend has no CR store — there, jobs are submitted
+        # in-process (tests/demos).
+        from edl_tpu.controller.sync import TrainingJobSyncLoop
+
+        sync = TrainingJobSyncLoop(cluster, controller,
+                                   poll_seconds=args.loop_seconds)
+        sync.start()
     try:
         while True:  # role of the select{} park in edl.go:50
             time.sleep(3600)
     except KeyboardInterrupt:
+        if sync is not None:
+            sync.stop()
         controller.stop()
     return 0
 
@@ -87,13 +100,20 @@ def cmd_launch(args) -> int:
 
 
 def cmd_submit(args) -> int:
-    from edl_tpu.api.serde import load_job_file
+    from edl_tpu.api.serde import job_to_dict, load_job_file
     from edl_tpu.api.validation import set_defaults_and_validate
 
     job = load_job_file(args.manifest)
-    set_defaults_and_validate(job)
+    set_defaults_and_validate(job)  # reject locally before touching the API
     cluster = _build_cluster(args)
-    cluster.create_resources(job)
+    if getattr(args, "fake", False):
+        # no CR store in the fake backend: materialize directly (demo path)
+        cluster.create_resources(job)
+    else:
+        # Submission = creating the CR; the controller's sync loop
+        # validates, materializes and tracks phases (the reference's flow:
+        # kubectl create CR → informer onAdd, pkg/controller.go:110-148).
+        cluster.create_training_job_cr(job_to_dict(job))
     log.info("job submitted", job=job.full_name,
              trainers=f"{job.spec.trainer.min_instance}"
                       f"-{job.spec.trainer.max_instance}",
@@ -105,6 +125,12 @@ def cmd_delete(args) -> int:
     from edl_tpu.api.types import TrainingJob
 
     cluster = _build_cluster(args)
+    if not getattr(args, "fake", False):
+        # the controller's sync loop observes the CR deletion and tears
+        # the job down (reference onDelete, pkg/controller.go:156-161)
+        cluster.delete_training_job_cr(args.name)
+    # also delete pod resources directly so the verb works when no
+    # controller is running (the reference's del_jobs.sh role)
     cluster.delete_resources(
         TrainingJob(name=args.name, namespace=args.namespace))
     log.info("job deleted", job=f"{args.namespace}/{args.name}")
@@ -112,15 +138,31 @@ def cmd_delete(args) -> int:
 
 
 def format_status(cluster, namespace: str, name: str) -> str:
-    """Per-role / per-pod state table for one job (role of the reference's
-    CRD status detail, pkg/apis/paddlepaddle/v1/types.go:154-162, surfaced
-    the way `kubectl get tj` would have)."""
+    """Per-role / per-pod state table for one job, preferring the status
+    the controller recorded in the TrainingJob CR (what `kubectl get tj`
+    shows; reference pkg/updater/trainingJobUpdater.go:295-307), falling
+    back to a stateless recompute from live pods when no CR/controller is
+    around (the fake backend, or a job submitted without the CRD)."""
     from edl_tpu.controller.updater import compute_replica_statuses
 
     uid = f"{namespace}/{name}"
     lines = [f"job {uid}"]
+    statuses = None
+    cr = None
+    if hasattr(cluster, "get_training_job_cr"):
+        cr = cluster.get_training_job_cr(name)
+    if cr is not None and cr.get("status"):
+        from edl_tpu.api.serde import status_from_dict
+
+        status = status_from_dict(cr["status"])
+        phase = status.phase.value + (
+            f" ({status.reason})" if status.reason else "")
+        lines.append(f"  phase: {phase}  [recorded by controller]")
+        statuses = status.replica_statuses
+    if statuses is None:
+        statuses = compute_replica_statuses(cluster, uid)
     any_pod = False
-    for st in compute_replica_statuses(cluster, uid):
+    for st in statuses:
         lines.append(f"  {st.resource_type:<8} {st.state.value}")
         for pod, state in sorted(st.resource_states.items()):
             any_pod = True

@@ -74,6 +74,7 @@ class K8sCluster(Cluster):
             kubernetes.config.load_incluster_config()
         self._core = kubernetes.client.CoreV1Api()
         self._batch = kubernetes.client.BatchV1Api()
+        self._custom = kubernetes.client.CustomObjectsApi()
         self.namespace = namespace
 
     # The method bodies below mirror reference pkg/cluster.go behavior and
@@ -170,16 +171,27 @@ class K8sCluster(Cluster):
         return PodCounts(total, running, pending, succeeded, failed)
 
     def create_resources(self, job: TrainingJob) -> None:
+        """Materialize the job's pod groups.  A 409 AlreadyExists is
+        ADOPTION, not an error: after a controller restart the sync loop
+        re-submits every listed CR, and the job's resources are usually
+        still there — the updater then simply confirms the running cohort
+        (the reference's create also tolerates existing resources by
+        logging and continuing, pkg/controller.go:134-148)."""
         from edl_tpu.controller.jobparser import parse_to_manifests
 
         apps = kubernetes.client.AppsV1Api()
         for manifest in parse_to_manifests(job):
-            if manifest["kind"] == "Job":
-                self._batch.create_namespaced_job(job.namespace, manifest)
-            elif manifest["kind"] == "ReplicaSet":
-                apps.create_namespaced_replica_set(job.namespace, manifest)
-            elif manifest["kind"] == "Service":
-                self._core.create_namespaced_service(job.namespace, manifest)
+            try:
+                if manifest["kind"] == "Job":
+                    self._batch.create_namespaced_job(job.namespace, manifest)
+                elif manifest["kind"] == "ReplicaSet":
+                    apps.create_namespaced_replica_set(job.namespace, manifest)
+                elif manifest["kind"] == "Service":
+                    self._core.create_namespaced_service(job.namespace,
+                                                         manifest)
+            except kubernetes.client.exceptions.ApiException as exc:
+                if exc.status != 409:
+                    raise
 
     def list_training_jobs(self) -> list[str]:
         """Names of jobs with a trainer group in this namespace (role of
@@ -215,6 +227,70 @@ class K8sCluster(Cluster):
         except kubernetes.client.exceptions.ApiException as exc:
             if exc.status != 404:
                 raise
+
+    # -- TrainingJob custom resources (the deployed control-plane surface;
+    #    role of the reference's generated clientset CRUD+Watch,
+    #    pkg/client/clientset/versioned/typed/paddlepaddle/v1/
+    #    trainingjob.go:33-44) --------------------------------------------
+
+    def list_training_job_crs(self) -> list[dict]:
+        """All TrainingJob custom objects in this namespace (the poll-list
+        the sync loop diffs; role of the informer's ListWatch source,
+        reference pkg/controller.go:80-87)."""
+        from edl_tpu.api.serde import CRD_GROUP, CRD_PLURAL, CRD_VERSION
+
+        out = self._custom.list_namespaced_custom_object(
+            CRD_GROUP, CRD_VERSION, self.namespace, CRD_PLURAL)
+        return list(out.get("items") or [])
+
+    def get_training_job_cr(self, name: str) -> dict | None:
+        from edl_tpu.api.serde import CRD_GROUP, CRD_PLURAL, CRD_VERSION
+
+        try:
+            return self._custom.get_namespaced_custom_object(
+                CRD_GROUP, CRD_VERSION, self.namespace, CRD_PLURAL, name)
+        except kubernetes.client.exceptions.ApiException as exc:
+            if exc.status == 404:
+                return None
+            raise
+
+    def create_training_job_cr(self, manifest: dict) -> None:
+        """Submit = create the CR and let the controller materialize it
+        (the reference's submission flow, doc/usage.md + controller
+        onAdd, pkg/controller.go:110-148)."""
+        from edl_tpu.api.serde import CRD_GROUP, CRD_PLURAL, CRD_VERSION
+
+        self._custom.create_namespaced_custom_object(
+            CRD_GROUP, CRD_VERSION, self.namespace, CRD_PLURAL, manifest)
+
+    def delete_training_job_cr(self, name: str) -> bool:
+        from edl_tpu.api.serde import CRD_GROUP, CRD_PLURAL, CRD_VERSION
+
+        try:
+            self._custom.delete_namespaced_custom_object(
+                CRD_GROUP, CRD_VERSION, self.namespace, CRD_PLURAL, name)
+            return True
+        except kubernetes.client.exceptions.ApiException as exc:
+            if exc.status == 404:
+                return False
+            raise
+
+    def patch_training_job_status(self, name: str, status: dict) -> bool:
+        """Write phase + replica statuses into the CR's status subresource
+        so ``kubectl get tj`` shows them (role of updateCRDStatus,
+        reference pkg/updater/trainingJobUpdater.go:295-307).  False if the
+        CR vanished (deleted between list and patch) — not an error."""
+        from edl_tpu.api.serde import CRD_GROUP, CRD_PLURAL, CRD_VERSION
+
+        try:
+            self._custom.patch_namespaced_custom_object_status(
+                CRD_GROUP, CRD_VERSION, self.namespace, CRD_PLURAL, name,
+                {"status": status})
+            return True
+        except kubernetes.client.exceptions.ApiException as exc:
+            if exc.status == 404:
+                return False
+            raise
 
     def list_pods(self, job_uid: str | None = None, role: str | None = None
                   ) -> list["PodView"]:

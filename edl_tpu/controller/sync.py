@@ -1,0 +1,236 @@
+"""TrainingJob CRD sync loop — the deployed control plane's watch.
+
+Role of the reference's informer loop (reference pkg/controller.go:79-108:
+``cache.NewListWatchFromClient`` + ``cache.NewInformer`` dispatching
+onAdd/onUpdate/onDelete) plus the status write-back its Gen-2 updater added
+(``updateCRDStatus``, reference pkg/updater/trainingJobUpdater.go:295-307).
+This is what makes ``edl-tpu controller`` on a real cluster actually manage
+jobs: users ``kubectl apply`` TrainingJob custom objects; the loop diffs
+the listed set against the controller's registry and forwards
+
+  new CR          → Controller.submit   (validate → materialize → phases)
+  spec changed    → Controller.modify
+  CR gone         → Controller.delete   (full teardown)
+
+and each tick writes every job's phase + per-role replica statuses into
+the CR's status subresource (only on change), so ``kubectl get tj`` shows
+the lifecycle the way the reference's CRD printer columns did.
+
+Poll-list rather than a streaming watch: the controller's reconcile
+cadence is 5 s (reference pkg/autoscaler.go:31) and a LIST at that cadence
+is the reference's own resync model (its informer disables resync only
+because Gen-1 never wrote status back; a poll-list is also immune to the
+dropped-watch staleness a real informer must re-list to fix).  The diff is
+driven purely by the listed spec content, not resourceVersion bookkeeping,
+so a missed tick never loses an event — the next tick sees the same truth.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Optional, Protocol
+
+from edl_tpu.api.serde import job_from_dict, status_to_dict
+from edl_tpu.api.types import JobPhase, TrainingJob
+from edl_tpu.api.validation import ValidationError
+from edl_tpu.controller.controller import Controller
+from edl_tpu.observability.logging import get_logger
+
+log = get_logger("crd-sync")
+
+
+class TrainingJobStore(Protocol):
+    """The CR surface the loop needs (K8sCluster implements it; the test
+    stub's CustomObjectsApi backs it)."""
+
+    def list_training_job_crs(self) -> list[dict]: ...
+
+    def patch_training_job_status(self, name: str, status: dict) -> bool: ...
+
+
+class TrainingJobSyncLoop:
+    """Diff-based CR → controller synchronizer with status write-back."""
+
+    def __init__(
+        self,
+        store: TrainingJobStore,
+        controller: Controller,
+        poll_seconds: float = 5.0,
+    ) -> None:
+        self.store = store
+        self.controller = controller
+        self.poll_seconds = poll_seconds
+        #: uid → the spec dict we last acted on (change detection; spec
+        #: content, not resourceVersion, so replays are harmless)
+        self._seen_specs: dict[str, Any] = {}
+        #: uid → job object handed to the controller (delete needs it)
+        self._jobs: dict[str, TrainingJob] = {}
+        #: uid → last status dict written to the CR (write only on change,
+        #: reference trainingJobUpdater.go:295-307)
+        self._written_status: dict[str, dict] = {}
+        #: uid → spec dict rejected by validation (retry only when the
+        #: user edits the spec, not every tick)
+        self._rejected_specs: dict[str, Any] = {}
+        #: uid → reason a spec EDIT was rejected while the job keeps
+        #: running under its last valid spec (surfaced via status.reason)
+        self._rejected_update_reason: dict[str, str] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="trainingjob-sync")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.run_once()
+            except Exception as exc:  # LIST failures must not kill the loop
+                log.error("sync tick failed", error=str(exc))
+            self._stop.wait(self.poll_seconds)
+
+    # -- one reconcile tick ------------------------------------------------
+
+    def run_once(self) -> None:
+        """One list → diff → dispatch → status write-back pass."""
+        listed: dict[str, dict] = {}
+        for cr in self.store.list_training_job_crs():
+            meta = cr.get("metadata") or {}
+            name = meta.get("name", "")
+            if not name:
+                continue
+            ns = meta.get("namespace", "default")
+            listed[f"{ns}/{name}"] = cr
+
+        for uid, cr in listed.items():
+            spec = cr.get("spec") or {}
+            if uid not in self._seen_specs:
+                self._on_add(uid, cr, spec)
+            elif spec != self._seen_specs[uid]:
+                self._on_update(uid, cr, spec)
+
+        for uid in list(self._seen_specs):
+            if uid not in listed:
+                self._on_delete(uid)
+        for uid in list(self._rejected_specs):
+            if uid not in listed:  # a rejected CR deleted without ever
+                self._rejected_specs.pop(uid, None)  # becoming a job
+                self._written_status.pop(uid, None)
+
+        self._sweep_orphans(listed)
+        self._write_back_statuses(listed)
+
+    def _sweep_orphans(self, listed: dict[str, dict]) -> None:
+        """Tear down trainer groups whose CR no longer exists — a
+        `kubectl delete tj` issued while the controller was down leaves
+        resources no in-memory diff can see (the restart-blind spot of
+        the reference's informer too; its del_jobs.sh was the manual
+        fix).  On the CRD-driven control plane the CR is the source of
+        truth, so a group without a CR is garbage."""
+        lister = getattr(self.store, "list_training_jobs", None)
+        deleter = getattr(self.store, "delete_resources", None)
+        if lister is None or deleter is None:
+            return
+        namespace = getattr(self.store, "namespace", "default")
+        cr_names = {uid.split("/", 1)[1] for uid in listed}
+        managed = {uid.split("/", 1)[1] for uid in self._jobs}
+        try:
+            group_names = set(lister())
+        except Exception as exc:
+            log.error("orphan sweep list failed", error=str(exc))
+            return
+        for name in sorted(group_names - cr_names - managed):
+            log.warn("tearing down orphaned job resources (no CR)",
+                     job=f"{namespace}/{name}")
+            try:
+                deleter(TrainingJob(name=name, namespace=namespace))
+            except Exception as exc:
+                log.error("orphan teardown failed", job=name, error=str(exc))
+
+    def _on_add(self, uid: str, cr: dict, spec: Any) -> None:
+        if self._rejected_specs.get(uid) == spec:
+            return  # unchanged invalid spec: don't re-reject every tick
+        try:
+            job = job_from_dict(cr)
+            self.controller.submit(job)
+        except (ValidationError, ValueError) as exc:
+            # surface the rejection where the user submitted it
+            log.warn("TrainingJob rejected", job=uid, error=str(exc))
+            self._rejected_specs[uid] = spec
+            self._patch_status(uid, cr, {
+                "phase": JobPhase.FAILED.value,
+                "reason": f"invalid spec: {exc}",
+                "replica_statuses": [],
+            })
+            return
+        self._rejected_specs.pop(uid, None)
+        self._seen_specs[uid] = spec
+        self._jobs[uid] = job
+        log.info("TrainingJob added", job=uid)
+
+    def _on_update(self, uid: str, cr: dict, spec: Any) -> None:
+        try:
+            job = job_from_dict(cr)
+            self.controller.modify(job)
+        except (ValidationError, ValueError, KeyError) as exc:
+            # Keep managing the last valid spec, but (a) record the spec so
+            # the rejection isn't re-logged every tick and (b) surface the
+            # reason in the CR status — the user must see the edit was
+            # rejected where they submitted it.
+            log.warn("TrainingJob update rejected", job=uid, error=str(exc))
+            self._seen_specs[uid] = spec
+            self._rejected_update_reason[uid] = str(exc)
+            return
+        self._rejected_update_reason.pop(uid, None)
+        self._seen_specs[uid] = spec
+        self._jobs[uid] = job
+        log.info("TrainingJob updated", job=uid)
+
+    def _on_delete(self, uid: str) -> None:
+        job = self._jobs.pop(uid, None)
+        self._seen_specs.pop(uid, None)
+        self._written_status.pop(uid, None)
+        self._rejected_specs.pop(uid, None)
+        self._rejected_update_reason.pop(uid, None)
+        if job is not None:
+            try:
+                self.controller.delete(job)
+            except Exception as exc:
+                log.error("teardown failed", job=uid, error=str(exc))
+        log.info("TrainingJob deleted", job=uid)
+
+    # -- status write-back -------------------------------------------------
+
+    def _write_back_statuses(self, listed: dict[str, dict]) -> None:
+        for uid, job in self._jobs.items():
+            cr = listed.get(uid)
+            if cr is None:
+                continue
+            updater = self.controller.get_updater(job)
+            if updater is None:
+                continue
+            status = status_to_dict(updater.job.status)
+            reason = self._rejected_update_reason.get(uid)
+            if reason is not None:
+                status["reason"] = (f"spec update rejected: {reason}; "
+                                    "running with last valid spec")
+            self._patch_status(uid, cr, status)
+
+    def _patch_status(self, uid: str, cr: dict, status: dict) -> None:
+        if self._written_status.get(uid) == status:
+            return
+        name = (cr.get("metadata") or {}).get("name", "")
+        try:
+            if self.store.patch_training_job_status(name, status):
+                self._written_status[uid] = status
+        except Exception as exc:
+            # next tick retries; the in-memory phase machine is unaffected
+            log.error("status write-back failed", job=uid, error=str(exc))

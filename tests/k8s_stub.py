@@ -81,6 +81,10 @@ class StubState:
     jobs: dict = field(default_factory=dict)
     replicasets: dict = field(default_factory=dict)
     services: dict = field(default_factory=dict)
+    #: (group, namespace, plural, name) → custom-object dict (the
+    #: TrainingJob CR store; role of the reference's object-tracker-backed
+    #: fake clientset, pkg/client/.../fake/fake_trainingjob.go:29-124)
+    custom_objects: dict = field(default_factory=dict)
     #: next N replace_namespaced_job calls fail 409 (concurrent-writer
     #: simulation for the ConflictError mapping test)
     conflicts_to_inject: int = 0
@@ -176,6 +180,78 @@ class _BatchV1Api:
         del self._s.jobs[(namespace, name)]
 
 
+class _CustomObjectsApi:
+    """CRD verbs the real K8sCluster CR methods touch.  Custom objects are
+    plain dicts, as in the real kubernetes client."""
+
+    def __init__(self, state: StubState):
+        self._s = state
+
+    def _key(self, group, namespace, plural, name):
+        return (group, namespace, plural, name)
+
+    def create_namespaced_custom_object(self, group, version, namespace,
+                                        plural, body):
+        name = (body.get("metadata") or {}).get("name", "")
+        key = self._key(group, namespace, plural, name)
+        if key in self._s.custom_objects:
+            raise ApiException(409, f"{plural} {name} exists")
+        obj = copy.deepcopy(body)
+        obj.setdefault("metadata", {})
+        obj["metadata"].setdefault("namespace", namespace)
+        obj["metadata"]["generation"] = 1
+        self._s.custom_objects[key] = obj
+        return copy.deepcopy(obj)
+
+    def list_namespaced_custom_object(self, group, version, namespace,
+                                      plural):
+        items = [copy.deepcopy(o)
+                 for (g, ns, pl, _), o in sorted(self._s.custom_objects.items())
+                 if (g, ns, pl) == (group, namespace, plural)]
+        return {"items": items}
+
+    def get_namespaced_custom_object(self, group, version, namespace,
+                                     plural, name):
+        key = self._key(group, namespace, plural, name)
+        if key not in self._s.custom_objects:
+            raise ApiException(404, f"{plural} {name}")
+        return copy.deepcopy(self._s.custom_objects[key])
+
+    def replace_namespaced_custom_object(self, group, version, namespace,
+                                         plural, name, body):
+        key = self._key(group, namespace, plural, name)
+        if key not in self._s.custom_objects:
+            raise ApiException(404, f"{plural} {name}")
+        old = self._s.custom_objects[key]
+        obj = copy.deepcopy(body)
+        obj.setdefault("metadata", {})
+        gen = (old.get("metadata") or {}).get("generation", 1)
+        # the apiserver bumps generation only on spec change (status
+        # subresource writes go through patch_..._status below)
+        if obj.get("spec") != old.get("spec"):
+            gen += 1
+        obj["metadata"]["generation"] = gen
+        obj.setdefault("status", copy.deepcopy(old.get("status") or {}))
+        self._s.custom_objects[key] = obj
+        return copy.deepcopy(obj)
+
+    def patch_namespaced_custom_object_status(self, group, version,
+                                              namespace, plural, name, body):
+        key = self._key(group, namespace, plural, name)
+        if key not in self._s.custom_objects:
+            raise ApiException(404, f"{plural} {name}")
+        obj = self._s.custom_objects[key]
+        obj["status"] = copy.deepcopy((body or {}).get("status") or {})
+        return copy.deepcopy(obj)
+
+    def delete_namespaced_custom_object(self, group, version, namespace,
+                                        plural, name):
+        key = self._key(group, namespace, plural, name)
+        if key not in self._s.custom_objects:
+            raise ApiException(404, f"{plural} {name}")
+        del self._s.custom_objects[key]
+
+
 class _AppsV1Api:
     def __init__(self, state: StubState):
         self._s = state
@@ -203,6 +279,7 @@ def build_module(state: StubState) -> types.ModuleType:
     client.CoreV1Api = lambda: _CoreV1Api(state)
     client.BatchV1Api = lambda: _BatchV1Api(state)
     client.AppsV1Api = lambda: _AppsV1Api(state)
+    client.CustomObjectsApi = lambda: _CustomObjectsApi(state)
     config.load_kube_config = lambda *_a, **_k: None
     config.load_incluster_config = lambda: None
     kubernetes.client = client
