@@ -150,7 +150,7 @@ def format_status(cluster, namespace: str, name: str) -> str:
     statuses = None
     cr = None
     if hasattr(cluster, "get_training_job_cr"):
-        cr = cluster.get_training_job_cr(name)
+        cr = cluster.get_training_job_cr(name, namespace=namespace)
     if cr is not None and cr.get("status"):
         from edl_tpu.api.serde import status_from_dict
 

@@ -176,7 +176,16 @@ class K8sCluster(Cluster):
         re-submits every listed CR, and the job's resources are usually
         still there — the updater then simply confirms the running cohort
         (the reference's create also tolerates existing resources by
-        logging and continuing, pkg/controller.go:134-148)."""
+        logging and continuing, pkg/controller.go:134-148).
+
+        Adoption is sound because every RUNTIME-mutable spec field
+        (trainer min/max bounds) lives in the controller registry and the
+        autoscaler actuates it via parallelism writes; pod-template fields
+        (image, entrypoint, per-pod resources) are create-time for the
+        life of the job here exactly as in the reference, whose controller
+        also never rewrites a running job's pod specs (its only actuation
+        is TrainerJob.Spec.Parallelism, autoscaler.go:339-376).  Changing
+        a template field means delete + resubmit."""
         from edl_tpu.controller.jobparser import parse_to_manifests
 
         apps = kubernetes.client.AppsV1Api()
@@ -234,21 +243,23 @@ class K8sCluster(Cluster):
     #    trainingjob.go:33-44) --------------------------------------------
 
     def list_training_job_crs(self) -> list[dict]:
-        """All TrainingJob custom objects in this namespace (the poll-list
-        the sync loop diffs; role of the informer's ListWatch source,
-        reference pkg/controller.go:80-87)."""
+        """TrainingJob custom objects across ALL namespaces (the poll-list
+        the sync loop diffs; role of the informer's NamespaceAll ListWatch
+        source, reference pkg/controller.go:80-87)."""
         from edl_tpu.api.serde import CRD_GROUP, CRD_PLURAL, CRD_VERSION
 
-        out = self._custom.list_namespaced_custom_object(
-            CRD_GROUP, CRD_VERSION, self.namespace, CRD_PLURAL)
+        out = self._custom.list_cluster_custom_object(
+            CRD_GROUP, CRD_VERSION, CRD_PLURAL)
         return list(out.get("items") or [])
 
-    def get_training_job_cr(self, name: str) -> dict | None:
+    def get_training_job_cr(self, name: str, namespace: str | None = None
+                            ) -> dict | None:
         from edl_tpu.api.serde import CRD_GROUP, CRD_PLURAL, CRD_VERSION
 
         try:
             return self._custom.get_namespaced_custom_object(
-                CRD_GROUP, CRD_VERSION, self.namespace, CRD_PLURAL, name)
+                CRD_GROUP, CRD_VERSION, namespace or self.namespace,
+                CRD_PLURAL, name)
         except kubernetes.client.exceptions.ApiException as exc:
             if exc.status == 404:
                 return None
@@ -257,25 +268,32 @@ class K8sCluster(Cluster):
     def create_training_job_cr(self, manifest: dict) -> None:
         """Submit = create the CR and let the controller materialize it
         (the reference's submission flow, doc/usage.md + controller
-        onAdd, pkg/controller.go:110-148)."""
+        onAdd, pkg/controller.go:110-148).  The CR lands in the
+        manifest's own metadata.namespace (an apiserver rejects a
+        namespace mismatch), falling back to this client's default."""
         from edl_tpu.api.serde import CRD_GROUP, CRD_PLURAL, CRD_VERSION
 
+        ns = ((manifest.get("metadata") or {}).get("namespace")
+              or self.namespace)
         self._custom.create_namespaced_custom_object(
-            CRD_GROUP, CRD_VERSION, self.namespace, CRD_PLURAL, manifest)
+            CRD_GROUP, CRD_VERSION, ns, CRD_PLURAL, manifest)
 
-    def delete_training_job_cr(self, name: str) -> bool:
+    def delete_training_job_cr(self, name: str, namespace: str | None = None
+                               ) -> bool:
         from edl_tpu.api.serde import CRD_GROUP, CRD_PLURAL, CRD_VERSION
 
         try:
             self._custom.delete_namespaced_custom_object(
-                CRD_GROUP, CRD_VERSION, self.namespace, CRD_PLURAL, name)
+                CRD_GROUP, CRD_VERSION, namespace or self.namespace,
+                CRD_PLURAL, name)
             return True
         except kubernetes.client.exceptions.ApiException as exc:
             if exc.status == 404:
                 return False
             raise
 
-    def patch_training_job_status(self, name: str, status: dict) -> bool:
+    def patch_training_job_status(self, name: str, status: dict,
+                                  namespace: str | None = None) -> bool:
         """Write phase + replica statuses into the CR's status subresource
         so ``kubectl get tj`` shows them (role of updateCRDStatus,
         reference pkg/updater/trainingJobUpdater.go:295-307).  False if the
@@ -284,8 +302,8 @@ class K8sCluster(Cluster):
 
         try:
             self._custom.patch_namespaced_custom_object_status(
-                CRD_GROUP, CRD_VERSION, self.namespace, CRD_PLURAL, name,
-                {"status": status})
+                CRD_GROUP, CRD_VERSION, namespace or self.namespace,
+                CRD_PLURAL, name, {"status": status})
             return True
         except kubernetes.client.exceptions.ApiException as exc:
             if exc.status == 404:

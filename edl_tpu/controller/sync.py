@@ -32,7 +32,6 @@ from typing import Any, Optional, Protocol
 
 from edl_tpu.api.serde import job_from_dict, status_to_dict
 from edl_tpu.api.types import JobPhase, TrainingJob
-from edl_tpu.api.validation import ValidationError
 from edl_tpu.controller.controller import Controller
 from edl_tpu.observability.logging import get_logger
 
@@ -45,7 +44,8 @@ class TrainingJobStore(Protocol):
 
     def list_training_job_crs(self) -> list[dict]: ...
 
-    def patch_training_job_status(self, name: str, status: dict) -> bool: ...
+    def patch_training_job_status(self, name: str, status: dict,
+                                  namespace: str | None = None) -> bool: ...
 
 
 class TrainingJobSyncLoop:
@@ -112,10 +112,18 @@ class TrainingJobSyncLoop:
 
         for uid, cr in listed.items():
             spec = cr.get("spec") or {}
-            if uid not in self._seen_specs:
-                self._on_add(uid, cr, spec)
-            elif spec != self._seen_specs[uid]:
-                self._on_update(uid, cr, spec)
+            try:
+                if uid not in self._seen_specs:
+                    self._on_add(uid, cr, spec)
+                elif spec != self._seen_specs[uid]:
+                    self._on_update(uid, cr, spec)
+            except Exception as exc:
+                # One CR must never block the tick for every other CR —
+                # the delete pass, orphan sweep and status write-back
+                # below run regardless (the _on_* handlers already treat
+                # any parse/validate failure as a recorded rejection; this
+                # guard catches what they could not foresee).
+                log.error("CR dispatch failed", job=uid, error=str(exc))
 
         for uid in list(self._seen_specs):
             if uid not in listed:
@@ -140,8 +148,13 @@ class TrainingJobSyncLoop:
         if lister is None or deleter is None:
             return
         namespace = getattr(self.store, "namespace", "default")
-        cr_names = {uid.split("/", 1)[1] for uid in listed}
-        managed = {uid.split("/", 1)[1] for uid in self._jobs}
+        # the group lister is scoped to the store's namespace; compare
+        # against CRs/jobs in that namespace only (a same-named CR
+        # elsewhere must not mask an orphan here)
+        cr_names = {uid.split("/", 1)[1] for uid in listed
+                    if uid.split("/", 1)[0] == namespace}
+        managed = {uid.split("/", 1)[1] for uid in self._jobs
+                   if uid.split("/", 1)[0] == namespace}
         try:
             group_names = set(lister())
         except Exception as exc:
@@ -161,7 +174,13 @@ class TrainingJobSyncLoop:
         try:
             job = job_from_dict(cr)
             self.controller.submit(job)
-        except (ValidationError, ValueError) as exc:
+        except Exception as exc:
+            # Any failure to turn an arbitrary user dict into a registered
+            # job is a spec rejection (the CRD schema's
+            # x-kubernetes-preserve-unknown-fields admits shapes the
+            # parser cannot — a string where a map belongs raises
+            # AttributeError, an explicit null TypeError; all of them must
+            # land in the CR status, not in a crash-looping tick).
             # surface the rejection where the user submitted it
             log.warn("TrainingJob rejected", job=uid, error=str(exc))
             self._rejected_specs[uid] = spec
@@ -180,7 +199,7 @@ class TrainingJobSyncLoop:
         try:
             job = job_from_dict(cr)
             self.controller.modify(job)
-        except (ValidationError, ValueError, KeyError) as exc:
+        except Exception as exc:  # same rejection surface as _on_add
             # Keep managing the last valid spec, but (a) record the spec so
             # the rejection isn't re-logged every tick and (b) surface the
             # reason in the CR status — the user must see the edit was
@@ -227,9 +246,12 @@ class TrainingJobSyncLoop:
     def _patch_status(self, uid: str, cr: dict, status: dict) -> None:
         if self._written_status.get(uid) == status:
             return
-        name = (cr.get("metadata") or {}).get("name", "")
+        meta = cr.get("metadata") or {}
+        name = meta.get("name", "")
+        ns = meta.get("namespace", "default")
         try:
-            if self.store.patch_training_job_status(name, status):
+            if self.store.patch_training_job_status(name, status,
+                                                    namespace=ns):
                 self._written_status[uid] = status
         except Exception as exc:
             # next tick retries; the in-memory phase machine is unaffected

@@ -76,7 +76,15 @@ class CoordClient:
             pass
 
     def _call(self, *parts: str) -> list[str]:
+        return self._call_traced(*parts)[0]
+
+    def _call_traced(self, *parts: str) -> tuple[list[str], bool]:
+        """Returns (response tokens, retransmitted) — ``retransmitted`` is
+        True iff the request was re-sent after a connection break, i.e.
+        the only window in which an executed-but-unacked duplicate is
+        possible (kv_cas narrows its lost-ack inference to exactly this)."""
         line = (" ".join(parts) + "\n").encode()
+        retransmitted = False
         with self._lock:
             deadline = time.monotonic() + self.reconnect_window_s
             while True:
@@ -86,10 +94,11 @@ class CoordClient:
                     if not resp:
                         raise CoordError(
                             "coordination server closed the connection")
-                    return resp.decode().strip().split(" ")
+                    return resp.decode().strip().split(" "), retransmitted
                 except (OSError, CoordError):
                     if time.monotonic() >= deadline:
                         raise
+                    retransmitted = True
                     time.sleep(0.3)
                     try:
                         self.close()
@@ -193,17 +202,26 @@ class CoordClient:
         return self._call("KVDEL", key)[0] == "OK"
 
     def kv_cas(self, key: str, expect: bytes, value: bytes) -> bool:
-        """CAS with retry-safe claim semantics.  A CAS that executed but
-        whose ack was lost (coordinator crash in the ack window) reports
-        FAIL when the reconnect loop re-sends it — the key now holds our
-        own value, so the plain response would tell the rightful winner it
-        lost (and e.g. no one would seed the data queue).  Every CAS in
-        the protocol is a claim with a claimant-unique value (worker names,
-        endpoints), so 'current value == ours' is exactly 'we won'."""
+        """CAS with retry-safe claim semantics.
+
+        CONTRACT: ``value`` must be claimant-unique — include the caller's
+        name, endpoint or a timestamp/nonce, never a shared constant like
+        ``b"done"`` (every call site in edl_tpu writes worker names,
+        endpoints or timestamped markers).  Rationale: a CAS that executed
+        but whose ack was lost (coordinator crash in the ack window)
+        reports FAIL when the reconnect loop re-sends it — the key then
+        holds our own value, and 'current value == ours' is 'we won' ONLY
+        if no other claimant could have written the same bytes.  The
+        inference is applied only when the request was actually
+        retransmitted after a connection break, so a plain losing CAS on a
+        healthy connection can never misreport victory even if a caller
+        breaks the uniqueness contract."""
         exp = expect.hex() if expect else "-"
-        if self._call("KVCAS", key, exp, value.hex() or "-")[0] == "OK":
+        r, retransmitted = self._call_traced("KVCAS", key, exp,
+                                             value.hex() or "-")
+        if r[0] == "OK":
             return True
-        return self.kv_get(key) == value
+        return retransmitted and self.kv_get(key) == value
 
     def kv_keys(self, prefix: str = "") -> list[str]:
         r = self._call("KEYS", prefix) if prefix else self._call("KEYS")

@@ -173,6 +173,13 @@ def ensure_seeded(coord, name: str, seed_fn: Callable[[Callable[[], None]],
     def claim_bytes() -> bytes:
         return f"seeding:{name}:{now_ms()}".encode()
 
+    #: when WE first observed a marker value we cannot parse an age out of
+    #: (foreign writer, format drift) — such a marker is NOT proof of
+    #: completed seeding (a pre-enqueue crash would leave the queue empty
+    #: forever and the job would terminate 'drained' at step 0), so it is
+    #: aged by our own clock and taken over like any stale claim.
+    first_seen: dict[bytes, int] = {}
+
     while True:
         raw = coord.kv_get("data-seeder")
         if raw == b"seeded":
@@ -181,19 +188,24 @@ def ensure_seeded(coord, name: str, seed_fn: Callable[[Callable[[], None]],
             if not coord.kv_cas("data-seeder", b"", claim_bytes()):
                 continue  # lost the race; re-read
         else:
+            s = coord.stats()
+            touched = s.todo or s.leased or s.done
             try:
                 _, _, ts = raw.decode().split(":")
                 age = now_ms() - int(ts)
             except ValueError:
-                return  # unknown marker owner; leave it alone
-            s = coord.stats()
-            touched = s.todo or s.leased or s.done
+                if touched:
+                    return  # queue has real content; work can proceed
+                log.warn("unrecognized data-seeder marker; waiting for "
+                         "'seeded' flip, queue content, or staleness",
+                         marker=raw[:64])
+                age = now_ms() - first_seen.setdefault(raw, now_ms())
             if age < stale_ms or touched:
                 _time.sleep(poll_s)
                 continue
             if not coord.kv_cas("data-seeder", raw, claim_bytes()):
                 continue  # someone else took over first
-            log.warn("taking over stale seeding claim", stale=raw.decode())
+            log.warn("taking over stale seeding claim", stale=raw[:64])
         # we hold the claim
         beat = lambda: coord.kv_set("data-seeder", claim_bytes())
         seed_fn(beat)

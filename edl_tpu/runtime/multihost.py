@@ -517,6 +517,21 @@ def _world_child(plan: WorldPlan, cfg: WorkerConfig, result_path: str,
             # peer is blocked in wait_state
         if state is None:
             found = ew.wait_state(world.epoch, timeout_s=cfg.state_wait_s)
+            if ((found is None or found[0] != world.epoch)
+                    and world.world_size > 1):
+                # The leader never published this epoch's generation within
+                # the window.  With peers present, falling back to an older
+                # generation (or cold init) would train replicated-DP ranks
+                # on DIVERGENT parameters silently forever — psum only
+                # syncs gradients.  Abort instead: the supervisor reforms
+                # the world, and the reform either gets a live leader to
+                # publish or shrinks the world (ADVICE r2).
+                print(f"[edl-mh] world {world.epoch}: state for this epoch "
+                      f"never published (have "
+                      f"{found[0] if found else 'nothing'}); aborting to "
+                      "reform rather than diverge", file=sys.stderr,
+                      flush=True)
+                sys.exit(WORLD_ABORTED)
             state = cfg.load_state(found[1]) if found else cfg.init_state()
 
         def should_stop() -> bool:

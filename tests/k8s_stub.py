@@ -210,6 +210,12 @@ class _CustomObjectsApi:
                  if (g, ns, pl) == (group, namespace, plural)]
         return {"items": items}
 
+    def list_cluster_custom_object(self, group, version, plural):
+        items = [copy.deepcopy(o)
+                 for (g, _, pl, _), o in sorted(self._s.custom_objects.items())
+                 if (g, pl) == (group, plural)]
+        return {"items": items}
+
     def get_namespaced_custom_object(self, group, version, namespace,
                                      plural, name):
         key = self._key(group, namespace, plural, name)

@@ -181,6 +181,59 @@ def test_status_verb_reads_recorded_status(control_plane, capsys):
     assert "Running" in out and "job2-trainer-0" in out
 
 
+def test_malformed_cr_rejected_does_not_block_tick(control_plane):
+    """The CRD schema's preserve-unknown-fields admits shapes the parser
+    cannot (a string where a map belongs, explicit nulls).  Such a CR must
+    get a Failed status — and must never abort the tick for other CRs."""
+    cluster, controller, sync, state = control_plane
+    bad = cr_manifest("mangled")
+    bad["spec"]["trainer"]["resources"] = "2cpu"  # string, not a map
+    cluster.create_training_job_cr(bad)
+    null_field = cr_manifest("nullfield")
+    null_field["spec"]["trainer"]["min_instance"] = None
+    cluster.create_training_job_cr(null_field)
+    cluster.create_training_job_cr(cr_manifest("zz-good", lo=1, hi=2))
+
+    sync.run_once()
+    for name in ("mangled", "nullfield"):
+        cr = state.custom_objects[("edl.tpu", "default", "trainingjobs",
+                                   name)]
+        assert cr["status"]["phase"] == "Failed", name
+        assert cr["status"]["reason"].startswith("invalid spec"), name
+    # the good CR (sorted after both bad ones) was still dispatched
+    assert [j.name for j in controller.jobs()] == ["zz-good"]
+    # and the bad ones are not re-dispatched every tick
+    sync.run_once()
+    assert [j.name for j in controller.jobs()] == ["zz-good"]
+
+
+def test_cr_in_other_namespace_is_managed(control_plane):
+    """The watch is cluster-wide (reference NamespaceAll informer,
+    pkg/controller.go:83); the CR lands in its manifest's namespace and
+    status writes back there."""
+    cluster, controller, sync, state = control_plane
+    cr = cr_manifest("nsjob", lo=1, hi=2)
+    cr["metadata"]["namespace"] = "team-a"
+    cluster.create_training_job_cr(cr)
+    assert ("edl.tpu", "team-a", "trainingjobs", "nsjob") in \
+        state.custom_objects
+    sync.run_once()
+    assert ("team-a", "nsjob-trainer") in state.jobs
+    state.pods.append(make_pod("nsjob-trainer-0", namespace="team-a",
+                               phase="Running", node="a0",
+                               labels={"edl-tpu-job": "nsjob"},
+                               cpu="1", memory="1Gi", tpu=1))
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        sync.run_once()
+        obj = state.custom_objects[("edl.tpu", "team-a", "trainingjobs",
+                                    "nsjob")]
+        if (obj.get("status") or {}).get("phase") == "Running":
+            break
+        time.sleep(0.05)
+    assert obj["status"]["phase"] == "Running"
+
+
 def test_controller_restart_adopts_running_jobs(control_plane):
     """A controller restart re-submits every listed CR; the job's
     resources still exist — that is ADOPTION (409 tolerated), not a
