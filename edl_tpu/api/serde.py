@@ -63,6 +63,7 @@ def job_from_dict(doc: dict[str, Any]) -> TrainingJob:
         resources=_resources(t.get("resources")),
         topology=(TpuTopology.parse(str(t["topology"]))
                   if t.get("topology") else None),
+        allow_multi_domain=bool(t.get("allow_multi_domain", False)),
     )
     p = _norm(spec.get("pserver") or {})
     pserver = PserverSpec(
@@ -122,6 +123,7 @@ def job_to_dict(job: TrainingJob) -> dict[str, Any]:
                 "workspace": t.workspace,
                 "min_instance": t.min_instance,
                 "max_instance": t.max_instance,
+                "allow_multi_domain": t.allow_multi_domain,
                 "resources": res(t.resources),
             },
             "pserver": {

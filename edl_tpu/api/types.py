@@ -37,6 +37,18 @@ DEFAULT_PORT = 7164  # reference pkg/jobparser.go:50-52
 DEFAULT_IMAGE = "edl-tpu/job:latest"  # role of paddlepaddle/paddlecloud-job, jobparser.go:61-63
 DEFAULT_PASSES = 1  # reference pkg/jobparser.go:58-60
 
+# Pod-label contract between the job compiler (controller/jobparser writes
+# them) and the cluster backends (cluster/k8s + the collector read them) —
+# one home so the writer and readers can never drift (role of
+# ``paddle-job``/``paddle-job-master``/``paddle-job-pserver``, reference
+# pkg/cluster.go:119 + example/collector.py:95-118).
+TRAINER_LABEL = "edl-tpu-job"
+COORDINATOR_LABEL = "edl-tpu-job-coordinator"
+PSERVER_LABEL = "edl-tpu-job-pserver"
+#: marks a DCN-spanning (multi-slice) job's trainer pods, so the cluster
+#: inventory knows not to pin the job to one ICI domain.
+MULTI_DOMAIN_LABEL = "edl-tpu-multi-domain"
+
 
 def _as_qmap(m: "dict[str, Quantity | str | int] | None") -> dict[str, Quantity]:
     return {k: Quantity(v) for k, v in (m or {}).items()}
@@ -109,6 +121,13 @@ class TrainerSpec:
     max_instance: int = 1
     resources: ResourceRequirements = field(default_factory=ResourceRequirements)
     topology: Optional[TpuTopology] = None
+    #: Opt-in for meshes that span ICI domains (multi-slice: data-parallel
+    #: gradient sync rides DCN between slices, ICI within — the
+    #: scaling-book multislice recipe).  Off by default: a chip job is
+    #: pinned to ONE ICI domain and its scale-up caps at that domain's
+    #: capacity, because an unwitting DCN hop inside a TP/FSDP mesh is a
+    #: silent order-of-magnitude bandwidth cliff.
+    allow_multi_domain: bool = False
 
 
 @dataclass

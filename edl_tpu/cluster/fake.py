@@ -144,9 +144,12 @@ class FakeCluster(Cluster):
                     nodes.nodes_cpu_idle_milli[p.node] -= p.cpu_request_milli
                     nodes.nodes_memory_free_mega[p.node] -= p.memory_request_mega
                     nodes.nodes_tpu_free[p.node] -= p.tpu_limit
-                if p.tpu_limit > 0 and p.job_uid and p.node in self._nodes:
+                if (p.tpu_limit > 0 and p.job_uid
+                        and p.node in self._nodes
+                        and not self._allows_multi_domain(p.job_uid)):
                     # chip pods pin their job to the domain they run in —
                     # the planner must keep growing the mesh there
+                    # (DCN-spanning jobs are never pinned)
                     r.jobs_ici_domain.setdefault(
                         p.job_uid, self._nodes[p.node].ici_domain)
             r.nodes = nodes
@@ -290,6 +293,10 @@ class FakeCluster(Cluster):
             raise KeyError(f"no trainer group for job {job.full_name!r}")
         return g
 
+    def _allows_multi_domain(self, job_uid: str) -> bool:
+        spec = self._job_specs.get(job_uid)
+        return spec is not None and spec.spec.trainer.allow_multi_domain
+
     def _find_node_for(self, pod: FakePod) -> Optional[str]:
         idle = {
             n.name: [n.cpu_milli, n.memory_mega, n.tpu_chips]
@@ -304,9 +311,11 @@ class FakeCluster(Cluster):
                 idle[p.node][2] -= p.tpu_limit
         # TPU jobs must stay within one ICI domain: once the first chip pod
         # of a job lands, its siblings only place on nodes in the same
-        # domain (a DP mesh spanning domains would all-reduce over DCN).
+        # domain (a DP mesh spanning domains would all-reduce over DCN) —
+        # unless the job opted into multi-slice (allow_multi_domain).
         required_domain = None
-        if pod.tpu_limit > 0 and pod.job_uid:
+        if (pod.tpu_limit > 0 and pod.job_uid
+                and not self._allows_multi_domain(pod.job_uid)):
             for p in self._pods.values():
                 if (p.job_uid == pod.job_uid and p.tpu_limit > 0
                         and p.node is not None

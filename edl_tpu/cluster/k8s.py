@@ -18,7 +18,14 @@ from __future__ import annotations
 
 from dataclasses import dataclass
 
-from edl_tpu.api.types import RESOURCE_TPU, TrainingJob
+from edl_tpu.api.types import (
+    COORDINATOR_LABEL,
+    MULTI_DOMAIN_LABEL,
+    PSERVER_LABEL,
+    RESOURCE_TPU,
+    TRAINER_LABEL,
+    TrainingJob,
+)
 from edl_tpu.cluster.base import Cluster, ConflictError, PodCounts, PodPhase
 from edl_tpu.cluster.resource import ClusterResource, NodeResources
 
@@ -43,10 +50,6 @@ try:
     _HAVE_K8S = True
 except ImportError:
     _HAVE_K8S = False
-
-#: label selecting a job's trainer pods (role of ``paddle-job=<name>``,
-#: reference pkg/cluster.go:119).
-TRAINER_LABEL = "edl-tpu-job"
 
 #: Node labels that identify the ICI fabric a TPU node belongs to, in
 #: preference order.  On GKE every node of a multi-host slice carries the
@@ -122,7 +125,10 @@ class K8sCluster(Cluster):
             # deleted/drained node must not pin its job to a domain that no
             # longer exists (the planner would find no candidate nodes and
             # freeze the job's scale-up until the stale pod is reaped).
+            # DCN-spanning jobs (MULTI_DOMAIN_LABEL) are never pinned —
+            # a pin would re-cap them at one domain.
             if (tl > 0 and TRAINER_LABEL in labels
+                    and MULTI_DOMAIN_LABEL not in labels
                     and nn in nodes.nodes_cpu_idle_milli):
                 uid = f"{pod.metadata.namespace}/{labels[TRAINER_LABEL]}"
                 r.jobs_ici_domain.setdefault(
@@ -211,6 +217,17 @@ class K8sCluster(Cluster):
             if TRAINER_LABEL in labels:
                 names.append(labels[TRAINER_LABEL])
         return sorted(set(names))
+
+    def list_trainer_groups(self) -> list[tuple[str, str]]:
+        """(namespace, job-name) of every trainer group CLUSTER-WIDE —
+        the sweep surface matching the cluster-wide CR watch, so an
+        orphaned group in any namespace is visible."""
+        out = set()
+        for j in self._batch.list_job_for_all_namespaces().items:
+            labels = j.metadata.labels or {}
+            if TRAINER_LABEL in labels:
+                out.add((j.metadata.namespace, labels[TRAINER_LABEL]))
+        return sorted(out)
 
     def delete_resources(self, job: TrainingJob) -> None:
         apps = kubernetes.client.AppsV1Api()
@@ -316,8 +333,8 @@ class K8sCluster(Cluster):
         (what the Collector and PodDiscovery consume)."""
         out = []
         role_labels = {"trainer": TRAINER_LABEL,
-                       "master": "edl-tpu-job-coordinator",
-                       "pserver": "edl-tpu-job-pserver"}
+                       "master": COORDINATOR_LABEL,
+                       "pserver": PSERVER_LABEL}
         if job_uid is not None or role is not None:
             # Job-scoped callers (PodDiscovery polls every 5 s): a
             # namespaced LIST with a label selector, not a full-cluster

@@ -97,6 +97,16 @@ class Controller:
             updater = self._updaters.get(job.full_name)
         if updater is None:
             raise KeyError(f"job {job.full_name} not found")
+        old = updater.job.spec
+        if old.trainer.allow_multi_domain != job.spec.trainer.allow_multi_domain:
+            # The flag is baked into the running pods' labels (the cluster
+            # inventory's pin/no-pin decision reads pods, not the spec) and
+            # into where the mesh already sits; flipping it in place would
+            # let the planner grow a "single-domain" mesh across a DCN
+            # boundary.  Like pod-template fields, it is create-time.
+            raise ValidationError(
+                "allow_multi_domain is immutable on a running job; "
+                "delete and resubmit to change it")
         updater.modify(job)
         self.autoscaler.on_update(job)
 

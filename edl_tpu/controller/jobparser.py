@@ -22,10 +22,26 @@ from __future__ import annotations
 
 from typing import Any
 
-from edl_tpu.api.types import DEFAULT_PORT, TrainingJob
+from edl_tpu.api.types import (
+    COORDINATOR_LABEL,
+    DEFAULT_PORT,
+    MULTI_DOMAIN_LABEL,
+    PSERVER_LABEL,
+    TRAINER_LABEL,
+    TrainingJob,
+)
 
 COORDINATOR_PORT = DEFAULT_PORT  # single source of truth (api/types.py)
 HEALTH_PORT = 8080  # role of the master's 8080 (reference jobparser.go:249-261)
+
+
+def _trainer_labels(job: TrainingJob) -> dict[str, str]:
+    labels = {TRAINER_LABEL: job.name}
+    if job.spec.trainer.allow_multi_domain:
+        # the pod IS the inventory's unit of truth: the label tells the
+        # cluster backend not to pin this job to one ICI domain
+        labels[MULTI_DOMAIN_LABEL] = "true"
+    return labels
 
 
 def pod_env(job: TrainingJob, role: str) -> dict[str, str]:
@@ -83,12 +99,12 @@ def parse_to_trainer(job: TrainingJob) -> dict[str, Any]:
         "metadata": {
             "name": f"{job.name}-trainer",
             "namespace": job.namespace,
-            "labels": {"edl-tpu-job": job.name},
+            "labels": _trainer_labels(job),
         },
         "spec": {
             "parallelism": spec.trainer.min_instance,
             "template": {
-                "metadata": {"labels": {"edl-tpu-job": job.name}},
+                "metadata": {"labels": _trainer_labels(job)},
                 "spec": {
                     "restartPolicy": "Never",
                     "nodeSelector": dict(spec.node_selector),
@@ -124,12 +140,12 @@ def parse_to_coordinator(job: TrainingJob) -> dict[str, Any]:
         "metadata": {
             "name": f"{job.name}-coordinator",
             "namespace": job.namespace,
-            "labels": {"edl-tpu-job-coordinator": job.name},
+            "labels": {COORDINATOR_LABEL: job.name},
         },
         "spec": {
             "replicas": 1,
             "template": {
-                "metadata": {"labels": {"edl-tpu-job-coordinator": job.name}},
+                "metadata": {"labels": {COORDINATOR_LABEL: job.name}},
                 "spec": {
                     "containers": [
                         {
@@ -183,12 +199,12 @@ def parse_to_pserver(job: TrainingJob) -> dict[str, Any] | None:
         "metadata": {
             "name": f"{job.name}-pserver",
             "namespace": job.namespace,
-            "labels": {"edl-tpu-job-pserver": job.name},
+            "labels": {PSERVER_LABEL: job.name},
         },
         "spec": {
             "replicas": spec.pserver.min_instance,
             "template": {
-                "metadata": {"labels": {"edl-tpu-job-pserver": job.name}},
+                "metadata": {"labels": {PSERVER_LABEL: job.name}},
                 "spec": {
                     "containers": [
                         {
@@ -222,10 +238,10 @@ def parse_to_coordinator_service(job: TrainingJob) -> dict[str, Any]:
         "metadata": {
             "name": f"{job.name}-coordinator",
             "namespace": job.namespace,
-            "labels": {"edl-tpu-job-coordinator": job.name},
+            "labels": {COORDINATOR_LABEL: job.name},
         },
         "spec": {
-            "selector": {"edl-tpu-job-coordinator": job.name},
+            "selector": {COORDINATOR_LABEL: job.name},
             "ports": [
                 {"name": "coord", "port": spec.port or COORDINATOR_PORT},
                 {"name": "health", "port": HEALTH_PORT},

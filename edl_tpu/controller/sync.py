@@ -142,31 +142,27 @@ class TrainingJobSyncLoop:
         resources no in-memory diff can see (the restart-blind spot of
         the reference's informer too; its del_jobs.sh was the manual
         fix).  On the CRD-driven control plane the CR is the source of
-        truth, so a group without a CR is garbage."""
-        lister = getattr(self.store, "list_training_jobs", None)
+        truth, so a group without a CR is garbage.  Cluster-wide, to
+        match the cluster-wide CR watch."""
+        lister = getattr(self.store, "list_trainer_groups", None)
         deleter = getattr(self.store, "delete_resources", None)
         if lister is None or deleter is None:
             return
-        namespace = getattr(self.store, "namespace", "default")
-        # the group lister is scoped to the store's namespace; compare
-        # against CRs/jobs in that namespace only (a same-named CR
-        # elsewhere must not mask an orphan here)
-        cr_names = {uid.split("/", 1)[1] for uid in listed
-                    if uid.split("/", 1)[0] == namespace}
-        managed = {uid.split("/", 1)[1] for uid in self._jobs
-                   if uid.split("/", 1)[0] == namespace}
+        cr_pairs = {tuple(uid.split("/", 1)) for uid in listed}
+        managed = {tuple(uid.split("/", 1)) for uid in self._jobs}
         try:
-            group_names = set(lister())
+            groups = set(lister())
         except Exception as exc:
             log.error("orphan sweep list failed", error=str(exc))
             return
-        for name in sorted(group_names - cr_names - managed):
+        for ns, name in sorted(groups - cr_pairs - managed):
             log.warn("tearing down orphaned job resources (no CR)",
-                     job=f"{namespace}/{name}")
+                     job=f"{ns}/{name}")
             try:
-                deleter(TrainingJob(name=name, namespace=namespace))
+                deleter(TrainingJob(name=name, namespace=ns))
             except Exception as exc:
-                log.error("orphan teardown failed", job=name, error=str(exc))
+                log.error("orphan teardown failed", job=f"{ns}/{name}",
+                          error=str(exc))
 
     def _on_add(self, uid: str, cr: dict, spec: Any) -> None:
         if self._rejected_specs.get(uid) == spec:

@@ -125,6 +125,15 @@ def search_assignable_nodes(
     name-tiebroken for determinism.  The kubelet enforces the same rule at
     placement time (cluster/fake.py), so a plan accepted here can never
     strand Pending pods on a domain boundary.
+
+    Multi-slice opt-out (``trainer.allow_multi_domain``): a job that
+    declares its gradient sync rides DCN between slices may span domains —
+    instances place across domains ordered most-free-chips-first, so the
+    job still consolidates into as few fabrics as possible (single-domain
+    whenever it fits) and is never pinned.  This is the SURVEY §2.4
+    "XLA collectives over ICI within a slice, DCN between slices" story;
+    without the opt-in, elastic growth deliberately caps at the largest
+    domain.
     """
     cpu = j.cpu_request_milli()
     mem = j.mem_request_mega()
@@ -173,12 +182,32 @@ def search_assignable_nodes(
     for name in r.nodes.nodes_cpu_idle_milli:
         by_domain.setdefault(r.nodes.domain_of(name), []).append(name)
 
+    free_chips = lambda d: sum(
+        r.nodes.nodes_tpu_free.get(n, 0) for n in by_domain[d])
+
+    if j.config.spec.trainer.allow_multi_domain:
+        # DCN-spanning job: still consolidate when possible — try each
+        # domain WHOLE first (most-free-chips order), and only when no
+        # single domain holds the step fall back to one greedy pass over
+        # all nodes in the same domain order.  (A naive single greedy pass
+        # can spill even when a fit exists: with domains {4,2} and {6}
+        # free and two 3-chip instances, greedy starts in the 6-chip
+        # most-free domain... or lands one instance in a roomy node of a
+        # domain whose remainder can't take the second.)  No pin in either
+        # case — a pin would re-cap the job at one domain.
+        domain_order = sorted(by_domain, key=lambda d: (-free_chips(d), d))
+        for domain in domain_order:
+            nodes = try_nodes(by_domain[domain])
+            if nodes is not None:
+                return nodes, None
+        ordered = [n for d in domain_order for n in by_domain[d]]
+        nodes = try_nodes(ordered)
+        return (nodes, None) if nodes is not None else None
+
     pinned = r.jobs_ici_domain.get(j.uid)
     if pinned is not None:
         candidates = [pinned] if pinned in by_domain else []
     else:
-        free_chips = lambda d: sum(
-            r.nodes.nodes_tpu_free.get(n, 0) for n in by_domain[d])
         candidates = sorted(by_domain, key=lambda d: (-free_chips(d), d))
     for domain in candidates:
         nodes = try_nodes(by_domain[domain])
@@ -234,7 +263,15 @@ def scale_dry_run(
             # reference's unconditional -1, quantized).
             additional = policy.next_down(planned, lo) - planned
             return account()
-        over_tpu = r.tpu_limit > r.tpu_total * max_load_desired
+        # Chips drain only on true over-commit (capacity loss), not at
+        # max_load_desired: the up-pass deliberately packs accelerators to
+        # 100% (reference's own NOTE at autoscaler.go:270-271), and the
+        # reference's down-pass GPULimit > Total*maxLoadDesired check
+        # (autoscaler.go:235) contradicts it — on a small cluster a full
+        # pack would be planned and immediately reversed, capping chip jobs
+        # at floor(total*mld) forever.  Idle chips are pure waste on TPU;
+        # the CPU ceiling below keeps its reference semantics.
+        over_tpu = r.tpu_limit > r.tpu_total
         over_cpu = r.cpu_request_milli > r.cpu_total_milli * max_load_desired
         if over_tpu or over_cpu:
             if planned > lo:

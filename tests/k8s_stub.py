@@ -173,6 +173,9 @@ class _BatchV1Api:
         return _Obj(items=[j for (ns, _), j in self._s.jobs.items()
                            if ns == namespace])
 
+    def list_job_for_all_namespaces(self):
+        return _Obj(items=list(self._s.jobs.values()))
+
     def delete_namespaced_job(self, name: str, namespace: str,
                               propagation_policy: str = ""):
         if (namespace, name) not in self._s.jobs:

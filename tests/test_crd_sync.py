@@ -283,6 +283,28 @@ def test_orphaned_resources_swept_after_restart(control_plane):
         controller2.stop()
 
 
+def test_orphan_sweep_covers_other_namespaces(control_plane):
+    """The sweep is cluster-wide like the watch: an orphaned group in a
+    non-default namespace is torn down too."""
+    cluster, controller, sync, state = control_plane
+    cr = cr_manifest("nsjob", lo=1, hi=2)
+    cr["metadata"]["namespace"] = "team-a"
+    cluster.create_training_job_cr(cr)
+    sync.run_once()
+    assert ("team-a", "nsjob-trainer") in state.jobs
+    controller.stop()
+
+    del state.custom_objects[("edl.tpu", "team-a", "trainingjobs", "nsjob")]
+    controller2 = Controller(cluster, updater_convert_seconds=0.05,
+                             updater_confirm_seconds=0.05)
+    sync2 = TrainingJobSyncLoop(cluster, controller2, poll_seconds=0.05)
+    try:
+        sync2.run_once()
+        assert ("team-a", "nsjob-trainer") not in state.jobs
+    finally:
+        controller2.stop()
+
+
 def test_invalid_spec_edit_surfaces_reason_keeps_running(control_plane):
     cluster, controller, sync, state = control_plane
     cluster.create_training_job_cr(cr_manifest("job1", lo=2, hi=4))
@@ -311,6 +333,27 @@ def test_invalid_spec_edit_surfaces_reason_keeps_running(control_plane):
     cr = state.custom_objects[("edl.tpu", "default", "trainingjobs", "job1")]
     assert "rejected" not in (cr["status"].get("reason") or "")
     assert controller.jobs()[0].spec.trainer.max_instance == 8
+
+
+def test_allow_multi_domain_flip_rejected_in_place(control_plane):
+    """The flag is baked into running pods' labels and the mesh's current
+    placement: an in-place flip is rejected with a visible reason (change
+    it by delete + resubmit, like pod-template fields)."""
+    cluster, controller, sync, state = control_plane
+    cluster.create_training_job_cr(cr_manifest("job1", lo=1, hi=2))
+    sync.run_once()
+    run_trainer_pods(state, "job1", 1)
+    wait_phase(sync, state, "job1", "Running")
+
+    flipped = cr_manifest("job1", lo=1, hi=2)
+    flipped["spec"]["trainer"]["allow_multi_domain"] = True
+    cluster._custom.replace_namespaced_custom_object(
+        "edl.tpu", "v1", "default", "trainingjobs", "job1", flipped)
+    sync.run_once()
+    sync.run_once()
+    cr = state.custom_objects[("edl.tpu", "default", "trainingjobs", "job1")]
+    assert "allow_multi_domain is immutable" in cr["status"]["reason"]
+    assert controller.jobs()[0].spec.trainer.allow_multi_domain is False
 
 
 def test_sync_loop_thread_and_autoscaler_integration(control_plane):
