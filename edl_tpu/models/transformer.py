@@ -87,6 +87,28 @@ TINY = TransformerConfig(
     remat=False,
 )
 
+#: The measured flagship: the SINGLE config the bench times and the driver
+#: compile-checks (__graft_entry__ imports this — one constant, so the
+#: recorded numbers and the compile check can never drift).  GQA 4:1
+#: (8 query heads / 2 KV heads, Llama-style), head_dim 128 — measured
+#: faster on v5e than 16/4's head_dim 64 (134 k vs 102 k tokens/s: the
+#: wider head keeps the MXU tiles full).  ~155 M params.  ``use_flash``
+#: is decided at use (pallas on TPU, XLA elsewhere).
+FLAGSHIP = TransformerConfig(
+    vocab_size=16_384, d_model=1024, n_layers=8, n_heads=8, n_kv_heads=2,
+    d_ff=4096, max_seq_len=1024, dtype=jnp.bfloat16, use_flash=False,
+    remat=False,
+)
+
+#: The large single-chip config (~0.6 B params, GQA 4:1, remat on): the
+#: regime the BASELINE.json north star implies; one v5e (16 GB) trains it
+#: only because remat trades FLOPs for activation HBM.
+LARGE = TransformerConfig(
+    vocab_size=32_768, d_model=2048, n_layers=8, n_heads=16, n_kv_heads=4,
+    d_ff=8192, max_seq_len=1024, dtype=jnp.bfloat16, use_flash=False,
+    remat=True,
+)
+
 
 # -- init --------------------------------------------------------------------
 

@@ -22,8 +22,15 @@ def main() -> None:
     from edl_tpu.cluster.k8s import K8sCluster
 
     cluster = K8sCluster(kubeconfig=args.kubeconfig, namespace=args.namespace)
-    names = cluster.list_training_jobs()
-    for name in names:
+    # CRs first (the controller tears down what it manages), then any
+    # group left behind (controller down / never-managed jobs)
+    names = set(cluster.list_training_jobs())
+    for cr in cluster.list_training_job_crs():
+        meta = cr.get("metadata") or {}
+        if meta.get("namespace", "default") == args.namespace:
+            cluster.delete_training_job_cr(meta.get("name", ""))
+            names.add(meta.get("name", ""))
+    for name in sorted(names):
         cluster.delete_resources(TrainingJob(name=name,
                                              namespace=args.namespace))
         print(f"deleted {args.namespace}/{name}")
