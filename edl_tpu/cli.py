@@ -15,6 +15,7 @@ surface:
   delete        delete a job (role of example/del_jobs.sh for one job)
   status        per-role / per-pod job status (the CRD status detail,
                 pkg/apis/paddlepaddle/v1/types.go:154-162)
+  list          all TrainingJobs with recorded phases (`kubectl get tj`)
   validate      parse+default+validate a manifest, print the result
 """
 
@@ -178,6 +179,42 @@ def cmd_status(args) -> int:
     return 0
 
 
+def format_job_list(cluster) -> str:
+    """One line per TrainingJob CR with its recorded phase — the
+    `kubectl get tj` table (the CRD's printer columns, k8s/crd.yaml)
+    without kubectl."""
+    rows = [("NAMESPACE", "NAME", "PHASE", "MIN", "MAX", "REASON")]
+    for cr in cluster.list_training_job_crs():
+        meta = cr.get("metadata") or {}
+        trainer = (cr.get("spec") or {}).get("trainer") or {}
+        status = cr.get("status") or {}
+        rows.append((
+            meta.get("namespace", "default"),
+            meta.get("name", ""),
+            status.get("phase", "None"),
+            str(trainer.get("min_instance", trainer.get("min-instance", ""))),
+            str(trainer.get("max_instance", trainer.get("max-instance", ""))),
+            (status.get("reason") or "")[:48],
+        ))
+    if len(rows) == 1:
+        return "no TrainingJobs found"
+    widths = [max(len(r[i]) for r in rows) for i in range(len(rows[0]))]
+    return "\n".join("  ".join(c.ljust(w) for c, w in zip(r, widths)).rstrip()
+                     for r in rows)
+
+
+def cmd_list(args) -> int:
+    cluster = _build_cluster(args)
+    if not hasattr(cluster, "list_training_job_crs"):
+        # no CR store (fake backend): list trainer groups from pods
+        names = sorted({p.job_uid for p in cluster.list_pods(role="trainer")
+                        if p.job_uid})
+        print("\n".join(names) if names else "no TrainingJobs found")
+        return 0
+    print(format_job_list(cluster))
+    return 0
+
+
 def cmd_validate(args) -> int:
     from edl_tpu.api.serde import job_to_yaml, load_job_file
     from edl_tpu.api.validation import ValidationError, set_defaults_and_validate
@@ -257,6 +294,11 @@ def build_parser() -> argparse.ArgumentParser:
     _add_cluster_flags(c)
     c.add_argument("name")
     c.set_defaults(fn=cmd_status)
+
+    c = sub.add_parser("list", help="all TrainingJobs with recorded phases "
+                                    "(the `kubectl get tj` table)")
+    _add_cluster_flags(c)
+    c.set_defaults(fn=cmd_list)
 
     c = sub.add_parser("validate", help="validate a manifest")
     c.add_argument("manifest")

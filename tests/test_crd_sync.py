@@ -335,6 +335,21 @@ def test_invalid_spec_edit_surfaces_reason_keeps_running(control_plane):
     assert controller.jobs()[0].spec.trainer.max_instance == 8
 
 
+def test_list_verb_shows_recorded_phases(control_plane, capsys):
+    cluster, controller, sync, state = control_plane
+    from edl_tpu.cli import format_job_list
+
+    cluster.create_training_job_cr(cr_manifest("job1", lo=2, hi=4))
+    sync.run_once()
+    run_trainer_pods(state, "job1", 2)
+    wait_phase(sync, state, "job1", "Running")
+    out = format_job_list(cluster)
+    lines = out.splitlines()
+    assert lines[0].split()[:3] == ["NAMESPACE", "NAME", "PHASE"]
+    row = [l for l in lines if " job1 " in f" {l} "][0]
+    assert "Running" in row and "2" in row and "4" in row
+
+
 def test_allow_multi_domain_flip_rejected_in_place(control_plane):
     """The flag is baked into running pods' labels and the mesh's current
     placement: an in-place flip is rejected with a visible reason (change
