@@ -13,8 +13,9 @@ kernels (dQ pass and dK/dV pass) that reconstruct the probabilities
 blockwise from the logsumexp saved by the forward — the [s, s] matrices
 never exist outside a VMEM tile in either direction.  Measured on one
 v5e chip, flagship-dims train step (fwd+bwd), vs XLA's fused attention:
-1.08x at seq 1024, 1.9x at 4096, 22x at 8192 (XLA's score materialization
-hits the HBM wall; the kernel doesn't).
+1.08x at seq 1024, 1.9x at 4096, 24-30x at 8192 (XLA's score
+materialization hits the HBM wall; the kernel doesn't), recorded in
+BENCH_r03; 32k trains at ~39k tokens/s, 64k (with remat) at ~17.7k.
 
 ``attention()`` dispatches: pallas on TPU (or in interpret mode for tests),
 reference jnp otherwise.
@@ -29,13 +30,30 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-# Measured on TPU v5e (seq 4096, d 128): 256x512 runs the forward 1.7x
-# faster than 128x128 — fewer grid steps amortize per-block DMA/setup —
-# and 1.8x faster than XLA's fused attention.  attention() shrinks the
-# blocks for shorter sequences.
-DEFAULT_BLOCK_Q = 256
-DEFAULT_BLOCK_K = 512
+# Measured on TPU v5e (d 128): larger blocks win — fewer grid steps
+# amortize per-block DMA/setup.  256x512 ran the seq-4096 forward 1.7x
+# faster than 128x128; 512x1024 adds ~5% end-to-end train throughput at
+# seq 8192 over 256x512 (62.9k -> 65.9k tokens/s) and is neutral at seq
+# 1024/32k.  attention() shrinks the blocks for shorter sequences.
+DEFAULT_BLOCK_Q = 512
+DEFAULT_BLOCK_K = 1024
 _NEG_INF = -1e30
+
+
+def fit_blocks(s: int, block_q: int = DEFAULT_BLOCK_Q,
+               block_k: int = DEFAULT_BLOCK_K) -> tuple[int, int]:
+    """Shape-adapt the block sizes to a sequence (or ring-chunk) length:
+    clamp to the length, then halve toward a divisor (floor 128) so every
+    128-aligned length keeps the kernel — without this, lengths that are
+    multiples of 512 but not 1024 (1536, 2560, 3584, ...) would silently
+    regress to the score-materializing reference path the moment the
+    defaults grew past them."""
+    bq, bk = min(block_q, s), min(block_k, s)
+    while bq > 128 and s % bq:
+        bq //= 2
+    while bk > 128 and s % bk:
+        bk //= 2
+    return bq, bk
 
 
 # -- reference implementation (also the VJP recompute path) ------------------
@@ -408,11 +426,11 @@ def attention(q: jax.Array, k: jax.Array, v: jax.Array, causal: bool = True,
         # the -1 fold below would silently accept it and the kernel would
         # read misaligned v rows — fail loudly instead
         raise ValueError(f"k has {hk} heads but v has {v.shape[2]}")
-    # shape-adaptive blocks: shrink for short sequences instead of
-    # falling back (a 128-token test sequence should still go through
-    # the kernel path), keep the big defaults for long ones
-    block_q = min(block_q, s)
-    block_k = min(block_k, s)
+    # shape-adaptive blocks: shrink for short sequences and halve toward
+    # a divisor for lengths the big defaults don't divide, instead of
+    # falling back — a 128-token test sequence and a 1536-token train
+    # sequence both go through the kernel path
+    block_q, block_k = fit_blocks(s, block_q, block_k)
     eligible = (
         use_pallas
         and (interpret or _on_tpu())

@@ -103,12 +103,11 @@ def _ring_local(q_loc, k_loc, v_loc, axis: str, n: int, causal: bool):
 def _ring_flash_local(q_loc, k_loc, v_loc, axis: str, n: int, causal: bool,
                       interpret: bool):
     """Shard-local flash ring: q_loc [b, sc, h, d]; k/v [b, sc, hk, d]."""
-    from edl_tpu.ops.flash_attention import DEFAULT_BLOCK_K, DEFAULT_BLOCK_Q
+    from edl_tpu.ops.flash_attention import fit_blocks
 
     b, sc, h, d = q_loc.shape
     hk = k_loc.shape[2]
-    block_q = min(DEFAULT_BLOCK_Q, sc)
-    block_k = min(DEFAULT_BLOCK_K, sc)
+    block_q, block_k = fit_blocks(sc)
     fold = lambda x: x.transpose(0, 2, 1, 3).reshape(-1, sc, d)
     unfold_h = lambda x: x.reshape(b, h, sc, d).transpose(0, 2, 1, 3)
 
@@ -239,15 +238,16 @@ def ring_flash_attention_sharded(
     # 128-aligned and divisible by the (shape-adapted) blocks — a pallas
     # grid of sc // block would silently TRUNCATE otherwise, never
     # writing the tail query rows.  Ineligible shapes take the jnp ring.
-    from edl_tpu.ops.flash_attention import DEFAULT_BLOCK_K, DEFAULT_BLOCK_Q
+    from edl_tpu.ops.flash_attention import fit_blocks
 
     s = q.shape[1]
     sc = s // n
+    bq, bk = fit_blocks(sc) if sc else (1, 1)
     eligible = (
         s % n == 0
         and sc % 128 == 0
-        and sc % min(DEFAULT_BLOCK_Q, sc) == 0
-        and sc % min(DEFAULT_BLOCK_K, sc) == 0
+        and sc % bq == 0
+        and sc % bk == 0
     )
     h, hk = q.shape[2], k.shape[2]
     tp_size = mesh.shape[head_axis] if head is not None else 1

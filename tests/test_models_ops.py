@@ -99,6 +99,34 @@ def test_attention_fallback_on_odd_lengths():
     assert out.shape == (1, 100, 2, 64)
 
 
+def test_blocks_halve_to_divisor_keep_kernel_path():
+    # 1536 is a multiple of 512 but not of the 1024 default block_k: the
+    # blocks must halve to a divisor so the length STAYS on the kernel
+    # path (regression: growing the defaults silently sent such lengths
+    # to the score-materializing reference path).
+    import edl_tpu.ops.flash_attention as fa
+
+    for s in (1536, 1664):
+        bq, bk = fa.fit_blocks(s)
+        assert s % bq == 0 and s % bk == 0 and bq >= 128 and bk >= 128
+
+    key = jax.random.key(3)
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (1, 1536, 4, 32))
+    k = jax.random.normal(kk, (1, 1536, 2, 32))
+    v = jax.random.normal(kv, (1, 1536, 2, 32))
+    ref = reference_attention(q, jnp.repeat(k, 2, axis=2),
+                              jnp.repeat(v, 2, axis=2), causal=True)
+    # kernel path must be taken: make the fallback loud
+    import unittest.mock as mock
+    with mock.patch.object(fa, "reference_attention",
+                           side_effect=AssertionError("fell back")):
+        out = attention(q, k, v, causal=True, use_pallas=True,
+                        interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
 # -- ring attention ----------------------------------------------------------
 
 
