@@ -766,7 +766,9 @@ def reform_latency_leg() -> dict:
         # pod-startup cost, amortized by a pre-warmed image) from the
         # framework-attributable reform: poll membership for w2's JOIN
         client = srv.client()
-        t_deadline = time.monotonic() + 120  # matches the merged-wait below
+        # ONE shared 120 s budget for both waits — the poll must not
+        # serialize a second full deadline in front of the merged-wait
+        t_deadline = time.monotonic() + 120
         t_membership = None
         while time.monotonic() < t_deadline:
             _, members = client.members()
@@ -776,7 +778,8 @@ def reform_latency_leg() -> dict:
             time.sleep(0.02)
         t_merged, _ = _wait_log(
             logs["w0"],
-            lambda t: _count_entering(t) > worlds_before, 120)
+            lambda t: _count_entering(t) > worlds_before,
+            max(t_deadline - time.monotonic(), 1.0))
         out["join_total_from_spawn_s"] = round(t_merged - t_join, 2)
         if t_membership is not None:
             out["join_reform_s"] = round(t_merged - t_membership, 2)

@@ -468,6 +468,7 @@ bool Service::SaveTo(const std::string& path) const {
   ok = (fsync(fileno(f)) == 0) && ok;
   std::fclose(f);
   if (!ok) return false;
+  if (persist_hook) persist_hook("tmp");
   if (std::rename(tmp.c_str(), path.c_str()) != 0) return false;
   // The rename itself must survive a host power loss: fsync the parent
   // directory so the new directory entry is on disk before the caller

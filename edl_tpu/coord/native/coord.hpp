@@ -24,6 +24,7 @@
 #include <atomic>
 #include <cstdint>
 #include <deque>
+#include <functional>
 #include <map>
 #include <mutex>
 #include <string>
@@ -211,6 +212,12 @@ struct Service {
   // directory fsync) / startup load.
   bool SaveTo(const std::string& path) const;
   bool LoadFrom(const std::string& path);
+
+  // Test-only fault injection, called INSIDE SaveTo at its real
+  // boundaries — "tmp": temp file written+fsynced, rename not yet done
+  // (the torn-write window) — so the injected crash can never diverge
+  // from the actual persist mechanics.  Null in production.
+  mutable std::function<void(const char*)> persist_hook;
 
   // Sum of the components' durable-state versions: cheap change detection
   // for the server's persist gate (no O(state) serialize-and-compare on

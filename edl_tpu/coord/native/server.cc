@@ -69,6 +69,15 @@ int64_t g_member_ttl_ms = edlcoord::kDefaultMemberTtlMs;
 std::string g_state_file;
 std::atomic<int64_t> g_persisted_version{-1};
 std::mutex g_persist_mu;
+// Fault injection (tests only): on the Nth persist, die (SIGKILL
+// semantics via _exit) at the flagged point — "tmp" = after writing the
+// temp file, BEFORE the rename (the mid-persist power-loss window);
+// "acked" = after the rename+dir-fsync, before the response is written
+// (the op is durable but the client never hears OK).  Drives the
+// power-loss durability tests without filesystem fault injection.
+int g_crash_on_persist = 0;       // 0 = disabled; N = trip on Nth persist
+std::string g_crash_point;        // "tmp" | "acked"
+std::atomic<int> g_persist_count{0};
 
 void MaybePersist() {
   if (g_state_file.empty()) return;
@@ -78,8 +87,24 @@ void MaybePersist() {
   // the reverse (recording a version whose state was not yet written).
   int64_t version = g_service->DurableVersion();
   if (version == g_persisted_version.load()) return;
+  int n = g_persist_count.fetch_add(1) + 1;
+  bool trip = g_crash_on_persist != 0 && n == g_crash_on_persist;
+  // "tmp" = simulated power loss mid-persist, injected INSIDE SaveTo at
+  // the real torn-write window (temp written, rename not yet done) so
+  // the fault can never diverge from the production persist mechanics
+  g_service->persist_hook =
+      (trip && g_crash_point == "tmp")
+          ? std::function<void(const char*)>([](const char* stage) {
+              if (std::strcmp(stage, "tmp") == 0) _exit(137);
+            })
+          : nullptr;
   if (g_service->SaveTo(g_state_file)) {
     g_persisted_version.store(version);
+    if (trip && g_crash_point == "acked") {
+      // durable but unacked: the client must retry and the retry must
+      // converge (at-least-once + claimant-unique CAS semantics)
+      _exit(137);
+    }
   } else {
     std::fprintf(stderr,
                  "edl-coord: PERSIST FAILED for %s — state is in-memory "
@@ -267,6 +292,15 @@ int main(int argc, char** argv) {
     if (flag == "--passes") passes = std::atoi(argv[i + 1]);
     if (flag == "--member-ttl-ms") member_ttl_ms = std::atoll(argv[i + 1]);
     if (flag == "--state-file") state_file = argv[i + 1];
+    if (flag == "--crash-on-persist") {
+      // "<N>:<point>" e.g. "2:tmp" — test-only fault injection
+      std::string v = argv[i + 1];
+      size_t colon = v.find(':');
+      if (colon != std::string::npos) {
+        g_crash_on_persist = std::atoi(v.substr(0, colon).c_str());
+        g_crash_point = v.substr(colon + 1);
+      }
+    }
   }
   signal(SIGPIPE, SIG_IGN);
   g_task_timeout_ms = task_timeout_ms;

@@ -50,11 +50,14 @@ def spawn_server(
     member_ttl_ms: int = DEFAULT_MEMBER_TTL_MS,
     startup_timeout: float = 10.0,
     state_file: str | None = None,
+    crash_on_persist: str | None = None,
 ) -> ServerHandle:
     """Start edl-coord-server (port 0 = ephemeral) and wait until it
     reports its listening port.  ``state_file`` enables write-through
     durability: restart the server with the same file and it resumes the
-    job's queue accounting, KV and epoch (the etcd-sidecar role)."""
+    job's queue accounting, KV and epoch (the etcd-sidecar role).
+    ``crash_on_persist`` ("N:tmp" | "N:acked") is test-only fault
+    injection for the power-loss durability tests."""
     if not ensure_built():
         raise RuntimeError("cannot build the native coordination server "
                            "(g++ unavailable?)")
@@ -67,6 +70,8 @@ def spawn_server(
     ]
     if state_file:
         cmd += ["--state-file", str(state_file)]
+    if crash_on_persist:
+        cmd += ["--crash-on-persist", crash_on_persist]
     proc = subprocess.Popen(
         cmd,
         stdout=subprocess.PIPE,

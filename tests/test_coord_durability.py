@@ -123,6 +123,79 @@ def test_lease_driven_rollover_survives_kill9(tmp_path):
         _kill9(srv2)
 
 
+def test_power_loss_mid_persist_keeps_last_acked_snapshot(tmp_path):
+    """Power loss in the middle of a persist (temp file written, rename
+    never happens): the previous COMPLETE snapshot must survive — acked
+    state is never lost and a half-written file is never loaded.  Fault
+    injection: --crash-on-persist N:tmp kills the server at exactly that
+    boundary (the VERDICT r2 #7 'power-loss-style test')."""
+    import edl_tpu.coord.client as client_mod
+
+    state = str(tmp_path / "coord.state")
+    # persists: #1 add, #2 kv ckpt, #3 trips mid-persist
+    srv = spawn_server(state_file=state, crash_on_persist="3:tmp")
+    c = client_mod.CoordClient("127.0.0.1", srv.port,
+                               reconnect_window_s=1.0)
+    c.add_task(b"shard-0")                      # persist 1, acked
+    c.kv_set("ckpt/latest", b"/ckpt/gen-7")     # persist 2, acked
+    with pytest.raises((client_mod.CoordError, OSError)):
+        c.kv_set("ckpt/latest", b"/ckpt/gen-8")  # persist 3: dies, no ack
+    srv.process.wait(timeout=10)
+    assert srv.process.returncode == 137
+    assert (tmp_path / "coord.state.tmp").exists()  # the torn write
+
+    srv2 = spawn_server(state_file=state)
+    try:
+        c2 = srv2.client()
+        # every ACKED op survives; the unacked one is absent (it was
+        # never confirmed — the client's contract is retry-or-raise)
+        assert c2.kv_get("ckpt/latest") == b"/ckpt/gen-7"
+        s = c2.stats()
+        assert (s.todo, s.done) == (1, 0)
+    finally:
+        _kill9(srv2)
+
+
+def test_durable_but_unacked_converges_on_retry(tmp_path):
+    """Crash AFTER the rename+dir-fsync but before the response: the op
+    is durable yet the client never heard OK.  The client's retransmit
+    against the restarted coordinator must converge (idempotent KVSET) —
+    the other side of the acked=>durable guarantee."""
+    import threading
+
+    state = str(tmp_path / "coord.state")
+    port = _free_port()
+    srv = spawn_server(port=port, state_file=state,
+                       crash_on_persist="2:acked")
+    c = srv.client()
+    c.kv_set("a", b"1")  # persist 1, acked
+
+    result: dict = {}
+
+    def do_set():
+        try:
+            c.kv_set("b", b"2")  # persist 2: durable, then server dies
+            result["ok"] = True
+        except Exception as exc:  # pragma: no cover - would fail the test
+            result["error"] = str(exc)
+
+    t = threading.Thread(target=do_set)
+    t.start()
+    srv.process.wait(timeout=10)
+    assert srv.process.returncode == 137
+    # restart on the same port inside the client's reconnect window
+    srv2 = spawn_server(port=port, state_file=state)
+    try:
+        t.join(timeout=30)
+        assert result.get("ok"), result
+        c2 = srv2.client()
+        assert c2.kv_get("a") == b"1"
+        assert c2.kv_get("b") == b"2"  # durable before the crash AND
+        # converged through the retransmit — exactly once either way
+    finally:
+        _kill9(srv2)
+
+
 def test_client_reconnects_across_restart(tmp_path):
     state = str(tmp_path / "coord.state")
     port = _free_port()
