@@ -68,6 +68,12 @@ class TransformerConfig:
     use_flash: bool = True
     # remat the block fn: trade FLOPs for HBM (jax.checkpoint)
     remat: bool = True
+    # "full" recomputes the whole block (min memory); "dots" saves matmul
+    # outputs and recomputes only elementwise (jax's
+    # dots_with_no_batch_dims_saveable) — faster when the activations
+    # still fit (measured on v5e, LARGE: ~3% over full at half the batch;
+    # full wins when the bigger batch fits, so it stays the default).
+    remat_policy: str = "full"
     # True when the embed table is tp/fsdp-sharded (see ops/embedding.py);
     # False (gather) is the single-chip default.
     one_hot_embed: bool = False
@@ -285,7 +291,13 @@ def apply(params: dict, tokens: jax.Array,
     angles = rope_freqs(cfg, positions)
     block = _block
     if cfg.remat:
-        block = jax.checkpoint(_block, static_argnums=(3,))
+        if cfg.remat_policy not in ("full", "dots"):
+            raise ValueError(
+                f"remat_policy must be 'full' or 'dots', "
+                f"got {cfg.remat_policy!r}")
+        policy = (jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+                  if cfg.remat_policy == "dots" else None)
+        block = jax.checkpoint(_block, static_argnums=(3,), policy=policy)
     for p in params["layers"]:
         x = block(p, x, angles, cfg)
     x = rms_norm(x, params["norm"], cfg.norm_eps)

@@ -99,6 +99,31 @@ def test_attention_fallback_on_odd_lengths():
     assert out.shape == (1, 100, 2, 64)
 
 
+def test_remat_policies_match_no_remat():
+    # both remat modes are pure memory/FLOPs tradeoffs — loss and grads
+    # must match the no-remat step exactly
+    import dataclasses
+
+    base = dataclasses.replace(transformer.TINY, remat=False)
+    params = transformer.init(jax.random.key(0), base)
+    tokens = jax.random.randint(jax.random.key(1), (2, 64), 0,
+                                base.vocab_size, dtype=jnp.int32)
+    batch = (tokens, jnp.roll(tokens, -1, axis=1))
+    ref_loss, ref_grad = jax.value_and_grad(
+        transformer.make_loss_fn(base))(params, batch)
+    for pol in ("full", "dots"):
+        cfg = dataclasses.replace(base, remat=True, remat_policy=pol)
+        loss, grad = jax.value_and_grad(
+            transformer.make_loss_fn(cfg))(params, batch)
+        np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-6)
+        jax.tree.map(lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-5, rtol=1e-5),
+            grad, ref_grad)
+    with pytest.raises(ValueError, match="remat_policy"):
+        bad = dataclasses.replace(base, remat=True, remat_policy="dot")
+        transformer.make_loss_fn(bad)(params, batch)
+
+
 def test_blocks_halve_to_divisor_keep_kernel_path():
     # 1536 is a multiple of 512 but not of the 1024 default block_k: the
     # blocks must halve to a divisor so the length STAYS on the kernel
