@@ -73,7 +73,15 @@ class TransformerConfig:
     # dots_with_no_batch_dims_saveable) — faster when the activations
     # still fit (measured on v5e, LARGE: ~3% over full at half the batch;
     # full wins when the bigger batch fits, so it stays the default).
+    # Validated at construction even when remat is off, so a typo is
+    # caught where it was written, not when remat is eventually enabled.
     remat_policy: str = "full"
+
+    def __post_init__(self):
+        if self.remat_policy not in ("full", "dots"):
+            raise ValueError(
+                f"remat_policy must be 'full' or 'dots', "
+                f"got {self.remat_policy!r}")
     # True when the embed table is tp/fsdp-sharded (see ops/embedding.py);
     # False (gather) is the single-chip default.
     one_hot_embed: bool = False
@@ -291,10 +299,6 @@ def apply(params: dict, tokens: jax.Array,
     angles = rope_freqs(cfg, positions)
     block = _block
     if cfg.remat:
-        if cfg.remat_policy not in ("full", "dots"):
-            raise ValueError(
-                f"remat_policy must be 'full' or 'dots', "
-                f"got {cfg.remat_policy!r}")
         policy = (jax.checkpoint_policies.dots_with_no_batch_dims_saveable
                   if cfg.remat_policy == "dots" else None)
         block = jax.checkpoint(_block, static_argnums=(3,), policy=policy)

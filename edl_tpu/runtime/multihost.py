@@ -609,6 +609,20 @@ def _world_child(plan: WorldPlan, cfg: WorkerConfig, result_path: str,
 
 # -- the supervisor ----------------------------------------------------------
 
+def _child_context():
+    """Multiprocessing context for world children: spawn, deliberately.
+
+    A forkserver with jax/numpy/optax preloaded would cut the ~3-5 s of
+    cold interpreter + import bootstrap per world (the dominant reform
+    term after the compile cache) — but it was tried and MEASURED to
+    deadlock the Orbax/fsdp collective paths (importing jax starts
+    threads in the forkserver; forked children inherit their carcasses —
+    the classic fork-after-threads hazard).  Spawn costs seconds but is
+    correct under every path; on k8s the joiner's bootstrap is pod
+    startup anyway."""
+    return mp.get_context("spawn")
+
+
 def run_elastic_worker(
     coord,
     name: str,
@@ -673,7 +687,7 @@ def run_elastic_worker(
             reform_grace_s = coord.member_ttl_ms() / 1000.0 * 2 + 5.0
         except Exception:
             reform_grace_s = 35.0
-    ctx = mp.get_context("spawn")
+    ctx = _child_context()
     os.makedirs(ckpt_dir, exist_ok=True)
     ew.join()
     # Reform timeline into the process tracer (the reference had no
