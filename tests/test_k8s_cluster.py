@@ -210,3 +210,35 @@ def test_list_pods_roles_and_scoping(kube):
     assert trainers[0].tpu_limit == 2 and trainers[0].node == "a0"
     everything = c.list_pods()
     assert {p.role for p in everything} == {"trainer", "master", "system"}
+
+
+def test_collector_on_k8s_backend(kube):
+    """The deployed observability path: the Collector's four TSV columns
+    computed from the REAL K8sCluster method bodies (all-namespaces pod
+    scan + node inventory) against the stub apiserver — previously only
+    FakeCluster exercised the Collector."""
+    import io
+
+    from edl_tpu.observability.collector import Collector
+
+    k8s_mod, state = kube
+    state.nodes = [make_node("a0", cpu="16", memory="64Gi", tpu=8)]
+    state.pods = [
+        make_pod("j1-t-0", labels={"edl-tpu-job": "j1"}, node="a0",
+                 cpu="1", memory="1Gi", tpu=1),
+        make_pod("j1-t-1", labels={"edl-tpu-job": "j1"}, node="a0",
+                 cpu="1", memory="1Gi", tpu=1),
+        make_pod("j2-t-0", phase="Pending",
+                 labels={"edl-tpu-job": "j2"}, cpu="1", memory="1Gi",
+                 tpu=1),
+        make_pod("sys-0", node="a0", cpu="500m", memory="1Gi"),
+    ]
+    c = k8s_mod.K8sCluster(kubeconfig="ignored")
+    out = io.StringIO()
+    s = Collector(c, out=out).run_once()
+    assert s.submitted_jobs == 2
+    assert s.pending_jobs == 1  # j2: all trainers pending
+    assert s.running_trainers["default/j1"] == 2
+    assert abs(s.chip_utils_pct - 100.0 * 2 / 8) < 1e-9
+    header, line = out.getvalue().strip().split("\n")
+    assert header.startswith("TIMESTAMP\tSUBMITTED-JOBS")
