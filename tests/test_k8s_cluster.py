@@ -242,3 +242,6 @@ def test_collector_on_k8s_backend(kube):
     assert abs(s.chip_utils_pct - 100.0 * 2 / 8) < 1e-9
     header, line = out.getvalue().strip().split("\n")
     assert header.startswith("TIMESTAMP\tSUBMITTED-JOBS")
+    fields = line.split("\t")
+    assert len(fields) == len(header.split("\t"))
+    assert fields[1] == "2" and fields[2] == "1"  # submitted, pending
