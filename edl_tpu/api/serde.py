@@ -37,7 +37,15 @@ CRD_PLURAL = "trainingjobs"
 
 
 def _norm(d: dict[str, Any]) -> dict[str, Any]:
-    return {k.replace("-", "_"): v for k, v in d.items()}
+    # Snake_case wins when both spellings are present (the CRD schema,
+    # k8s/crd.yaml, declares both so neither is apiserver-pruned; a manifest
+    # carrying both must resolve deterministically, not by dict order).
+    out: dict[str, Any] = {}
+    for k, v in d.items():
+        nk = k.replace("-", "_")
+        if nk == k or nk not in d:
+            out[nk] = v
+    return out
 
 
 def _resources(d: dict[str, Any] | None) -> ResourceRequirements:

@@ -30,3 +30,41 @@ def fake_cluster():
     from edl_tpu.cluster.fake import FakeCluster
 
     return FakeCluster()
+
+
+@pytest.fixture
+def kube(monkeypatch):
+    """The stub apiserver (tests/k8s_stub.py) installed as the `kubernetes`
+    package, with one 8-chip TPU node; yields (k8s module, StubState)."""
+    import importlib
+    import sys
+
+    from tests.k8s_stub import StubState, build_module, make_node
+
+    state = StubState()
+    state.nodes = [make_node("a0", cpu="64", memory="128Gi", tpu=8,
+                             labels={"edl-tpu/ici-domain": "slice-a"})]
+    module = build_module(state)
+    monkeypatch.setitem(sys.modules, "kubernetes", module)
+    import edl_tpu.cluster.k8s as k8s_mod
+
+    importlib.reload(k8s_mod)
+    yield k8s_mod, state
+    monkeypatch.delitem(sys.modules, "kubernetes")
+    importlib.reload(k8s_mod)
+
+
+@pytest.fixture
+def control_plane(kube):
+    """A full deployed-style control plane over the stub apiserver:
+    (K8sCluster, Controller, TrainingJobSyncLoop, StubState)."""
+    from edl_tpu.controller.controller import Controller
+    from edl_tpu.controller.sync import TrainingJobSyncLoop
+
+    k8s_mod, state = kube
+    cluster = k8s_mod.K8sCluster(kubeconfig="ignored")
+    controller = Controller(cluster, updater_convert_seconds=0.05,
+                            updater_confirm_seconds=0.05)
+    sync = TrainingJobSyncLoop(cluster, controller, poll_seconds=0.05)
+    yield cluster, controller, sync, state
+    controller.stop()

@@ -14,31 +14,15 @@ full teardown.
 
 from __future__ import annotations
 
-import importlib
-import sys
 import time
-
-import pytest
 
 from edl_tpu.controller.controller import Controller
 from edl_tpu.controller.sync import TrainingJobSyncLoop
 
-from tests.k8s_stub import StubState, build_module, make_node, make_pod
+from tests.k8s_stub import StubState, make_pod
 
-
-@pytest.fixture
-def kube(monkeypatch):
-    state = StubState()
-    state.nodes = [make_node("a0", cpu="64", memory="128Gi", tpu=8,
-                             labels={"edl-tpu/ici-domain": "slice-a"})]
-    module = build_module(state)
-    monkeypatch.setitem(sys.modules, "kubernetes", module)
-    import edl_tpu.cluster.k8s as k8s_mod
-
-    importlib.reload(k8s_mod)
-    yield k8s_mod, state
-    monkeypatch.delitem(sys.modules, "kubernetes")
-    importlib.reload(k8s_mod)
+# fixtures: `kube` and `control_plane` live in tests/conftest.py (shared
+# with test_crd_pruning.py)
 
 
 def cr_manifest(name="job1", lo=2, hi=4, fault_tolerant=True):
@@ -89,17 +73,6 @@ def wait_phase(sync: TrainingJobSyncLoop, state: StubState, name: str,
     raise TimeoutError(
         f"CR {name} never reached recorded phase {phase}; "
         f"have {(cr or {}).get('status')!r}")
-
-
-@pytest.fixture
-def control_plane(kube):
-    k8s_mod, state = kube
-    cluster = k8s_mod.K8sCluster(kubeconfig="ignored")
-    controller = Controller(cluster, updater_convert_seconds=0.05,
-                            updater_confirm_seconds=0.05)
-    sync = TrainingJobSyncLoop(cluster, controller, poll_seconds=0.05)
-    yield cluster, controller, sync, state
-    controller.stop()
 
 
 def test_cr_lifecycle_end_to_end(control_plane):
