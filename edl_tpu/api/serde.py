@@ -7,8 +7,14 @@ The manifest shape is kept deliberately close to the reference's so a
 reference job YAML ports by changing ``apiVersion`` and swapping GPU
 limits for ``google.com/tpu`` chips / a ``topology``.
 
-Both snake_case and the reference's kebab-case keys are accepted
-(``min-instance`` / ``min_instance``).
+Snake_case is canonical.  The reference's kebab-case spellings are
+accepted for exactly the keys its manifests write in kebab
+(``min-instance`` / ``max-instance``, reference example/examplejob.yaml)
+plus our own ``allow-multi-domain`` — the same alias set k8s/crd.yaml
+declares, so the in-process file path and the ``kubectl apply`` CR path
+accept the same spellings (an alias the schema did not declare would be
+apiserver-pruned on one path while the CLI accepted it on the other).
+tests/test_crd_pruning.py cross-checks this set against the shipped CRD.
 """
 
 from __future__ import annotations
@@ -36,13 +42,23 @@ CRD_VERSION = "v1"
 CRD_PLURAL = "trainingjobs"
 
 
+#: kebab → snake aliases (mirrors the declarations in k8s/crd.yaml; keep
+#: the two in lockstep or a manifest key will silently behave differently
+#: between `edl-tpu submit` and `kubectl apply`)
+KEBAB_ALIASES = {
+    "min-instance": "min_instance",
+    "max-instance": "max_instance",
+    "allow-multi-domain": "allow_multi_domain",
+}
+
+
 def _norm(d: dict[str, Any]) -> dict[str, Any]:
     # Snake_case wins when both spellings are present (the CRD schema,
     # k8s/crd.yaml, declares both so neither is apiserver-pruned; a manifest
     # carrying both must resolve deterministically, not by dict order).
     out: dict[str, Any] = {}
     for k, v in d.items():
-        nk = k.replace("-", "_")
+        nk = KEBAB_ALIASES.get(k, k)
         if nk == k or nk not in d:
             out[nk] = v
     return out

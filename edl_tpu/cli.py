@@ -64,7 +64,9 @@ def cmd_controller(args) -> int:
         from edl_tpu.controller.sync import TrainingJobSyncLoop
 
         sync = TrainingJobSyncLoop(cluster, controller,
-                                   poll_seconds=args.loop_seconds)
+                                   poll_seconds=args.loop_seconds,
+                                   gc_orphans=args.gc_orphans,
+                                   orphan_grace_ticks=args.orphan_grace_ticks)
         sync.start()
     try:
         while True:  # role of the select{} park in edl.go:50
@@ -255,6 +257,15 @@ def build_parser() -> argparse.ArgumentParser:
     c.add_argument("--pow2-shapes", action="store_true",
                    help="scale trainer counts in powers of two (TPU slice "
                         "shape policy)")
+    c.add_argument("--gc-orphans", action=argparse.BooleanOptionalAction,
+                   default=True,
+                   help="tear down job resources whose TrainingJob CR is "
+                        "gone (--no-gc-orphans = log-only; teardown always "
+                        "waits --orphan-grace-ticks consecutive ticks)")
+    c.add_argument("--orphan-grace-ticks", type=int, default=3,
+                   help="consecutive CR-less ticks before an orphaned "
+                        "group is torn down (min 2: never on the first "
+                        "tick)")
     c.set_defaults(fn=cmd_controller)
 
     c = sub.add_parser("collector", help="cluster metrics TSV")

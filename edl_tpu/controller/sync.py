@@ -56,10 +56,23 @@ class TrainingJobSyncLoop:
         store: TrainingJobStore,
         controller: Controller,
         poll_seconds: float = 5.0,
+        gc_orphans: bool = True,
+        orphan_grace_ticks: int = 3,
     ) -> None:
         self.store = store
         self.controller = controller
         self.poll_seconds = poll_seconds
+        #: False → the orphan sweep only logs, never deletes (operator
+        #: opt-out for clusters where other tooling shares the job label)
+        self.gc_orphans = gc_orphans
+        #: a group must be CR-less for this many CONSECUTIVE ticks before
+        #: teardown — never on the first tick after controller start, so a
+        #: transient LIST miss or a CR created moments after its resources
+        #: cannot destroy running training work irreversibly.  The clamp
+        #: floor of 2 enforces that invariant even for --orphan-grace-ticks 1
+        self.orphan_grace_ticks = max(2, orphan_grace_ticks)
+        #: (ns, name) → consecutive ticks observed CR-less
+        self._orphan_strikes: dict[tuple[str, str], int] = {}
         #: uid → the spec dict we last acted on (change detection; spec
         #: content, not resourceVersion, so replays are harmless)
         self._seen_specs: dict[str, Any] = {}
@@ -143,23 +156,50 @@ class TrainingJobSyncLoop:
         the reference's informer too; its del_jobs.sh was the manual
         fix).  On the CRD-driven control plane the CR is the source of
         truth, so a group without a CR is garbage.  Cluster-wide, to
-        match the cluster-wide CR watch."""
+        match the cluster-wide CR watch.
+
+        Deletion is irreversible, so three guards apply: jobs the
+        in-process controller registry manages (the pre-CR submit flow)
+        are never candidates; a candidate must stay CR-less for
+        ``orphan_grace_ticks`` consecutive ticks (log-only until then);
+        and ``gc_orphans=False`` turns the sweep into pure logging."""
         lister = getattr(self.store, "list_trainer_groups", None)
         deleter = getattr(self.store, "delete_resources", None)
         if lister is None or deleter is None:
             return
         cr_pairs = {tuple(uid.split("/", 1)) for uid in listed}
         managed = {tuple(uid.split("/", 1)) for uid in self._jobs}
+        # jobs submitted in-process (Controller.submit without a CR —
+        # tests, demos, legacy tooling) are owned work, not garbage
+        managed |= {(j.namespace, j.name) for j in self.controller.jobs()}
         try:
             groups = set(lister())
         except Exception as exc:
             log.error("orphan sweep list failed", error=str(exc))
             return
-        for ns, name in sorted(groups - cr_pairs - managed):
+        candidates = groups - cr_pairs - managed
+        # a group that regained its CR (or vanished) resets its strikes
+        for pair in list(self._orphan_strikes):
+            if pair not in candidates:
+                del self._orphan_strikes[pair]
+        for ns, name in sorted(candidates):
+            strikes = self._orphan_strikes.get((ns, name), 0) + 1
+            self._orphan_strikes[(ns, name)] = strikes
+            if strikes < self.orphan_grace_ticks:
+                log.warn("orphaned job resources (no CR); will tear down "
+                         "if still orphaned",
+                         job=f"{ns}/{name}",
+                         strike=f"{strikes}/{self.orphan_grace_ticks}")
+                continue
+            if not self.gc_orphans:
+                log.warn("orphaned job resources (no CR); gc disabled, "
+                         "leaving in place", job=f"{ns}/{name}")
+                continue
             log.warn("tearing down orphaned job resources (no CR)",
                      job=f"{ns}/{name}")
             try:
                 deleter(TrainingJob(name=name, namespace=ns))
+                del self._orphan_strikes[(ns, name)]
             except Exception as exc:
                 log.error("orphan teardown failed", job=f"{ns}/{name}",
                           error=str(exc))

@@ -71,6 +71,28 @@ def test_pruner_keeps_both_instance_spellings():
                       "min_instance": 3}
 
 
+def test_serde_aliases_and_crd_schema_in_lockstep():
+    """Every spelling the client serde accepts must be declared in the
+    CRD schema wherever its canonical form is — otherwise the key works
+    via `edl-tpu submit` but is apiserver-pruned on `kubectl apply`."""
+    def walk(schema, out):
+        props = schema.get("properties") or {}
+        for k, sub in props.items():
+            out.setdefault(k, []).append(props)
+            walk(sub, out)
+        if isinstance(schema.get("items"), dict):
+            walk(schema["items"], out)
+    declared: dict[str, list] = {}
+    walk(SCHEMA, declared)
+    for kebab, snake in serde.KEBAB_ALIASES.items():
+        assert snake in declared, snake
+        for scope in declared[snake]:
+            assert kebab in scope, (
+                f"{kebab} missing from a schema scope declaring {snake}")
+    # the master-endpoint alias serde reads is declared too
+    assert "coord_endpoint" in declared
+
+
 def test_serde_prefers_snake_when_both_spellings_present():
     t = serde.job_from_dict({
         "kind": "TrainingJob", "metadata": {"name": "j"},

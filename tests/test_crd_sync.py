@@ -235,7 +235,10 @@ def test_controller_restart_adopts_running_jobs(control_plane):
 def test_orphaned_resources_swept_after_restart(control_plane):
     """`kubectl delete tj` while the controller is down must not leak the
     trainer group forever: the CR is the source of truth, so a group
-    without a CR is torn down by the sync loop's orphan sweep."""
+    without a CR is torn down by the sync loop's orphan sweep — but only
+    after the grace window: teardown is irreversible, so the first ticks
+    after a controller start are LOG-ONLY (advisor r3: a single-tick sweep
+    destroyed running work on controller upgrade)."""
     cluster, controller, sync, state = control_plane
     cluster.create_training_job_cr(cr_manifest("job1", lo=2, hi=4))
     sync.run_once()
@@ -247,11 +250,77 @@ def test_orphaned_resources_swept_after_restart(control_plane):
 
     controller2 = Controller(cluster, updater_convert_seconds=0.05,
                              updater_confirm_seconds=0.05)
-    sync2 = TrainingJobSyncLoop(cluster, controller2, poll_seconds=0.05)
+    sync2 = TrainingJobSyncLoop(cluster, controller2, poll_seconds=0.05,
+                                orphan_grace_ticks=3)
     try:
-        sync2.run_once()
+        for _ in range(2):  # inside the grace window: nothing destroyed
+            sync2.run_once()
+            assert ("default", "job1-trainer") in state.jobs
+        sync2.run_once()  # third consecutive CR-less tick: swept
         assert ("default", "job1-trainer") not in state.jobs
         assert not state.replicasets and not state.services
+    finally:
+        controller2.stop()
+
+
+def test_orphan_strikes_reset_when_cr_reappears(control_plane):
+    """A CR applied moments after its resources (or a transient LIST
+    blip) must clear the strike counter — no teardown later."""
+    cluster, controller, sync, state = control_plane
+    cluster.create_training_job_cr(cr_manifest("job1", lo=2, hi=4))
+    sync.run_once()
+    controller.stop()
+    saved = state.custom_objects.pop(
+        ("edl.tpu", "default", "trainingjobs", "job1"))
+
+    controller2 = Controller(cluster, updater_convert_seconds=0.05,
+                             updater_confirm_seconds=0.05)
+    sync2 = TrainingJobSyncLoop(cluster, controller2, poll_seconds=0.05,
+                                orphan_grace_ticks=3)
+    try:
+        sync2.run_once()
+        sync2.run_once()  # 2 strikes accrued
+        state.custom_objects[
+            ("edl.tpu", "default", "trainingjobs", "job1")] = saved
+        sync2.run_once()  # CR back: strikes reset, job adopted
+        assert sync2._orphan_strikes == {}
+        for _ in range(4):
+            sync2.run_once()
+        assert ("default", "job1-trainer") in state.jobs
+    finally:
+        controller2.stop()
+
+
+def test_in_process_submitted_job_never_swept(control_plane):
+    """A job submitted straight into the controller registry (the pre-CR
+    flow: tests, demos, legacy tooling) has no CR by design — the sweep
+    must treat it as owned work, not garbage (advisor r3 medium)."""
+    from edl_tpu.api.serde import job_from_dict
+
+    cluster, controller, sync, state = control_plane
+    controller.submit(job_from_dict(cr_manifest("direct", lo=1, hi=2)))
+    assert ("default", "direct-trainer") in state.jobs
+    for _ in range(5):  # well past any grace window
+        sync.run_once()
+    assert ("default", "direct-trainer") in state.jobs
+
+
+def test_gc_orphans_off_is_log_only(control_plane):
+    """--no-gc-orphans: the sweep reports orphans but never deletes."""
+    cluster, controller, sync, state = control_plane
+    cluster.create_training_job_cr(cr_manifest("job1", lo=2, hi=4))
+    sync.run_once()
+    controller.stop()
+    del state.custom_objects[("edl.tpu", "default", "trainingjobs", "job1")]
+
+    controller2 = Controller(cluster, updater_convert_seconds=0.05,
+                             updater_confirm_seconds=0.05)
+    sync2 = TrainingJobSyncLoop(cluster, controller2, poll_seconds=0.05,
+                                gc_orphans=False, orphan_grace_ticks=2)
+    try:
+        for _ in range(6):
+            sync2.run_once()
+        assert ("default", "job1-trainer") in state.jobs
     finally:
         controller2.stop()
 
@@ -270,9 +339,12 @@ def test_orphan_sweep_covers_other_namespaces(control_plane):
     del state.custom_objects[("edl.tpu", "team-a", "trainingjobs", "nsjob")]
     controller2 = Controller(cluster, updater_convert_seconds=0.05,
                              updater_confirm_seconds=0.05)
-    sync2 = TrainingJobSyncLoop(cluster, controller2, poll_seconds=0.05)
+    sync2 = TrainingJobSyncLoop(cluster, controller2, poll_seconds=0.05,
+                                orphan_grace_ticks=2)
     try:
-        sync2.run_once()
+        sync2.run_once()  # strike 1: log-only
+        assert ("team-a", "nsjob-trainer") in state.jobs
+        sync2.run_once()  # strike 2: swept
         assert ("team-a", "nsjob-trainer") not in state.jobs
     finally:
         controller2.stop()
