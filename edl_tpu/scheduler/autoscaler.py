@@ -133,6 +133,10 @@ class Autoscaler:
         if self._thread is not None:
             self._thread.join(timeout=5)
 
+    def is_alive(self) -> bool:
+        """Liveness of the background loop — the /healthz probe truth."""
+        return self._thread is not None and self._thread.is_alive()
+
     # -- internals ---------------------------------------------------------
 
     def _sync_parallelism(self, j: PlannedJob) -> bool:

@@ -283,3 +283,42 @@ def test_updater_surfaces_scaling_phase():
     c.reconcile()  # capacity arrives; the kubelet places the pods
     assert wait_phase(lambda: u.phase, JobPhase.RUNNING)
     u.stop()
+
+
+def test_coordinator_manifest_probes_and_health_env():
+    """The advertised health port must be served and probed: the manifest
+    wires EDL_HEALTH_PORT into the coord process (which serves /healthz,
+    coord/native/server.cc) and points liveness/readiness at it — a
+    wedged coordinator gets restarted by the kubelet (reference
+    docker/paddle_k8s:27-31 served :8080 the same way)."""
+    from edl_tpu.api.validation import set_defaults_and_validate
+    from edl_tpu.controller.jobparser import HEALTH_PORT
+
+    job = set_defaults_and_validate(mk_job())
+    coord = parse_to_manifests(job)[0]
+    container = coord["spec"]["template"]["spec"]["containers"][0]
+    env = {e["name"]: e["value"] for e in container["env"]}
+    assert env["EDL_HEALTH_PORT"] == str(HEALTH_PORT)
+    for probe in ("livenessProbe", "readinessProbe"):
+        http = container[probe]["httpGet"]
+        assert http == {"path": "/healthz", "port": HEALTH_PORT}
+    ports = {p["name"]: p["containerPort"] for p in container["ports"]}
+    assert ports["health"] == HEALTH_PORT
+
+
+def test_controller_deployment_manifest_probes():
+    """k8s/controller.yaml wires the CLI's --health-port and probes it."""
+    import pathlib
+
+    import yaml
+
+    doc = yaml.safe_load(
+        (pathlib.Path(__file__).resolve().parent.parent /
+         "k8s" / "controller.yaml").read_text())
+    container = doc["spec"]["template"]["spec"]["containers"][0]
+    cmd = container["command"]
+    assert "--health-port" in cmd
+    port = int(cmd[cmd.index("--health-port") + 1])
+    assert {"containerPort": port, "name": "health"} in container["ports"]
+    assert container["livenessProbe"]["httpGet"]["path"] == "/healthz"
+    assert container["readinessProbe"]["httpGet"]["path"] == "/healthz"

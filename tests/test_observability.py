@@ -125,3 +125,52 @@ class TestTracer:
         with profile_step("unit_step"):
             pass
         assert any(e.name == "unit_step" for e in get_tracer().events())
+
+
+class TestServeHealth:
+    """The controller-side /healthz (edl_tpu/observability/health.py):
+    200 while every named check passes, 503 the moment one fails — that
+    transition is what makes k8s/controller.yaml's livenessProbe restart
+    a controller whose autoscaler/sync thread died."""
+
+    def test_ok_then_unhealthy_then_shutdown(self):
+        import json
+        import urllib.error
+        import urllib.request
+
+        from edl_tpu.observability.health import serve_health
+
+        state = {"alive": True}
+        srv = serve_health(0, {"autoscaler": lambda: state["alive"]},
+                           host="127.0.0.1")
+        try:
+            port = srv.server_address[1]
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/healthz", timeout=5) as r:
+                doc = json.loads(r.read())
+            assert r.status == 200
+            assert doc == {"status": "ok", "autoscaler": True}
+
+            state["alive"] = False  # the thread died
+            try:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/healthz", timeout=5)
+                raise AssertionError("expected 503")
+            except urllib.error.HTTPError as e:
+                assert e.code == 503
+                assert json.loads(e.read())["autoscaler"] is False
+
+            # a check that RAISES counts as dead, not as a 500
+            srv2 = serve_health(0, {"boom": lambda: 1 / 0},
+                                host="127.0.0.1")
+            try:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv2.server_address[1]}/healthz",
+                    timeout=5)
+                raise AssertionError("expected 503")
+            except urllib.error.HTTPError as e:
+                assert e.code == 503
+            finally:
+                srv2.shutdown()
+        finally:
+            srv.shutdown()
