@@ -66,7 +66,8 @@ def cmd_controller(args) -> int:
         sync = TrainingJobSyncLoop(cluster, controller,
                                    poll_seconds=args.loop_seconds,
                                    gc_orphans=args.gc_orphans,
-                                   orphan_grace_ticks=args.orphan_grace_ticks)
+                                   orphan_grace_ticks=args.orphan_grace_ticks,
+                                   watch=args.watch)
         sync.start()
     health = None
     if args.health_port >= 0:
@@ -284,6 +285,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="serve GET /healthz for k8s probes "
                         "(k8s/controller.yaml passes 8080); -1 disables, "
                         "0 = OS-assigned")
+    c.add_argument("--watch", action=argparse.BooleanOptionalAction,
+                   default=True,
+                   help="stream TrainingJob watch events between periodic "
+                        "full LISTs (the reference informer model); "
+                        "--no-watch = pure poll-list every tick")
     c.set_defaults(fn=cmd_controller)
 
     c = sub.add_parser("collector", help="cluster metrics TSV")

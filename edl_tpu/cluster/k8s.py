@@ -263,11 +263,40 @@ class K8sCluster(Cluster):
         """TrainingJob custom objects across ALL namespaces (the poll-list
         the sync loop diffs; role of the informer's NamespaceAll ListWatch
         source, reference pkg/controller.go:80-87)."""
+        return self.list_training_job_crs_with_rv()[0]
+
+    def list_training_job_crs_with_rv(self) -> tuple[list[dict], str]:
+        """(items, list resourceVersion) — the rv anchors a streaming
+        watch exactly where this LIST observed the collection."""
         from edl_tpu.api.serde import CRD_GROUP, CRD_PLURAL, CRD_VERSION
 
         out = self._custom.list_cluster_custom_object(
             CRD_GROUP, CRD_VERSION, CRD_PLURAL)
-        return list(out.get("items") or [])
+        rv = str((out.get("metadata") or {}).get("resourceVersion") or "")
+        return list(out.get("items") or []), rv
+
+    def watch_training_job_crs(self, resource_version: str,
+                               timeout_seconds: int = 30):
+        """Streaming watch from ``resource_version``: yields kubernetes
+        watch events ({"type": ADDED|MODIFIED|DELETED, "object": cr}) —
+        the event-driven half of the reference informer's ListWatch
+        (reference pkg/controller.go:87-107).  The stream ends at the
+        server-side timeout (the caller loops); a stale rv raises the
+        client's 410 Gone ApiException, which the sync loop answers with
+        a fresh LIST."""
+        from kubernetes import watch as k8s_watch
+
+        from edl_tpu.api.serde import CRD_GROUP, CRD_PLURAL, CRD_VERSION
+
+        w = k8s_watch.Watch()
+        try:
+            yield from w.stream(
+                self._custom.list_cluster_custom_object,
+                CRD_GROUP, CRD_VERSION, CRD_PLURAL,
+                resource_version=resource_version,
+                timeout_seconds=timeout_seconds)
+        finally:
+            w.stop()
 
     def get_training_job_cr(self, name: str, namespace: str | None = None
                             ) -> dict | None:

@@ -16,18 +16,26 @@ and each tick writes every job's phase + per-role replica statuses into
 the CR's status subresource (only on change), so ``kubectl get tj`` shows
 the lifecycle the way the reference's CRD printer columns did.
 
-Poll-list rather than a streaming watch: the controller's reconcile
-cadence is 5 s (reference pkg/autoscaler.go:31) and a LIST at that cadence
-is the reference's own resync model (its informer disables resync only
-because Gen-1 never wrote status back; a poll-list is also immune to the
-dropped-watch staleness a real informer must re-list to fix).  The diff is
-driven purely by the listed spec content, not resourceVersion bookkeeping,
-so a missed tick never loses an event — the next tick sees the same truth.
+Two watch modes share the same diff/dispatch core:
+
+* **poll-list** (default off the deployed path's critical sections, and
+  the fallback everywhere): a full LIST each tick; the diff is driven
+  purely by listed spec content, not resourceVersion bookkeeping, so a
+  missed tick never loses an event — the next tick sees the same truth.
+* **streaming watch** (``watch=True``; the reference informer's
+  event-driven ListWatch, pkg/controller.go:87-107): a LIST anchors a
+  resourceVersion, then watch events drive add/update/delete with no
+  O(cluster) LIST per tick.  The stream is re-anchored by a fresh LIST
+  on any error — including 410 Gone after apiserver compaction — and a
+  periodic full resync (every ``resync_every`` windows) keeps the orphan
+  sweep and any missed-event drift bounded, which is exactly the
+  re-list discipline a production informer follows.
 """
 
 from __future__ import annotations
 
 import threading
+import time
 from typing import Any, Optional, Protocol
 
 from edl_tpu.api.serde import job_from_dict, status_to_dict
@@ -58,10 +66,19 @@ class TrainingJobSyncLoop:
         poll_seconds: float = 5.0,
         gc_orphans: bool = True,
         orphan_grace_ticks: int = 3,
+        watch: bool = False,
+        resync_every: int = 6,
     ) -> None:
         self.store = store
         self.controller = controller
         self.poll_seconds = poll_seconds
+        #: True → consume streaming watch events between full LISTs
+        self.watch = watch
+        #: full LIST resync after this many watch windows (window length
+        #: = poll_seconds), bounding sweep latency and any event drift
+        self.resync_every = max(1, resync_every)
+        #: resourceVersion of the last LIST (anchors the watch stream)
+        self._last_rv: Optional[str] = None
         #: False → the orphan sweep only logs, never deletes (operator
         #: opt-out for clusters where other tooling shares the job label)
         self.gc_orphans = gc_orphans
@@ -107,19 +124,92 @@ class TrainingJobSyncLoop:
         return self._thread is not None and self._thread.is_alive()
 
     def _run(self) -> None:
+        windows = 0
         while not self._stop.is_set():
-            try:
-                self.run_once()
-            except Exception as exc:  # LIST failures must not kill the loop
-                log.error("sync tick failed", error=str(exc))
-            self._stop.wait(self.poll_seconds)
+            if (not self.watch or self._last_rv is None
+                    or windows % self.resync_every == 0):
+                try:
+                    self.run_once()
+                except Exception as exc:  # LIST failure must not kill the loop
+                    log.error("sync tick failed", error=str(exc))
+            if self.watch and self._last_rv is not None:
+                try:
+                    self._watch_window(self.poll_seconds)
+                except Exception as exc:
+                    # 410 Gone (compaction), dropped connection, anything:
+                    # the informer answer is a fresh LIST re-anchor
+                    log.warn("watch stream failed; re-listing",
+                             error=str(exc))
+                    self._last_rv = None
+                # phase transitions happen without CR events (pods coming
+                # ready); flush recorded statuses every window
+                self._write_back_statuses()
+            else:
+                self._stop.wait(self.poll_seconds)
+            windows += 1
+
+    def _watch_window(self, seconds: float) -> None:
+        """Consume watch events for one window.
+
+        The stream normally ends at its server-side timeout, but a proxy
+        or apiserver may close it early (idle-close, EOF-after-open);
+        sleeping out the remainder of the window keeps the loop paced —
+        without it an early-closing connection turns the controller into
+        a hot loop of watch requests (review r4)."""
+        t0 = time.monotonic()
+        try:
+            stream = getattr(self.store, "watch_training_job_crs", None)
+            if stream is None:  # store has no watch surface: stay poll-list
+                return
+            for ev in stream(self._last_rv,
+                             timeout_seconds=max(1, int(seconds))):
+                if self._stop.is_set():
+                    return
+                self._handle_event(ev)
+        finally:
+            remaining = seconds - (time.monotonic() - t0)
+            if remaining > 0 and not self._stop.is_set():
+                self._stop.wait(remaining)
+
+    def _handle_event(self, ev: dict) -> None:
+        typ = ev.get("type")
+        cr = ev.get("object") or {}
+        meta = cr.get("metadata") or {}
+        name = meta.get("name", "")
+        if not name:
+            return
+        uid = f"{meta.get('namespace', 'default')}/{name}"
+        rv = meta.get("resourceVersion")
+        if rv:
+            self._last_rv = str(rv)
+        try:
+            if typ == "DELETED":
+                if uid in self._seen_specs or uid in self._jobs:
+                    self._on_delete(uid)
+                self._rejected_specs.pop(uid, None)
+                self._written_status.pop(uid, None)
+            elif typ in ("ADDED", "MODIFIED"):
+                spec = cr.get("spec") or {}
+                if uid not in self._seen_specs:
+                    self._on_add(uid, cr, spec)
+                elif spec != self._seen_specs[uid]:
+                    self._on_update(uid, cr, spec)
+        except Exception as exc:  # one CR must never kill the stream
+            log.error("watch event dispatch failed", job=uid,
+                      error=str(exc))
 
     # -- one reconcile tick ------------------------------------------------
 
     def run_once(self) -> None:
         """One list → diff → dispatch → status write-back pass."""
+        lister = getattr(self.store, "list_training_job_crs_with_rv", None)
+        if lister is not None:
+            items, rv = lister()
+            self._last_rv = rv or None
+        else:
+            items = self.store.list_training_job_crs()
         listed: dict[str, dict] = {}
-        for cr in self.store.list_training_job_crs():
+        for cr in items:
             meta = cr.get("metadata") or {}
             name = meta.get("name", "")
             if not name:
@@ -224,11 +314,13 @@ class TrainingJobSyncLoop:
             # surface the rejection where the user submitted it
             log.warn("TrainingJob rejected", job=uid, error=str(exc))
             self._rejected_specs[uid] = spec
-            self._patch_status(uid, cr, {
+            meta = cr.get("metadata") or {}
+            self._patch_status(uid, {
                 "phase": JobPhase.FAILED.value,
                 "reason": f"invalid spec: {exc}",
                 "replica_statuses": [],
-            })
+            }, name=meta.get("name", ""),
+                namespace=meta.get("namespace", "default"))
             return
         self._rejected_specs.pop(uid, None)
         self._seen_specs[uid] = spec
@@ -268,10 +360,16 @@ class TrainingJobSyncLoop:
 
     # -- status write-back -------------------------------------------------
 
-    def _write_back_statuses(self, listed: dict[str, dict]) -> None:
+    def _write_back_statuses(self,
+                             listed: Optional[dict[str, dict]] = None
+                             ) -> None:
+        """Record every managed job's phase into its CR status.  ``listed``
+        (the LIST path) restricts to CRs seen this tick; the watch path
+        passes None and patches by the registry's name/namespace — a CR
+        deleted under us patches as a 404 no-op until the DELETED event
+        or the next resync cleans the registry."""
         for uid, job in self._jobs.items():
-            cr = listed.get(uid)
-            if cr is None:
+            if listed is not None and uid not in listed:
                 continue
             updater = self.controller.get_updater(job)
             if updater is None:
@@ -281,17 +379,16 @@ class TrainingJobSyncLoop:
             if reason is not None:
                 status["reason"] = (f"spec update rejected: {reason}; "
                                     "running with last valid spec")
-            self._patch_status(uid, cr, status)
+            self._patch_status(uid, status, name=job.name,
+                               namespace=job.namespace)
 
-    def _patch_status(self, uid: str, cr: dict, status: dict) -> None:
+    def _patch_status(self, uid: str, status: dict, *, name: str,
+                      namespace: str) -> None:
         if self._written_status.get(uid) == status:
             return
-        meta = cr.get("metadata") or {}
-        name = meta.get("name", "")
-        ns = meta.get("namespace", "default")
         try:
             if self.store.patch_training_job_status(name, status,
-                                                    namespace=ns):
+                                                    namespace=namespace):
                 self._written_status[uid] = status
         except Exception as exc:
             # next tick retries; the in-memory phase machine is unaffected

@@ -1,0 +1,168 @@
+"""Sharding-plan and memory-fit evidence for large configs.
+
+BASELINE.json config 4 names "Llama-3 8B FSDP-style shard; autoscaler
+grows slice v5p-16→64".  No 8B-capable hardware exists in this
+environment, so the honest evidence is a *plan*: eval_shape the params
+and Adam state (no memory allocated), apply the model's real
+:func:`~edl_tpu.models.transformer.param_partition_specs` over candidate
+meshes, and prove arithmetically that
+
+* every large tensor is sharded (nothing big is accidentally replicated),
+* the per-device bytes of params + optimizer state fit the chip's HBM
+  with room for gradients and remat activations.
+
+``python -m edl_tpu.models.planning`` prints the table recorded in
+BASELINE.md; tests/test_llama8b_plan.py asserts the same numbers and
+additionally executes one real training step at the 8B layer shapes
+(scaled layer count) over a virtual 8-device mesh.
+
+Slice naming: v5p slice names count TensorCores; one v5p chip is two
+cores presented to JAX as one (megacore) device with 95 GB HBM — so
+v5p-16 = 8 devices, v5p-64 = 32.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from math import ceil, prod
+from typing import Any
+
+#: slice name → JAX device count (megacore: cores / 2)
+V5P_SLICES = {"v5p-16": 8, "v5p-32": 16, "v5p-64": 32}
+V5P_HBM_GB = 95.0
+
+
+@dataclass(frozen=True)
+class LeafPlan:
+    name: str
+    shape: tuple
+    bytes_total: int
+    shard_factor: int  # how many ways the leaf is split (1 = replicated)
+
+    @property
+    def bytes_per_device(self) -> int:
+        return ceil(self.bytes_total / self.shard_factor)
+
+
+@dataclass(frozen=True)
+class MemoryPlan:
+    """Per-device accounting of params + Adam(m, v) under the model's
+    partition specs on an fsdp×tp mesh."""
+
+    n_devices: int
+    tp: int
+    n_params: int
+    param_bytes_per_device: int
+    opt_bytes_per_device: int
+    hbm_gb: float
+    leaves: list = field(repr=False, default_factory=list)
+
+    @property
+    def fsdp(self) -> int:
+        return self.n_devices // self.tp
+
+    @property
+    def state_gb_per_device(self) -> float:
+        return (self.param_bytes_per_device + self.opt_bytes_per_device) / 1e9
+
+    @property
+    def fits(self) -> bool:
+        return self.state_gb_per_device < self.hbm_gb
+
+    def replicated_leaves(self) -> list:
+        return [l for l in self.leaves if l.shard_factor == 1]
+
+
+def _axis_sizes(n_devices: int, tp: int) -> dict:
+    assert n_devices % tp == 0, (n_devices, tp)
+    return {"dp": 1, "fsdp": n_devices // tp, "tp": tp, "sp": 1}
+
+
+def _leaf_plans(cfg, n_devices: int, tp: int) -> list:
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from edl_tpu.models import transformer as T
+
+    abstract = jax.eval_shape(lambda: T.init(jax.random.key(0), cfg))
+    specs = T.param_partition_specs(cfg)
+    sizes = _axis_sizes(n_devices, tp)
+    flat_leaves = jax.tree_util.tree_flatten_with_path(abstract)[0]
+    flat_specs = jax.tree_util.tree_flatten_with_path(
+        specs, is_leaf=lambda x: isinstance(x, P))[0]
+    assert [p for p, _ in flat_leaves] == [p for p, _ in flat_specs]
+    plans = []
+    for (path, leaf), (_, spec) in zip(flat_leaves, flat_specs):
+        factor = 1
+        for dim, part in enumerate(spec):
+            if part is None:
+                continue
+            axes = (part,) if isinstance(part, str) else part
+            dim_factor = prod(sizes[a] for a in axes)
+            # the spec only shards what divides evenly — the same rule a
+            # NamedSharding enforces at jit time; an indivisible dim here
+            # is a planning error we want loud, not padded over
+            if dim_factor > 1:
+                assert leaf.shape[dim] % dim_factor == 0, (
+                    path, leaf.shape, spec, dim_factor)
+            factor *= dim_factor
+        plans.append(LeafPlan(
+            name=jax.tree_util.keystr(path),
+            shape=tuple(leaf.shape),
+            bytes_total=leaf.size * leaf.dtype.itemsize,
+            shard_factor=factor,
+        ))
+    return plans
+
+
+def fsdp_memory_plan(cfg, n_devices: int, tp: int = 1,
+                     hbm_gb: float = V5P_HBM_GB) -> MemoryPlan:
+    """Plan params + Adam state over ``n_devices`` (fsdp = devices/tp).
+
+    Optimizer bytes assume Adam's two moments sharded exactly like their
+    parameter (optax trees mirror the param tree, so the same specs
+    apply) — 2× the param bytes, which is how the elastic runtime
+    actually shards them (multihost_worker._compiled_step)."""
+    leaves = _leaf_plans(cfg, n_devices, tp)
+    param_per_dev = sum(l.bytes_per_device for l in leaves)
+    return MemoryPlan(
+        n_devices=n_devices,
+        tp=tp,
+        n_params=sum(prod(l.shape) for l in leaves),
+        param_bytes_per_device=param_per_dev,
+        opt_bytes_per_device=2 * param_per_dev,
+        hbm_gb=hbm_gb,
+        leaves=leaves,
+    )
+
+
+def format_plan_table(cfg, rows: list[tuple[str, int, int]]) -> str:
+    """rows: (slice_name, n_devices, tp) → markdown table."""
+    out = ["| slice | devices | mesh (fsdp×tp) | params | state GB/dev "
+           "(params+Adam) | HBM | fits |",
+           "|---|---|---|---|---|---|---|"]
+    for name, n, tp in rows:
+        p = fsdp_memory_plan(cfg, n, tp)
+        out.append(
+            f"| {name} | {n} | {p.fsdp}×{p.tp} | {p.n_params / 1e9:.2f} B "
+            f"| {p.state_gb_per_device:.1f} | {p.hbm_gb:.0f} GB "
+            f"| {'yes' if p.fits else 'NO'} |")
+    return "\n".join(out)
+
+
+def main() -> int:
+    from edl_tpu.models.transformer import LLAMA3_8B
+
+    rows = [(name, n, 1) for name, n in V5P_SLICES.items()]
+    rows.append(("v5p-64 (2-D)", 32, 8))
+    print(format_plan_table(LLAMA3_8B, rows))
+    plan = fsdp_memory_plan(LLAMA3_8B, V5P_SLICES["v5p-16"])
+    repl = plan.replicated_leaves()
+    print(f"\nreplicated leaves on v5p-16: {len(repl)} "
+          f"(all small norms: max "
+          f"{max(l.bytes_total for l in repl) / 1e6:.3f} MB)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

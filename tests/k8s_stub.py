@@ -150,6 +150,25 @@ class StubState:
     #: next N replace_namespaced_job calls fail 409 (concurrent-writer
     #: simulation for the ConflictError mapping test)
     conflicts_to_inject: int = 0
+    #: monotonic collection resourceVersion for custom objects; every
+    #: mutation bumps it and appends to the event log the watch serves
+    custom_rv: int = 0
+    #: [(rv, "ADDED"|"MODIFIED"|"DELETED", object snapshot)]
+    custom_events: list = field(default_factory=list)
+    #: events at/below this rv have been compacted away — a watch asking
+    #: to resume below it gets 410 Gone (etcd compaction semantics)
+    custom_compacted_rv: int = 0
+
+    def record_custom_event(self, typ: str, obj: dict) -> None:
+        self.custom_rv += 1
+        obj.setdefault("metadata", {})["resourceVersion"] = str(self.custom_rv)
+        self.custom_events.append((self.custom_rv, typ, copy.deepcopy(obj)))
+
+    def compact_custom_events(self) -> None:
+        """Simulate etcd compaction: the watch window is gone; resuming
+        from any pre-compaction rv must 410 (the informer's re-list path)."""
+        self.custom_compacted_rv = self.custom_rv
+        self.custom_events.clear()
 
     # mutation helpers the real apiserver would do itself
     def put_job(self, namespace: str, name: str, parallelism: int,
@@ -278,6 +297,7 @@ class _CustomObjectsApi:
         obj.setdefault("metadata", {})
         obj["metadata"].setdefault("namespace", namespace)
         obj["metadata"]["generation"] = 1
+        self._s.record_custom_event("ADDED", obj)
         self._s.custom_objects[key] = obj
         return copy.deepcopy(obj)
 
@@ -286,13 +306,15 @@ class _CustomObjectsApi:
         items = [copy.deepcopy(o)
                  for (g, ns, pl, _), o in sorted(self._s.custom_objects.items())
                  if (g, ns, pl) == (group, namespace, plural)]
-        return {"items": items}
+        return {"items": items,
+                "metadata": {"resourceVersion": str(self._s.custom_rv)}}
 
     def list_cluster_custom_object(self, group, version, plural):
         items = [copy.deepcopy(o)
                  for (g, _, pl, _), o in sorted(self._s.custom_objects.items())
                  if (g, pl) == (group, plural)]
-        return {"items": items}
+        return {"items": items,
+                "metadata": {"resourceVersion": str(self._s.custom_rv)}}
 
     def get_namespaced_custom_object(self, group, version, namespace,
                                      plural, name):
@@ -316,6 +338,7 @@ class _CustomObjectsApi:
             gen += 1
         obj["metadata"]["generation"] = gen
         obj.setdefault("status", copy.deepcopy(old.get("status") or {}))
+        self._s.record_custom_event("MODIFIED", obj)
         self._s.custom_objects[key] = obj
         return copy.deepcopy(obj)
 
@@ -328,6 +351,7 @@ class _CustomObjectsApi:
         obj["status"] = self._admit(group, plural,
                                     {"status": (body or {}).get("status")
                                      or {}}).get("status", {})
+        self._s.record_custom_event("MODIFIED", obj)
         return copy.deepcopy(obj)
 
     def delete_namespaced_custom_object(self, group, version, namespace,
@@ -335,6 +359,7 @@ class _CustomObjectsApi:
         key = self._key(group, namespace, plural, name)
         if key not in self._s.custom_objects:
             raise ApiException(404, f"{plural} {name}")
+        self._s.record_custom_event("DELETED", self._s.custom_objects[key])
         del self._s.custom_objects[key]
 
 
@@ -352,6 +377,36 @@ class _AppsV1Api:
         del self._s.replicasets[(namespace, name)]
 
 
+class _Watch:
+    """Role of ``kubernetes.watch.Watch`` for the custom-object
+    collection: replays the event log past ``resource_version``, then
+    tails it until ``timeout_seconds`` (the server-side watch timeout the
+    real apiserver enforces).  A resume rv at/below the compaction point
+    raises 410 Gone, as etcd compaction does."""
+
+    def __init__(self, state: StubState):
+        self._s = state
+        self._stopped = False
+
+    def stop(self) -> None:
+        self._stopped = True
+
+    def stream(self, func, *args, resource_version="0",
+               timeout_seconds=30, **kwargs):
+        import time
+
+        rv = int(resource_version or 0)
+        if rv < self._s.custom_compacted_rv:
+            raise ApiException(410, "too old resource version (compacted)")
+        deadline = time.monotonic() + float(timeout_seconds)
+        while not self._stopped and time.monotonic() < deadline:
+            for erv, typ, obj in list(self._s.custom_events):
+                if erv > rv:
+                    rv = erv
+                    yield {"type": typ, "object": copy.deepcopy(obj)}
+            time.sleep(0.01)
+
+
 def build_module(state: StubState) -> types.ModuleType:
     """A module object that satisfies every ``kubernetes.*`` attribute
     K8sCluster touches."""
@@ -359,6 +414,7 @@ def build_module(state: StubState) -> types.ModuleType:
     client = types.ModuleType("kubernetes.client")
     config = types.ModuleType("kubernetes.config")
     exceptions = types.ModuleType("kubernetes.client.exceptions")
+    watch = types.ModuleType("kubernetes.watch")
 
     exceptions.ApiException = ApiException
     client.exceptions = exceptions
@@ -368,6 +424,8 @@ def build_module(state: StubState) -> types.ModuleType:
     client.CustomObjectsApi = lambda: _CustomObjectsApi(state)
     config.load_kube_config = lambda *_a, **_k: None
     config.load_incluster_config = lambda: None
+    watch.Watch = lambda: _Watch(state)
     kubernetes.client = client
     kubernetes.config = config
+    kubernetes.watch = watch
     return kubernetes

