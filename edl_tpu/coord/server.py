@@ -56,13 +56,19 @@ def spawn_server(
     state_file: str | None = None,
     crash_on_persist: str | None = None,
     health_port: int | None = None,
+    die_with_parent: bool = True,
 ) -> ServerHandle:
     """Start edl-coord-server (port 0 = ephemeral) and wait until it
     reports its listening port.  ``state_file`` enables write-through
     durability: restart the server with the same file and it resumes the
     job's queue accounting, KV and epoch (the etcd-sidecar role).
     ``crash_on_persist`` ("N:tmp" | "N:acked") is test-only fault
-    injection for the power-loss durability tests."""
+    injection for the power-loss durability tests.  ``die_with_parent``
+    (default on) SIGKILLs the server when the spawning process dies —
+    spawn_server callers are tests/benches/demos, and an interrupted
+    harness must not leave a coordinator squatting on the state file
+    (the deployed coordinator path, ``edl-tpu coordinator`` → execv,
+    never goes through here)."""
     if not ensure_built():
         raise RuntimeError("cannot build the native coordination server "
                            "(g++ unavailable?)")
@@ -81,10 +87,27 @@ def spawn_server(
     health_enabled = health_port is not None and health_port >= 0
     if health_enabled:
         cmd += ["--health-port", str(health_port)]  # 0 = OS-assigned
+    preexec = None
+    if die_with_parent:
+        # Resolve libc in the PARENT: the preexec closure runs between
+        # fork and exec, where import machinery / symbol resolution can
+        # deadlock under a threaded parent — post-fork it may only call
+        # the already-bound C function.
+        import ctypes
+        import signal as _signal
+
+        try:
+            _libc = ctypes.CDLL("libc.so.6", use_errno=True)
+
+            def preexec(_libc=_libc, _sig=_signal.SIGKILL):
+                _libc.prctl(1, _sig)  # PR_SET_PDEATHSIG
+        except OSError:  # pragma: no cover - non-glibc platform
+            preexec = None
     proc = subprocess.Popen(
         cmd,
         stdout=subprocess.PIPE,
         stderr=subprocess.STDOUT,
+        preexec_fn=preexec,
     )
 
     def read_banner(what: str) -> bytes:

@@ -49,6 +49,10 @@ def main() -> int:
         XLA_FLAGS="--xla_force_host_platform_device_count=1",
         EDL_MH_EXAMPLES=str(64 * 1024), EDL_MH_SHARDS="256",
         EDL_MH_BATCH="32", EDL_MH_STEP_SLEEP="0.04",
+        # CPU demo: disarm the axon TPU bootstrap hook (~5 s of jax
+        # import per interpreter start) and reap the tree if the demo dies
+        PALLAS_AXON_POOL_IPS="",
+        EDL_MH_DIE_WITH_PARENT="1",
     )
 
     print(f"== durable coordinator (state write-through: {state})")
