@@ -5,16 +5,32 @@ example/train_ft.py): discover peers, join the job, lease data tasks, run
 training steps, and survive membership changes.  The TPU-native version
 replaces pserver RPC with a jax device mesh: a membership change is a mesh
 resize + reshard, not a pserver reconnect.
+
+Exports resolve lazily (PEP 562): ``ElasticTrainer``/``ElasticCheckpointer``
+pull in jax + orbax (~4.5 s on a small host, measured), and the worker
+supervisor process (``python -m edl_tpu.runtime.multihost_worker``) must
+stay device-free and boot fast — its spawn-to-membership time is part of
+every join/reform latency, so the package import must not tax it.
 """
 
-from edl_tpu.runtime.elastic import ElasticTrainer, TrainState
-from edl_tpu.runtime.data import ShardRegistry, TaskLeaseBatches
-from edl_tpu.runtime.checkpoint import ElasticCheckpointer
+_EXPORTS = {
+    "ElasticTrainer": ("edl_tpu.runtime.elastic", "ElasticTrainer"),
+    "TrainState": ("edl_tpu.runtime.elastic", "TrainState"),
+    "ShardRegistry": ("edl_tpu.runtime.data", "ShardRegistry"),
+    "TaskLeaseBatches": ("edl_tpu.runtime.data", "TaskLeaseBatches"),
+    "ElasticCheckpointer": ("edl_tpu.runtime.checkpoint",
+                            "ElasticCheckpointer"),
+}
 
-__all__ = [
-    "ElasticTrainer",
-    "TrainState",
-    "ShardRegistry",
-    "TaskLeaseBatches",
-    "ElasticCheckpointer",
-]
+__all__ = list(_EXPORTS)
+
+
+def __getattr__(name: str):
+    try:
+        module, attr = _EXPORTS[name]
+    except KeyError:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}") from None
+    import importlib
+
+    return getattr(importlib.import_module(module), attr)

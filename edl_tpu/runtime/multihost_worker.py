@@ -544,6 +544,11 @@ def main(argv=None) -> int:
         # import is heavy and only the collective path touches it
         preload=(("jax", "optax", "orbax.checkpoint") if fsdp
                  else ("jax", "optax")),
+        # warm pre-spawn trades idle CPU for reform latency; on a 1-core
+        # host the concurrent preload imports CONTEND with the critical
+        # path instead (measured: join leg 33 s warm vs 22 s cold), so
+        # the knob exists for benches/tests on starved machines
+        warm_spawn=os.environ.get("EDL_MH_WARM_SPAWN", "1") != "0",
     )
     # The world children report their final step through the supervisor
     # (no checkpoint load here — the supervisor process stays device-free);

@@ -388,3 +388,37 @@ time.sleep(300)
     finally:
         if harness.poll() is None:
             harness.kill()
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("warm", ["1", "0"])
+def test_warm_respawn_knob_observed_in_supervisor_log(coord_server, tmp_path,
+                                                      warm):
+    """The warm pre-spawn actually serves reforms (and its kill switch
+    works): the supervisor's world-start trace event records warm=True
+    when the plan was piped to a pre-spawned child, warm=False under
+    EDL_MH_WARM_SPAWN=0 — so a silent regression to cold spawns (which
+    only degrades latency, never correctness) fails here (review r4)."""
+    env = _worker_env(8192, 32)
+    env.update(EDL_MH_STEP_SLEEP="0.05", EDL_MH_WARM_SPAWN=warm,
+               EDL_MH_TRACE=str(tmp_path / "traces"))
+    procs = {"w0": _spawn_worker(coord_server.port, "w0", tmp_path, 1, env,
+                                 tmp_path / "w0.log")}
+    # world 1 lives well past the respawn delay before w1's join reforms it
+    _wait_for_line(tmp_path / "w0.log", "step 60 ", timeout_s=180)
+    procs["w1"] = _spawn_worker(coord_server.port, "w1", tmp_path, 1, env,
+                                tmp_path / "w1.log")
+    rcs = _wait_all(procs, timeout_s=300)
+    assert rcs == {"w0": 0, "w1": 0}
+    import json as _json
+
+    trace = _json.loads((tmp_path / "traces" / "trace-w0.json").read_text())
+    starts = [e for e in trace.get("traceEvents", trace)
+              if e.get("name") == "world_start"]
+    assert len(starts) >= 2, starts
+    by_epoch = {e["args"]["epoch"]: e["args"]["warm"] for e in starts}
+    if warm == "1":
+        assert by_epoch[2] is True, by_epoch
+    else:
+        assert all(v is False for v in by_epoch.values()), by_epoch
+    _assert_exactly_once(coord_server.client(), 32)

@@ -467,3 +467,17 @@ def test_mid_world_generation_ordering_and_prune(tmp_path):
     prune_generations(coord, str(tmp_path), upto_gen=8, keep=3)
     assert coord.kv_get("ckpt-mid/3/60") is None
     assert not (tmp_path / "mid-3-60.npz").exists()
+
+
+def test_should_respawn_warm_predicate():
+    """Warm-respawn pacing (review r4): after warm_delay on the warm path;
+    plus the cold-bootstrap allowance when the live child was a cold spawn
+    (its own jax import is still in flight at warm_delay)."""
+    from edl_tpu.runtime.multihost import _should_respawn_warm
+
+    assert not _should_respawn_warm(1.9, was_warm=True, warm_delay_s=2.0)
+    assert _should_respawn_warm(2.0, was_warm=True, warm_delay_s=2.0)
+    # cold child: the 2 s mark is mid-import — hold off
+    assert not _should_respawn_warm(2.0, was_warm=False, warm_delay_s=2.0)
+    assert not _should_respawn_warm(9.9, was_warm=False, warm_delay_s=2.0)
+    assert _should_respawn_warm(10.0, was_warm=False, warm_delay_s=2.0)
