@@ -79,10 +79,16 @@ def init(key: jax.Array, cfg: ResNetConfig) -> dict:
 
 
 def _conv(x, w, stride=1):
+    # No preferred_element_type=f32 + downcast here: the MXU accumulates
+    # bf16 convs in f32 internally regardless, and materializing the f32
+    # output breaks the conv TRANSPOSE rule under value_and_grad (the
+    # cotangent arrives f32 against a bf16 operand — TypeError at lower
+    # time; hit the first time the bf16 RESNET50 config was actually
+    # trained rather than the f32 TINY).  GroupNorm upcasts to f32 for
+    # its statistics immediately after every conv anyway.
     return jax.lax.conv_general_dilated(
         x, w.astype(x.dtype), window_strides=(stride, stride),
-        padding="SAME", dimension_numbers=("NHWC", "HWIO", "NHWC"),
-        preferred_element_type=jnp.float32).astype(x.dtype)
+        padding="SAME", dimension_numbers=("NHWC", "HWIO", "NHWC"))
 
 
 def _group_norm(x, p, groups, eps=1e-5):

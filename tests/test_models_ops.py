@@ -467,3 +467,32 @@ def test_dryrun_multichip_has_no_remat_warnings():
     assert out.returncode == 0, out.stderr[-2000:]
     assert "rematerialization" in out.stderr, (
         "warning channel dead: gather on a sharded table should warn")
+
+
+def test_resnet_bf16_trains_a_step():
+    """The bf16 compute path (what RESNET50 uses on TPU) must be
+    differentiable end-to-end — the f32-accumulate + downcast conv
+    variant broke the conv transpose rule, caught only when the bf16
+    config first reached a real train step (bench model_zoo leg)."""
+    import dataclasses
+
+    from edl_tpu.models import resnet
+
+    cfg = dataclasses.replace(resnet.TINY, dtype=jnp.bfloat16)
+    params = resnet.init(jax.random.key(0), cfg)
+    opt = optax.adamw(1e-3)
+    opt_state = opt.init(params)
+    images = jax.random.normal(jax.random.key(1), (2, 32, 32, 3)
+                               ).astype(cfg.dtype)
+    labels = jnp.array([1, 3], jnp.int32)
+
+    @jax.jit
+    def step(params, opt_state):
+        loss, grads = jax.value_and_grad(resnet.loss_fn)(
+            params, (images, labels), cfg=cfg)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    params, opt_state, l1 = step(params, opt_state)
+    _, _, l2 = step(params, opt_state)
+    assert jnp.isfinite(l1) and jnp.isfinite(l2)
