@@ -961,11 +961,13 @@ def reform_latency_leg() -> dict:
 
 def tpu_world_cycle_leg() -> dict:
     """Two sequential supervised worlds on the real TPU: a world-of-1
-    trains on the chip, a membership transient (ghost join+leave) forces a
-    reform, and the SECOND child process must re-acquire the TPU (libtpu
-    lock) after its sibling's exit — the one mechanism no CPU test can
-    see.  Done = the job finishes with exactly-once accounting across the
-    two worlds."""
+    trains THE REAL ARCHITECTURE (the GQA decoder family, --model
+    transformer) on the chip, a membership transient (ghost join+leave)
+    forces a reform, and the SECOND child process must re-acquire the TPU
+    (libtpu lock) after its sibling's exit — the one mechanism no CPU
+    test can see.  Done = the job finishes with exactly-once accounting
+    across the two worlds, with the second world resuming the first's
+    trained generation (loss continuity on the chip)."""
     import tempfile
 
     from edl_tpu.coord.client import CoordClient
@@ -996,11 +998,13 @@ def tpu_world_cycle_leg() -> dict:
         env.update(EDL_MH_EXAMPLES=str(16 * 1024),
                    EDL_MH_SHARDS=str(n_shards),
                    EDL_MH_BATCH="64", EDL_MH_STEP_SLEEP="0",
+                   EDL_MH_SEQ="128",
                    EDL_MH_DIE_WITH_PARENT="1")
         proc = subprocess.Popen(
             [sys.executable, "-m", "edl_tpu.runtime.multihost_worker",
              "--coord", f"127.0.0.1:{port}", "--name", "w0",
              "--ckpt-dir", tmp, "--min-members", "1",
+             "--model", "transformer", "--model-config", "tiny",
              "--settle-s", "0.5", "--heartbeat-timeout-s", "5"],
             stdout=open(log, "w"), stderr=subprocess.STDOUT, env=env)
 
@@ -1024,11 +1028,23 @@ def tpu_world_cycle_leg() -> dict:
         text = open(log).read()
         out["worlds"] = _count_entering(text)
         out["rc"] = rc
+        out["model"] = "transformer-tiny (GQA decoder)"
+        # restore continuity: the FIRST post-transient world entered at
+        # the previous world's published step, not 0 (the generation
+        # protocol on TPU).  Index by worlds_before — the same anchor the
+        # wait condition used — not a hardcoded [1], so a startup
+        # transient can neither mask a lost generation nor fail a
+        # correct resume.
+        entries = [l for l in text.splitlines() if "entering world" in l]
+        if len(entries) > worlds_before:
+            out["world2_resumed_step"] = int(
+                entries[worlds_before].rsplit("step=", 1)[1])
         stats = srv.client().stats()
         out["exactly_once"] = (stats.done == n_shards and stats.todo == 0
                                and stats.dropped == 0)
         out["tpu_world_cycle"] = (
             "ok" if rc == 0 and out["worlds"] >= 2 and out["exactly_once"]
+            and out.get("world2_resumed_step", 0) > 0
             else "FAILED")
         return out
     finally:
