@@ -1129,13 +1129,19 @@ def main() -> None:
     reform = _run_leg("reform", timeout_s=560)
 
     # Reference baseline: peak utilization in the published elastic trace is
-    # 88.40 % with 0 pending (BASELINE.md; doc/boss_tutorial.md:300-301).
+    # 88.40 % with 0 pending (BASELINE.md; doc/boss_tutorial.md:293-294).
     value = sched["chip_utilization_pct"]
     result = {
         "metric": "cluster_chip_utilization_pct_8_elastic_jobs",
         "value": value,
         "unit": "%",
         "vs_baseline": round(value / 88.40, 4),
+        # the honest label, everywhere the ratio travels (r3 weak #4):
+        # numerator = our planner packing a SIMULATED 256-chip cluster;
+        # denominator = the reference's published LIVE demo trace peak
+        # (88.40 %, doc/boss_tutorial.md:293-294) — the only number it
+        # ever published
+        "vs_baseline_note": "simulated packing vs reference live demo",
         "pending_jobs": sched["pending_jobs"],
         "mean_admission_seconds": sched["mean_admission_seconds"],
         "tokens_per_second": tput.get("tokens_per_second"),

@@ -16,7 +16,11 @@ processes (the same code path a TPU pod would run):
 5. both survivors drain the queue and exit 0 with exactly-once shard
    accounting.
 
-Usage:  python examples/multihost_ft_demo.py
+Usage:  python examples/multihost_ft_demo.py [--model transformer]
+
+``--model transformer`` runs the real GQA decoder family (the bench's
+architecture) through the same fault story, with mid-world checkpoints
+bounding the crash loss to 20 steps.
 """
 
 import os
@@ -41,19 +45,31 @@ def wait_for(path: str, needle: str, timeout_s: float) -> None:
 
 
 def main() -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--model", choices=("mlp", "transformer"),
+                    default="mlp",
+                    help="transformer = the GQA decoder family (the "
+                         "bench's architecture) through the fault story")
+    model = ap.parse_args().model
     work = tempfile.mkdtemp(prefix="edl-mh-demo-")
     state = os.path.join(work, "coord.state")
+    n_shards = 256 if model == "mlp" else 64
     env = dict(os.environ)
     env.update(
         JAX_PLATFORMS="cpu",
         XLA_FLAGS="--xla_force_host_platform_device_count=1",
-        EDL_MH_EXAMPLES=str(64 * 1024), EDL_MH_SHARDS="256",
+        EDL_MH_EXAMPLES=str(64 * 1024), EDL_MH_SHARDS=str(n_shards),
         EDL_MH_BATCH="32", EDL_MH_STEP_SLEEP="0.04",
         # CPU demo: disarm the axon TPU bootstrap hook (~5 s of jax
         # import per interpreter start) and reap the tree if the demo dies
         PALLAS_AXON_POOL_IPS="",
         EDL_MH_DIE_WITH_PARENT="1",
     )
+    if model == "transformer":
+        env.update(EDL_MH_SEQ="32", EDL_MH_BATCH="16",
+                   EDL_MH_CKPT_EVERY="20", EDL_MH_EXAMPLES=str(16 * 1024))
 
     print(f"== durable coordinator (state write-through: {state})")
     srv = spawn_server(member_ttl_ms=3000, task_timeout_ms=4000,
@@ -68,6 +84,7 @@ def main() -> int:
             [sys.executable, "-m", "edl_tpu.runtime.multihost_worker",
              "--coord", f"127.0.0.1:{port}", "--name", n,
              "--ckpt-dir", work, "--min-members", "3",
+             "--model", model,
              "--settle-s", "0.3", "--heartbeat-timeout-s", "5"],
             stdout=open(logs[n], "w"), stderr=subprocess.STDOUT, env=env)
     wait_for(logs["w0"], "step 20 ", 180)
@@ -94,7 +111,7 @@ def main() -> int:
     print(f"== done: w0 rc={rc0}, w2 rc={rc2}")
     print(f"   queue: done={stats.done} todo={stats.todo} "
           f"leased={stats.leased} dropped={stats.dropped}")
-    ok = (rc0 == 0 and rc2 == 0 and stats.done == 256
+    ok = (rc0 == 0 and rc2 == 0 and stats.done == n_shards
           and stats.todo == 0 and stats.dropped == 0)
     print("   exactly-once accounting:", "OK" if ok else "VIOLATED")
     for n in ("w0", "w2"):
