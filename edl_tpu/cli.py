@@ -108,6 +108,18 @@ def cmd_coordinator(args) -> int:
     argv = ["--port", str(args.port)]
     if args.state_file:
         argv += ["--state-file", args.state_file]
+    if args.health_port is None:
+        # env fallback resolved HERE, not at parser build: a malformed
+        # EDL_HEALTH_PORT must only affect this verb, and an explicit
+        # --health-port -1 must win over the env (coord_server.main would
+        # otherwise re-read it)
+        try:
+            health_port = int(os.environ.get("EDL_HEALTH_PORT", "-1"))
+        except ValueError:
+            health_port = -1
+    else:
+        health_port = args.health_port
+    argv += ["--health-port", str(health_port)]
     return coord_server.main(argv)
 
 
@@ -306,6 +318,10 @@ def build_parser() -> argparse.ArgumentParser:
                    default=os.environ.get("EDL_COORD_STATE_FILE", ""),
                    help="write-through durability file (restart with the "
                         "same path to resume queue/KV/epoch state)")
+    c.add_argument("--health-port", type=int, default=None,
+                   help="HTTP GET /healthz port; default from "
+                        "EDL_HEALTH_PORT (compiled manifests set 8080), "
+                        "-1 disables")
     c.set_defaults(fn=cmd_coordinator)
 
     c = sub.add_parser("launch", help="pod-role entrypoint")

@@ -161,12 +161,19 @@ def main(argv: list[str] | None = None) -> int:
                     default=os.environ.get("EDL_COORD_STATE_FILE", ""),
                     help="write-through durability file; restart with the "
                          "same path to resume the job's coordination state")
-    ap.add_argument("--health-port", type=int,
-                    default=int(os.environ.get("EDL_HEALTH_PORT", "-1")),
+    ap.add_argument("--health-port", type=int, default=None,
                     help="HTTP GET /healthz port (the probe target the "
                          "compiled coordinator manifest points at); "
-                         "-1 disables, 0 = OS-assigned")
+                         "default from EDL_HEALTH_PORT, -1 disables, "
+                         "0 = OS-assigned")
     args = ap.parse_args(argv)
+    if args.health_port is None:
+        # resolved after parse so a malformed env value degrades to
+        # disabled instead of a parser-build traceback
+        try:
+            args.health_port = int(os.environ.get("EDL_HEALTH_PORT", "-1"))
+        except ValueError:
+            args.health_port = -1
     if not ensure_built():
         print("error: cannot build native coord server", file=sys.stderr)
         return 1
