@@ -108,18 +108,10 @@ def cmd_coordinator(args) -> int:
     argv = ["--port", str(args.port)]
     if args.state_file:
         argv += ["--state-file", args.state_file]
-    if args.health_port is None:
-        # env fallback resolved HERE, not at parser build: a malformed
-        # EDL_HEALTH_PORT must only affect this verb, and an explicit
-        # --health-port -1 must win over the env (coord_server.main would
-        # otherwise re-read it)
-        try:
-            health_port = int(os.environ.get("EDL_HEALTH_PORT", "-1"))
-        except ValueError:
-            health_port = -1
-    else:
-        health_port = args.health_port
-    argv += ["--health-port", str(health_port)]
+    if args.health_port is not None:
+        # explicit flag wins over the env; when absent, coord_server.main
+        # owns the EDL_HEALTH_PORT fallback (one policy, one place)
+        argv += ["--health-port", str(args.health_port)]
     return coord_server.main(argv)
 
 

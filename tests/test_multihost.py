@@ -422,3 +422,27 @@ def test_warm_respawn_knob_observed_in_supervisor_log(coord_server, tmp_path,
     else:
         assert all(v is False for v in by_epoch.values()), by_epoch
     _assert_exactly_once(coord_server.client(), 32)
+
+
+@pytest.mark.slow
+def test_multi_device_hosts_form_one_mesh(coord_server, tmp_path):
+    """Multi-chip hosts: each worker PROCESS holds several devices (the
+    TPU pod reality — one process per host, 4-8 chips each), so the
+    world's mesh is processes × local devices and the per-process flag
+    rows must tile evenly over P('dp') (train_world sizes them by
+    jax.local_device_count).  Two 2-device processes train to completion
+    with exactly-once accounting — the path single-device tests miss."""
+    env = _worker_env(SMALL_EXAMPLES, SMALL_SHARDS)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    procs = {
+        n: _spawn_worker(coord_server.port, n, tmp_path, 2, env,
+                         tmp_path / f"{n}.log")
+        for n in ("w0", "w1")
+    }
+    rcs = _wait_all(procs, timeout_s=240)
+    assert rcs == {"w0": 0, "w1": 0}
+    for n in procs:
+        text = (tmp_path / f"{n}.log").read_text()
+        assert "done at step" in text
+        assert "world=2" in text  # 2 processes (4 devices total)
+    _assert_exactly_once(coord_server.client(), SMALL_SHARDS)
