@@ -551,3 +551,38 @@ def test_watch_thread_end_to_end(control_plane):
         sync.stop()
     # event-driven: far fewer LISTs than loop turns (>= ~40 turns ran)
     assert lists["n"] <= 3, lists["n"]
+
+
+def test_watch_mode_under_cr_churn(control_plane):
+    """Event-driven correctness at modest scale: 20 CRs created, half
+    edited, a third deleted — all through watch events with a single
+    anchoring LIST — must converge the registry to exactly the surviving
+    set with the edited specs, no event lost or double-applied."""
+    cluster, controller, sync, state = control_plane
+    sync.watch = True
+    sync.run_once()  # anchor
+
+    for i in range(20):
+        cluster.create_training_job_cr(cr_manifest(f"churn-{i:02d}",
+                                                   lo=1, hi=2))
+    sync._watch_window(0.5)
+    assert len(controller.jobs()) == 20
+
+    for i in range(0, 20, 2):  # edit every even job's max
+        cluster._custom.replace_namespaced_custom_object(
+            "edl.tpu", "v1", "default", "trainingjobs", f"churn-{i:02d}",
+            cr_manifest(f"churn-{i:02d}", lo=1, hi=6))
+    for i in range(0, 20, 3):  # delete every third
+        cluster.delete_training_job_cr(f"churn-{i:02d}")
+    sync._watch_window(0.5)
+
+    alive = {j.name: j for j in controller.jobs()}
+    expected = {f"churn-{i:02d}" for i in range(20) if i % 3 != 0}
+    assert set(alive) == expected
+    for name, job in alive.items():
+        i = int(name.split("-")[1])
+        assert job.spec.trainer.max_instance == (6 if i % 2 == 0 else 2), name
+    # torn-down groups are gone; survivors' groups exist
+    for i in range(20):
+        present = ("default", f"churn-{i:02d}-trainer") in state.jobs
+        assert present == (i % 3 != 0), i
