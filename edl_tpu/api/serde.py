@@ -88,6 +88,7 @@ def job_from_dict(doc: dict[str, Any]) -> TrainingJob:
         topology=(TpuTopology.parse(str(t["topology"]))
                   if t.get("topology") else None),
         allow_multi_domain=bool(t.get("allow_multi_domain", False)),
+        env={k: str(v) for k, v in (t.get("env") or {}).items()},
     )
     p = _norm(spec.get("pserver") or {})
     pserver = PserverSpec(
@@ -148,6 +149,7 @@ def job_to_dict(job: TrainingJob) -> dict[str, Any]:
                 "min_instance": t.min_instance,
                 "max_instance": t.max_instance,
                 "allow_multi_domain": t.allow_multi_domain,
+                "env": {k: str(v) for k, v in sorted(t.env.items())},
                 "resources": res(t.resources),
             },
             "pserver": {

@@ -128,6 +128,10 @@ class TrainerSpec:
     #: capacity, because an unwitting DCN hop inside a TP/FSDP mesh is a
     #: silent order-of-magnitude bandwidth cliff.
     allow_multi_domain: bool = False
+    #: User environment for trainer pods, merged AFTER the EDL_* contract
+    #: so user values win — the supported way to tune runtime knobs like
+    #: EDL_MH_CKPT_EVERY per job (k8s env-list semantics: last wins).
+    env: dict = field(default_factory=dict)
 
 
 @dataclass

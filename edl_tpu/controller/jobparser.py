@@ -66,6 +66,15 @@ def pod_env(job: TrainingJob, role: str) -> dict[str, str]:
         "EDL_COORD_PORT": str(spec.port or COORDINATOR_PORT),
         "EDL_TPU_CHIPS_PER_TRAINER": str(job.tpu_chips_per_trainer()),
     }
+    if spec.fault_tolerant and role == "trainer":
+        # Mid-world checkpoint cadence ON by default for deployed FT
+        # trainers: the reference's pserver param residency meant a
+        # trainer crash never lost global state; the TPU-native
+        # equivalent (publish_mid_state) must be armed out of the box or
+        # a crash loses everything back to the last membership change.
+        # 200 steps ≈ tens of seconds of work at flagship step times;
+        # spec.trainer.env (merged below) overrides per job.
+        env["EDL_MH_CKPT_EVERY"] = "200"
     if spec.trainer.topology is not None:
         env["EDL_TPU_TOPOLOGY"] = str(spec.trainer.topology)
     if spec.master.etcd_endpoint:
@@ -77,6 +86,11 @@ def pod_env(job: TrainingJob, role: str) -> dict[str, str]:
         env["EDL_COORD_ENDPOINT"] = (
             f"{job.name}-coordinator.{job.namespace}.svc"
             f":{spec.port or COORDINATOR_PORT}")
+    if role == "trainer":
+        # user env merged LAST — after every generated key, including the
+        # topology/endpoint defaults above — so the documented "user
+        # values win" contract holds for all of them
+        env.update({k: str(v) for k, v in spec.trainer.env.items()})
     return env
 
 

@@ -322,3 +322,57 @@ def test_controller_deployment_manifest_probes():
     assert {"containerPort": port, "name": "health"} in container["ports"]
     assert container["livenessProbe"]["httpGet"]["path"] == "/healthz"
     assert container["readinessProbe"]["httpGet"]["path"] == "/healthz"
+
+
+def test_ft_trainer_env_arms_mid_world_checkpoints():
+    """Deployed FT trainers get a default mid-world checkpoint cadence —
+    the reference's pserver residency meant a crash never lost global
+    state; without this env a deployed crash would lose everything back
+    to the last membership change (generation protocol, doc/design.md)."""
+    from edl_tpu.api.validation import set_defaults_and_validate
+
+    job = set_defaults_and_validate(mk_job())
+    env = pod_env(job, "trainer")
+    assert int(env["EDL_MH_CKPT_EVERY"]) > 0
+    # non-FT jobs and non-trainer roles are not armed
+    assert "EDL_MH_CKPT_EVERY" not in pod_env(job, "coordinator")
+    nonft = mk_job(ft=False, lo=2, hi=2)
+    set_defaults_and_validate(nonft)
+    assert "EDL_MH_CKPT_EVERY" not in pod_env(nonft, "trainer")
+
+
+def test_trainer_env_passthrough_overrides_defaults():
+    """spec.trainer.env is the supported per-job tuning surface: values
+    land in the compiled trainer manifest AFTER the EDL_* contract, so a
+    user can override defaults like EDL_MH_CKPT_EVERY (or disable with
+    0) without hand-editing manifests."""
+    from edl_tpu.api.validation import set_defaults_and_validate
+
+    job = mk_job()
+    job.spec.trainer.env = {"EDL_MH_CKPT_EVERY": "0", "MY_KNOB": "x"}
+    set_defaults_and_validate(job)
+    env = pod_env(job, "trainer")
+    assert env["EDL_MH_CKPT_EVERY"] == "0"  # user value beat the default
+    assert env["MY_KNOB"] == "x"
+    # the contract itself is not clobbered
+    assert env["EDL_JOB_NAME"] == job.name
+    # round-trips through the CR shape (kubectl path)
+    from edl_tpu.api.serde import job_from_dict, job_to_dict
+
+    again = job_from_dict(job_to_dict(job))
+    assert again.spec.trainer.env == job.spec.trainer.env
+
+
+def test_trainer_env_overrides_every_generated_key():
+    """The 'user values win' contract covers ALL generated keys —
+    including the ones assigned after the defaults (coordinator endpoint,
+    topology), which an earlier merge point silently clobbered."""
+    from edl_tpu.api.validation import set_defaults_and_validate
+
+    job = mk_job()
+    job.spec.trainer.env = {"EDL_COORD_ENDPOINT": "my-etcd.infra.svc:2379",
+                            "EDL_TPU_TOPOLOGY": "4x4"}
+    set_defaults_and_validate(job)
+    env = pod_env(job, "trainer")
+    assert env["EDL_COORD_ENDPOINT"] == "my-etcd.infra.svc:2379"
+    assert env["EDL_TPU_TOPOLOGY"] == "4x4"
