@@ -21,6 +21,8 @@ from __future__ import annotations
 
 from typing import Any
 
+from edl_tpu.observability.logging import get_logger
+
 from edl_tpu.api.types import (
     MasterSpec,
     PserverSpec,
@@ -31,6 +33,8 @@ from edl_tpu.api.types import (
     TrainingJobSpec,
     TrainingJobStatus,
 )
+
+log = get_logger("serde")
 
 API_VERSION = "edl.tpu/v1"
 KIND = "TrainingJob"
@@ -51,6 +55,18 @@ KEBAB_ALIASES = {
     "allow-multi-domain": "allow_multi_domain",
 }
 
+#: every snake_case field any manifest section understands; a kebab key whose
+#: snake twin is in this set but which is NOT a declared alias would be
+#: silently dropped — _norm warns loudly instead of degrading the job.
+_KNOWN_SNAKE_FIELDS = frozenset({
+    "min_instance", "max_instance", "allow_multi_domain",
+    "ports_num", "ports_num_for_sparse", "fault_tolerant", "host_network",
+    "node_selector", "etcd_endpoint", "coord_endpoint",
+    "entrypoint", "workspace", "resources", "topology", "env",
+    "image", "port", "passes", "trainer", "pserver", "master",
+    "requests", "limits", "name", "namespace", "labels",
+})
+
 
 def _norm(d: dict[str, Any]) -> dict[str, Any]:
     # Snake_case wins when both spellings are present (the CRD schema,
@@ -59,6 +75,13 @@ def _norm(d: dict[str, Any]) -> dict[str, Any]:
     out: dict[str, Any] = {}
     for k, v in d.items():
         nk = KEBAB_ALIASES.get(k, k)
+        if nk == k and "-" in k and k.replace("-", "_") in _KNOWN_SNAKE_FIELDS:
+            # e.g. 'etcd-endpoint': a kebab spelling of a real field that the
+            # CRD schema does not declare. kubectl apply would prune it; here
+            # the field would fall back to its default. Surface that.
+            log.warn("manifest key looks like kebab-case for a known field "
+                     "but is not a declared alias (k8s/crd.yaml); it is "
+                     "IGNORED", key=k, spell_it=k.replace("-", "_"))
         if nk == k or nk not in d:
             out[nk] = v
     return out

@@ -72,7 +72,14 @@ class TrainingJobSyncLoop:
         self.store = store
         self.controller = controller
         self.poll_seconds = poll_seconds
-        #: True → consume streaming watch events between full LISTs
+        #: True → consume streaming watch events between full LISTs.
+        #: A store with no watch surface gets poll-list cadence outright —
+        #: staying in "watch mode" against such a store would silently
+        #: stretch reconcile latency from poll_seconds to
+        #: resync_every*poll_seconds with no events ever arriving.
+        if watch and getattr(store, "watch_training_job_crs", None) is None:
+            log.warn("store has no watch surface; using poll-list cadence")
+            watch = False
         self.watch = watch
         #: full LIST resync after this many watch windows (window length
         #: = poll_seconds), bounding sweep latency and any event drift
@@ -98,6 +105,14 @@ class TrainingJobSyncLoop:
         #: uid → last status dict written to the CR (write only on change,
         #: reference trainingJobUpdater.go:295-307)
         self._written_status: dict[str, dict] = {}
+        #: uid → (monotonic deadline before which no retry, current delay):
+        #: per-job exponential backoff with jitter on failed status patches,
+        #: so one job whose PATCH 500s doesn't get hammered every window
+        #: while healthy jobs proceed (the reference informer's rate-limited
+        #: workqueue discipline, pkg/controller.go:87-107)
+        self._patch_backoff: dict[str, tuple[float, float]] = {}
+        self.patch_backoff_base_s = 1.0
+        self.patch_backoff_cap_s = 60.0
         #: uid → spec dict rejected by validation (retry only when the
         #: user edits the spec, not every tick)
         self._rejected_specs: dict[str, Any] = {}
@@ -349,6 +364,7 @@ class TrainingJobSyncLoop:
         job = self._jobs.pop(uid, None)
         self._seen_specs.pop(uid, None)
         self._written_status.pop(uid, None)
+        self._patch_backoff.pop(uid, None)
         self._rejected_specs.pop(uid, None)
         self._rejected_update_reason.pop(uid, None)
         if job is not None:
@@ -386,10 +402,23 @@ class TrainingJobSyncLoop:
                       namespace: str) -> None:
         if self._written_status.get(uid) == status:
             return
+        deadline, delay = self._patch_backoff.get(uid, (0.0, 0.0))
+        now = time.monotonic()
+        if now < deadline:
+            return  # this job is backing off; others are unaffected
         try:
             if self.store.patch_training_job_status(name, status,
                                                     namespace=namespace):
                 self._written_status[uid] = status
+            self._patch_backoff.pop(uid, None)
         except Exception as exc:
-            # next tick retries; the in-memory phase machine is unaffected
-            log.error("status write-back failed", job=uid, error=str(exc))
+            # exponential backoff + jitter; the in-memory phase machine is
+            # unaffected and the patch retries once the deadline passes
+            import random
+
+            delay = min(self.patch_backoff_cap_s,
+                        max(self.patch_backoff_base_s, delay * 2))
+            jittered = delay * (0.5 + random.random() * 0.5)
+            self._patch_backoff[uid] = (now + jittered, delay)
+            log.error("status write-back failed; backing off", job=uid,
+                      error=str(exc), retry_in_s=round(jittered, 2))

@@ -274,10 +274,22 @@ std::string HealthBody() {
   return js.str();
 }
 
+// probes in flight; new connections beyond the cap are shed (closed) so a
+// flood cannot fan out into unbounded threads — the kubelet just retries
+std::atomic<int> g_health_inflight{0};
+
 void ServeHealth(int fd) {
   std::string req;
   char chunk[1024];
+  // total-request deadline: SO_RCVTIMEO is per-read, so a client trickling
+  // one byte per read could otherwise hold a probe slot for hours
+  const auto deadline =
+      std::chrono::steady_clock::now() + std::chrono::seconds(5);
   while (req.find("\r\n\r\n") == std::string::npos && req.size() < 8192) {
+    if (std::chrono::steady_clock::now() > deadline) {
+      close(fd);
+      return;
+    }
     ssize_t n = read(fd, chunk, sizeof(chunk));
     if (n <= 0) break;
     req.append(chunk, static_cast<size_t>(n));
@@ -426,12 +438,29 @@ int main(int argc, char** argv) {
     std::thread([hs]() {
       for (;;) {
         int fd = accept(hs, nullptr, nullptr);
-        if (fd < 0) continue;
+        if (fd < 0) {
+          // persistent failures (EMFILE under fd exhaustion) must not
+          // hot-spin the core the kubelet's probes depend on
+          usleep(100 * 1000);
+          continue;
+        }
         // a stalled probe client must not pin a thread forever
         timeval tv{2, 0};
         setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
         setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
-        std::thread(ServeHealth, fd).detach();
+        // bounded concurrency: each probe gets its own thread (one slow
+        // client can't block the kubelet's next probe) but at most 8 are
+        // in flight — beyond that, shed the connection instead of
+        // spawning unbounded threads
+        if (g_health_inflight.fetch_add(1) >= 8) {
+          g_health_inflight.fetch_sub(1);
+          close(fd);
+          continue;
+        }
+        std::thread([fd]() {
+          ServeHealth(fd);
+          g_health_inflight.fetch_sub(1);
+        }).detach();
       }
     }).detach();
   }
@@ -446,7 +475,10 @@ int main(int argc, char** argv) {
 
   for (;;) {
     int fd = accept(srv, nullptr, nullptr);
-    if (fd < 0) continue;
+    if (fd < 0) {
+      usleep(10 * 1000);  // same anti-hot-spin guard as the health loop
+      continue;
+    }
     setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
     std::thread(Serve, fd).detach();
   }

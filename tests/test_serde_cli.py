@@ -115,3 +115,33 @@ class TestCli:
                              "--max-load-desired", "0.9"])
         assert args.max_load_desired == 0.9
         assert args.loop_seconds == 5.0  # reference pkg/autoscaler.go:31
+
+
+def test_undeclared_kebab_key_warns_loudly(caplog):
+    """A kebab spelling of a real field that is NOT a declared alias
+    (e.g. 'etcd-endpoint') would be silently dropped on the submit path
+    (and apiserver-pruned on the kubectl path) — the parser must warn so
+    the degradation surfaces instead of the job quietly using defaults
+    (advisor r4, serde.py)."""
+    import logging
+
+    from edl_tpu.api import serde
+
+    doc = {
+        "apiVersion": serde.API_VERSION,
+        "kind": "TrainingJob",
+        "metadata": {"name": "j"},
+        "spec": {
+            "trainer": {"min-instance": 1, "max-instance": 2},
+            "master": {"etcd-endpoint": "http://coord:8080"},
+        },
+    }
+    with caplog.at_level(logging.WARNING, logger="edl_tpu.serde"):
+        job = serde.job_from_dict(doc)
+    # declared aliases still work silently
+    assert job.spec.trainer.min_instance == 1
+    assert job.spec.trainer.max_instance == 2
+    # the undeclared kebab key is ignored BUT warned about
+    assert job.spec.master.etcd_endpoint == ""
+    assert any("etcd-endpoint" in r.message and "etcd_endpoint" in r.message
+               for r in caplog.records)
