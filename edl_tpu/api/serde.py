@@ -58,14 +58,22 @@ KEBAB_ALIASES = {
 #: every snake_case field any manifest section understands; a kebab key whose
 #: snake twin is in this set but which is NOT a declared alias would be
 #: silently dropped — _norm warns loudly instead of degrading the job.
-_KNOWN_SNAKE_FIELDS = frozenset({
-    "min_instance", "max_instance", "allow_multi_domain",
-    "ports_num", "ports_num_for_sparse", "fault_tolerant", "host_network",
-    "node_selector", "etcd_endpoint", "coord_endpoint",
-    "entrypoint", "workspace", "resources", "topology", "env",
-    "image", "port", "passes", "trainer", "pserver", "master",
-    "requests", "limits", "name", "namespace", "labels",
-})
+#: Derived from the spec dataclasses so a newly added field cannot drift
+#: out of the warning's coverage; the literal tail covers the non-dataclass
+#: manifest keys (metadata, resources maps, the etcd_endpoint alias).
+def _known_snake_fields() -> frozenset[str]:
+    import dataclasses
+
+    return frozenset(
+        f.name
+        for t in (TrainingJobSpec, TrainerSpec, PserverSpec, MasterSpec)
+        for f in dataclasses.fields(t)
+    ) | frozenset({"coord_endpoint", "requests", "limits",
+                   "name", "namespace", "labels",
+                   "trainer", "pserver", "master"})
+
+
+_KNOWN_SNAKE_FIELDS = _known_snake_fields()
 
 
 def _norm(d: dict[str, Any]) -> dict[str, Any]:

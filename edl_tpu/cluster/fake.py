@@ -81,6 +81,10 @@ class FakeCluster(Cluster):
         #: Called with (pod, "start"|"stop") when reconcile changes the world;
         #: the elastic runtime uses this to launch/kill real worker processes.
         self.pod_event_hook: Optional[Callable[[FakePod, str], None]] = None
+        #: True → reconcile also keeps one live coordinator pod per
+        #: fault-tolerant job (the master RS analogue); enabled by the
+        #: process-backed kubelet, off for pure scheduler bookkeeping.
+        self.materialize_aux_pods: bool = False
         #: Injected failure for conflict-retry tests.
         self.fail_next_updates: int = 0
 
@@ -198,6 +202,25 @@ class FakeCluster(Cluster):
             self._job_specs[job.full_name] = job
         self.reconcile()
 
+    def job_spec(self, job_uid: str) -> Optional[TrainingJob]:
+        """The spec a pod's job was created from (the kubelet needs it to
+        compile the pod's container command/env via the jobparser)."""
+        with self._lock:
+            return self._job_specs.get(job_uid)
+
+    def report_pod_exit(self, name: str, returncode: int) -> None:
+        """Kubelet status update: the pod's process exited.  rc 0 →
+        Succeeded (work-queue Job: the job is done), else Failed (the Job
+        controller replaces it on the next reconcile)."""
+        with self._lock:
+            p = self._pods.get(name)
+            if p is None or p.phase not in (PodPhase.PENDING,
+                                            PodPhase.RUNNING):
+                return
+            p.phase = (PodPhase.SUCCEEDED if returncode == 0
+                       else PodPhase.FAILED)
+        self.reconcile()
+
     def delete_resources(self, job: TrainingJob) -> None:
         stopped: list[FakePod] = []
         with self._lock:
@@ -220,6 +243,32 @@ class FakeCluster(Cluster):
                 spec = self._job_specs.get(g.job_uid)
                 if spec is None:
                     continue
+                # coordinator ReplicaSet semantics for FT jobs (role of the
+                # master RS, reference pkg/jobparser.go:167-227): keep ONE
+                # live coordinator pod; a Failed one is replaced.  Off by
+                # default: the pure-bookkeeping scheduler scenarios elide
+                # aux pods (they hold no chips); the process-backed kubelet
+                # turns it on to run the job's coordinator for real.
+                if spec.spec.fault_tolerant and self.materialize_aux_pods:
+                    coords = [
+                        p for p in self._pods.values()
+                        if p.job_uid == g.job_uid and p.role == "coordinator"
+                        and p.phase in (PodPhase.PENDING, PodPhase.RUNNING)
+                        and not p.deletion_timestamp
+                    ]
+                    if not coords:
+                        seq = next(self._aux_pods_seq)
+                        mres = spec.spec.master.resources
+                        self._pods[f"{spec.name}-coordinator-{seq}"] = FakePod(
+                            name=f"{spec.name}-coordinator-{seq}",
+                            job_uid=g.job_uid, role="coordinator", seq=seq,
+                            cpu_request_milli=mres.cpu_request().milli_value(),
+                            cpu_limit_milli=mres.cpu_limit().milli_value(),
+                            memory_request_mega=(
+                                mres.memory_request().scaled_value(6)),
+                            memory_limit_mega=(
+                                mres.memory_limit().scaled_value(6)),
+                        )
                 pods = [
                     p for p in self._pods.values()
                     if p.job_uid == g.job_uid and p.role == "trainer"

@@ -152,11 +152,16 @@ def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(description="edl_tpu coordination server")
     ap.add_argument("--port", type=int,
                     default=int(os.environ.get("EDL_COORD_PORT", "7164")))
+    # env-tunable so a deployed coordinator pod can be tuned through the
+    # manifest's env block without changing the container command
     ap.add_argument("--task-timeout-ms", type=int,
-                    default=DEFAULT_TASK_TIMEOUT_MS)
+                    default=int(os.environ.get("EDL_COORD_TASK_TIMEOUT_MS",
+                                               str(DEFAULT_TASK_TIMEOUT_MS))))
     ap.add_argument("--passes", type=int,
                     default=int(os.environ.get("EDL_PASSES", "1")))
-    ap.add_argument("--member-ttl-ms", type=int, default=DEFAULT_MEMBER_TTL_MS)
+    ap.add_argument("--member-ttl-ms", type=int,
+                    default=int(os.environ.get("EDL_COORD_MEMBER_TTL_MS",
+                                               str(DEFAULT_MEMBER_TTL_MS))))
     ap.add_argument("--state-file",
                     default=os.environ.get("EDL_COORD_STATE_FILE", ""),
                     help="write-through durability file; restart with the "

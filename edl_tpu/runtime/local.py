@@ -37,6 +37,11 @@ class RunReport:
     #: wall-clock cost of each reshard: the resize() call plus the first
     #: step on the new mesh (which includes its compile on a cache miss)
     resize_seconds: list[float] = field(default_factory=list)
+    #: completed-step index at which each resize was applied — the exact
+    #: loss-trace boundary, so continuity can be checked per resize even
+    #: when one lands before the first step or two land between samples
+    #: of the world-size trace
+    resize_steps: list[int] = field(default_factory=list)
 
     @property
     def first_loss(self) -> float:
@@ -104,6 +109,7 @@ class LocalElasticJob:
                 resized_at = time.perf_counter()
                 self.trainer.resize(want)
                 report.resizes += 1
+                report.resize_steps.append(report.steps)
                 log.info("elastic resize applied", job=self.job.full_name,
                          from_size=before, to_size=want,
                          step=self.trainer.state.step)

@@ -1,51 +1,57 @@
-"""Multi-host fault-tolerance, live: kill a worker AND the coordinator.
+"""Multi-host fault-tolerance, live, through the DEPLOYED path.
 
-Runs the full elastic multi-host stack on this machine with CPU jax
-processes (the same code path a TPU pod would run):
+A thin wrapper over the same stack the e2e test drives
+(tests/test_exec_kubelet_e2e.py): a Controller materializes the job on a
+FakeCluster whose pods a ProcessKubelet actually EXECS — the coordinator
+pod runs `python -m edl_tpu.coord.server`, each trainer pod runs
+`python -m edl_tpu.runtime.launcher start_trainer`, exactly the commands
+the shipped manifests declare (controller/jobparser.py; reference
+parity: pkg/jobparser.go:124 + docker/paddle_k8s:119-141).  Then the
+fault story:
 
-1. a DURABLE coordination server (``--state-file``: queue accounting,
-   checkpoint pointers and the membership epoch survive restarts);
-2. three elastic workers training one job from the shared task queue;
-3. ~5 s in: ``kill -9`` one worker — the survivors reform a 2-world and
-   its leased shards re-dispatch (reference: a dead trainer is a
-   non-event, docker/paddle_k8s:119-141 + the 16 s re-dispatch);
-4. ~10 s in: ``kill -9`` the coordinator, then restart it on the same
-   port — workers redial, membership rebuilds from heartbeats, training
-   continues (reference: the etcd sidecar's persistence,
-   pkg/jobparser.go:167-184);
-5. both survivors drain the queue and exit 0 with exactly-once shard
-   accounting.
+1. three trainer pods form a world and train from the shared task queue;
+2. kill -9 one trainer's process group — the survivors reform and the
+   Job controller replaces the pod (a dead trainer is a non-event,
+   reference docker/paddle_k8s:119-141 + the 16 s re-dispatch);
+3. kill -9 the coordinator pod's process — the ReplicaSet analogue
+   respawns it on the same state volume (PVC semantics), workers redial,
+   membership rebuilds from heartbeats (reference: the etcd sidecar's
+   persistence, pkg/jobparser.go:167-184);
+4. the queue drains with exactly-once accounting and the job Succeeds.
 
 Usage:  python examples/multihost_ft_demo.py [--model transformer]
-
-``--model transformer`` runs the real GQA decoder family (the bench's
-architecture) through the same fault story, with mid-world checkpoints
-bounding the crash loss to 20 steps.
 """
 
+import _bootstrap  # noqa: F401  (repo-root sys.path + platform pin)
+
 import os
+import re
 import signal
-import subprocess
 import sys
 import tempfile
 import time
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from edl_tpu.api.serde import job_from_dict
+from edl_tpu.api.types import JobPhase
+from edl_tpu.cluster.exec_kubelet import ProcessKubelet
+from edl_tpu.cluster.fake import FakeCluster
+from edl_tpu.controller.controller import Controller
+from edl_tpu.coord.client import CoordClient
 
-from edl_tpu.coord.server import spawn_server  # noqa: E402
 
-
-def wait_for(path: str, needle: str, timeout_s: float) -> None:
+def wait_for(cond, what: str, timeout_s: float):
     deadline = time.monotonic() + timeout_s
     while time.monotonic() < deadline:
-        if os.path.exists(path) and needle in open(path).read():
+        if cond():
             return
         time.sleep(0.25)
-    raise TimeoutError(f"{needle!r} never appeared in {path}")
+    raise TimeoutError(f"never reached: {what}")
 
 
 def main() -> int:
     import argparse
+    import glob
+    import socket
 
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--model", choices=("mlp", "transformer"),
@@ -54,72 +60,141 @@ def main() -> int:
                          "bench's architecture) through the fault story")
     model = ap.parse_args().model
     work = tempfile.mkdtemp(prefix="edl-mh-demo-")
-    state = os.path.join(work, "coord.state")
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+
     n_shards = 256 if model == "mlp" else 64
-    env = dict(os.environ)
-    env.update(
-        JAX_PLATFORMS="cpu",
-        XLA_FLAGS="--xla_force_host_platform_device_count=1",
-        EDL_MH_EXAMPLES=str(64 * 1024), EDL_MH_SHARDS=str(n_shards),
-        EDL_MH_BATCH="32", EDL_MH_STEP_SLEEP="0.04",
-        # CPU demo: disarm the axon TPU bootstrap hook (~5 s of jax
-        # import per interpreter start) and reap the tree if the demo dies
-        PALLAS_AXON_POOL_IPS="",
-        EDL_MH_DIE_WITH_PARENT="1",
-    )
+    overrides = {
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+        "PALLAS_AXON_POOL_IPS": "",
+        "EDL_MH_DIE_WITH_PARENT": "1",
+        "EDL_MH_EXAMPLES": str(64 * 1024), "EDL_MH_SHARDS": str(n_shards),
+        "EDL_MH_BATCH": "32", "EDL_MH_STEP_SLEEP": "0.04",
+        "EDL_MH_MODEL": model,
+        "EDL_HEALTH_PORT": "0",
+        "EDL_COORD_MEMBER_TTL_MS": "3000",
+        "EDL_COORD_TASK_TIMEOUT_MS": "4000",
+        "EDL_MH_WARM_SPAWN": "0",
+    }
     if model == "transformer":
-        env.update(EDL_MH_SEQ="32", EDL_MH_BATCH="16",
-                   EDL_MH_CKPT_EVERY="20", EDL_MH_EXAMPLES=str(16 * 1024))
+        overrides.update(EDL_MH_SEQ="32", EDL_MH_BATCH="16",
+                         EDL_MH_CKPT_EVERY="20",
+                         EDL_MH_EXAMPLES=str(16 * 1024))
 
-    print(f"== durable coordinator (state write-through: {state})")
-    srv = spawn_server(member_ttl_ms=3000, task_timeout_ms=4000,
-                       state_file=state)
-    port = srv.port
+    print("== control plane: FakeCluster + process-backed kubelet "
+          "(pods exec the SHIPPED commands)")
+    fake = FakeCluster()
+    fake.add_node("host0", cpu_milli=16000, memory_mega=16000, tpu_chips=8)
+    controller = Controller(fake, updater_convert_seconds=0.3,
+                            updater_confirm_seconds=0.2)
+    kubelet = ProcessKubelet(fake, work, env_overrides=overrides)
 
-    print("== 3 elastic workers join, one world forms")
-    procs, logs = {}, {}
-    for n in ("w0", "w1", "w2"):
-        logs[n] = os.path.join(work, f"{n}.log")
-        procs[n] = subprocess.Popen(
-            [sys.executable, "-m", "edl_tpu.runtime.multihost_worker",
-             "--coord", f"127.0.0.1:{port}", "--name", n,
-             "--ckpt-dir", work, "--min-members", "3",
-             "--model", model,
-             "--settle-s", "0.3", "--heartbeat-timeout-s", "5"],
-            stdout=open(logs[n], "w"), stderr=subprocess.STDOUT, env=env)
-    wait_for(logs["w0"], "step 20 ", 180)
-    print("   training underway (w0 passed step 20)")
+    entry = (
+        "python -m edl_tpu.runtime.multihost_worker"
+        " --coord $EDL_COORD_HOST:$EDL_COORD_PORT"
+        " --name $EDL_WORKER_NAME"
+        f" --ckpt-dir {work}/ckpt"
+        " --min-members 3 --settle-s 0.3 --heartbeat-timeout-s 5"
+        f" --model {model}"
+    )
+    job = job_from_dict({
+        "apiVersion": "edl.tpu/v1", "kind": "TrainingJob",
+        "metadata": {"name": "demo"},
+        "spec": {
+            "image": "edl-tpu-job:latest", "fault_tolerant": True,
+            "port": port,
+            "trainer": {
+                "entrypoint": entry, "min_instance": 3, "max_instance": 3,
+                "resources": {"requests": {"cpu": "500m",
+                                           "memory": "256Mi"},
+                              "limits": {"cpu": "1", "memory": "512Mi",
+                                         "google.com/tpu": "1"}},
+            },
+        },
+    })
 
-    print("== kill -9 w1: a dead trainer is a non-event")
-    procs["w1"].kill()
-    procs["w1"].wait()
-    wait_for(logs["w0"], "world=2", 120)
-    print("   survivors reformed a 2-world; w1's leased shards re-dispatch")
+    def tlogs():
+        return sorted(glob.glob(os.path.join(work, "logs",
+                                             "demo-trainer-*.log")))
 
-    print("== kill -9 the coordinator, restart it on the same port")
-    srv.process.send_signal(signal.SIGKILL)
-    srv.process.wait()
-    time.sleep(1.0)
-    srv = spawn_server(port=port, member_ttl_ms=3000, task_timeout_ms=4000,
-                       state_file=state)
-    print("   restarted; workers redial, membership rebuilds from heartbeats")
+    def text():
+        return "".join(open(p).read() for p in tlogs())
 
-    rc0 = procs["w0"].wait(timeout=300)
-    rc2 = procs["w2"].wait(timeout=300)
-    stats = srv.client().stats()
-    srv.stop()
-    print(f"== done: w0 rc={rc0}, w2 rc={rc2}")
-    print(f"   queue: done={stats.done} todo={stats.todo} "
-          f"leased={stats.leased} dropped={stats.dropped}")
-    ok = (rc0 == 0 and rc2 == 0 and stats.done == n_shards
-          and stats.todo == 0 and stats.dropped == 0)
-    print("   exactly-once accounting:", "OK" if ok else "VIOLATED")
-    for n in ("w0", "w2"):
-        line = [l for l in open(logs[n]).read().splitlines()
-                if "done at step" in l]
-        if line:
-            print(f"   {line[-1]}")
-    return 0 if ok else 1
+    def worlds():
+        return [int(m.group(1)) for m in
+                re.finditer(r"entering world epoch=\d+ world=(\d+)",
+                            text())]
+
+    stats = None
+
+    def poll_stats():
+        # keep the HIGHEST done-count seen: on success the updater tears
+        # the coordinator down at once, and a last poll that raced the
+        # teardown must not roll the evidence back to an earlier snapshot
+        nonlocal stats
+        try:
+            c = CoordClient("127.0.0.1", port, timeout=2.0)
+            s = c.stats()
+            c.close()
+            if stats is None or s.done >= stats.done:
+                stats = s
+        except OSError:
+            pass
+
+    try:
+        controller.submit(job)
+        print("== 3 trainer pods exec `launcher start_trainer`; "
+              "one world forms")
+        wait_for(lambda: any(w == 3 for w in worlds()),
+                 "3-world forms", 180)
+        wait_for(lambda: "step 20 " in text(), "training underway", 120)
+        print("   training underway (step 20 logged)")
+
+        print("== kill -9 one trainer pod: a dead trainer is a non-event")
+        victim = [p for p in kubelet.live_pods() if "-trainer-" in p][0]
+        before = set(tlogs())
+        kubelet.signal_pod(victim, signal.SIGKILL)
+        wait_for(lambda: any("entering world" in open(p).read()
+                             for p in set(tlogs()) - before),
+                 "replacement pod rejoins", 180)
+        print(f"   {victim} killed; survivors reformed; replacement "
+              "pod rejoined")
+
+        print("== kill -9 the coordinator pod: the RS respawns it on the "
+              "same state volume")
+        coord_pod = [p for p in kubelet.live_pods()
+                     if "-coordinator-" in p][0]
+        kubelet.signal_pod(coord_pod, signal.SIGKILL)
+        wait_for(lambda: any(p != coord_pod and "-coordinator-" in p
+                             for p in kubelet.live_pods()),
+                 "coordinator replaced", 60)
+        print("   restarted; workers redial, membership rebuilds, "
+              "queue state restored from the volume")
+
+        print("== drain to completion")
+        updater = controller.get_updater(job)
+        deadline = time.monotonic() + 600
+        while time.monotonic() < deadline:
+            poll_stats()
+            if updater.job.status.phase in (JobPhase.SUCCEEDED,
+                                            JobPhase.FAILED):
+                break
+            time.sleep(0.3)
+        phase = updater.job.status.phase
+        ok = (phase == JobPhase.SUCCEEDED and stats is not None
+              and stats.done == n_shards and stats.todo == 0
+              and stats.dropped == 0)
+        print(f"== done: phase={phase.value} queue="
+              f"{stats and (stats.done, stats.todo, stats.dropped)}")
+        print("   exactly-once accounting:", "OK" if ok else "VIOLATED")
+        for line in re.findall(r".*done at step.*", text())[:3]:
+            print(f"   {line}")
+        return 0 if ok else 1
+    finally:
+        controller.stop()
+        kubelet.stop()
 
 
 if __name__ == "__main__":

@@ -1,9 +1,14 @@
-"""Fault-tolerant elastic trainer example — word2vec (CBOW).
+"""Fault-tolerant elastic trainer example — word2vec (CBOW) on REAL text.
 
 TPU-native port of the reference's flagship example
 (reference example/train_ft.py:15-118: word2vec/imikolov on paddle.v2,
 pserver discovery via etcd, data via the master task queue).  Here:
 
+  * the corpus is a real text file (``examples/data/tiny_corpus.txt``,
+    baked into the job image like the reference's pre-converted imikolov
+    RecordIO chunks, example/Dockerfile:1-8) — tokenized and sharded to
+    disk ONCE by a claim-elected seeder (``runtime.corpus`` +
+    ``FileShardStore``), then leased as file shards;
   * parameters live replicated/sharded on the local device mesh
     (ElasticTrainer), not in pservers;
   * data shards are leased from the coordination service's task queue
@@ -12,7 +17,7 @@ pserver discovery via etcd, data via the master task queue).  Here:
   * trainer count appears nowhere (the property that makes the job
     elastic, SURVEY §3.4).
 
-Run standalone (in-process coordinator, synthetic corpus):
+Run standalone (in-process coordinator, the shipped corpus):
 
     python examples/train_ft.py
 
@@ -20,7 +25,10 @@ or as a pod entrypoint under the launcher, which exports
 EDL_COORD_HOST/EDL_COORD_PORT/EDL_WORKER_NAME:
 
     python -m edl_tpu.runtime.launcher start_trainer
-"""
+
+Env: ``EDL_DATA_FILE`` picks a different corpus (empty string →
+synthetic fallback); ``EDL_DATA_DIR`` is where shards are written
+(shared storage in a real deployment)."""
 
 from __future__ import annotations
 
@@ -67,32 +75,68 @@ def connect_coordinator():
     return PyCoordService(passes=PASSES)
 
 
+DEFAULT_CORPUS = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "data", "tiny_corpus.txt")
+
+
 def main() -> None:
+    import tempfile
+
+    from edl_tpu.runtime.data import FileShardStore, ensure_seeded
+
     worker = os.environ.get("EDL_WORKER_NAME", "local-0")
     coord = connect_coordinator()
 
-    # Every worker registers the same deterministic shard split (role of
-    # RecordIO files on shared storage); exactly one worker — elected via a
-    # KV compare-and-swap, the etcd-slot-claim idiom — enqueues the tasks.
-    registry = ShardRegistry()
-    shard_ids = registry.register_arrays(synthetic_corpus(), SHARDS)
-    if coord.kv_cas("data-seeder", b"", worker.encode()):
-        registry.enqueue(coord, shard_ids)
+    data_file = os.environ.get("EDL_DATA_FILE", DEFAULT_CORPUS)
+    if data_file and os.path.exists(data_file):
+        # REAL data: tokenize + shard the corpus to disk once (the
+        # claim-elected seeder with crash takeover — ensure_seeded), then
+        # everyone leases the FILES (role of RecordIO chunks + master
+        # task list, reference example/train_ft.py:112)
+        from edl_tpu.runtime import corpus
 
-    params = word2vec.init(jax.random.key(0), VOCAB, CONTEXT, EMBED)
+        data_dir = os.environ.get(
+            "EDL_DATA_DIR",
+            os.path.join(tempfile.gettempdir(),
+                         f"edl-train-ft-{os.path.basename(data_file)}"))
+
+        def seed(beat):
+            FileShardStore.enqueue(coord, corpus.prepare_shards(
+                data_file, data_dir, num_shards=SHARDS,
+                vocab_size=VOCAB, context=CONTEXT, on_shard=beat))
+
+        ensure_seeded(coord, worker, seed)
+        meta = corpus.load_vocab_meta(data_dir)
+        vocab_size, fetch = meta["vocab_size"], FileShardStore.fetch
+        print(f"[{worker}] corpus {os.path.basename(data_file)}: "
+              f"{meta['tokens']} tokens, vocab {vocab_size}, "
+              f"{SHARDS} file shards in {data_dir}")
+    else:
+        # synthetic fallback: every worker registers the same
+        # deterministic split; one CAS-elected worker enqueues
+        registry = ShardRegistry()
+        shard_ids = registry.register_arrays(synthetic_corpus(), SHARDS)
+        if coord.kv_cas("data-seeder", b"", worker.encode()):
+            registry.enqueue(coord, shard_ids)
+        vocab_size, fetch = VOCAB, registry.fetch
+
+    params = word2vec.init(jax.random.key(0), vocab_size, CONTEXT, EMBED)
     trainer = ElasticTrainer(
         word2vec.loss_fn, params, optax.adam(3e-3),
     )
 
     losses = []
-    batches = TaskLeaseBatches(coord, worker, registry.fetch, BATCH)
+    batches = TaskLeaseBatches(coord, worker, fetch, BATCH)
     for i, batch in enumerate(batches):
         losses.append(trainer.step(batch))
         if i % 50 == 0:
             print(f"[{worker}] step {trainer.state.step} "
                   f"pass {coord.current_pass()} loss {losses[-1]:.4f}")
+    stats = coord.stats()
     print(f"[{worker}] done: {trainer.state.step} steps, "
-          f"loss {losses[0]:.4f} -> {losses[-1]:.4f}")
+          f"loss {losses[0]:.4f} -> {losses[-1]:.4f}; "
+          f"queue done={stats.done} todo={stats.todo} "
+          f"dropped={stats.dropped}")
     assert losses[-1] < losses[0], "loss should decrease"
 
 
