@@ -633,6 +633,25 @@ def model_zoo_leg() -> dict:
                                          1)})
     out["resnet50"] = with_mfu(m)
 
+    # -- the TPU-native stem variant (s2d; models/resnet.py RESNET50_TPU):
+    # same bottleneck trunk, MXU-dense stem — recorded alongside the
+    # canonical number, not instead of it
+    if on_tpu:
+        # a variant failure must not void the canonical numbers above
+        try:
+            tcfg = resnet.RESNET50_TPU
+            tparams = resnet.init(jax.random.key(2), tcfg)
+            mt = _timed_generic_step(resnet.make_loss_fn(tcfg), tparams,
+                                     (images[:batch], labels[:batch]),
+                                     n_steps)
+            mt.update({"batch": batch, "image": f"{hw}x{hw}",
+                       "stem": "s2d",
+                       "images_per_second": round(
+                           n_steps * batch / mt.pop("seconds"), 1)})
+            out["resnet50_tpu"] = with_mfu(mt)
+        except Exception as exc:
+            out["resnet50_tpu"] = {"error": str(exc)[:200]}
+
     # -- BERT-base MLM pretrain shape (BASELINE config 3) --
     if on_tpu:
         # swept: 32×512 beats 32/64/128×128 and 64×512 (142k vs 123-132k
@@ -655,6 +674,22 @@ def model_zoo_leg() -> dict:
               "tokens_per_second": round(
                   n_steps * batch * seq / m.pop("seconds"), 1)})
     out["bert_base"] = with_mfu(m)
+
+    # -- the TPU-native head layout (6 heads x 128; models/bert.py
+    # BERT_BASE_TPU): head_dim is the MXU contraction dim in attention,
+    # and 64 idles half the array — recorded alongside the canonical
+    if on_tpu:
+        try:  # a variant failure must not void the canonical numbers
+            btcfg = bert.BERT_BASE_TPU
+            btparams = bert.init(jax.random.key(6), btcfg)
+            mt = _timed_generic_step(bert.make_loss_fn(btcfg), btparams,
+                                     (tokens, targets, mask), n_steps)
+            mt.update({"batch": batch, "seq": seq, "heads": "6x128",
+                       "tokens_per_second": round(
+                           n_steps * batch * seq / mt.pop("seconds"), 1)})
+            out["bert_base_tpu"] = with_mfu(mt)
+        except Exception as exc:
+            out["bert_base_tpu"] = {"error": str(exc)[:200]}
     return out
 
 
@@ -1243,7 +1278,11 @@ def main() -> None:
         "flash_speedup_vs_xla": long_ctx.get("speedup_vs_xla_attention"),
         "resnet50_mfu_pct": (zoo.get("resnet50") or {}).get("mfu_pct"),
         "resnet50_img_s": (zoo.get("resnet50") or {}).get("images_per_second"),
+        "resnet50_tpu_stem_mfu_pct": (zoo.get("resnet50_tpu")
+                                      or {}).get("mfu_pct"),
         "bert_mfu_pct": (zoo.get("bert_base") or {}).get("mfu_pct"),
+        "bert_tpu_heads_mfu_pct": (zoo.get("bert_base_tpu")
+                                   or {}).get("mfu_pct"),
         "crash_reform_s": reform.get("crash_reform_s"),
         "graceful_reform_s": reform.get("graceful_reform_s"),
         "join_from_spawn_s": reform.get("join_total_from_spawn_s"),

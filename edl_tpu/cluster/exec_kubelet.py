@@ -181,9 +181,13 @@ class ProcessKubelet:
         for vol, mount in container["mounts"].items():
             host_dir = os.path.join(self.workdir, "volumes", job_dir, vol)
             os.makedirs(host_dir, exist_ok=True)
+            prefix = mount.rstrip("/") + "/"
             for k, v in list(env.items()):
-                if isinstance(v, str) and v.startswith(mount):
-                    env[k] = host_dir + v[len(mount):]
+                # exact path or a child of the mount — NOT a sibling that
+                # merely shares the string prefix (/state vs /state-backup)
+                if isinstance(v, str) and (v == mount
+                                           or v.startswith(prefix)):
+                    env[k] = host_dir + v[len(mount.rstrip("/")):]
         # Service DNS emulation: *.svc resolves to this machine
         ep = env.get("EDL_COORD_ENDPOINT", "")
         if ".svc" in ep:
@@ -205,6 +209,20 @@ class ProcessKubelet:
             self._request_stop(pod.name)
 
     def _start_pod(self, pod: FakePod) -> None:
+        # start events race teardown and scale-down: reconcile() runs on
+        # several threads and the hook fires outside the cluster lock, so
+        # a pod may already be stopped/deleted (or the kubelet stopping)
+        # by the time its start event lands — spawning then would leak a
+        # live process no snapshot tracks
+        if self._stop.is_set():
+            return
+        from edl_tpu.cluster.base import PodPhase
+
+        current = {p.name for p in self.cluster.list_pods()
+                   if p.phase == PodPhase.RUNNING
+                   and not p.deletion_timestamp}
+        if pod.name not in current:
+            return
         container = self._container_for(pod)
         if container is None:
             return
@@ -261,6 +279,9 @@ class ProcessKubelet:
                         except ProcessLookupError:
                             pass
             for name, rc in exited:
+                if self._stop.is_set():
+                    break  # teardown: a FAILED report would spawn a
+                    # replacement process that outlives stop()
                 log.info("pod exited", pod=name, rc=rc)
                 # a stop-requested pod is already deleted cluster-side;
                 # report_pod_exit no-ops for it (pod gone / terminal)

@@ -26,9 +26,19 @@ class ResNetConfig:
     num_classes: int = 1000
     groups: int = 32  # GroupNorm groups
     dtype: Any = jnp.bfloat16
+    #: "conv7" = canonical 7x7-stride-2 stem + 3x3 maxpool;
+    #: "s2d"   = 4x4 space-to-depth + 2x2 conv straight to 56x56 (the
+    #: MLPerf-lineage TPU stem: a 3-channel 7x7 conv pads its 3 input
+    #: channels to 8 MXU lanes and wastes most of the systolic array on
+    #: the largest feature map; s2d feeds 48 dense channels instead and
+    #: skips the 112x112x64 intermediate entirely).  Measured on v5e at
+    #: batch 256: 106.5 -> 100.3 ms/step (scripts/profile_resnet.py, r5).
+    stem: str = "conv7"
 
 
 RESNET50 = ResNetConfig()
+#: TPU-native stem variant (same bottleneck trunk; see `stem` docs above)
+RESNET50_TPU = ResNetConfig(stem="s2d")
 TINY = ResNetConfig(stage_sizes=(1, 1), width=8, num_classes=10, groups=4,
                     dtype=jnp.float32)
 
@@ -47,7 +57,9 @@ def _gn_init(c):
 def init(key: jax.Array, cfg: ResNetConfig) -> dict:
     keys = iter(jax.random.split(key, 4 * sum(cfg.stage_sizes) * 3 + 16))
     params: dict = {
-        "stem": _conv_init(next(keys), 7, 7, 3, cfg.width),
+        "stem": (_conv_init(next(keys), 2, 2, 48, cfg.width)
+                 if cfg.stem == "s2d"
+                 else _conv_init(next(keys), 7, 7, 3, cfg.width)),
         "stem_norm": _gn_init(cfg.width),
         "stages": [],
     }
@@ -92,14 +104,18 @@ def _conv(x, w, stride=1):
 
 
 def _group_norm(x, p, groups, eps=1e-5):
-    b, h, w, c = x.shape
-    orig = x.dtype
-    g = x.astype(jnp.float32).reshape(b, h, w, groups, c // groups)
-    mean = jnp.mean(g, axis=(1, 2, 4), keepdims=True)
-    var = jnp.var(g, axis=(1, 2, 4), keepdims=True)
-    g = (g - mean) * jax.lax.rsqrt(var + eps)
-    x = g.reshape(b, h, w, c) * p["scale"] + p["bias"]
-    return x.astype(orig)
+    # Fused GroupNorm (ops/group_norm.py).  Two generations of the r5
+    # bandwidth work live behind this call: (1) single-pass statistics
+    # (var = E[x^2]-E[x]^2, one fused read instead of jnp.var's dependent
+    # second pass) took the ResNet-50 step from 189.5 -> 107.9 ms on v5e
+    # (scripts/profile_resnet.py); (2) the pallas kernel holds one
+    # image's map VMEM-resident, folding stats + normalize into a single
+    # HBM read+write (and the backward's reductions likewise).  Identical
+    # loss to 3 decimals; E[x^2]-E[x]^2 cancellation is benign on
+    # zero-centered post-conv activations with f32 accumulation.
+    from edl_tpu.ops.group_norm import group_norm
+
+    return group_norm(x, p["scale"], p["bias"], groups, eps)
 
 
 def _bottleneck(x, blk, groups, stride):
@@ -116,10 +132,18 @@ def _bottleneck(x, blk, groups, stride):
 def apply(params: dict, images: jax.Array, cfg: ResNetConfig) -> jax.Array:
     """images [b, h, w, 3] → logits [b, num_classes]."""
     x = images.astype(cfg.dtype)
-    x = _conv(x, params["stem"], stride=2)
-    x = jax.nn.relu(_group_norm(x, params["stem_norm"], cfg.groups))
-    x = jax.lax.reduce_window(x, -jnp.inf, jax.lax.max, (1, 3, 3, 1),
-                              (1, 2, 2, 1), "SAME")
+    if cfg.stem == "s2d":
+        b, h, w, c = x.shape
+        x = x.reshape(b, h // 4, 4, w // 4, 4, c)
+        x = x.transpose(0, 1, 3, 2, 4, 5).reshape(b, h // 4, w // 4,
+                                                  16 * c)
+        x = _conv(x, params["stem"])
+        x = jax.nn.relu(_group_norm(x, params["stem_norm"], cfg.groups))
+    else:
+        x = _conv(x, params["stem"], stride=2)
+        x = jax.nn.relu(_group_norm(x, params["stem_norm"], cfg.groups))
+        x = jax.lax.reduce_window(x, -jnp.inf, jax.lax.max, (1, 3, 3, 1),
+                                  (1, 2, 2, 1), "SAME")
     for stage, blocks in enumerate(params["stages"]):
         for b, blk in enumerate(blocks):
             stride = 2 if (stage > 0 and b == 0) else 1

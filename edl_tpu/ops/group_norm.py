@@ -1,0 +1,254 @@
+"""Fused GroupNorm pallas kernel for conv nets (NHWC).
+
+ResNet-50 at batch 256 is activation-bandwidth-bound: after the
+single-pass-statistics rewrite (models/resnet.py::_group_norm history),
+the remaining GroupNorm cost is the *second* read of the activation —
+statistics need a full pass before normalization can start, so XLA's
+best schedule is read-for-stats, read-for-normalize, write.  One image's
+feature map fits VMEM at every ResNet-50 stage (worst case
+112x112x64 f32 = 3.2 MB against ~16 MB/core), so a pallas kernel can
+hold the block resident and do stats + normalize in ONE HBM read + one
+write.  The backward pass fuses the same way: x and dy are read once,
+dx and the per-image dgamma/dbeta partials come out, instead of XLA's
+four-plus passes for the three group reductions and dx.
+
+Mosaic layout note: the obvious [HW, C] → [HW, G, CG] reshape SPLITS THE
+LANE DIMENSION and fails to lower ("infer-vector-layout: unsupported
+shape cast").  The kernels therefore never reshape: channel→group
+aggregation is a [1, C] @ [C, G] matmul against a constant 0/1
+membership matrix, and group→channel broadcast is the transpose matmul —
+both MXU-trivial and layout-clean.
+
+Dispatch mirrors ops/flash_attention.py: pallas on TPU (or interpret
+mode for CPU tests), pure-jnp single-pass math elsewhere.  The jnp path
+is also the numerical reference in tests/test_models_ops.py.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+
+def _reference(x2d, scale, bias, groups: int, eps: float):
+    """Single-pass-stats jnp math (the non-TPU path and test oracle).
+    x2d: [b, hw, c]."""
+    b, hw, c = x2d.shape
+    g32 = x2d.reshape(b, hw, groups, c // groups).astype(jnp.float32)
+    mean = jnp.mean(g32, axis=(1, 3), keepdims=True)
+    mean2 = jnp.mean(g32 * g32, axis=(1, 3), keepdims=True)
+    inv = jax.lax.rsqrt(jnp.maximum(mean2 - mean * mean, 0.0) + eps)
+    y = ((g32 - mean) * inv).reshape(b, hw, c)
+    return (y * scale.astype(jnp.float32)
+            + bias.astype(jnp.float32)).astype(x2d.dtype)
+
+
+def _membership(c: int, groups: int) -> np.ndarray:
+    """[C, G] 0/1 matrix: column g selects group g's channels."""
+    m = np.zeros((c, groups), np.float32)
+    cg = c // groups
+    for g in range(groups):
+        m[g * cg:(g + 1) * cg, g] = 1.0
+    return m
+
+
+def _dot(a, b):
+    return jax.lax.dot_general(a, b, (((1,), (0,)), ((), ())),
+                               preferred_element_type=jnp.float32)
+
+
+# -- forward kernel ----------------------------------------------------------
+
+def _fwd_kernel(x_ref, s_ref, b_ref, m_ref, y_ref, mean_ref, inv_ref,
+                *, n_per_group: float, eps: float):
+    # VMEM discipline: reductions accumulate straight from the input
+    # block (dtype=f32 accumulators, no materialized f32 copy), and the
+    # normalize collapses to ONE input-dtype multiply-add y = x*p + q
+    # with per-channel p/q — f32 [hw, c] temps overflowed the 16 MB
+    # scoped-vmem budget once the grid was big enough to double-buffer.
+    x = x_ref[0]                                             # [hw, c]
+    m = m_ref[...]                                           # [c, g]
+    sum_c = jnp.sum(x, axis=0, keepdims=True, dtype=jnp.float32)
+    sum2_c = jnp.sum(x * x, axis=0, keepdims=True, dtype=jnp.float32)
+    mean_g = _dot(sum_c, m) / n_per_group                    # [1, g]
+    mean2_g = _dot(sum2_c, m) / n_per_group
+    inv_g = jax.lax.rsqrt(
+        jnp.maximum(mean2_g - mean_g * mean_g, 0.0) + eps)
+    mean_c = _dot(mean_g, m.T)                               # [1, c]
+    inv_c = _dot(inv_g, m.T)
+    gamma = s_ref[0].astype(jnp.float32)
+    p = (inv_c * gamma).astype(x.dtype)
+    q = (b_ref[0].astype(jnp.float32)
+         - mean_c * inv_c * gamma).astype(x.dtype)
+    y_ref[0] = (x * p + q).astype(y_ref.dtype)
+    mean_ref[0] = mean_g
+    inv_ref[0] = inv_g
+
+
+def _fwd(x2d, scale, bias, groups: int, eps: float, interpret: bool):
+    b, hw, c = x2d.shape
+    s2 = scale.reshape(1, c)
+    b2 = bias.reshape(1, c)
+    memb = jnp.asarray(_membership(c, groups))
+    n_per_group = float(hw * (c // groups))
+    y, mean, inv = pl.pallas_call(
+        functools.partial(_fwd_kernel, n_per_group=n_per_group, eps=eps),
+        grid=(b,),
+        in_specs=[
+            pl.BlockSpec((1, hw, c), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, c), lambda i: (0, 0)),
+            pl.BlockSpec((1, c), lambda i: (0, 0)),
+            pl.BlockSpec((c, groups), lambda i: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, hw, c), lambda i: (i, 0, 0)),
+            # small outputs are (b, 1, g) with (1, 1, g) blocks: each of
+            # the last two block dims must be tile-divisible or equal to
+            # the full array dim
+            pl.BlockSpec((1, 1, groups), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, 1, groups), lambda i: (i, 0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, hw, c), x2d.dtype),
+            jax.ShapeDtypeStruct((b, 1, groups), jnp.float32),
+            jax.ShapeDtypeStruct((b, 1, groups), jnp.float32),
+        ],
+        interpret=interpret,
+    )(x2d, s2, b2, memb)
+    return y, mean, inv
+
+
+# -- backward kernel ---------------------------------------------------------
+
+def _bwd_kernel(x_ref, dy_ref, s_ref, m_ref, mean_ref, inv_ref,
+                dx_ref, dg_ref, db_ref, *, n_per_group: float):
+    # VMEM discipline: every group statistic the backward needs reduces
+    # to TWO per-channel sums (a = Σdy, b = Σdy·x), so no [hw, c]
+    # intermediate (xhat, dy·γ) is ever materialized — the first version
+    # that built them overflowed the 16 MB scoped-vmem budget by 466 KB
+    # at the 12544x64 stem shape.
+    x = x_ref[0]                                             # [hw, c]
+    dy = dy_ref[0]
+    m = m_ref[...]                                           # [c, g]
+    gamma = s_ref[0].astype(jnp.float32)                     # [1, c]
+    mean_c = _dot(mean_ref[0], m.T)                          # [1, c]
+    inv_c = _dot(inv_ref[0], m.T)
+    a_c = jnp.sum(dy, axis=0, keepdims=True, dtype=jnp.float32)
+    b_c = jnp.sum(dy * x, axis=0, keepdims=True, dtype=jnp.float32)
+    # param grads (partials over this image; XLA sums over b):
+    #   dγ_c = Σ dy·x̂ = inv_c·(b_c − mean_c·a_c);  dβ_c = a_c
+    dg_ref[0] = inv_c * (b_c - mean_c * a_c)
+    db_ref[0] = a_c
+    # group means of dy·γ and (dy·γ)·x̂, from the same channel sums
+    s1_g = _dot(gamma * a_c, m)                              # [1, g]
+    s2_g = _dot(gamma * b_c, m)
+    m1_g = s1_g / n_per_group
+    m2_g = inv_ref[0] * (s2_g - mean_ref[0] * s1_g) / n_per_group
+    m1_c = _dot(m1_g, m.T)
+    m2_c = _dot(m2_g, m.T)
+    # dx = (dy·γ − m1 − x̂·m2)·inv  ≡  dy·p − x·q + r with per-channel
+    # coefficients — one input-dtype fused multiply-add, no f32 temps
+    p = (gamma * inv_c).astype(x.dtype)
+    q = (inv_c * inv_c * m2_c).astype(x.dtype)
+    r = ((mean_c * inv_c * m2_c - m1_c) * inv_c).astype(x.dtype)
+    dx_ref[0] = (dy * p - x * q + r).astype(dx_ref.dtype)
+
+
+def _bwd_call(x2d, dy, scale, mean, inv, groups: int, interpret: bool):
+    b, hw, c = x2d.shape
+    s2 = scale.reshape(1, c)
+    memb = jnp.asarray(_membership(c, groups))
+    n_per_group = float(hw * (c // groups))
+    dx, dg_b, db_b = pl.pallas_call(
+        functools.partial(_bwd_kernel, n_per_group=n_per_group),
+        grid=(b,),
+        in_specs=[
+            pl.BlockSpec((1, hw, c), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, hw, c), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, c), lambda i: (0, 0)),
+            pl.BlockSpec((c, groups), lambda i: (0, 0)),
+            pl.BlockSpec((1, 1, groups), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, 1, groups), lambda i: (i, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, hw, c), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, 1, c), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, 1, c), lambda i: (i, 0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, hw, c), x2d.dtype),
+            jax.ShapeDtypeStruct((b, 1, c), jnp.float32),
+            jax.ShapeDtypeStruct((b, 1, c), jnp.float32),
+        ],
+        interpret=interpret,
+    )(x2d, dy, s2, memb, mean, inv)
+    return dx, dg_b[:, 0], db_b[:, 0]
+
+
+# -- custom-vjp wrapper ------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _gn2d(x2d, scale, bias, groups: int, eps: float, interpret: bool):
+    y, _mean, _inv = _fwd(x2d, scale, bias, groups, eps, interpret)
+    return y
+
+
+def _gn2d_fwd(x2d, scale, bias, groups, eps, interpret):
+    y, mean, inv = _fwd(x2d, scale, bias, groups, eps, interpret)
+    return y, (x2d, scale, mean, inv)
+
+
+def _gn2d_bwd(groups, eps, interpret, res, dy):
+    x2d, scale, mean, inv = res
+    dx, dg_b, db_b = _bwd_call(x2d, dy, scale, mean, inv, groups,
+                               interpret)
+    return dx, jnp.sum(dg_b, axis=0), jnp.sum(db_b, axis=0)
+
+
+_gn2d.defvjp(_gn2d_fwd, _gn2d_bwd)
+
+
+def group_norm(x, scale, bias, groups: int, eps: float = 1e-5,
+               use_pallas: bool | None = None,
+               interpret: bool = False):
+    """GroupNorm over NHWC ``x`` with per-channel ``scale``/``bias``.
+
+    ``use_pallas=None`` → the fused-math jnp path everywhere; set
+    ``EDL_GN_PALLAS=1`` (TPU only) to opt into the pallas kernel.
+
+    MEASURED NEGATIVE RESULT (v5e, ResNet-50 b256, r5): the pallas
+    kernel is 170.8 ms/step vs 107.9 ms for the jnp single-pass math.
+    The kernel does save the second stats read, but a custom call is a
+    fusion BARRIER — XLA had been folding the relu, residual add, and
+    conv-input casts into the norm's elementwise epilogue for free, and
+    losing those fusions costs more than the pass it saves.  The kernel
+    stays as a tested building block (and the measurement as a warning:
+    don't hand-schedule what the compiler already fuses — pallas pays
+    off where XLA CANNOT fuse, like flash attention's softmax-rescale
+    loop, not where it already does).
+    """
+    b, h, w, c = x.shape
+    if c % groups:
+        raise ValueError(f"channels {c} not divisible by groups {groups}")
+    x2d = x.reshape(b, h * w, c)
+    if use_pallas is None:
+        use_pallas = (jax.default_backend() == "tpu"
+                      and os.environ.get("EDL_GN_PALLAS", "0") == "1")
+    # VMEM guards: one image's block (plus pipeline double-buffering and
+    # reduction temps) must sit inside the ~16 MB scoped budget, and a
+    # sub-128 channel count pads the lane dimension — the 112x112x64 stem
+    # block doubles to an effective 12544x128 and overflowed by 2.2 MB
+    # (measured).  Such shapes take the jnp path; every other ResNet-50
+    # site is 128-multiple.
+    if use_pallas and ((h * w) * c * 4 > 6 * 1024 * 1024 or c % 128):
+        use_pallas = False
+    if use_pallas or interpret:
+        y = _gn2d(x2d, scale, bias, groups, eps, interpret)
+    else:
+        y = _reference(x2d, scale, bias, groups, eps)
+    return y.reshape(b, h, w, c)

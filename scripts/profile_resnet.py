@@ -1,0 +1,202 @@
+"""Attribute ResNet-50's step time on the real chip (verdict r5 weak #1).
+
+BENCH_r04: 16.7 % MFU at batch 256 — 188 ms/step where the pure-FLOPs
+floor is ~31 ms.  This script measures WHERE the time goes by timing
+targeted model variants (each isolates one suspected sink), then the
+candidate fixes.  Run on the TPU:
+
+    python scripts/profile_resnet.py [--steps 10]
+
+Variants:
+  baseline      the shipped model (GroupNorm f32 two-pass stats)
+  fwd_only      no backward/optimizer — splits fwd vs bwd+update
+  no_norm       GroupNorm removed (scale+bias only) — the norm's total tax
+  gn_onepass    var = E[x^2] - E[x]^2 (one fused read instead of two)
+  gn_bf16_out   one-pass stats + normalized output computed in bf16
+  s2d_stem      4x4 space-to-depth input + 2x2-stride stem conv (the
+                MLPerf conv0 trick: 3 input channels pad to 8 MXU lanes,
+                wasting 5/8 of the systolic array on the biggest image)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def timed(loss_fn, params, data, n_steps, fwd_only=False):
+    import jax
+    import optax
+
+    if fwd_only:
+        compiled = jax.jit(lambda p, d: loss_fn(p, d)).lower(
+            params, data).compile()
+        float(compiled(params, data))
+        t0 = time.perf_counter()
+        for _ in range(n_steps):
+            loss = compiled(params, data)
+        final = float(loss)
+        return 1000 * (time.perf_counter() - t0) / n_steps, final
+
+    optimizer = optax.adamw(3e-4)
+    opt_state = optimizer.init(params)
+
+    def step(params, opt_state, data):
+        loss, grads = jax.value_and_grad(loss_fn)(params, data)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    compiled = jax.jit(step).lower(params, opt_state, data).compile()
+    params, opt_state, loss = compiled(params, opt_state, data)
+    float(loss)
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        params, opt_state, loss = compiled(params, opt_state, data)
+    final = float(loss)
+    return 1000 * (time.perf_counter() - t0) / n_steps, final
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=10)
+    ap.add_argument("--batch", type=int, default=256)
+    ap.add_argument("--only", default="",
+                    help="comma-separated variant subset")
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from edl_tpu.models import resnet
+
+    try:
+        os.makedirs("/tmp/edl-bench-cache", exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", "/tmp/edl-bench-cache")
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    except Exception:
+        pass
+
+    dev = jax.devices()[0]
+    print(f"# platform={dev.platform} kind={dev.device_kind}", flush=True)
+
+    cfg = resnet.RESNET50
+    b, hw = args.batch, 224
+    images = jax.random.normal(jax.random.key(0), (b, hw, hw, 3)
+                               ).astype(cfg.dtype)
+    labels = jax.random.randint(jax.random.key(1), (b,), 0,
+                                cfg.num_classes, dtype=jnp.int32)
+    params = resnet.init(jax.random.key(2), cfg)
+    data = (images, labels)
+
+    orig_gn = resnet._group_norm
+
+    def gn_onepass(x, p, groups, eps=1e-5):
+        bb, h, w, c = x.shape
+        g = x.reshape(bb, h, w, groups, c // groups)
+        g32 = g.astype(jnp.float32)
+        mean = jnp.mean(g32, axis=(1, 2, 4), keepdims=True)
+        mean2 = jnp.mean(g32 * g32, axis=(1, 2, 4), keepdims=True)
+        inv = jax.lax.rsqrt(jnp.maximum(mean2 - mean * mean, 0.0) + eps)
+        y = (g32 - mean) * inv
+        return (y.reshape(bb, h, w, c) * p["scale"]
+                + p["bias"]).astype(x.dtype)
+
+    def gn_bf16_out(x, p, groups, eps=1e-5):
+        bb, h, w, c = x.shape
+        g = x.reshape(bb, h, w, groups, c // groups)
+        g32 = g.astype(jnp.float32)
+        mean = jnp.mean(g32, axis=(1, 2, 4), keepdims=True)
+        mean2 = jnp.mean(g32 * g32, axis=(1, 2, 4), keepdims=True)
+        inv = jax.lax.rsqrt(jnp.maximum(mean2 - mean * mean, 0.0) + eps)
+        # fold (mean, inv, scale, bias) into one bf16 multiply-add over x
+        scale = (inv.astype(x.dtype)
+                 * p["scale"].astype(x.dtype).reshape(1, 1, 1, groups, -1))
+        shift = (p["bias"].astype(x.dtype).reshape(1, 1, 1, groups, -1)
+                 - (mean * inv).astype(x.dtype)
+                 * p["scale"].astype(x.dtype).reshape(1, 1, 1, groups, -1))
+        return (g * scale + shift).reshape(bb, h, w, c)
+
+    def gn_none(x, p, groups, eps=1e-5):
+        return x * p["scale"].astype(x.dtype) + p["bias"].astype(x.dtype)
+
+    def s2d_loss_fn(cfg):
+        # 4x4 space-to-depth: [b,224,224,3] -> [b,56,56,48]; the stem
+        # becomes a 2x2 conv over 48 channels (dense on MXU lanes) with
+        # the same receptive-field stride product (7x7 s2 + 3x3 maxpool
+        # s2 ~ 56x56 output); here: s2d + 2x2 s1 conv -> 56x56x64
+        import functools
+
+        w_key = jax.random.key(9)
+        stem48 = (jax.random.normal(w_key, (2, 2, 48, cfg.width),
+                                    jnp.float32)
+                  * (2.0 / (2 * 2 * 48)) ** 0.5)
+
+        def apply_s2d(p, imgs):
+            x = imgs.astype(cfg.dtype)
+            bb, h, w, c = x.shape
+            x = x.reshape(bb, h // 4, 4, w // 4, 4, c)
+            x = x.transpose(0, 1, 3, 2, 4, 5).reshape(bb, h // 4, w // 4,
+                                                      48)
+            x = jax.lax.conv_general_dilated(
+                x, p["stem48"].astype(x.dtype), (1, 1), "SAME",
+                dimension_numbers=("NHWC", "HWIO", "NHWC"))
+            x = jax.nn.relu(resnet._group_norm(x, p["stem_norm"],
+                                               cfg.groups))
+            for stage, blocks in enumerate(p["stages"]):
+                for bi, blk in enumerate(blocks):
+                    stride = 2 if (stage > 0 and bi == 0) else 1
+                    x = resnet._bottleneck(x, blk, cfg.groups, stride)
+            x = jnp.mean(x, axis=(1, 2))
+            return (x @ p["head"].astype(x.dtype)
+                    + p["head_bias"]).astype(jnp.float32)
+
+        def loss(p, batch):
+            imgs, lbls = batch
+            logp = jax.nn.log_softmax(apply_s2d(p, imgs), axis=-1)
+            return -jnp.mean(jnp.take_along_axis(logp, lbls[:, None],
+                                                 axis=1))
+
+        p2 = dict(params)
+        p2["stem48"] = stem48
+        return functools.partial(loss), p2
+
+    variants = {}
+    variants["baseline"] = (resnet.make_loss_fn(cfg), params, False, None)
+    variants["fwd_only"] = (resnet.make_loss_fn(cfg), params, True, None)
+    variants["no_norm"] = (resnet.make_loss_fn(cfg), params, False, gn_none)
+    variants["gn_onepass"] = (resnet.make_loss_fn(cfg), params, False,
+                              gn_onepass)
+    variants["gn_bf16_out"] = (resnet.make_loss_fn(cfg), params, False,
+                               gn_bf16_out)
+    s2d_loss, s2d_params = s2d_loss_fn(cfg)
+    variants["s2d_stem"] = (s2d_loss, s2d_params, False, None)
+
+    only = set(filter(None, args.only.split(",")))
+    results = {}
+    for name, (loss_fn, ps, fwd, gn) in variants.items():
+        if only and name not in only:
+            continue
+        resnet._group_norm = gn if gn is not None else orig_gn
+        try:
+            ms, final = timed(loss_fn, ps, data, args.steps, fwd_only=fwd)
+            results[name] = {"step_ms": round(ms, 1),
+                             "img_s": round(1000 * b / ms, 1),
+                             "final_loss": round(final, 3)}
+            print(f"{name:12s} {ms:8.1f} ms/step "
+                  f"{1000 * b / ms:8.1f} img/s", flush=True)
+        except Exception as exc:
+            results[name] = {"error": str(exc)[:200]}
+            print(f"{name:12s} ERROR {str(exc)[:160]}", flush=True)
+        finally:
+            resnet._group_norm = orig_gn
+    print(json.dumps(results))
+
+
+if __name__ == "__main__":
+    main()
