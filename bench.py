@@ -1117,7 +1117,10 @@ def tpu_world_cycle_leg() -> dict:
             steps_now = open(log).read().count("] step ")
             _wait_log(log, lambda t: t.count("] step ") > steps_now
                       or exited(), 300)
-        med = lambda xs: round(float(np.median(xs)), 2) if xs else None
+        import statistics
+
+        med = lambda xs: (round(statistics.median(xs), 2)  # noqa: E731
+                          if xs else None)
         out["cycles"] = len(totals_s)
         out["reacquire_samples_s"] = reacquire_s
         out["reform_samples_s"] = reform_s
