@@ -319,7 +319,7 @@ def build_parser() -> argparse.ArgumentParser:
     c = sub.add_parser("launch", help="pod-role entrypoint")
     c.add_argument("verb",
                    choices=["start_coordinator", "start_trainer",
-                            "start_pserver"])
+                            "start_static_trainer", "start_pserver"])
     c.add_argument("rest", nargs="*")
     c.set_defaults(fn=cmd_launch)
 

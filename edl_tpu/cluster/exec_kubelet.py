@@ -157,7 +157,10 @@ class ProcessKubelet:
         container = tmpl["containers"][0]
         return {
             "command": list(container["command"]),
-            "env": {e["name"]: e["value"] for e in container.get("env", [])},
+            # valueFrom (downward-API) entries carry no literal value —
+            # this kubelet injects the pod identity itself in _pod_env
+            "env": {e["name"]: e["value"]
+                    for e in container.get("env", []) if "value" in e},
             "volumes": [v["name"] for v in tmpl.get("volumes", [])],
             "mounts": {m["name"]: m["mountPath"]
                        for m in container.get("volumeMounts", [])},

@@ -43,6 +43,9 @@ class PodView:
     cpu_request_milli: int = 0
     memory_request_mega: int = 0
     tpu_limit: int = 0
+    #: pod IP — the static path's rendezvous address (role of the
+    #: reference's fetch_ips, docker/k8s_tools.py:95-110)
+    ip: str = ""
 
 try:
     import kubernetes  # type: ignore
@@ -407,6 +410,7 @@ class K8sCluster(Cluster):
                 cpu_request_milli=creq,
                 memory_request_mega=mreq,
                 tpu_limit=tl,
+                ip=getattr(pod.status, "pod_ip", None) or "",
             ))
         return out
 

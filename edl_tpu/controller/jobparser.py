@@ -34,6 +34,17 @@ from edl_tpu.api.types import (
 COORDINATOR_PORT = DEFAULT_PORT  # single source of truth (api/types.py)
 HEALTH_PORT = 8080  # role of the master's 8080 (reference jobparser.go:249-261)
 
+#: downward-API pod identity (role of the reference's NAMESPACE/POD_IP
+#: fieldRefs, pkg/jobparser.go:263-311).  HOSTNAME is NOT a substitute:
+#: under spec.host_network it is the node's hostname, so the static
+#: path's rank lookup would use the wrong identity.
+_DOWNWARD_ENV = [
+    {"name": "EDL_POD_NAME",
+     "valueFrom": {"fieldRef": {"fieldPath": "metadata.name"}}},
+    {"name": "EDL_POD_IP",
+     "valueFrom": {"fieldRef": {"fieldPath": "status.podIP"}}},
+]
+
 
 def _trainer_labels(job: TrainingJob) -> dict[str, str]:
     labels = {TRAINER_LABEL: job.name}
@@ -127,13 +138,20 @@ def parse_to_trainer(job: TrainingJob) -> dict[str, Any]:
                         {
                             "name": "trainer",
                             "image": spec.image,
+                            # FT jobs take the coordinator-backed elastic
+                            # path; non-FT jobs take the static barrier
+                            # path (rank from the sorted pod list) — the
+                            # reference's start_new_trainer vs start_trainer
+                            # v2 switch (pkg/jobparser.go:124)
                             "command": ["python", "-m",
                                         "edl_tpu.runtime.launcher",
-                                        "start_trainer"],
+                                        "start_trainer"
+                                        if spec.fault_tolerant
+                                        else "start_static_trainer"],
                             "env": [
                                 {"name": k, "value": v}
                                 for k, v in pod_env(job, "trainer").items()
-                            ],
+                            ] + list(_DOWNWARD_ENV),
                             "resources": _resources_dict(spec.trainer.resources),
                         }
                     ],
@@ -255,7 +273,7 @@ def parse_to_pserver(job: TrainingJob) -> dict[str, Any] | None:
                             "env": [
                                 {"name": k, "value": v}
                                 for k, v in pod_env(job, "pserver").items()
-                            ],
+                            ] + list(_DOWNWARD_ENV),
                             "resources": _resources_dict(spec.pserver.resources),
                         }
                     ],

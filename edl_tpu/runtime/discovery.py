@@ -185,17 +185,30 @@ class PodDiscovery:
                     f"waited {timeout_s}s for {n} running pods, have {running}")
             time.sleep(self._poll_s)
 
-    def fetch_addresses(self) -> list[str]:
-        """Sorted Running-pod names/addresses (k8s_tools.py:95-110)."""
+    def snapshot_running(self) -> list[tuple[str, str]]:
+        """ONE consistent view of the live peer set: sorted (name, addr)
+        for pods that are Running and not Terminating.  The barrier, the
+        rank, and the peer addresses must all derive from the same
+        snapshot with the same filter, or a pod deleted during startup
+        makes EDL_TRAINERS disagree with EDL_TRAINER_ADDRESSES and ranks
+        collide across peers.  addr = pod IP when the backend provides
+        one (the reference's fetch_ips, k8s_tools.py:95-110), else the
+        pod name (in-process fakes)."""
         return sorted(
-            p.name for p in self._pods() if p.phase == PodPhase.RUNNING)
+            (p.name, getattr(p, "ip", "") or p.name)
+            for p in self._pods()
+            if p.phase == PodPhase.RUNNING and not p.deletion_timestamp)
+
+    def fetch_addresses(self) -> list[str]:
+        """Sorted Running-pod addresses (k8s_tools.py:95-110)."""
+        return [addr for _name, addr in self.snapshot_running()]
 
     def fetch_rank(self, my_name: str) -> int:
         """Reference fetch_id semantics (k8s_tools.py:113-121) — kept for
         the static (non-fault-tolerant) path only; elastic jobs use
         :meth:`CoordDiscovery.rank_and_world`."""
-        addrs = self.fetch_addresses()
+        names = [n for n, _addr in self.snapshot_running()]
         try:
-            return addrs.index(my_name)
+            return names.index(my_name)
         except ValueError:
-            raise RuntimeError(f"{my_name!r} not among running pods {addrs}")
+            raise RuntimeError(f"{my_name!r} not among running pods {names}")

@@ -220,16 +220,28 @@ def start_static_trainer(
 ) -> int:
     """Static (non-fault-tolerant) path (role of start_trainer v2,
     reference paddle_k8s:143-226): barrier on the exact trainer count,
-    rank from the sorted running-pod list, zero failure budget."""
+    rank from the sorted running-pod list, zero failure budget.
+
+    Barrier, rank and peer addresses all come from ONE
+    ``snapshot_running`` view — separate list calls with different
+    filters let a pod deleted mid-startup desynchronize them."""
     if check_failed_cnt(discovery, 0):
         return 1
-    discovery.wait_pods_running(n_trainers, wait_timeout_s)
-    rank = discovery.fetch_rank(my_name)
-    peers = discovery.fetch_addresses()
+    deadline = time.monotonic() + wait_timeout_s
+    while True:
+        snap = discovery.snapshot_running()
+        names = [n for n, _a in snap]
+        if len(snap) >= n_trainers and my_name in names:
+            break
+        if time.monotonic() >= deadline:
+            log.error("static barrier timed out",
+                      have=len(snap), want=n_trainers, me=my_name)
+            return 1
+        time.sleep(1.0)
     return run_entry(entry, workspace, {
-        "EDL_TRAINER_ID": str(rank),
-        "EDL_TRAINERS": str(n_trainers),
-        "EDL_TRAINER_ADDRESSES": ",".join(peers),
+        "EDL_TRAINER_ID": str(names.index(my_name)),
+        "EDL_TRAINERS": str(len(snap)),
+        "EDL_TRAINER_ADDRESSES": ",".join(a for _n, a in snap),
     })
 
 
@@ -255,6 +267,46 @@ def resolve_coordinator_endpoint(env, default_port: int) -> tuple[str, int]:
         "name for fault-tolerant jobs")
 
 
+class _EnvPeersLister:
+    """Pod 'listing' from EDL_STATIC_PEERS="name[=addr],name[=addr],..."
+    — the discovery backend for environments without a kubernetes client
+    (the process-backed kubelet harness, unit tests, bare-metal runs with
+    a pre-agreed peer set).  Every listed peer is Running."""
+
+    def __init__(self, spec: str, job_uid: str) -> None:
+        from edl_tpu.cluster.k8s import PodView
+        from edl_tpu.cluster.base import PodPhase
+
+        self._pods = []
+        for item in filter(None, (s.strip() for s in spec.split(","))):
+            name, _, addr = item.partition("=")
+            self._pods.append(PodView(
+                name=name, job_uid=job_uid, role="trainer",
+                phase=PodPhase.RUNNING, ip=addr))
+
+    def list_pods(self, job_uid=None, role=None):
+        return list(self._pods)
+
+
+def _pod_discovery_from_env(env) -> PodDiscovery:
+    """Pod-list discovery for the static path, from the EDL_* contract
+    (role of the in-cluster k8s_tools calls, reference paddle_k8s:143-226).
+    EDL_STATIC_PEERS (explicit peer set) takes precedence; otherwise the
+    in-cluster kubernetes client.  Split out so tests can monkeypatch."""
+    ns = env.get("EDL_NAMESPACE", "default")
+    job = env.get("EDL_JOB_NAME", "")
+    if not job:
+        raise ValueError("EDL_JOB_NAME not set; the jobparser always "
+                         "emits it for trainer pods")
+    peers = env.get("EDL_STATIC_PEERS", "")
+    if peers:
+        return PodDiscovery(_EnvPeersLister(peers, f"{ns}/{job}"),
+                            f"{ns}/{job}")
+    from edl_tpu.cluster.k8s import K8sCluster
+
+    return PodDiscovery(K8sCluster(namespace=ns), f"{ns}/{job}")
+
+
 # -- env-reading shell (the container's actual command) ----------------------
 
 def main(argv: list[str] | None = None) -> int:
@@ -264,7 +316,8 @@ def main(argv: list[str] | None = None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     if not argv:
         print("usage: launcher "
-              "{start_coordinator|start_trainer|start_pserver}",
+              "{start_coordinator|start_trainer|start_static_trainer|"
+              "start_pserver}",
               file=sys.stderr)
         return 2
     verb = argv[0]
@@ -272,6 +325,23 @@ def main(argv: list[str] | None = None) -> int:
     default_port = int(env.get("EDL_COORD_PORT", "7164"))
     if verb == "start_coordinator":
         return start_coordinator(default_port, argv[1:])
+    if verb == "start_static_trainer":
+        # non-FT pods (jobparser emits this verb when fault_tolerant is
+        # off): barrier on the exact trainer count via the pod API —
+        # no coordinator exists for these jobs
+        try:
+            discovery = _pod_discovery_from_env(env)
+        except Exception as exc:
+            print(f"error: {exc}", file=sys.stderr)
+            return 2
+        return start_static_trainer(
+            discovery=discovery,
+            n_trainers=int(env.get("EDL_TRAINER_MIN", "1")),
+            my_name=env.get("EDL_POD_NAME",
+                            env.get("HOSTNAME", "")),
+            entry=env.get("EDL_ENTRY", ""),
+            workspace=env.get("EDL_TRAINER_PACKAGE", ""),
+        )
     if verb in ("start_trainer", "start_pserver"):
         try:
             host, port = resolve_coordinator_endpoint(env, default_port)

@@ -198,7 +198,8 @@ def test_manifests_order_and_shape():
     # points it at the coordinator Service
     assert pod["containers"][0]["command"][-2:] == \
         ["edl_tpu.runtime.launcher", "start_trainer"]
-    env = {e["name"]: e["value"] for e in pod["containers"][0]["env"]}
+    env = {e["name"]: e["value"] for e in pod["containers"][0]["env"]
+           if "value" in e}  # downward-API entries have valueFrom
     assert env["EDL_COORD_ENDPOINT"].startswith("j-coordinator.default.svc:")
 
 

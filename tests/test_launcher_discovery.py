@@ -258,3 +258,95 @@ class TestLauncher:
             client.close()
         finally:
             handle.stop()
+
+
+class TestStaticVerbDispatch:
+    def test_jobparser_emits_static_verb_for_non_ft(self):
+        """The reference switches start_new_trainer vs start_trainer v2 on
+        fault_tolerant (pkg/jobparser.go:124); the compiled command must
+        switch the same way."""
+        from edl_tpu.api.types import (ResourceRequirements, TrainerSpec,
+                                       TrainingJob, TrainingJobSpec)
+        from edl_tpu.controller.jobparser import parse_to_trainer
+
+        def job(ft):
+            return TrainingJob(name="j", spec=TrainingJobSpec(
+                fault_tolerant=ft,
+                trainer=TrainerSpec(entrypoint="true", min_instance=2,
+                                    max_instance=2,
+                                    resources=ResourceRequirements())))
+
+        cmd_ft = parse_to_trainer(job(True))["spec"]["template"]["spec"][
+            "containers"][0]["command"]
+        cmd_static = parse_to_trainer(job(False))["spec"]["template"][
+            "spec"]["containers"][0]["command"]
+        assert cmd_ft[-1] == "start_trainer"
+        assert cmd_static[-1] == "start_static_trainer"
+
+    def test_main_static_trainer_runs_entry_with_rank(self, tmp_path,
+                                                      monkeypatch):
+        """`launcher start_static_trainer` under the EDL_* env contract:
+        barrier on the pod count, rank from the sorted pod list, entry
+        exec'd with EDL_TRAINER_ID/TRAINERS/ADDRESSES exported."""
+        from edl_tpu.cluster.base import PodPhase
+        from edl_tpu.cluster.fake import FakeCluster, FakePod
+        from edl_tpu.runtime.discovery import PodDiscovery
+
+        fake = FakeCluster()
+        for i in range(2):
+            fake._pods[f"j-trainer-{i}"] = FakePod(
+                name=f"j-trainer-{i}", job_uid="default/j", role="trainer",
+                phase=PodPhase.RUNNING, node="n0")
+        monkeypatch.setattr(
+            launcher, "_pod_discovery_from_env",
+            lambda env: PodDiscovery(fake, "default/j"))
+        out = tmp_path / "env.txt"
+        monkeypatch.setenv("EDL_JOB_NAME", "j")
+        monkeypatch.setenv("EDL_POD_NAME", "j-trainer-1")
+        monkeypatch.setenv("EDL_TRAINER_MIN", "2")
+        monkeypatch.setenv(
+            "EDL_ENTRY",
+            f'echo "$EDL_TRAINER_ID/$EDL_TRAINERS $EDL_TRAINER_ADDRESSES"'
+            f' > {out}')
+        assert launcher.main(["start_static_trainer"]) == 0
+        text = out.read_text()
+        assert "1/2" in text
+        assert "j-trainer-0,j-trainer-1" in text
+
+    def test_main_static_trainer_env_peers_backend(self, tmp_path,
+                                                   monkeypatch):
+        """EDL_STATIC_PEERS gives the static path a discovery backend
+        without a kubernetes client (harness / bare-metal runs): rank
+        from the sorted names, addresses from the peer spec."""
+        out = tmp_path / "env.txt"
+        monkeypatch.setenv("EDL_JOB_NAME", "j")
+        monkeypatch.setenv("EDL_POD_NAME", "p-b")
+        monkeypatch.setenv("EDL_TRAINER_MIN", "2")
+        monkeypatch.setenv("EDL_STATIC_PEERS",
+                           "p-b=10.0.0.2,p-a=10.0.0.1")
+        monkeypatch.setenv(
+            "EDL_ENTRY",
+            f'echo "$EDL_TRAINER_ID/$EDL_TRAINERS $EDL_TRAINER_ADDRESSES"'
+            f' > {out}')
+        assert launcher.main(["start_static_trainer"]) == 0
+        assert out.read_text().strip() == "1/2 10.0.0.1,10.0.0.2"
+
+    def test_trainer_manifest_carries_downward_identity(self):
+        """EDL_POD_NAME/EDL_POD_IP come from the downward API — HOSTNAME
+        is the node's name under hostNetwork and cannot be the identity."""
+        from edl_tpu.api.types import (ResourceRequirements, TrainerSpec,
+                                       TrainingJob, TrainingJobSpec)
+        from edl_tpu.controller.jobparser import parse_to_trainer
+
+        job = TrainingJob(name="j", spec=TrainingJobSpec(
+            fault_tolerant=False, host_network=True,
+            trainer=TrainerSpec(entrypoint="true", min_instance=1,
+                                max_instance=1,
+                                resources=ResourceRequirements())))
+        env = parse_to_trainer(job)["spec"]["template"]["spec"][
+            "containers"][0]["env"]
+        by_name = {e["name"]: e for e in env}
+        assert by_name["EDL_POD_NAME"]["valueFrom"][
+            "fieldRef"]["fieldPath"] == "metadata.name"
+        assert by_name["EDL_POD_IP"]["valueFrom"][
+            "fieldRef"]["fieldPath"] == "status.podIP"
