@@ -197,6 +197,23 @@ class ProcessKubelet:
             host, sep, port = ep.rpartition(":")
             env["EDL_COORD_ENDPOINT"] = (
                 f"127.0.0.1:{port}" if sep and port.isdigit() else "127.0.0.1")
+        # pod-API emulation for the static path: the launcher's
+        # kubernetes-client discovery has no apiserver here, so hand it
+        # the job's trainer pod set explicitly (launcher EDL_STATIC_PEERS
+        # backend).  All pods run on this machine — name doubles as addr.
+        if (pod.role == "trainer"
+                and container["command"][-1] == "start_static_trainer"):
+            from edl_tpu.cluster.base import PodPhase
+
+            # LIVE pods only: a crashed trainer must not appear in its
+            # replacement's frozen peer list (the env backend cannot
+            # re-observe phases later — the non-FT updater's any-failure-
+            # is-fatal rule is what ultimately enforces the zero budget)
+            peers = sorted(p.name for p in self.cluster.list_pods(
+                job_uid=pod.job_uid, role="trainer")
+                if not p.deletion_timestamp
+                and p.phase in (PodPhase.PENDING, PodPhase.RUNNING))
+            env.setdefault("EDL_STATIC_PEERS", ",".join(peers))
         # pod identity (downward API / pod hostname)
         env["EDL_POD_NAME"] = pod.name
         env["HOSTNAME"] = pod.name

@@ -231,7 +231,11 @@ def start_static_trainer(
     while True:
         snap = discovery.snapshot_running()
         names = [n for n, _a in snap]
-        if len(snap) >= n_trainers and my_name in names:
+        # EXACT count (the reference's barrier): ">=" would let two pods
+        # pass with different-sized snapshots during churn and disagree
+        # on world size; with "==", every pod that passes saw the same
+        # n_trainers-member set
+        if len(snap) == n_trainers and my_name in names:
             break
         if time.monotonic() >= deadline:
             log.error("static barrier timed out",
@@ -240,7 +244,7 @@ def start_static_trainer(
         time.sleep(1.0)
     return run_entry(entry, workspace, {
         "EDL_TRAINER_ID": str(names.index(my_name)),
-        "EDL_TRAINERS": str(len(snap)),
+        "EDL_TRAINERS": str(n_trainers),
         "EDL_TRAINER_ADDRESSES": ",".join(a for _n, a in snap),
     })
 
@@ -271,7 +275,11 @@ class _EnvPeersLister:
     """Pod 'listing' from EDL_STATIC_PEERS="name[=addr],name[=addr],..."
     — the discovery backend for environments without a kubernetes client
     (the process-backed kubelet harness, unit tests, bare-metal runs with
-    a pre-agreed peer set).  Every listed peer is Running."""
+    a pre-agreed peer set).  Every listed peer is reported Running: a
+    static declaration carries no live phase, so the failed-count guard
+    cannot fire through this backend — failure budgeting falls to the
+    control plane (the non-FT updater fails the job on ANY failed
+    trainer, controller/updater.py convert)."""
 
     def __init__(self, spec: str, job_uid: str) -> None:
         from edl_tpu.cluster.k8s import PodView

@@ -246,3 +246,76 @@ def test_cr_to_supervised_world_end_to_end(kube, tmp_path):
         sync.stop()
         controller.stop()
         kubelet.stop()
+
+
+def test_static_non_ft_job_runs_through_kubelet(tmp_path):
+    """A NON-fault-tolerant job through the same deployed path: the
+    jobparser emits `launcher start_static_trainer`, the kubelet execs
+    it with the job's peer set, every pod computes its rank from the
+    sorted pod list, runs the entry, and the job Succeeds (role of the
+    reference's start_trainer v2, docker/paddle_k8s:143-226)."""
+    from edl_tpu.api.serde import job_from_dict
+    from edl_tpu.api.types import JobPhase
+    from edl_tpu.controller.controller import Controller
+
+    fake = FakeCluster()
+    fake.add_node("host0", cpu_milli=16000, memory_mega=16000, tpu_chips=8)
+    controller = Controller(fake, updater_convert_seconds=0.3,
+                            updater_confirm_seconds=0.2)
+    work = str(tmp_path)
+    kubelet = ProcessKubelet(fake, work, env_overrides={
+        "JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": "",
+    })
+    ranks = os.path.join(work, "ranks")
+    os.makedirs(ranks, exist_ok=True)
+    job = job_from_dict({
+        "apiVersion": "edl.tpu/v1", "kind": "TrainingJob",
+        "metadata": {"name": "static"},
+        "spec": {
+            "image": "edl-tpu-job:latest",
+            "fault_tolerant": False,
+            "trainer": {
+                # each pod records its rank/world and peer list, then
+                # exits 0 — the work-queue Job completes
+                "entrypoint": (
+                    f'echo "$EDL_TRAINER_ID/$EDL_TRAINERS '
+                    f'$EDL_TRAINER_ADDRESSES" '
+                    f'> {ranks}/$EDL_POD_NAME && sleep 0.5'),
+                "min_instance": 3, "max_instance": 3,
+                "resources": {"requests": {"cpu": "500m",
+                                           "memory": "256Mi"},
+                              "limits": {"cpu": "1", "memory": "512Mi",
+                                         "google.com/tpu": "1"}},
+            },
+        },
+    })
+    try:
+        controller.submit(job)
+        updater = controller.get_updater(job)
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            if updater.job.status.phase in (JobPhase.SUCCEEDED,
+                                            JobPhase.FAILED):
+                break
+            time.sleep(0.25)
+        assert updater.job.status.phase == JobPhase.SUCCEEDED, (
+            updater.job.status)
+        files = sorted(os.listdir(ranks))
+        assert len(files) == 3, files
+        seen = {}
+        for f in files:
+            rank_world, peers = open(os.path.join(ranks, f)
+                                     ).read().split(" ", 1)
+            rank, world = rank_world.split("/")
+            seen[f] = (int(rank), int(world), peers.strip())
+        # ranks are exactly 0..2, every pod agrees on world and peers
+        assert sorted(r for r, _w, _p in seen.values()) == [0, 1, 2]
+        assert {w for _r, w, _p in seen.values()} == {3}
+        assert len({p for _r, _w, p in seen.values()}) == 1
+        # rank = index of my pod in the shared sorted peer list
+        peers = next(iter(seen.values()))[2].split(",")
+        for f, (rank, _w, _p) in seen.items():
+            assert peers[rank] == f
+    finally:
+        controller.stop()
+        kubelet.stop()
