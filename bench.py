@@ -370,7 +370,11 @@ def _timed_train_step(cfg, batch: int, seq: int, n_steps: int,
     tokens = jax.random.randint(jax.random.key(1), (batch, seq), 0,
                                 cfg.vocab_size, dtype=jnp.int32)
     data = (tokens, jnp.roll(tokens, -1, axis=1))
-    compiled = (jax.jit(make_step(loss_fn))
+    # donate params+opt state: the standard training-loop idiom (the old
+    # buffers die at reassignment anyway).  Step time is unchanged at
+    # flagship dims (147.5k vs 148.0k tok/s — noise), but the freed
+    # aliasing lowers transient HBM pressure for the big configs
+    compiled = (jax.jit(make_step(loss_fn), donate_argnums=(0, 1))
                 .lower(params, opt_state, data).compile())
 
     out = {"batch": batch, "seq": seq, "n_steps": n_steps}
@@ -534,7 +538,11 @@ def _timed_generic_step(loss_fn, params, data, n_steps: int,
     """Compile + warm + time an adamw step for any (loss_fn, params, data)
     — the non-transformer twin of _timed_train_step: same float(loss)
     fence; FLOPs from cost_analysis of the executed compile (convs and
-    dense attention are visible to it — nothing here uses pallas)."""
+    dense attention are visible to it — nothing here uses pallas).
+
+    CONSUMES ``params``: the step donates param/opt buffers (training-
+    loop idiom), so the caller's tree is invalid afterwards — re-init
+    before reusing (the resnet OOM fallback does)."""
     import jax
     import optax
 
@@ -546,7 +554,8 @@ def _timed_generic_step(loss_fn, params, data, n_steps: int,
         updates, opt_state = optimizer.update(grads, opt_state, params)
         return optax.apply_updates(params, updates), opt_state, loss
 
-    compiled = jax.jit(train_step).lower(params, opt_state, data).compile()
+    compiled = (jax.jit(train_step, donate_argnums=(0, 1))
+                .lower(params, opt_state, data).compile())
     cost = compiled.cost_analysis()
     if isinstance(cost, (list, tuple)):
         cost = cost[0] if cost else {}
@@ -618,6 +627,9 @@ def model_zoo_leg() -> dict:
                    or "HBM" in msg)
         if on_tpu and (mem_sig or "remote_compile" in msg):
             batch, images, labels = 128, images[:128], labels[:128]
+            # fresh params: if the failed attempt got past compile, its
+            # donated param buffers are already invalidated
+            rparams = resnet.init(jax.random.key(2), rcfg)
             try:
                 m = _timed_generic_step(resnet.make_loss_fn(rcfg), rparams,
                                         (images, labels), n_steps)

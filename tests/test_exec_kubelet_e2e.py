@@ -390,7 +390,9 @@ def test_coordinator_pod_respawn_preserves_state(tmp_path):
 
         # record real progress BEFORE the kill, then kill -9 the
         # coordinator pod's process group
+        deadline = time.monotonic() + 180
         while raw_stats().done == 0:
+            assert time.monotonic() < deadline, "no shard ever completed"
             time.sleep(0.3)
         done_before = raw_stats().done
         assert done_before > 0
@@ -413,21 +415,25 @@ def test_coordinator_pod_respawn_preserves_state(tmp_path):
         after = raw_stats(timeout_s=30.0)
         assert after.done >= done_before, (after, done_before)
 
+        # wait for the FULL drain while the coordinator is guaranteed
+        # alive (workers only exit after the queue is done, so observing
+        # done==64 here cannot race the post-success teardown), THEN for
+        # the phase machine to record the success
         updater = controller.get_updater(job)
         final = after
         deadline = time.monotonic() + 420
+        while final.done < 64 and time.monotonic() < deadline:
+            time.sleep(0.3)
+            final = raw_stats(timeout_s=30.0)
+        assert final.done == 64 and final.dropped == 0, final
+        deadline = time.monotonic() + 120
         while time.monotonic() < deadline:
-            try:
-                final = raw_stats(timeout_s=1.0)
-            except (OSError, CoordError):
-                pass  # teardown after success races the poll
             if updater.job.status.phase in (JobPhase.SUCCEEDED,
                                             JobPhase.FAILED):
                 break
             time.sleep(0.3)
         assert updater.job.status.phase == JobPhase.SUCCEEDED, (
             updater.job.status)
-        assert final.done == 64 and final.dropped == 0, final
     finally:
         controller.stop()
         kubelet.stop()
