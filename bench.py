@@ -764,16 +764,20 @@ def long_context_leg() -> dict:
         # dominate at this length — "full" stays); at 32k, no-remat
         # batch 1 (38k tok/s) beats remat batch 2 (31k) and remat batch 4
         # OOMs — the recorded configs are the measured knees.
-        try:
-            k64 = _timed_train_step(
-                dataclasses.replace(base, max_seq_len=65_536, remat=True),
-                1, 65_536, n_steps=2)
-            out["context_64k_remat"] = {
-                "tokens_per_second": k64["tokens_per_second"],
-                "step_ms": k64["step_ms"],
-            }
-        except Exception as exc:  # record the failure, never lose the leg
-            out["context_64k_remat"] = {"error": str(exc)[:200]}
+        # 80k is the single-chip ceiling after r5's buffer donation freed
+        # the update-step's transient copies (64k was the r4 max; 96k and
+        # 128k still exhaust HBM — measured)
+        for deep_seq, key in ((65_536, "context_64k_remat"),
+                              (81_920, "context_80k_remat")):
+            try:
+                k = _timed_train_step(
+                    dataclasses.replace(base, max_seq_len=deep_seq,
+                                        remat=True),
+                    1, deep_seq, n_steps=2)
+                out[key] = {"tokens_per_second": k["tokens_per_second"],
+                            "step_ms": k["step_ms"]}
+            except Exception as exc:  # record failure, never lose the leg
+                out[key] = {"error": str(exc)[:200]}
     return out
 
 
@@ -1228,7 +1232,7 @@ def main() -> None:
         zoo = {"error": "skipped: backend probe failed"}
         tpu_cycle = {"error": "skipped: backend probe failed"}
     else:
-        long_ctx = _run_leg("long_context", timeout_s=600)
+        long_ctx = _run_leg("long_context", timeout_s=900)
         large = _run_leg("large", timeout_s=600)
         # ResNet-50 + BERT-base step numbers (BASELINE configs 2/3/5)
         zoo = _run_leg("model_zoo", timeout_s=600)
@@ -1291,6 +1295,8 @@ def main() -> None:
         "large_mfu_pct": large.get("mfu_pct"),
         "long_ctx_8k_tok_s": long_ctx.get("tokens_per_second"),
         "flash_speedup_vs_xla": long_ctx.get("speedup_vs_xla_attention"),
+        "context_80k_tok_s": (long_ctx.get("context_80k_remat")
+                              or {}).get("tokens_per_second"),
         "resnet50_mfu_pct": (zoo.get("resnet50") or {}).get("mfu_pct"),
         "resnet50_img_s": (zoo.get("resnet50") or {}).get("images_per_second"),
         "resnet50_tpu_stem_mfu_pct": (zoo.get("resnet50_tpu")
