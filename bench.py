@@ -883,7 +883,13 @@ def elastic_leg() -> dict:
         # its pre window is the first loss (ratio ~1 by construction)
         pre = max(float(pre_win.mean()) if len(pre_win) else float(losses[0]),
                   floor)
-        post = max(float(losses[b:b + 5].mean()), floor)
+        # the post window is empty too when the resize landed at the final
+        # completed step — fall back to the last loss like the pre window
+        # falls back to the first, so the ratio (and json.dumps) never
+        # sees NaN (ADVICE r5 item 1)
+        post_win = losses[b:b + 5]
+        post = max(float(post_win.mean()) if len(post_win)
+                   else float(losses[-1]), floor)
         ratios.append(post / pre)
     if len(ratios) != report.resizes:  # the leg must evidence every resize
         raise RuntimeError(

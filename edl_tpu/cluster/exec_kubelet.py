@@ -124,18 +124,20 @@ class ProcessKubelet:
         """Tear the kubelet down: kill every pod process group."""
         self._stop.set()
         with self._lock:
-            procs = dict(self._procs)
-        for name, proc in procs.items():
-            if proc.poll() is None:
-                try:
-                    os.killpg(proc.pid, signal.SIGKILL)
-                except ProcessLookupError:
-                    pass
-            try:
-                proc.wait(timeout=10)
-            except subprocess.TimeoutExpired:
-                log.error("pod process unreapable", pod=name, pid=proc.pid)
+            names = list(self._procs)
+        for name in names:
+            self._kill_registered(name)
         self._reaper.join(timeout=5)
+        # An in-flight _start_pod may have passed its _stop check before
+        # set() above and registered a fresh process AFTER the sweep; with
+        # the reaper gone nothing else would ever reap it (ADVICE r5
+        # item 2).  The reaper has exited here, so re-sweep whatever is
+        # still registered.
+        with self._lock:
+            leaked = [n for n, p in self._procs.items() if p.poll() is None]
+        for name in leaked:
+            log.warn("reaping pod spawned during teardown", pod=name)
+            self._kill_registered(name)
         self.cluster.pod_event_hook = self._prev_hook
         self.cluster.materialize_aux_pods = self._prev_aux
 
@@ -264,8 +266,31 @@ class ProcessKubelet:
             logf.close()  # the child holds its own fd now
         with self._lock:
             self._procs[pod.name] = proc
+        # stop() may have run between the _stop check above and the
+        # registration: its kill sweep missed this process and the reaper
+        # is gone, so nothing would ever reap it — kill it ourselves
+        # (stop()'s post-join re-sweep is the backstop for the symmetric
+        # window where registration lands mid-sweep)
+        if self._stop.is_set():
+            self._kill_registered(pod.name)
+            return
         log.info("pod started", pod=pod.name, pid=proc.pid,
                  command=" ".join(command[:4]))
+
+    def _kill_registered(self, pod_name: str) -> None:
+        """SIGKILL + reap a process already in ``_procs`` (teardown path)."""
+        with self._lock:
+            proc = self._procs.pop(pod_name, None)
+        if proc is None or proc.poll() is not None:
+            return
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except ProcessLookupError:
+            pass
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            log.error("pod process unreapable", pod=pod_name, pid=proc.pid)
 
     def _request_stop(self, pod_name: str) -> None:
         with self._lock:

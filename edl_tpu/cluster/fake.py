@@ -283,6 +283,17 @@ class FakeCluster(Cluster):
                 done = any(p.phase == PodPhase.SUCCEEDED for p in pods)
                 if done:
                     continue
+                # Non-fault-tolerant jobs have a zero-failure budget: the
+                # updater's any-failure-is-fatal rule will tear the job
+                # down, but until it does, spawning a replacement trainer
+                # would hand it a frozen EDL_STATIC_PEERS list the
+                # survivors disagree with (the dead pod is still in
+                # theirs).  Enforce the budget at the Job-controller level
+                # too: once any trainer Failed, never replace (ADVICE r5
+                # item 3).
+                if (not spec.spec.fault_tolerant
+                        and any(p.phase == PodPhase.FAILED for p in pods)):
+                    continue
                 # surplus: delete newest first (creation-order, not name-order)
                 for p in sorted(live, key=lambda p: p.seq)[g.parallelism:]:
                     self._pods.pop(p.name, None)

@@ -7,10 +7,11 @@ whether coordination is in-process or remote.
 
 from __future__ import annotations
 
+import random
 import socket
 import threading
 import time
-from typing import Optional
+from typing import Callable, Optional
 
 from edl_tpu.coord.service import (
     DEFAULT_MEMBER_TTL_MS, DEFAULT_TASK_TIMEOUT_MS, LeaseStatus, QueueStats,
@@ -21,6 +22,25 @@ class CoordError(RuntimeError):
     pass
 
 
+#: Reconnect backoff envelope: first retry lands within ~50 ms (a blip —
+#: e.g. one dropped connection — must not stall a step boundary), doubling
+#: to a 2 s ceiling (a coordinator POD restart takes seconds; hammering it
+#: with a fixed fast cadence from every trainer is a reconnect storm).
+BACKOFF_BASE_S = 0.05
+BACKOFF_CAP_S = 2.0
+
+
+def backoff_delay(attempt: int, rng: random.Random,
+                  base: float = BACKOFF_BASE_S,
+                  cap: float = BACKOFF_CAP_S) -> float:
+    """Full-jitter exponential backoff: uniform in (d/2, d] where
+    d = min(cap, base·2^attempt).  Jitter de-synchronizes the trainer herd
+    redialing a restarted coordinator (they all observed the same outage
+    at the same step boundary)."""
+    d = min(cap, base * (2 ** min(attempt, 16)))
+    return rng.uniform(d / 2, d)
+
+
 class CoordClient:
     """``reconnect_window_s`` bounds how long a call rides out a
     coordinator restart: on a broken connection the client redials and
@@ -28,7 +48,18 @@ class CoordClient:
     composes with at-least-once delivery — a request that executed but
     whose response was lost behaves like a lease that timed out (the
     durable server persists BEFORE acking, so an acked op is never lost,
-    and an unacked op is retried or re-dispatched)."""
+    and an unacked op is retried or re-dispatched).
+
+    Outage riding is **degraded mode**: retries back off exponentially
+    with full jitter (see :func:`backoff_delay`) instead of hot-spinning a
+    fixed cadence, and the optional hooks let the owning trainer observe
+    the transition — ``on_degraded(attempt, elapsed_s)`` fires on every
+    failed attempt inside an outage (pause at a step boundary, surface
+    health, ...), ``on_recovered(outage_s)`` fires when a call finally
+    succeeds again.  Hooks run on the calling thread, under the client's
+    request lock — keep them cheap and non-reentrant (no coord calls).
+    Hooks are process-local: they do not survive pickling (a deserialized
+    client starts with both unset)."""
 
     def __init__(self, host: str, port: int, timeout: float = 10.0,
                  reconnect_window_s: float = 20.0) -> None:
@@ -37,11 +68,15 @@ class CoordClient:
         self.timeout = timeout
         self.reconnect_window_s = reconnect_window_s
         self._lock = threading.Lock()
+        self._rng = random.Random()
+        self.on_degraded: Optional[Callable[[int, float], None]] = None
+        self.on_recovered: Optional[Callable[[float], None]] = None
         # The FIRST dial also rides the window: clients are routinely
         # (un)pickled into fresh processes during the elastic dance, and a
         # world child spawned while the coordinator pod restarts must not
         # die on ConnectionRefused when a 2 s wait would have connected.
         deadline = time.monotonic() + max(self.reconnect_window_s, 0.0)
+        attempt = 0
         while True:
             try:
                 self._connect()
@@ -49,7 +84,8 @@ class CoordClient:
             except OSError:
                 if time.monotonic() >= deadline:
                     raise
-                time.sleep(0.3)
+                time.sleep(backoff_delay(attempt, self._rng))
+                attempt += 1
 
     def _connect(self) -> None:
         self._sock = socket.create_connection((self.host, self.port),
@@ -86,7 +122,9 @@ class CoordClient:
         line = (" ".join(parts) + "\n").encode()
         retransmitted = False
         with self._lock:
-            deadline = time.monotonic() + self.reconnect_window_s
+            t0 = time.monotonic()
+            deadline = t0 + self.reconnect_window_s
+            attempt = 0
             while True:
                 try:
                     self._sock.sendall(line)
@@ -94,17 +132,47 @@ class CoordClient:
                     if not resp:
                         raise CoordError(
                             "coordination server closed the connection")
+                    if attempt:
+                        self._note_recovered(time.monotonic() - t0)
                     return resp.decode().strip().split(" "), retransmitted
                 except (OSError, CoordError):
-                    if time.monotonic() >= deadline:
+                    now = time.monotonic()
+                    if now >= deadline:
                         raise
                     retransmitted = True
-                    time.sleep(0.3)
+                    self._note_degraded(attempt, now - t0)
+                    time.sleep(backoff_delay(attempt, self._rng))
+                    attempt += 1
                     try:
                         self.close()
                         self._connect()
                     except OSError:
                         pass  # server still down; keep retrying
+
+    def _note_degraded(self, attempt: int, elapsed_s: float) -> None:
+        """Record the outage once (trace + counter) and fire the hook on
+        every failed attempt — the trainer's cue to hold at a step
+        boundary instead of treating the outage as fatal."""
+        if attempt == 0:
+            from edl_tpu.observability.collector import get_counters
+            from edl_tpu.observability.tracing import get_tracer
+
+            get_tracer().instant("coord_degraded", category="chaos",
+                                 host=self.host, port=self.port)
+            get_counters().inc("coord_outages")
+        if self.on_degraded is not None:
+            self.on_degraded(attempt, elapsed_s)
+
+    def _note_recovered(self, outage_s: float) -> None:
+        from edl_tpu.observability.collector import get_counters
+        from edl_tpu.observability.tracing import get_tracer
+
+        get_tracer().instant("coord_reconnected", category="chaos",
+                             host=self.host, port=self.port,
+                             outage_s=round(outage_s, 3))
+        get_counters().inc("coord_reconnects")
+        if self.on_recovered is not None:
+            self.on_recovered(outage_s)
 
     # -- task queue --------------------------------------------------------
 

@@ -277,6 +277,7 @@ std::string HealthBody() {
 // probes in flight; new connections beyond the cap are shed (closed) so a
 // flood cannot fan out into unbounded threads — the kubelet just retries
 std::atomic<int> g_health_inflight{0};
+std::atomic<int> g_health_shed_drains{0};
 
 void ServeHealth(int fd) {
   std::string req;
@@ -451,10 +452,43 @@ int main(int argc, char** argv) {
         // bounded concurrency: each probe gets its own thread (one slow
         // client can't block the kubelet's next probe) but at most 8 are
         // in flight — beyond that, shed the connection instead of
-        // spawning unbounded threads
+        // spawning unbounded threads.  Shed WITH a minimal 503: a bare
+        // close reads as connection-reset, which a kubelet probe counts
+        // toward the liveness failureThreshold exactly like a wedged
+        // coordinator — during a connection flood that restarts a
+        // healthy server.  A 503 says "overloaded, not dead" (ADVICE r5
+        // item 4; best-effort write, the socket already has SNDTIMEO).
         if (g_health_inflight.fetch_add(1) >= 8) {
           g_health_inflight.fetch_sub(1);
-          close(fd);
+          static const char kShed[] =
+              "HTTP/1.1 503 Service Unavailable\r\n"
+              "Content-Type: application/json\r\nContent-Length: 22\r\n"
+              "Connection: close\r\n\r\n{\"error\":\"overloaded\"}";
+          (void)!write(fd, kShed, sizeof(kShed) - 1);
+          // drain the probe's request before close(): closing with
+          // unread received bytes sends RST, which can flush the
+          // buffered 503 client-side and read as exactly the
+          // connection-reset this reply exists to avoid.  The drain
+          // must NOT run on the accept loop (a trickling client would
+          // stall real probes behind it), so hand the fd to a
+          // short-lived drain thread — itself capped; past the cap the
+          // 503 is best-effort and the fd just closes.
+          shutdown(fd, SHUT_WR);
+          if (g_health_shed_drains.fetch_add(1) < 32) {
+            std::thread([fd]() {
+              timeval fast{0, 100 * 1000};
+              setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &fast, sizeof(fast));
+              char drain[512];
+              for (int i = 0;
+                   i < 4 && read(fd, drain, sizeof(drain)) > 0; ++i) {
+              }
+              close(fd);
+              g_health_shed_drains.fetch_sub(1);
+            }).detach();
+          } else {
+            g_health_shed_drains.fetch_sub(1);
+            close(fd);
+          }
           continue;
         }
         std::thread([fd]() {

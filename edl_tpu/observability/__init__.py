@@ -1,8 +1,10 @@
 """Observability: structured logging, metrics collector, step tracing."""
 
-from edl_tpu.observability.collector import Collector, JobInfo, Sample
+from edl_tpu.observability.collector import (
+    Collector, Counters, JobInfo, Sample, get_counters,
+)
 from edl_tpu.observability.logging import get_logger
 from edl_tpu.observability.tracing import Tracer, get_tracer, profile_step
 
-__all__ = ["Collector", "JobInfo", "Sample", "Tracer", "get_logger",
-           "get_tracer", "profile_step"]
+__all__ = ["Collector", "Counters", "JobInfo", "Sample", "Tracer",
+           "get_counters", "get_logger", "get_tracer", "profile_step"]

@@ -25,6 +25,7 @@ same choice the reference makes by summing only Running pods.
 from __future__ import annotations
 
 import sys
+import threading
 import time
 from dataclasses import dataclass, field
 from typing import Callable, TextIO
@@ -83,6 +84,67 @@ class Sample:
             f"{self.cpu_utils_pct:.2f}",
             f"{self.chip_utils_pct:.2f}",
         ])
+
+
+class Counters:
+    """Thread-safe labeled monotonic counters.
+
+    The gauge-style TSV sampler above answers "what does the cluster look
+    like right now"; chaos drills and recovery audits need the other kind
+    of truth — "how many times did X happen" — e.g.
+    ``faults_injected{type=kill_coordinator}`` vs.
+    ``recoveries_completed{type=kill_coordinator}``.  Labels are passed as
+    kwargs and folded into the key in sorted order, so
+    ``inc("faults_injected", type="network_flake")`` and
+    ``get("faults_injected", type="network_flake")`` always agree.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._counts: dict[tuple[str, tuple[tuple[str, str], ...]], int] = {}
+
+    @staticmethod
+    def _key(name: str, labels: dict[str, str]
+             ) -> tuple[str, tuple[tuple[str, str], ...]]:
+        return name, tuple(sorted((k, str(v)) for k, v in labels.items()))
+
+    def inc(self, name: str, n: int = 1, **labels: str) -> int:
+        with self._lock:
+            key = self._key(name, labels)
+            self._counts[key] = self._counts.get(key, 0) + n
+            return self._counts[key]
+
+    def get(self, name: str, **labels: str) -> int:
+        with self._lock:
+            return self._counts.get(self._key(name, labels), 0)
+
+    def total(self, name: str) -> int:
+        """Sum over every label combination of ``name``."""
+        with self._lock:
+            return sum(v for (n, _), v in self._counts.items() if n == name)
+
+    def snapshot(self) -> dict[str, int]:
+        """Flat ``name{k=v,...}`` → count view (audit dumps, tests)."""
+        with self._lock:
+            out = {}
+            for (name, labels), v in self._counts.items():
+                key = name if not labels else name + "{" + ",".join(
+                    f"{k}={val}" for k, val in labels) + "}"
+                out[key] = v
+            return out
+
+    def clear(self) -> None:
+        with self._lock:
+            self._counts.clear()
+
+
+#: Process-wide counter registry — what the chaos engine, checkpointer and
+#: coord client record into (mirrors tracing.get_tracer()).
+_default_counters = Counters()
+
+
+def get_counters() -> Counters:
+    return _default_counters
 
 
 class Collector:

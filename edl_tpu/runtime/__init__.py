@@ -20,6 +20,10 @@ _EXPORTS = {
     "TaskLeaseBatches": ("edl_tpu.runtime.data", "TaskLeaseBatches"),
     "ElasticCheckpointer": ("edl_tpu.runtime.checkpoint",
                             "ElasticCheckpointer"),
+    "ChaosProxy": ("edl_tpu.runtime.faults", "ChaosProxy"),
+    "FaultContext": ("edl_tpu.runtime.faults", "FaultContext"),
+    "FaultPlan": ("edl_tpu.runtime.faults", "FaultPlan"),
+    "FaultPlanEngine": ("edl_tpu.runtime.faults", "FaultPlanEngine"),
 }
 
 __all__ = list(_EXPORTS)

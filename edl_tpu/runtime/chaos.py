@@ -8,6 +8,12 @@ the kill-a-trainer-every-N-steps fixture: wired into a training loop's
 cluster, exercising the whole recovery chain — pod replacement by the Job
 controller, membership epoch bump, mesh resize at the next step boundary,
 and task-queue re-dispatch of the dead trainer's leased shard.
+
+ChaosMonkey automates exactly ONE fault on a fixed cadence.  For scripted
+multi-fault campaigns — coordinator kills, network flakes, domain
+preemptions, checkpoint corruption — see the fault-plan engine in
+:mod:`edl_tpu.runtime.faults`, which generalizes this fixture into seeded,
+auditable drills.
 """
 
 from __future__ import annotations
@@ -16,6 +22,7 @@ import random
 from typing import Optional
 
 from edl_tpu.cluster.base import PodPhase
+from edl_tpu.observability.collector import get_counters
 from edl_tpu.observability.logging import get_logger
 from edl_tpu.observability.tracing import get_tracer
 
@@ -59,5 +66,6 @@ class ChaosMonkey:
         log.warn("chaos: killing trainer pod", pod=victim.name, step=step)
         get_tracer().instant("chaos_kill", category="chaos",
                              pod=victim.name, step=step)
+        get_counters().inc("faults_injected", type="kill_trainer")
         self._cluster.kill_pod(victim.name, self._phase)
         self.kills.append(victim.name)

@@ -1,27 +1,69 @@
-"""Checkpoint/restore across mesh resizes, via Orbax.
+"""Checkpoint/restore across mesh resizes, via Orbax — with integrity.
 
 The reference delegated checkpointing to the Paddle stack (pserver state in
 etcd + per-pass parameter tars, SURVEY §5.4 — train_local.py:95-96,
 paddle_k8s:205).  Here Orbax owns it: state is saved with its shardings and
 restored *onto a different mesh* — the piece that lets a job survive a full
 slice preemption or a cross-host resize, not just an in-process reshard.
+
+On top of the Orbax step store this adds the two degradations real
+checkpoint volumes exhibit and the fault-plan engine drills
+(`edl_tpu.runtime.faults`):
+
+* **Torn/corrupt steps** — every completed save is fingerprinted into a
+  per-step integrity manifest (relative path → size + CRC32, stored under
+  ``<dir>/.integrity/<step>.json``).  ``restore()`` verifies a step before
+  trusting it and transparently falls back to the newest step that still
+  verifies, logging the corruption and counting the recovery
+  (``recoveries_completed{type=corrupt_checkpoint}``).
+* **Disk-full at the persist boundary** — ``save(..., best_effort=True)``
+  turns an ``OSError`` (ENOSPC for real, or injected via
+  :meth:`ElasticCheckpointer.inject_save_failures`) into a logged, counted
+  skip instead of a crashed trainer; the first successful save afterwards
+  counts ``recoveries_completed{type=disk_full}``.
 """
 
 from __future__ import annotations
 
+import errno
+import json
+import os
+import zlib
 from pathlib import Path
 from typing import Any, Optional
 
 import jax
 import orbax.checkpoint as ocp
 
+from edl_tpu.observability.collector import get_counters
 from edl_tpu.observability.logging import get_logger
+from edl_tpu.observability.tracing import get_tracer
 
 log = get_logger("runtime.checkpoint")
 
+_MANIFEST_DIRNAME = ".integrity"
+
+
+def _fingerprint_tree(root: Path) -> dict[str, list]:
+    """Relative path → [size, crc32] for every regular file under root."""
+    out: dict[str, list] = {}
+    for dirpath, _dirnames, filenames in os.walk(root):
+        for fn in filenames:
+            p = Path(dirpath) / fn
+            crc = 0
+            with open(p, "rb") as f:
+                while chunk := f.read(1 << 20):
+                    crc = zlib.crc32(chunk, crc)
+            out[str(p.relative_to(root))] = [p.stat().st_size, crc & 0xFFFFFFFF]
+    return out
+
+
+class CheckpointCorruption(RuntimeError):
+    """No step in the store survives integrity verification + restore."""
+
 
 class ElasticCheckpointer:
-    """Thin CheckpointManager wrapper keyed by step."""
+    """CheckpointManager wrapper keyed by step, with integrity manifests."""
 
     def __init__(self, directory: str | Path, max_to_keep: int = 3) -> None:
         self.directory = Path(directory).resolve()
@@ -36,22 +78,170 @@ class ElasticCheckpointer:
                 cleanup_tmp_directories=True,
             ),
         )
+        #: injected persist-boundary failures (the fault plan's DiskFull
+        #: action); each pending failure makes one save() raise ENOSPC
+        self._injected_save_failures = 0
+        #: consecutive failed saves — the degraded window whose end is the
+        #: disk_full recovery transition
+        self._save_failure_streak = 0
 
-    def save(self, step: int, tree: Any, wait: bool = True) -> None:
-        self._mgr.save(step, args=ocp.args.StandardSave(tree))
+    # -- fault injection (chaos drills) ------------------------------------
+
+    def inject_save_failures(self, n: int = 1) -> None:
+        """Make the next ``n`` save() calls fail with ENOSPC at the persist
+        boundary — the DiskFull fault of `edl_tpu.runtime.faults` (the
+        volume itself cannot be filled from a test, and root bypasses
+        read-only modes, so the boundary is injected exactly where a full
+        disk would first bite)."""
+        self._injected_save_failures += n
+
+    # -- integrity manifests -----------------------------------------------
+
+    def _manifest_path(self, step: int) -> Path:
+        return self.directory / _MANIFEST_DIRNAME / f"{step}.json"
+
+    def _step_dir(self, step: int) -> Path:
+        return Path(self._mgr.directory) / str(step)
+
+    def _write_manifest(self, step: int) -> None:
+        root = self._step_dir(step)
+        if not root.is_dir():  # layout drift — never fail the save for it
+            return
+        manifest = {"step": step, "files": _fingerprint_tree(root)}
+        dest = self._manifest_path(step)
+        dest.parent.mkdir(parents=True, exist_ok=True)
+        # per-process tmp name: in a collective save every rank writes the
+        # (identical) manifest for the same step into the same shared dir,
+        # and a shared tmp path would let one rank rename it out from
+        # under another (os.replace itself is atomic; last writer wins)
+        tmp = dest.with_suffix(f".{os.getpid()}.tmp")
+        with open(tmp, "w") as f:
+            json.dump(manifest, f)
+        try:
+            os.replace(tmp, dest)
+        except OSError:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+        self._prune_manifests()
+
+    def _prune_manifests(self) -> None:
+        """Drop manifests of steps the manager has garbage-collected."""
+        mdir = self.directory / _MANIFEST_DIRNAME
+        if not mdir.is_dir():
+            return
+        live = {str(s) for s in self._mgr.all_steps()}
+        for entry in mdir.glob("*.json"):
+            if entry.stem not in live:
+                try:
+                    entry.unlink()
+                except OSError:
+                    pass
+
+    def verify(self, step: int) -> bool:
+        """True iff the step's on-disk files match its manifest.  A step
+        without a manifest (pre-manifest save, async save) verifies
+        vacuously — restore() will still catch a torn read when Orbax
+        fails to parse it."""
+        mpath = self._manifest_path(step)
+        if not mpath.exists():
+            return True
+        try:
+            with open(mpath) as f:
+                manifest = json.load(f)
+        except (OSError, ValueError):
+            return True  # unreadable manifest is no evidence against data
+        root = self._step_dir(step)
+        try:
+            found = _fingerprint_tree(root)
+        except OSError:
+            return False  # files listed in the manifest are unreadable
+        return found == manifest["files"]
+
+    # -- save/restore -------------------------------------------------------
+
+    def save(self, step: int, tree: Any, wait: bool = True,
+             best_effort: bool = False) -> bool:
+        """Persist ``tree`` at ``step``; returns True on success.
+
+        ``best_effort`` is the graceful-degradation mode the fault drills
+        demand: an OSError at the persist boundary (disk full, injected or
+        real) is logged and counted instead of raised — training proceeds
+        with the previous checkpoint as the recovery point, and the first
+        subsequent successful save is the recovery transition."""
+        try:
+            if self._injected_save_failures > 0:
+                self._injected_save_failures -= 1
+                raise OSError(errno.ENOSPC,
+                              "No space left on device (injected)")
+            self._mgr.save(step, args=ocp.args.StandardSave(tree))
+            if wait:
+                self._mgr.wait_until_finished()
+        except OSError as exc:
+            if not best_effort:
+                raise
+            self._save_failure_streak += 1
+            log.warn("checkpoint save failed; continuing without it",
+                     step=step, error=str(exc),
+                     consecutive_failures=self._save_failure_streak)
+            get_tracer().instant("checkpoint_save_failed", category="chaos",
+                                 step=step, error=str(exc)[:120])
+            get_counters().inc("checkpoint_save_failures")
+            return False
         if wait:
-            self._mgr.wait_until_finished()
+            # fingerprint only finalized files: an async save's files are
+            # still being written, so its manifest is written by nobody —
+            # verify() treats the step as unverifiable, not corrupt
+            self._write_manifest(step)
+        if self._save_failure_streak:
+            log.info("checkpoint saves recovered", step=step,
+                     after_failures=self._save_failure_streak)
+            get_tracer().instant("checkpoint_save_recovered",
+                                 category="chaos", step=step)
+            get_counters().inc("recoveries_completed", type="disk_full")
+            self._save_failure_streak = 0
+        return True
 
     def latest_step(self) -> Optional[int]:
         return self._mgr.latest_step()
 
+    def latest_verified_step(self) -> Optional[int]:
+        """Newest step whose integrity manifest matches the files."""
+        for step in sorted(self._mgr.all_steps(), reverse=True):
+            if self.verify(step):
+                return step
+        return None
+
     def restore(self, tree_like: Any, step: Optional[int] = None,
-                shardings: Any = None) -> Any:
+                shardings: Any = None, parse_fallback: bool = True) -> Any:
         """Restore onto the shardings of ``tree_like`` (or explicit
         ``shardings``) — the target mesh may differ from the one that saved.
-        ``tree_like`` supplies shapes/dtypes (live arrays are fine)."""
-        step = step if step is not None else self._mgr.latest_step()
-        if step is None:
+        ``tree_like`` supplies shapes/dtypes (live arrays are fine).
+
+        A torn or corrupt step (manifest mismatch, or Orbax failing to
+        parse the files) is skipped with a warning and the restore falls
+        back to the newest older step that verifies AND parses — the
+        recovery chain of the CorruptCheckpoint/torn-save faults.
+
+        ``parse_fallback=False`` re-raises an Orbax parse failure instead
+        of falling back.  Collective multi-host restores need this: the
+        manifest check reads the same shared files on every host and
+        falls back identically, but a host-local parse error would send
+        ONE host to an older step — a mismatched collective.  Raising
+        kills the worker and lets the supervisor reform, which is the
+        collective-safe recovery."""
+        steps = sorted(self._mgr.all_steps(), reverse=True)
+        if step is not None:
+            if step not in steps:
+                # the caller pinned a step that isn't in the store —
+                # silently handing back an older one would diverge a
+                # multi-host resume whose peers agreed on ``step``
+                raise FileNotFoundError(
+                    f"requested checkpoint step {step} not in "
+                    f"{self.directory} (have {sorted(steps)})")
+            steps = [s for s in steps if s <= step]
+        if not steps:
             raise FileNotFoundError(f"no checkpoints in {self.directory}")
 
         def to_abstract(x, s):
@@ -64,11 +254,78 @@ class ElasticCheckpointer:
             abstract = jax.tree.map(lambda x: to_abstract(x, None), tree_like)
         else:
             abstract = jax.tree.map(to_abstract, tree_like, shardings)
-        restored = self._mgr.restore(
-            step, args=ocp.args.StandardRestore(abstract)
-        )
-        log.info("restored checkpoint", step=step, dir=str(self.directory))
-        return restored
+
+        fell_back = False
+        manifest_failed = False
+        last_exc: Optional[Exception] = None
+        exc_types: set = set()
+        # parse failures (manifest OK, Orbax restore raised) might not be
+        # corruption at all — if EVERY step fails that way identically the
+        # caller's tree/shardings changed.  Defer their corruption
+        # counters/traces until that determination so a healthy store
+        # never shows phantom corruption events in the chaos audit.
+        deferred: list[tuple[int, str]] = []
+
+        def flush_deferred() -> None:
+            for s, err in deferred:
+                get_tracer().instant("checkpoint_corruption_detected",
+                                     category="chaos", step=s, error=err)
+                get_counters().inc("checkpoint_corruption_detected")
+            deferred.clear()
+
+        all_manifested = True
+        for candidate in steps:
+            if not self._manifest_path(candidate).exists():
+                # verify() passes vacuously without a manifest (pre-
+                # manifest store, async save) — the mismatch heuristic
+                # below must not mistake that for "bytes proven intact"
+                all_manifested = False
+            if not self.verify(candidate):
+                log.warn("checkpoint step failed integrity verification; "
+                         "falling back", step=candidate)
+                get_tracer().instant("checkpoint_corruption_detected",
+                                     category="chaos", step=candidate)
+                get_counters().inc("checkpoint_corruption_detected")
+                fell_back = True
+                manifest_failed = True
+                continue
+            try:
+                restored = self._mgr.restore(
+                    candidate, args=ocp.args.StandardRestore(abstract))
+            except Exception as exc:  # torn past the manifest's reach
+                if not parse_fallback:
+                    raise
+                log.warn("checkpoint step unreadable; falling back",
+                         step=candidate, error=str(exc))
+                deferred.append((candidate, str(exc)[:120]))
+                fell_back = True
+                last_exc = exc
+                exc_types.add(type(exc))
+                continue
+            if fell_back:
+                flush_deferred()  # a later step restored — those WERE torn
+                log.warn("restored from fallback checkpoint after "
+                         "corruption", step=candidate)
+                get_tracer().instant("checkpoint_fallback_restore",
+                                     category="chaos", step=candidate)
+                get_counters().inc("recoveries_completed",
+                                   type="corrupt_checkpoint")
+            log.info("restored checkpoint", step=candidate,
+                     dir=str(self.directory))
+            return restored
+        if (all_manifested and not manifest_failed and last_exc is not None
+                and len(exc_types) == 1
+                and not isinstance(last_exc, OSError)):
+            # every step's manifest verified (bytes on disk are exactly
+            # what save() wrote) yet Orbax failed identically on all of
+            # them — that's a caller-side mismatch (tree structure /
+            # shardings changed), not corruption; surface the real error
+            # (and record no corruption events for the healthy store)
+            raise last_exc
+        flush_deferred()
+        raise CheckpointCorruption(
+            f"every checkpoint step in {self.directory} is corrupt "
+            f"(tried {steps})") from last_exc
 
     def close(self) -> None:
         self._mgr.close()

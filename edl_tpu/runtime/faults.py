@@ -1,0 +1,709 @@
+"""Fault-plan engine: scriptable, seeded multi-fault chaos campaigns.
+
+:class:`~edl_tpu.runtime.chaos.ChaosMonkey` automates exactly one fault —
+kill a running trainer pod on a fixed cadence (the reference's hand-run
+demo, doc/boss_tutorial.md:271-301).  Real elastic clusters fail in
+correlated, messier ways: coordinator restarts mid-lease, flaky networks,
+whole-ICI-domain preemptions, torn checkpoints, full disks.  This module
+makes those scenarios **programmable, deterministic and auditable**:
+
+* a :class:`FaultPlan` is an ordered campaign of typed fault actions
+  (:class:`KillTrainer`, :class:`KillCoordinator`, :class:`NetworkFlake`,
+  :class:`PreemptDomain`, :class:`CorruptCheckpoint`, :class:`DiskFull`)
+  fired on step or wall-clock triggers; :meth:`FaultPlan.random` derives a
+  whole campaign from a single seed, so any drill is reproducible from the
+  integer that named it;
+* the :class:`FaultPlanEngine` plugs into a training loop exactly like
+  ChaosMonkey (``on_step(step, loss, world)``), fires due actions against
+  a :class:`FaultContext` (cluster, kubelet, coord client, chaos proxy,
+  checkpointer), and then *watches the recovery*: every injected fault and
+  every completed recovery transition is emitted as a chaos-category trace
+  event and a labeled counter (``faults_injected{type=...}`` /
+  ``recoveries_completed{type=...}``), so a drill's outcome is a queryable
+  artifact, not a green test with no evidence;
+* :class:`ChaosProxy` is a socket-level chaos middlebox for the coord
+  server: connection resets, per-response delay windows, and blackhole
+  windows (connections accepted, bytes silently dropped) — the faults that
+  exercise :class:`~edl_tpu.coord.client.CoordClient`'s jittered-backoff
+  reconnect and at-least-once retry path without touching the server.
+
+Checkpoint-integrity faults recover inside the checkpointer itself
+(`runtime.checkpoint`): a corrupted step is detected by the integrity
+manifest and restore falls back to the newest verified step
+(``recoveries_completed{type=corrupt_checkpoint}``); an injected
+disk-full save is skipped gracefully and the first subsequent successful
+save completes the recovery (``recoveries_completed{type=disk_full}``).
+
+See ``doc/fault_drills.md`` for the drill cookbook and
+``tests/test_fault_campaign.py`` for the seeded end-to-end soak.
+"""
+
+from __future__ import annotations
+
+import random
+import socket
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+from edl_tpu.observability.collector import get_counters
+from edl_tpu.observability.logging import get_logger
+from edl_tpu.observability.tracing import get_tracer
+
+log = get_logger("runtime.faults")
+
+
+# ---------------------------------------------------------------------------
+# ChaosProxy: a socket-level chaos middlebox for the coordination server
+# ---------------------------------------------------------------------------
+
+class ChaosProxy:
+    """TCP proxy in front of the coord server that injects network faults.
+
+    Trainers dial the proxy's ``(host, port)`` instead of the server; the
+    proxy pumps bytes both ways until told to misbehave:
+
+    * :meth:`reset_all` — abruptly close every live connection (the
+      connection-reset fault; clients see ECONNRESET / empty read);
+    * :meth:`delay` — for a window, sleep before forwarding each
+      server→client chunk (congested / slow network);
+    * :meth:`blackhole` — for a window, accepted connections go nowhere
+      and a connection with in-flight bytes is parked for the window and
+      then closed (partition: requests vanish, clients block until their
+      socket timeout and then ride the reconnect path; never a mid-stream
+      byte drop, which TCP's in-order delivery makes unphysical).
+
+    ``set_upstream`` retargets new connections — this is what keeps the
+    trainers' endpoint stable across a coordinator restart that came back
+    on a different port (the k8s Service's job, emulated at one socket).
+    """
+
+    def __init__(self, upstream: tuple[str, int],
+                 host: str = "127.0.0.1", port: int = 0) -> None:
+        self._upstream = tuple(upstream)
+        self._lock = threading.Lock()
+        self._conns: list[socket.socket] = []
+        self._blackhole_until = 0.0
+        self._delay_until = 0.0
+        self._delay_s = 0.0
+        self._stop = threading.Event()
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((host, port))
+        self._listener.listen(64)
+        self.host, self.port = self._listener.getsockname()[:2]
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True, name="chaos-proxy-accept")
+        self._accept_thread.start()
+
+    # -- fault injection knobs ---------------------------------------------
+
+    def set_upstream(self, host: str, port: int) -> None:
+        with self._lock:
+            self._upstream = (host, port)
+
+    def reset_all(self) -> int:
+        """Close every live proxied connection; returns how many."""
+        with self._lock:
+            conns, self._conns = self._conns, []
+        import struct
+
+        for s in conns:
+            try:
+                # linger on, 0 s → close sends RST, not FIN (a real reset)
+                s.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER,
+                             struct.pack("ii", 1, 0))
+            except OSError:
+                pass
+            try:
+                s.close()
+            except OSError:
+                pass
+        # _conns holds the (client, upstream) PAIR per proxied connection
+        return len(conns) // 2
+
+    def blackhole(self, duration_s: float) -> None:
+        with self._lock:
+            self._blackhole_until = time.monotonic() + duration_s
+
+    def delay(self, duration_s: float, per_chunk_s: float = 0.2) -> None:
+        with self._lock:
+            self._delay_until = time.monotonic() + duration_s
+            self._delay_s = per_chunk_s
+
+    def faults_active(self) -> bool:
+        now = time.monotonic()
+        with self._lock:
+            return now < self._blackhole_until or now < self._delay_until
+
+    def close(self) -> None:
+        self._stop.set()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        self.reset_all()
+
+    # -- internals ----------------------------------------------------------
+
+    def _blackholed(self) -> bool:
+        with self._lock:
+            return time.monotonic() < self._blackhole_until
+
+    def _current_delay(self) -> float:
+        with self._lock:
+            return (self._delay_s
+                    if time.monotonic() < self._delay_until else 0.0)
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                client, _addr = self._listener.accept()
+            except OSError:
+                return  # listener closed
+            threading.Thread(target=self._serve, args=(client,),
+                             daemon=True, name="chaos-proxy-conn").start()
+
+    def _serve(self, client: socket.socket) -> None:
+        # A blackholed connection is ACCEPTED and parked: the TCP
+        # handshake succeeds but requests vanish — the partition shape
+        # that exercises the client's timeout path, not its refused path.
+        while self._blackholed() and not self._stop.is_set():
+            time.sleep(0.05)
+        if self._stop.is_set():
+            client.close()
+            return
+        with self._lock:
+            upstream_addr = self._upstream
+        try:
+            upstream = socket.create_connection(upstream_addr, timeout=5.0)
+        except OSError:
+            client.close()
+            return
+        with self._lock:
+            self._conns += [client, upstream]
+        threading.Thread(target=self._pump, args=(client, upstream, False),
+                         daemon=True, name="chaos-proxy-up").start()
+        self._pump(upstream, client, True)
+
+    def _pump(self, src: socket.socket, dst: socket.socket,
+              is_response: bool) -> None:
+        try:
+            while not self._stop.is_set():
+                data = src.recv(65536)
+                if not data:
+                    break
+                if self._blackholed():
+                    # Park until the window lapses, then kill the
+                    # connection.  TCP delivers in order — a real
+                    # partition can never drop THESE bytes yet deliver
+                    # later ones, so swallowing the chunk and pumping the
+                    # next would desync the newline protocol mid-stream.
+                    # Ending the connection instead sends the client down
+                    # the documented reconnect/at-least-once path.
+                    while self._blackholed() and not self._stop.is_set():
+                        time.sleep(0.05)
+                    break  # finally: closes both sides
+                if is_response:
+                    d = self._current_delay()
+                    if d:
+                        time.sleep(d)
+                dst.sendall(data)
+        except OSError:
+            pass
+        finally:
+            for s in (src, dst):
+                try:
+                    s.close()
+                except OSError:
+                    pass
+            with self._lock:
+                self._conns = [c for c in self._conns
+                               if c is not src and c is not dst]
+
+
+# ---------------------------------------------------------------------------
+# Fault actions
+# ---------------------------------------------------------------------------
+
+@dataclass
+class FaultContext:
+    """Everything a campaign may act on.  All fields optional — an action
+    whose dependency is absent reports itself unfireable (a planning
+    error) rather than crashing the drill."""
+
+    cluster: Any = None          # FakeCluster-compatible backend
+    job: Any = None              # TrainingJob the campaign targets
+    kubelet: Any = None          # ProcessKubelet (real pod processes)
+    coord: Any = None            # CoordClient/service for recovery probes
+    proxy: Optional[ChaosProxy] = None
+    checkpointer: Any = None     # ElasticCheckpointer
+    #: non-kubelet drills: SIGKILL + respawn the coord server process
+    #: (durable state file carries recovery) — provided by the harness
+    restart_coordinator: Optional[Callable[[], None]] = None
+    rng: random.Random = field(default_factory=random.Random)
+
+    def running_trainers(self) -> list:
+        from edl_tpu.cluster.base import PodPhase
+
+        return [p for p in self.cluster.list_pods(
+                    job_uid=self.job.full_name, role="trainer")
+                if p.phase == PodPhase.RUNNING and not p.deletion_timestamp]
+
+    def kill_pod(self, name: str) -> None:
+        """SIGKILL the pod's real process when a kubelet runs it (the
+        reaper then reports the exit); otherwise flip the fake pod."""
+        if self.kubelet is not None and self.kubelet.pid_of(name) is not None:
+            self.kubelet.signal_pod(name)
+        else:
+            self.cluster.kill_pod(name)
+
+    def coord_alive(self) -> bool:
+        c = self.coord
+        if c is None:
+            return True
+        # Probe with a dedicated short-timeout socket, not the production
+        # client: CoordClient.ping() rides the reconnect window (seconds)
+        # and fires the client's degraded hooks, so polling it from every
+        # training-step hook would stall the loop for the whole outage.
+        host, port = getattr(c, "host", None), getattr(c, "port", None)
+        if host is not None and port is not None:
+            try:
+                with socket.create_connection((host, port),
+                                              timeout=0.5) as s:
+                    s.settimeout(0.5)
+                    s.sendall(b"PING\n")
+                    return s.makefile("rb").readline().startswith(b"PONG")
+            except OSError:
+                return False
+        ping = getattr(c, "ping", None)
+        return bool(ping()) if ping is not None else True
+
+
+#: fire() outcomes
+FIRED, RETRY = "fired", "retry"
+
+
+def _death_then_headcount(ctx: FaultContext, victims: set,
+                          baseline: int) -> Callable[[], bool]:
+    """Recovery predicate for pod-kill faults: True only after every
+    victim has been observed gone from the running set AND the running
+    headcount is back to the pre-fault baseline.  The two phases matter
+    on the kubelet path, where a SIGKILLed pod keeps listing as RUNNING
+    until the reaper polls its exit (~0.2 s) — a plain headcount check
+    polled in the same engine call that fired the kill would declare an
+    instant, vacuous recovery."""
+    seen_dead = [False]
+
+    def recovered() -> bool:
+        running = {p.name for p in ctx.running_trainers()}
+        if not seen_dead[0]:
+            if not (victims & running):
+                seen_dead[0] = True
+            return False
+        return len(running) >= baseline
+
+    return recovered
+
+
+@dataclass
+class FaultAction:
+    """One scheduled fault.  ``at_step`` triggers on the training-loop
+    hook; ``at_time_s`` (relative to engine start) triggers on tick().
+    Subclasses implement ``fire(ctx) -> (outcome, recovery)`` where
+    ``recovery`` is an optional zero-arg predicate that turns true when
+    the system has healed from *this* fault."""
+
+    at_step: Optional[int] = None
+    at_time_s: Optional[float] = None
+    kind: str = "fault"
+
+    def due(self, step: int, elapsed_s: float) -> bool:
+        if self.at_step is not None:
+            return step >= self.at_step
+        if self.at_time_s is not None:
+            return elapsed_s >= self.at_time_s
+        return False
+
+    def describe(self) -> dict:
+        d = {"kind": self.kind}
+        if self.at_step is not None:
+            d["at_step"] = self.at_step
+        if self.at_time_s is not None:
+            d["at_time_s"] = self.at_time_s
+        return d
+
+    def fire(self, ctx: FaultContext):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+
+@dataclass
+class KillTrainer(FaultAction):
+    """SIGKILL one running trainer pod — ChaosMonkey's fault, scheduled."""
+
+    kind: str = "kill_trainer"
+
+    def fire(self, ctx: FaultContext):
+        victims = ctx.running_trainers()
+        if not victims:
+            return RETRY, None  # mid-recovery from an earlier fault
+        victim = ctx.rng.choice(sorted(victims, key=lambda p: p.name))
+        baseline = len(victims)
+        log.warn("fault: killing trainer pod", pod=victim.name)
+        ctx.kill_pod(victim.name)
+        # two-phase recovery: a SIGKILLed pod still lists as RUNNING until
+        # the kubelet reaper reports the exit, so a bare count>=baseline
+        # check would record an instant bogus recovery — first observe the
+        # victim actually gone, THEN the headcount restored (pod names are
+        # never reused: FakeCluster names by a global monotonic seq)
+        return FIRED, _death_then_headcount(ctx, {victim.name}, baseline)
+
+
+@dataclass
+class KillCoordinator(FaultAction):
+    """SIGKILL the coordinator pod/process; durable state (the state file
+    on the job volume) carries recovery when the replacement starts."""
+
+    kind: str = "kill_coordinator"
+
+    def fire(self, ctx: FaultContext):
+        if ctx.kubelet is not None:
+            coords = [n for n in ctx.kubelet.live_pods()
+                      if "-coordinator-" in n]
+            if not coords:
+                return RETRY, None
+            log.warn("fault: killing coordinator pod", pod=coords[0])
+            ctx.kubelet.signal_pod(coords[0])
+            # async kill — same two-phase shape as _death_then_headcount:
+            # the SIGKILLed coordinator can still answer a probe in the
+            # very _advance call that fired the kill, so require the
+            # outage observed before an answered probe counts as recovery
+            seen_dead = [False]
+
+            def recovered() -> bool:
+                alive = ctx.coord_alive()
+                if not seen_dead[0]:
+                    if not alive:
+                        seen_dead[0] = True
+                    return False
+                return alive
+
+            return FIRED, recovered
+        if ctx.restart_coordinator is not None:
+            log.warn("fault: killing coordinator process")
+            # synchronous kill+respawn: the outage happens inside the
+            # call, so recovery is simply the replacement answering
+            ctx.restart_coordinator()
+            return FIRED, ctx.coord_alive
+        raise RuntimeError("KillCoordinator needs a kubelet or a "
+                           "restart_coordinator callable")
+
+
+@dataclass
+class NetworkFlake(FaultAction):
+    """Network chaos through the :class:`ChaosProxy`: ``reset`` closes all
+    live connections, ``delay`` slows responses for a window, ``blackhole``
+    drops everything for a window."""
+
+    mode: str = "reset"  # reset | delay | blackhole
+    duration_s: float = 1.0
+
+    kind: str = "network_flake"
+
+    def fire(self, ctx: FaultContext):
+        if ctx.proxy is None:
+            raise RuntimeError("NetworkFlake needs a ChaosProxy in the ctx")
+        log.warn("fault: network flake", mode=self.mode,
+                 duration_s=self.duration_s)
+        if self.mode == "reset":
+            ctx.proxy.reset_all()
+        elif self.mode == "delay":
+            ctx.proxy.delay(self.duration_s)
+        elif self.mode == "blackhole":
+            ctx.proxy.blackhole(self.duration_s)
+        else:
+            raise ValueError(f"unknown flake mode {self.mode!r}")
+        proxy = ctx.proxy
+        return FIRED, lambda: not proxy.faults_active() and ctx.coord_alive()
+
+    def describe(self) -> dict:
+        return {**super().describe(), "mode": self.mode,
+                "duration_s": self.duration_s}
+
+
+@dataclass
+class PreemptDomain(FaultAction):
+    """Correlated failure: every running trainer pod in ONE ICI domain
+    dies at once (a slice preemption / maintenance event), forcing the
+    world to reform across whatever capacity remains."""
+
+    domain: Optional[str] = None  # None = the domain hosting most trainers
+
+    kind: str = "preempt_domain"
+
+    def fire(self, ctx: FaultContext):
+        trainers = ctx.running_trainers()
+        if not trainers:
+            return RETRY, None
+        nodes = {n.name: n.ici_domain
+                 for n in getattr(ctx.cluster, "_nodes", {}).values()}
+        by_domain: dict[str, list] = {}
+        for p in trainers:
+            dom = nodes.get(p.node, p.node or "")
+            by_domain.setdefault(dom, []).append(p)
+        domain = self.domain
+        if domain is None or domain not in by_domain:
+            domain = max(sorted(by_domain), key=lambda d: len(by_domain[d]))
+        victims = by_domain[domain]
+        baseline = len(trainers)
+        log.warn("fault: preempting ICI domain", domain=domain,
+                 pods=[p.name for p in victims])
+        for p in victims:
+            ctx.kill_pod(p.name)
+        return FIRED, _death_then_headcount(
+            ctx, {p.name for p in victims}, baseline)
+
+    def describe(self) -> dict:
+        d = super().describe()
+        if self.domain is not None:
+            d["domain"] = self.domain
+        return d
+
+
+@dataclass
+class CorruptCheckpoint(FaultAction):
+    """Tear the newest saved checkpoint step on disk (flip a byte or
+    truncate a file).  Recovery happens inside
+    ``ElasticCheckpointer.restore``: the integrity manifest detects the
+    damage and the restore falls back to the newest verified step."""
+
+    mode: str = "flip"  # flip | truncate
+
+    kind: str = "corrupt_checkpoint"
+
+    def fire(self, ctx: FaultContext):
+        ck = ctx.checkpointer
+        if ck is None:
+            raise RuntimeError("CorruptCheckpoint needs a checkpointer")
+        step = ck.latest_step()
+        if step is None:
+            return RETRY, None  # nothing saved yet; strike after a save
+        root = ck._step_dir(step)
+        files = sorted((p for p in root.rglob("*") if p.is_file()),
+                       key=lambda p: (p.stat().st_size, str(p)))
+        if not files:
+            return RETRY, None
+        victim = files[-1]  # the largest file holds the parameter bytes
+        log.warn("fault: corrupting checkpoint", step=step,
+                 file=str(victim), mode=self.mode)
+        data = victim.read_bytes()
+        if self.mode == "truncate":
+            victim.write_bytes(data[:len(data) // 2])
+        else:
+            b = bytearray(data) or bytearray(1)
+            b[len(b) // 2] ^= 0xFF
+            victim.write_bytes(bytes(b))
+        # recovery = the checkpointer's own fallback restore; counted by
+        # the checkpointer (recoveries_completed{type=corrupt_checkpoint})
+        return FIRED, None
+
+    def describe(self) -> dict:
+        return {**super().describe(), "mode": self.mode}
+
+
+@dataclass
+class DiskFull(FaultAction):
+    """ENOSPC at the persist boundary: the next ``saves`` checkpointer
+    saves fail.  Recovery is the checkpointer's first subsequent
+    successful save (counted as ``recoveries_completed{type=disk_full}``)."""
+
+    saves: int = 1
+
+    kind: str = "disk_full"
+
+    def fire(self, ctx: FaultContext):
+        if ctx.checkpointer is None:
+            raise RuntimeError("DiskFull needs a checkpointer")
+        log.warn("fault: disk full at persist boundary", saves=self.saves)
+        ctx.checkpointer.inject_save_failures(self.saves)
+        return FIRED, None
+
+    def describe(self) -> dict:
+        return {**super().describe(), "saves": self.saves}
+
+
+#: kind string → action class (plan (de)serialization + random campaigns)
+ACTION_TYPES = {
+    cls.kind: cls  # type: ignore[attr-defined]
+    for cls in (KillTrainer, KillCoordinator, NetworkFlake, PreemptDomain,
+                CorruptCheckpoint, DiskFull)
+}
+
+
+# ---------------------------------------------------------------------------
+# FaultPlan
+# ---------------------------------------------------------------------------
+
+@dataclass
+class FaultPlan:
+    """An ordered campaign of fault actions plus the seed that named it."""
+
+    actions: list[FaultAction] = field(default_factory=list)
+    seed: Optional[int] = None
+
+    def describe(self) -> list[dict]:
+        """The reproducible audit view: what fires when, with what params.
+        Two plans built from the same seed describe identically — the
+        property the soak test pins."""
+        return [a.describe() for a in self.actions]
+
+    @classmethod
+    def random(cls, seed: int, *, n_faults: int = 6,
+               first_step: int = 5, last_step: int = 120,
+               min_gap: int = 8,
+               kinds: tuple[str, ...] = tuple(ACTION_TYPES),
+               flake_duration_s: float = 1.0) -> "FaultPlan":
+        """Derive a whole campaign deterministically from ``seed``:
+        ``n_faults`` actions drawn from ``kinds`` (each kind appears at
+        least once when ``n_faults`` allows), scheduled at strictly
+        increasing steps at least ``min_gap`` apart so each recovery has
+        room to land before the next strike."""
+        rng = random.Random(seed)
+        if n_faults < len(kinds):
+            # a shortened campaign draws its fault MIX from the seed too,
+            # not just its schedule — a fixed prefix of ACTION_TYPES would
+            # silently bar the tail kinds from ever appearing
+            chosen = rng.sample(list(kinds), n_faults)
+        else:
+            chosen = list(kinds)
+            while len(chosen) < n_faults:
+                chosen.append(rng.choice(kinds))
+        rng.shuffle(chosen)
+        span = max(last_step - first_step - min_gap * (n_faults - 1), 1)
+        offsets = sorted(rng.randrange(span) for _ in range(n_faults))
+        actions: list[FaultAction] = []
+        for i, kind in enumerate(chosen):
+            step = first_step + offsets[i] + min_gap * i
+            if kind == "network_flake":
+                mode = rng.choice(("reset", "delay", "blackhole"))
+                actions.append(NetworkFlake(at_step=step, mode=mode,
+                                            duration_s=flake_duration_s))
+            elif kind == "corrupt_checkpoint":
+                actions.append(CorruptCheckpoint(
+                    at_step=step, mode=rng.choice(("flip", "truncate"))))
+            elif kind == "disk_full":
+                actions.append(DiskFull(at_step=step, saves=1))
+            else:
+                actions.append(ACTION_TYPES[kind](at_step=step))
+        return cls(actions=actions, seed=seed)
+
+
+# ---------------------------------------------------------------------------
+# The engine
+# ---------------------------------------------------------------------------
+
+class FaultPlanEngine:
+    """Fires a :class:`FaultPlan` against a :class:`FaultContext` and
+    audits the recoveries.
+
+    Wire it into a training loop exactly like ChaosMonkey::
+
+        engine = FaultPlanEngine(plan, ctx)
+        runner.run(on_step=engine)
+
+    or drive wall-clock campaigns with periodic :meth:`tick` calls.  Each
+    call fires every due, not-yet-fired action (an action whose
+    preconditions aren't met — e.g. no running trainer to kill mid-reform
+    — stays armed and retries on the next call), then polls the pending
+    recovery predicates.  ``fired`` / ``recovered`` record the audit
+    trail; :meth:`quiescent` is the drill's exit condition.
+    """
+
+    def __init__(self, plan: FaultPlan, ctx: FaultContext,
+                 clock: Callable[[], float] = time.monotonic) -> None:
+        self.plan = plan
+        self.ctx = ctx
+        self._clock = clock
+        self._t0 = clock()
+        self._armed: list[FaultAction] = list(plan.actions)
+        self._pending: list[tuple[str, Callable[[], bool]]] = []
+        self._lock = threading.Lock()
+        #: (step, kind) of every action actually fired, in firing order
+        self.fired: list[tuple[int, str]] = []
+        #: kinds whose engine-watched recovery predicate turned true
+        self.recovered: list[str] = []
+
+    def __call__(self, step: int, loss: float = 0.0, world: int = 0) -> None:
+        self._advance(step)
+
+    def tick(self) -> None:
+        """Clock-only advance (time-triggered campaigns, idle polling)."""
+        self._advance(-1)
+
+    def quiescent(self) -> bool:
+        """True when every action has fired and every engine-watched
+        recovery has completed (checkpoint faults recover inside the
+        checkpointer and are not awaited here)."""
+        with self._lock:
+            return not self._armed and not self._pending
+
+    def unfired(self) -> list[dict]:
+        with self._lock:
+            return [a.describe() for a in self._armed]
+
+    # -- internals ----------------------------------------------------------
+
+    def _advance(self, step: int) -> None:
+        elapsed = self._clock() - self._t0
+        # claim due actions under the lock BEFORE firing: a concurrent
+        # on_step/tick caller (the documented wiring) must not fire the
+        # same action twice
+        with self._lock:
+            due = [a for a in self._armed if a.due(step, elapsed)]
+            for a in due:
+                self._armed.remove(a)
+        for action in due:
+            try:
+                outcome, recovery = action.fire(self.ctx)
+            except Exception as exc:
+                # a misconfigured action must not kill the drill loop —
+                # surface it in the audit trail and leave it disarmed
+                log.warn("fault action failed to fire", kind=action.kind,
+                         error=str(exc))
+                get_tracer().instant("fault_unfireable", category="chaos",
+                                     type=action.kind, error=str(exc)[:120])
+                continue
+            if outcome == RETRY:
+                with self._lock:  # re-arm; strikes when preconditions return
+                    self._armed.append(action)
+                continue
+            with self._lock:
+                self.fired.append((step, action.kind))
+                if recovery is not None:
+                    self._pending.append((action.kind, recovery))
+            get_tracer().instant("fault_injected", category="chaos",
+                                 type=action.kind, step=step,
+                                 elapsed_s=round(elapsed, 3))
+            get_counters().inc("faults_injected", type=action.kind)
+        self._check_recoveries(step)
+
+    def _check_recoveries(self, step: int) -> None:
+        with self._lock:
+            pending = list(self._pending)
+        for kind, predicate in pending:
+            try:
+                healed = bool(predicate())
+            except Exception:
+                healed = False  # probe hiccup ≠ recovery
+            if not healed:
+                continue
+            with self._lock:
+                if (kind, predicate) not in self._pending:
+                    continue  # a concurrent caller already recorded it
+                self._pending.remove((kind, predicate))
+                self.recovered.append(kind)
+            log.info("recovery completed", type=kind, step=step)
+            get_tracer().instant("recovery_completed", category="chaos",
+                                 type=kind, step=step)
+            get_counters().inc("recoveries_completed", type=kind)

@@ -584,14 +584,22 @@ def _world_child(plan: WorldPlan, cfg: WorkerConfig, result_path: str,
         except Exception:
             pass  # the cache is an optimization, never a failure
 
+    init_kwargs = dict(
+        coordinator_address=plan.coordinator,
+        num_processes=plan.world_size,
+        process_id=plan.rank,
+        initialization_timeout=max(int(cfg.init_timeout_s), 1),
+        heartbeat_timeout_seconds=cfg.heartbeat_timeout_s,
+    )
     try:
-        jax.distributed.initialize(
-            coordinator_address=plan.coordinator,
-            num_processes=plan.world_size,
-            process_id=plan.rank,
-            initialization_timeout=max(int(cfg.init_timeout_s), 1),
-            heartbeat_timeout_seconds=cfg.heartbeat_timeout_s,
-        )
+        try:
+            jax.distributed.initialize(**init_kwargs)
+        except TypeError:
+            # jax version drift: builds without the heartbeat kwarg
+            # (e.g. 0.4.x) must still form worlds — a default failure
+            # detector beats a world that aborts at every epoch forever
+            init_kwargs.pop("heartbeat_timeout_seconds", None)
+            jax.distributed.initialize(**init_kwargs)
     except Exception as exc:  # peer died mid-handshake → supervisor reforms
         print(f"[{cfg.name}] world init failed at epoch {plan.epoch}: "
               f"{str(exc)[:200]}", file=sys.stderr, flush=True)
@@ -905,6 +913,7 @@ def run_elastic_worker(
     # Reform timeline into the process tracer (the reference had no
     # tracing at all, SURVEY §5.1); EDL_MH_TRACE=<dir> dumps a chrome
     # trace per worker at exit for offline inspection of the dance.
+    from edl_tpu.observability.collector import get_counters
     from edl_tpu.observability.tracing import get_tracer
 
     tracer = get_tracer()
@@ -997,6 +1006,9 @@ def run_elastic_worker(
                          exitcode=child.exitcode)
                 tracer.instant("world_reform", category="membership",
                                epoch=plan.epoch, exitcode=child.exitcode)
+                # the reform IS the recovery transition for a crashed peer
+                # — auditable next to the chaos engine's injections
+                get_counters().inc("world_reforms")
                 if plan.rank == 0:
                     # The coordinator endpoint died with our child; clear
                     # the epoch's claim so a same-epoch reform binds a

@@ -454,7 +454,13 @@ def orbax_load_state(path: str, task=MlpTask()):
     }
     ckpt = ElasticCheckpointer(path, max_to_keep=1)
     try:
-        return ckpt.restore(abstract, shardings=shardings)
+        # parse_fallback=False: this is a collective restore — a
+        # host-local parse failure must kill this worker (supervisor
+        # reforms) rather than send one host to an older step than its
+        # peers.  The manifest-verify fallback still applies and is
+        # deterministic across hosts (same shared files).
+        return ckpt.restore(abstract, shardings=shardings,
+                            parse_fallback=False)
     finally:
         ckpt.close()
 

@@ -378,3 +378,102 @@ def test_health_port_negative_means_disabled():
         c.close()
     finally:
         h.stop()
+
+
+def test_health_shed_replies_503_not_reset():
+    """At the 8-in-flight probe cap the server must shed WITH a minimal
+    503 — a bare close reads as connection-reset, which kubelet probes
+    count toward the liveness failureThreshold exactly like a wedged
+    coordinator (ADVICE r5 item 4)."""
+    import socket
+    import time
+    import urllib.request
+
+    h = spawn_server(port=0, health_port=0)
+    try:
+        # park 8 idle connections in ServeHealth's read (5 s deadline)
+        held = [socket.create_connection(("127.0.0.1", h.health_port))
+                for _ in range(8)]
+        time.sleep(0.3)  # let the accept loop count them in-flight
+        s = socket.create_connection(("127.0.0.1", h.health_port), timeout=3)
+        s.sendall(b"GET /healthz HTTP/1.1\r\nHost: x\r\n\r\n")
+        s.settimeout(3)
+        resp = b""
+        try:
+            while chunk := s.recv(4096):
+                resp += chunk
+        except OSError:
+            pass
+        assert resp.startswith(b"HTTP/1.1 503"), resp
+        assert b"overloaded" in resp
+        s.close()
+        for c in held:
+            c.close()
+        time.sleep(0.5)  # slots drain
+        # overload over: probes are 200 again (overload != wedge)
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{h.health_port}/healthz", timeout=5) as r:
+            assert r.status == 200
+    finally:
+        h.stop()
+
+
+# ---------------------------------------------------------------------------
+# Client outage riding: jittered exponential backoff + degraded-mode hooks
+# ---------------------------------------------------------------------------
+
+
+def test_backoff_delay_envelope():
+    import random as _random
+
+    from edl_tpu.coord.client import BACKOFF_CAP_S, backoff_delay
+
+    rng = _random.Random(42)
+    delays = [backoff_delay(a, rng) for a in range(12)]
+    # jitter stays inside (d/2, d] of the exponential envelope
+    for attempt, d in enumerate(delays):
+        env = min(BACKOFF_CAP_S, 0.05 * 2 ** attempt)
+        assert env / 2 < d <= env, (attempt, d)
+    # the envelope grows to the cap and never beyond (no hot-spin, no
+    # unbounded stall; the huge-attempt form must not overflow either)
+    assert max(delays) <= BACKOFF_CAP_S
+    assert backoff_delay(10_000, rng) <= BACKOFF_CAP_S
+    # early retries are fast: a blip costs tens of ms, not 0.3 s
+    assert delays[0] < 0.06
+
+
+def test_client_degraded_hook_fires_during_outage(server):
+    """Kill nothing: dial a dead port.  The first-connect loop rides the
+    window; the degraded hook is the per-retry signal a trainer uses to
+    hold at a step boundary instead of treating the outage as fatal."""
+    import socket as _socket
+    import time
+
+    from edl_tpu.coord.client import CoordClient
+
+    # a port with nothing behind it (bind+close = likely free, refused)
+    s = _socket.socket()
+    s.bind(("127.0.0.1", 0))
+    dead_port = s.getsockname()[1]
+    s.close()
+    t0 = time.monotonic()
+    with pytest.raises(OSError):
+        CoordClient("127.0.0.1", dead_port, timeout=1.0,
+                    reconnect_window_s=0.8)
+    # the dial loop honored the window (with backoff, not a busy loop)
+    assert 0.7 < time.monotonic() - t0 < 10.0
+
+    # live server: break the connection under the client and watch the
+    # degraded → recovered transition fire exactly once each
+    c = server.client()
+    events = []
+    c.on_degraded = lambda attempt, elapsed: events.append(("deg", attempt))
+    c.on_recovered = lambda outage: events.append(("rec", outage))
+    # sever the live connection out from under the client (close() alone
+    # would not: the makefile reader still holds the fd open)
+    c._sock.shutdown(_socket.SHUT_RDWR)
+    assert c.ping()  # rides the reconnect window transparently
+    kinds = [k for k, _ in events]
+    assert "deg" in kinds and "rec" in kinds
+    assert kinds.index("deg") < kinds.index("rec")
+    c.close()

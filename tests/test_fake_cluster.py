@@ -148,3 +148,28 @@ def test_ici_domain_keeps_tpu_job_together(fake_cluster):
     nodes = {p.node for p in fake_cluster.list_pods(job_uid="default/j")
              if p.node is not None}
     assert len(nodes) == 1  # all placed pods share one domain
+
+
+def test_non_ft_job_failure_is_not_replaced(fake_cluster):
+    """Zero-failure budget enforced at the Job-controller level: once any
+    trainer of a non-fault_tolerant job Failed, reconcile must never
+    spawn a replacement — a replacement's frozen EDL_STATIC_PEERS would
+    disagree with the survivors' peer lists (ADVICE r5 item 3)."""
+    fake_cluster.add_node("n0", cpu_milli=8000, memory_mega=8000)
+    job = mk_job(lo=2, hi=2)
+    job.spec.fault_tolerant = False
+    fake_cluster.create_resources(job)
+    victim = fake_cluster.list_pods(job_uid="default/j", role="trainer")[0]
+    fake_cluster.kill_pod(victim.name)
+    counts = fake_cluster.job_pods(job)
+    assert counts.failed == 1
+    assert counts.running == 1  # the survivor only — no replacement
+    # and it stays that way across later reconciles
+    fake_cluster.reconcile()
+    assert fake_cluster.job_pods(job).running == 1
+    # the FT flavor of the same scenario DOES replace (contrast pin)
+    ft = mk_job(name="ft", lo=2, hi=2)
+    fake_cluster.create_resources(ft)
+    victim = fake_cluster.list_pods(job_uid="default/ft", role="trainer")[0]
+    fake_cluster.kill_pod(victim.name)
+    assert fake_cluster.job_pods(ft).running == 2

@@ -1,0 +1,528 @@
+"""Fault-plan engine: unit drills + the seeded multi-fault soak.
+
+The fast tests pin the engine's contract (seed determinism, the ChaosProxy
+middlebox, fire/recovery accounting on the fake cluster, the kubelet
+teardown race).  The slow soak is the acceptance drill: a randomized
+multi-fault campaign — coordinator kill, network flakes, domain
+preemption, trainer kills, checkpoint corruption, disk-full — against a
+real coord server (durable state file) behind the chaos proxy, driving a
+live elastic training loop on the fake cluster, asserting exactly-once
+task accounting, loss continuity across every recovery, auditable
+chaos counters/traces, and zero leaked processes.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import subprocess
+import threading
+import time
+
+import pytest
+
+from edl_tpu.api.types import (
+    JobPhase, RESOURCE_CPU, RESOURCE_MEMORY,
+    ResourceRequirements, TrainerSpec, TrainingJob, TrainingJobSpec,
+)
+from edl_tpu.cluster.base import PodPhase
+from edl_tpu.cluster.fake import FakeCluster, FakePod
+from edl_tpu.runtime.faults import (
+    ACTION_TYPES,
+    ChaosProxy,
+    CorruptCheckpoint,
+    DiskFull,
+    FaultContext,
+    FaultPlan,
+    FaultPlanEngine,
+    KillCoordinator,
+    KillTrainer,
+    NetworkFlake,
+    PreemptDomain,
+)
+
+
+def _ft_job(name="drill", lo=2, hi=4, fault_tolerant=True):
+    return TrainingJob(
+        name=name,
+        spec=TrainingJobSpec(
+            fault_tolerant=fault_tolerant,
+            trainer=TrainerSpec(
+                min_instance=lo, max_instance=hi,
+                resources=ResourceRequirements(
+                    requests={RESOURCE_CPU: "1", RESOURCE_MEMORY: "100M"},
+                    limits={RESOURCE_CPU: "1", RESOURCE_MEMORY: "100M"},
+                ),
+            ),
+        ),
+    )
+
+
+# ---------------------------------------------------------------------------
+# FaultPlan: seeded, reproducible campaigns
+# ---------------------------------------------------------------------------
+
+
+def test_fault_plan_same_seed_same_campaign():
+    """The reproduction contract: the seed IS the campaign."""
+    a = FaultPlan.random(1234)
+    b = FaultPlan.random(1234)
+    assert a.describe() == b.describe()
+    assert a.seed == 1234
+
+
+def test_fault_plan_covers_all_kinds_with_spacing():
+    plan = FaultPlan.random(7, n_faults=6, first_step=10, last_step=100,
+                            min_gap=8)
+    kinds = [d["kind"] for d in plan.describe()]
+    assert sorted(kinds) == sorted(ACTION_TYPES)
+    steps = [d["at_step"] for d in plan.describe()]
+    assert steps == sorted(steps)
+    assert all(b - a >= 8 for a, b in zip(steps, steps[1:]))
+    assert steps[0] >= 10
+
+
+def test_fault_plan_describe_carries_params():
+    plan = FaultPlan(actions=[
+        NetworkFlake(at_step=3, mode="blackhole", duration_s=2.5),
+        CorruptCheckpoint(at_step=9, mode="truncate"),
+        DiskFull(at_step=12, saves=2),
+    ])
+    assert plan.describe() == [
+        {"kind": "network_flake", "at_step": 3, "mode": "blackhole",
+         "duration_s": 2.5},
+        {"kind": "corrupt_checkpoint", "at_step": 9, "mode": "truncate"},
+        {"kind": "disk_full", "at_step": 12, "saves": 2},
+    ]
+
+
+# ---------------------------------------------------------------------------
+# Engine fire/recovery accounting on the fake cluster (no jax, no procs)
+# ---------------------------------------------------------------------------
+
+
+def test_engine_kill_and_preempt_with_recovery_counters():
+    from edl_tpu.observability.collector import get_counters
+
+    cluster = FakeCluster()
+    cluster.add_node("a0", cpu_milli=8000, memory_mega=64000,
+                     ici_domain="slice-a")
+    cluster.add_node("b0", cpu_milli=8000, memory_mega=64000,
+                     ici_domain="slice-b")
+    job = _ft_job()
+    cluster.create_resources(job)
+    plan = FaultPlan(actions=[KillTrainer(at_step=1),
+                              PreemptDomain(at_step=3)])
+    ctx = FaultContext(cluster=cluster, job=job, rng=random.Random(0))
+    engine = FaultPlanEngine(plan, ctx)
+
+    before = {k: get_counters().get("faults_injected", type=k)
+              for k in ("kill_trainer", "preempt_domain")}
+    engine(1)  # kill fires; reconcile replaces synchronously
+    assert [k for _, k in engine.fired] == ["kill_trainer"]
+    engine(2)  # recovery observed (replacement Running)
+    assert engine.recovered == ["kill_trainer"]
+    engine(3)  # whole-domain preemption: every pod in one domain dies
+    assert [k for _, k in engine.fired] == ["kill_trainer", "preempt_domain"]
+    engine(4)
+    assert engine.recovered == ["kill_trainer", "preempt_domain"]
+    assert engine.quiescent()
+    for k in ("kill_trainer", "preempt_domain"):
+        assert (get_counters().get("faults_injected", type=k)
+                == before[k] + 1)
+        assert get_counters().get("recoveries_completed", type=k) >= 1
+
+
+def test_engine_retries_action_without_victims():
+    """A fault whose preconditions are absent stays armed (mid-recovery
+    strikes retry) instead of being lost or crashing."""
+    cluster = FakeCluster()  # no nodes: pods all Pending, none Running
+    job = _ft_job()
+    cluster.create_resources(job)
+    plan = FaultPlan(actions=[KillTrainer(at_step=1)])
+    engine = FaultPlanEngine(plan, FaultContext(cluster=cluster, job=job))
+    engine(1)
+    assert engine.fired == [] and not engine.quiescent()
+    cluster.add_node("n0", cpu_milli=8000, memory_mega=64000)
+    cluster.reconcile()
+    engine(2)
+    assert [k for _, k in engine.fired] == ["kill_trainer"]
+
+
+def test_engine_unfireable_action_is_disarmed_not_fatal():
+    plan = FaultPlan(actions=[KillCoordinator(at_step=1)])
+    engine = FaultPlanEngine(plan, FaultContext())  # no kubelet, no restart
+    engine(1)  # must not raise
+    assert engine.fired == []
+    assert engine.quiescent()  # disarmed with a trace, drill continues
+
+
+# ---------------------------------------------------------------------------
+# ChaosProxy middlebox
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def echo_upstream():
+    """A tiny newline echo server standing in for the coord server."""
+    import socket as s
+
+    srv = s.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(8)
+    stop = threading.Event()
+
+    def serve():
+        while not stop.is_set():
+            try:
+                conn, _ = srv.accept()
+            except OSError:
+                return
+            def pump(c):
+                try:
+                    f = c.makefile("rb")
+                    while line := f.readline():
+                        c.sendall(b"echo " + line)
+                except OSError:
+                    pass
+                finally:
+                    c.close()
+            threading.Thread(target=pump, args=(conn,), daemon=True).start()
+
+    t = threading.Thread(target=serve, daemon=True)
+    t.start()
+    yield srv.getsockname()
+    stop.set()
+    srv.close()
+
+
+def test_proxy_forwards_and_resets(echo_upstream):
+    import socket as s
+
+    proxy = ChaosProxy(echo_upstream)
+    try:
+        c = s.create_connection((proxy.host, proxy.port), timeout=5)
+        c.sendall(b"hello\n")
+        f = c.makefile("rb")
+        assert f.readline() == b"echo hello\n"
+        assert proxy.reset_all() >= 1
+        # the severed connection is dead: EOF or reset on next read
+        c.settimeout(2)
+        try:
+            assert f.readline() == b""
+        except OSError:
+            pass
+        c.close()
+        # new connections work again immediately
+        c2 = s.create_connection((proxy.host, proxy.port), timeout=5)
+        c2.sendall(b"again\n")
+        assert c2.makefile("rb").readline() == b"echo again\n"
+        c2.close()
+    finally:
+        proxy.close()
+
+
+def test_proxy_blackhole_window_then_recovers(echo_upstream):
+    import socket as s
+
+    proxy = ChaosProxy(echo_upstream)
+    try:
+        c = s.create_connection((proxy.host, proxy.port), timeout=5)
+        f = c.makefile("rb")
+        c.sendall(b"one\n")
+        assert f.readline() == b"echo one\n"
+        proxy.blackhole(1.0)
+        assert proxy.faults_active()
+        c.sendall(b"lost\n")  # eaten by the window
+        c.settimeout(0.5)
+        with pytest.raises(OSError):
+            f.readline()
+        time.sleep(1.1)
+        assert not proxy.faults_active()
+        # the old connection's request was dropped mid-protocol; a fresh
+        # connection (what a reconnecting client does) works
+        c.close()
+        c2 = s.create_connection((proxy.host, proxy.port), timeout=5)
+        c2.sendall(b"back\n")
+        assert c2.makefile("rb").readline() == b"echo back\n"
+        c2.close()
+    finally:
+        proxy.close()
+
+
+def test_proxy_delay_window(echo_upstream):
+    import socket as s
+
+    proxy = ChaosProxy(echo_upstream)
+    try:
+        c = s.create_connection((proxy.host, proxy.port), timeout=5)
+        f = c.makefile("rb")
+        proxy.delay(1.0, per_chunk_s=0.3)
+        t0 = time.monotonic()
+        c.sendall(b"slow\n")
+        assert f.readline() == b"echo slow\n"
+        assert time.monotonic() - t0 >= 0.25
+        c.close()
+    finally:
+        proxy.close()
+
+
+def test_proxy_retargets_upstream(echo_upstream):
+    """set_upstream is the stable-endpoint story for a coordinator that
+    came back on a different port."""
+    import socket as s
+
+    proxy = ChaosProxy(("127.0.0.1", 1))  # nothing there yet
+    try:
+        c = s.create_connection((proxy.host, proxy.port), timeout=5)
+        # upstream dead: the proxy closes us (client reconnect path)
+        assert c.makefile("rb").readline() == b""
+        c.close()
+        proxy.set_upstream(*echo_upstream)
+        c2 = s.create_connection((proxy.host, proxy.port), timeout=5)
+        c2.sendall(b"routed\n")
+        assert c2.makefile("rb").readline() == b"echo routed\n"
+        c2.close()
+    finally:
+        proxy.close()
+
+
+# ---------------------------------------------------------------------------
+# Kubelet teardown race (ADVICE r5 item 2): a pod registered by an
+# in-flight _start_pod after stop()'s sweep must still be reaped
+# ---------------------------------------------------------------------------
+
+
+def test_kubelet_reaps_pod_spawned_during_stop(tmp_path, monkeypatch):
+    from edl_tpu.cluster import exec_kubelet as ek
+
+    cluster = FakeCluster()
+    kubelet = ek.ProcessKubelet(cluster, str(tmp_path))
+    pod = FakePod(name="ghost", job_uid="default/j", role="trainer",
+                  phase=PodPhase.RUNNING)
+    cluster._pods["ghost"] = pod
+    monkeypatch.setattr(
+        kubelet, "_container_for",
+        lambda p: {"command": ["sleep", "60"], "env": {}, "volumes": [],
+                   "mounts": {}})
+    monkeypatch.setattr(kubelet, "_pod_env",
+                        lambda p, c: dict(os.environ))
+    entered, release = threading.Event(), threading.Event()
+    real_popen = subprocess.Popen
+    spawned = []
+
+    def gated_popen(*args, **kwargs):
+        entered.set()
+        release.wait(10)  # hold the spawn past stop()'s kill sweep
+        proc = real_popen(*args, **kwargs)
+        spawned.append(proc)
+        return proc
+
+    monkeypatch.setattr(ek.subprocess, "Popen", gated_popen)
+    t = threading.Thread(target=kubelet._start_pod, args=(pod,))
+    t.start()
+    assert entered.wait(10)  # _start_pod passed its _stop check, pre-spawn
+    stopper = threading.Thread(target=kubelet.stop)
+    stopper.start()
+    time.sleep(0.3)  # stop() sets _stop and sweeps (ghost not registered)
+    release.set()  # the racing spawn lands NOW
+    t.join(timeout=15)
+    stopper.join(timeout=15)
+    assert spawned, "the gated spawn never ran"
+    proc = spawned[0]
+    deadline = time.monotonic() + 5
+    while proc.poll() is None and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert proc.poll() is not None, "pod process leaked through stop()"
+    assert "ghost" not in kubelet._procs
+
+
+# ---------------------------------------------------------------------------
+# THE SOAK: seeded randomized multi-fault campaign, end to end
+# ---------------------------------------------------------------------------
+
+SOAK_SEED = int(os.environ.get("EDL_FAULT_SEED", "11"))
+
+
+def _children_named(needle: str) -> list[int]:
+    """PIDs of live direct children of this process whose cmdline contains
+    ``needle`` (the leaked-process audit)."""
+    me = os.getpid()
+    out = []
+    for pid in os.listdir("/proc"):
+        if not pid.isdigit():
+            continue
+        try:
+            with open(f"/proc/{pid}/stat") as f:
+                parts = f.read().split()
+            if int(parts[3]) != me:
+                continue
+            with open(f"/proc/{pid}/cmdline", "rb") as f:
+                cmd = f.read().decode(errors="replace")
+        except (OSError, IndexError, ValueError):
+            continue
+        if needle in cmd:
+            out.append(int(pid))
+    return out
+
+
+@pytest.mark.slow
+def test_seeded_multi_fault_campaign_soak(tmp_path):
+    """Acceptance drill: ≥4 distinct fault types (all six here, including
+    coordinator kill, network flake and checkpoint corruption) fired from
+    one seed against a live elastic training loop.  Asserts exactly-once
+    task accounting, loss continuity/progress across recoveries, chaos
+    counters + trace events per fault type, plan reproducibility from the
+    seed, and zero leaked processes."""
+    import jax
+    import numpy as np
+    import optax
+
+    from edl_tpu.controller.controller import Controller
+    from edl_tpu.coord.client import CoordClient
+    from edl_tpu.coord.server import spawn_server
+    from edl_tpu.models import mlp
+    from edl_tpu.observability.collector import get_counters
+    from edl_tpu.observability.tracing import get_tracer
+    from edl_tpu.runtime.checkpoint import ElasticCheckpointer
+    from edl_tpu.runtime.data import ShardRegistry
+    from edl_tpu.runtime.elastic import ElasticTrainer
+    from edl_tpu.runtime.local import LocalElasticJob
+
+    counters = get_counters()
+    state_file = str(tmp_path / "coord.state")
+    handles = [spawn_server(state_file=state_file, task_timeout_ms=6000,
+                            member_ttl_ms=6000)]
+    proxy = ChaosProxy(("127.0.0.1", handles[0].port))
+
+    def restart_coordinator():
+        old = handles[-1]
+        old.process.kill()
+        old.process.wait(timeout=15)
+        handles.append(spawn_server(state_file=state_file,
+                                    task_timeout_ms=6000,
+                                    member_ttl_ms=6000))
+        proxy.set_upstream("127.0.0.1", handles[-1].port)
+
+    client = CoordClient("127.0.0.1", proxy.port, timeout=3.0,
+                         reconnect_window_s=40.0)
+    # two ICI domains so a domain preemption is a partial-cluster event
+    cluster = FakeCluster()
+    cluster.add_node("a0", cpu_milli=4000, memory_mega=64000,
+                     ici_domain="slice-a")
+    cluster.add_node("b0", cpu_milli=4000, memory_mega=64000,
+                     ici_domain="slice-b")
+    ctl = Controller(cluster, autoscaler_loop_seconds=0.02,
+                     updater_convert_seconds=0.02,
+                     updater_confirm_seconds=0.01)
+    ctl.start()
+    job = _ft_job()
+    ctl.submit(job)
+    deadline = time.monotonic() + 30
+    while ctl.phase(job) != JobPhase.RUNNING:
+        assert time.monotonic() < deadline, "job never started"
+        time.sleep(0.02)
+
+    # data: 32 shards × 256 rows ÷ batch 64 = 128 exactly-once steps
+    rng = np.random.default_rng(SOAK_SEED)
+    x = rng.normal(size=(8192, 16)).astype(np.float32)
+    y = rng.integers(0, 4, size=8192).astype(np.int32)
+    reg = ShardRegistry()
+    reg.add_arrays(client, (x, y), num_shards=32)
+
+    params = mlp.init(jax.random.key(SOAK_SEED), [16, 32, 4])
+    trainer = ElasticTrainer(mlp.loss_fn, params, optax.adam(1e-2),
+                             initial_world_size=2)
+    runner = LocalElasticJob(job, cluster, trainer, client, reg.fetch,
+                             batch_size=64)
+    ckpt = ElasticCheckpointer(tmp_path / "ckpt", max_to_keep=3)
+
+    plan = FaultPlan.random(SOAK_SEED, n_faults=6, first_step=10,
+                            last_step=100, min_gap=10)
+    # the seed IS the campaign: rebuilding the plan from the same seed
+    # must reproduce the exact fault sequence (the reproduction story
+    # doc/fault_drills.md documents)
+    assert plan.describe() == FaultPlan.random(
+        SOAK_SEED, n_faults=6, first_step=10, last_step=100,
+        min_gap=10).describe()
+    kinds = {d["kind"] for d in plan.describe()}
+    assert kinds == set(ACTION_TYPES)  # all six, incl. the required trio
+
+    ctx = FaultContext(cluster=cluster, job=job, coord=client, proxy=proxy,
+                       checkpointer=ckpt,
+                       restart_coordinator=restart_coordinator,
+                       rng=random.Random(SOAK_SEED))
+    engine = FaultPlanEngine(plan, ctx)
+    base = {
+        "corrupt": counters.get("recoveries_completed",
+                                type="corrupt_checkpoint"),
+        "disk": counters.get("recoveries_completed", type="disk_full"),
+    }
+    audited = []
+
+    def on_step(step, loss, world):
+        if step % 5 == 0:
+            ckpt.save(step, {"params": trainer.state.params,
+                             "opt": trainer.state.opt_state},
+                      best_effort=True)
+        engine(step, loss, world)
+        # corruption audit: the moment the corrupt fault has struck,
+        # exercise the restore path (before newer saves mask the damage)
+        # — the fallback must hand back an older verified step
+        if not audited and any(k == "corrupt_checkpoint"
+                               for _, k in engine.fired):
+            restored = ckpt.restore({"params": trainer.state.params,
+                                     "opt": trainer.state.opt_state})
+            audited.append(jax.tree.leaves(restored["params"])[0] is not None)
+
+    report = runner.run(on_step=on_step)
+
+    # every action fired; engine-watched recoveries all completed
+    deadline = time.monotonic() + 30
+    while not engine.quiescent() and time.monotonic() < deadline:
+        engine.tick()
+        time.sleep(0.1)
+    assert engine.quiescent(), (engine.unfired(), engine.recovered)
+    assert len(engine.fired) == 6, engine.fired
+    assert audited == [True]
+
+    # exactly-once task accounting across every fault (the coordinator
+    # was SIGKILL'd and restarted from its durable state mid-campaign)
+    stats = client.stats()
+    assert stats.done == 32, stats
+    assert stats.todo == 0 and stats.leased == 0 and stats.dropped == 0, stats
+
+    # training progress: monotone steps, every shard's batches trained at
+    # least once (128 exactly-once steps; a lease lost to a coordinator
+    # restart may legitimately retrain one shard)
+    assert report.steps >= 128
+    assert trainer.state.step == report.steps
+    losses = np.asarray(report.losses)
+    assert np.isfinite(losses).all()
+    assert losses[-10:].mean() < losses[:10].mean()  # it learned through it
+
+    # auditable evidence: counters + chaos trace events per fault type
+    for kind in ACTION_TYPES:
+        assert counters.get("faults_injected", type=kind) >= 1, kind
+    for kind in ("kill_trainer", "kill_coordinator", "network_flake",
+                 "preempt_domain"):
+        assert counters.get("recoveries_completed", type=kind) >= 1, kind
+    assert counters.get("recoveries_completed",
+                        type="corrupt_checkpoint") > base["corrupt"]
+    assert counters.get("recoveries_completed",
+                        type="disk_full") > base["disk"]
+    chaos_names = {e.name for e in get_tracer().events(category="chaos")}
+    assert "fault_injected" in chaos_names
+    assert "recovery_completed" in chaos_names
+
+    # teardown + the leaked-process audit: every server we ever spawned is
+    # reaped, and no edl-coord-server child of this process survives
+    ctl.stop()
+    client.close()
+    proxy.close()
+    ckpt.close()
+    for h in handles:
+        h.stop()
+    for h in handles:
+        assert h.process.poll() is not None
+    assert _children_named("edl-coord-server") == []

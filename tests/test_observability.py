@@ -174,3 +174,66 @@ class TestServeHealth:
                 srv2.shutdown()
         finally:
             srv.shutdown()
+
+
+class TestCounters:
+    """Labeled counters — the chaos/recovery audit surface the fault-plan
+    engine records into (faults_injected / recoveries_completed)."""
+
+    def test_inc_get_with_labels(self):
+        from edl_tpu.observability.collector import Counters
+
+        c = Counters()
+        assert c.get("faults_injected", type="kill_trainer") == 0
+        c.inc("faults_injected", type="kill_trainer")
+        c.inc("faults_injected", type="kill_trainer")
+        c.inc("faults_injected", type="network_flake")
+        assert c.get("faults_injected", type="kill_trainer") == 2
+        assert c.get("faults_injected", type="network_flake") == 1
+        assert c.total("faults_injected") == 3
+        assert c.get("recoveries_completed", type="kill_trainer") == 0
+
+    def test_snapshot_and_clear(self):
+        from edl_tpu.observability.collector import Counters
+
+        c = Counters()
+        c.inc("plain")
+        c.inc("labeled", n=3, type="x")
+        snap = c.snapshot()
+        assert snap["plain"] == 1
+        assert snap["labeled{type=x}"] == 3
+        c.clear()
+        assert c.snapshot() == {}
+
+    def test_label_order_is_canonical(self):
+        from edl_tpu.observability.collector import Counters
+
+        c = Counters()
+        c.inc("m", a="1", b="2")
+        assert c.get("m", b="2", a="1") == 1
+
+    def test_process_wide_registry(self):
+        from edl_tpu.observability import get_counters
+
+        g = get_counters()
+        before = g.get("test_obs_probe")
+        g.inc("test_obs_probe")
+        assert get_counters().get("test_obs_probe") == before + 1
+
+    def test_thread_safety(self):
+        import threading
+
+        from edl_tpu.observability.collector import Counters
+
+        c = Counters()
+
+        def bump():
+            for _ in range(1000):
+                c.inc("hot", type="t")
+
+        threads = [threading.Thread(target=bump) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert c.get("hot", type="t") == 8000

@@ -481,3 +481,117 @@ def test_should_respawn_warm_predicate():
     assert not _should_respawn_warm(2.0, was_warm=False, warm_delay_s=2.0)
     assert not _should_respawn_warm(9.9, was_warm=False, warm_delay_s=2.0)
     assert _should_respawn_warm(10.0, was_warm=False, warm_delay_s=2.0)
+
+
+# -- checkpoint integrity: corruption detection + fallback restore -----------
+
+
+def _ckpt_with_steps(tmp_path, steps=(1, 2, 3)):
+    import numpy as np
+
+    ck = ElasticCheckpointer(tmp_path / "ickpt", max_to_keep=len(steps) + 1)
+    for s in steps:
+        ck.save(s, {"w": np.full(16, float(s), np.float32),
+                    "step": np.asarray(s, np.int32)})
+    return ck
+
+
+def _largest_file(ck, step):
+    files = [p for p in ck._step_dir(step).rglob("*") if p.is_file()]
+    return max(files, key=lambda p: (p.stat().st_size, str(p)))
+
+
+def _like():
+    import numpy as np
+
+    return {"w": np.zeros(16, np.float32), "step": np.asarray(0, np.int32)}
+
+
+def test_restore_falls_back_on_flipped_bytes(tmp_path, caplog):
+    """A bit-flipped newest step fails the integrity manifest; restore()
+    transparently returns the previous verified step with a warning."""
+    from edl_tpu.observability.collector import get_counters
+
+    ck = _ckpt_with_steps(tmp_path)
+    victim = _largest_file(ck, 3)
+    data = bytearray(victim.read_bytes())
+    data[len(data) // 2] ^= 0xFF
+    victim.write_bytes(bytes(data))
+    assert not ck.verify(3)
+    assert ck.latest_verified_step() == 2
+
+    before = get_counters().get("recoveries_completed",
+                                type="corrupt_checkpoint")
+    with caplog.at_level("WARNING"):
+        out = ck.restore(_like())
+    assert int(out["step"]) == 2
+    assert float(out["w"][0]) == 2.0
+    assert any("integrity" in r.message or "falling back" in r.message
+               for r in caplog.records)
+    assert get_counters().get("recoveries_completed",
+                              type="corrupt_checkpoint") == before + 1
+    ck.close()
+
+
+def test_restore_falls_back_on_truncated_file(tmp_path):
+    """A torn write (truncated file, the power-loss shape) is caught the
+    same way — sizes are part of the manifest."""
+    ck = _ckpt_with_steps(tmp_path)
+    victim = _largest_file(ck, 3)
+    data = victim.read_bytes()
+    victim.write_bytes(data[: len(data) // 2])
+    assert not ck.verify(3)
+    out = ck.restore(_like())
+    assert int(out["step"]) == 2
+    ck.close()
+
+
+def test_restore_explicit_step_also_falls_back(tmp_path):
+    """Asking for a specific corrupted step still degrades gracefully to
+    an older verified one instead of crashing the reform."""
+    ck = _ckpt_with_steps(tmp_path)
+    victim = _largest_file(ck, 2)
+    victim.write_bytes(b"")
+    out = ck.restore(_like(), step=2)
+    assert int(out["step"]) == 1
+    ck.close()
+
+
+def test_restore_raises_when_every_step_corrupt(tmp_path):
+    from edl_tpu.runtime.checkpoint import CheckpointCorruption
+
+    ck = _ckpt_with_steps(tmp_path, steps=(1, 2))
+    for s in (1, 2):
+        _largest_file(ck, s).write_bytes(b"garbage")
+    with pytest.raises(CheckpointCorruption):
+        ck.restore(_like())
+    ck.close()
+
+
+def test_disk_full_save_degrades_and_recovers(tmp_path):
+    """ENOSPC at the persist boundary: best_effort saves skip-and-log
+    instead of crashing, and the first subsequent success is counted as
+    the disk_full recovery transition."""
+    import numpy as np
+
+    from edl_tpu.observability.collector import get_counters
+
+    ck = ElasticCheckpointer(tmp_path / "dfull")
+    tree = {"w": np.ones(4, np.float32)}
+    assert ck.save(1, tree)
+    ck.inject_save_failures(2)
+    before = get_counters().get("recoveries_completed", type="disk_full")
+    assert ck.save(2, tree, best_effort=True) is False
+    assert ck.save(3, tree, best_effort=True) is False
+    # non-best-effort callers still see the raw error
+    ck.inject_save_failures(1)
+    with pytest.raises(OSError):
+        ck.save(4, tree)
+    assert ck.save(5, tree, best_effort=True) is True
+    assert get_counters().get("recoveries_completed",
+                              type="disk_full") == before + 1
+    # the failed steps were never persisted; the good ones were
+    assert sorted(ck._mgr.all_steps()) == [1, 5]
+    out = ck.restore({"w": np.zeros(4, np.float32)})
+    assert float(out["w"][0]) == 1.0
+    ck.close()
