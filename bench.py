@@ -852,9 +852,19 @@ def elastic_leg() -> dict:
     runner = LocalElasticJob(job, cluster, trainer, coord, reg.fetch,
                              batch_size=64)
 
+    # live stall watchdog over the leg's own step progress: the
+    # stalls_detected field below is a real tripwire (a hang mid-leg
+    # shows up in the artifact instead of wedging the bench), not a
+    # counter that can never move
+    from edl_tpu.runtime.watchdog import StallWatchdog
+
+    watchdog = StallWatchdog(floor_s=30.0, k=8.0, scope="bench-elastic")
+    watchdog.start(poll_s=1.0)
+
     contended = []
 
     def on_step(step, loss, world):
+        watchdog.beat(step)
         if step == 100 and not contended:  # the competing online service
             for i in range(4):
                 cluster.add_system_pod(f"nginx-{i}", "n0",
@@ -864,7 +874,10 @@ def elastic_leg() -> dict:
         time.sleep(0.002)
 
     t0 = time.perf_counter()
-    report = runner.run(on_step=on_step)
+    try:
+        report = runner.run(on_step=on_step)
+    finally:
+        watchdog.stop()  # a failed leg must not leak the poller thread
     wall = time.perf_counter() - t0
     ctl.stop()
 
@@ -895,10 +908,20 @@ def elastic_leg() -> dict:
         raise RuntimeError(
             f"elastic leg: {report.resizes} resizes but {len(ratios)} "
             f"continuity ratios (resize_steps={report.resize_steps})")
+    from edl_tpu.observability.collector import get_counters
+
     return {
         "steps": report.steps,
         "wall_seconds": round(wall, 1),
         "resizes": report.resizes,
+        # robustness counters (PR 2): a healthy leg shows zero of both —
+        # a nonzero value in a bench artifact is the audit trail for a
+        # rolled-back resize or a hang the leg's own watchdog (above)
+        # caught during the run.  Scoped read: another leg's (or
+        # library's) watchdog must not be misattributed to this one.
+        "resizes_failed": trainer.resizes_failed,
+        "stalls_detected": get_counters().get("stalls_detected",
+                                              scope="bench-elastic"),
         "world_size_max": int(max(report.world_sizes)),
         "world_size_min_after_peak": int(min(
             report.world_sizes[report.world_sizes.index(
@@ -1322,6 +1345,8 @@ def main() -> None:
         "graceful_reform_s": reform.get("graceful_reform_s"),
         "join_from_spawn_s": reform.get("join_total_from_spawn_s"),
         "elastic_resizes": elastic.get("resizes"),
+        "elastic_resizes_failed": elastic.get("resizes_failed"),
+        "elastic_stalls_detected": elastic.get("stalls_detected"),
         "elastic_loss_ratios": elastic.get("loss_ratio_at_resizes"),
         "tpu_world_cycle": tpu_cycle.get("tpu_world_cycle",
                                          tpu_cycle.get("error")),

@@ -44,6 +44,8 @@ class Controller:
         autoscaler_loop_seconds: float = 5.0,
         updater_convert_seconds: float = 10.0,
         updater_confirm_seconds: float = 5.0,
+        resize_cooldown_s: float = 0.0,
+        min_resize_delta: int = 1,
     ) -> None:
         self.cluster = cluster
         self.autoscaler = Autoscaler(
@@ -51,6 +53,8 @@ class Controller:
             max_load_desired=max_load_desired,
             shape_policy=shape_policy,
             loop_seconds=autoscaler_loop_seconds,
+            resize_cooldown_s=resize_cooldown_s,
+            min_resize_delta=min_resize_delta,
         )
         self._updater_convert_seconds = updater_convert_seconds
         self._updater_confirm_seconds = updater_confirm_seconds

@@ -10,41 +10,138 @@ The handler evaluates named liveness checks on every request, so a dead
 autoscaler or sync thread flips the endpoint to 503 and the kubelet
 restarts the pod — the failure mode the round-3 verdict flagged (a wedged
 control-plane pod that nobody restarts).
+
+Each check runs with a **per-check timeout** in its own daemon thread: one
+wedged check used to block the probe thread inline, making the pod look
+dead for the wrong reason (and a wedged check IS the stall failure mode
+the watchdog exists for — the health surface must not share its fate).  A
+check that breaches its timeout reports unhealthy with ``timed_out`` set,
+and every check's latency is included in the JSON body so a probe log
+doubles as a latency trace of the control plane's internals.
 """
 
 from __future__ import annotations
 
 import json
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Callable, Mapping
+from typing import Callable, Mapping, Optional
+
+#: a liveness check answering slower than this is as good as dead — the
+#: kubelet's own probe timeout is typically 1 s
+DEFAULT_CHECK_TIMEOUT_S = 2.0
+
+
+class _InFlight:
+    """One check evaluation, shareable between concurrent probes."""
+
+    __slots__ = ("thread", "t0", "result")
+
+    def __init__(self) -> None:
+        self.thread: Optional[threading.Thread] = None
+        self.t0 = time.monotonic()
+        self.result: dict = {}  # {"ok": bool, "latency_s": float} on done
+
+
+class _CheckRunner:
+    """Runs the named checks concurrently with a shared deadline, and
+    never stacks threads on a wedged check: each check has at most ONE
+    evaluation in flight.  Concurrent probes (ThreadingHTTPServer —
+    liveness + readiness + a dashboard can overlap) SHARE that
+    evaluation and all read its result; only an evaluation that has
+    already outlived its own ``timeout_s`` budget is reported stuck
+    without waiting.  A permanently wedged check therefore costs one
+    leaked daemon thread total, probe latency is bounded by
+    max(check_timeout_s) rather than the sum, and an overlapping probe
+    can never 503 a healthy pod."""
+
+    def __init__(self, checks: Mapping[str, Callable[[], bool]],
+                 timeout_s: float) -> None:
+        self._checks = checks
+        self._timeout_s = timeout_s
+        self._lock = threading.Lock()
+        self._in_flight: dict[str, _InFlight] = {}
+
+    def _get_or_spawn(self, name: str, fn: Callable[[], bool]) -> _InFlight:
+        with self._lock:
+            prev = self._in_flight.get(name)
+            if (prev is not None and prev.thread is not None
+                    and prev.thread.is_alive()):
+                return prev  # share the evaluation another probe started
+            entry = _InFlight()
+
+            def call() -> None:
+                t0 = time.monotonic()
+                try:
+                    ok = bool(fn())
+                except Exception:
+                    ok = False
+                # latency measured INSIDE the evaluation: join order in
+                # run_all must not inflate a fast check's number
+                entry.result = {"ok": ok,
+                                "latency_s": time.monotonic() - t0}
+
+            entry.thread = threading.Thread(target=call, daemon=True,
+                                            name=f"healthz-{name}")
+            self._in_flight[name] = entry
+            entry.thread.start()
+            return entry
+
+    def run_all(self) -> dict[str, dict]:
+        entries = {name: self._get_or_spawn(name, fn)
+                   for name, fn in self._checks.items()}
+        deadline = time.monotonic() + self._timeout_s
+        detail: dict[str, dict] = {}
+        for name, entry in entries.items():  # concurrent: shared deadline
+            stuck = time.monotonic() - entry.t0 > self._timeout_s
+            if not stuck:
+                entry.thread.join(
+                    timeout=max(deadline - time.monotonic(), 0.0))
+            timed_out = entry.thread.is_alive()
+            latency = (entry.result.get("latency_s")
+                       if not timed_out else time.monotonic() - entry.t0)
+            detail[name] = {
+                "ok": False if timed_out else entry.result.get("ok", False),
+                "latency_ms": round((latency or 0.0) * 1000, 2),
+                "timed_out": timed_out,
+            }
+            if timed_out and stuck:
+                # outlived its own budget before this probe even began
+                detail[name]["stuck"] = True
+        return detail
 
 
 def serve_health(port: int,
                  checks: Mapping[str, Callable[[], bool]],
-                 host: str = "0.0.0.0") -> ThreadingHTTPServer:
+                 host: str = "0.0.0.0",
+                 check_timeout_s: float = DEFAULT_CHECK_TIMEOUT_S,
+                 ) -> ThreadingHTTPServer:
     """Serve ``GET /healthz`` on ``port`` in a daemon thread.
 
-    200 + ``{"status": "ok", ...}`` when every check passes, 503 when any
-    fails (each check's boolean is included by name).  ``port`` 0 binds an
-    OS-assigned port — read it from ``.server_address[1]``.  Call
+    200 when every check passes, 503 when any fails or breaches
+    ``check_timeout_s``.  Checks run concurrently under one shared
+    deadline (probe latency ≈ the slowest check, capped at the timeout),
+    and a check still wedged from a previous probe is reported stuck
+    immediately without spawning another thread.  The body carries both
+    the flat per-check booleans (``{"sync": true, ...}`` — the shape
+    probes and dashboards already parse) and a ``checks`` detail map
+    with per-check ``latency_ms`` and ``timed_out``.  ``port`` 0 binds
+    an OS-assigned port — read it from ``.server_address[1]``.  Call
     ``.shutdown()`` to stop.
     """
+    runner = _CheckRunner(checks, check_timeout_s)
 
     class Handler(BaseHTTPRequestHandler):
         def do_GET(self) -> None:  # noqa: N802 (http.server API)
             if self.path not in ("/", "/healthz"):
                 self.send_error(404)
                 return
-            results = {}
-            for name, fn in checks.items():
-                try:
-                    results[name] = bool(fn())
-                except Exception:
-                    results[name] = False
+            detail = runner.run_all()
+            results = {name: d["ok"] for name, d in detail.items()}
             ok = all(results.values())
-            body = json.dumps(
-                {"status": "ok" if ok else "unhealthy", **results}).encode()
+            body = json.dumps({"status": "ok" if ok else "unhealthy",
+                               **results, "checks": detail}).encode()
             self.send_response(200 if ok else 503)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))

@@ -24,6 +24,8 @@ _EXPORTS = {
     "FaultContext": ("edl_tpu.runtime.faults", "FaultContext"),
     "FaultPlan": ("edl_tpu.runtime.faults", "FaultPlan"),
     "FaultPlanEngine": ("edl_tpu.runtime.faults", "FaultPlanEngine"),
+    "StallWatchdog": ("edl_tpu.runtime.watchdog", "StallWatchdog"),
+    "Stall": ("edl_tpu.runtime.watchdog", "Stall"),
 }
 
 __all__ = list(_EXPORTS)

@@ -47,6 +47,22 @@ class CoordDiscovery:
         self.address = address
         self.member_id: Optional[int] = None
         self._beat_thread: Optional[threading.Thread] = None
+        #: set by the keepalive when an eviction marker names this worker
+        self.evicted = False
+
+    def _eviction_marker(self) -> bool:
+        """True when a peer wrote an eviction marker for this worker
+        (multihost straggler eviction — see ElasticWorld.evict).  The
+        keepalive consults this before an expiry-rejoin: without the
+        check, the evicted worker's beat thread would undo the eviction
+        forever (leave → heartbeat False → rejoin → leave → ...)."""
+        kv_get = getattr(self._client, "kv_get", None)
+        if kv_get is None:
+            return False
+        try:
+            return kv_get(f"evict/{self.name}") is not None
+        except Exception:
+            return False  # coordinator unreachable ≠ evicted
 
     def join(self) -> int:
         """Register this worker; returns the membership epoch after join."""
@@ -98,7 +114,13 @@ class CoordDiscovery:
                         # a blip longer than the TTL — rejoin rather than
                         # staying out of membership forever.  The stop
                         # check keeps a late beat from re-registering a
-                        # worker that is deliberately leaving.
+                        # worker that is deliberately leaving.  UNLESS a
+                        # peer evicted us (straggler vote): the marker
+                        # overrules the rejoin, or the eviction would be
+                        # undone every TTL forever.
+                        if self._eviction_marker():
+                            self.evicted = True
+                            return  # stay out; stop beating entirely
                         self._client.join(self.name, self.address)
                 except (OSError, CoordError):
                     pass  # coordinator briefly unreachable; retry next tick

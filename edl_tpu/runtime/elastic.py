@@ -14,6 +14,13 @@ membership change is handled by
 
 Step functions are compiled once per mesh size and cached, so oscillating
 between sizes does not recompile.
+
+Resizes are **transactional**: the new mesh, shardings, and compiled step
+are staged and the live state is resharded into fresh buffers before
+anything is committed.  A failure anywhere mid-resize (compile error,
+OOM during ``device_put``) rolls back to the previous mesh — the trainer
+keeps stepping on the world it had, with a ``resizes_failed`` counter as
+the audit trail, instead of being stranded with half-moved state.
 """
 
 from __future__ import annotations
@@ -25,7 +32,9 @@ from typing import Any, Callable, Optional, Sequence
 import jax
 import optax
 
+from edl_tpu.observability.collector import get_counters
 from edl_tpu.observability.logging import get_logger
+from edl_tpu.observability.tracing import get_tracer
 from edl_tpu.parallel.mesh import (
     MeshSpec,
     dp_sharding,
@@ -36,11 +45,34 @@ from edl_tpu.parallel.mesh import (
 log = get_logger("runtime.elastic")
 
 
+def _reshard(tree: Any, shardings: Any) -> Any:
+    """The reshard hop (seam for fault injection in tests): device_put
+    with NamedShardings moves/reshards across device sets in one hop."""
+    return jax.device_put(tree, shardings)
+
+
 @dataclass
 class TrainState:
     params: Any
     opt_state: Any
     step: int = 0
+
+
+@dataclass
+class _MeshBundle:
+    """Everything bound to ONE concrete mesh, staged and committed as a
+    unit.  Cached per (size, device ids): a resize back to a previously
+    seen size must reuse the exact Mesh object its jitted functions were
+    compiled against — rebuilding "equal" shardings over a fresh Mesh
+    leaves the cached executable bound to the old object (the stale
+    step-cache bug this dataclass exists to make impossible)."""
+
+    mesh: Any
+    param_shardings: Any
+    opt_shardings: Any
+    batch_sharding: Any
+    step_fn: Callable = None
+    eval_fn: Callable = None
 
 
 class ElasticTrainer:
@@ -68,13 +100,16 @@ class ElasticTrainer:
         self.spec = spec
         self.param_sharding_kind = param_sharding
         self._devices = list(devices) if devices is not None else jax.devices()
-        self._step_cache: dict[int, Callable] = {}
+        self._step_cache: dict[tuple[int, tuple], _MeshBundle] = {}
         self.resizes = 0
+        self.resizes_failed = 0
         self.mesh = None
         self.state = TrainState(params=params,
                                 opt_state=optimizer.init(params))
         n0 = initial_world_size or len(self._devices)
-        self._build(n0)
+        # the first build has no previous mesh to fall back to — a
+        # failure here is a constructor failure, not a rollback
+        self._commit(*self._stage(n0))
 
     # -- public API --------------------------------------------------------
 
@@ -82,16 +117,39 @@ class ElasticTrainer:
     def world_size(self) -> int:
         return self.mesh.size
 
-    def resize(self, n_devices: int) -> None:
-        """Rebuild the mesh over ``n_devices`` and reshard live state."""
+    def resize(self, n_devices: int) -> bool:
+        """Rebuild the mesh over ``n_devices`` and reshard live state.
+
+        Transactional: the new world is fully staged (mesh, shardings,
+        compiled step, state resharded into fresh buffers) before the
+        commit.  On any mid-resize failure the previous mesh stays live
+        and the trainer keeps stepping on it; returns False and bumps
+        ``resizes_failed``.  Returns True on success (or no-op).
+        """
         if n_devices == self.world_size:
-            return
+            return True
         t0 = time.monotonic()
-        self._build(n_devices)
+        try:
+            bundle, new_params, new_opt = self._stage(n_devices)
+        except Exception as exc:
+            # nothing was committed: self.mesh/_step_fn/state are the
+            # previous world's, still coherent — keep training on them
+            self.resizes_failed += 1
+            log.warn("mesh resize failed; rolled back",
+                     want_size=n_devices, keep_size=self.world_size,
+                     step=self.state.step, error=str(exc)[:200])
+            get_tracer().instant("resize_rolled_back", category="chaos",
+                                 want_size=n_devices,
+                                 keep_size=self.world_size,
+                                 error=str(exc)[:120])
+            get_counters().inc("resizes_failed")
+            return False
+        self._commit(bundle, new_params, new_opt)
         self.resizes += 1
         log.info("mesh resized", world_size=n_devices,
                  reshard_ms=round((time.monotonic() - t0) * 1000, 1),
                  step=self.state.step)
+        return True
 
     def step(self, batch) -> float:
         """One training step on the current mesh; returns the scalar loss."""
@@ -108,27 +166,56 @@ class ElasticTrainer:
 
     # -- internals ---------------------------------------------------------
 
-    def _build(self, n_devices: int) -> None:
-        self.mesh = make_mesh(n_devices, self.spec, devices=self._devices)
-        self._param_shardings = tree_shardings(
-            self.mesh, self.state.params, self.param_sharding_kind
-        )
-        self._opt_shardings = tree_shardings(
-            self.mesh, self.state.opt_state, self.param_sharding_kind
-        )
-        self._batch_sharding = dp_sharding(self.mesh)
-        # Reshard live state onto the new mesh. device_put with a
-        # NamedSharding moves/reshards across device sets in one hop.
-        self.state.params = jax.device_put(self.state.params,
-                                           self._param_shardings)
-        self.state.opt_state = jax.device_put(self.state.opt_state,
-                                              self._opt_shardings)
-        key = n_devices
-        if key not in self._step_cache:
-            self._step_cache[key] = self._compile_step()
-        self._step_fn, self._eval_fn = self._step_cache[key]
+    def _cache_key(self, n_devices: int) -> tuple[int, tuple]:
+        """Cache key for a world of ``n_devices``: size + the identities
+        of the devices it would span.  Size alone is NOT enough — it let
+        a resize back to a previously-seen size reuse jitted functions
+        whose captured shardings were bound to the *old* Mesh object."""
+        return n_devices, tuple(
+            getattr(d, "id", i) for i, d in
+            enumerate(self._devices[:n_devices]))
 
-    def _compile_step(self):
+    def _stage(self, n_devices: int) -> tuple[_MeshBundle, Any, Any]:
+        """Build (or fetch) everything the new world needs WITHOUT
+        touching live state: the mesh bundle plus the state resharded
+        into fresh buffers.  device_put copies — the previous arrays stay
+        valid until :meth:`_commit`, which is what makes rollback free."""
+        key = self._cache_key(n_devices)
+        bundle = self._step_cache.get(key)
+        if bundle is None:
+            mesh = make_mesh(n_devices, self.spec, devices=self._devices)
+            bundle = _MeshBundle(
+                mesh=mesh,
+                param_shardings=tree_shardings(
+                    mesh, self.state.params, self.param_sharding_kind),
+                opt_shardings=tree_shardings(
+                    mesh, self.state.opt_state, self.param_sharding_kind),
+                batch_sharding=dp_sharding(mesh),
+            )
+            bundle.step_fn, bundle.eval_fn = self._compile_step(bundle)
+            # cache only once fully compiled: a compile that failed
+            # halfway must not leave a poisoned entry for the retry.  A
+            # later reshard failure (OOM) keeps the entry — the compiled
+            # world is still valid and the retry skips the compile.
+            self._step_cache[key] = bundle
+        new_params = _reshard(self.state.params, bundle.param_shardings)
+        new_opt = _reshard(self.state.opt_state, bundle.opt_shardings)
+        return bundle, new_params, new_opt
+
+    def _commit(self, bundle: _MeshBundle, new_params: Any,
+                new_opt: Any) -> None:
+        """The commit point: after this the trainer is entirely on the
+        new world.  Pure assignments — nothing here can fail halfway."""
+        self.mesh = bundle.mesh
+        self._param_shardings = bundle.param_shardings
+        self._opt_shardings = bundle.opt_shardings
+        self._batch_sharding = bundle.batch_sharding
+        self._step_fn = bundle.step_fn
+        self._eval_fn = bundle.eval_fn
+        self.state.params = new_params
+        self.state.opt_state = new_opt
+
+    def _compile_step(self, bundle: _MeshBundle):
         grad_fn = jax.value_and_grad(self.loss_fn)
         optimizer = self.optimizer
 
@@ -140,13 +227,14 @@ class ElasticTrainer:
 
         jitted = jax.jit(
             train_step,
-            in_shardings=(self._param_shardings, self._opt_shardings,
-                          self._batch_sharding),
-            out_shardings=(self._param_shardings, self._opt_shardings, None),
+            in_shardings=(bundle.param_shardings, bundle.opt_shardings,
+                          bundle.batch_sharding),
+            out_shardings=(bundle.param_shardings, bundle.opt_shardings,
+                           None),
             donate_argnums=(0, 1),
         )
         jitted_eval = jax.jit(
             self.loss_fn,
-            in_shardings=(self._param_shardings, self._batch_sharding),
+            in_shardings=(bundle.param_shardings, bundle.batch_sharding),
         )
         return jitted, jitted_eval

@@ -9,7 +9,9 @@ makes those scenarios **programmable, deterministic and auditable**:
 
 * a :class:`FaultPlan` is an ordered campaign of typed fault actions
   (:class:`KillTrainer`, :class:`KillCoordinator`, :class:`NetworkFlake`,
-  :class:`PreemptDomain`, :class:`CorruptCheckpoint`, :class:`DiskFull`)
+  :class:`PreemptDomain`, :class:`CorruptCheckpoint`, :class:`DiskFull`,
+  plus the quiet pair :class:`StallStep` / :class:`WedgeCollective` that
+  hang instead of crash — the faults only the stall watchdog can see)
   fired on step or wall-clock triggers; :meth:`FaultPlan.random` derives a
   whole campaign from a single seed, so any drill is reproducible from the
   integer that named it;
@@ -242,6 +244,14 @@ class FaultContext:
     #: non-kubelet drills: SIGKILL + respawn the coord server process
     #: (durable state file carries recovery) — provided by the harness
     restart_coordinator: Optional[Callable[[], None]] = None
+    #: quiet-failure hooks (the watchdog drills).  ``stall`` wedges the
+    #: training loop for a duration (None = until escalation unwedges
+    #: it); ``wedge`` freezes one collective participant (e.g. SIGSTOP a
+    #: live world child), returning False when there is nothing to
+    #: freeze yet.  Both are harness-installed: the fault describes WHAT
+    #: hangs, the harness knows HOW.
+    stall: Optional[Callable[[Optional[float]], None]] = None
+    wedge: Optional[Callable[[], bool]] = None
     rng: random.Random = field(default_factory=random.Random)
 
     def running_trainers(self) -> list:
@@ -533,11 +543,68 @@ class DiskFull(FaultAction):
         return {**super().describe(), "saves": self.saves}
 
 
+def _stalls_detected_total() -> int:
+    return get_counters().total("stalls_detected")
+
+
+@dataclass
+class StallStep(FaultAction):
+    """The QUIET failure: the training loop wedges mid-step — no crash,
+    no closed socket, the host keeps heartbeating.  Nothing in the crash
+    path ever notices; only the :class:`~edl_tpu.runtime.watchdog.\
+StallWatchdog`'s EWMA deadline does.  ``duration_s=None`` hangs until
+    the escalation ladder unwedges it (the honest drill: detection IS
+    the recovery trigger).  Recovery is observed as the watchdog's
+    ``stalls_detected`` counter moving — the drill asserts the hang was
+    *detected*, the escalation path owns what happens next."""
+
+    duration_s: Optional[float] = None
+
+    kind: str = "stall_step"
+
+    def fire(self, ctx: FaultContext):
+        if ctx.stall is None:
+            raise RuntimeError("StallStep needs a stall hook in the ctx")
+        before = _stalls_detected_total()
+        log.warn("fault: stalling training step",
+                 duration_s=self.duration_s)
+        ctx.stall(self.duration_s)
+        return FIRED, lambda: _stalls_detected_total() > before
+
+    def describe(self) -> dict:
+        d = super().describe()
+        if self.duration_s is not None:
+            d["duration_s"] = self.duration_s
+        return d
+
+
+@dataclass
+class WedgeCollective(FaultAction):
+    """Freeze ONE participant of a live collective (the harness typically
+    SIGSTOPs a world child): every peer blocks in the collective with the
+    process table fully green.  The lease timeout can't fire (the host
+    renews), membership can't prune (the supervisor heartbeats) — only
+    the stall watchdog's missing progress beats give it away.  Recovery
+    observed like :class:`StallStep`: ``stalls_detected`` moved."""
+
+    kind: str = "wedge_collective"
+
+    def fire(self, ctx: FaultContext):
+        if ctx.wedge is None:
+            raise RuntimeError("WedgeCollective needs a wedge hook in "
+                               "the ctx")
+        before = _stalls_detected_total()
+        if not ctx.wedge():
+            return RETRY, None  # nothing to freeze yet (mid-reform)
+        log.warn("fault: wedged a collective participant")
+        return FIRED, lambda: _stalls_detected_total() > before
+
+
 #: kind string → action class (plan (de)serialization + random campaigns)
 ACTION_TYPES = {
     cls.kind: cls  # type: ignore[attr-defined]
     for cls in (KillTrainer, KillCoordinator, NetworkFlake, PreemptDomain,
-                CorruptCheckpoint, DiskFull)
+                CorruptCheckpoint, DiskFull, StallStep, WedgeCollective)
 }
 
 

@@ -71,6 +71,15 @@ log = get_logger("runtime.multihost")
 _JAX_COORD_KEY = "jax-coordinator/{epoch}"
 _CKPT_KEY = "ckpt/{epoch}"
 _CKPT_WRITER_KEY = "ckpt-writer/{epoch}"
+#: formation barrier: each supervisor re-writes its marker (a fresh value
+#: per planning attempt) when it arrives at an epoch's plan; a member
+#: whose marker never changes across repeated formation failures is a
+#: straggler (wedged supervisor whose keepalive thread still heartbeats)
+_FORM_KEY = "form-arrive/{epoch}/{name}"
+#: eviction markers: written ON BEHALF of a straggler; its keepalive
+#: reads this and declines the expiry-rejoin that would otherwise undo
+#: the eviction forever (CoordDiscovery.keepalive)
+_EVICT_KEY = "evict/{name}"
 #: mid-world generations: periodic in-world checkpoints so a crash loses
 #: at most the cadence window, not everything back to the world's start
 #: generation (role of the reference's pserver param residency — a dead
@@ -101,6 +110,18 @@ def _mid_from_key(key: str) -> Optional[tuple[int, int]]:
 #: Child exit code for "world aborted, reform" (a Python-visible failure;
 #: XLA coordination-service aborts arrive as negative signal codes).
 WORLD_ABORTED = 3
+
+
+class WorkerEvicted(RuntimeError):
+    """This worker was evicted from the job (a peer wrote an eviction
+    marker on its behalf after it repeatedly missed the epoch barrier).
+    A recovered straggler raises this instead of rejoining a world that
+    voted it out."""
+
+
+class FormationTimeout(TimeoutError):
+    """plan() exhausted its formation budget: membership never stabilized
+    or the coordinator claim never resolved within the window."""
 
 
 @dataclass(frozen=True)
@@ -261,12 +282,28 @@ class ElasticWorld:
     def wait_stable(self, min_members: int = 1, timeout_s: float = 120.0
                     ) -> tuple[int, list[str]]:
         """Snapshot membership once it has ≥ min_members and hasn't changed
-        for settle_s (a joining wave lands as ONE world, not several)."""
+        for settle_s (a joining wave lands as ONE world, not several).
+
+        Evicted members are filtered from the snapshot: a straggler voted
+        out of the job must not re-enter anyone's world plan even if its
+        keepalive raced it back into membership for a moment.  Raises
+        :class:`WorkerEvicted` when THIS worker is the one voted out.
+        """
         deadline = time.monotonic() + timeout_s
         last_epoch, stable_since = -1, time.monotonic()
+        evicted: set[str] = set()
         while True:
             epoch, members = self._coord.members()
-            names = sorted(n for n, _ in members)
+            if epoch != last_epoch or last_epoch == -1:
+                # refresh the eviction set only when membership moved:
+                # every eviction bumps the epoch (the leave written on
+                # the victim's behalf), so a per-poll prefix scan would
+                # be 20 Hz of coordinator load buying nothing
+                evicted = self.evicted_names()
+            if self.name in evicted:
+                raise WorkerEvicted(
+                    f"worker {self.name!r} was evicted from the job")
+            names = sorted(n for n, _ in members if n not in evicted)
             now = time.monotonic()
             if epoch != last_epoch:
                 last_epoch, stable_since = epoch, now
@@ -275,19 +312,27 @@ class ElasticWorld:
                   and self.name in names):
                 return epoch, names
             if now >= deadline:
-                raise TimeoutError(
+                raise FormationTimeout(
                     f"membership never stabilized at ≥{min_members} "
                     f"members within {timeout_s}s (have {names})")
             time.sleep(self._poll_s)
 
     # -- world planning ----------------------------------------------------
 
-    def plan(self, min_members: int = 1, timeout_s: float = 120.0
-             ) -> WorldPlan:
+    def plan(self, min_members: int = 1, timeout_s: float = 120.0,
+             formation_budget_s: Optional[float] = None) -> WorldPlan:
         """Block until a stable world can form and return its plan — rank,
         size, and the coordinator endpoint rank 0 claimed for the epoch.
-        No jax state is touched; the supervisor stays abort-proof."""
-        deadline = time.monotonic() + timeout_s
+        No jax state is touched; the supervisor stays abort-proof.
+
+        ``formation_budget_s`` (when set) overrides ``timeout_s`` as the
+        total budget for this ONE formation attempt; on exhaustion
+        :class:`FormationTimeout` is raised so the supervisor can count
+        the miss against stragglers instead of dying or blocking forever.
+        """
+        budget = formation_budget_s if formation_budget_s is not None \
+            else timeout_s
+        deadline = time.monotonic() + budget
         while True:
             epoch, names = self.wait_stable(
                 min_members, max(deadline - time.monotonic(), 0.01))
@@ -295,9 +340,86 @@ class ElasticWorld:
             endpoint = self._claim_coordinator(epoch, rank,
                                                deadline - time.monotonic())
             if endpoint is None:  # epoch moved under us; re-snapshot
+                if time.monotonic() >= deadline:
+                    raise FormationTimeout(
+                        f"coordinator claim for epoch {epoch} never "
+                        f"resolved within {budget}s")
                 continue
             return WorldPlan(epoch=epoch, rank=rank, world_size=len(names),
                              coordinator=endpoint, members=tuple(names))
+
+    # -- formation barrier + straggler eviction ----------------------------
+    #
+    # A wedged supervisor is the quiet twin of a crashed one: its
+    # keepalive thread still heartbeats, so membership never prunes it,
+    # every plan includes it, and every world init times out against a
+    # peer that will never arrive — the job stalls forever at full
+    # liveness.  The formation barrier makes that visible: every
+    # supervisor re-marks its arrival each time it plans, so a member
+    # whose marker stays frozen across repeated formation failures is
+    # provably not planning, and the lowest-ranked live supervisor
+    # evicts it — a leave written on its behalf plus a durable eviction
+    # marker its keepalive respects (CoordDiscovery declines the
+    # expiry-rejoin when marked).
+
+    def mark_formed(self, epoch: int) -> None:
+        """Arrive at the epoch's formation barrier.  The value changes on
+        every attempt, so 'arrived again since the last failure' is
+        distinguishable from a marker left by a previous attempt."""
+        self._form_attempt = getattr(self, "_form_attempt", 0) + 1
+        self._coord.kv_set(
+            _FORM_KEY.format(epoch=epoch, name=self.name),
+            f"{self.name}:{self._form_attempt}".encode())
+
+    def formation_markers(self, epoch: int, members: tuple
+                          ) -> dict[str, Optional[bytes]]:
+        """Current barrier marker per member (None = never arrived)."""
+        return {m: self._coord.kv_get(_FORM_KEY.format(epoch=epoch, name=m))
+                for m in members}
+
+    def evict(self, name: str, reason: str = "straggler") -> None:
+        """Evict ``name`` from the job on its behalf: durable marker
+        first (so its keepalive cannot rejoin through the race), then the
+        membership leave that bumps the epoch for everyone else."""
+        log.warn("evicting straggler", member=name, by=self.name,
+                 reason=reason)
+        self._coord.kv_set(_EVICT_KEY.format(name=name),
+                           f"{self.name}:{reason}".encode())
+        try:
+            self._coord.leave(name)
+        except Exception:
+            pass  # membership TTL will prune it; the marker already rules
+        from edl_tpu.observability.collector import get_counters
+        from edl_tpu.observability.tracing import get_tracer
+
+        get_tracer().instant("member_evicted", category="membership",
+                             member=name, by=self.name, reason=reason)
+        get_counters().inc("members_evicted")
+
+    def evicted_names(self) -> set[str]:
+        return {key.split("/", 1)[1]
+                for key in self._coord.kv_keys("evict/")}
+
+    def clear_eviction(self) -> bool:
+        """Lift this worker's own eviction (fresh-start amnesty).
+
+        The marker exists to defeat ONE adversary: the wedged process's
+        still-beating keepalive thread.  A *fresh* supervisor invocation
+        under the same name (pod restarted by the operator/kubelet) is
+        exactly the recovery the eviction was waiting for — without
+        amnesty the stable pod name would be locked out of the job
+        forever (markers ride the coordinator's durable state).  If the
+        new incarnation wedges too, it just gets evicted again."""
+        key = _EVICT_KEY.format(name=self.name)
+        if self._coord.kv_get(key) is None:
+            return False
+        log.warn("clearing own eviction marker on fresh start",
+                 member=self.name)
+        self._coord.kv_del(key)
+        from edl_tpu.observability.collector import get_counters
+
+        get_counters().inc("evictions_cleared")
+        return True
 
     def _claim_coordinator(self, epoch: int, rank: int, budget_s: float
                            ) -> Optional[str]:
@@ -464,6 +586,10 @@ class WorkerConfig:
     heartbeat_timeout_s: int = 10
     state_wait_s: float = 30.0
     collective_ckpt: bool = False
+    #: progress-heartbeat file the child refreshes every step (atomic
+    #: replace); the supervisor's StallWatchdog reads it.  None = no
+    #: stall detection for this worker.
+    heartbeat_path: Optional[str] = None
 
 
 #: exactly how many of the newest state generations survive GC.  The
@@ -537,6 +663,8 @@ class WorkerOutcome:
 
     state_path: str
     step: Optional[int] = None
+    #: True when this worker left because its peers evicted it (straggler)
+    evicted: bool = False
 
 
 def _write_result(path: str, result: dict) -> None:
@@ -683,18 +811,36 @@ def _world_child(plan: WorldPlan, cfg: WorkerConfig, result_path: str,
             ew.publish_mid_state(world.epoch, step,
                                  lambda: cfg.save_state(cur_state, dest))
 
+        def heartbeat(step: int) -> None:
+            """Refresh the progress heartbeat the supervisor's stall
+            watchdog reads.  Atomic replace: the supervisor can never
+            read a torn write; best-effort: a full disk must degrade
+            stall DETECTION, not kill the world."""
+            if cfg.heartbeat_path is None:
+                return
+            tmp = cfg.heartbeat_path + ".tmp"
+            try:
+                with open(tmp, "w") as f:
+                    f.write(str(int(step)))
+                os.replace(tmp, cfg.heartbeat_path)
+            except OSError:
+                pass
+
         # mechanism lives here, cadence policy with the training loop: the
-        # body opts in by accepting a `checkpoint` kwarg (older bodies
-        # without the kwarg keep world-boundary-only generations)
+        # body opts in by accepting `checkpoint` / `heartbeat` kwargs
+        # (older bodies without them keep world-boundary-only generations
+        # and run without stall detection)
         import inspect
 
         extra: dict = {}
         try:
             params = inspect.signature(cfg.train_world).parameters
-            if ("checkpoint" in params
-                    or any(p.kind is inspect.Parameter.VAR_KEYWORD
-                           for p in params.values())):
+            var_kw = any(p.kind is inspect.Parameter.VAR_KEYWORD
+                         for p in params.values())
+            if "checkpoint" in params or var_kw:
                 extra["checkpoint"] = mid_checkpoint
+            if "heartbeat" in params or var_kw:
+                extra["heartbeat"] = heartbeat
         except (TypeError, ValueError):  # builtins/partials w/o signature
             pass
         state, stopped = cfg.train_world(world, state, should_stop, **extra)
@@ -799,6 +945,93 @@ def _warm_world_child(conn, parent_pid: int,
     _world_child(plan, cfg, result_path, parent_pid)
 
 
+#: consecutive formation failures a member may sit out (marker frozen)
+#: before the lowest-ranked live supervisor evicts it
+EVICT_AFTER_MISSES = 2
+
+
+class StragglerTracker:
+    """Supervisor-side strike accounting for the formation barrier.
+
+    Fed one :meth:`note_failure` per dead world whose epoch never moved;
+    a member whose barrier marker is UNCHANGED across
+    ``evict_after`` consecutive failures at the same epoch is evicted by
+    the lowest-ranked member that did arrive (deterministic single actor
+    — eviction is idempotent anyway, but one evictor keeps the audit
+    trail readable).
+
+    ``strike_interval_s`` is the time floor between strikes for one
+    member: markers only refresh when a peer's NEXT plan() completes,
+    and a healthy peer needs up to the jax heartbeat timeout just to
+    notice the world died — a locally crash-looping child (bad state
+    file, instant exits) must not burn through the strike budget faster
+    than an honest peer can possibly re-arrive."""
+
+    def __init__(self, ew: ElasticWorld,
+                 evict_after: int = EVICT_AFTER_MISSES,
+                 strike_interval_s: float = 20.0,
+                 clock: Callable[[], float] = time.monotonic) -> None:
+        self._ew = ew
+        self.evict_after = max(int(evict_after), 1)
+        self.strike_interval_s = strike_interval_s
+        self._clock = clock
+        self._strikes: dict[str, int] = {}
+        self._last_strike: dict[str, float] = {}
+        self._prev: dict[str, Optional[bytes]] = {}
+        self._prev_epoch: Optional[int] = None
+
+    def note_success(self) -> None:
+        """A world formed and ran: everyone arrived; clear all strikes."""
+        self._strikes.clear()
+        self._prev_epoch = None
+
+    def note_failure(self, plan: WorldPlan) -> list[str]:
+        """A world died at ``plan.epoch``.  Returns the members evicted
+        by THIS call (empty unless this supervisor is the designated
+        evictor and someone crossed the strike threshold)."""
+        markers = self._ew.formation_markers(plan.epoch, plan.members)
+        if self._prev_epoch != plan.epoch:
+            # first failure at this epoch: baseline the markers; strikes
+            # only accumulate across CONSECUTIVE failures that membership
+            # never resolved (a crashed peer is pruned by the TTL and
+            # moves the epoch — it never reaches a second strike)
+            self._prev, self._prev_epoch = markers, plan.epoch
+            return []
+        frozen = [m for m in plan.members
+                  if m != self._ew.name and markers.get(m) is not None
+                  and markers.get(m) == self._prev.get(m)]
+        # members that never arrived AT ALL (no marker ever) are equally
+        # frozen — a supervisor wedged before its very first plan
+        frozen += [m for m in plan.members
+                   if m != self._ew.name and markers.get(m) is None
+                   and self._prev.get(m) is None]
+        now = self._clock()
+        for m in plan.members:
+            if m in frozen:
+                # time floor: a strike only lands if the member had at
+                # least strike_interval_s to re-arrive since its last
+                # one — rapid local crash-loops must not outrun an
+                # honest peer's reform latency
+                if now - self._last_strike.get(m, -1e18) \
+                        >= self.strike_interval_s:
+                    self._strikes[m] = self._strikes.get(m, 0) + 1
+                    self._last_strike[m] = now
+            else:
+                self._strikes.pop(m, None)
+                self._last_strike.pop(m, None)
+        self._prev = markers
+        arrived = [m for m in plan.members if m not in frozen]
+        if not arrived or arrived[0] != self._ew.name:
+            return []  # another live supervisor is the designated evictor
+        evicted = [m for m in frozen
+                   if self._strikes.get(m, 0) >= self.evict_after]
+        for m in evicted:
+            self._ew.evict(m, reason="missed epoch barrier "
+                                     f"{self._strikes[m]}x")
+            self._strikes.pop(m, None)
+        return evicted
+
+
 # -- the supervisor ----------------------------------------------------------
 
 def _child_context():
@@ -836,6 +1069,11 @@ def run_elastic_worker(
     warm_spawn: bool = True,
     warm_delay_s: float = 2.0,
     preload: tuple = ("jax", "optax"),
+    stall_watchdog: bool = True,
+    stall_floor_s: Optional[float] = None,
+    stall_k: float = 6.0,
+    formation_budget_s: float = 120.0,
+    evict_after_misses: int = EVICT_AFTER_MISSES,
 ) -> "WorkerOutcome":
     """The full elastic dance for one worker host: supervise one world
     child per membership epoch (see module docstring for the protocol).
@@ -867,6 +1105,20 @@ def run_elastic_worker(
     pkg/jobparser.go:131); later worlds form with whoever is live, which
     is what lets survivors of a crash reform below the initial quorum.
 
+    ``stall_watchdog`` arms the silent-hang tripwire: the world child
+    refreshes a heartbeat file every step, and the supervisor runs a
+    :class:`~edl_tpu.runtime.watchdog.StallWatchdog` over it (deadline =
+    ``max(stall_floor_s, stall_k × EWMA step time)``; floor defaults to
+    ``EDL_MH_STALL_FLOOR_S`` or 60 s).  On breach the supervisor SIGKILLs
+    the epoch's child — converting a wedged collective, which no crash
+    path would ever notice, into the child-death the reform logic already
+    handles.  ``formation_budget_s`` bounds each planning attempt, and
+    ``evict_after_misses`` is the straggler-eviction threshold: a member
+    whose formation-barrier marker stays frozen across that many
+    consecutive same-epoch world failures is evicted via a KV leave
+    written on its behalf (see :class:`StragglerTracker`) instead of
+    wedging the world forever.
+
     ``warm_spawn`` keeps one pre-spawned world child idling with
     ``preload`` imported; on reform the plan is piped to it instead of
     paying the spawn + import bootstrap on the critical path (the lever
@@ -880,6 +1132,10 @@ def run_elastic_worker(
     respawn on a 1-core box).  A crash inside the delay window falls
     back to a cold spawn — the pre-warm-spawn behavior."""
     ew = ElasticWorld(coord, name, address=address, settle_s=settle_s)
+    if stall_floor_s is None:
+        stall_floor_s = float(os.environ.get("EDL_MH_STALL_FLOOR_S", "60"))
+    hb_path = (os.path.join(ckpt_dir, f"hb-{name}")
+               if stall_watchdog else None)
     cfg = WorkerConfig(
         coord=coord, name=name, init_state=init_state,
         train_world=train_world, save_state=save_state,
@@ -887,6 +1143,7 @@ def run_elastic_worker(
         init_timeout_s=init_timeout_s,
         heartbeat_timeout_s=heartbeat_timeout_s,
         collective_ckpt=collective_ckpt,
+        heartbeat_path=hb_path,
     )
     if reform_grace_s is None:
         # a crashed peer is pruned from membership after the TTL; wait a
@@ -909,6 +1166,12 @@ def run_elastic_worker(
 
     # the first world's child bootstraps while we join + settle
     warm = spawn_warm() if warm_spawn else None
+    # fresh-start amnesty: a restarted pod under an evicted name is the
+    # recovery the eviction was waiting for — lift the marker, rejoin
+    try:
+        ew.clear_eviction()
+    except Exception:
+        pass  # coordinator briefly unreachable; join's retry path rules
     ew.join()
     # Reform timeline into the process tracer (the reference had no
     # tracing at all, SURVEY §5.1); EDL_MH_TRACE=<dir> dumps a chrome
@@ -917,18 +1180,49 @@ def run_elastic_worker(
     from edl_tpu.observability.tracing import get_tracer
 
     tracer = get_tracer()
+    tracker = StragglerTracker(
+        ew, evict_after=evict_after_misses,
+        # a peer's children die via the jax heartbeat detector (~this
+        # long) before its supervisor can possibly re-plan — strikes
+        # slower than that can't falsely accumulate against it
+        strike_interval_s=max(20.0, 2.0 * heartbeat_timeout_s))
     last_path: Optional[str] = None
     last_step: Optional[int] = None
+    evicted_self = False
     try:
         with ew.member.keepalive():
             for n_world in range(max_worlds):
                 if leave_requested is not None and leave_requested():
                     break
-                plan = ew.plan(min_members=min_members if n_world == 0 else 1)
+                try:
+                    plan = ew.plan(
+                        min_members=min_members if n_world == 0 else 1,
+                        formation_budget_s=formation_budget_s)
+                except FormationTimeout as exc:
+                    log.warn("formation budget exhausted; retrying",
+                             error=str(exc))
+                    get_counters().inc("formation_timeouts")
+                    continue
+                except WorkerEvicted:
+                    log.warn("this worker was evicted; exiting", name=name)
+                    evicted_self = True
+                    break
+                ew.mark_formed(plan.epoch)
                 result_path = os.path.join(
                     ckpt_dir, f"result-{name}-{plan.epoch}.json")
                 if os.path.exists(result_path):
                     os.remove(result_path)  # stale attempt at this epoch
+                wd = None
+                if cfg.heartbeat_path is not None:
+                    from edl_tpu.runtime.watchdog import StallWatchdog
+
+                    try:  # stale beat from the previous world
+                        os.remove(cfg.heartbeat_path)
+                    except OSError:
+                        pass
+                    wd = StallWatchdog(floor_s=stall_floor_s, k=stall_k,
+                                       scope="multihost")
+                last_hb: Optional[str] = None
                 world_t0 = time.monotonic()
                 child = child_conn = None
                 if warm is not None and warm[0].is_alive():
@@ -952,8 +1246,45 @@ def run_elastic_worker(
                     rank=plan.rank, world=plan.world_size,
                     warm=child_conn is not None)
                 announced = False
+                stall_killed = False
                 while child.exitcode is None:
                     child.join(timeout=0.1)
+                    if wd is not None and not stall_killed:
+                        try:
+                            with open(cfg.heartbeat_path) as f:
+                                hb = f.read().strip()
+                        except OSError:
+                            hb = None
+                        if hb and hb != last_hb:
+                            last_hb = hb
+                            try:
+                                wd.beat(int(hb))
+                            except ValueError:
+                                wd.beat()
+                        stall = wd.check()
+                        if stall is not None:
+                            # A wedged collective never crashes on its
+                            # own — SIGKILL the child so the silent hang
+                            # becomes the death the reform path already
+                            # handles.  (SIGKILL lands on SIGSTOPped
+                            # children too.)
+                            log.warn(
+                                "world child stalled; killing for reform",
+                                epoch=plan.epoch, pid=child.pid,
+                                step=stall.step,
+                                silent_s=round(stall.silent_s, 3),
+                                deadline_s=round(stall.deadline_s, 3))
+                            print(f"[{name}] stall detected epoch="
+                                  f"{plan.epoch} step={stall.step} "
+                                  f"silent_s={stall.silent_s:.3f} "
+                                  f"deadline_s={stall.deadline_s:.3f}",
+                                  file=sys.stderr, flush=True)
+                            tracer.instant(
+                                "stall_escalated", category="chaos",
+                                epoch=plan.epoch, step=stall.step,
+                                silent_s=round(stall.silent_s, 3))
+                            child.kill()
+                            stall_killed = True
                     if (warm is None and warm_spawn
                             and _should_respawn_warm(
                                 time.monotonic() - world_t0,
@@ -977,6 +1308,7 @@ def run_elastic_worker(
                     exitcode=child.exitcode,
                     lifetime_s=round(time.monotonic() - world_t0, 3))
                 if child.exitcode == 0 and os.path.exists(result_path):
+                    tracker.note_success()
                     with open(result_path) as f:
                         result = json.load(f)
                     last_path = result.get("state_path") or last_path
@@ -1009,6 +1341,14 @@ def run_elastic_worker(
                 # the reform IS the recovery transition for a crashed peer
                 # — auditable next to the chaos engine's injections
                 get_counters().inc("world_reforms")
+                # strike accounting: members whose formation marker froze
+                # across consecutive same-epoch failures are stragglers;
+                # the designated evictor votes them out so the world can
+                # form without them (their keepalive respects the marker)
+                try:
+                    tracker.note_failure(plan)
+                except Exception as exc:  # accounting must not kill us
+                    log.warn("straggler accounting failed", error=str(exc))
                 if plan.rank == 0:
                     # The coordinator endpoint died with our child; clear
                     # the epoch's claim so a same-epoch reform binds a
@@ -1050,9 +1390,18 @@ def run_elastic_worker(
         found = ew.latest_state(ew.epoch() + 1)
         last_path = found[1] if found else None
     if last_path is None:
+        if evicted_self:
+            # the typical straggler wedged before ever publishing — the
+            # caller must see the typed eviction verdict, not a
+            # misleading "trained state lost" crash (the job's state
+            # lives with the peers that voted it out)
+            raise WorkerEvicted(
+                f"worker {name!r} was evicted from the job before "
+                "publishing any state generation")
         raise RuntimeError(
             "no state generation was ever published — trained state lost")
-    return WorkerOutcome(state_path=last_path, step=last_step)
+    return WorkerOutcome(state_path=last_path, step=last_step,
+                         evicted=evicted_self)
 
 
 # -- numpy-tree state helpers (the default save/load for DP-replicated

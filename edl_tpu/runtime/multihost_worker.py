@@ -83,7 +83,27 @@ STEP_SLEEP_S = float(os.environ.get("EDL_MH_STEP_SLEEP", "0"))
 #: progress (the generation protocol's in-world extension,
 #: multihost.publish_mid_state)
 CKPT_EVERY = int(os.environ.get("EDL_MH_CKPT_EVERY", "0"))
+#: stall injection for watchdog drills: "worker:step[:seconds]" wedges
+#: that worker's train loop at that step (default: effectively forever —
+#: only the supervisor's StallWatchdog escalation can end it).  A marker
+#: file in the ckpt dir makes the stall fire ONCE per job, so the
+#: reformed world trains through the step it wedged at.
+STALL_SPEC = os.environ.get("EDL_MH_STALL", "")
 SEED = 7
+
+
+def _parse_stall(spec: str):
+    """'worker:step[:seconds]' → (worker, step, seconds) or None.
+    Malformed specs parse to None (a broken drill knob must not crash
+    the training loop it was meant to wedge)."""
+    if not spec:
+        return None
+    try:
+        parts = spec.split(":")
+        return (parts[0], int(parts[1]),
+                float(parts[2]) if len(parts) > 2 else 3600.0)
+    except (IndexError, ValueError):
+        return None
 
 
 # -- the model families that ride the fault path -----------------------------
@@ -338,7 +358,8 @@ class LeasedBatchSource:
 
 def train_world(world: WorldHandle, state, should_stop, *, coord, name,
                 registry, verbose=True, sharding="replicated",
-                task=MlpTask(), checkpoint=None):
+                task=MlpTask(), checkpoint=None, heartbeat=None,
+                ckpt_dir=None):
     import jax
 
     mesh, param_sh, opt_sh, data_sh, step = _compiled_step(sharding, task)
@@ -374,6 +395,22 @@ def train_world(world: WorldHandle, state, should_stop, *, coord, name,
 
             time.sleep(STEP_SLEEP_S)
         nstep += 1
+        if heartbeat is not None:
+            heartbeat(nstep)
+        stall = _parse_stall(STALL_SPEC)
+        if stall is not None and stall[0] == name and nstep >= stall[1]:
+            # the quiet failure: the step completed (heartbeat sent),
+            # then the loop wedges — no crash, no closed socket, just
+            # silence.  Fires once per job (marker file) so the reformed
+            # world trains through this step.
+            marker = os.path.join(ckpt_dir or ".", f"stalled-{name}")
+            if not os.path.exists(marker):
+                open(marker, "w").close()
+                print(f"[{name}] injecting stall at step {nstep} for "
+                      f"{stall[2]}s", flush=True)
+                import time
+
+                time.sleep(stall[2])
         if verbose and (nstep % 20 == 0 or nstep == 1):
             print(f"[{name}] step {nstep} world={world.world_size} "
                   f"loss={float(loss):.5f}", flush=True)
@@ -476,6 +513,13 @@ def main(argv=None) -> int:
     ap.add_argument("--min-members", type=int, default=1)
     ap.add_argument("--settle-s", type=float, default=0.5)
     ap.add_argument("--heartbeat-timeout-s", type=int, default=10)
+    ap.add_argument("--stall-floor-s", type=float, default=None,
+                    help="stall-watchdog deadline floor (default: "
+                         "EDL_MH_STALL_FLOOR_S or 60)")
+    ap.add_argument("--stall-k", type=float, default=6.0,
+                    help="stall deadline = max(floor, k × EWMA step time)")
+    ap.add_argument("--no-stall-watchdog", action="store_true",
+                    help="disable supervisor-side stall detection")
     ap.add_argument("--param-sharding", choices=("replicated", "fsdp"),
                     default=os.environ.get("EDL_MH_SHARDING", "replicated"),
                     help="replicated = pure DP with npz generations; "
@@ -527,35 +571,48 @@ def main(argv=None) -> int:
 
     ensure_seeded(coord, args.name, seed)
 
+    from edl_tpu.runtime.multihost import WorkerEvicted
+
     fsdp = args.param_sharding == "fsdp"
     os.makedirs(args.ckpt_dir, exist_ok=True)
-    outcome = run_elastic_worker(
-        coord,
-        args.name,
-        init_state=functools.partial(init_state, task),
-        train_world=functools.partial(
-            train_world, coord=coord, name=args.name, registry=registry,
-            verbose=not args.quiet, sharding=args.param_sharding,
-            task=task),
-        save_state=orbax_save_state if fsdp else save_numpy_tree,
-        load_state=functools.partial(
-            orbax_load_state if fsdp else load_state, task=task),
-        ckpt_dir=args.ckpt_dir,
-        min_members=args.min_members,
-        settle_s=args.settle_s,
-        leave_requested=leave.is_set,
-        heartbeat_timeout_s=args.heartbeat_timeout_s,
-        collective_ckpt=fsdp,
-        # the warm child pre-imports what train_world will need; orbax's
-        # import is heavy and only the collective path touches it
-        preload=(("jax", "optax", "orbax.checkpoint") if fsdp
-                 else ("jax", "optax")),
-        # warm pre-spawn trades idle CPU for reform latency; on a 1-core
-        # host the concurrent preload imports CONTEND with the critical
-        # path instead (measured: join leg 33 s warm vs 22 s cold), so
-        # the knob exists for benches/tests on starved machines
-        warm_spawn=os.environ.get("EDL_MH_WARM_SPAWN", "1") != "0",
-    )
+    try:
+        outcome = run_elastic_worker(
+            coord,
+            args.name,
+            init_state=functools.partial(init_state, task),
+            train_world=functools.partial(
+                train_world, coord=coord, name=args.name, registry=registry,
+                verbose=not args.quiet, sharding=args.param_sharding,
+                task=task, ckpt_dir=args.ckpt_dir),
+            save_state=orbax_save_state if fsdp else save_numpy_tree,
+            load_state=functools.partial(
+                orbax_load_state if fsdp else load_state, task=task),
+            ckpt_dir=args.ckpt_dir,
+            min_members=args.min_members,
+            settle_s=args.settle_s,
+            leave_requested=leave.is_set,
+            heartbeat_timeout_s=args.heartbeat_timeout_s,
+            collective_ckpt=fsdp,
+            stall_watchdog=not args.no_stall_watchdog,
+            stall_floor_s=args.stall_floor_s,
+            stall_k=args.stall_k,
+            # the warm child pre-imports what train_world will need;
+            # orbax's import is heavy and only the collective path
+            # touches it
+            preload=(("jax", "optax", "orbax.checkpoint") if fsdp
+                     else ("jax", "optax")),
+            # warm pre-spawn trades idle CPU for reform latency; on a
+            # 1-core host the concurrent preload imports CONTEND with
+            # the critical path instead (measured: join leg 33 s warm
+            # vs 22 s cold), so the knob exists for benches/tests on
+            # starved machines
+            warm_spawn=os.environ.get("EDL_MH_WARM_SPAWN", "1") != "0",
+        )
+    except WorkerEvicted as exc:
+        # voted out by the peers' formation barrier: a typed, clean exit
+        # — the job's state lives with the members that evicted us
+        print(f"[{args.name}] evicted: {exc}", file=sys.stderr, flush=True)
+        return 4
     # The world children report their final step through the supervisor
     # (no checkpoint load here — the supervisor process stays device-free);
     # only the rare fallback path, where the state was located by a KV

@@ -28,6 +28,12 @@ from edl_tpu.scheduler.topology import SliceShapePolicy, UNIT_POLICY
 
 DEFAULT_LOOP_SECONDS = 5.0  # reference autoscaler.go:31
 UPDATE_RETRIES = 5  # reference autoscaler.go:346
+#: hysteresis defaults: off (cooldown 0, any nonzero delta actuates) so
+#: the planner's pure behavior is unchanged unless a deployment opts in —
+#: production manifests set a cooldown so watchdog-triggered world
+#: reforms and load flapping don't thrash the mesh with resize churn
+DEFAULT_RESIZE_COOLDOWN_S = 0.0
+DEFAULT_MIN_RESIZE_DELTA = 1
 
 log = get_logger("autoscaler")
 
@@ -51,17 +57,32 @@ class Autoscaler:
         max_load_desired: float = 1.0,
         shape_policy: SliceShapePolicy = UNIT_POLICY,
         loop_seconds: float = DEFAULT_LOOP_SECONDS,
+        resize_cooldown_s: float = DEFAULT_RESIZE_COOLDOWN_S,
+        min_resize_delta: int = DEFAULT_MIN_RESIZE_DELTA,
+        clock=time.monotonic,
     ) -> None:
         self.cluster = cluster
         self.max_load_desired = max_load_desired
         self.shape_policy = shape_policy
         self.loop_seconds = loop_seconds
+        #: hysteresis: a job resized less than ``resize_cooldown_s`` ago
+        #: is left alone this tick, and a plan delta smaller than
+        #: ``min_resize_delta`` chips is not worth a reshard (every
+        #: actuation costs the runtime a mesh rebuild + state move —
+        #: flapping load or watchdog-triggered reforms must not turn
+        #: into resize churn)
+        self.resize_cooldown_s = resize_cooldown_s
+        self.min_resize_delta = max(int(min_resize_delta), 1)
+        self._clock = clock
+        self._last_resize: dict[str, float] = {}  # uid -> actuation time
         self.jobs: dict[str, PlannedJob] = {}  # keyed by uid (namespace/name)
         self._events: "queue.Queue[Event]" = queue.Queue()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         #: log of (job -> target) plans, for tests/observability
         self.plan_history: list[dict[str, int]] = []
+        #: log of {uid: reason} suppressions, for tests/observability
+        self.suppressed_history: list[dict[str, str]] = []
 
     # -- event intake (reference autoscaler.go:159-171) --------------------
 
@@ -90,6 +111,11 @@ class Autoscaler:
                 self._sync_parallelism(j)
             elif evt.type == EventType.DEL:
                 self.jobs.pop(evt.job.full_name, None)
+                # drop the cooldown stamp too: a re-submitted job under
+                # the same uid starts with a clean hysteresis slate (and
+                # a long-lived controller must not leak one float per
+                # deleted job)
+                self._last_resize.pop(evt.job.full_name, None)
 
     def tick(self) -> dict[str, int]:
         """One plan-and-actuate pass; returns the actuated targets
@@ -106,15 +132,39 @@ class Autoscaler:
 
         # Zero deltas are dropped: no no-op actuation writes, no plan spam
         # (the reference re-writes unchanged Parallelism every tick — a
-        # quirk, not a behavior worth keeping).
-        target = {
-            uid: self.jobs[uid].parallelism + delta
-            for uid, delta in diff.items()
-            if uid in self.jobs and delta != 0
-        }
+        # quirk, not a behavior worth keeping).  Hysteresis drops two
+        # more classes: deltas below min_resize_delta (not worth the
+        # reshard) and jobs inside their resize cooldown (no thrash when
+        # load flaps or a watchdog-triggered reform wobbles the pod
+        # count) — each suppression is logged and counted.
+        now = self._clock()
+        target: dict[str, int] = {}
+        suppressed: dict[str, str] = {}
+        for uid, delta in diff.items():
+            if uid not in self.jobs or delta == 0:
+                continue
+            if abs(delta) < self.min_resize_delta:
+                suppressed[uid] = "min_delta"
+                continue
+            last = self._last_resize.get(uid)
+            if (self.resize_cooldown_s > 0 and last is not None
+                    and now - last < self.resize_cooldown_s):
+                suppressed[uid] = "cooldown"
+                continue
+            target[uid] = self.jobs[uid].parallelism + delta
+        if suppressed:
+            from edl_tpu.observability.collector import get_counters
+
+            for uid, reason in suppressed.items():
+                log.info("resize suppressed", job=uid, reason=reason,
+                         delta=diff[uid])
+                get_counters().inc("resizes_suppressed", reason=reason)
+            self.suppressed_history.append(suppressed)
         if target:
             log.info("scaling plan", target=target)
             self.plan_history.append(dict(target))
+            for uid in target:
+                self._last_resize[uid] = now
         self._scale_all_jobs(target)
         return target
 

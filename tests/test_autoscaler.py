@@ -41,6 +41,96 @@ def submit(cluster, scaler, job):
     scaler.on_add(job)
 
 
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def test_resize_cooldown_suppresses_thrash():
+    """Hysteresis: after one actuation, further plan deltas for the same
+    job are held until the cooldown lapses — a flapping load signal (or a
+    watchdog-triggered reform wobbling the pod count) must not turn into
+    continuous mesh resizes."""
+    c = cluster_with(cpu_milli=10_000)
+    clock = FakeClock()
+    a = Autoscaler(c, max_load_desired=1.0, resize_cooldown_s=30.0,
+                   clock=clock)
+    job = mk_job("example", lo=2, hi=10)
+    submit(c, a, job)
+    assert a.tick()  # first resize actuates immediately (no prior)
+    grown = c.get_trainer_parallelism(job)
+    assert grown > 2
+    # load flaps: an online service lands, the planner wants to shrink
+    for i in range(4):
+        c.add_system_pod(f"nginx-{i}", "n0", cpu_request_milli=1000,
+                         memory_request_mega=100)
+    clock.t += 5.0  # still inside the cooldown
+    assert a.tick() == {}  # suppressed, not actuated
+    assert c.get_trainer_parallelism(job) == grown
+    assert a.suppressed_history and \
+        a.suppressed_history[-1] == {job.full_name: "cooldown"}
+    clock.t += 40.0  # cooldown lapsed: the shrink goes through
+    target = a.tick()
+    assert target and target[job.full_name] < grown
+    assert c.get_trainer_parallelism(job) < grown
+
+
+def test_min_resize_delta_ignores_one_chip_wobble():
+    """A plan delta below min_resize_delta is not worth a reshard."""
+    c = cluster_with(cpu_milli=10_000)
+    clock = FakeClock()
+    a = Autoscaler(c, max_load_desired=1.0, min_resize_delta=4,
+                   clock=clock)
+    job = mk_job("example", lo=2, hi=10)
+    submit(c, a, job)
+    # from 2 pods the planner wants +8 → passes the delta gate
+    assert a.tick()
+    assert c.get_trainer_parallelism(job) == 10
+    # take away ONE cpu worth of headroom: the planner wants -1, which
+    # is wobble, not a resize
+    c.add_system_pod("nginx", "n0", cpu_request_milli=1000,
+                     memory_request_mega=100)
+    assert a.tick() == {}
+    assert c.get_trainer_parallelism(job) == 10
+    assert a.suppressed_history[-1] == {job.full_name: "min_delta"}
+
+
+def test_cooldown_stamp_cleared_on_job_deletion():
+    """A deleted-then-resubmitted job (same uid) must not inherit the
+    previous incarnation's cooldown stamp."""
+    c = cluster_with(cpu_milli=10_000)
+    clock = FakeClock()
+    a = Autoscaler(c, max_load_desired=1.0, resize_cooldown_s=300.0,
+                   clock=clock)
+    job = mk_job("example", lo=2, hi=10)
+    submit(c, a, job)
+    assert a.tick()  # actuates; cooldown stamp recorded
+    a.on_del(job)
+    c.delete_resources(job)
+    a.drain_events()
+    assert a._last_resize == {}  # stamp dropped with the job
+    clock.t += 1.0  # well inside what the old cooldown would have been
+    submit(c, a, job)
+    assert a.tick()  # the reborn job's first scale-up is NOT suppressed
+    assert c.get_trainer_parallelism(job) == 10
+
+
+def test_hysteresis_defaults_off_preserve_pure_planner():
+    """cooldown 0 + min_delta 1 = the pre-hysteresis behavior, tick for
+    tick (the planner tests above rely on it)."""
+    c = cluster_with(cpu_milli=10_000)
+    a = Autoscaler(c, max_load_desired=1.0)
+    job = mk_job("example", lo=2, hi=10)
+    submit(c, a, job)
+    for _ in range(3):
+        a.tick()
+    assert c.get_trainer_parallelism(job) == 10
+    assert a.suppressed_history == []
+
+
 def test_single_job_scales_to_max():
     c = cluster_with(cpu_milli=10_000)
     a = Autoscaler(c, max_load_desired=1.0)

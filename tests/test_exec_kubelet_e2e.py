@@ -48,7 +48,7 @@ from edl_tpu.cluster.fake import FakeCluster
 from edl_tpu.controller.controller import Controller
 from edl_tpu.controller.sync import TrainingJobSyncLoop
 
-pytestmark = pytest.mark.slow
+pytestmark = [pytest.mark.slow, pytest.mark.timeout_s(840)]
 
 
 def free_port() -> int:

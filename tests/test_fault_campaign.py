@@ -39,6 +39,8 @@ from edl_tpu.runtime.faults import (
     KillTrainer,
     NetworkFlake,
     PreemptDomain,
+    StallStep,
+    WedgeCollective,
 )
 
 
@@ -72,8 +74,8 @@ def test_fault_plan_same_seed_same_campaign():
 
 
 def test_fault_plan_covers_all_kinds_with_spacing():
-    plan = FaultPlan.random(7, n_faults=6, first_step=10, last_step=100,
-                            min_gap=8)
+    plan = FaultPlan.random(7, n_faults=len(ACTION_TYPES), first_step=10,
+                            last_step=100, min_gap=8)
     kinds = [d["kind"] for d in plan.describe()]
     assert sorted(kinds) == sorted(ACTION_TYPES)
     steps = [d["at_step"] for d in plan.describe()]
@@ -155,6 +157,58 @@ def test_engine_unfireable_action_is_disarmed_not_fatal():
     engine(1)  # must not raise
     assert engine.fired == []
     assert engine.quiescent()  # disarmed with a trace, drill continues
+
+
+# ---------------------------------------------------------------------------
+# The quiet faults: StallStep / WedgeCollective (watchdog drills)
+# ---------------------------------------------------------------------------
+
+
+def test_stall_and_wedge_fire_and_await_watchdog_detection():
+    """The quiet pair's recovery contract: fired when the harness hook
+    ran, recovered only once ``stalls_detected`` moved — i.e. the drill
+    passes iff the watchdog actually SAW the hang."""
+    from edl_tpu.observability.collector import get_counters
+
+    stalls, wedges = [], []
+    ctx = FaultContext(stall=stalls.append,
+                       wedge=lambda: bool(wedges.append(1)) or True)
+    plan = FaultPlan(actions=[StallStep(at_step=1, duration_s=2.5),
+                              WedgeCollective(at_step=2)])
+    assert plan.describe()[0] == {"kind": "stall_step", "at_step": 1,
+                                  "duration_s": 2.5}
+    engine = FaultPlanEngine(plan, ctx)
+    engine(1)
+    engine(2)
+    assert [k for _, k in engine.fired] == ["stall_step",
+                                            "wedge_collective"]
+    assert stalls == [2.5] and wedges == [1]
+    assert not engine.quiescent()  # hangs injected, not yet detected
+    # the watchdog notices (what StallWatchdog.check emits on breach)
+    get_counters().inc("stalls_detected", scope="drill-unit")
+    engine(3)
+    assert engine.quiescent()
+    assert sorted(engine.recovered) == ["stall_step", "wedge_collective"]
+
+
+def test_wedge_retries_until_a_victim_exists():
+    """wedge() returning False (no live collective yet) re-arms."""
+    ready = []
+    ctx = FaultContext(wedge=lambda: bool(ready))
+    engine = FaultPlanEngine(
+        FaultPlan(actions=[WedgeCollective(at_step=1)]), ctx)
+    engine(1)
+    assert engine.fired == [] and not engine.quiescent()
+    ready.append(1)
+    engine(2)
+    assert [k for _, k in engine.fired] == ["wedge_collective"]
+
+
+def test_stall_without_hook_is_disarmed_not_fatal():
+    engine = FaultPlanEngine(
+        FaultPlan(actions=[StallStep(at_step=1)]), FaultContext())
+    engine(1)  # must not raise
+    assert engine.fired == [] and engine.quiescent()
 
 
 # ---------------------------------------------------------------------------
@@ -367,13 +421,16 @@ def _children_named(needle: str) -> list[int]:
 
 
 @pytest.mark.slow
+@pytest.mark.timeout_s(600)  # above the drill's own internal wait budgets
 def test_seeded_multi_fault_campaign_soak(tmp_path):
-    """Acceptance drill: ≥4 distinct fault types (all six here, including
-    coordinator kill, network flake and checkpoint corruption) fired from
-    one seed against a live elastic training loop.  Asserts exactly-once
-    task accounting, loss continuity/progress across recoveries, chaos
-    counters + trace events per fault type, plan reproducibility from the
-    seed, and zero leaked processes."""
+    """Acceptance drill: ≥4 distinct fault types (all eight here,
+    including coordinator kill, network flake, checkpoint corruption and
+    the quiet stall/wedge pair that only the watchdog can see) fired
+    from one seed against a live elastic training loop.  Asserts
+    exactly-once task accounting, loss continuity/progress across
+    recoveries, stall detection within the EWMA deadline bound, chaos
+    counters + trace events per fault type, plan reproducibility from
+    the seed, and zero leaked processes."""
     import jax
     import numpy as np
     import optax
@@ -437,20 +494,38 @@ def test_seeded_multi_fault_campaign_soak(tmp_path):
                              batch_size=64)
     ckpt = ElasticCheckpointer(tmp_path / "ckpt", max_to_keep=3)
 
-    plan = FaultPlan.random(SOAK_SEED, n_faults=6, first_step=10,
+    n_faults = len(ACTION_TYPES)
+    plan = FaultPlan.random(SOAK_SEED, n_faults=n_faults, first_step=10,
                             last_step=100, min_gap=10)
     # the seed IS the campaign: rebuilding the plan from the same seed
     # must reproduce the exact fault sequence (the reproduction story
     # doc/fault_drills.md documents)
     assert plan.describe() == FaultPlan.random(
-        SOAK_SEED, n_faults=6, first_step=10, last_step=100,
+        SOAK_SEED, n_faults=n_faults, first_step=10, last_step=100,
         min_gap=10).describe()
     kinds = {d["kind"] for d in plan.describe()}
-    assert kinds == set(ACTION_TYPES)  # all six, incl. the required trio
+    assert kinds == set(ACTION_TYPES)  # all eight, incl. the quiet pair
+
+    # The quiet-fault harness: a stall request wedges the training loop
+    # (below, in on_step) until the threaded StallWatchdog's deadline
+    # breaches and its escalation releases it — detection IS the
+    # recovery trigger, exactly the multihost supervisor's ladder with
+    # "SIGKILL the child" swapped for "unwedge the loop".
+    from edl_tpu.runtime.watchdog import StallWatchdog
+
+    released = threading.Event()
+    stall_requests: list[float] = []
+    watchdog = StallWatchdog(floor_s=1.0, k=6.0, warmup=3, alpha=0.5,
+                             on_stall=lambda s: released.set(),
+                             scope="soak")
+    watchdog.start(poll_s=0.05)
 
     ctx = FaultContext(cluster=cluster, job=job, coord=client, proxy=proxy,
                        checkpointer=ckpt,
                        restart_coordinator=restart_coordinator,
+                       stall=lambda d: stall_requests.append(d or 30.0),
+                       wedge=lambda: bool(stall_requests.append(30.0))
+                       or True,
                        rng=random.Random(SOAK_SEED))
     engine = FaultPlanEngine(plan, ctx)
     base = {
@@ -459,8 +534,10 @@ def test_seeded_multi_fault_campaign_soak(tmp_path):
         "disk": counters.get("recoveries_completed", type="disk_full"),
     }
     audited = []
+    stall_latencies: list[tuple[float, float]] = []  # (silent, deadline)
 
     def on_step(step, loss, world):
+        watchdog.beat(step)
         if step % 5 == 0:
             ckpt.save(step, {"params": trainer.state.params,
                              "opt": trainer.state.opt_state},
@@ -474,6 +551,16 @@ def test_seeded_multi_fault_campaign_soak(tmp_path):
             restored = ckpt.restore({"params": trainer.state.params,
                                      "opt": trainer.state.opt_state})
             audited.append(jax.tree.leaves(restored["params"])[0] is not None)
+        if stall_requests:  # a quiet fault struck: wedge THIS loop
+            duration = stall_requests.pop()
+            released.clear()
+            t0 = time.monotonic()
+            while (time.monotonic() - t0 < duration
+                   and not released.is_set()):
+                time.sleep(0.02)  # no beats while wedged
+            stall = watchdog.last_stall()
+            assert stall is not None, "watchdog never saw the hang"
+            stall_latencies.append((stall.silent_s, stall.deadline_s))
 
     report = runner.run(on_step=on_step)
 
@@ -482,9 +569,15 @@ def test_seeded_multi_fault_campaign_soak(tmp_path):
     while not engine.quiescent() and time.monotonic() < deadline:
         engine.tick()
         time.sleep(0.1)
+    watchdog.stop()
     assert engine.quiescent(), (engine.unfired(), engine.recovered)
-    assert len(engine.fired) == 6, engine.fired
+    assert len(engine.fired) == n_faults, engine.fired
     assert audited == [True]
+    # both quiet faults were detected, each within 2× the EWMA deadline
+    # in force at the breach (the acceptance bound), and training resumed
+    assert len(stall_latencies) == 2, stall_latencies
+    for silent_s, deadline_s in stall_latencies:
+        assert silent_s <= 2 * deadline_s, stall_latencies
 
     # exactly-once task accounting across every fault (the coordinator
     # was SIGKILL'd and restarted from its durable state mid-campaign)
@@ -505,7 +598,7 @@ def test_seeded_multi_fault_campaign_soak(tmp_path):
     for kind in ACTION_TYPES:
         assert counters.get("faults_injected", type=kind) >= 1, kind
     for kind in ("kill_trainer", "kill_coordinator", "network_flake",
-                 "preempt_domain"):
+                 "preempt_domain", "stall_step", "wedge_collective"):
         assert counters.get("recoveries_completed", type=kind) >= 1, kind
     assert counters.get("recoveries_completed",
                         type="corrupt_checkpoint") > base["corrupt"]

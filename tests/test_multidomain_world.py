@@ -29,7 +29,7 @@ from edl_tpu.controller.controller import Controller
 
 from tests.test_exec_kubelet_e2e import e2e_cr, free_port
 
-pytestmark = pytest.mark.slow
+pytestmark = [pytest.mark.slow, pytest.mark.timeout_s(840)]
 
 
 def test_multidomain_job_forms_one_world(tmp_path):

@@ -31,7 +31,10 @@ import pytest
 
 from edl_tpu.coord.server import spawn_server
 
-pytestmark = pytest.mark.multihost
+# every test here budgets its own subprocess waits (up to ~600 s on a
+# loaded box) — the conftest SIGALRM ceiling must sit ABOVE them, or the
+# per-test tripwire turns legitimate slow runs into flakes
+pytestmark = [pytest.mark.multihost, pytest.mark.timeout_s(840)]
 
 #: Enough data that scenarios are still mid-job when we inject faults
 #: (shards × rows ÷ batch = 512 global steps).
@@ -446,3 +449,51 @@ def test_multi_device_hosts_form_one_mesh(coord_server, tmp_path):
         assert "done at step" in text
         assert "world=2" in text  # 2 processes (4 devices total)
     _assert_exactly_once(coord_server.client(), SMALL_SHARDS)
+
+
+@pytest.mark.slow
+def test_stalled_world_child_killed_by_watchdog_and_epoch_rebuilds(
+        coord_server, tmp_path):
+    """THE quiet-failure acceptance drill: one worker's train loop wedges
+    mid-step (no crash, no closed socket — its supervisor and lease
+    renewals stay perfectly healthy).  Nothing in the crash path can see
+    it; the supervisor's StallWatchdog must: detect the missing progress
+    beats within the EWMA deadline, SIGKILL the wedged child (turning the
+    silent hang into the already-handled death), and let the epoch
+    rebuild.  Both workers finish the job with exactly-once accounting —
+    and the detection latency recorded in the log is within 2× the
+    deadline in force at the breach."""
+    import re
+
+    env = _worker_env(EXAMPLES, SHARDS)
+    # steps SLOWER than the supervisor's 0.1 s heartbeat poll so several
+    # distinct beats are observed and the EWMA settles before the wedge
+    env["EDL_MH_STEP_SLEEP"] = "0.1"
+    env["EDL_MH_STALL"] = "w1:12"      # w1 wedges (forever) after step 12
+    extra = ("--stall-floor-s", "3", "--stall-k", "6")
+    procs = {
+        n: _spawn_worker(coord_server.port, n, tmp_path, 2, env,
+                         tmp_path / f"{n}.log", extra=extra)
+        for n in ("w0", "w1")
+    }
+    # the injection actually happened (not a vacuous pass)
+    _wait_for_line(tmp_path / "w1.log", "injecting stall", timeout_s=180)
+    # the watchdog saw it: silence crossed the deadline, child killed
+    line = _wait_for_line(tmp_path / "w1.log", "stall detected",
+                          timeout_s=120)
+    m = re.search(r"silent_s=([0-9.]+) deadline_s=([0-9.]+)", line)
+    assert m, line
+    silent_s, deadline_s = float(m.group(1)), float(m.group(2))
+    assert deadline_s >= 3.0  # the floor ruled (EWMA steps are ~40 ms)
+    assert silent_s <= 2 * deadline_s, line  # the acceptance bound
+    rcs = _wait_all(procs, timeout_s=420)
+    assert rcs == {"w0": 0, "w1": 0}
+    w1_log = (tmp_path / "w1.log").read_text()
+    # the kill became a reform: the supervisor treated the stall as the
+    # crash it already knows, and the job then drained to completion
+    assert "world child died; reforming" in w1_log
+    for n in ("w0", "w1"):
+        assert "done at step" in (tmp_path / f"{n}.log").read_text()
+    # exactly-once accounting across the stall + reform: the wedged
+    # child's leased shard re-dispatched, nothing double-counted
+    _assert_exactly_once(coord_server.client(), SHARDS)

@@ -149,7 +149,11 @@ class TestServeHealth:
                     f"http://127.0.0.1:{port}/healthz", timeout=5) as r:
                 doc = json.loads(r.read())
             assert r.status == 200
-            assert doc == {"status": "ok", "autoscaler": True}
+            assert doc["status"] == "ok" and doc["autoscaler"] is True
+            # per-check detail: latency + timeout verdict in the body
+            assert doc["checks"]["autoscaler"]["ok"] is True
+            assert doc["checks"]["autoscaler"]["timed_out"] is False
+            assert doc["checks"]["autoscaler"]["latency_ms"] >= 0
 
             state["alive"] = False  # the thread died
             try:
@@ -172,6 +176,98 @@ class TestServeHealth:
                 assert e.code == 503
             finally:
                 srv2.shutdown()
+        finally:
+            srv.shutdown()
+
+    def test_wedged_check_times_out_instead_of_blocking_probe(self):
+        """One hung check must not wedge the probe thread: the probe
+        still answers (503) within the per-check timeout, the stuck
+        check is reported as timed_out, and healthy checks alongside it
+        still report truthfully."""
+        import json
+        import threading
+        import time
+        import urllib.error
+        import urllib.request
+
+        from edl_tpu.observability.health import serve_health
+
+        release = threading.Event()
+
+        def wedged() -> bool:
+            release.wait(30)  # a check stuck in a lock/collective
+            return True
+
+        srv = serve_health(0, {"wedged": wedged, "fine": lambda: True},
+                           host="127.0.0.1", check_timeout_s=0.3)
+        try:
+            port = srv.server_address[1]
+            t0 = time.monotonic()
+            try:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/healthz", timeout=10)
+                raise AssertionError("expected 503")
+            except urllib.error.HTTPError as e:
+                assert e.code == 503
+                doc = json.loads(e.read())
+            assert time.monotonic() - t0 < 5  # probe was never blocked
+            assert doc["wedged"] is False
+            assert doc["checks"]["wedged"]["timed_out"] is True
+            assert doc["checks"]["wedged"]["latency_ms"] >= 300
+            assert doc["fine"] is True
+            assert doc["checks"]["fine"]["timed_out"] is False
+            # a SECOND probe while the check is still wedged must not
+            # stack another thread: it reports the check stuck instantly
+            before_threads = threading.active_count()
+            try:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/healthz", timeout=10)
+                raise AssertionError("expected 503")
+            except urllib.error.HTTPError as e:
+                doc2 = json.loads(e.read())
+            assert doc2["checks"]["wedged"]["stuck"] is True
+            assert doc2["checks"]["wedged"]["timed_out"] is True
+            assert doc2["fine"] is True
+            # one leaked daemon thread TOTAL for the wedged check, not
+            # one per probe (the HTTP handler thread itself comes and
+            # goes; allow slack for it)
+            assert threading.active_count() <= before_threads + 2
+        finally:
+            release.set()
+            srv.shutdown()
+
+    def test_concurrent_probes_share_inflight_check_no_false_503(self):
+        """ThreadingHTTPServer overlaps probes (liveness + readiness +
+        dashboards): a probe arriving while a healthy-but-slowish check
+        is mid-run must SHARE that evaluation and report healthy — not
+        declare it stuck and 503 a healthy pod."""
+        import concurrent.futures
+        import json
+        import time
+        import urllib.request
+
+        from edl_tpu.observability.health import serve_health
+
+        def slowish() -> bool:
+            time.sleep(0.15)  # well inside the timeout
+            return True
+
+        srv = serve_health(0, {"slowish": slowish}, host="127.0.0.1",
+                           check_timeout_s=2.0)
+        try:
+            port = srv.server_address[1]
+
+            def probe():
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/healthz", timeout=10) as r:
+                    return r.status, json.loads(r.read())
+
+            with concurrent.futures.ThreadPoolExecutor(4) as ex:
+                results = list(ex.map(lambda _: probe(), range(4)))
+            for code, doc in results:
+                assert code == 200, doc
+                assert doc["slowish"] is True
+                assert "stuck" not in doc["checks"]["slowish"]
         finally:
             srv.shutdown()
 

@@ -122,7 +122,89 @@ def test_step_cache_no_recompile_on_oscillation():
     t.step((x[:64], y[:64]))
     t.resize(2)
     t.resize(4)
-    assert set(t._step_cache.keys()) == {2, 4}
+    # keyed by (size, device ids): oscillation reuses both entries
+    assert {k[0] for k in t._step_cache} == {2, 4}
+    assert len(t._step_cache) == 2
+
+
+def test_step_cache_hit_reuses_exact_mesh_and_shardings():
+    """Resize down then back up: the cache hit must hand back shardings
+    bound to the SAME Mesh object the cached step function was compiled
+    against — size-only keying rebuilt 'equal' shardings over a fresh
+    Mesh and trained through a stale-mesh executable."""
+    t = make_trainer(n0=4)
+    x, y = synthetic_classification(n=128)
+    t.step((x[:64], y[:64]))
+    first_mesh = t.mesh
+    first_shardings = t._param_shardings
+    t.resize(2)
+    assert t.mesh is not first_mesh
+    t.resize(4)  # back to a previously-seen size → cache hit
+    assert t.mesh is first_mesh
+    assert t._param_shardings is first_shardings
+    # every staged sharding really is bound to the live mesh
+    import jax
+
+    for sh in jax.tree.leaves(t._param_shardings):
+        assert sh.mesh is t.mesh
+    loss = t.step((x[:64], y[:64]))  # and it still trains
+    assert loss == loss  # not NaN
+
+
+def test_resize_failure_rolls_back_and_keeps_training(monkeypatch):
+    """Transactional resize: a device_put failure mid-resize (the OOM
+    shape) leaves the previous mesh fully live — the trainer keeps
+    stepping, the failure is counted, and a later retry succeeds."""
+    from edl_tpu.observability.collector import get_counters
+    from edl_tpu.runtime import elastic as elastic_mod
+
+    t = make_trainer(n0=4)
+    x, y = synthetic_classification(n=128)
+    l0 = t.step((x[:64], y[:64]))
+    before_mesh = t.mesh
+    before_failed = get_counters().get("resizes_failed")
+
+    calls = []
+    real = elastic_mod._reshard
+
+    def failing_reshard(tree, shardings):
+        calls.append(1)
+        if len(calls) == 2:  # params staged OK, opt-state put blows up
+            raise RuntimeError("injected: RESOURCE_EXHAUSTED during reshard")
+        return real(tree, shardings)
+
+    monkeypatch.setattr(elastic_mod, "_reshard", failing_reshard)
+    assert t.resize(8) is False
+    assert t.mesh is before_mesh and t.world_size == 4  # rolled back
+    assert t.resizes_failed == 1 and t.resizes == 0
+    assert get_counters().get("resizes_failed") == before_failed + 1
+    # the old world still trains — state was never half-moved
+    l1 = t.step((x[:64], y[:64]))
+    assert np.isfinite(l1) and l1 <= l0 * 2
+    # and the retry (injection cleared) commits normally
+    monkeypatch.setattr(elastic_mod, "_reshard", real)
+    assert t.resize(8) is True
+    assert t.world_size == 8 and t.resizes == 1
+    assert np.isfinite(t.step((x[:64], y[:64])))
+
+
+def test_resize_compile_failure_rolls_back(monkeypatch):
+    """A compile error while staging the new world must also roll back
+    (and must NOT poison the step cache for the retry)."""
+    t = make_trainer(n0=4)
+    x, y = synthetic_classification(n=128)
+    t.step((x[:64], y[:64]))
+
+    def exploding_compile(bundle):
+        raise RuntimeError("injected: XLA compile failed")
+
+    monkeypatch.setattr(t, "_compile_step", exploding_compile)
+    assert t.resize(2) is False
+    assert t.world_size == 4 and t.resizes_failed == 1
+    assert {k[0] for k in t._step_cache} == {4}  # no poisoned entry
+    monkeypatch.undo()
+    assert t.resize(2) is True
+    assert np.isfinite(t.step((x[:64], y[:64])))
 
 
 # -- task-lease data ---------------------------------------------------------

@@ -1,0 +1,307 @@
+"""Formation barrier, straggler eviction, and the eviction-aware
+keepalive — the protocol pieces that keep a wedged-but-heartbeating
+member from stalling world formation forever.
+
+Fast and deterministic: everything runs against an in-process
+PyCoordService (same API as the native server), no jax, no subprocesses.
+The end-to-end stall drill (wedged world child → watchdog kill → epoch
+rebuild) lives in tests/test_multihost.py.
+"""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from edl_tpu.coord import PyCoordService
+from edl_tpu.runtime.discovery import CoordDiscovery
+from edl_tpu.runtime.multihost import (
+    ElasticWorld,
+    FormationTimeout,
+    StragglerTracker,
+    WorkerEvicted,
+)
+
+
+def make_worlds(coord, names, settle_s=0.05):
+    worlds = {n: ElasticWorld(coord, n, settle_s=settle_s, poll_s=0.01)
+              for n in names}
+    for w in worlds.values():
+        w.join()
+    return worlds
+
+
+def plan_all(worlds, exclude=()):
+    """Every (non-wedged) supervisor plans + arrives at the barrier."""
+    plans = {}
+    for n, w in worlds.items():
+        if n in exclude:
+            continue
+        plans[n] = w.plan(min_members=1, formation_budget_s=10.0)
+        w.mark_formed(plans[n].epoch)
+    return plans
+
+
+def test_formation_timeout_is_bounded_and_typed():
+    coord = PyCoordService()
+    w = ElasticWorld(coord, "w0", settle_s=0.05, poll_s=0.01)
+    w.join()
+    t0 = time.monotonic()
+    with pytest.raises(FormationTimeout):
+        w.plan(min_members=3, formation_budget_s=0.3)
+    assert time.monotonic() - t0 < 2.0  # budget, not the 120 s default
+    assert issubclass(FormationTimeout, TimeoutError)  # old callers ok
+
+
+def test_straggler_evicted_after_repeated_frozen_barrier():
+    """w2 joins membership (keepalive-alive) but never plans: its barrier
+    marker stays frozen across consecutive same-epoch failures, so the
+    lowest-ranked arrived member evicts it and the next plan excludes it."""
+    from edl_tpu.observability.collector import get_counters
+
+    coord = PyCoordService()
+    worlds = make_worlds(coord, ["w0", "w1", "w2"])
+    # strike_interval_s=0: the test drives failures back-to-back; the
+    # time floor has its own test below
+    trackers = {n: StragglerTracker(worlds[n], evict_after=2,
+                                    strike_interval_s=0.0)
+                for n in ("w0", "w1")}
+    before = get_counters().get("members_evicted")
+
+    # attempt 1: w0/w1 arrive, w2 never does; the world dies (init
+    # timeout against the absent peer).  First failure only baselines.
+    plans = plan_all(worlds, exclude=("w2",))
+    assert plans["w0"].world_size == 3  # w2 still in everyone's plan
+    epoch1 = plans["w0"].epoch
+    for n in ("w0", "w1"):
+        assert trackers[n].note_failure(plans[n]) == []
+
+    # attempt 2 at the same epoch: markers re-written by w0/w1, w2 frozen
+    plans = plan_all(worlds, exclude=("w2",))
+    assert plans["w0"].epoch == epoch1  # membership never moved
+    for n in ("w0", "w1"):
+        trackers[n].note_failure(plans[n])
+    # attempt 3: strike threshold crossed — w0 (lowest arrived) evicts
+    plans = plan_all(worlds, exclude=("w2",))
+    evicted = trackers["w0"].note_failure(plans["w0"])
+    assert evicted == ["w2"]
+    assert trackers["w1"].note_failure(plans["w1"]) == []  # not the actor
+    assert get_counters().get("members_evicted") == before + 1
+
+    # membership moved past the straggler; the next plan excludes it
+    _, members = coord.members()
+    assert "w2" not in {n for n, _ in members}
+    p = worlds["w0"].plan(min_members=1, formation_budget_s=10.0)
+    assert p.members == ("w0", "w1")
+
+    # the evicted member itself gets a typed verdict, not a stale world
+    with pytest.raises(WorkerEvicted):
+        worlds["w2"].wait_stable(min_members=1, timeout_s=1.0)
+
+
+def test_strike_time_floor_protects_slow_replanners():
+    """A locally crash-looping child (instant exits) fires note_failure
+    rapidly; a healthy peer needs real time to notice the death and
+    re-plan.  The strike_interval_s floor means back-to-back failures
+    land at most ONE strike per interval — no false eviction."""
+    fake_now = [0.0]
+    coord = PyCoordService()
+    worlds = make_worlds(coord, ["w0", "w1"])
+    tracker = StragglerTracker(worlds["w0"], evict_after=2,
+                               strike_interval_s=20.0,
+                               clock=lambda: fake_now[0])
+    # w1 planned once (baseline marker), then goes quiet while w0's
+    # child crash-loops 5 times within a second
+    plans = plan_all(worlds)
+    assert tracker.note_failure(plans["w0"]) == []  # baseline
+    for _ in range(5):
+        fake_now[0] += 0.2
+        plans = plan_all(worlds, exclude=("w1",))
+        assert tracker.note_failure(plans["w0"]) == []  # floored: no evict
+    assert tracker._strikes.get("w1", 0) == 1  # one strike, not five
+    # only once real re-arrival time has elapsed does the second strike
+    # land — and with it the (evict_after=2) eviction
+    fake_now[0] += 25.0
+    plans = plan_all(worlds, exclude=("w1",))
+    assert tracker.note_failure(plans["w0"]) == ["w1"]
+
+
+def test_fresh_start_amnesty_clears_own_eviction():
+    """A restarted worker under an evicted name must not be locked out
+    forever: clear_eviction (run_elastic_worker's first act) lifts the
+    marker, after which join + wait_stable work normally — while the
+    OLD wedged incarnation's keepalive keeps declining rejoin right up
+    to that restart."""
+    coord = PyCoordService()
+    evictor = ElasticWorld(coord, "w0")
+    evictor.join()
+    evictor.evict("w1", reason="wedged")
+    assert "w1" in evictor.evicted_names()
+
+    # the fresh incarnation (new process, same stable name)
+    reborn = ElasticWorld(coord, "w1", settle_s=0.05, poll_s=0.01)
+    assert reborn.clear_eviction() is True
+    assert reborn.clear_eviction() is False  # idempotent: already lifted
+    reborn.join()
+    epoch, names = reborn.wait_stable(min_members=2, timeout_s=5.0)
+    assert "w1" in names  # fully back in the job
+
+
+def test_crash_pruned_by_ttl_never_reaches_eviction():
+    """A crashed peer leaves membership via the TTL → the epoch moves →
+    strikes reset (consecutive-same-epoch accounting): eviction stays
+    reserved for wedged-but-heartbeating members."""
+    coord = PyCoordService()
+    worlds = make_worlds(coord, ["w0", "w1"])
+    tracker = StragglerTracker(worlds["w0"], evict_after=2)
+    plans = plan_all(worlds)
+    assert tracker.note_failure(plans["w0"]) == []  # baseline
+    coord.leave("w1")  # the TTL-prune/clean-leave of a CRASHED peer
+    p2 = worlds["w0"].plan(min_members=1, formation_budget_s=10.0)
+    assert p2.epoch != plans["w0"].epoch
+    worlds["w0"].mark_formed(p2.epoch)
+    # failure at the NEW epoch re-baselines instead of striking
+    assert tracker.note_failure(p2) == []
+    _, members = coord.members()
+    assert {n for n, _ in members} == {"w0"}  # w1 pruned, w0 untouched
+
+
+def test_eviction_marker_blocks_keepalive_rejoin():
+    """The eviction must survive the victim's own keepalive: heartbeat
+    expiry normally triggers a rejoin; the marker overrules it."""
+    coord = PyCoordService(member_ttl_ms=200)
+    disc = CoordDiscovery(coord, "w-straggler")
+    disc.join()
+    evictor = ElasticWorld(coord, "w0")
+    evictor.join()
+    with disc.keepalive(interval_s=0.05):
+        time.sleep(0.2)  # keepalive humming
+        _, members = coord.members()
+        assert "w-straggler" in {n for n, _ in members}
+        evictor.evict("w-straggler", reason="test")
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline and not disc.evicted:
+            time.sleep(0.05)
+        assert disc.evicted
+        # the rejoin was declined: the straggler stays OUT of membership
+        time.sleep(0.3)  # several would-be rejoin intervals
+        _, members = coord.members()
+        assert "w-straggler" not in {n for n, _ in members}
+
+
+def test_keepalive_still_rejoins_without_marker():
+    """Regression guard for the rejoin path the eviction check rides on:
+    a plain expiry (no marker) must still rejoin."""
+    coord = PyCoordService(member_ttl_ms=150)
+    disc = CoordDiscovery(coord, "w0")
+    disc.join()
+    with disc.keepalive(interval_s=0.05):
+        coord.leave("w0")  # simulate a server-side prune
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            _, members = coord.members()
+            if "w0" in {n for n, _ in members}:
+                break
+            time.sleep(0.05)
+        _, members = coord.members()
+        assert "w0" in {n for n, _ in members}
+        assert not disc.evicted
+
+
+# ---------------------------------------------------------------------------
+# Supervisor escalation, end to end on ONE worker (no collectives needed):
+# the world child wedges mid-step → the supervisor's StallWatchdog kills
+# it → the epoch rebuilds → the job completes.  Runs in tier-1: a
+# single-process world avoids the multiprocess-CPU-collectives support
+# the heavier drills in tests/test_multihost.py require.
+# ---------------------------------------------------------------------------
+
+import os
+
+import numpy as np
+
+import pytest as _pytest
+
+
+def _wedge_init_state():
+    return {"step": np.zeros((), np.int32)}
+
+
+def _wedge_load_state(path: str):
+    from edl_tpu.runtime.multihost import load_numpy_tree
+
+    return load_numpy_tree(path, _wedge_init_state())
+
+
+def _wedge_train_world(world, state, should_stop, *, marker="",
+                       done_at=30, wedge_at=8, heartbeat=None):
+    """Picklable world body: beats per step, wedges once at ``wedge_at``
+    (forever — only the supervisor's SIGKILL ends it), and on the rerun
+    (marker exists) drains to ``done_at``.
+
+    Steps are paced SLOWER than the supervisor's 0.1 s heartbeat poll so
+    several distinct beats are observed and the EWMA settles — detection
+    itself arms at the first observed beat, but a well-fed EWMA makes
+    the asserted deadline/latency numbers deterministic."""
+    import time as _time
+
+    step = int(state["step"])
+    while step < done_at:
+        if should_stop():
+            return {"step": np.asarray(step, np.int32)}, True
+        step += 1
+        if heartbeat is not None:
+            heartbeat(step)
+        _time.sleep(0.15)
+        if step == wedge_at and not os.path.exists(marker):
+            open(marker, "w").close()
+            _time.sleep(600)  # the silent hang; no beat ever again
+    return {"step": np.asarray(step, np.int32)}, False  # drained
+
+
+@_pytest.mark.timeout_s(240)
+def test_supervisor_watchdog_kills_wedged_child_and_world_rebuilds(tmp_path):
+    from edl_tpu.coord.client import CoordClient
+    from edl_tpu.coord.server import spawn_server
+    from edl_tpu.observability.collector import get_counters
+    from edl_tpu.runtime.multihost import run_elastic_worker, save_numpy_tree
+    import functools
+
+    counters = get_counters()
+    before_stalls = counters.get("stalls_detected", scope="multihost")
+    before_reforms = counters.get("world_reforms")
+    handle = spawn_server(member_ttl_ms=3000, task_timeout_ms=4000)
+    client = CoordClient("127.0.0.1", handle.port)
+    try:
+        t0 = time.monotonic()
+        outcome = run_elastic_worker(
+            client, "w0",
+            init_state=_wedge_init_state,
+            train_world=functools.partial(
+                _wedge_train_world, marker=str(tmp_path / "wedged")),
+            save_state=save_numpy_tree,
+            load_state=_wedge_load_state,
+            ckpt_dir=str(tmp_path),
+            settle_s=0.1,
+            warm_spawn=False,       # fewer processes; determinism
+            reform_grace_s=2.0,     # single member: epoch never moves
+            stall_floor_s=1.5, stall_k=6.0,
+        )
+        wall = time.monotonic() - t0
+        # the hang was detected (not ridden out): the wedge sleeps 600 s,
+        # the whole drill — two world bootstraps included — finished in
+        # a fraction of that
+        assert wall < 200, wall
+        assert os.path.exists(tmp_path / "wedged")  # the wedge happened
+        assert counters.get("stalls_detected", scope="multihost") \
+            == before_stalls + 1
+        # the kill became the already-handled reform, and the rebuilt
+        # epoch finished the job from the last published generation
+        assert counters.get("world_reforms") >= before_reforms + 1
+        assert outcome.step == 30
+        assert outcome.state_path and os.path.exists(outcome.state_path)
+        assert int(_wedge_load_state(outcome.state_path)["step"]) == 30
+    finally:
+        client.close()
+        handle.stop()

@@ -1,0 +1,251 @@
+"""StallWatchdog: deadline math, breach detection, escalation, health.
+
+All deterministic — the watchdog takes an injectable clock, so the tests
+advance time by hand instead of sleeping.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from edl_tpu.observability.collector import get_counters
+from edl_tpu.observability.tracing import get_tracer
+from edl_tpu.runtime.watchdog import Stall, StallWatchdog
+
+
+class FakeClock:
+    def __init__(self, t: float = 0.0) -> None:
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+def make_wd(clock, **kw):
+    kw.setdefault("floor_s", 1.0)
+    kw.setdefault("k", 4.0)
+    kw.setdefault("warmup", 3)
+    kw.setdefault("alpha", 0.5)
+    return StallWatchdog(clock=clock, **kw)
+
+
+# -- deadline model ----------------------------------------------------------
+
+
+def test_floor_rules_before_any_ewma_sample():
+    clock = FakeClock()
+    wd = make_wd(clock, floor_s=2.5)
+    assert wd.deadline_s() == 2.5  # no beats at all
+    wd.beat(0)
+    assert wd.deadline_s() == 2.5  # one beat: still no interval
+
+
+def test_detection_arms_at_first_beat_not_after_warmup():
+    """The blind-window regression: a child that makes ONE step of
+    progress and then wedges must still be caught — warmup gates only
+    the EWMA's settled-ness (armed()), never detection itself.  Before
+    any beat, nothing fires (bootstrap/compile/restore is unwatched)."""
+    clock = FakeClock()
+    wd = make_wd(clock, floor_s=1.0, warmup=3)
+    clock.advance(100.0)
+    assert wd.check() is None  # pre-beat silence is not a stall
+    wd.beat(0)
+    assert not wd.armed()  # EWMA not settled...
+    clock.advance(2.0)
+    stall = wd.check()  # ...but the one-step-then-wedge hang IS caught
+    assert stall is not None and stall.step == 0
+    assert stall.deadline_s == pytest.approx(1.0)  # floor rules pre-EWMA
+
+
+def test_slow_first_interval_raises_deadline_before_warmup():
+    """A legitimately slow workload is protected from the first interval
+    sample onward: the EWMA term raises the deadline above the floor
+    even before warmup declares it settled."""
+    clock = FakeClock()
+    wd = make_wd(clock, floor_s=1.0, k=4.0, warmup=3, alpha=0.5)
+    wd.beat(0)
+    clock.advance(5.0)  # one slow (but honest) step
+    wd.beat(1)
+    assert not wd.armed()
+    assert wd.deadline_s() == pytest.approx(20.0)  # 4 × 5.0 > floor
+    clock.advance(10.0)  # silence < the raised deadline
+    assert wd.check() is None
+
+
+def test_floor_clamps_fast_steps():
+    """Sub-millisecond steps must not produce a sub-millisecond deadline
+    — the floor absorbs EWMA noise."""
+    clock = FakeClock()
+    wd = make_wd(clock, floor_s=1.0, k=4.0)
+    for s in range(5):
+        clock.advance(0.001)
+        wd.beat(s)
+    assert wd.ewma_s() == pytest.approx(0.001)
+    assert wd.deadline_s() == 1.0  # max(floor, 4 * 0.001)
+
+
+def test_deadline_grows_after_legitimately_slow_step():
+    """One slow step (checkpoint barrier, recompile) raises the EWMA so
+    the NEXT pause of similar size is not a false positive."""
+    clock = FakeClock()
+    wd = make_wd(clock, floor_s=0.1, k=4.0, alpha=0.5)
+    for s in range(4):
+        clock.advance(0.2)
+        wd.beat(s)
+    d_fast = wd.deadline_s()
+    assert d_fast == pytest.approx(4.0 * 0.2)
+    clock.advance(5.0)  # a legitimately slow step completes (no breach
+    wd.beat(4)          # check ran during it)
+    assert wd.deadline_s() > d_fast
+    assert wd.ewma_s() == pytest.approx(0.5 * 5.0 + 0.5 * 0.2)
+
+
+# -- breach detection + escalation -------------------------------------------
+
+
+def test_breach_fires_once_counts_and_escalates():
+    clock = FakeClock()
+    stalls: list[Stall] = []
+    wd = make_wd(clock, floor_s=1.0, on_stall=stalls.append,
+                 scope="unit-test")
+    before = get_counters().get("stalls_detected", scope="unit-test")
+    for s in range(4):
+        clock.advance(0.1)
+        wd.beat(s)
+    assert wd.healthy()
+    clock.advance(0.5)
+    assert wd.check() is None  # within deadline
+    clock.advance(0.6)  # now 1.1 s of silence > 1.0 s floor deadline
+    stall = wd.check()
+    assert stall is not None
+    assert stall.step == 3
+    assert stall.silent_s == pytest.approx(1.1)
+    assert stall.deadline_s == pytest.approx(1.0)
+    # detection latency is bounded: the breach was seen within 2× the
+    # deadline of the last beat (the acceptance bound)
+    assert stall.silent_s <= 2 * stall.deadline_s
+    assert stalls == [stall]
+    assert not wd.healthy()
+    # one stall = one escalation: repeated checks during the same
+    # silence do not re-fire
+    clock.advance(5.0)
+    assert wd.check() is None
+    assert wd.stalls_detected == 1
+    assert (get_counters().get("stalls_detected", scope="unit-test")
+            == before + 1)
+    names = {e.name for e in get_tracer().events(category="chaos")}
+    assert "stall_detected" in names
+    # a beat clears the stall and re-arms
+    wd.beat(4)
+    assert wd.healthy()
+    clock.advance(50.0)
+    assert wd.check() is not None
+    assert wd.stalls_detected == 2
+
+
+def test_escalation_failure_does_not_kill_the_poller():
+    clock = FakeClock()
+
+    def bad_escalation(stall):
+        raise RuntimeError("boom")
+
+    wd = make_wd(clock, floor_s=0.5, on_stall=bad_escalation)
+    for s in range(3):
+        clock.advance(0.01)
+        wd.beat(s)
+    clock.advance(1.0)
+    assert wd.check() is not None  # no raise
+    assert not wd.healthy()
+
+
+def test_healthy_wires_into_serve_health():
+    """The watchdog's verdict is a liveness check: a stalled trainer
+    flips its pod's /healthz to 503."""
+    import json
+    import urllib.request
+
+    from edl_tpu.observability.health import serve_health
+
+    clock = FakeClock()
+    wd = make_wd(clock, floor_s=0.5)
+    srv = serve_health(0, {"trainer_progress": wd.healthy},
+                       host="127.0.0.1")
+    try:
+        port = srv.server_address[1]
+
+        def probe():
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/healthz", timeout=5) as r:
+                    return r.status, json.loads(r.read())
+            except urllib.error.HTTPError as e:
+                return e.code, json.loads(e.read())
+
+        for s in range(3):
+            clock.advance(0.01)
+            wd.beat(s)
+        code, body = probe()
+        assert code == 200 and body["trainer_progress"] is True
+        clock.advance(2.0)
+        wd.check()
+        code, body = probe()
+        assert code == 503 and body["trainer_progress"] is False
+    finally:
+        srv.shutdown()
+
+
+def test_threaded_mode_detects_real_hang():
+    """Wall-clock smoke for start()/stop(): beats stop arriving and the
+    daemon poller catches it."""
+    import threading
+    import time
+
+    caught = threading.Event()
+    wd = StallWatchdog(floor_s=0.3, k=4.0, warmup=2, alpha=0.5,
+                       on_stall=lambda s: caught.set(), scope="thread-test")
+    wd.start(poll_s=0.05)
+    try:
+        for s in range(4):
+            wd.beat(s)
+            time.sleep(0.02)
+        # now go silent: the poller must fire within ~floor + poll slack
+        assert caught.wait(timeout=3.0)
+        assert not wd.healthy()
+    finally:
+        wd.stop()
+
+
+def test_constructor_validation():
+    with pytest.raises(ValueError):
+        StallWatchdog(floor_s=0.0)
+    with pytest.raises(ValueError):
+        StallWatchdog(alpha=0.0)
+
+
+def test_per_test_alarm_guard_interrupts_a_hang():
+    """The suite-level tripwire (tests/conftest.py): a hung test body is
+    interrupted by SIGALRM with a named TestTimeout instead of eating
+    the whole tier-1 budget."""
+    import time
+
+    from tests.conftest import TestTimeout, _alarm_guard
+
+    class FakeMarker:
+        args = (0.3,)
+
+    class FakeItem:
+        nodeid = "fake.py::test_wedged"
+
+        def get_closest_marker(self, name):
+            return FakeMarker() if name == "timeout_s" else None
+
+    t0 = time.monotonic()
+    with pytest.raises(TestTimeout):
+        with _alarm_guard(FakeItem(), "call"):
+            time.sleep(30)
+    assert time.monotonic() - t0 < 5.0
+    # and the timer is fully disarmed afterwards
+    time.sleep(0.4)  # would re-raise if the itimer leaked
