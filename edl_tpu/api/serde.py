@@ -48,11 +48,16 @@ CRD_PLURAL = "trainingjobs"
 
 #: kebab → snake aliases (mirrors the declarations in k8s/crd.yaml; keep
 #: the two in lockstep or a manifest key will silently behave differently
-#: between `edl-tpu submit` and `kubectl apply`)
+#: between `edl-tpu submit` and `kubectl apply`).  The camelCase entries
+#: are the k8s-native spellings of the pod-template passthroughs — anyone
+#: porting a Deployment's volumes block will write ``volumeMounts`` /
+#: ``imagePullSecrets``, so both spellings are declared and accepted.
 KEBAB_ALIASES = {
     "min-instance": "min_instance",
     "max-instance": "max_instance",
     "allow-multi-domain": "allow_multi_domain",
+    "volumeMounts": "volume_mounts",
+    "imagePullSecrets": "image_pull_secrets",
 }
 
 #: every snake_case field any manifest section understands; a kebab key whose
@@ -120,6 +125,10 @@ def job_from_dict(doc: dict[str, Any]) -> TrainingJob:
                   if t.get("topology") else None),
         allow_multi_domain=bool(t.get("allow_multi_domain", False)),
         env={k: str(v) for k, v in (t.get("env") or {}).items()},
+        volumes=[dict(v) for v in (t.get("volumes") or [])],
+        volume_mounts=[dict(v) for v in (t.get("volume_mounts") or [])],
+        image_pull_secrets=[dict(v)
+                            for v in (t.get("image_pull_secrets") or [])],
     )
     p = _norm(spec.get("pserver") or {})
     pserver = PserverSpec(
@@ -181,6 +190,10 @@ def job_to_dict(job: TrainingJob) -> dict[str, Any]:
                 "max_instance": t.max_instance,
                 "allow_multi_domain": t.allow_multi_domain,
                 "env": {k: str(v) for k, v in sorted(t.env.items())},
+                "volumes": [dict(v) for v in t.volumes],
+                "volume_mounts": [dict(v) for v in t.volume_mounts],
+                "image_pull_secrets": [dict(v)
+                                       for v in t.image_pull_secrets],
                 "resources": res(t.resources),
             },
             "pserver": {

@@ -132,6 +132,16 @@ class TrainerSpec:
     #: so user values win — the supported way to tune runtime knobs like
     #: EDL_MH_CKPT_EVERY per job (k8s env-list semantics: last wins).
     env: dict = field(default_factory=dict)
+    #: Pod-template passthroughs (spec parity with real k8s training
+    #: workloads): lists of k8s-shaped dicts carried VERBATIM into the
+    #: compiled trainer pod template — ``volumes`` on the pod spec,
+    #: ``volume_mounts`` on the trainer container, ``image_pull_secrets``
+    #: on the pod spec.  No schema is imposed beyond "a list of objects":
+    #: the apiserver owns validating volume sources, and mirroring its
+    #: whole vocabulary here would only drift.
+    volumes: list = field(default_factory=list)
+    volume_mounts: list = field(default_factory=list)
+    image_pull_secrets: list = field(default_factory=list)
 
 
 @dataclass

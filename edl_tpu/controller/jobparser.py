@@ -34,6 +34,17 @@ from edl_tpu.api.types import (
 COORDINATOR_PORT = DEFAULT_PORT  # single source of truth (api/types.py)
 HEALTH_PORT = 8080  # role of the master's 8080 (reference jobparser.go:249-261)
 
+#: where FT trainer pods keep the persistent XLA compilation cache
+#: (jax_compilation_cache_dir, consumed by the multihost world children
+#: via EDL_COMPILE_CACHE).  Backed by an emptyDir: every world child the
+#: pod's supervisor respawns across membership epochs hits the cache the
+#: previous child populated, so the post-reform recompile is paid once
+#: per pod instead of once per epoch.  Mount a shared PVC at the same
+#: path (spec.trainer.volumes/volume_mounts override the default) to
+#: amortize across pods and restarts too.
+COMPILE_CACHE_PATH = "/var/edl/compile-cache"
+COMPILE_CACHE_VOLUME = "edl-compile-cache"
+
 #: downward-API pod identity (role of the reference's NAMESPACE/POD_IP
 #: fieldRefs, pkg/jobparser.go:263-311).  HOSTNAME is NOT a substitute:
 #: under spec.host_network it is the node's hostname, so the static
@@ -86,6 +97,10 @@ def pod_env(job: TrainingJob, role: str) -> dict[str, str]:
         # 200 steps ≈ tens of seconds of work at flagship step times;
         # spec.trainer.env (merged below) overrides per job.
         env["EDL_MH_CKPT_EVERY"] = "200"
+        # Persistent XLA compilation cache for the elastic path's world
+        # children (multihost._world_child reads this): first compile per
+        # pod, cache hits on every reform after (see COMPILE_CACHE_PATH).
+        env["EDL_COMPILE_CACHE"] = COMPILE_CACHE_PATH
     if spec.trainer.topology is not None:
         env["EDL_TPU_TOPOLOGY"] = str(spec.trainer.topology)
     if spec.master.etcd_endpoint:
@@ -118,6 +133,50 @@ def parse_to_trainer(job: TrainingJob) -> dict[str, Any]:
     restart-policy Never — failures are survived by elasticity, not pod
     restarts."""
     spec = job.spec
+    # user-declared pod-template passthroughs, verbatim (spec parity with
+    # real k8s training workloads: datasets on PVCs, /dev/shm tmpfs,
+    # private registries) — plus the FT path's compile-cache emptyDir,
+    # which a user volume of the same name overrides
+    volumes = [dict(v) for v in spec.trainer.volumes]
+    mounts = [dict(m) for m in spec.trainer.volume_mounts]
+    if spec.fault_tolerant:
+        if not any(v.get("name") == COMPILE_CACHE_VOLUME for v in volumes):
+            volumes.append({"name": COMPILE_CACHE_VOLUME, "emptyDir": {}})
+        if not any(m.get("mountPath") == COMPILE_CACHE_PATH for m in mounts):
+            mounts.append({"name": COMPILE_CACHE_VOLUME,
+                           "mountPath": COMPILE_CACHE_PATH})
+    container = {
+        "name": "trainer",
+        "image": spec.image,
+        # FT jobs take the coordinator-backed elastic
+        # path; non-FT jobs take the static barrier
+        # path (rank from the sorted pod list) — the
+        # reference's start_new_trainer vs start_trainer
+        # v2 switch (pkg/jobparser.go:124)
+        "command": ["python", "-m",
+                    "edl_tpu.runtime.launcher",
+                    "start_trainer"
+                    if spec.fault_tolerant
+                    else "start_static_trainer"],
+        "env": [
+            {"name": k, "value": v}
+            for k, v in pod_env(job, "trainer").items()
+        ] + list(_DOWNWARD_ENV),
+        "resources": _resources_dict(spec.trainer.resources),
+    }
+    if mounts:
+        container["volumeMounts"] = mounts
+    pod_spec: dict[str, Any] = {
+        "restartPolicy": "Never",
+        "nodeSelector": dict(spec.node_selector),
+        "hostNetwork": spec.host_network,
+        "containers": [container],
+    }
+    if volumes:
+        pod_spec["volumes"] = volumes
+    if spec.trainer.image_pull_secrets:
+        pod_spec["imagePullSecrets"] = [
+            dict(s) for s in spec.trainer.image_pull_secrets]
     return {
         "kind": "Job",
         "apiVersion": "batch/v1",
@@ -130,32 +189,7 @@ def parse_to_trainer(job: TrainingJob) -> dict[str, Any]:
             "parallelism": spec.trainer.min_instance,
             "template": {
                 "metadata": {"labels": _trainer_labels(job)},
-                "spec": {
-                    "restartPolicy": "Never",
-                    "nodeSelector": dict(spec.node_selector),
-                    "hostNetwork": spec.host_network,
-                    "containers": [
-                        {
-                            "name": "trainer",
-                            "image": spec.image,
-                            # FT jobs take the coordinator-backed elastic
-                            # path; non-FT jobs take the static barrier
-                            # path (rank from the sorted pod list) — the
-                            # reference's start_new_trainer vs start_trainer
-                            # v2 switch (pkg/jobparser.go:124)
-                            "command": ["python", "-m",
-                                        "edl_tpu.runtime.launcher",
-                                        "start_trainer"
-                                        if spec.fault_tolerant
-                                        else "start_static_trainer"],
-                            "env": [
-                                {"name": k, "value": v}
-                                for k, v in pod_env(job, "trainer").items()
-                            ] + list(_DOWNWARD_ENV),
-                            "resources": _resources_dict(spec.trainer.resources),
-                        }
-                    ],
-                },
+                "spec": pod_spec,
             },
         },
     }

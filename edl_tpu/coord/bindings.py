@@ -229,6 +229,46 @@ class NativeCoordService:
                 out.append((name, addr))
         return self.epoch(), out
 
+    # -- long-poll waits ---------------------------------------------------
+    #
+    # Interface parity with PyCoordService/CoordClient.  The C core has no
+    # condition variable surface, so these wait on a short in-process poll
+    # — no network round-trips are being saved here anyway (the remote
+    # path, where request load matters, parks on the native SERVER's cv);
+    # 5 ms keeps in-process wakeup latency negligible against the 50 ms
+    # sleep loops these calls replace.
+
+    _WAIT_POLL_S = 0.005
+
+    def wait_epoch(self, known_epoch: int, timeout_s: float) -> int:
+        deadline = time.monotonic() + max(timeout_s, 0.0)
+        while True:
+            self.expire_members()
+            e = self.epoch()
+            if e != known_epoch or time.monotonic() >= deadline:
+                return e
+            time.sleep(self._WAIT_POLL_S)
+
+    def kv_wait(self, key: str, timeout_s: float,
+                known_epoch: Optional[int] = None
+                ) -> tuple[Optional[bytes], Optional[int]]:
+        deadline = time.monotonic() + max(timeout_s, 0.0)
+        while True:
+            self.expire_members()
+            v = self.kv_get(key)
+            if v is not None:
+                return v, self.epoch()
+            e = self.epoch()
+            if known_epoch is not None and e != known_epoch:
+                return None, e
+            if time.monotonic() >= deadline:
+                return None, e
+            time.sleep(self._WAIT_POLL_S)
+
+    def server_metrics(self) -> dict:
+        return {"requests_served": 0, "longpolls_parked": 0,
+                "longpolls_fired": 0}
+
     # -- kv ----------------------------------------------------------------
 
     def kv_set(self, key: str, value: bytes) -> None:

@@ -16,6 +16,7 @@ from typing import Callable, Optional
 from edl_tpu.coord.service import (
     DEFAULT_MEMBER_TTL_MS, DEFAULT_TASK_TIMEOUT_MS, LeaseStatus, QueueStats,
 )
+from edl_tpu.observability.collector import get_counters
 
 
 class CoordError(RuntimeError):
@@ -28,6 +29,16 @@ class CoordError(RuntimeError):
 #: with a fixed fast cadence from every trainer is a reconnect storm).
 BACKOFF_BASE_S = 0.05
 BACKOFF_CAP_S = 2.0
+
+#: Per-request park ceiling for long-poll waits (wait_epoch / kv_wait).
+#: The client's request lock serializes every RPC on the one socket —
+#: including the keepalive thread's heartbeats — so a single parked wait
+#: must stay far inside the heartbeat cadence (member TTL / 3).  1 s keeps
+#: the worst-case heartbeat delay harmless at every TTL this repo deploys
+#: while still collapsing the old 20 Hz polling loops to ≤1 request/s of
+#: idle re-parks (the park itself is event-driven server-side: an epoch
+#: move or KV set wakes the request instantly).
+LONGPOLL_CHUNK_S = 1.0
 
 
 def backoff_delay(attempt: int, rng: random.Random,
@@ -69,6 +80,9 @@ class CoordClient:
         self.reconnect_window_s = reconnect_window_s
         self._lock = threading.Lock()
         self._rng = random.Random()
+        #: set once a WAIT command comes back ERR (older server): every
+        #: later wait falls back to sleep-polling instead of re-probing
+        self._no_longpoll = False
         self.on_degraded: Optional[Callable[[int, float], None]] = None
         self.on_recovered: Optional[Callable[[float], None]] = None
         # The FIRST dial also rides the window: clients are routinely
@@ -121,6 +135,10 @@ class CoordClient:
         possible (kv_cas narrows its lost-ack inference to exactly this)."""
         line = (" ".join(parts) + "\n").encode()
         retransmitted = False
+        # per-reform request load is a recorded fact, not a guess: every
+        # logical RPC (retries excluded) counts once, so a bench can diff
+        # the counter across a reform window
+        get_counters().inc("coord_requests")
         with self._lock:
             t0 = time.monotonic()
             deadline = t0 + self.reconnect_window_s
@@ -252,6 +270,118 @@ class CoordClient:
                     name, addr = item.split("=", 1)
                     out.append((name, "" if addr == "-" else addr))
         return epoch, out
+
+    # -- long-poll waits ---------------------------------------------------
+
+    def wait_epoch(self, known_epoch: int, timeout_s: float) -> int:
+        """Block until the membership epoch differs from ``known_epoch``
+        or ``timeout_s`` elapses; returns the last observed epoch.
+
+        Event-driven against servers with WAITEPOCH — the request parks
+        server-side and an epoch move wakes it instantly; re-parks every
+        :data:`LONGPOLL_CHUNK_S` so the shared request lock is never held
+        long enough to starve the keepalive heartbeats.  Falls back to
+        sleep-polling transparently against older servers."""
+        deadline = time.monotonic() + max(timeout_s, 0.0)
+        epoch = known_epoch
+        while epoch == known_epoch:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            if self._no_longpoll:
+                epoch = self.epoch()
+                if epoch == known_epoch:
+                    time.sleep(min(remaining, 0.05))
+                continue
+            chunk_ms = max(int(min(remaining, LONGPOLL_CHUNK_S) * 1000), 1)
+            r = self._call("WAITEPOCH", str(known_epoch), str(chunk_ms))
+            # yield between re-parks: CPython locks are unfair, and a
+            # tight release/re-acquire loop on the shared request lock
+            # could starve the keepalive thread's heartbeat off this same
+            # socket — 1 ms per 1 s chunk guarantees the handoff
+            time.sleep(0.001)
+            if r[0] == "OK":
+                epoch = int(r[1])
+            elif self._verb_unknown(r):
+                self._no_longpoll = True  # genuinely old server
+            else:
+                # transient server error: one bad reply must not demote
+                # this client to sleep-polling for its whole lifetime
+                time.sleep(min(remaining, 0.05))
+                epoch = self.epoch()
+        get_counters().inc(
+            "coord_longpolls", kind="epoch",
+            result="fired" if epoch != known_epoch else "timeout")
+        return epoch
+
+    def kv_wait(self, key: str, timeout_s: float,
+                known_epoch: Optional[int] = None
+                ) -> tuple[Optional[bytes], Optional[int]]:
+        """Block until ``key`` exists, the epoch moves off ``known_epoch``
+        (when given), or the timeout lapses.  Returns ``(value, epoch)``
+        where exactly one side is meaningful: ``value`` when the key
+        fired, ``epoch`` when the epoch moved first, both None-ish on
+        timeout (``epoch`` may still report the last observation)."""
+        deadline = time.monotonic() + max(timeout_s, 0.0)
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                get_counters().inc("coord_longpolls", kind="kv",
+                                   result="timeout")
+                return None, None
+            if self._no_longpoll:
+                v = self.kv_get(key)
+                if v is not None:
+                    break
+                if known_epoch is not None:
+                    e = self.epoch()
+                    if e != known_epoch:
+                        get_counters().inc("coord_longpolls", kind="kv",
+                                           result="fired")
+                        return None, e
+                time.sleep(min(remaining, 0.05))
+                continue
+            chunk_ms = max(int(min(remaining, LONGPOLL_CHUNK_S) * 1000), 1)
+            r = self._call("KVWAIT", key, str(chunk_ms),
+                           str(known_epoch) if known_epoch is not None
+                           else "-")
+            time.sleep(0.001)  # unfair-lock yield (see wait_epoch)
+            if r[0] == "OK":
+                get_counters().inc("coord_longpolls", kind="kv",
+                                   result="fired")
+                return (bytes.fromhex(r[1]) if len(r) > 1 and r[1]
+                        else b""), None
+            if r[0] == "EPOCH":
+                get_counters().inc("coord_longpolls", kind="kv",
+                                   result="fired")
+                return None, int(r[1])
+            if r[0] != "NONE":
+                if self._verb_unknown(r):
+                    self._no_longpoll = True  # genuinely old server
+                else:  # transient server error: retry, don't demote
+                    time.sleep(min(remaining, 0.05))
+        get_counters().inc("coord_longpolls", kind="kv", result="fired")
+        return v, None
+
+    @staticmethod
+    def _verb_unknown(r: list[str]) -> bool:
+        """True iff the reply is the server's unknown-command error — the
+        only evidence that justifies falling back to sleep-polling for
+        the client's lifetime (an old server never grows the verb)."""
+        return r[0] == "ERR" and len(r) > 1 and r[1] == "unknown"
+
+    def server_metrics(self) -> dict:
+        """Server-side op counters (METRICS): requests served and
+        long-polls parked/fired.  Empty dict from older servers."""
+        try:
+            r = self._call("METRICS")
+        except (OSError, CoordError):
+            return {}
+        if r[0] != "OK" or len(r) < 4:
+            return {}
+        return {"requests_served": int(r[1]),
+                "longpolls_parked": int(r[2]),
+                "longpolls_fired": int(r[3])}
 
     # -- kv ----------------------------------------------------------------
 

@@ -22,9 +22,23 @@
 //   KEYS <prefix?>                 -> OK <k1,k2,...>
 //   PING                           -> PONG
 //   CONFIG                         -> OK <task_timeout_ms> <passes> <member_ttl_ms>
+//   WAITEPOCH <epoch> <timeout_ms> -> OK <epoch>  (long-poll: parks until
+//                                     the membership epoch != <epoch> or
+//                                     the timeout lapses)
+//   KVWAIT <k> <timeout_ms> <epoch|-> -> OK <hex> | EPOCH <n> | NONE
+//                                     (parks until the key exists, the
+//                                     epoch moves off <epoch>, or timeout)
+//   METRICS                        -> OK <requests> <parked> <fired>
 //
 // Thread-per-connection; the core is mutex-guarded so this scales to the
-// O(100) workers a single job needs.
+// O(100) workers a single job needs.  The WAIT verbs are what let that
+// same thread-per-connection shape serve event-driven coordination: a
+// parked wait blocks only its own connection thread on a condition
+// variable that every handled command notifies, so reform-critical waits
+// (discovery.wait_stable, the coordinator claim, wait_state) fire within
+// microseconds of the triggering mutation instead of a poll interval —
+// and the coordinator sees ~1 request per second per idle waiter instead
+// of the 20 Hz sleep-poll loops the Python runtime used to run.
 
 #include <arpa/inet.h>
 #include <netinet/in.h>
@@ -35,10 +49,12 @@
 
 #include <atomic>
 #include <chrono>
+#include <condition_variable>
 #include <csignal>
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
+#include <mutex>
 #include <sstream>
 #include <string>
 #include <thread>
@@ -120,6 +136,49 @@ int64_t NowMs() {
       .count();
 }
 
+// Long-poll machinery: every handled command bumps the generation and
+// notifies, so a parked WAITEPOCH/KVWAIT wakes the instant any mutation
+// could have satisfied it (spurious wakeups just re-check and re-park).
+// The generation counter closes the check-then-wait race: a waiter
+// snapshots it before inspecting state, and skips the wait if a command
+// landed in between.  TTL expiry has no command to announce it, so parked
+// waits also re-check on a coarse 100 ms cadence — that bounds only
+// expiry-detection latency, never event latency.
+std::mutex g_wait_mu;
+std::condition_variable g_wait_cv;
+int64_t g_wait_gen = 0;  // guarded by g_wait_mu
+
+// Op counters (METRICS + /healthz): the recorded fact behind "long-poll
+// cut the coordinator request load" — requests served, waits that parked,
+// parked waits woken by an event (the rest timed out).
+std::atomic<int64_t> g_requests{0};
+std::atomic<int64_t> g_longpolls_parked{0};
+std::atomic<int64_t> g_longpolls_fired{0};
+
+constexpr int64_t kWaitTimeoutCapMs = 60'000;
+constexpr int64_t kWaitRecheckMs = 100;
+
+void NotifyWaiters() {
+  {
+    std::lock_guard<std::mutex> lk(g_wait_mu);
+    ++g_wait_gen;
+  }
+  g_wait_cv.notify_all();
+}
+
+// Park until the generation moves past `gen` or `chunk_ms` elapses.
+void WaitChunk(int64_t gen, int64_t chunk_ms) {
+  std::unique_lock<std::mutex> lk(g_wait_mu);
+  if (g_wait_gen != gen) return;  // a command landed since the check
+  g_wait_cv.wait_for(lk, std::chrono::milliseconds(chunk_ms),
+                     [gen] { return g_wait_gen != gen; });
+}
+
+int64_t CurrentWaitGen() {
+  std::lock_guard<std::mutex> lk(g_wait_mu);
+  return g_wait_gen;
+}
+
 using edlcoord::HexDecode;
 using edlcoord::HexEncode;
 
@@ -135,6 +194,7 @@ std::string HandleImpl(const std::string& line);
 
 // One bad line must never take down the coordinator for the whole job.
 std::string Handle(const std::string& line) {
+  g_requests.fetch_add(1);
   std::string resp;
   try {
     resp = HandleImpl(line);
@@ -146,6 +206,9 @@ std::string Handle(const std::string& line) {
   // coordinator restart must not forget it.
   if (g_service->DurableVersion() != g_persisted_version.load())
     MaybePersist();
+  // Wake parked long-polls AFTER the persist boundary, so a waiter that
+  // fires and immediately acts can never observe un-persisted state.
+  NotifyWaiters();
   return resp;
 }
 
@@ -248,6 +311,77 @@ std::string HandleImpl(const std::string& line) {
     }
     return "OK " + list;
   }
+
+  // Long-poll verbs.  Blocking here is safe: thread-per-connection means a
+  // parked wait holds nothing but its own connection thread, and the core
+  // is only touched briefly per re-check.  The epoch checks sweep TTL
+  // expiry exactly like MEMBERS does, so a parked waiter is also the one
+  // that notices a dead peer (its own sweep bumps the epoch and fires it).
+  if (cmd == "WAITEPOCH" && args.size() == 3) {
+    const int64_t known = std::stoll(args[1]);
+    const int64_t timeout_ms =
+        std::min(std::max<int64_t>(std::stoll(args[2]), 0), kWaitTimeoutCapMs);
+    const auto deadline = std::chrono::steady_clock::now() +
+                          std::chrono::milliseconds(timeout_ms);
+    bool parked = false;
+    for (;;) {
+      const int64_t gen = CurrentWaitGen();
+      s.membership.Members(NowMs());  // expiry sweep (may bump the epoch)
+      const int64_t epoch = s.membership.Epoch();
+      if (epoch != known) {
+        if (parked) g_longpolls_fired.fetch_add(1);
+        return "OK " + std::to_string(epoch);
+      }
+      const auto now = std::chrono::steady_clock::now();
+      if (now >= deadline) return "OK " + std::to_string(epoch);
+      if (!parked) {
+        parked = true;
+        g_longpolls_parked.fetch_add(1);
+      }
+      const int64_t left = std::chrono::duration_cast<
+          std::chrono::milliseconds>(deadline - now).count();
+      WaitChunk(gen, std::min(left + 1, kWaitRecheckMs));
+    }
+  }
+  if (cmd == "KVWAIT" && args.size() == 4) {
+    const std::string& key = args[1];
+    const int64_t timeout_ms =
+        std::min(std::max<int64_t>(std::stoll(args[2]), 0), kWaitTimeoutCapMs);
+    const bool watch_epoch = args[3] != "-";
+    const int64_t known = watch_epoch ? std::stoll(args[3]) : 0;
+    const auto deadline = std::chrono::steady_clock::now() +
+                          std::chrono::milliseconds(timeout_ms);
+    bool parked = false;
+    for (;;) {
+      const int64_t gen = CurrentWaitGen();
+      std::string v;
+      if (s.kv.Get(key, &v)) {
+        if (parked) g_longpolls_fired.fetch_add(1);
+        return "OK " + HexEncode(v);
+      }
+      if (watch_epoch) {
+        s.membership.Members(NowMs());
+        const int64_t epoch = s.membership.Epoch();
+        if (epoch != known) {
+          if (parked) g_longpolls_fired.fetch_add(1);
+          return "EPOCH " + std::to_string(epoch);
+        }
+      }
+      const auto now = std::chrono::steady_clock::now();
+      if (now >= deadline) return "NONE";
+      if (!parked) {
+        parked = true;
+        g_longpolls_parked.fetch_add(1);
+      }
+      const int64_t left = std::chrono::duration_cast<
+          std::chrono::milliseconds>(deadline - now).count();
+      WaitChunk(gen, std::min(left + 1, kWaitRecheckMs));
+    }
+  }
+  if (cmd == "METRICS")
+    return "OK " + std::to_string(g_requests.load()) + " " +
+           std::to_string(g_longpolls_parked.load()) + " " +
+           std::to_string(g_longpolls_fired.load());
   return "ERR unknown";
 }
 
@@ -270,6 +404,9 @@ std::string HealthBody() {
      << ",\"done\":" << done << ",\"dropped\":" << dropped << "}"
      << ",\"epoch\":" << g_service->membership.Epoch()
      << ",\"members\":" << members
+     << ",\"requests_served\":" << g_requests.load()
+     << ",\"longpolls_parked\":" << g_longpolls_parked.load()
+     << ",\"longpolls_fired\":" << g_longpolls_fired.load()
      << ",\"persisted_version\":" << g_persisted_version.load() << "}";
   return js.str();
 }

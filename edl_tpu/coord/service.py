@@ -66,6 +66,15 @@ class PyCoordService:
         clock=_now_ms,
     ) -> None:
         self._lock = threading.RLock()
+        #: wakes long-poll waiters (wait_epoch / kv_wait) the instant a
+        #: mutation lands, instead of making every worker poll on a sleep
+        self._cond = threading.Condition(self._lock)
+        #: long-poll accounting (server_metrics): how many waits actually
+        #: parked, and how many of those were woken by an event (vs timeout)
+        self.longpolls_parked = 0
+        self.longpolls_fired = 0
+        #: bumped by the TCP layer per request line; stays 0 in-process
+        self.requests_served = 0
         self._clock = clock
         # queue
         self._timeout_ms = task_timeout_ms
@@ -210,6 +219,7 @@ class PyCoordService:
             self._members[name] = (address, now + self._ttl_ms)
             if change:
                 self._epoch += 1
+                self._cond.notify_all()
             return self._epoch
 
     def heartbeat(self, name: str) -> bool:
@@ -226,6 +236,7 @@ class PyCoordService:
             if self._members.pop(name, None) is None:
                 return False
             self._epoch += 1
+            self._cond.notify_all()
             return True
 
     def expire_members(self) -> int:
@@ -236,11 +247,82 @@ class PyCoordService:
                 del self._members[n]
             if dead:
                 self._epoch += 1
+                self._cond.notify_all()
             return len(dead)
 
     def epoch(self) -> int:
         with self._lock:
             return self._epoch
+
+    # -- long-poll waits ---------------------------------------------------
+    #
+    # The event-driven replacement for the fixed-sleep polling loops every
+    # worker used to run against membership and KV (discovery.wait_stable,
+    # the multihost rendezvous, wait_state): a waiter parks on the service's
+    # condition variable and is woken the moment a mutation lands, instead
+    # of hammering members()/kv_get() on a 20 Hz sleep.  The short internal
+    # re-check cadence exists only for TTL expiry, which no command
+    # announces.  Timeouts are real-time (the contract callers hold),
+    # independent of the injectable lease/TTL clock.
+
+    #: internal re-check cadence while parked — bounds TTL-expiry
+    #: detection latency only; actual mutations wake waiters instantly
+    WAIT_RECHECK_S = 0.05
+
+    def wait_epoch(self, known_epoch: int, timeout_s: float) -> int:
+        """Block until the membership epoch differs from ``known_epoch``
+        or ``timeout_s`` elapses; returns the current epoch either way."""
+        deadline = time.monotonic() + max(timeout_s, 0.0)
+        parked = False
+        with self._cond:
+            while True:
+                self.expire_members()  # TTL truth, like MEMBERS' sweep
+                if self._epoch != known_epoch:
+                    if parked:
+                        self.longpolls_fired += 1
+                    return self._epoch
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return self._epoch
+                if not parked:
+                    parked = True
+                    self.longpolls_parked += 1
+                self._cond.wait(min(remaining, self.WAIT_RECHECK_S))
+
+    def kv_wait(self, key: str, timeout_s: float,
+                known_epoch: Optional[int] = None
+                ) -> tuple[Optional[bytes], Optional[int]]:
+        """Block until ``key`` exists (→ ``(value, epoch)``), the epoch
+        moves off ``known_epoch`` when one is given (→ ``(None, epoch)``),
+        or the timeout lapses (→ ``(None, current_epoch)``)."""
+        deadline = time.monotonic() + max(timeout_s, 0.0)
+        parked = False
+        with self._cond:
+            while True:
+                self.expire_members()
+                v = self._kv.get(key)
+                if v is not None:
+                    if parked:
+                        self.longpolls_fired += 1
+                    return bytes(v), self._epoch
+                if known_epoch is not None and self._epoch != known_epoch:
+                    if parked:
+                        self.longpolls_fired += 1
+                    return None, self._epoch
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return None, self._epoch
+                if not parked:
+                    parked = True
+                    self.longpolls_parked += 1
+                self._cond.wait(min(remaining, self.WAIT_RECHECK_S))
+
+    def server_metrics(self) -> dict:
+        """Op counters, shape-matched to CoordClient.server_metrics()."""
+        with self._lock:
+            return {"requests_served": self.requests_served,
+                    "longpolls_parked": self.longpolls_parked,
+                    "longpolls_fired": self.longpolls_fired}
 
     def members(self) -> tuple[int, list[tuple[str, str]]]:
         """(epoch, [(name, address)]) name-sorted — this order IS the rank
@@ -255,6 +337,7 @@ class PyCoordService:
     def kv_set(self, key: str, value: bytes) -> None:
         with self._lock:
             self._kv[key] = bytes(value)
+            self._cond.notify_all()
 
     def kv_get(self, key: str) -> Optional[bytes]:
         with self._lock:
@@ -262,7 +345,10 @@ class PyCoordService:
 
     def kv_del(self, key: str) -> bool:
         with self._lock:
-            return self._kv.pop(key, None) is not None
+            removed = self._kv.pop(key, None) is not None
+            if removed:
+                self._cond.notify_all()
+            return removed
 
     def kv_cas(self, key: str, expect: bytes, value: bytes) -> bool:
         """Set iff current == expect (empty expect: must not exist) — the
@@ -275,6 +361,7 @@ class PyCoordService:
             elif cur != expect:
                 return False
             self._kv[key] = bytes(value)
+            self._cond.notify_all()
             return True
 
     def kv_keys(self, prefix: str = "") -> list[str]:

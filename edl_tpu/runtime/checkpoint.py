@@ -21,6 +21,16 @@ checkpoint volumes exhibit and the fault-plan engine drills
   :meth:`ElasticCheckpointer.inject_save_failures`) into a logged, counted
   skip instead of a crashed trainer; the first successful save afterwards
   counts ``recoveries_completed{type=disk_full}``.
+
+**Async pipeline** (:meth:`ElasticCheckpointer.save_async`): the step loop
+pays only the device→host snapshot; persist + fsync + integrity-manifest
+finalization run on a background thread with bounded backpressure — never
+more than one persist in flight, so a second cadence tick blocks only if
+the previous persist hasn't landed.  Every async save is finalized with
+its manifest (verify/restore semantics identical to a synchronous save);
+``save(wait=False)`` callers get the same guarantee via :meth:`finalize`,
+closing the gap where an un-finalized async save was invisible to
+``latest_verified_step()`` forever.
 """
 
 from __future__ import annotations
@@ -28,6 +38,8 @@ from __future__ import annotations
 import errno
 import json
 import os
+import threading
+import time
 import zlib
 from pathlib import Path
 from typing import Any, Optional
@@ -84,6 +96,15 @@ class ElasticCheckpointer:
         #: consecutive failed saves — the degraded window whose end is the
         #: disk_full recovery transition
         self._save_failure_streak = 0
+        #: steps whose Orbax save was submitted with wait=False and whose
+        #: integrity manifest is therefore owed at finalize time
+        self._unfinalized: set[int] = set()
+        #: the async pipeline: at most ONE persist thread in flight
+        self._inflight: Optional[threading.Thread] = None
+        self._async_error: Optional[BaseException] = None
+        #: step-loop pause of each save_async call (backpressure + snapshot
+        #: + handoff), for percentile reporting by benches/tests
+        self.async_pauses_s: list[float] = []
 
     # -- fault injection (chaos drills) ------------------------------------
 
@@ -117,6 +138,8 @@ class ElasticCheckpointer:
         tmp = dest.with_suffix(f".{os.getpid()}.tmp")
         with open(tmp, "w") as f:
             json.dump(manifest, f)
+            f.flush()
+            os.fsync(f.fileno())  # a manifest that "exists" must be whole
         try:
             os.replace(tmp, dest)
         except OSError:
@@ -169,7 +192,21 @@ class ElasticCheckpointer:
         demand: an OSError at the persist boundary (disk full, injected or
         real) is logged and counted instead of raised — training proceeds
         with the previous checkpoint as the recovery point, and the first
-        subsequent successful save is the recovery transition."""
+        subsequent successful save is the recovery transition.
+
+        ``wait=False`` hands the write to Orbax's async machinery; the
+        step's integrity manifest is owed and written by :meth:`finalize`
+        (or :meth:`close`) — fingerprinting mid-write files would bake a
+        torn snapshot into the manifest.  Prefer :meth:`save_async`, which
+        finalizes each step automatically."""
+        self.wait_pending()  # one persist pipeline: saves never overlap
+        return self._persist(step, tree, wait=wait, best_effort=best_effort)
+
+    def _persist(self, step: int, tree: Any, wait: bool,
+                 best_effort: bool) -> bool:
+        """The persist body shared by the sync and async paths — must only
+        ever run on one thread at a time (callers serialize through
+        :meth:`wait_pending`)."""
         try:
             if self._injected_save_failures > 0:
                 self._injected_save_failures -= 1
@@ -190,10 +227,14 @@ class ElasticCheckpointer:
             get_counters().inc("checkpoint_save_failures")
             return False
         if wait:
-            # fingerprint only finalized files: an async save's files are
-            # still being written, so its manifest is written by nobody —
-            # verify() treats the step as unverifiable, not corrupt
+            # fingerprint only finalized files: an in-flight save's files
+            # are still being written, so its manifest must wait for
+            # finalize() — verify() treats the step as unverifiable, not
+            # corrupt, until then
             self._write_manifest(step)
+            self._unfinalized.discard(step)
+        else:
+            self._unfinalized.add(step)
         if self._save_failure_streak:
             log.info("checkpoint saves recovered", step=step,
                      after_failures=self._save_failure_streak)
@@ -203,11 +244,110 @@ class ElasticCheckpointer:
             self._save_failure_streak = 0
         return True
 
+    # -- the async pipeline -------------------------------------------------
+    #
+    # Cadence checkpointing used to bill the step loop for the whole
+    # persist (`save(wait=True)` at every tick); `save_async` bills it for
+    # the device→host snapshot ONLY.  The persist — Orbax write, fsync'd
+    # manifest, recovery accounting — runs on a background thread, with
+    # bounded backpressure: never more than one in flight, so memory holds
+    # at most one host snapshot and a slow disk degrades to the old
+    # synchronous behavior instead of queueing unboundedly.  All other
+    # entry points (save/restore/latest_*/finalize/close) drain the
+    # pipeline first, so Orbax never sees concurrent operations and a
+    # background failure is never silently lost.
+
+    def save_async(self, step: int, tree: Any,
+                   best_effort: bool = False,
+                   skip_if_busy: bool = False) -> float:
+        """Checkpoint ``step`` without stalling the step loop.
+
+        Snapshots ``tree`` device→host on the calling thread (the only
+        cost the caller pays when the pipeline is idle), then persists and
+        finalizes — integrity manifest included, so the step is visible to
+        ``latest_verified_step()`` exactly like a synchronous save — in
+        the background.  If the previous persist hasn't landed, blocks
+        until it has (the bounded-backpressure rule) — unless
+        ``skip_if_busy``, the CADENCE policy: the tick is dropped
+        (counted ``checkpoint_async_skipped``) and the next tick persists
+        a newer step, trading one cadence window of staleness for a step
+        loop that NEVER blocks on checkpointing (a slow disk or a
+        compile-burst starving the persist thread costs recovery
+        granularity, not training throughput).  Returns the seconds this
+        call paused the caller: the recordable checkpoint-pause.  A
+        background failure without ``best_effort`` re-raises at the next
+        sync point (any save/restore/wait/close)."""
+        import jax
+
+        t0 = time.monotonic()
+        if skip_if_busy:
+            t = self._inflight
+            if t is not None and t.is_alive():
+                get_counters().inc("checkpoint_async_skipped")
+                pause = time.monotonic() - t0
+                self.async_pauses_s.append(pause)
+                return pause
+        self.wait_pending()
+        host_tree = jax.device_get(tree)
+        # non-daemon: a persist mid-write at interpreter exit must be
+        # joined, not torn down under the C++ IO/serialization stack
+        t = threading.Thread(target=self._persist_bg,
+                             args=(step, host_tree, best_effort),
+                             name=f"ckpt-persist-{step}")
+        self._inflight = t
+        t.start()
+        pause = time.monotonic() - t0
+        self.async_pauses_s.append(pause)
+        get_counters().inc("checkpoint_async_saves")
+        return pause
+
+    def _persist_bg(self, step: int, host_tree: Any,
+                    best_effort: bool) -> None:
+        t0 = time.monotonic()
+        try:
+            if self._persist(step, host_tree, wait=True,
+                             best_effort=best_effort):
+                get_tracer().instant(
+                    "checkpoint_async_persisted", category="checkpoint",
+                    step=step,
+                    persist_ms=round((time.monotonic() - t0) * 1000, 1))
+        except BaseException as exc:  # surfaced at the next sync point
+            self._async_error = exc
+
+    def wait_pending(self) -> None:
+        """Block until the in-flight async persist (if any) has landed;
+        re-raises the failure of a non-best-effort background persist."""
+        t = self._inflight
+        if t is not None:
+            t.join()
+            self._inflight = None
+        err, self._async_error = self._async_error, None
+        if err is not None:
+            raise err
+
+    def finalize(self) -> None:
+        """Land every pending persist and write every owed manifest.
+
+        This is the async saves' durability boundary: after it returns,
+        everything previously submitted (``save_async`` or
+        ``save(wait=False)``) is on disk WITH its integrity manifest, so
+        ``latest_verified_step()`` and the restore fallback chain see it.
+        A crash before finalize leaves the step manifest-less — restore
+        treats it as unverifiable and Orbax's own parse decides, exactly
+        the pre-manifest semantics."""
+        self.wait_pending()
+        self._mgr.wait_until_finished()
+        for step in sorted(self._unfinalized):
+            self._write_manifest(step)
+        self._unfinalized.clear()
+
     def latest_step(self) -> Optional[int]:
+        self.wait_pending()
         return self._mgr.latest_step()
 
     def latest_verified_step(self) -> Optional[int]:
         """Newest step whose integrity manifest matches the files."""
+        self.wait_pending()
         for step in sorted(self._mgr.all_steps(), reverse=True):
             if self.verify(step):
                 return step
@@ -231,6 +371,7 @@ class ElasticCheckpointer:
         ONE host to an older step — a mismatched collective.  Raising
         kills the worker and lets the supervisor reform, which is the
         collective-safe recovery."""
+        self.wait_pending()  # never read the store under an in-flight write
         steps = sorted(self._mgr.all_steps(), reverse=True)
         if step is not None:
             if step not in steps:
@@ -328,4 +469,11 @@ class ElasticCheckpointer:
             f"(tried {steps})") from last_exc
 
     def close(self) -> None:
+        try:
+            self.finalize()
+        except Exception as exc:
+            # close() must still close, but a swallowed persist failure
+            # would be a silent data loss — say it loudly
+            log.warn("pending checkpoint work failed at close",
+                     error=str(exc))
         self._mgr.close()

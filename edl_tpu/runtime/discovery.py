@@ -38,6 +38,30 @@ class DiscoveryTimeout(TimeoutError):
     pass
 
 
+def wait_epoch_change(client, known_epoch: int, timeout_s: float,
+                      poll_s: float = 0.05) -> int:
+    """Block until the membership epoch differs from ``known_epoch`` or
+    ``timeout_s`` elapses; returns the last observed epoch.
+
+    The one place the reform-critical path waits on membership: backends
+    with a long-poll surface (``wait_epoch`` — the coord service, client
+    and native server all grew one) park event-driven and wake within
+    microseconds of the join/leave/expiry that matters; duck-typed
+    backends without it fall back to the old sleep-poll."""
+    wait = getattr(client, "wait_epoch", None)
+    if wait is not None:
+        try:
+            return wait(known_epoch, timeout_s)
+        except Exception:
+            pass  # degraded backend mid-call: fall back to polling below
+    deadline = time.monotonic() + max(timeout_s, 0.0)
+    epoch = client.epoch()
+    while epoch == known_epoch and time.monotonic() < deadline:
+        time.sleep(poll_s)
+        epoch = client.epoch()
+    return epoch
+
+
 class CoordDiscovery:
     """Rendezvous through the coordination service's membership epochs."""
 
@@ -161,16 +185,20 @@ class CoordDiscovery:
                      poll_s: float = 0.1) -> list[tuple[str, str]]:
         """Barrier until ≥ n members are live (role of wait_pods_running,
         k8s_tools.py:70-78 — ``≥`` not ``==`` because "pods may be
-        scaled")."""
+        scaled").  Event-driven: the member count only changes when the
+        epoch moves, so the wait parks on that instead of re-listing on a
+        sleep cadence."""
         deadline = time.monotonic() + timeout_s
         while True:
-            peers = self.peers()
-            if len(peers) >= n:
-                return peers
-            if time.monotonic() >= deadline:
+            epoch, members = self._client.members()
+            if len(members) >= n:
+                return sorted(members)
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
                 raise DiscoveryTimeout(
-                    f"waited {timeout_s}s for {n} members, have {len(peers)}")
-            time.sleep(poll_s)
+                    f"waited {timeout_s}s for {n} members, "
+                    f"have {len(members)}")
+            wait_epoch_change(self._client, epoch, remaining, poll_s=poll_s)
 
 
 class PodDiscovery:

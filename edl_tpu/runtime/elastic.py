@@ -21,10 +21,21 @@ anything is committed.  A failure anywhere mid-resize (compile error,
 OOM during ``device_put``) rolls back to the previous mesh — the trainer
 keeps stepping on the world it had, with a ``resizes_failed`` counter as
 the audit trail, instead of being stranded with half-moved state.
+
+Resizes are also **prewarmable**: the dominant resize cost is the jit
+compile of the step function for the new mesh, and the autoscaler's plan
+knows the likely next parallelism before the pods ever move —
+:meth:`ElasticTrainer.prewarm` takes those hints and compiles neighbor
+mesh bundles on a background thread (AOT, against the last seen batch
+shape), so the resize itself pays only the reshard hop.  Every resize
+records its ``compile_ms`` / ``reshard_ms`` split (``resize_events``, the
+``mesh_resized`` trace event, and ``prewarm_hits``/``prewarm_misses``
+counters), so the prewarm win is a recorded fact, not a claim.
 """
 
 from __future__ import annotations
 
+import threading
 import time
 from dataclasses import dataclass
 from typing import Any, Callable, Optional, Sequence
@@ -43,6 +54,12 @@ from edl_tpu.parallel.mesh import (
 )
 
 log = get_logger("runtime.elastic")
+
+#: how long a resize may wait on another thread's in-flight bundle build
+#: before treating it as wedged and rolling back.  Generous — first
+#: compiles run 20-40 s on real TPUs — but finite: the alternative is a
+#: step loop blocked forever behind a hung compile
+BUILD_WAIT_TIMEOUT_S = 300.0
 
 
 def _reshard(tree: Any, shardings: Any) -> Any:
@@ -73,6 +90,16 @@ class _MeshBundle:
     batch_sharding: Any
     step_fn: Callable = None
     eval_fn: Callable = None
+    #: AOT-compiled executable of ``step_fn`` for ``batch_spec`` — what
+    #: makes a prewarmed resize actually skip the compile (a bare jax.jit
+    #: object defers compilation to its first CALL, i.e. back onto the
+    #: step loop).  None when no batch shape was known at build time;
+    #: step() falls back to the jit path, which compiles on first use.
+    compiled_step: Any = None
+    batch_spec: Any = None
+    #: who built it ("resize" inline, or "prewarm" speculatively) — the
+    #: provenance behind the prewarm_hits counter
+    source: str = "resize"
 
 
 class ElasticTrainer:
@@ -94,6 +121,7 @@ class ElasticTrainer:
         param_sharding: str = "replicated",
         devices: Optional[Sequence[jax.Device]] = None,
         initial_world_size: Optional[int] = None,
+        prewarm_cache_limit: int = 4,
     ) -> None:
         self.loss_fn = loss_fn
         self.optimizer = optimizer
@@ -101,8 +129,30 @@ class ElasticTrainer:
         self.param_sharding_kind = param_sharding
         self._devices = list(devices) if devices is not None else jax.devices()
         self._step_cache: dict[tuple[int, tuple], _MeshBundle] = {}
+        #: guards the step cache + build coordination: resize() on the
+        #: caller thread and prewarm on its background thread must agree
+        #: on who compiles a given size exactly once
+        self._cache_lock = threading.RLock()
+        #: key → Event for a bundle currently compiling; a resize of a
+        #: size that is mid-prewarm waits for THAT compile (finishing a
+        #: partially paid compile) instead of duplicating it
+        self._building: dict[tuple[int, tuple], threading.Event] = {}
+        #: speculative (prewarm-built) bundles not yet used by a resize,
+        #: oldest first — hints for sizes that never arrive are evicted
+        #: beyond ``prewarm_cache_limit`` so a chatty planner can't grow
+        #: the executable cache without bound
+        self._prewarm_unused: list[tuple[int, tuple]] = []
+        self.prewarm_cache_limit = max(int(prewarm_cache_limit), 1)
+        #: abstract (shape/dtype) pytree of the last stepped batch — what
+        #: prewarm AOT-compiles against; None until the first step
+        self._batch_abstract: Any = None
+        self._batch_spec: Any = None
+        self._last_batch: Any = None
         self.resizes = 0
         self.resizes_failed = 0
+        #: one record per successful resize: size, compile_ms, reshard_ms,
+        #: prewarm_hit — the split the bench artifacts report
+        self.resize_events: list[dict] = []
         self.mesh = None
         self.state = TrainState(params=params,
                                 opt_state=optimizer.init(params))
@@ -128,7 +178,6 @@ class ElasticTrainer:
         """
         if n_devices == self.world_size:
             return True
-        t0 = time.monotonic()
         try:
             bundle, new_params, new_opt = self._stage(n_devices)
         except Exception as exc:
@@ -146,15 +195,120 @@ class ElasticTrainer:
             return False
         self._commit(bundle, new_params, new_opt)
         self.resizes += 1
+        evt = dict(self._last_split, size=n_devices, step=self.state.step)
+        self.resize_events.append(evt)
+        get_tracer().instant("mesh_resized", category="elastic", **evt)
+        get_counters().inc("prewarm_hits" if evt["prewarm_hit"]
+                           else "prewarm_misses")
         log.info("mesh resized", world_size=n_devices,
-                 reshard_ms=round((time.monotonic() - t0) * 1000, 1),
-                 step=self.state.step)
+                 compile_ms=evt["compile_ms"], reshard_ms=evt["reshard_ms"],
+                 prewarm_hit=evt["prewarm_hit"], step=self.state.step)
         return True
+
+    def prewarm(self, sizes: Sequence[int],
+                wait: bool = False) -> Optional[threading.Thread]:
+        """Speculatively compile the mesh bundles for likely next world
+        sizes on a background thread, so a later :meth:`resize` to one of
+        them pays only the reshard hop.
+
+        Feed it the autoscaler/planner's hints — the plan knows the next
+        parallelism before the pods ever move, which is exactly the
+        compile window.  Sizes that are invalid, current, already cached,
+        or already compiling are skipped.  Speculative bundles that no
+        resize ever uses are evicted beyond ``prewarm_cache_limit``
+        (oldest first), so hints for sizes that never arrive stay
+        bounded.  A prewarm failure is logged and counted, never raised —
+        the inline-compile path still rules.
+
+        Returns the worker thread (joined already when ``wait=True``),
+        or None when there was nothing to do."""
+        wanted = []
+        with self._cache_lock:
+            for n in sizes:
+                try:
+                    n = int(n)
+                except (TypeError, ValueError):
+                    continue
+                if (n < 1 or n > len(self._devices) or n == self.world_size
+                        or n in wanted):
+                    continue
+                key = self._cache_key(n)
+                if key in self._step_cache or key in self._building:
+                    continue
+                wanted.append(n)
+        if not wanted:
+            return None
+        # NON-daemon, deliberately: a daemon thread still inside XLA's
+        # C++ compiler when the interpreter finalizes races the runtime's
+        # static teardown and aborts the process (std::terminate — seen
+        # as a shutdown SIGABRT in test runs).  Compiles are finite, so
+        # joining at exit costs at most one compile's tail.
+        t = threading.Thread(target=self._prewarm_bg, args=(tuple(wanted),),
+                             name="mesh-prewarm")
+        t.start()
+        if wait:
+            t.join()
+        return t
+
+    def is_building(self, n_devices: int) -> bool:
+        """True while a speculative build for ``n_devices`` is in flight.
+
+        The elastic loop's deferral predicate: a resize whose bundle is
+        still compiling does not have to stall waiting for it — training
+        can continue on the CURRENT world and commit the resize a few
+        steps later, when the staged bundle is ready.  (Correct because a
+        resize is never a correctness event, only a capacity adjustment:
+        the new pods idle a moment longer, the step loop never stops.)"""
+        with self._cache_lock:
+            return self._cache_key(n_devices) in self._building
+
+    def prewarm_quiesce(self, timeout_s: float = 10.0) -> bool:
+        """Block until no speculative build is in flight; True when quiet.
+
+        For harnesses whose hint→resize gap is unrealistically short: on
+        a real cluster the autoscaler's hint leads the resize by pod
+        startup (seconds to minutes), while an in-process fake starts
+        pods in milliseconds — this models that head start explicitly
+        instead of letting the resize eat the whole compile as wait."""
+        deadline = time.monotonic() + timeout_s
+        while True:
+            with self._cache_lock:
+                evs = list(self._building.values())
+            if not evs:
+                return True
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return False
+            evs[0].wait(remaining)
+
+    def _prewarm_bg(self, sizes: tuple) -> None:
+        for n in sizes:
+            t0 = time.perf_counter()
+            try:
+                bundle, cached = self._acquire_bundle(n, source="prewarm")
+            except Exception as exc:
+                log.warn("mesh prewarm failed; resize will compile inline",
+                         size=n, error=str(exc)[:200])
+                get_counters().inc("prewarms_failed")
+                continue
+            if cached:
+                continue  # someone else built it meanwhile
+            get_tracer().instant(
+                "mesh_prewarmed", category="elastic", size=n,
+                compile_ms=round((time.perf_counter() - t0) * 1000, 1))
+            get_counters().inc("mesh_prewarms")
 
     def step(self, batch) -> float:
         """One training step on the current mesh; returns the scalar loss."""
+        self._remember_batch(batch)
         batch = jax.device_put(batch, self._batch_sharding)
-        self.state.params, self.state.opt_state, loss = self._step_fn(
+        fn = self._step_fn
+        if (self._compiled_step is not None
+                and self._bundle_batch_spec == self._batch_spec):
+            # the AOT executable staged by resize/prewarm — a jax.jit
+            # object would compile here, on the step loop
+            fn = self._compiled_step
+        self.state.params, self.state.opt_state, loss = fn(
             self.state.params, self.state.opt_state, batch
         )
         self.state.step += 1
@@ -175,31 +329,166 @@ class ElasticTrainer:
             getattr(d, "id", i) for i, d in
             enumerate(self._devices[:n_devices]))
 
+    def _remember_batch(self, batch: Any) -> None:
+        """Track the stepped batch's abstract shape — the signature
+        prewarm/stage AOT-compiles against.
+
+        Per-step cost: one identity check when the caller reuses the
+        batch container, else a small spec tuple over the batch's leaves
+        (batches are few-leaf trees — inputs/targets/weights — so this is
+        nanoseconds next to the step dispatch).  The abstract tree is
+        only rebuilt when the shape actually changes."""
+        if batch is self._last_batch:
+            return
+        self._last_batch = batch
+        spec = tuple(
+            (tuple(x.shape), str(getattr(x, "dtype", type(x))))
+            for x in jax.tree.leaves(batch))
+        if spec != self._batch_spec:
+            self._batch_spec = spec
+            self._batch_abstract = jax.tree.map(
+                lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), batch)
+
+    def _acquire_bundle(self, n_devices: int, source: str = "resize"
+                        ) -> tuple[_MeshBundle, bool]:
+        """Fetch or build the bundle for ``n_devices``; returns
+        ``(bundle, was_cached)``.
+
+        Exactly-once compile across threads: whoever wins the build slot
+        compiles; a concurrent caller of the same size (the classic race:
+        resize() of a size that is mid-prewarm) parks on the builder's
+        event and picks up the finished bundle — paying only the
+        *remainder* of a compile that started earlier, which is the whole
+        point of speculation."""
+        key = self._cache_key(n_devices)
+        while True:
+            with self._cache_lock:
+                bundle = self._step_cache.get(key)
+                ev = None
+                if bundle is None:
+                    ev = self._building.get(key)
+                    if ev is None:
+                        ev = threading.Event()
+                        self._building[key] = ev
+                        break  # this thread builds
+                elif source == "resize" and key in self._prewarm_unused:
+                    # graduate at ACQUISITION, not commit: the reshard
+                    # window between here and _commit must not leave the
+                    # bundle eligible for eviction by a concurrent
+                    # prewarm crossing the cache limit
+                    self._prewarm_unused.remove(key)
+            if bundle is not None:
+                # upgrade path: a bundle built before any batch shape was
+                # known (the run-start neighbor prewarm) carries no AOT
+                # executable — fill it in now, outside the cache lock
+                self._ensure_aot(bundle)
+                return bundle, True
+            # bounded: a WEDGED speculative compile (the silent-hang class
+            # the stall watchdog exists for) must surface as a failed
+            # resize — which rolls back and keeps training — not as a
+            # step loop blocked forever on another thread's compile
+            if not ev.wait(BUILD_WAIT_TIMEOUT_S):
+                raise RuntimeError(
+                    f"mesh bundle build for size {n_devices} still in "
+                    f"flight after {BUILD_WAIT_TIMEOUT_S}s — wedged "
+                    "compile; keeping the current world")
+            # loop: the builder either cached the bundle (hit next pass)
+            # or failed (this thread takes over the build slot)
+        try:
+            bundle = self._build_bundle(n_devices, source)
+            with self._cache_lock:
+                # cache only once fully compiled: a compile that failed
+                # halfway must not leave a poisoned entry for the retry.
+                # A later reshard failure (OOM) keeps the entry — the
+                # compiled world is still valid, the retry skips compile.
+                self._step_cache[key] = bundle
+                if source == "prewarm":
+                    self._prewarm_unused.append(key)
+                    self._evict_unused_locked()
+            return bundle, False
+        finally:
+            with self._cache_lock:
+                self._building.pop(key, None)
+            ev.set()
+
+    def _evict_unused_locked(self) -> None:
+        """Bound the speculative cache: drop the oldest prewarm-built,
+        never-resized-to bundles past ``prewarm_cache_limit``.  Entries a
+        resize used (and the live world) are exempt — they are the
+        oscillation cache that predates prewarm."""
+        live_key = self._cache_key(self.world_size) if self.mesh else None
+        while len(self._prewarm_unused) > self.prewarm_cache_limit:
+            victim = self._prewarm_unused.pop(0)
+            if victim == live_key:
+                continue
+            if self._step_cache.pop(victim, None) is not None:
+                log.info("evicted unused prewarmed mesh bundle",
+                         size=victim[0])
+                get_counters().inc("prewarms_evicted")
+
+    def _build_bundle(self, n_devices: int, source: str) -> _MeshBundle:
+        mesh = make_mesh(n_devices, self.spec, devices=self._devices)
+        bundle = _MeshBundle(
+            mesh=mesh,
+            param_shardings=tree_shardings(
+                mesh, self.state.params, self.param_sharding_kind),
+            opt_shardings=tree_shardings(
+                mesh, self.state.opt_state, self.param_sharding_kind),
+            batch_sharding=dp_sharding(mesh),
+            source=source,
+        )
+        bundle.step_fn, bundle.eval_fn = self._compile_step(bundle)
+        self._ensure_aot(bundle)
+        return bundle
+
+    def _ensure_aot(self, bundle: _MeshBundle) -> None:
+        """AOT-compile the bundle's step for the last seen batch shape.
+
+        jax.jit defers compilation to the first CALL, which for a freshly
+        resized mesh is the first step — i.e. the hot loop.  Lowering
+        against the last batch's abstract shapes moves that cost here,
+        where prewarm pays it on a background thread (or, for a bundle
+        built before any batch was seen, the next acquisition fills it
+        in).  No-op until a step has taught the trainer its batch shape.
+        Best-effort: any AOT failure (exotic dtypes, jax version drift)
+        leaves the compile-on-first-call jit fallback.  Idempotent per
+        batch shape; a rare concurrent double-compile is harmless."""
+        batch_abstract, batch_spec = self._batch_abstract, self._batch_spec
+        if batch_abstract is None or bundle.batch_spec == batch_spec:
+            return
+        try:
+            abstract = lambda t: jax.tree.map(  # noqa: E731
+                lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), t)
+            compiled = bundle.step_fn.lower(
+                abstract(self.state.params),
+                abstract(self.state.opt_state),
+                batch_abstract).compile()
+            bundle.compiled_step, bundle.batch_spec = compiled, batch_spec
+        except Exception as exc:
+            log.warn("AOT step compile failed; first step will "
+                     "compile inline", size=bundle.mesh.size,
+                     error=str(exc)[:200])
+
     def _stage(self, n_devices: int) -> tuple[_MeshBundle, Any, Any]:
         """Build (or fetch) everything the new world needs WITHOUT
         touching live state: the mesh bundle plus the state resharded
         into fresh buffers.  device_put copies — the previous arrays stay
-        valid until :meth:`_commit`, which is what makes rollback free."""
-        key = self._cache_key(n_devices)
-        bundle = self._step_cache.get(key)
-        if bundle is None:
-            mesh = make_mesh(n_devices, self.spec, devices=self._devices)
-            bundle = _MeshBundle(
-                mesh=mesh,
-                param_shardings=tree_shardings(
-                    mesh, self.state.params, self.param_sharding_kind),
-                opt_shardings=tree_shardings(
-                    mesh, self.state.opt_state, self.param_sharding_kind),
-                batch_sharding=dp_sharding(mesh),
-            )
-            bundle.step_fn, bundle.eval_fn = self._compile_step(bundle)
-            # cache only once fully compiled: a compile that failed
-            # halfway must not leave a poisoned entry for the retry.  A
-            # later reshard failure (OOM) keeps the entry — the compiled
-            # world is still valid and the retry skips the compile.
-            self._step_cache[key] = bundle
+        valid until :meth:`_commit`, which is what makes rollback free.
+        Records the compile/reshard wall-time split in ``_last_split``."""
+        t0 = time.perf_counter()
+        bundle, cached = self._acquire_bundle(n_devices)
+        t1 = time.perf_counter()
         new_params = _reshard(self.state.params, bundle.param_shardings)
         new_opt = _reshard(self.state.opt_state, bundle.opt_shardings)
+        t2 = time.perf_counter()
+        self._last_split = {
+            # bundle-acquisition wall time: ~0 on a cache hit, the full
+            # compile when built inline, the residual wait when a resize
+            # landed mid-prewarm
+            "compile_ms": round((t1 - t0) * 1000, 2),
+            "reshard_ms": round((t2 - t1) * 1000, 2),
+            "prewarm_hit": bool(cached and bundle.source == "prewarm"),
+        }
         return bundle, new_params, new_opt
 
     def _commit(self, bundle: _MeshBundle, new_params: Any,
@@ -212,8 +501,16 @@ class ElasticTrainer:
         self._batch_sharding = bundle.batch_sharding
         self._step_fn = bundle.step_fn
         self._eval_fn = bundle.eval_fn
+        self._compiled_step = bundle.compiled_step
+        self._bundle_batch_spec = bundle.batch_spec
         self.state.params = new_params
         self.state.opt_state = new_opt
+        with self._cache_lock:
+            # the bundle is live: it graduated from speculation, so it is
+            # no longer an eviction candidate
+            key = self._cache_key(bundle.mesh.size)
+            if key in self._prewarm_unused:
+                self._prewarm_unused.remove(key)
 
     def _compile_step(self, bundle: _MeshBundle):
         grad_fn = jax.value_and_grad(self.loss_fn)

@@ -42,6 +42,17 @@ class RunReport:
     #: when one lands before the first step or two land between samples
     #: of the world-size trace
     resize_steps: list[int] = field(default_factory=list)
+    #: per-resize split from ElasticTrainer.resize_events: how much of
+    #: each resize was bundle compile vs state reshard, and how many
+    #: resizes landed on a prewarmed bundle — the evidence that
+    #: speculation moved the compile off the hot path
+    resize_compile_ms: list[float] = field(default_factory=list)
+    resize_reshard_ms: list[float] = field(default_factory=list)
+    prewarm_hits: int = 0
+    #: steps spent training on the OLD world while the new world's bundle
+    #: was still compiling (deferred resize — the zero-stall alternative
+    #: to blocking on an in-flight speculative compile)
+    resize_deferred_steps: int = 0
 
     @property
     def first_loss(self) -> float:
@@ -64,6 +75,8 @@ class LocalElasticJob:
         fetch: Callable,
         batch_size: int,
         max_devices: Optional[int] = None,
+        prewarm_neighbors: bool = True,
+        resize_defer_s: float = 30.0,
     ) -> None:
         self.job = job
         self.cluster = cluster
@@ -72,17 +85,58 @@ class LocalElasticJob:
         self.fetch = fetch
         self.batch_size = batch_size
         self.max_devices = max_devices or len(trainer._devices)
+        #: speculative compile policy: after every commit, prewarm the
+        #: adjacent valid world sizes — an elastic job's next resize is
+        #: overwhelmingly one hop along the grow/shrink trace, so the
+        #: compile is almost always done (or at least started) by the
+        #: time the pod count actually moves
+        self.prewarm_neighbors = prewarm_neighbors
+        #: zero-stall deferral: when the target size's bundle is still
+        #: compiling (speculation in flight), keep training on the
+        #: CURRENT world instead of blocking the step loop on the
+        #: compile; commit the resize once the bundle is staged.  The
+        #: budget bounds deferral so a wedged compile can't postpone a
+        #: resize forever (0 disables: resizes wait inline).
+        self.resize_defer_s = resize_defer_s
+
+    def _snap(self, n: int) -> int:
+        """Clamp to available devices and snap down to a divisor of the
+        global batch — the same rule desired_world_size applies."""
+        n = min(max(n, 1), self.max_devices)
+        while n > 1 and self.batch_size % n != 0:
+            n -= 1
+        return n
 
     def desired_world_size(self) -> int:
         """Running trainer pods, clamped to available devices and snapped
         down to a divisor of the global batch (a DP mesh must divide the
         batch; the scheduler's SliceShapePolicy normally guarantees this —
         the snap is a belt-and-braces guard for unit-policy jobs)."""
-        counts = self.cluster.job_pods(self.job)
-        n = min(max(counts.running, 1), self.max_devices)
-        while n > 1 and self.batch_size % n != 0:
-            n -= 1
-        return n
+        return self._snap(self.cluster.job_pods(self.job).running or 1)
+
+    def _neighbor_sizes(self, current: int) -> list[int]:
+        """The adjacent valid world sizes (next divisor of the batch in
+        each direction) — the prewarm candidates."""
+        out = []
+        for n in range(current + 1, self.max_devices + 1):
+            if self.batch_size % n == 0:
+                out.append(n)
+                break
+        for n in range(current - 1, 0, -1):
+            if n == 1 or self.batch_size % n == 0:
+                out.append(n)
+                break
+        return out
+
+    def prewarm_for_parallelism(self, parallelism: int) -> None:
+        """Autoscaler plan hint → speculative mesh compile.
+
+        Wire this to :attr:`Autoscaler.hint_sink` (via a uid match): the
+        plan knows the next parallelism before any pod moves, so the
+        mesh bundle for the size this loop will eventually observe can
+        compile off the hot path.  Applies the same clamp/snap rule the
+        loop itself will apply when the pods land."""
+        self.trainer.prewarm([self._snap(parallelism)])
 
     def run(
         self,
@@ -101,15 +155,45 @@ class LocalElasticJob:
             self.coord, worker=f"{self.job.full_name}/driver",
             fetch=self.fetch, batch_size=self.batch_size,
         )
+        defer_deadline: Optional[float] = None
+        defer_target: Optional[int] = None
         for batch in batches:
             want = self.desired_world_size()
             resized_at = None
+            if want == self.trainer.world_size:
+                defer_deadline = defer_target = None
+            else:
+                if (self.resize_defer_s > 0
+                        and self.trainer.is_building(want)):
+                    # the new world's bundle is still compiling: train on
+                    # the world we have instead of stalling the step loop
+                    # on the compile — the resize commits a few steps
+                    # from now, when the staged bundle is ready.  The
+                    # budget is per TARGET: a plan that revises the size
+                    # mid-deferral starts a fresh window for the new
+                    # size's compile instead of inheriting a spent one.
+                    now = time.perf_counter()
+                    if defer_deadline is None or want != defer_target:
+                        defer_target = want
+                        defer_deadline = now + self.resize_defer_s
+                    if now < defer_deadline:
+                        report.resize_deferred_steps += 1
+                        want = self.trainer.world_size
             if want != self.trainer.world_size:
+                defer_deadline = defer_target = None
                 before = self.trainer.world_size
                 resized_at = time.perf_counter()
-                self.trainer.resize(want)
+                ok = self.trainer.resize(want)
                 report.resizes += 1
                 report.resize_steps.append(report.steps)
+                if ok and self.trainer.resize_events:
+                    evt = self.trainer.resize_events[-1]
+                    report.resize_compile_ms.append(evt["compile_ms"])
+                    report.resize_reshard_ms.append(evt["reshard_ms"])
+                    report.prewarm_hits += int(evt["prewarm_hit"])
+                if ok and self.prewarm_neighbors:
+                    # next hop along the grow/shrink trace, compiled now
+                    self.trainer.prewarm(self._neighbor_sizes(want))
                 log.info("elastic resize applied", job=self.job.full_name,
                          from_size=before, to_size=want,
                          step=self.trainer.state.step)
@@ -118,6 +202,14 @@ class LocalElasticJob:
                 report.resize_seconds.append(
                     time.perf_counter() - resized_at)
             report.steps += 1
+            if report.steps == 1 and self.prewarm_neighbors:
+                # first prewarm AFTER the first step, not at run start:
+                # the step teaches the trainer its batch shape, which is
+                # what lets the speculative bundles AOT-compile — a
+                # shape-blind prewarm would leave the first post-resize
+                # step to compile inline anyway
+                self.trainer.prewarm(
+                    self._neighbor_sizes(self.trainer.world_size))
             report.losses.append(loss)
             report.world_sizes.append(self.trainer.world_size)
             if on_step is not None:

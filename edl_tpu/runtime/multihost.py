@@ -63,7 +63,7 @@ from typing import Any, Callable, Optional
 import numpy as np
 
 from edl_tpu.observability.logging import get_logger
-from edl_tpu.runtime.discovery import CoordDiscovery
+from edl_tpu.runtime.discovery import CoordDiscovery, wait_epoch_change
 
 log = get_logger("runtime.multihost")
 
@@ -272,12 +272,15 @@ class ElasticWorld:
 
     def wait_epoch_past(self, epoch: int, timeout_s: float = 60.0) -> None:
         """Block until membership moves past ``epoch`` (a leaver deregisters
-        or the TTL prunes a dead one)."""
+        or the TTL prunes a dead one).  Parks on the coordinator's
+        long-poll instead of sleep-polling."""
         deadline = time.monotonic() + timeout_s
         while self._coord.epoch() == epoch:
-            if time.monotonic() >= deadline:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
                 raise TimeoutError(f"membership stuck at epoch {epoch}")
-            time.sleep(self._poll_s)
+            wait_epoch_change(self._coord, epoch, remaining,
+                              poll_s=self._poll_s)
 
     def wait_stable(self, min_members: int = 1, timeout_s: float = 120.0
                     ) -> tuple[int, list[str]]:
@@ -297,8 +300,8 @@ class ElasticWorld:
             if epoch != last_epoch or last_epoch == -1:
                 # refresh the eviction set only when membership moved:
                 # every eviction bumps the epoch (the leave written on
-                # the victim's behalf), so a per-poll prefix scan would
-                # be 20 Hz of coordinator load buying nothing
+                # the victim's behalf), so scanning the prefix more often
+                # would be coordinator load buying nothing
                 evicted = self.evicted_names()
             if self.name in evicted:
                 raise WorkerEvicted(
@@ -315,7 +318,16 @@ class ElasticWorld:
                 raise FormationTimeout(
                     f"membership never stabilized at ≥{min_members} "
                     f"members within {timeout_s}s (have {names})")
-            time.sleep(self._poll_s)
+            # Event-driven settle: park until the epoch moves (resets the
+            # stability window) or the settle window closes — the wait
+            # returns at exactly one of the two instants the loop needs
+            # to re-evaluate, so a stable membership costs ~1 request per
+            # settle window instead of a 20 Hz members() poll.
+            settle_left = self._settle_s - (now - stable_since)
+            park = min(deadline - now,
+                       settle_left if settle_left > 0 else deadline - now)
+            wait_epoch_change(self._coord, epoch, max(park, 0.001),
+                              poll_s=self._poll_s)
 
     # -- world planning ----------------------------------------------------
 
@@ -434,7 +446,24 @@ class ElasticWorld:
                 endpoint = raw.decode() if raw else endpoint
             return endpoint
         deadline = time.monotonic() + max(budget_s, 0.01)
+        kv_wait = getattr(self._coord, "kv_wait", None)
         while time.monotonic() < deadline:
+            if kv_wait is not None:
+                # one parked request covers both exits: the leader's KVSET
+                # fires it instantly, and an epoch move (stale world)
+                # fires it with the new epoch instead
+                try:
+                    raw, seen_epoch = kv_wait(
+                        key, max(deadline - time.monotonic(), 0.01),
+                        known_epoch=epoch)
+                except Exception:
+                    kv_wait = None  # degraded backend: poll below
+                    continue
+                if raw:
+                    return raw.decode()
+                if seen_epoch is not None and seen_epoch != epoch:
+                    return None
+                continue
             raw = self._coord.kv_get(key)
             if raw:
                 return raw.decode()
@@ -539,14 +568,26 @@ class ElasticWorld:
     def wait_state(self, epoch: int, timeout_s: float = 30.0
                    ) -> Optional[tuple[int, str]]:
         """Wait for the generation written at ``epoch`` (reform sync point);
-        falls back to the latest earlier generation at timeout."""
+        falls back to the latest earlier generation at timeout.  Parks on
+        the coordinator's KV long-poll — the leader's publish wakes every
+        blocked peer at once instead of at their next poll tick."""
         deadline = time.monotonic() + timeout_s
         key = _CKPT_KEY.format(epoch=epoch)
+        kv_wait = getattr(self._coord, "kv_wait", None)
         while time.monotonic() < deadline:
-            raw = self._coord.kv_get(key)
+            if kv_wait is not None:
+                try:
+                    raw, _ = kv_wait(
+                        key, max(deadline - time.monotonic(), 0.01))
+                except Exception:
+                    kv_wait = None  # degraded backend: poll below
+                    continue
+            else:
+                raw = self._coord.kv_get(key)
             if raw:
                 return epoch, raw.decode()
-            time.sleep(self._poll_s)
+            if kv_wait is None:
+                time.sleep(self._poll_s)
         return self.latest_state(epoch)
 
 
@@ -590,6 +631,12 @@ class WorkerConfig:
     #: replace); the supervisor's StallWatchdog reads it.  None = no
     #: stall detection for this worker.
     heartbeat_path: Optional[str] = None
+    #: persistent XLA compilation cache directory for world children
+    #: (None = EDL_COMPILE_CACHE env, else <ckpt_dir>/.jax_compilation_cache;
+    #: "" disables).  Explicit plumbing so deployments — the compiled pod
+    #: manifests mount a cache volume and point EDL_COMPILE_CACHE at it —
+    #: and tests can pin where the post-reform recompile amortizes.
+    compile_cache_dir: Optional[str] = None
 
 
 #: exactly how many of the newest state generations survive GC.  The
@@ -698,10 +745,17 @@ def _world_child(plan: WorldPlan, cfg: WorkerConfig, result_path: str,
     # first gets its train step from disk instead of recompiling, which is
     # most of the reform latency on both CPU worlds (measured: the
     # join-reform went 53 s -> cache-hit seconds) and TPU worlds (20-40 s
-    # first compile).  EDL_COMPILE_CACHE overrides; empty disables.
-    cache_dir = os.environ.get(
-        "EDL_COMPILE_CACHE",
-        os.path.join(cfg.ckpt_dir, ".jax_compilation_cache"))
+    # first compile).  Deployed pods wire it explicitly: the compiled
+    # trainer manifests mount a cache volume and set EDL_COMPILE_CACHE
+    # (controller/jobparser.py COMPILE_CACHE_PATH), so RESPAWNED world
+    # children — warm or cold, every epoch after a pod's first — load
+    # their step from the cache the previous child populated.
+    # cfg.compile_cache_dir pins it programmatically; empty disables.
+    cache_dir = cfg.compile_cache_dir
+    if cache_dir is None:
+        cache_dir = os.environ.get(
+            "EDL_COMPILE_CACHE",
+            os.path.join(cfg.ckpt_dir, ".jax_compilation_cache"))
     if cache_dir:
         try:
             os.makedirs(cache_dir, exist_ok=True)
@@ -799,17 +853,69 @@ def _world_child(plan: WorldPlan, cfg: WorkerConfig, result_path: str,
             return (ew.epoch() != world.epoch
                     or ew.leave_announced(world.epoch))
 
+        # Async cadence pipeline (replicated mode): the step loop already
+        # paid the device→host transfer in the training body; the npz
+        # write + KV pointer publish move to a background thread with
+        # bounded backpressure — one publish in flight, a second cadence
+        # tick blocks only until the previous one lands.  Collective mode
+        # stays synchronous: the sharded save IS a barrier every rank
+        # must enter together, so "async" would just park it on another
+        # thread while the step loop waits anyway.
+        mid_inflight: list = []  # 0 or 1 running publish threads
+
+        def _drain_mid() -> None:
+            while mid_inflight:
+                mid_inflight.pop().join()
+
+        def _publish_mid_bg(cur_state: Any, step: int, dest: str) -> None:
+            try:
+                ew.publish_mid_state(world.epoch, step,
+                                     lambda: cfg.save_state(cur_state, dest))
+            except Exception as exc:
+                # a mid generation is crash-loss *bounding*, not the
+                # durable boundary gen — losing one shrinks nothing but
+                # the bound, so log and keep training
+                print(f"[{cfg.name}] async mid-checkpoint at step {step} "
+                      f"failed: {str(exc)[:200]}", file=sys.stderr,
+                      flush=True)
+
         def mid_checkpoint(cur_state: Any, step: int) -> None:
             """Periodic in-world generation: bounds crash loss to the
             caller's cadence window.  Replicated mode: leader-only (every
-            rank holds identical state, the save is local).  Collective
-            mode: every rank must call at the same step — the sharded
-            save is a barrier."""
+            rank holds identical state, the save is local) and async —
+            see the pipeline note above.  Collective mode: every rank
+            must call at the same step — the sharded save is a barrier."""
             if not (cfg.collective_ckpt or world.is_leader):
                 return
             dest = os.path.join(cfg.ckpt_dir, f"mid-{world.epoch}-{step}")
-            ew.publish_mid_state(world.epoch, step,
-                                 lambda: cfg.save_state(cur_state, dest))
+            if cfg.collective_ckpt:
+                ew.publish_mid_state(world.epoch, step,
+                                     lambda: cfg.save_state(cur_state, dest))
+                return
+            import threading
+
+            t0 = time.monotonic()
+            _drain_mid()  # bounded backpressure: never two in flight
+            # snapshot mutable leaves ON THIS thread before handoff: a
+            # train body that reuses numpy buffers in place (legal when
+            # the publish was synchronous) must not race the background
+            # write into a torn generation.  jax Arrays are immutable —
+            # only numpy leaves need the copy.
+            cur_state = jax.tree.map(
+                lambda x: np.array(x) if isinstance(x, np.ndarray) else x,
+                cur_state)
+            # non-daemon: joined by _drain_mid before teardown, and an
+            # interpreter exit must never tear down a mid-write thread
+            t = threading.Thread(target=_publish_mid_bg,
+                                 args=(cur_state, step, dest),
+                                 name=f"mid-ckpt-{step}")
+            mid_inflight.append(t)
+            t.start()
+            from edl_tpu.observability.tracing import get_tracer
+
+            get_tracer().instant(
+                "mid_ckpt_async", category="checkpoint", step=step,
+                pause_ms=round((time.monotonic() - t0) * 1000, 2))
 
         def heartbeat(step: int) -> None:
             """Refresh the progress heartbeat the supervisor's stall
@@ -844,6 +950,12 @@ def _world_child(plan: WorldPlan, cfg: WorkerConfig, result_path: str,
         except (TypeError, ValueError):  # builtins/partials w/o signature
             pass
         state, stopped = cfg.train_world(world, state, should_stop, **extra)
+
+        # The world is over: land any in-flight async mid publish before
+        # the boundary generation, so the kv namespace quiesces in order
+        # and the cadence promise ("a crash loses at most one window")
+        # holds right up to teardown.
+        _drain_mid()
 
         # Persist this generation before any supervisor re-enters planning.
         # gen = epoch + 1 is unique per world and ≤ the next membership
@@ -1074,6 +1186,7 @@ def run_elastic_worker(
     stall_k: float = 6.0,
     formation_budget_s: float = 120.0,
     evict_after_misses: int = EVICT_AFTER_MISSES,
+    compile_cache_dir: Optional[str] = None,
 ) -> "WorkerOutcome":
     """The full elastic dance for one worker host: supervise one world
     child per membership epoch (see module docstring for the protocol).
@@ -1144,6 +1257,7 @@ def run_elastic_worker(
         heartbeat_timeout_s=heartbeat_timeout_s,
         collective_ckpt=collective_ckpt,
         heartbeat_path=hb_path,
+        compile_cache_dir=compile_cache_dir,
     )
     if reform_grace_s is None:
         # a crashed peer is pruned from membership after the TTL; wait a

@@ -18,7 +18,7 @@ import queue
 import threading
 import time
 from dataclasses import dataclass
-from typing import Optional
+from typing import Callable, Optional
 
 from edl_tpu.api.types import TrainingJob
 from edl_tpu.cluster.base import Cluster
@@ -83,6 +83,16 @@ class Autoscaler:
         self.plan_history: list[dict[str, int]] = []
         #: log of {uid: reason} suppressions, for tests/observability
         self.suppressed_history: list[dict[str, str]] = []
+        #: speculative-prewarm hint hook: called as ``hint_sink(uid,
+        #: target_parallelism)`` the moment a plan is decided — BEFORE
+        #: actuation, pods moving, or the training loop observing any of
+        #: it.  That head start is the whole point: a runtime that wires
+        #: this to ElasticTrainer.prewarm compiles the next mesh while
+        #: the pods are still being created, so the eventual resize pays
+        #: only the reshard hop.  Must be cheap and non-blocking (it runs
+        #: on the scaling loop); exceptions are swallowed and logged —
+        #: hints are an optimization, never a dependency.
+        self.hint_sink: Optional[Callable[[str, int], None]] = None
 
     # -- event intake (reference autoscaler.go:159-171) --------------------
 
@@ -165,6 +175,16 @@ class Autoscaler:
             self.plan_history.append(dict(target))
             for uid in target:
                 self._last_resize[uid] = now
+            if self.hint_sink is not None:
+                # hint BEFORE actuation: the plan is the earliest moment
+                # the next parallelism is known, and every tick of head
+                # start is compile time off the eventual resize
+                for uid, n in target.items():
+                    try:
+                        self.hint_sink(uid, n)
+                    except Exception as exc:
+                        log.warn("prewarm hint sink failed", job=uid,
+                                 error=str(exc))
         self._scale_all_jobs(target)
         return target
 

@@ -24,4 +24,64 @@ assert r["pending_jobs"] == 0, r
 assert r["chip_utilization_pct"] >= 88.4, r  # reference peak
 EOF
 
+echo "== perf smoke (async checkpoint cadence + prewarm + long-poll counters)"
+JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+python - <<'EOF'
+# Fast tripwire for PR 3's zero-stall machinery: an async-cadence run with
+# prewarmed resizes must leave the new counters populated and the stall
+# watchdog silent — a regression that reintroduces a step-loop stall or
+# breaks speculation shows up here, not in a 7-minute bench.
+import tempfile, threading, time
+import jax, numpy as np, optax
+
+from edl_tpu.coord import PyCoordService
+from edl_tpu.models import mlp
+from edl_tpu.observability.collector import get_counters
+from edl_tpu.parallel.mesh import MeshSpec
+from edl_tpu.runtime.checkpoint import ElasticCheckpointer
+from edl_tpu.runtime.elastic import ElasticTrainer
+from edl_tpu.runtime.watchdog import StallWatchdog
+
+params = mlp.init(jax.random.key(0), [16, 32, 4])
+tr = ElasticTrainer(mlp.loss_fn, params, optax.adam(1e-2),
+                    spec=MeshSpec(dp=-1), initial_world_size=2)
+rng = np.random.default_rng(0)
+batch = (rng.normal(size=(64, 16)).astype(np.float32),
+         rng.integers(0, 4, 64).astype(np.int32))
+ck = ElasticCheckpointer(tempfile.mkdtemp(prefix="edl-perf-smoke-"))
+wd = StallWatchdog(floor_s=30.0, k=8.0, scope="perf-smoke")
+wd.start(poll_s=0.5)
+try:
+    tr.step(batch)                      # teach the batch shape
+    tr.prewarm([4], wait=True)          # speculation lands off-path
+    assert tr.resize(4)
+    for step in range(2, 42):
+        wd.beat(step)
+        tr.step(batch)
+        if step % 10 == 0:
+            ck.save_async(step, {"params": tr.state.params})
+    ck.finalize()
+finally:
+    wd.stop()
+assert ck.latest_verified_step() is not None   # async saves finalized
+ck.close()
+
+# coord long-poll counters move when a parked wait fires
+svc = PyCoordService()
+svc.join("a")
+t = threading.Thread(target=svc.wait_epoch, args=(svc.epoch(), 5.0))
+t.start(); time.sleep(0.1); svc.join("b"); t.join(timeout=5)
+m = svc.server_metrics()
+assert m["longpolls_parked"] >= 1 and m["longpolls_fired"] >= 1, m
+
+c = get_counters()
+evt = tr.resize_events[-1]
+assert evt["prewarm_hit"] and evt["compile_ms"] < 100.0, evt
+assert c.get("prewarm_hits") >= 1, c.snapshot()
+assert c.get("checkpoint_async_saves") >= 4, c.snapshot()
+assert c.get("stalls_detected", scope="perf-smoke") == 0, c.snapshot()
+print("perf smoke OK:", {k: v for k, v in c.snapshot().items()
+                         if "prewarm" in k or "async" in k})
+EOF
+
 echo "CI OK"

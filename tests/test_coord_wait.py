@@ -1,0 +1,277 @@
+"""Event-driven coordination: the long-poll wait path under churn.
+
+PR 3 replaced the fixed-sleep polling loops on the reform critical path
+(discovery.wait_stable, the coordinator claim, wait_state) with
+WAITEPOCH/KVWAIT long-polls: a waiter parks on the coordination service
+and is woken by the join/leave/expiry/KV-set that matters.  These tests
+pin the contract on both the pure-Python service and the native TCP
+server: correctness under concurrent churn, timeout-vs-fire ordering,
+and — the operational point — no thundering-herd re-poll while parked.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from edl_tpu.coord import PyCoordService, spawn_server
+from edl_tpu.runtime.discovery import CoordDiscovery, wait_epoch_change
+
+
+@pytest.fixture()
+def server():
+    srv = spawn_server(member_ttl_ms=2000)
+    try:
+        yield srv
+    finally:
+        srv.stop()
+
+
+def _service_and_clients(kind, server, n=1):
+    """One mutating handle + n independent waiter handles."""
+    if kind == "python":
+        s = PyCoordService(member_ttl_ms=2000)
+        return s, [s] * n
+    return server.client(), [server.client() for _ in range(n)]
+
+
+@pytest.fixture(params=["python", "native-server"])
+def kind(request):
+    return request.param
+
+
+# ---------------------------------------------------------------- basic fire
+
+def test_wait_epoch_fires_on_join(kind, server):
+    svc, (waiter,) = _service_and_clients(kind, server)
+    svc.join("a")
+    known = svc.epoch()
+    got = {}
+
+    def park():
+        t0 = time.monotonic()
+        got["epoch"] = waiter.wait_epoch(known, timeout_s=10.0)
+        got["dt"] = time.monotonic() - t0
+
+    t = threading.Thread(target=park)
+    t.start()
+    time.sleep(0.2)
+    svc.join("b")
+    t.join(timeout=5)
+    assert not t.is_alive()
+    assert got["epoch"] != known
+    # event-driven: woke on the join, not at the 10 s timeout
+    assert got["dt"] < 2.0
+
+
+def test_wait_epoch_timeout_returns_same_epoch(kind, server):
+    svc, (waiter,) = _service_and_clients(kind, server)
+    svc.join("only")
+    known = svc.epoch()
+    t0 = time.monotonic()
+    assert waiter.wait_epoch(known, timeout_s=0.3) == known
+    dt = time.monotonic() - t0
+    assert 0.25 <= dt < 2.0  # honored the timeout, did not park forever
+
+
+def test_kv_wait_fires_on_set_and_on_epoch_move(kind, server):
+    svc, (w1, w2) = _service_and_clients(kind, server, n=2)
+    svc.join("a")
+    known = svc.epoch()
+    got = {}
+
+    def park_kv():
+        got["kv"] = w1.kv_wait("the-key", timeout_s=10.0)
+
+    def park_epoch():
+        got["ep"] = w2.kv_wait("never-set", timeout_s=10.0,
+                               known_epoch=known)
+
+    t1 = threading.Thread(target=park_kv)
+    t2 = threading.Thread(target=park_epoch)
+    t1.start(), t2.start()
+    time.sleep(0.2)
+    svc.kv_set("the-key", b"payload")
+    svc.join("b")  # moves the epoch for the second waiter
+    t1.join(timeout=5), t2.join(timeout=5)
+    assert got["kv"][0] == b"payload"
+    v, ep = got["ep"]
+    assert v is None and ep is not None and ep != known
+
+
+def test_kv_wait_preexisting_key_returns_immediately(kind, server):
+    svc, (waiter,) = _service_and_clients(kind, server)
+    svc.kv_set("already", b"here")
+    t0 = time.monotonic()
+    v, _ = waiter.kv_wait("already", timeout_s=10.0)
+    assert v == b"here"
+    assert time.monotonic() - t0 < 1.0
+
+
+def test_kv_wait_timeout_vs_fire_ordering(kind, server):
+    """A waiter whose timeout lapses BEFORE the fire reports the timeout;
+    one still parked AT the fire reports the value — the two outcomes
+    never blur even when the fire lands just after a timeout."""
+    svc, (w1, w2) = _service_and_clients(kind, server, n=2)
+    results = {}
+
+    def short():  # times out at 0.3 s; the set comes at 0.6 s
+        results["short"] = w1.kv_wait("ordered", timeout_s=0.3)
+
+    def long():
+        results["long"] = w2.kv_wait("ordered", timeout_s=10.0)
+
+    t1, t2 = threading.Thread(target=short), threading.Thread(target=long)
+    t1.start(), t2.start()
+    time.sleep(0.6)
+    svc.kv_set("ordered", b"late")
+    t1.join(timeout=5), t2.join(timeout=5)
+    assert results["short"][0] is None  # lapsed before the fire
+    assert results["long"][0] == b"late"  # parked through it
+
+
+# --------------------------------------------------------------- churn soak
+
+def test_waiters_survive_concurrent_churn(kind, server):
+    """Joins/leaves/kv churn from several threads while waiters are
+    parked: every wait returns (no wedge), every fired wait observed a
+    real change."""
+    svc, waiters = _service_and_clients(kind, server, n=4)
+    svc.join("base")
+    stop = threading.Event()
+    outcomes: list = []
+    lock = threading.Lock()
+
+    def churner(i):
+        for round_ in range(10):
+            svc.join(f"w{i}-{round_}")
+            time.sleep(0.01)
+            svc.leave(f"w{i}-{round_}")
+            svc.kv_set(f"churn/{i}/{round_}", b"x")
+
+    def parked_epoch(w):
+        while not stop.is_set():
+            known = w.epoch()
+            got = w.wait_epoch(known, timeout_s=0.5)
+            with lock:
+                outcomes.append(("epoch", known, got))
+
+    churners = [threading.Thread(target=churner, args=(i,))
+                for i in range(3)]
+    parkers = [threading.Thread(target=parked_epoch, args=(w,))
+               for w in waiters]
+    for t in churners + parkers:
+        t.start()
+    for t in churners:
+        t.join(timeout=30)
+    stop.set()
+    for t in parkers:
+        t.join(timeout=10)
+        assert not t.is_alive(), "parked waiter wedged through churn"
+    fired = [o for o in outcomes if o[1] != o[2]]
+    assert fired, "no waiter ever observed the churn"
+
+
+def test_wait_epoch_fires_on_ttl_expiry(kind, server):
+    """TTL expiry is the one mutation no command announces — parked
+    waiters must still notice a dead member within the re-check cadence."""
+    svc, (waiter,) = _service_and_clients(kind, server)
+    if kind == "python":
+        # the python service's injectable clock defaults to monotonic ms —
+        # real time passes, so a 2 s TTL genuinely lapses
+        svc.join("dies")
+        known = svc.epoch()
+    else:
+        svc.join("dies")
+        known = svc.epoch()
+    t0 = time.monotonic()
+    got = waiter.wait_epoch(known, timeout_s=10.0)
+    dt = time.monotonic() - t0
+    assert got != known, "TTL expiry never fired the waiter"
+    assert dt < 5.0  # TTL (2 s) + recheck cadence, with margin
+
+
+# ------------------------------------------------------- no thundering herd
+
+def test_parked_waiters_do_not_thundering_herd(server):
+    """The operational claim: K parked waiters cost ~K re-parks per
+    LONGPOLL_CHUNK_S, not the 20 Hz × K request storm the old sleep-poll
+    loops generated.  Measured against the native server's own request
+    counter so client-side batching can't fake it."""
+    mut = server.client()
+    mut.join("a")
+    known = mut.epoch()
+    waiters = [server.client() for _ in range(4)]
+    before = mut.server_metrics()
+    threads = [threading.Thread(target=w.wait_epoch, args=(known, 1.8))
+               for w in waiters]
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=10)
+    parked_s = time.monotonic() - t0
+    after = mut.server_metrics()
+    requests = (after["requests_served"] - before["requests_served"]
+                - 1)  # the metrics read itself
+    # 4 waiters × ~1.8 s parked at ≤1 req/s each (+1 initial park each):
+    # anything close to sleep-polling (4 × 20 Hz × 1.8 s = 144) fails
+    assert requests <= 20, (requests, parked_s)
+    assert after["longpolls_parked"] > before["longpolls_parked"]
+
+
+def test_server_metrics_counts_fired(server):
+    c = server.client()
+    c.join("a")
+    known = c.epoch()
+    w = server.client()
+    t = threading.Thread(target=w.wait_epoch, args=(known, 10.0))
+    t.start()
+    time.sleep(0.2)
+    c.join("b")
+    t.join(timeout=5)
+    m = c.server_metrics()
+    assert m["longpolls_fired"] >= 1
+    assert m["requests_served"] > 0
+
+
+# ----------------------------------------------------- discovery integration
+
+def test_wait_members_event_driven(kind, server):
+    svc, (waiter,) = _service_and_clients(kind, server)
+    d = CoordDiscovery(waiter, "me", "addr0")
+    d.join()
+    got = {}
+
+    def park():
+        got["peers"] = d.wait_members(3, timeout_s=10.0)
+
+    t = threading.Thread(target=park)
+    t.start()
+    time.sleep(0.1)
+    svc.join("p1", "addr1")
+    svc.join("p2", "addr2")
+    t.join(timeout=5)
+    assert [n for n, _ in got["peers"]] == ["me", "p1", "p2"]
+
+
+def test_wait_epoch_change_falls_back_without_longpoll():
+    """Duck-typed backends without wait_epoch still work (sleep-poll)."""
+
+    class Minimal:
+        def __init__(self):
+            self._e = 0
+
+        def epoch(self):
+            return self._e
+
+    m = Minimal()
+
+    def bump():
+        time.sleep(0.2)
+        m._e = 1
+
+    threading.Thread(target=bump).start()
+    assert wait_epoch_change(m, 0, timeout_s=5.0, poll_s=0.02) == 1

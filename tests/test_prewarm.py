@@ -1,0 +1,165 @@
+"""Speculative mesh prewarm: compile off the hot path, race-proof.
+
+PR 3's perf tentpole: ElasticTrainer.prewarm compiles neighbor mesh
+bundles on a background thread so resize() pays only the reshard hop.
+These tests pin the contracts the speculation must keep: the classic
+prewarm/resize race (a resize of a size that is mid-compile waits for
+that compile instead of duplicating it), hints for sizes that never
+arrive stay bounded, and the transactional-rollback guarantee survives
+a staged bundle that came from the prewarm thread.
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import numpy as np
+import optax
+import pytest
+
+import edl_tpu.runtime.elastic as elastic_mod
+from edl_tpu.models import mlp
+from edl_tpu.observability.collector import get_counters
+from edl_tpu.parallel.mesh import MeshSpec
+from edl_tpu.runtime.elastic import ElasticTrainer
+
+BATCH = 64
+
+
+def make_trainer(**kw):
+    params = mlp.init(jax.random.key(0), [16, 32, 4])
+    return ElasticTrainer(mlp.loss_fn, params, optax.adam(1e-2),
+                          spec=MeshSpec(dp=-1), initial_world_size=2, **kw)
+
+
+def batch():
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(BATCH, 16)).astype(np.float32)
+    y = rng.integers(0, 4, BATCH).astype(np.int32)
+    return x, y
+
+
+def test_prewarm_hit_skips_compile():
+    tr = make_trainer()
+    b = batch()
+    for _ in range(2):
+        tr.step(b)  # teaches the trainer the batch shape for AOT
+    t = tr.prewarm([4], wait=True)
+    assert t is not None
+    assert tr.resize(4)
+    evt = tr.resize_events[-1]
+    assert evt["prewarm_hit"] is True
+    # the compile happened on the prewarm thread: the resize's bundle
+    # acquisition is a cache hit, orders of magnitude under a jit compile
+    assert evt["compile_ms"] < 50.0, evt
+    # and the first step on the new mesh runs the AOT executable
+    t0 = time.perf_counter()
+    loss = tr.step(b)
+    first_step_ms = (time.perf_counter() - t0) * 1000
+    assert np.isfinite(loss)
+    assert first_step_ms < 200.0, first_step_ms
+
+
+def test_resize_mid_prewarm_waits_not_duplicates():
+    """A resize landing while its size is still compiling on the prewarm
+    thread must finish that compile (pay the residual), not race a second
+    compile or commit a half-built bundle."""
+    tr = make_trainer()
+    b = batch()
+    tr.step(b)
+    before = get_counters().get("mesh_prewarms")
+    tr.prewarm([4])  # no wait: compile in flight
+    assert tr.resize(4)  # lands mid-compile
+    assert tr.world_size == 4
+    assert np.isfinite(tr.step(b))
+    evt = tr.resize_events[-1]
+    # speculation was in flight → counted as a hit, whatever the residual
+    assert evt["prewarm_hit"] is True
+    # exactly one bundle exists for the size (no duplicate compile)
+    key = tr._cache_key(4)
+    assert key in tr._step_cache and not tr._building
+    assert get_counters().get("mesh_prewarms") <= before + 1
+
+
+def test_unused_hints_are_bounded():
+    """Hints for sizes that never arrive must not grow the executable
+    cache without bound: beyond prewarm_cache_limit the oldest unused
+    speculative bundle is evicted."""
+    tr = make_trainer(prewarm_cache_limit=2)
+    tr.step(batch())
+    for n in (3, 4, 5, 6, 7):  # five hints, none ever resized to
+        tr.prewarm([n], wait=True)
+    speculative = [k for k, v in tr._step_cache.items()
+                   if v.source == "prewarm"]
+    assert len(speculative) <= 2, speculative
+    assert len(tr._prewarm_unused) <= 2
+    assert get_counters().get("prewarms_evicted") >= 3
+
+
+def test_used_prewarm_bundle_exempt_from_eviction():
+    tr = make_trainer(prewarm_cache_limit=1)
+    tr.step(batch())
+    tr.prewarm([4], wait=True)
+    assert tr.resize(4)  # graduates the speculative bundle to live
+    live_bundle = tr._step_cache[tr._cache_key(4)]
+    tr.prewarm([5], wait=True)
+    tr.prewarm([6], wait=True)  # eviction pressure
+    assert tr._step_cache[tr._cache_key(4)] is live_bundle
+
+
+def test_rollback_clean_with_prewarmed_bundle(monkeypatch):
+    """The transactional-resize guarantee must hold when the staged
+    bundle came from the prewarm thread: a reshard failure rolls back to
+    the previous mesh and the trainer keeps stepping."""
+    tr = make_trainer()
+    b = batch()
+    tr.step(b)
+    tr.prewarm([4], wait=True)
+    real_reshard = elastic_mod._reshard
+
+    def boom(tree, shardings):
+        raise RuntimeError("injected reshard OOM")
+
+    monkeypatch.setattr(elastic_mod, "_reshard", boom)
+    assert tr.resize(4) is False
+    assert tr.world_size == 2
+    assert tr.resizes_failed == 1
+    monkeypatch.setattr(elastic_mod, "_reshard", real_reshard)
+    assert np.isfinite(tr.step(b))  # previous world fully intact
+    # the prewarmed bundle survived the rollback: the retry is a pure hit
+    assert tr.resize(4)
+    assert tr.resize_events[-1]["prewarm_hit"] is True
+    assert np.isfinite(tr.step(b))
+
+
+def test_prewarm_skips_invalid_and_current_sizes():
+    tr = make_trainer()
+    assert tr.prewarm([0, -1, 10_000, tr.world_size, None]) is None
+
+
+def test_resize_events_record_split():
+    tr = make_trainer()
+    b = batch()
+    tr.step(b)
+    assert tr.resize(4)  # cold: inline compile
+    evt = tr.resize_events[-1]
+    assert set(evt) >= {"size", "compile_ms", "reshard_ms", "prewarm_hit",
+                        "step"}
+    assert evt["prewarm_hit"] is False
+    assert evt["compile_ms"] > evt["reshard_ms"], evt
+
+
+@pytest.mark.parametrize("sizes", [(4, 8), (8, 4)])
+def test_oscillation_still_correct_with_prewarm(sizes):
+    """Grow/shrink through prewarmed sizes keeps learning (the PR 2
+    stale-mesh regression surface, now with speculation in the mix)."""
+    tr = make_trainer()
+    b = batch()
+    losses = [tr.step(b) for _ in range(3)]
+    for n in sizes + (2,):
+        tr.prewarm([n], wait=True)
+        assert tr.resize(n)
+        losses += [tr.step(b) for _ in range(3)]
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0] * 2.0  # no blow-up across the dance

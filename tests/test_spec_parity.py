@@ -1,0 +1,159 @@
+"""Spec parity: volumes / volumeMounts / imagePullSecrets (VERDICT r5).
+
+Real k8s training workloads mount datasets from PVCs, need /dev/shm
+tmpfs, and pull from private registries — a trainer spec without pod
+volume passthroughs can't express any of it.  These tests pin the full
+thread: manifest → serde (both spellings) → TrainerSpec → jobparser pod
+manifests, round-tripping without loss, plus the FT path's compile-cache
+volume wiring that rides the same mechanism.
+"""
+
+from __future__ import annotations
+
+from edl_tpu.api import serde
+from edl_tpu.api.types import (
+    RESOURCE_CPU,
+    ResourceRequirements,
+    TrainerSpec,
+    TrainingJob,
+    TrainingJobSpec,
+)
+from edl_tpu.controller.jobparser import (
+    COMPILE_CACHE_PATH,
+    COMPILE_CACHE_VOLUME,
+    parse_to_trainer,
+    pod_env,
+)
+
+VOLUMES = [
+    {"name": "dataset", "persistentVolumeClaim": {"claimName": "imagenet"}},
+    {"name": "shm", "emptyDir": {"medium": "Memory"}},
+]
+MOUNTS = [
+    {"name": "dataset", "mountPath": "/data", "readOnly": True},
+    {"name": "shm", "mountPath": "/dev/shm"},
+]
+PULL_SECRETS = [{"name": "registry-cred"}]
+
+
+def make_job(fault_tolerant=True) -> TrainingJob:
+    return TrainingJob(
+        name="j", spec=TrainingJobSpec(
+            fault_tolerant=fault_tolerant,
+            trainer=TrainerSpec(
+                min_instance=2, max_instance=4,
+                resources=ResourceRequirements(
+                    requests={RESOURCE_CPU: "1"}),
+                volumes=[dict(v) for v in VOLUMES],
+                volume_mounts=[dict(m) for m in MOUNTS],
+                image_pull_secrets=[dict(s) for s in PULL_SECRETS],
+            )))
+
+
+# ------------------------------------------------------------------- serde
+
+def test_round_trip_preserves_pod_template_fields():
+    job = make_job()
+    doc = serde.job_to_dict(job)
+    t = doc["spec"]["trainer"]
+    assert t["volumes"] == VOLUMES
+    assert t["volume_mounts"] == MOUNTS
+    assert t["image_pull_secrets"] == PULL_SECRETS
+    back = serde.job_from_dict(doc)
+    assert back.spec.trainer.volumes == VOLUMES
+    assert back.spec.trainer.volume_mounts == MOUNTS
+    assert back.spec.trainer.image_pull_secrets == PULL_SECRETS
+    # yaml round-trip too (the CLI path)
+    assert serde.job_from_yaml(serde.job_to_yaml(job)) == back
+
+
+def test_camel_case_spellings_accepted():
+    """Anyone porting a Deployment writes volumeMounts/imagePullSecrets;
+    both spellings parse to the same spec (snake wins when both appear —
+    the established alias rule)."""
+    doc = {
+        "kind": "TrainingJob", "metadata": {"name": "j"},
+        "spec": {"trainer": {
+            "min_instance": 1, "max_instance": 1,
+            "volumes": VOLUMES,
+            "volumeMounts": MOUNTS,
+            "imagePullSecrets": PULL_SECRETS,
+        }},
+    }
+    t = serde.job_from_dict(doc).spec.trainer
+    assert t.volume_mounts == MOUNTS
+    assert t.image_pull_secrets == PULL_SECRETS
+
+
+def test_snake_wins_over_camel_when_both_present():
+    doc = {
+        "kind": "TrainingJob", "metadata": {"name": "j"},
+        "spec": {"trainer": {
+            "volume_mounts": MOUNTS[:1],
+            "volumeMounts": MOUNTS,
+        }},
+    }
+    assert serde.job_from_dict(doc).spec.trainer.volume_mounts == MOUNTS[:1]
+
+
+# --------------------------------------------------------------- jobparser
+
+def trainer_pod(job):
+    return parse_to_trainer(job)["spec"]["template"]["spec"]
+
+
+def test_manifest_carries_volumes_mounts_and_secrets():
+    pod = trainer_pod(make_job())
+    names = [v["name"] for v in pod["volumes"]]
+    assert names[:2] == ["dataset", "shm"]  # user volumes verbatim, first
+    mounts = pod["containers"][0]["volumeMounts"]
+    assert mounts[0] == MOUNTS[0] and mounts[1] == MOUNTS[1]
+    assert pod["imagePullSecrets"] == PULL_SECRETS
+
+
+def test_ft_trainer_gets_compile_cache_volume_and_env():
+    """Tentpole wiring: respawned world children amortize the post-reform
+    recompile through a per-pod compile-cache volume + EDL_COMPILE_CACHE."""
+    job = make_job(fault_tolerant=True)
+    pod = trainer_pod(job)
+    assert any(v["name"] == COMPILE_CACHE_VOLUME and "emptyDir" in v
+               for v in pod["volumes"])
+    assert any(m["mountPath"] == COMPILE_CACHE_PATH
+               for m in pod["containers"][0]["volumeMounts"])
+    assert pod_env(job, "trainer")["EDL_COMPILE_CACHE"] == COMPILE_CACHE_PATH
+
+
+def test_non_ft_trainer_gets_no_compile_cache():
+    job = make_job(fault_tolerant=False)
+    job.spec.trainer.volumes = []
+    job.spec.trainer.volume_mounts = []
+    job.spec.trainer.image_pull_secrets = []
+    pod = trainer_pod(job)
+    assert "volumes" not in pod
+    assert "volumeMounts" not in pod["containers"][0]
+    assert "imagePullSecrets" not in pod
+    assert "EDL_COMPILE_CACHE" not in pod_env(job, "trainer")
+
+
+def test_user_compile_cache_volume_wins():
+    """A user volume named like the cache (e.g. a shared PVC mounted at
+    the cache path) overrides the default emptyDir instead of colliding."""
+    job = make_job(fault_tolerant=True)
+    job.spec.trainer.volumes = [
+        {"name": COMPILE_CACHE_VOLUME,
+         "persistentVolumeClaim": {"claimName": "shared-cache"}}]
+    job.spec.trainer.volume_mounts = [
+        {"name": COMPILE_CACHE_VOLUME, "mountPath": COMPILE_CACHE_PATH}]
+    pod = trainer_pod(job)
+    cache_vols = [v for v in pod["volumes"]
+                  if v["name"] == COMPILE_CACHE_VOLUME]
+    assert cache_vols == [job.spec.trainer.volumes[0]]
+    cache_mounts = [m for m in pod["containers"][0]["volumeMounts"]
+                    if m["mountPath"] == COMPILE_CACHE_PATH]
+    assert len(cache_mounts) == 1
+
+
+def test_user_env_still_overrides_compile_cache_default():
+    job = make_job(fault_tolerant=True)
+    job.spec.trainer.env = {"EDL_COMPILE_CACHE": "/my/cache"}
+    assert pod_env(job, "trainer")["EDL_COMPILE_CACHE"] == "/my/cache"
