@@ -1078,6 +1078,22 @@ def _count_entering(text: str) -> int:
     return text.count("entering world epoch=")
 
 
+def _parse_world_phases(text: str) -> list[dict]:
+    """Parse the child-emitted ``world_phases epoch=N a_s=1.2 b_s=0.3``
+    lines (one per world start, log order) into dicts of seconds per
+    named phase — the startup attribution the world-cycle leg reports."""
+    import re
+
+    records = []
+    for m in re.finditer(r"world_phases epoch=(\d+)((?: \w+_s=[0-9.]+)+)",
+                         text):
+        rec: dict = {"epoch": int(m.group(1))}
+        for pm in re.finditer(r"(\w+)_s=([0-9.]+)", m.group(2)):
+            rec[pm.group(1)] = float(pm.group(2))
+        records.append(rec)
+    return records
+
+
 def reform_latency_leg() -> dict:
     """The REAL fault-tolerance path's latency (VERDICT r2 weak #3): the
     supervised world dance — child teardown → membership settle →
@@ -1181,6 +1197,17 @@ def reform_latency_leg() -> dict:
 
         out["reference_redispatch_bound_s"] = 16.0
         out["marker"] = "entering-world line = restore complete, pre-step"
+        # startup-phase attribution for the survivor's reforms (same
+        # world_phases instrumentation the TPU cycle leg reads)
+        recs = _parse_world_phases(open(logs["w0"]).read())
+        if recs:
+            import statistics
+
+            allp = sorted({k for r in recs for k in r if k != "epoch"})
+            out["phase_medians_s"] = {
+                p: round(statistics.median(
+                    [r[p] for r in recs if p in r]), 2)
+                for p in allp}
         return out
     finally:
         for p in procs.values():
@@ -1301,6 +1328,33 @@ def tpu_world_cycle_leg() -> dict:
         out["reacquire_and_reform_s"] = med(totals_s)  # r4-compatible key
         out["total_spread_s"] = (round(max(totals_s) - min(totals_s), 2)
                                  if totals_s else None)
+        # Per-phase attribution from the child's own world_phases lines
+        # (runtime/multihost.py startup instrumentation): medians per
+        # named phase, and the slowest cycle's dominant phase NAMED in
+        # the artifact — so a reacquire outlier is a record, not a
+        # hypothesis (VERDICT r5 weak #3 / next-round #5).
+        phase_records = _parse_world_phases(open(log).read())
+        out["phase_records"] = phase_records
+        if phase_records:
+            all_phases = sorted({k for r in phase_records for k in r
+                                 if k != "epoch"})
+            out["phase_medians_s"] = {
+                p: med([r[p] for r in phase_records if p in r])
+                for p in all_phases}
+        if totals_s:
+            # cycle i's world-entry is phase record worlds_before + i
+            # (the same anchor the wait conditions used)
+            slowest = max(range(len(totals_s)), key=totals_s.__getitem__)
+            idx = worlds_before + slowest
+            if idx < len(phase_records):
+                rec = {k: v for k, v in phase_records[idx].items()
+                       if k != "epoch"}
+                if rec:
+                    phase = max(rec, key=rec.get)
+                    out["outlier_cycle"] = slowest
+                    out["outlier_total_s"] = totals_s[slowest]
+                    out["outlier_phase"] = phase
+                    out["outlier_phase_s"] = rec[phase]
 
         # the final world must actually TRAIN on the chip to completion
         rc = proc.wait(timeout=480)
@@ -1407,27 +1461,36 @@ def main() -> None:
     # an external SIGKILL would orphan the coord server and workers.
     reform = _run_leg("reform", timeout_s=560)
 
-    # Reference baseline: peak utilization in the published elastic trace is
-    # 88.40 % with 0 pending (BASELINE.md; doc/boss_tutorial.md:293-294).
+    # Headline discipline (VERDICT r5 weak #4): LEAD with metrics that
+    # can still move — contended admission latency, the MFU suite,
+    # reform/resize latencies.  The saturated packing ratio (100 % vs the
+    # reference's 88.40 % live peak, identical since r1) is demoted to a
+    # floor assertion: vs_baseline_floor_ok must stay true, but it is no
+    # longer the number a skimmer reads first.
     value = sched["chip_utilization_pct"]
+    vs_baseline = round(value / 88.40, 4)
     result = {
-        "metric": "cluster_chip_utilization_pct_8_elastic_jobs",
-        "value": value,
-        "unit": "%",
-        "vs_baseline": round(value / 88.40, 4),
-        # the honest label, everywhere the ratio travels (r3 weak #4):
-        # numerator = our planner packing a SIMULATED 256-chip cluster;
-        # denominator = the reference's published LIVE demo trace peak
-        # (88.40 %, doc/boss_tutorial.md:293-294) — the only number it
-        # ever published
-        "vs_baseline_note": "simulated packing vs reference live demo",
-        "pending_jobs": sched["pending_jobs"],
+        "metric": "mean_admission_seconds_contended",
+        "value": sched["mean_admission_seconds"],
+        "unit": "s",
         "mean_admission_seconds": sched["mean_admission_seconds"],
         "tokens_per_second": tput.get("tokens_per_second"),
         "mfu_pct": tput.get("mfu_pct"),
         "crash_reform_s": reform.get("crash_reform_s"),
         "tpu_world_cycle": tpu_cycle.get("tpu_world_cycle",
                                          tpu_cycle.get("error")),
+        # -- saturated floor (was the headline r1-r5) --------------------
+        "chip_utilization_pct": value,
+        "pending_jobs": sched["pending_jobs"],
+        "vs_baseline": vs_baseline,
+        "vs_baseline_floor": ">= 1.0",
+        "vs_baseline_floor_ok": vs_baseline >= 1.0,
+        # the honest label, everywhere the ratio travels (r3 weak #4):
+        # numerator = our planner packing a SIMULATED 256-chip cluster;
+        # denominator = the reference's published LIVE demo trace peak
+        # (88.40 %, doc/boss_tutorial.md:293-294) — the only number it
+        # ever published
+        "vs_baseline_note": "simulated packing vs reference live demo",
         "detail": {"scheduler": sched, "throughput": tput,
                    "large": large, "long_context": long_ctx,
                    "model_zoo": zoo, "elastic": elastic, "reform": reform,
@@ -1440,10 +1503,12 @@ def main() -> None:
     # they are restated here, small, after the full artifact (verdict r4
     # weak #5).  Keys match what BASELINE.md cites.
     headline = {
+        # moving metrics FIRST (r5 weak #4): the first keys a reader (or
+        # a truncated tail) sees are the ones that can still change
         "metric": result["metric"],
         "value": result["value"],
         "unit": result["unit"],
-        "vs_baseline": result["vs_baseline"],
+        "mean_admission_seconds": sched["mean_admission_seconds"],
         "flagship_tok_s": tput.get("tokens_per_second"),
         "flagship_mfu_pct": tput.get("mfu_pct"),
         "large_tok_s": large.get("tokens_per_second"),
@@ -1484,6 +1549,12 @@ def main() -> None:
                                          tpu_cycle.get("error")),
         "tpu_cycle_reacquire_s": tpu_cycle.get("reacquire_median_s"),
         "tpu_cycle_reform_s": tpu_cycle.get("reform_median_s"),
+        "tpu_cycle_phase_medians_s": tpu_cycle.get("phase_medians_s"),
+        "tpu_cycle_outlier_phase": tpu_cycle.get("outlier_phase"),
+        # the saturated ex-headline, now a floor assertion at the tail
+        "chip_utilization_pct": result["chip_utilization_pct"],
+        "vs_baseline": result["vs_baseline"],
+        "vs_baseline_floor_ok": result["vs_baseline_floor_ok"],
     }
     print(json.dumps(headline))
 

@@ -97,8 +97,22 @@ def cmd_collector(args) -> int:
     from edl_tpu.observability.collector import Collector
 
     cluster = _build_cluster(args)
-    Collector(cluster, interval_s=args.interval).run(
-        max_samples=args.samples if args.samples > 0 else None)
+    health = None
+    if args.health_port >= 0:
+        from edl_tpu.observability.health import serve_health
+
+        # the TSV columns double as gauges on /metrics (Collector mirrors
+        # every sample into the shared registry); /healthz goes 503 only
+        # if the process is gone — sampling runs on this thread
+        health = serve_health(args.health_port, {"collector": lambda: True})
+        log.info("collector /metrics serving",
+                 port=health.server_address[1])
+    try:
+        Collector(cluster, interval_s=args.interval).run(
+            max_samples=args.samples if args.samples > 0 else None)
+    finally:
+        if health is not None:
+            health.shutdown()
     return 0
 
 
@@ -302,6 +316,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="sampling cadence (reference example/collector.py:226)")
     c.add_argument("--samples", type=int, default=0,
                    help="stop after N samples (0 = forever)")
+    c.add_argument("--health-port", type=int, default=-1,
+                   help="serve GET /healthz + /metrics (Prometheus text "
+                        "of the TSV columns); -1 disables, 0 = "
+                        "OS-assigned")
     c.set_defaults(fn=cmd_collector)
 
     c = sub.add_parser("coordinator", help="run the coordination server")

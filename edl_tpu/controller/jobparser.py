@@ -211,7 +211,17 @@ def parse_to_coordinator(job: TrainingJob) -> dict[str, Any]:
         "spec": {
             "replicas": 1,
             "template": {
-                "metadata": {"labels": {COORDINATOR_LABEL: job.name}},
+                "metadata": {
+                    "labels": {COORDINATOR_LABEL: job.name},
+                    # the health port also serves GET /metrics in
+                    # Prometheus text (server.cc): one scrape config
+                    # covers coordinators and the controller alike
+                    "annotations": {
+                        "prometheus.io/scrape": "true",
+                        "prometheus.io/path": "/metrics",
+                        "prometheus.io/port": str(HEALTH_PORT),
+                    },
+                },
                 "spec": {
                     "containers": [
                         {

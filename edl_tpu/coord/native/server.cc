@@ -387,7 +387,10 @@ std::string HandleImpl(const std::string& line) {
 
 // HTTP health endpoint (role of the reference master's :8080, the port its
 // liveness was judged by, docker/paddle_k8s:27-31): GET /healthz returns
-// 200 with queue/membership/kv stats as JSON; any other path is 404.
+// 200 with queue/membership/kv stats as JSON; GET /metrics returns the
+// same truth in Prometheus text exposition format (version 0.0.4) under
+// the edl_coord_* namespace, so one scrape config covers this native
+// backend and every Python-served /metrics route; any other path is 404.
 // HTTP/1.0 + Connection: close per request — exactly what kubelet probes
 // and `curl` speak, nothing more.  Serving it from the coord process (not
 // a sidecar) is the point: a wedge that stops command processing also
@@ -409,6 +412,51 @@ std::string HealthBody() {
      << ",\"longpolls_fired\":" << g_longpolls_fired.load()
      << ",\"persisted_version\":" << g_persisted_version.load() << "}";
   return js.str();
+}
+
+// Prometheus text exposition of the same counters/gauges /healthz reports
+// as JSON — the exposition-format twin of observability/metrics.py's
+// MetricsRegistry.render() (same edl_ prefix, counters suffixed _total),
+// so the Python and native coordinator backends are scrape-compatible.
+std::string MetricsBody() {
+  int64_t todo, leased, done, dropped;
+  g_service->queue.Stats(&todo, &leased, &done, &dropped);
+  size_t members = g_service->membership.Members(NowMs()).size();
+  std::ostringstream out;
+  auto counter = [&out](const char* name, const char* help, int64_t v) {
+    out << "# HELP " << name << " " << help << "\n"
+        << "# TYPE " << name << " counter\n"
+        << name << " " << v << "\n";
+  };
+  auto gauge = [&out](const char* name, const char* help,
+                      const char* labels, int64_t v) {
+    out << "# HELP " << name << " " << help << "\n"
+        << "# TYPE " << name << " gauge\n"
+        << name << labels << " " << v << "\n";
+  };
+  counter("edl_coord_requests_total", "protocol requests served",
+          g_requests.load());
+  counter("edl_coord_longpolls_parked_total",
+          "long-poll waits that actually parked", g_longpolls_parked.load());
+  counter("edl_coord_longpolls_fired_total",
+          "parked waits woken by an event (rest timed out)",
+          g_longpolls_fired.load());
+  // one labeled family for the queue, matching the Python service's shape
+  out << "# HELP edl_coord_queue_tasks task queue depth by state\n"
+      << "# TYPE edl_coord_queue_tasks gauge\n"
+      << "edl_coord_queue_tasks{state=\"todo\"} " << todo << "\n"
+      << "edl_coord_queue_tasks{state=\"leased\"} " << leased << "\n"
+      << "edl_coord_queue_tasks{state=\"done\"} " << done << "\n"
+      << "edl_coord_queue_tasks{state=\"dropped\"} " << dropped << "\n";
+  gauge("edl_coord_pass", "current task-queue pass", "",
+        g_service->queue.CurrentPass());
+  gauge("edl_coord_membership_epoch", "membership epoch", "",
+        g_service->membership.Epoch());
+  gauge("edl_coord_members", "live members", "",
+        static_cast<int64_t>(members));
+  gauge("edl_coord_persisted_version", "last durably persisted version", "",
+        g_persisted_version.load());
+  return out.str();
 }
 
 // probes in flight; new connections beyond the cap are shed (closed) so a
@@ -436,18 +484,23 @@ void ServeHealth(int fd) {
   std::string method, path;
   ss >> method >> path;
   std::string status = "200 OK", body;
+  std::string content_type = "application/json";
   if (method == "GET" && (path == "/healthz" || path == "/")) {
     body = HealthBody();
     // the sweep inside HealthBody may have bumped the epoch; make it
     // durable on the same boundary every command uses
     MaybePersist();
+  } else if (method == "GET" && path == "/metrics") {
+    body = MetricsBody();
+    content_type = "text/plain; version=0.0.4; charset=utf-8";
+    MaybePersist();  // same sweep-durability boundary as /healthz
   } else {
     status = "404 Not Found";
     body = "{\"error\":\"not found\"}";
   }
   std::ostringstream resp;
-  resp << "HTTP/1.0 " << status
-       << "\r\nContent-Type: application/json\r\nContent-Length: "
+  resp << "HTTP/1.0 " << status << "\r\nContent-Type: " << content_type
+       << "\r\nContent-Length: "
        << body.size() << "\r\nConnection: close\r\n\r\n"
        << body;
   const std::string out = resp.str();

@@ -324,6 +324,43 @@ class PyCoordService:
                     "longpolls_parked": self.longpolls_parked,
                     "longpolls_fired": self.longpolls_fired}
 
+    def register_metrics(self, registry=None) -> None:
+        """Expose this service's live state on a
+        :class:`~edl_tpu.observability.metrics.MetricsRegistry` (default:
+        the process-wide one) as callback gauges, name-matched to the
+        native server's ``/metrics`` exposition (edl_coord_*) — so a
+        process hosting a PyCoordService serves the SAME series names a
+        native coordinator pod would, and one scrape config (and one
+        dashboard) covers both backends.  The monotonic tallies use
+        ``counter_fn`` (rendered ``_total`` counters, exactly like the
+        native server) since the service owns the authoritative
+        values."""
+        if registry is None:
+            from edl_tpu.observability.metrics import get_registry
+
+            registry = get_registry()
+        registry.counter_fn("coord_requests",
+                            lambda: self.requests_served,
+                            help="protocol requests served")
+        registry.counter_fn("coord_longpolls_parked",
+                            lambda: self.longpolls_parked,
+                            help="long-poll waits that actually parked")
+        registry.counter_fn("coord_longpolls_fired",
+                            lambda: self.longpolls_fired,
+                            help="parked waits woken by an event")
+        registry.gauge_fn("coord_membership_epoch", self.epoch,
+                          help="membership epoch")
+        registry.gauge_fn("coord_members",
+                          lambda: len(self.members()[1]),
+                          help="live members")
+        registry.gauge_fn("coord_pass", self.current_pass,
+                          help="current task-queue pass")
+        for state in ("todo", "leased", "done", "dropped"):
+            registry.gauge_fn(
+                "coord_queue_tasks",
+                lambda s=state: getattr(self.stats(), s),
+                help="task queue depth by state", state=state)
+
     def members(self) -> tuple[int, list[tuple[str, str]]]:
         """(epoch, [(name, address)]) name-sorted — this order IS the rank
         assignment (replacing IP-sort ranks, reference k8s_tools.py:113-121)."""

@@ -1,10 +1,22 @@
-"""Observability: structured logging, metrics collector, step tracing."""
+"""Observability: structured logging, the unified metrics plane
+(shared registry + Prometheus /metrics), correlated span tracing, and
+the flight recorder."""
 
 from edl_tpu.observability.collector import (
     Collector, Counters, JobInfo, Sample, get_counters,
 )
 from edl_tpu.observability.logging import get_logger
-from edl_tpu.observability.tracing import Tracer, get_tracer, profile_step
+from edl_tpu.observability.metrics import (
+    Counter, Gauge, Histogram, MetricsRegistry, dump_flight_record,
+    get_registry,
+)
+from edl_tpu.observability.tracing import (
+    Tracer, current_trace_id, get_tracer, new_trace_id, profile_step,
+    set_trace_id,
+)
 
-__all__ = ["Collector", "Counters", "JobInfo", "Sample", "Tracer",
-           "get_counters", "get_logger", "get_tracer", "profile_step"]
+__all__ = ["Collector", "Counter", "Counters", "Gauge", "Histogram",
+           "JobInfo", "MetricsRegistry", "Sample", "Tracer",
+           "current_trace_id", "dump_flight_record", "get_counters",
+           "get_logger", "get_registry", "get_tracer", "new_trace_id",
+           "profile_step", "set_trace_id"]

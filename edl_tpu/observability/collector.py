@@ -25,7 +25,6 @@ same choice the reference makes by summing only Running pods.
 from __future__ import annotations
 
 import sys
-import threading
 import time
 from dataclasses import dataclass, field
 from typing import Callable, TextIO
@@ -97,50 +96,63 @@ class Counters:
     kwargs and folded into the key in sorted order, so
     ``inc("faults_injected", type="network_flake")`` and
     ``get("faults_injected", type="network_flake")`` always agree.
+
+    Since the unified telemetry plane, this is a *facade* over a
+    :class:`~edl_tpu.observability.metrics.MetricsRegistry`: the
+    process-wide instance returned by :func:`get_counters` is backed by
+    ``metrics.get_registry()``, so every ``inc()`` anywhere in the
+    runtime is also a Prometheus series on every ``/metrics`` route
+    (rendered ``edl_<name>_total{labels}``) with zero extra wiring.  The
+    inc/get/total/snapshot surface is unchanged.
     """
 
-    def __init__(self) -> None:
-        self._lock = threading.Lock()
-        self._counts: dict[tuple[str, tuple[tuple[str, str], ...]], int] = {}
+    def __init__(self, registry=None) -> None:
+        from edl_tpu.observability.metrics import MetricsRegistry
 
-    @staticmethod
-    def _key(name: str, labels: dict[str, str]
-             ) -> tuple[str, tuple[tuple[str, str], ...]]:
-        return name, tuple(sorted((k, str(v)) for k, v in labels.items()))
+        #: standalone Counters() instances (tests) get a private registry
+        self._registry = registry if registry is not None \
+            else MetricsRegistry()
+
+    @property
+    def registry(self):
+        return self._registry
 
     def inc(self, name: str, n: int = 1, **labels: str) -> int:
-        with self._lock:
-            key = self._key(name, labels)
-            self._counts[key] = self._counts.get(key, 0) + n
-            return self._counts[key]
+        return int(self._registry.counter(name).inc(n, **labels))
 
     def get(self, name: str, **labels: str) -> int:
-        with self._lock:
-            return self._counts.get(self._key(name, labels), 0)
+        return int(self._registry.counter(name).value(**labels))
 
     def total(self, name: str) -> int:
         """Sum over every label combination of ``name``."""
-        with self._lock:
-            return sum(v for (n, _), v in self._counts.items() if n == name)
+        return int(self._registry.counter(name).total())
 
     def snapshot(self) -> dict[str, int]:
-        """Flat ``name{k=v,...}`` → count view (audit dumps, tests)."""
-        with self._lock:
-            out = {}
-            for (name, labels), v in self._counts.items():
+        """Flat ``name{k=v,...}`` → count view (audit dumps, tests).
+        Families that exist but never counted are omitted (pre-registry
+        behavior: an un-inc'd name was absent)."""
+        out: dict[str, int] = {}
+        for name, fam in sorted(self._registry.counter_families().items()):
+            for labels, v in fam.series().items():
                 key = name if not labels else name + "{" + ",".join(
                     f"{k}={val}" for k, val in labels) + "}"
-                out[key] = v
-            return out
+                out[key] = int(v)
+        return out
 
     def clear(self) -> None:
-        with self._lock:
-            self._counts.clear()
+        self._registry.clear_counters()
+
+
+def _make_default_counters() -> Counters:
+    from edl_tpu.observability.metrics import get_registry
+
+    return Counters(registry=get_registry())
 
 
 #: Process-wide counter registry — what the chaos engine, checkpointer and
-#: coord client record into (mirrors tracing.get_tracer()).
-_default_counters = Counters()
+#: coord client record into (mirrors tracing.get_tracer()); backed by the
+#: process-wide MetricsRegistry so every counter is scrape-visible.
+_default_counters = _make_default_counters()
 
 
 def get_counters() -> Counters:
@@ -152,12 +164,20 @@ class Collector:
 
     def __init__(self, cluster, interval_s: float = DEFAULT_INTERVAL_S,
                  out: TextIO | None = None,
-                 clock: Callable[[], float] = time.time) -> None:
+                 clock: Callable[[], float] = time.time,
+                 registry=None) -> None:
         self._cluster = cluster
         self._interval_s = interval_s
         self._out = out  # None = current sys.stdout at write time
         self._clock = clock
         self._header_written = False
+        # every TSV column doubles as a scrape-able gauge (the four
+        # reference columns become edl_cluster_* series on /metrics)
+        if registry is None:
+            from edl_tpu.observability.metrics import get_registry
+
+            registry = get_registry()
+        self._registry = registry
 
     # -- classification (reference collector.py:95-118) --------------------
 
@@ -204,7 +224,34 @@ class Collector:
                             if r.tpu_total else 0.0),
         )
         self._write(sample)
+        self._export(sample)
         return sample
+
+    def _export(self, s: Sample) -> None:
+        """Mirror the sample into the shared registry so the collector's
+        /metrics route serves the same truth as its TSV."""
+        r = self._registry
+        r.gauge("cluster_submitted_jobs",
+                help="jobs with any pod present").set(s.submitted_jobs)
+        r.gauge("cluster_pending_jobs",
+                help="jobs pending by the reference rule").set(s.pending_jobs)
+        r.gauge("cluster_cpu_utils_pct",
+                help="running-pod CPU requests over allocatable"
+                ).set(s.cpu_utils_pct)
+        r.gauge("cluster_chip_utils_pct",
+                help="running-pod chip limits over allocatable"
+                ).set(s.chip_utils_pct)
+        g = r.gauge("cluster_running_trainers",
+                    help="running trainer pods per job")
+        # prune series for jobs that left the cluster FIRST — a deleted
+        # job must disappear from /metrics, not freeze at its last count
+        for labels in g.label_sets():
+            if labels.get("job") not in s.running_trainers:
+                g.remove(**labels)
+        for job, n in s.running_trainers.items():
+            g.set(n, job=job)
+        r.counter("collector_samples",
+                  help="collector samples taken").inc()
 
     def run(self, max_samples: int | None = None) -> None:
         """Poll forever (reference collector.py:215-226); ``max_samples``

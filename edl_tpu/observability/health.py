@@ -116,24 +116,50 @@ def serve_health(port: int,
                  checks: Mapping[str, Callable[[], bool]],
                  host: str = "0.0.0.0",
                  check_timeout_s: float = DEFAULT_CHECK_TIMEOUT_S,
+                 registry=None,
                  ) -> ThreadingHTTPServer:
-    """Serve ``GET /healthz`` on ``port`` in a daemon thread.
+    """Serve ``GET /healthz`` and ``GET /metrics`` on ``port`` in a
+    daemon thread.
 
-    200 when every check passes, 503 when any fails or breaches
-    ``check_timeout_s``.  Checks run concurrently under one shared
-    deadline (probe latency ≈ the slowest check, capped at the timeout),
-    and a check still wedged from a previous probe is reported stuck
-    immediately without spawning another thread.  The body carries both
-    the flat per-check booleans (``{"sync": true, ...}`` — the shape
-    probes and dashboards already parse) and a ``checks`` detail map
-    with per-check ``latency_ms`` and ``timed_out``.  ``port`` 0 binds
-    an OS-assigned port — read it from ``.server_address[1]``.  Call
-    ``.shutdown()`` to stop.
+    ``/healthz``: 200 when every check passes, 503 when any fails or
+    breaches ``check_timeout_s``.  Checks run concurrently under one
+    shared deadline (probe latency ≈ the slowest check, capped at the
+    timeout), and a check still wedged from a previous probe is reported
+    stuck immediately without spawning another thread.  The body carries
+    both the flat per-check booleans (``{"sync": true, ...}`` — the
+    shape probes and dashboards already parse) and a ``checks`` detail
+    map with per-check ``latency_ms`` and ``timed_out``.
+
+    ``/metrics``: Prometheus text exposition of ``registry`` (default:
+    the process-wide :func:`~edl_tpu.observability.metrics.get_registry`
+    — which is also where :func:`~edl_tpu.observability.collector.
+    get_counters` records), so every process that serves a probe also
+    serves its whole telemetry plane from one port.
+
+    ``port`` 0 binds an OS-assigned port — read it from
+    ``.server_address[1]``.  Call ``.shutdown()`` to stop.
     """
     runner = _CheckRunner(checks, check_timeout_s)
 
+    def _registry():
+        if registry is not None:
+            return registry
+        from edl_tpu.observability.metrics import get_registry
+
+        return get_registry()
+
     class Handler(BaseHTTPRequestHandler):
         def do_GET(self) -> None:  # noqa: N802 (http.server API)
+            if self.path == "/metrics":
+                from edl_tpu.observability.metrics import CONTENT_TYPE
+
+                body = _registry().render().encode()
+                self.send_response(200)
+                self.send_header("Content-Type", CONTENT_TYPE)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return
             if self.path not in ("/", "/healthz"):
                 self.send_error(404)
                 return

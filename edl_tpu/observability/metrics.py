@@ -1,0 +1,450 @@
+"""Shared metrics registry + Prometheus text exposition + flight recorder.
+
+One telemetry plane for every long-lived process.  Before this module the
+stack had three disjoint counter stores — the :class:`~edl_tpu.
+observability.collector.Counters` registry, the coord client's request
+counters, and the native server's METRICS text — none of which an
+operator could scrape.  Now every counter, gauge and histogram lands in
+one process-wide :class:`MetricsRegistry`, and every process that serves
+``/healthz`` (controller, collector, coordinator, multihost supervisor)
+also serves ``GET /metrics`` in Prometheus text format
+(``text/plain; version=0.0.4``) from that registry, so a single scrape
+config covers the whole job.  The native coordination server renders the
+same exposition format from C++ (coord/native/server.cc ``/metrics``).
+
+Naming scheme (doc/observability.md):
+
+* every series is prefixed ``edl_`` at render time;
+* counters get the conventional ``_total`` suffix (``faults_injected``
+  renders as ``edl_faults_injected_total``);
+* histograms use base-unit names ending ``_seconds`` with the fixed
+  latency buckets in :data:`DEFAULT_BUCKETS`;
+* labels are passed as kwargs exactly like ``Counters.inc`` always did.
+
+The existing :class:`Counters` facade is *absorbed*, not broken: it is
+now backed by a registry (the process-wide one for ``get_counters()``),
+so every ``inc()`` anywhere in the runtime is scrape-visible for free.
+
+The **flight recorder** (:func:`dump_flight_record`) is the post-mortem
+complement: on stall/fault escalation the watchdog dumps the process's
+trace ring plus a counters + metrics snapshot to a timestamped
+``flightrec-*.json``, so attributing a hang never depends on having had
+a profiler attached when it happened.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import re
+import tempfile
+import threading
+import time
+from typing import Callable, Iterable, Optional
+
+#: Fixed histogram buckets (seconds) covering the stack's latency range:
+#: sub-ms step pauses up to the 120 s formation budget.  Fixed — not
+#: adaptive — so series from different processes/rounds are mergeable.
+DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                   1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0)
+
+#: rendered-name prefix: one namespace for every series the stack emits
+PREFIX = "edl_"
+
+_NAME_OK = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_LABEL_OK = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+
+
+def sanitize_name(name: str) -> str:
+    """Coerce an arbitrary metric/label name into the exposition-format
+    grammar (``[a-zA-Z_:][a-zA-Z0-9_:]*``)."""
+    out = re.sub(r"[^a-zA-Z0-9_:]", "_", name)
+    if not out or not _NAME_OK.match(out):
+        out = "_" + out
+    return out
+
+
+def escape_label_value(value: str) -> str:
+    """Backslash-escape per the text-format spec (\\, \", \\n)."""
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def format_value(v: float) -> str:
+    """Integers without a decimal point; floats via repr; specials per
+    the spec (+Inf/-Inf/NaN)."""
+    if isinstance(v, bool):
+        return str(int(v))
+    if math.isinf(v):
+        return "+Inf" if v > 0 else "-Inf"
+    if math.isnan(v):
+        return "NaN"
+    if float(v).is_integer():
+        return str(int(v))
+    return repr(float(v))
+
+
+def _label_key(labels: dict) -> tuple[tuple[str, str], ...]:
+    return tuple(sorted((k, str(v)) for k, v in labels.items()))
+
+
+def _render_labels(key: tuple[tuple[str, str], ...],
+                   extra: tuple[tuple[str, str], ...] = ()) -> str:
+    pairs = list(key) + list(extra)
+    if not pairs:
+        return ""
+    inner = ",".join(
+        f'{sanitize_name(k)}="{escape_label_value(v)}"' for k, v in pairs)
+    return "{" + inner + "}"
+
+
+class _Family:
+    """One named metric family: a lock, a help string, labeled children."""
+
+    kind = "untyped"
+
+    def __init__(self, name: str, help: str = "") -> None:
+        self.name = name
+        self.help = help
+        self._lock = threading.Lock()
+
+
+class Counter(_Family):
+    kind = "counter"
+
+    def __init__(self, name: str, help: str = "") -> None:
+        super().__init__(name, help)
+        self._values: dict[tuple, float] = {}
+
+    def inc(self, n: float = 1, **labels) -> float:
+        if n < 0:
+            raise ValueError("counters only go up")
+        with self._lock:
+            key = _label_key(labels)
+            self._values[key] = self._values.get(key, 0) + n
+            return self._values[key]
+
+    def value(self, **labels) -> float:
+        with self._lock:
+            return self._values.get(_label_key(labels), 0)
+
+    def total(self) -> float:
+        with self._lock:
+            return sum(self._values.values())
+
+    def series(self) -> dict[tuple, float]:
+        with self._lock:
+            return dict(self._values)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._values.clear()
+
+    def render(self, lines: list[str]) -> None:
+        name = PREFIX + sanitize_name(self.name)
+        if not name.endswith("_total"):
+            name += "_total"
+        lines.append(f"# HELP {name} {self.help or self.name}")
+        lines.append(f"# TYPE {name} counter")
+        series = self.series()
+        if not series:
+            lines.append(f"{name} 0")
+            return
+        for key in sorted(series):
+            lines.append(
+                f"{name}{_render_labels(key)} {format_value(series[key])}")
+
+
+class Gauge(_Family):
+    kind = "gauge"
+
+    def __init__(self, name: str, help: str = "") -> None:
+        super().__init__(name, help)
+        self._values: dict[tuple, float] = {}
+
+    def set(self, v: float, **labels) -> None:
+        with self._lock:
+            self._values[_label_key(labels)] = float(v)
+
+    def inc(self, n: float = 1, **labels) -> None:
+        with self._lock:
+            key = _label_key(labels)
+            self._values[key] = self._values.get(key, 0) + n
+
+    def dec(self, n: float = 1, **labels) -> None:
+        self.inc(-n, **labels)
+
+    def value(self, **labels) -> float:
+        with self._lock:
+            return self._values.get(_label_key(labels), 0)
+
+    def remove(self, **labels) -> None:
+        """Drop one label-set's series (an entity that no longer exists
+        must stop being reported, not freeze at its last value)."""
+        with self._lock:
+            self._values.pop(_label_key(labels), None)
+
+    def label_sets(self) -> list[dict]:
+        with self._lock:
+            return [dict(k) for k in self._values]
+
+    def render(self, lines: list[str]) -> None:
+        name = PREFIX + sanitize_name(self.name)
+        lines.append(f"# HELP {name} {self.help or self.name}")
+        lines.append(f"# TYPE {name} gauge")
+        with self._lock:
+            series = dict(self._values)
+        if not series:
+            lines.append(f"{name} 0")
+            return
+        for key in sorted(series):
+            lines.append(
+                f"{name}{_render_labels(key)} {format_value(series[key])}")
+
+
+class Histogram(_Family):
+    kind = "histogram"
+
+    def __init__(self, name: str, help: str = "",
+                 buckets: Iterable[float] = DEFAULT_BUCKETS) -> None:
+        super().__init__(name, help)
+        bs = sorted(float(b) for b in buckets)
+        if not bs:
+            raise ValueError("histogram needs at least one bucket")
+        self.buckets: tuple[float, ...] = tuple(bs)
+        # per label-set: [bucket counts..., +Inf count], sum
+        self._counts: dict[tuple, list[int]] = {}
+        self._sums: dict[tuple, float] = {}
+
+    def observe(self, v: float, **labels) -> None:
+        v = float(v)
+        with self._lock:
+            key = _label_key(labels)
+            counts = self._counts.get(key)
+            if counts is None:
+                counts = [0] * (len(self.buckets) + 1)
+                self._counts[key] = counts
+                self._sums[key] = 0.0
+            for i, b in enumerate(self.buckets):
+                if v <= b:
+                    counts[i] += 1
+            counts[-1] += 1  # +Inf
+            self._sums[key] += v
+
+    def count(self, **labels) -> int:
+        with self._lock:
+            counts = self._counts.get(_label_key(labels))
+            return counts[-1] if counts else 0
+
+    def sum(self, **labels) -> float:
+        with self._lock:
+            return self._sums.get(_label_key(labels), 0.0)
+
+    def quantile_bucket(self, q: float, **labels) -> Optional[float]:
+        """Upper bound of the bucket containing quantile ``q`` (a cheap
+        p50/p99 for dashboards; None with no observations)."""
+        with self._lock:
+            counts = self._counts.get(_label_key(labels))
+            if not counts or counts[-1] == 0:
+                return None
+            rank = q * counts[-1]
+            for i, b in enumerate(self.buckets):
+                if counts[i] >= rank:
+                    return b
+            return math.inf
+
+    def render(self, lines: list[str]) -> None:
+        name = PREFIX + sanitize_name(self.name)
+        lines.append(f"# HELP {name} {self.help or self.name}")
+        lines.append(f"# TYPE {name} histogram")
+        with self._lock:
+            keys = sorted(self._counts)
+            snap = {k: (list(self._counts[k]), self._sums[k]) for k in keys}
+        for key in keys:
+            counts, total = snap[key]
+            for i, b in enumerate(self.buckets):
+                lines.append(
+                    f"{name}_bucket"
+                    f"{_render_labels(key, (('le', format_value(b)),))}"
+                    f" {counts[i]}")
+            lines.append(
+                f"{name}_bucket{_render_labels(key, (('le', '+Inf'),))}"
+                f" {counts[-1]}")
+            lines.append(f"{name}_sum{_render_labels(key)} "
+                         f"{format_value(total)}")
+            lines.append(f"{name}_count{_render_labels(key)} {counts[-1]}")
+
+
+class MetricsRegistry:
+    """Typed families keyed by raw (unprefixed) name, plus callback
+    gauges evaluated at render time (live values — queue depths, member
+    counts — that nothing needs to push)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._families: dict[str, _Family] = {}
+        #: (name, label-key) → (fn, help, kind): several label-sets may
+        #: share one family name (edl_coord_queue_tasks{state=...});
+        #: kind is "gauge" or "counter" (render type + _total suffix)
+        self._gauge_fns: dict[tuple[str, tuple],
+                              tuple[Callable[[], float], str, str]] = {}
+
+    def _get_or_create(self, name: str, cls, **kwargs) -> _Family:
+        with self._lock:
+            fam = self._families.get(name)
+            if fam is None:
+                fam = cls(name, **kwargs)
+                self._families[name] = fam
+            elif not isinstance(fam, cls):
+                raise TypeError(
+                    f"metric {name!r} already registered as {fam.kind}")
+            return fam
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        return self._get_or_create(name, Counter, help=help)
+
+    def gauge(self, name: str, help: str = "") -> Gauge:
+        return self._get_or_create(name, Gauge, help=help)
+
+    def histogram(self, name: str, help: str = "",
+                  buckets: Iterable[float] = DEFAULT_BUCKETS) -> Histogram:
+        return self._get_or_create(name, Histogram, help=help,
+                                   buckets=buckets)
+
+    def gauge_fn(self, name: str, fn: Callable[[], float], help: str = "",
+                 **labels) -> None:
+        """Register (or replace) a callback gauge; ``fn()`` is called at
+        render time and a raising/None callback is skipped, never fatal.
+        The same family name may be registered once per label set."""
+        with self._lock:
+            self._gauge_fns[(name, _label_key(labels))] = (fn, help, "gauge")
+
+    def counter_fn(self, name: str, fn: Callable[[], float],
+                   help: str = "", **labels) -> None:
+        """Callback COUNTER: like :meth:`gauge_fn` but rendered as
+        ``# TYPE counter`` with the ``_total`` suffix — for components
+        that already own an authoritative monotonic count (the Python
+        coord service's request/longpoll tallies), so their series names
+        match the native server's exposition exactly."""
+        with self._lock:
+            self._gauge_fns[(name, _label_key(labels))] = (fn, help,
+                                                           "counter")
+
+    def counter_families(self) -> dict[str, Counter]:
+        with self._lock:
+            return {n: f for n, f in self._families.items()
+                    if isinstance(f, Counter)}
+
+    def clear_counters(self) -> None:
+        for fam in self.counter_families().values():
+            fam.clear()
+
+    def render(self) -> str:
+        """Prometheus text exposition (version 0.0.4) of every family +
+        callback gauge, deterministically ordered."""
+        lines: list[str] = []
+        with self._lock:
+            fams = sorted(self._families.items())
+            gfns = sorted(self._gauge_fns.items())
+        for _, fam in fams:
+            fam.render(lines)
+        last_name = None
+        for (name, lkey), (fn, help, kind) in gfns:
+            try:
+                v = fn()
+            except Exception:
+                continue
+            if v is None:
+                continue
+            rname = PREFIX + sanitize_name(name)
+            if kind == "counter" and not rname.endswith("_total"):
+                rname += "_total"
+            if name != last_name:  # HELP/TYPE once per family
+                lines.append(f"# HELP {rname} {help or name}")
+                lines.append(f"# TYPE {rname} {kind}")
+                last_name = name
+            lines.append(f"{rname}{_render_labels(lkey)} "
+                         f"{format_value(float(v))}")
+        return "\n".join(lines) + "\n"
+
+
+#: Process-wide registry — what get_counters() is backed by and what
+#: every /metrics route renders (mirrors tracing.get_tracer()).
+_default_registry = MetricsRegistry()
+
+
+def get_registry() -> MetricsRegistry:
+    return _default_registry
+
+
+#: the scrape content type every /metrics route advertises
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+# -- flight recorder ---------------------------------------------------------
+
+_flight_seq = [0]
+_flight_seq_lock = threading.Lock()
+
+
+def dump_flight_record(dir_path: str, reason: str,
+                       extra: Optional[dict] = None,
+                       tracer=None, registry: Optional[MetricsRegistry] = None,
+                       keep: int = 20) -> str:
+    """Dump the process's trace ring + counters + metrics snapshot to a
+    timestamped ``flightrec-<utc>-<reason>-<pid>.json`` under
+    ``dir_path`` and return its path.
+
+    Called on stall/fault escalation (StallWatchdog, the multihost
+    supervisor) so the post-mortem evidence — what the process was doing,
+    how long each recent phase took, every counter's value at the moment
+    of escalation — exists on disk even when nobody had a profiler or a
+    scraper attached.  Atomic (temp + rename); prunes to the ``keep``
+    newest records so an escalation loop cannot fill the disk.
+    """
+    from dataclasses import asdict
+
+    from edl_tpu.observability.collector import get_counters
+    from edl_tpu.observability.tracing import get_tracer
+
+    os.makedirs(dir_path, exist_ok=True)
+    tracer = tracer if tracer is not None else get_tracer()
+    registry = registry if registry is not None else get_registry()
+    stamp = time.strftime("%Y%m%dT%H%M%S", time.gmtime())
+    slug = re.sub(r"[^a-zA-Z0-9_-]", "-", reason)[:48] or "event"
+    with _flight_seq_lock:
+        _flight_seq[0] += 1
+        seq = _flight_seq[0]
+    # pid+seq make the name unique even for two escalations in the same
+    # second with the same reason (the stamp keeps it sortable)
+    path = os.path.join(
+        dir_path, f"flightrec-{stamp}-{slug}-{os.getpid()}-{seq}.json")
+    doc = {
+        "reason": reason,
+        "wall_time": time.time(),
+        "pid": os.getpid(),
+        "extra": extra or {},
+        "counters": get_counters().snapshot(),
+        "metrics_text": registry.render(),
+        "trace_events": [asdict(e) for e in tracer.events()],
+    }
+    fd, tmp = tempfile.mkstemp(dir=dir_path, prefix=".flightrec-")
+    with os.fdopen(fd, "w") as f:
+        json.dump(doc, f)
+    os.replace(tmp, path)
+    _prune_flight_records(dir_path, keep)
+    return path
+
+
+def _prune_flight_records(dir_path: str, keep: int) -> None:
+    try:
+        recs = sorted(f for f in os.listdir(dir_path)
+                      if f.startswith("flightrec-") and f.endswith(".json"))
+    except OSError:
+        return
+    for f in recs[:-keep] if keep > 0 else recs:
+        try:
+            os.remove(os.path.join(dir_path, f))
+        except OSError:
+            pass

@@ -1,13 +1,20 @@
-"""Lightweight event tracing for steps and scale events.
+"""Lightweight event tracing with cross-process span correlation.
 
 The reference has no tracing at all (SURVEY §5.1 — nothing beyond log
 lines with caller annotation, reference cmd/edl/edl.go:26-28).  This build
-adds the two things an elastic-training operator actually needs:
+adds the things an elastic-training operator actually needs:
 
   * a **trace ring** of timestamped events (train steps, scale decisions,
     membership epochs, checkpoint saves/restores) that is cheap enough to
     leave on, queryable in-process, and dumpable as Chrome
-    ``chrome://tracing`` JSON for offline inspection, and
+    ``chrome://tracing`` JSON for offline inspection,
+  * **correlated spans**: every span carries a ``span_id``; a reform /
+    resize / checkpoint event opens a *root* span whose ``trace_id``
+    propagates to other processes via the ``EDL_TRACE_ID`` env var and a
+    coordinator KV key (runtime/multihost.py), so per-worker traces merge
+    into one job-level timeline where a reform reads as a single span
+    tree (:meth:`Tracer.merge_files` — each file becomes one pid row,
+    timestamps aligned on the per-process wall-clock anchor), and
   * a **jax profiler surface** — ``profile_step()`` wraps a step in a
     ``jax.profiler.TraceAnnotation`` and ``start_server()`` exposes the
     live profiler so TensorBoard/XProf can attach to a running trainer.
@@ -19,12 +26,14 @@ host from tracing.
 from __future__ import annotations
 
 import json
+import os
 import threading
 import time
+import uuid
 from collections import deque
 from contextlib import contextmanager
 from dataclasses import dataclass, field
-from typing import Iterator
+from typing import Iterator, Optional
 
 
 @dataclass(frozen=True)
@@ -34,6 +43,69 @@ class TraceEvent:
     start_s: float
     duration_s: float
     args: dict = field(default_factory=dict)
+    #: correlation triplet — None on plain events; spans get a span_id,
+    #: and events inside a propagated trace share its trace_id
+    trace_id: Optional[str] = None
+    span_id: Optional[str] = None
+    parent_id: Optional[str] = None
+
+
+def new_trace_id() -> str:
+    return uuid.uuid4().hex[:16]
+
+
+def new_span_id() -> str:
+    return uuid.uuid4().hex[:16]
+
+
+#: thread-local explicit trace id (set_trace_id); falls back to the
+#: EDL_TRACE_ID env var — which is how a spawned child inherits the trace
+_tls = threading.local()
+
+
+def set_trace_id(trace_id: Optional[str]) -> None:
+    """Pin the current trace id for this thread (None clears it)."""
+    _tls.trace_id = trace_id
+
+
+def current_trace_id() -> Optional[str]:
+    tid = getattr(_tls, "trace_id", None)
+    if tid:
+        return tid
+    return os.environ.get("EDL_TRACE_ID") or None
+
+
+class SpanHandle:
+    """An open span: close it with :meth:`end` (explicit begin/end for
+    spans that outlive one ``with`` block, like a reform root that spans
+    a supervisor loop iteration)."""
+
+    __slots__ = ("_tracer", "name", "category", "t0", "trace_id",
+                 "span_id", "parent_id", "args", "_done")
+
+    def __init__(self, tracer: "Tracer", name: str, category: str,
+                 trace_id: Optional[str], parent_id: Optional[str],
+                 args: dict) -> None:
+        self._tracer = tracer
+        self.name = name
+        self.category = category
+        self.t0 = tracer._clock()
+        self.trace_id = trace_id
+        self.span_id = new_span_id()
+        self.parent_id = parent_id
+        self.args = args
+        self._done = False
+
+    def end(self, **more) -> None:
+        if self._done:  # idempotent: escalation paths may double-close
+            return
+        self._done = True
+        t = self._tracer
+        with t._lock:
+            t._events.append(TraceEvent(
+                self.name, self.category, self.t0, t._clock() - self.t0,
+                {**self.args, **more}, trace_id=self.trace_id,
+                span_id=self.span_id, parent_id=self.parent_id))
 
 
 class Tracer:
@@ -44,23 +116,80 @@ class Tracer:
         self._events: deque[TraceEvent] = deque(maxlen=capacity)
         self._lock = threading.Lock()
         self._clock = clock
+        #: wall-clock anchor: wall time when this tracer's clock read 0 —
+        #: what lets merge_files align per-process perf_counter timelines
+        #: onto one shared axis
+        self._wall_anchor = time.time() - self._clock()
 
     def instant(self, name: str, category: str = "event", **args) -> None:
         """Zero-duration marker (scale decision, epoch bump, ...)."""
         with self._lock:
             self._events.append(
-                TraceEvent(name, category, self._clock(), 0.0, args))
+                TraceEvent(name, category, self._clock(), 0.0, args,
+                           trace_id=current_trace_id()))
 
     @contextmanager
-    def span(self, name: str, category: str = "step", **args) -> Iterator[None]:
-        """Timed region; the event is recorded when the region exits."""
-        t0 = self._clock()
+    def span(self, name: str, category: str = "step",
+             parent_id: Optional[str] = None, **args) -> Iterator[SpanHandle]:
+        """Timed region; the event is recorded when the region exits.
+        Yields the open :class:`SpanHandle` so nested work can parent
+        itself (``parent_id=handle.span_id``)."""
+        handle = SpanHandle(self, name, category, current_trace_id(),
+                            parent_id, args)
         try:
-            yield
+            yield handle
         finally:
-            with self._lock:
-                self._events.append(
-                    TraceEvent(name, category, t0, self._clock() - t0, args))
+            handle.end()
+
+    def begin(self, name: str, category: str = "event",
+              trace_id: Optional[str] = None,
+              parent_id: Optional[str] = None, **args) -> SpanHandle:
+        """Open a span explicitly; close it with ``handle.end()``."""
+        return SpanHandle(self, name, category,
+                          trace_id or current_trace_id(), parent_id, args)
+
+    @contextmanager
+    def root_span(self, name: str, category: str = "reform",
+                  trace_id: Optional[str] = None,
+                  **args) -> Iterator[SpanHandle]:
+        """Open a root span and make its trace id *current* for the
+        duration — on this thread (set_trace_id) and in ``EDL_TRACE_ID``
+        so processes spawned inside the region inherit it."""
+        tid = trace_id or new_trace_id()
+        prev_tls = getattr(_tls, "trace_id", None)
+        prev_env = os.environ.get("EDL_TRACE_ID")
+        set_trace_id(tid)
+        os.environ["EDL_TRACE_ID"] = tid
+        handle = SpanHandle(self, name, category, tid, None, args)
+        try:
+            yield handle
+        finally:
+            handle.end()
+            set_trace_id(prev_tls)
+            if prev_env is None:
+                os.environ.pop("EDL_TRACE_ID", None)
+            else:
+                os.environ["EDL_TRACE_ID"] = prev_env
+
+    def from_wall(self, wall_ts: float) -> float:
+        """Convert a wall-clock timestamp to this tracer's clock axis
+        (for spans whose start was observed in another process, e.g. the
+        supervisor's spawn time seen from the world child)."""
+        return wall_ts - self._wall_anchor
+
+    def record_span(self, name: str, category: str, start_s: float,
+                    end_s: float, trace_id: Optional[str] = None,
+                    span_id: Optional[str] = None,
+                    parent_id: Optional[str] = None, **args) -> str:
+        """Append a span with explicit clock-axis timestamps (use
+        :meth:`from_wall` for wall-observed starts).  Returns span_id."""
+        sid = span_id or new_span_id()
+        with self._lock:
+            self._events.append(TraceEvent(
+                name, category, start_s, max(end_s - start_s, 0.0), args,
+                trace_id=trace_id or current_trace_id(),
+                span_id=sid, parent_id=parent_id))
+        return sid
 
     def events(self, category: str | None = None) -> list[TraceEvent]:
         with self._lock:
@@ -75,21 +204,87 @@ class Tracer:
 
     # -- export -------------------------------------------------------------
 
-    def to_chrome_trace(self) -> str:
-        """Chrome trace-event JSON (load in chrome://tracing / Perfetto)."""
+    def to_chrome_trace(self, process_name: Optional[str] = None) -> str:
+        """Chrome trace-event JSON (load in chrome://tracing / Perfetto).
+
+        The correlation ids travel in ``args`` (Perfetto shows them per
+        slice); the top-level ``edl`` object carries the wall anchor and
+        process name :meth:`merge_files` needs — chrome ignores unknown
+        top-level keys.
+        """
         out = []
+        if process_name:
+            out.append({"name": "process_name", "ph": "M", "pid": 0,
+                        "tid": 0, "args": {"name": process_name}})
         for e in self.events():
+            args = dict(e.args)
+            for k in ("trace_id", "span_id", "parent_id"):
+                v = getattr(e, k)
+                if v:
+                    args[k] = v
             out.append({
                 "name": e.name, "cat": e.category,
                 "ph": "X" if e.duration_s > 0 else "i",
                 "ts": e.start_s * 1e6, "dur": e.duration_s * 1e6,
-                "pid": 0, "tid": 0, "args": e.args,
+                "pid": 0, "tid": 0, "args": args,
             })
-        return json.dumps({"traceEvents": out})
+        return json.dumps({
+            "traceEvents": out,
+            "edl": {"wall_anchor_s": self._wall_anchor,
+                    "process": process_name or f"pid-{os.getpid()}"},
+        })
 
-    def dump(self, path: str) -> None:
+    def dump(self, path: str, process_name: Optional[str] = None) -> None:
         with open(path, "w") as f:
-            f.write(self.to_chrome_trace())
+            f.write(self.to_chrome_trace(process_name))
+
+    # -- cross-process merge -------------------------------------------------
+
+    @staticmethod
+    def merge_files(paths, out_path: Optional[str] = None) -> dict:
+        """Merge per-process chrome traces (written by :meth:`dump`) into
+        one job-level timeline: each input file becomes one pid row, and
+        every timestamp is shifted onto a shared wall-clock axis using
+        the per-file ``edl.wall_anchor_s`` — so a reform recorded by the
+        supervisor and its world child's startup phases line up as the
+        one span tree they are.  Files without the anchor merge at their
+        raw timestamps (degraded but never fatal).  Returns the merged
+        document; writes it to ``out_path`` when given."""
+        docs = []
+        for p in paths:
+            try:
+                with open(p) as f:
+                    docs.append((os.path.basename(p), json.load(f)))
+            except (OSError, json.JSONDecodeError):
+                continue
+        anchors = [d.get("edl", {}).get("wall_anchor_s") for _, d in docs]
+        # base over ANCHORED files only: an anchorless file (a pre-plane
+        # dump, a foreign chrome trace) merges at its raw timestamps —
+        # folding its implicit 0.0 into min() would instead shift every
+        # anchored file by its full wall-clock epoch (~decades)
+        known = [a for a in anchors if a is not None]
+        base = min(known) if known else 0.0
+        merged: list[dict] = []
+        names: list[str] = []
+        for pid, ((fname, doc), anchor) in enumerate(zip(docs, anchors)):
+            pname = doc.get("edl", {}).get("process") or fname
+            names.append(pname)
+            merged.append({"name": "process_name", "ph": "M", "pid": pid,
+                           "tid": 0, "args": {"name": pname}})
+            shift_us = (anchor - base) * 1e6 if anchor is not None else 0.0
+            for e in doc.get("traceEvents", []):
+                if e.get("ph") == "M":
+                    continue  # replaced by our per-pid metadata
+                e = dict(e)
+                e["pid"] = pid
+                e["ts"] = e.get("ts", 0.0) + shift_us
+                merged.append(e)
+        out = {"traceEvents": merged,
+               "edl": {"wall_anchor_s": base, "merged_from": names}}
+        if out_path:
+            with open(out_path, "w") as f:
+                json.dump(out, f)
+        return out
 
 
 #: Process-wide default tracer — what the runtime and scheduler record into.

@@ -299,6 +299,13 @@ class ElasticCheckpointer:
         pause = time.monotonic() - t0
         self.async_pauses_s.append(pause)
         get_counters().inc("checkpoint_async_saves")
+        from edl_tpu.observability.metrics import get_registry
+
+        # the step-loop pause distribution — the p50/p99 the bench quotes,
+        # as a scrape-able histogram
+        get_registry().histogram(
+            "checkpoint_pause_seconds",
+            help="step-loop pause per async checkpoint save").observe(pause)
         return pause
 
     def _persist_bg(self, step: int, host_tree: Any,

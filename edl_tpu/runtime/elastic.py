@@ -200,6 +200,17 @@ class ElasticTrainer:
         get_tracer().instant("mesh_resized", category="elastic", **evt)
         get_counters().inc("prewarm_hits" if evt["prewarm_hit"]
                            else "prewarm_misses")
+        # the compile/reshard split as scrape-able distributions, next to
+        # the per-event list the bench reads
+        from edl_tpu.observability.metrics import get_registry
+
+        get_registry().histogram(
+            "resize_phase_seconds",
+            help="mesh-resize latency by phase").observe(
+                evt["compile_ms"] / 1000.0, phase="compile")
+        get_registry().histogram(
+            "resize_phase_seconds").observe(
+                evt["reshard_ms"] / 1000.0, phase="reshard")
         log.info("mesh resized", world_size=n_devices,
                  compile_ms=evt["compile_ms"], reshard_ms=evt["reshard_ms"],
                  prewarm_hit=evt["prewarm_hit"], step=self.state.step)

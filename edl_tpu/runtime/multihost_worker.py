@@ -520,6 +520,12 @@ def main(argv=None) -> int:
                     help="stall deadline = max(floor, k × EWMA step time)")
     ap.add_argument("--no-stall-watchdog", action="store_true",
                     help="disable supervisor-side stall detection")
+    ap.add_argument("--metrics-port", type=int, default=None,
+                    help="serve GET /metrics (Prometheus text) + "
+                         "/healthz from the supervisor; default from "
+                         "EDL_MH_METRICS_PORT, -1 disables, 0 = "
+                         "OS-assigned (address written to "
+                         "metrics-addr-<name> in the ckpt dir)")
     ap.add_argument("--param-sharding", choices=("replicated", "fsdp"),
                     default=os.environ.get("EDL_MH_SHARDING", "replicated"),
                     help="replicated = pure DP with npz generations; "
@@ -596,6 +602,7 @@ def main(argv=None) -> int:
             stall_watchdog=not args.no_stall_watchdog,
             stall_floor_s=args.stall_floor_s,
             stall_k=args.stall_k,
+            metrics_port=args.metrics_port,
             # the warm child pre-imports what train_world will need;
             # orbax's import is heavy and only the collective path
             # touches it

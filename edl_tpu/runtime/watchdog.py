@@ -48,6 +48,7 @@ Two driving modes:
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from dataclasses import dataclass
@@ -95,6 +96,7 @@ class StallWatchdog:
         alpha: float = DEFAULT_ALPHA,
         on_stall: Optional[Callable[[Stall], None]] = None,
         scope: str = "local",
+        flight_dir: Optional[str] = None,
         clock: Callable[[], float] = time.monotonic,
     ) -> None:
         if floor_s <= 0:
@@ -107,6 +109,13 @@ class StallWatchdog:
         self.alpha = alpha
         self.on_stall = on_stall
         self.scope = scope
+        #: flight-recorder directory: on the first breach of a silence
+        #: the trace ring + counters + metrics snapshot are dumped to a
+        #: timestamped flightrec-*.json there, so the post-mortem exists
+        #: even when no profiler/scraper was attached.  None falls back
+        #: to EDL_FLIGHTREC_DIR; empty/absent disables.
+        self.flight_dir = (flight_dir if flight_dir is not None
+                           else os.environ.get("EDL_FLIGHTREC_DIR", ""))
         self._clock = clock
         self._lock = threading.Lock()
         self._last_beat: Optional[float] = None
@@ -200,6 +209,20 @@ class StallWatchdog:
                              silent_s=round(stall.silent_s, 3),
                              deadline_s=round(stall.deadline_s, 3))
         get_counters().inc("stalls_detected", scope=self.scope)
+        if self.flight_dir:
+            # the stall IS the post-mortem moment: capture the trace ring
+            # and every counter before escalation mutates the world
+            try:
+                from edl_tpu.observability.metrics import dump_flight_record
+
+                dump_flight_record(
+                    self.flight_dir, f"stall-{self.scope}",
+                    extra={"step": stall.step,
+                           "silent_s": round(stall.silent_s, 3),
+                           "deadline_s": round(stall.deadline_s, 3),
+                           "ewma_s": round(stall.ewma_s, 4)})
+            except Exception as exc:  # recording must not kill the poller
+                log.warn("flight record dump failed", error=str(exc))
         if self.on_stall is not None:
             try:
                 self.on_stall(stall)

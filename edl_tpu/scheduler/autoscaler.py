@@ -173,6 +173,10 @@ class Autoscaler:
         if target:
             log.info("scaling plan", target=target)
             self.plan_history.append(dict(target))
+            from edl_tpu.observability.collector import get_counters
+
+            get_counters().inc("autoscaler_plans")
+            get_counters().inc("autoscaler_resizes_actuated", n=len(target))
             for uid in target:
                 self._last_resize[uid] = now
             if self.hint_sink is not None:
@@ -195,8 +199,25 @@ class Autoscaler:
             self._stop.wait(self.loop_seconds)
 
     def start(self) -> None:
+        self.register_metrics()
         self._thread = threading.Thread(target=self.run, daemon=True, name="autoscaler")
         self._thread.start()
+
+    def register_metrics(self, registry=None) -> None:
+        """Expose live planner state on the shared registry (callback
+        gauges, evaluated at scrape time) — the controller's /metrics
+        route serves these next to every counter the loop already bumps
+        (autoscaler_plans, resizes_suppressed{reason})."""
+        if registry is None:
+            from edl_tpu.observability.metrics import get_registry
+
+            registry = get_registry()
+        registry.gauge_fn("autoscaler_jobs_tracked",
+                          lambda: len(self.jobs),
+                          help="jobs in the autoscaler's job map")
+        registry.gauge_fn("autoscaler_loop_alive",
+                          lambda: float(self.is_alive()),
+                          help="1 while the planning loop thread lives")
 
     def stop(self) -> None:
         self._stop.set()

@@ -84,4 +84,120 @@ print("perf smoke OK:", {k: v for k, v in c.snapshot().items()
                          if "prewarm" in k or "async" in k})
 EOF
 
+echo "== telemetry smoke (/metrics both backends + merged reform span tree)"
+# Part A: exposition conformance over a live native coordinator and a
+# controller-shaped Python process, held to the same strict parser the
+# tests use — the "one scrape config covers everything" claim, executed.
+JAX_PLATFORMS=cpu python - <<'EOF'
+import json, urllib.request
+from tests.test_observability import parse_prometheus
+from edl_tpu.coord import PyCoordService
+from edl_tpu.coord.server import spawn_server
+from edl_tpu.observability.collector import get_counters
+from edl_tpu.observability.health import serve_health
+from edl_tpu.observability.metrics import MetricsRegistry
+
+def scrape(port, path="/metrics"):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}", timeout=5) as r:
+        return r.read().decode(), r.headers["Content-Type"]
+
+# native backend
+h = spawn_server(health_port=0)
+try:
+    c = h.client(); c.join("w0", "a"); c.add_task(b"x")
+    body, ctype = scrape(h.health_port)
+    assert "version=0.0.4" in ctype, ctype
+    s = parse_prometheus(body)
+    assert s["edl_coord_requests_total"] >= 2, s
+    assert s['edl_coord_queue_tasks{state="todo"}'] == 1, s
+    assert s["edl_coord_members"] == 1, s
+    c.close()
+finally:
+    h.stop()
+
+# python backend: controller-style serve_health + PyCoordService gauges;
+# series names must match the native exposition name-for-name
+svc = PyCoordService(); svc.join("a"); svc.add_task(b"x")
+reg = MetricsRegistry(); svc.register_metrics(reg)
+s = parse_prometheus(reg.render())
+assert s['edl_coord_queue_tasks{state="todo"}'] == 1, s
+for parity in ("edl_coord_requests_total", "edl_coord_longpolls_parked_total",
+               "edl_coord_members", "edl_coord_membership_epoch"):
+    assert parity in s, (parity, sorted(s))
+get_counters().inc("ci_telemetry_probe")
+srv = serve_health(0, {"ok": lambda: True}, host="127.0.0.1")
+try:
+    body, ctype = scrape(srv.server_address[1])
+    assert "version=0.0.4" in ctype, ctype
+    s = parse_prometheus(body)
+    assert s["edl_ci_telemetry_probe_total"] >= 1, s
+    health, _ = scrape(srv.server_address[1], "/healthz")
+    assert json.loads(health)["ok"] is True
+finally:
+    srv.shutdown()
+print("telemetry scrape OK (native + python backends)")
+EOF
+
+# Part B: a scripted stall→kill→reform under the supervisor must leave a
+# merged job timeline whose root reform span decomposes into the child's
+# named startup phases, plus a flight record.  Runs from a real file (not
+# stdin) because the spawn-context world children re-import __main__.
+TELE_TMP="$(mktemp -d)"
+cat > "$TELE_TMP/reform_span_smoke.py" <<'EOF'
+import functools, json, os, sys, tempfile
+import numpy as np
+
+sys.path.insert(0, os.getcwd())
+from tests.test_telemetry import (_tele_init_state, _tele_load_state,
+                                  _tele_train_world)
+
+def main():
+    from edl_tpu.coord.client import CoordClient
+    from edl_tpu.coord.server import spawn_server
+    from edl_tpu.observability.tracing import Tracer
+    from edl_tpu.runtime.multihost import run_elastic_worker, save_numpy_tree
+
+    tmp = tempfile.mkdtemp(prefix="edl-ci-tele-")
+    traces = os.path.join(tmp, "traces")
+    os.environ["EDL_MH_TRACE"] = traces
+    h = spawn_server(member_ttl_ms=3000, task_timeout_ms=4000)
+    client = CoordClient("127.0.0.1", h.port)
+    try:
+        outcome = run_elastic_worker(
+            client, "w0",
+            init_state=_tele_init_state,
+            train_world=functools.partial(
+                _tele_train_world, marker=os.path.join(tmp, "wedged"),
+                done_at=14, wedge_at=5),
+            save_state=save_numpy_tree, load_state=_tele_load_state,
+            ckpt_dir=tmp, settle_s=0.1, warm_spawn=False,
+            reform_grace_s=2.0, stall_floor_s=1.5, stall_k=6.0)
+        assert outcome.step == 14, outcome
+        files = sorted(os.path.join(traces, f) for f in os.listdir(traces))
+        merged = Tracer.merge_files(files)
+        slices = [e for e in merged["traceEvents"] if e.get("ph") == "X"]
+        roots = [e for e in slices if e["name"] == "reform"]
+        assert len(roots) >= 2, [e["name"] for e in slices]
+        phases = {"world_start.spawn_imports",
+                  "world_start.coordinator_handshake",
+                  "world_start.device_acquire", "world_start.restore"}
+        for root in roots:
+            tid = root["args"]["trace_id"]
+            names = {e["name"] for e in slices
+                     if e["args"].get("trace_id") == tid}
+            assert phases <= names, (tid, names)
+        assert any(f.startswith("flightrec-") and "stall" in f
+                   for f in os.listdir(tmp)), os.listdir(tmp)
+        print("reform span tree OK:", len(roots), "roots,",
+              len(slices), "spans")
+    finally:
+        client.close()
+        h.stop()
+
+if __name__ == "__main__":
+    main()
+EOF
+JAX_PLATFORMS=cpu python "$TELE_TMP/reform_span_smoke.py"
+rm -rf "$TELE_TMP"
+
 echo "CI OK"

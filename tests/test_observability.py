@@ -1,6 +1,12 @@
-"""Collector + tracing tests (reference example/collector.py behavior)."""
+"""Collector + tracing + unified-telemetry-plane tests.
+
+Collector behavior mirrors reference example/collector.py; the metrics
+registry / exposition / span-correlation tests cover the shared
+telemetry plane (observability/metrics.py + tracing span IDs).
+"""
 
 import io
+import re
 
 from edl_tpu.api.types import (
     RESOURCE_CPU, RESOURCE_MEMORY, RESOURCE_TPU,
@@ -9,6 +15,95 @@ from edl_tpu.api.types import (
 from edl_tpu.cluster.fake import FakeCluster
 from edl_tpu.observability.collector import Collector
 from edl_tpu.observability.tracing import Tracer
+
+
+# -- strict Prometheus text-format (0.0.4) parser ---------------------------
+#
+# The conformance oracle every process's /metrics is held to: metric-name
+# and label grammar, HELP/TYPE placement, histogram le-monotonicity and
+# the _sum/_count contract.  Deliberately strict — a scraper is.
+
+_METRIC_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>[^}]*)\})? "
+    r"(?P<value>[0-9eE+.\-]+|\+Inf|-Inf|NaN)$")
+_LABEL_RE = re.compile(
+    r'^(?P<k>[a-zA-Z_][a-zA-Z0-9_]*)="(?P<v>(?:[^"\\]|\\.)*)"$')
+
+
+def parse_prometheus(text: str) -> dict:
+    """Parse exposition text into {series_key: float}; raises
+    AssertionError on any grammar violation."""
+    series: dict[str, float] = {}
+    typed: dict[str, str] = {}
+    for line in text.splitlines():
+        if not line.strip():
+            continue
+        if line.startswith("# HELP "):
+            assert len(line.split(" ", 3)) >= 3, f"bad HELP: {line!r}"
+            continue
+        if line.startswith("# TYPE "):
+            parts = line.split(" ")
+            assert len(parts) >= 4, f"bad TYPE: {line!r}"
+            assert parts[3] in ("counter", "gauge", "histogram",
+                                "summary", "untyped"), line
+            assert parts[2] not in typed, f"duplicate TYPE for {parts[2]}"
+            typed[parts[2]] = parts[3]
+            continue
+        assert not line.startswith("#"), f"unknown comment: {line!r}"
+        m = _METRIC_RE.match(line)
+        assert m, f"malformed sample line: {line!r}"
+        labels = m.group("labels")
+        if labels:
+            for pair in _split_label_pairs(labels):
+                assert _LABEL_RE.match(pair), f"bad label {pair!r} in {line!r}"
+        key = m.group("name") + ("{" + labels + "}" if labels else "")
+        assert key not in series, f"duplicate series: {key}"
+        v = m.group("value")
+        series[key] = (float("inf") if v == "+Inf"
+                       else float("-inf") if v == "-Inf" else float(v))
+    # histogram contracts: buckets monotone in le AND in count; sum/count
+    for name, kind in typed.items():
+        if kind != "histogram":
+            continue
+        by_labels: dict[str, list[tuple[float, float]]] = {}
+        for key, v in series.items():
+            if not key.startswith(name + "_bucket"):
+                continue
+            lm = re.search(r'le="([^"]+)"', key)
+            assert lm, key
+            le = float("inf") if lm.group(1) == "+Inf" else float(lm.group(1))
+            rest = re.sub(r'le="[^"]+",?', "", key).rstrip(",{}")
+            by_labels.setdefault(rest, []).append((le, v))
+        for rest, buckets in by_labels.items():
+            buckets.sort()
+            assert buckets[-1][0] == float("inf"), f"{name}: no +Inf bucket"
+            counts = [c for _, c in buckets]
+            assert counts == sorted(counts), f"{name}: non-monotone buckets"
+    return series
+
+
+def _split_label_pairs(labels: str) -> list[str]:
+    """Split a label body on commas outside quoted values."""
+    out, cur, in_q, esc = [], "", False, False
+    for ch in labels:
+        if esc:
+            cur += ch
+            esc = False
+        elif ch == "\\":
+            cur += ch
+            esc = True
+        elif ch == '"':
+            cur += ch
+            in_q = not in_q
+        elif ch == "," and not in_q:
+            out.append(cur)
+            cur = ""
+        else:
+            cur += ch
+    if cur:
+        out.append(cur)
+    return out
 
 
 def _job(name, chips=1, lo=2, hi=4):
@@ -84,6 +179,26 @@ class TestCollector:
         out = io.StringIO()
         Collector(_cluster(), interval_s=0.0, out=out).run(max_samples=3)
         assert len(out.getvalue().strip().split("\n")) == 4  # header + 3
+
+    def test_deleted_job_series_pruned_from_metrics(self):
+        """A job that leaves the cluster must leave /metrics too — not
+        freeze at its last trainer count forever."""
+        from edl_tpu.observability.metrics import MetricsRegistry
+
+        reg = MetricsRegistry()
+        c = _cluster()
+        job = _job("ephemeral")
+        c.create_resources(job)
+        c.reconcile()
+        col = Collector(c, out=io.StringIO(), registry=reg)
+        col.run_once()
+        g = reg.gauge("cluster_running_trainers")
+        assert g.value(job="default/ephemeral") == 2
+        c.delete_resources(job)
+        c.reconcile()
+        col.run_once()
+        assert 'job="default/ephemeral"' not in reg.render()
+        assert reg.gauge("cluster_submitted_jobs").value() == 0
 
 
 class TestTracer:
@@ -333,3 +448,210 @@ class TestCounters:
         for t in threads:
             t.join()
         assert c.get("hot", type="t") == 8000
+
+
+class TestMetricsRegistry:
+    """The unified plane: one registry, Prometheus exposition, and the
+    Counters facade absorbed into it."""
+
+    def test_counter_gauge_histogram_render_conform(self):
+        from edl_tpu.observability.metrics import MetricsRegistry
+
+        r = MetricsRegistry()
+        r.counter("faults_injected", help="chaos injections").inc(
+            2, type="kill_trainer")
+        r.counter("faults_injected").inc(type="network_flake")
+        r.gauge("queue_depth").set(7, state="todo")
+        h = r.histogram("world_start_phase_seconds")
+        h.observe(0.004, phase="restore")
+        h.observe(2.0, phase="restore")
+        h.observe(200.0, phase="restore")  # beyond the last bucket
+        series = parse_prometheus(r.render())
+        assert series['edl_faults_injected_total{type="kill_trainer"}'] == 2
+        assert series['edl_queue_depth{state="todo"}'] == 7
+        assert series[
+            'edl_world_start_phase_seconds_bucket'
+            '{phase="restore",le="+Inf"}'] == 3
+        assert series[
+            'edl_world_start_phase_seconds_count{phase="restore"}'] == 3
+        assert abs(series[
+            'edl_world_start_phase_seconds_sum{phase="restore"}']
+            - 202.004) < 1e-6
+
+    def test_counters_facade_lands_in_registry(self):
+        from edl_tpu.observability.collector import get_counters
+        from edl_tpu.observability.metrics import get_registry
+
+        get_counters().inc("telemetry_probe", kind="facade")
+        series = parse_prometheus(get_registry().render())
+        assert series['edl_telemetry_probe_total{kind="facade"}'] >= 1
+
+    def test_gauge_fn_families_and_failures_skipped(self):
+        from edl_tpu.observability.metrics import MetricsRegistry
+
+        r = MetricsRegistry()
+        r.gauge_fn("coord_queue_tasks", lambda: 3, state="todo")
+        r.gauge_fn("coord_queue_tasks", lambda: 1, state="leased")
+        r.gauge_fn("boom", lambda: 1 / 0)
+        series = parse_prometheus(r.render())
+        assert series['edl_coord_queue_tasks{state="todo"}'] == 3
+        assert series['edl_coord_queue_tasks{state="leased"}'] == 1
+        assert not any("boom" in k for k in series)
+
+    def test_name_and_label_sanitization(self):
+        from edl_tpu.observability.metrics import MetricsRegistry
+
+        r = MetricsRegistry()
+        r.counter("weird-name.with spaces").inc(**{"label": 'va"l\\ue'})
+        parse_prometheus(r.render())  # the strict parser IS the assertion
+
+    def test_type_collision_raises(self):
+        import pytest
+
+        from edl_tpu.observability.metrics import MetricsRegistry
+
+        r = MetricsRegistry()
+        r.counter("x")
+        with pytest.raises(TypeError):
+            r.gauge("x")
+
+    def test_histogram_quantile_bucket(self):
+        from edl_tpu.observability.metrics import MetricsRegistry
+
+        h = MetricsRegistry().histogram("lat", buckets=(0.1, 1.0, 10.0))
+        assert h.quantile_bucket(0.5) is None
+        for v in (0.05, 0.05, 0.5, 5.0):
+            h.observe(v)
+        assert h.quantile_bucket(0.5) == 0.1
+        assert h.quantile_bucket(0.99) == 10.0
+
+
+class TestMetricsRoute:
+    """Every process that serves /healthz now serves /metrics from the
+    shared registry on the same port."""
+
+    def test_metrics_route_serves_registry(self):
+        import urllib.request
+
+        from edl_tpu.observability.collector import get_counters
+        from edl_tpu.observability.health import serve_health
+
+        get_counters().inc("route_probe")
+        srv = serve_health(0, {"ok": lambda: True}, host="127.0.0.1")
+        try:
+            port = srv.server_address[1]
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics", timeout=5) as r:
+                assert r.status == 200
+                assert "version=0.0.4" in r.headers["Content-Type"]
+                series = parse_prometheus(r.read().decode())
+            assert series["edl_route_probe_total"] >= 1
+        finally:
+            srv.shutdown()
+
+    def test_metrics_route_private_registry(self):
+        import urllib.request
+
+        from edl_tpu.observability.health import serve_health
+        from edl_tpu.observability.metrics import MetricsRegistry
+
+        reg = MetricsRegistry()
+        reg.gauge("only_here").set(42)
+        srv = serve_health(0, {"ok": lambda: True}, host="127.0.0.1",
+                           registry=reg)
+        try:
+            port = srv.server_address[1]
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics", timeout=5) as r:
+                series = parse_prometheus(r.read().decode())
+            assert series == {"edl_only_here": 42.0}
+        finally:
+            srv.shutdown()
+
+
+class TestSpanCorrelation:
+    """Span IDs, trace propagation, and the cross-process merge."""
+
+    def test_span_ids_and_parenting(self):
+        from edl_tpu.observability.tracing import Tracer
+
+        t = Tracer()
+        with t.root_span("reform", epoch=3) as root:
+            assert root.trace_id and root.span_id
+            with t.span("plan", category="reform",
+                        parent_id=root.span_id) as child:
+                pass
+        evs = {e.name: e for e in t.events()}
+        assert evs["plan"].parent_id == root.span_id
+        assert evs["plan"].trace_id == root.trace_id
+        assert evs["reform"].span_id == root.span_id
+        assert evs["reform"].parent_id is None
+
+    def test_root_span_env_propagation_and_restore(self):
+        import os
+
+        from edl_tpu.observability.tracing import Tracer, current_trace_id
+
+        t = Tracer()
+        prev = os.environ.get("EDL_TRACE_ID")
+        with t.root_span("resize") as root:
+            assert os.environ["EDL_TRACE_ID"] == root.trace_id
+            assert current_trace_id() == root.trace_id
+        assert os.environ.get("EDL_TRACE_ID") == prev
+
+    def test_merge_files_aligns_and_separates_pids(self, tmp_path):
+        import json
+        import time as _time
+
+        from edl_tpu.observability.tracing import Tracer
+
+        a, b = Tracer(), Tracer()
+        with a.root_span("reform") as root:
+            tid = root.trace_id
+        b.record_span("world_start.restore", "reform",
+                      b.from_wall(_time.time() - 0.2),
+                      b.from_wall(_time.time()),
+                      trace_id=tid, parent_id=root.span_id)
+        pa, pb = str(tmp_path / "a.json"), str(tmp_path / "b.json")
+        a.dump(pa, process_name="supervisor")
+        b.dump(pb, process_name="child")
+        merged = Tracer.merge_files([pa, pb],
+                                    str(tmp_path / "merged.json"))
+        doc = json.loads((tmp_path / "merged.json").read_text())
+        assert doc == merged
+        slices = [e for e in merged["traceEvents"] if e.get("ph") == "X"]
+        assert {e["pid"] for e in slices} == {0, 1}
+        assert {e["args"]["trace_id"] for e in slices} == {tid}
+        child = next(e for e in slices
+                     if e["name"] == "world_start.restore")
+        assert child["args"]["parent_id"] == root.span_id
+        # wall alignment: both events within a second of each other on
+        # the merged axis (they were recorded ~at the same wall time)
+        root_ev = next(e for e in slices if e["name"] == "reform")
+        assert abs(child["ts"] - root_ev["ts"]) < 5e6
+
+    def test_merge_files_anchorless_file_does_not_skew_base(self, tmp_path):
+        """A file without the edl wall anchor (pre-plane dump, foreign
+        chrome trace) merges at its raw timestamps; the anchored files
+        still align among themselves — not shifted by ~wall-epoch."""
+        import json
+
+        from edl_tpu.observability.tracing import Tracer
+
+        t = Tracer()
+        t.instant("anchored_event")
+        pa = str(tmp_path / "anchored.json")
+        t.dump(pa, process_name="anchored")
+        pb = str(tmp_path / "legacy.json")
+        (tmp_path / "legacy.json").write_text(json.dumps({
+            "traceEvents": [{"name": "legacy_event", "cat": "x",
+                             "ph": "i", "ts": 123.0, "dur": 0.0,
+                             "pid": 0, "tid": 0, "args": {}}]}))
+        merged = Tracer.merge_files([pa, pb])
+        by_name = {e["name"]: e for e in merged["traceEvents"]
+                   if e.get("ph") != "M"}
+        # legacy keeps raw ts; anchored file is base → shift ~0, so its
+        # ts stays clock-relative (perf_counter µs), nowhere near the
+        # wall epoch (~1.7e15 µs) the old min(0.0, anchor) bug produced
+        assert by_name["legacy_event"]["ts"] == 123.0
+        assert by_name["anchored_event"]["ts"] < 1e14

@@ -225,6 +225,63 @@ def test_constructor_validation():
         StallWatchdog(alpha=0.0)
 
 
+def test_flight_record_dumped_on_injected_stall(tmp_path):
+    """The post-mortem contract: the FIRST breach of a silence drops one
+    flightrec-*.json carrying the trace ring, the counters and the
+    rendered metrics — and repeated checks of the same stall don't spam
+    more records."""
+    import json
+    import os
+
+    from edl_tpu.observability.collector import get_counters
+    from edl_tpu.observability.tracing import get_tracer
+
+    get_tracer().instant("pre_stall_marker", category="chaos", step=5)
+    get_counters().inc("flight_probe")
+    clock = FakeClock()
+    wd = make_wd(clock, floor_s=1.0, scope="flight-test",
+                 flight_dir=str(tmp_path))
+    wd.beat(5)
+    clock.advance(10.0)  # injected stall: silence far past the floor
+    stall = wd.check()
+    assert stall is not None
+    recs = [f for f in os.listdir(tmp_path) if f.startswith("flightrec-")]
+    assert len(recs) == 1, recs
+    doc = json.loads((tmp_path / recs[0]).read_text())
+    assert doc["reason"] == "stall-flight-test"
+    assert doc["extra"]["step"] == 5
+    assert doc["extra"]["silent_s"] >= 1.0
+    assert doc["counters"].get("flight_probe", 0) >= 1
+    assert "edl_flight_probe_total" in doc["metrics_text"]
+    assert any(e["name"] == "pre_stall_marker"
+               for e in doc["trace_events"])
+    # same silence, second check: no second record (one stall = one dump)
+    clock.advance(5.0)
+    assert wd.check() is None
+    assert len([f for f in os.listdir(tmp_path)
+                if f.startswith("flightrec-")]) == 1
+    # recovery then a NEW stall dumps again (the 15 s beat gap fed the
+    # EWMA, so the deadline is now k×15 — advance past it)
+    wd.beat(6)
+    clock.advance(100.0)
+    assert wd.check() is not None
+    assert len([f for f in os.listdir(tmp_path)
+                if f.startswith("flightrec-")]) == 2
+
+
+def test_flight_record_disabled_by_default_env(tmp_path, monkeypatch):
+    """No EDL_FLIGHTREC_DIR and no flight_dir → no dump (the recorder is
+    opt-in for bare watchdogs; the multihost supervisor opts in with its
+    ckpt dir)."""
+    monkeypatch.delenv("EDL_FLIGHTREC_DIR", raising=False)
+    clock = FakeClock()
+    wd = make_wd(clock, floor_s=1.0)
+    assert wd.flight_dir == ""
+    wd.beat()
+    clock.advance(5.0)
+    assert wd.check() is not None  # detection itself unaffected
+
+
 def test_per_test_alarm_guard_interrupts_a_hang():
     """The suite-level tripwire (tests/conftest.py): a hung test body is
     interrupted by SIGALRM with a named TestTimeout instead of eating
