@@ -792,6 +792,97 @@ def long_context_leg() -> dict:
 # Leg 3: elastic grow→contend→shrink with a live model (subprocess, CPU mesh)
 # ---------------------------------------------------------------------------
 
+def _collectives_of(trainer) -> dict | None:
+    """Per-axis collective census of the trainer's live compiled step
+    (None when the bundle has no AOT executable to inspect)."""
+    compiled = getattr(trainer, "_compiled_step", None)
+    if compiled is None:
+        return None
+    try:
+        from edl_tpu.parallel.replan import collective_stats
+
+        return collective_stats(compiled, trainer.mesh)
+    except Exception as exc:  # census is evidence, never a leg failure
+        return {"error": str(exc)[:120]}
+
+
+def reparallel_leg() -> dict:
+    """Dynamic reparallelization measured: a live dp×fsdp shape walk
+    (4,1)→(2,2)→(4,1) on 4 CPU devices through the transactional resize,
+    recording per resize the transfer plan (bytes_moved vs the
+    gather-scatter bound), the replan/compile/reshard split, and the
+    compiled step's per-axis collective counts — the PR 6 headline
+    numbers (ROADMAP open item #1, Tenplex arxiv 2312.05181)."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    import optax
+
+    from edl_tpu.models import mlp
+    from edl_tpu.parallel.mesh import MeshShape, MeshSpec
+    from edl_tpu.runtime.elastic import ElasticTrainer
+
+    rng = np.random.default_rng(0)
+    centers = rng.normal(size=(4, 16)) * 3
+    y = rng.integers(0, 4, size=2048).astype(np.int32)
+    x = (centers[y] + rng.normal(size=(2048, 16))).astype(np.float32)
+    batch = lambda i: (x[(i * 64) % 1984:(i * 64) % 1984 + 64],  # noqa: E731
+                       y[(i * 64) % 1984:(i * 64) % 1984 + 64])
+
+    params = mlp.init(jax.random.key(0), [16, 64, 4])
+    t = ElasticTrainer(mlp.loss_fn, params, optax.adam(1e-2),
+                       spec=MeshSpec(dp=-1), param_sharding="fsdp",
+                       initial_world_size=4)
+    losses = [t.step(batch(0))]  # warm-up: compile + teach batch shape
+
+    walk = [MeshShape(dp=2, fsdp=2), MeshShape(dp=4)]
+    events = []
+    continuity = []
+    for step_idx, shape in enumerate(walk, start=1):
+        t.prewarm([shape], wait=True)  # the hint pipeline's head start
+        pre = t.eval_loss((x[:256], y[:256]))
+        t0 = time.perf_counter()
+        assert t.resize(shape), f"resize to {shape.describe()} failed"
+        wall_ms = (time.perf_counter() - t0) * 1000
+        # drift across the resize ALONE (before any step moves params):
+        # a re-split is a layout change, so this must be ~0
+        post = t.eval_loss((x[:256], y[:256]))
+        continuity.append(abs(post - pre))
+        t0 = time.perf_counter()
+        losses.append(t.step(batch(step_idx)))
+        wall_ms += (time.perf_counter() - t0) * 1000
+        evt = dict(t.resize_events[-1])
+        evt["wall_ms_with_first_step"] = round(wall_ms, 2)
+        evt["collectives"] = _collectives_of(t)
+        events.append(evt)
+        assert evt["bytes_moved"] < evt["bytes_naive"], evt
+    for i in range(3, 20):
+        losses.append(t.step(batch(i)))
+
+    from edl_tpu.observability.collector import get_counters
+
+    return {
+        "device_count": 4,
+        "walk": ["dp4"] + [s.describe() for s in walk],
+        "resizes": t.resizes,
+        "resizes_failed": t.resizes_failed,
+        "resize_events": events,
+        "bytes_moved": [e["bytes_moved"] for e in events],
+        "bytes_naive": [e["bytes_naive"] for e in events],
+        "replan_ms": [e["replan_ms"] for e in events],
+        "reshard_ms": [e["reshard_ms"] for e in events],
+        "prewarm_hits": sum(int(e["prewarm_hit"]) for e in events),
+        # state survives every re-split bit-exactly → eval drift is zero
+        "eval_drift_at_resizes": [round(c, 9) for c in continuity],
+        "loss_continuous": bool(all(c < 1e-4 for c in continuity)),
+        "final_loss": float(losses[-1]),
+        "learned": bool(np.mean(losses[-5:]) < np.mean(losses[:5])),
+        "reshard_host_fallbacks": get_counters().get(
+            "reshard_host_fallbacks"),
+    }
+
+
 def elastic_leg() -> dict:
     """The BOSS trace executed by the real elastic runtime: submit an
     elastic job, let the autoscaler grow it to max, inject a competing
@@ -845,8 +936,13 @@ def elastic_leg() -> dict:
     params = mlp.init(jax.random.key(0), [16, 64, 4])
     trainer = ElasticTrainer(mlp.loss_fn, params, optax.adam(1e-2),
                              spec=MeshSpec(dp=-1), initial_world_size=2)
+    # deferral budget sized for THIS leg's compile times (~0.5 s CPU
+    # meshes), not the 30 s TPU default: on a loaded host a background
+    # compile can starve behind the 2 ms step cadence for the whole
+    # ~2 s run, and an unexpiring budget turns every resize into a
+    # deferral — the leg must commit its resizes to measure them
     runner = LocalElasticJob(job, cluster, trainer, coord, reg.fetch,
-                             batch_size=64)
+                             batch_size=64, resize_defer_s=0.5)
     # Speculative prewarm, both feeds (PR 3): the autoscaler's plan hints
     # fire the compile the moment a new parallelism is DECIDED (before
     # pods move), and the runner's neighbor policy covers anything the
@@ -1002,6 +1098,13 @@ def elastic_leg() -> dict:
         # records no split and is not a speculation verdict)
         "prewarm_misses": len(report.resize_compile_ms)
         - report.prewarm_hits,
+        # the reparallelization record (PR 6): how long each resize's
+        # transfer plan took and how many bytes it priced as moving —
+        # plus the compiled step's collective census per mesh axis, so a
+        # layout that silently over-communicates shows in the artifact
+        "resize_replan_ms": [round(v, 3) for v in report.resize_replan_ms],
+        "resize_bytes_moved": [int(v) for v in report.resize_bytes_moved],
+        "collectives_per_axis": _collectives_of(trainer),
         # steps trained on the old world while the new one's bundle was
         # still compiling (zero-stall deferral instead of blocking)
         "resize_deferred_steps": report.resize_deferred_steps,
@@ -1455,6 +1558,15 @@ def main() -> None:
                    "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
                    "PALLAS_AXON_POOL_IPS": ""})
 
+    # dynamic reparallelization: the live dp×fsdp shape walk with the
+    # minimal-transfer plan record (CPU mesh — it is a plan/latency
+    # number, not a throughput number)
+    reparallel = _run_leg(
+        "reparallel", timeout_s=300,
+        extra_env={"JAX_PLATFORMS": "cpu",
+                   "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+                   "PALLAS_AXON_POOL_IPS": ""})
+
     # real world-reform latency (CPU mesh — it is a latency, not a
     # throughput number).  Outer timeout exceeds the leg's summed inner
     # deadlines (~510 s worst case) so its finally-cleanup always runs —
@@ -1493,7 +1605,8 @@ def main() -> None:
         "vs_baseline_note": "simulated packing vs reference live demo",
         "detail": {"scheduler": sched, "throughput": tput,
                    "large": large, "long_context": long_ctx,
-                   "model_zoo": zoo, "elastic": elastic, "reform": reform,
+                   "model_zoo": zoo, "elastic": elastic,
+                   "reparallel": reparallel, "reform": reform,
                    "tpu_world_cycle": tpu_cycle},
     }
     print(json.dumps(result))
@@ -1537,6 +1650,15 @@ def main() -> None:
         "elastic_resize_reshard_ms_mean":
             elastic.get("resize_reshard_ms_mean"),
         "elastic_prewarm_hits": elastic.get("prewarm_hits"),
+        "elastic_bytes_moved": elastic.get("resize_bytes_moved"),
+        "elastic_replan_ms": elastic.get("resize_replan_ms"),
+        # the reparallelization headline: a live dp×fsdp re-split's
+        # planned transfer vs the gather-scatter bound it beat
+        "reparallel_walk": reparallel.get("walk"),
+        "reparallel_bytes_moved": reparallel.get("bytes_moved"),
+        "reparallel_bytes_naive": reparallel.get("bytes_naive"),
+        "reparallel_replan_ms": reparallel.get("replan_ms"),
+        "reparallel_loss_continuous": reparallel.get("loss_continuous"),
         "ckpt_pause_p50_ms": elastic.get("ckpt_pause_p50_ms"),
         "ckpt_pause_p99_ms": elastic.get("ckpt_pause_p99_ms"),
         "ckpt_pause_p99_vs_sync_pct":
@@ -1574,6 +1696,8 @@ if __name__ == "__main__":
             out = model_zoo_leg()
         elif leg == "elastic":
             out = elastic_leg()
+        elif leg == "reparallel":
+            out = reparallel_leg()
         elif leg == "reform":
             out = reform_latency_leg()
         elif leg == "tpu_world_cycle":

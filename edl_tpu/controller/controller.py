@@ -46,6 +46,7 @@ class Controller:
         updater_confirm_seconds: float = 5.0,
         resize_cooldown_s: float = 0.0,
         min_resize_delta: int = 1,
+        mesh_shape_for=None,
     ) -> None:
         self.cluster = cluster
         self.autoscaler = Autoscaler(
@@ -55,6 +56,7 @@ class Controller:
             loop_seconds=autoscaler_loop_seconds,
             resize_cooldown_s=resize_cooldown_s,
             min_resize_delta=min_resize_delta,
+            mesh_shape_for=mesh_shape_for,
         )
         self._updater_convert_seconds = updater_convert_seconds
         self._updater_confirm_seconds = updater_confirm_seconds

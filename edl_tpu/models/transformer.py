@@ -40,7 +40,7 @@ from edl_tpu.ops.flash_attention import attention as flash_attention
 def _maybe_constrain(x: jax.Array, spec: P) -> jax.Array:
     """with_sharding_constraint iff a mesh context is active — the model
     works unchanged single-device and sharded."""
-    from jax.sharding import get_abstract_mesh
+    from edl_tpu.parallel.compat import get_abstract_mesh
 
     mesh = get_abstract_mesh()
     if mesh is None or mesh.empty:
@@ -248,7 +248,7 @@ def _attention_block(p: dict, x: jax.Array, angles: jax.Array,
     # GQA: the flash path takes the UNREPEATED kv heads (the kernel maps
     # each kv head to its query group — the repeat never hits HBM); the
     # ring path still wants matched heads.
-    from jax.sharding import get_abstract_mesh
+    from edl_tpu.parallel.compat import get_abstract_mesh
 
     mesh = get_abstract_mesh()
     if (mesh is not None and not mesh.empty

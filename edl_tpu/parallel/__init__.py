@@ -9,17 +9,31 @@ change.
 """
 
 from edl_tpu.parallel.mesh import (
+    MeshShape,
     MeshSpec,
     make_mesh,
     dp_sharding,
     replicated,
     fsdp_sharding,
 )
+from edl_tpu.parallel.replan import (
+    ReshardPlan,
+    choose_shape,
+    collective_stats,
+    plan_reshard,
+    propose_shape,
+)
 
 __all__ = [
+    "MeshShape",
     "MeshSpec",
     "make_mesh",
     "dp_sharding",
     "replicated",
     "fsdp_sharding",
+    "ReshardPlan",
+    "choose_shape",
+    "collective_stats",
+    "plan_reshard",
+    "propose_shape",
 ]

@@ -1,0 +1,97 @@
+"""jax version compatibility: shard_map / ambient-mesh API.
+
+The framework is written against the current jax surface (top-level
+``jax.shard_map``, ``jax.set_mesh``, ``jax.sharding.get_abstract_mesh``)
+but must also run on the older jax baked into some worker images, where
+the same machinery lives under ``jax.experimental.shard_map`` and the
+ambient mesh is the legacy ``with mesh:`` thread-resources context.  All
+mesh-context access in this repo goes through the three names below, so
+a jax upgrade (or downgrade) is a no-op for the rest of the codebase:
+
+* :func:`shard_map` — the modern keyword signature (``mesh=`` optional
+  under an ambient mesh, ``check_vma=``), mapped onto the experimental
+  API (``check_rep``, mandatory mesh) when the top-level export is
+  missing.
+* :func:`get_abstract_mesh` — the ambient mesh, or None when no mesh
+  context is active (old jax returns a bare ``()`` sentinel; callers
+  here always get ``None``-or-AbstractMesh).
+* :func:`set_mesh` — context manager establishing the ambient mesh.  On
+  old jax this enters BOTH legacy contexts (``thread_resources`` for
+  ``with_sharding_constraint(x, PartitionSpec)`` and the abstract mesh
+  for shard_map/ring-attention routing), which together reproduce the
+  modern ``jax.set_mesh`` semantics the models and the multichip dryrun
+  rely on.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+try:  # modern jax: top-level export, ambient-mesh aware
+    from jax import shard_map  # type: ignore[attr-defined]
+
+    _LEGACY = False
+except ImportError:  # this container's jax: experimental module
+    from jax.experimental.shard_map import shard_map as _exp_shard_map
+
+    _LEGACY = True
+
+try:
+    from jax.sharding import get_abstract_mesh as _get_abstract_mesh
+
+    def get_abstract_mesh():
+        return _get_abstract_mesh()
+
+except ImportError:
+    from jax._src import mesh as _src_mesh
+
+    def get_abstract_mesh():
+        am = _src_mesh.get_abstract_mesh()
+        # old jax's default "no mesh" value is an empty tuple, not an
+        # (empty) AbstractMesh — normalize to None so callers can use
+        # ``mesh is None or mesh.empty`` on every version
+        if not isinstance(am, _src_mesh.AbstractMesh):
+            return None
+        return am
+
+
+if _LEGACY:
+
+    def shard_map(f, mesh=None, *, in_specs, out_specs,  # noqa: F811
+                  check_vma=None, **kwargs):
+        """Modern-signature shard_map over the experimental implementation.
+
+        ``check_vma`` (varying-mesh-axes checking) is the renamed
+        ``check_rep``; ``mesh=None`` resolves the ambient mesh the way
+        the modern API does."""
+        if check_vma is not None:
+            kwargs.setdefault("check_rep", check_vma)
+        if mesh is None:
+            mesh = get_abstract_mesh()
+            if mesh is None or mesh.empty:
+                raise ValueError(
+                    "shard_map called with no mesh and no ambient mesh "
+                    "context (use edl_tpu.parallel.compat.set_mesh)")
+        return _exp_shard_map(f, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs, **kwargs)
+
+
+def set_mesh(mesh):
+    """Context manager: make ``mesh`` the ambient mesh (all jax versions)."""
+    if hasattr(jax, "set_mesh"):
+        return jax.set_mesh(mesh)
+    from jax._src import mesh as _src_mesh
+
+    @contextlib.contextmanager
+    def _legacy_cm():
+        # thread_resources feeds with_sharding_constraint(x, PartitionSpec);
+        # the abstract mesh feeds shard_map and the models' mesh routing
+        with mesh, _src_mesh.set_abstract_mesh(mesh.abstract_mesh):
+            yield mesh
+
+    return _legacy_cm()
+
+
+__all__ = ["shard_map", "get_abstract_mesh", "set_mesh"]

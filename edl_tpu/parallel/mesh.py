@@ -74,6 +74,87 @@ class MeshSpec:
         return sizes
 
 
+@dataclass(frozen=True)
+class MeshShape:
+    """A fully *resolved* mesh shape: concrete size per axis, no wildcards.
+
+    :class:`MeshSpec` is the elastic *policy* ("dp absorbs the rest");
+    MeshShape is one concrete point in that space — the unit the
+    reparallelization engine plans between, the resize cache keys on, and
+    the autoscaler hints with.  Unlike a spec, two equal MeshShapes always
+    describe the same physical layout, so they are safely hashable cache
+    keys and comparable across the control plane."""
+
+    dp: int = 1
+    fsdp: int = 1
+    tp: int = 1
+    sp: int = 1
+    ep: int = 1
+
+    def __post_init__(self):
+        for a, s in self.axis_sizes().items():
+            if not isinstance(s, int) or s < 1:
+                raise ValueError(f"MeshShape axis {a} must be a positive "
+                                 f"int, got {s!r} (specs, not shapes, may "
+                                 "carry -1 wildcards)")
+
+    @property
+    def size(self) -> int:
+        n = 1
+        for s in self.axis_sizes().values():
+            n *= s
+        return n
+
+    def axis_sizes(self) -> dict[str, int]:
+        return {
+            AXIS_DP: self.dp,
+            AXIS_FSDP: self.fsdp,
+            AXIS_TP: self.tp,
+            AXIS_SP: self.sp,
+            "ep": self.ep,
+        }
+
+    def key(self) -> tuple:
+        """Canonical hashable form: ((axis, size), ...) in axis order."""
+        return tuple(self.axis_sizes().items())
+
+    def to_spec(self) -> MeshSpec:
+        return MeshSpec(dp=self.dp, fsdp=self.fsdp, tp=self.tp,
+                        sp=self.sp, ep=self.ep)
+
+    def describe(self) -> str:
+        """Compact human form, non-unit axes only: ``dp2xfsdp2``."""
+        parts = [f"{a}{s}" for a, s in self.axis_sizes().items() if s > 1]
+        return "x".join(parts) or "1"
+
+    @classmethod
+    def of_mesh(cls, mesh: Mesh) -> "MeshShape":
+        sizes = {a: mesh.shape.get(a, 1) for a in
+                 (AXIS_DP, AXIS_FSDP, AXIS_TP, AXIS_SP, "ep")}
+        return cls(dp=sizes[AXIS_DP], fsdp=sizes[AXIS_FSDP],
+                   tp=sizes[AXIS_TP], sp=sizes[AXIS_SP], ep=sizes["ep"])
+
+    @classmethod
+    def resolve(cls, target, n_devices: Optional[int] = None,
+                spec: Optional[MeshSpec] = None) -> "MeshShape":
+        """Normalize any resize target to a concrete shape.
+
+        ``target`` may be a MeshShape (returned as-is), a MeshSpec
+        (resolved over ``n_devices``), or an int world size (resolved
+        through ``spec`` — the legacy pure-wildcard path, so existing
+        ``resize(n)`` callers keep bit-identical behavior)."""
+        if isinstance(target, cls):
+            return target
+        if isinstance(target, MeshSpec):
+            if n_devices is None:
+                raise ValueError("resolving a MeshSpec needs n_devices")
+            return cls(**target.resolve(n_devices))
+        n = int(target)
+        sizes = (spec or MeshSpec(dp=-1)).resolve(n)
+        return cls(dp=sizes[AXIS_DP], fsdp=sizes[AXIS_FSDP],
+                   tp=sizes[AXIS_TP], sp=sizes[AXIS_SP], ep=sizes["ep"])
+
+
 def make_mesh(
     n_devices: Optional[int] = None,
     spec: Optional[MeshSpec] = None,

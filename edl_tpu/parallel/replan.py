@@ -1,0 +1,437 @@
+"""Reparallelization planning: minimal-transfer reshard plans between
+mesh *shapes*, not just mesh sizes.
+
+A resize used to mean "same dp-dominant layout, N′ chips" — the split of
+the dp×fsdp×sp axes could never change mid-run, and every commit moved
+state through a generic ``device_put`` with no account of what actually
+had to move.  This module treats model/optimizer state as a
+parallelizable tensor collection (Tenplex, arxiv 2312.05181): given the
+old mesh + per-leaf shardings and a new device set + shape, it computes a
+per-leaf **transfer plan** —
+
+* ``bytes_stay``  — shard bytes already resident on the right device,
+* ``bytes_ici``   — bytes that must move, but whose source shard lives on
+  a device of the *new* mesh (a device-to-device hop over the fabric),
+* ``bytes_dcn``   — bytes whose only sources are devices leaving the mesh
+  (the cross-slice / host-path residue),
+* ``bytes_naive`` — the all-gather-then-scatter bound a checkpoint
+  round-trip (or shape-blind reshard) would pay,
+
+— and, when the target shape is unconstrained, picks the axis assignment
+that minimizes the planned transfer (ElasWave's hybrid-parallel resize,
+arxiv 2510.00606).  The accounting is exact for NamedShardings: a
+sharding partitions every leaf into a grid of per-axis blocks, so
+overlap volumes are products of per-dimension interval intersections and
+coverage sums over grid cells never double-count.
+
+Execution stays with the runtime (``jax.device_put`` with the new
+shardings moves exactly the planned bytes device-to-device); the plan is
+the *accounting and the choice*, recorded per resize as ``replan_ms`` /
+``bytes_moved`` so a layout decision is an audited fact.
+
+Also here: :func:`collective_stats`, which parses a compiled step's HLO
+and attributes every collective (all-reduce / all-gather / reduce-scatter
+/ collective-permute / all-to-all) to the mesh axes its replica groups
+span, with payload bytes — the machine-check behind the multichip
+dryrun's "expected collectives per axis" assertion and the bench's
+per-resize communication record.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from dataclasses import dataclass, field
+from typing import Any, Optional, Sequence
+
+import jax
+import numpy as np
+
+from edl_tpu.parallel.mesh import (
+    MeshShape,
+    MeshSpec,
+    dp_sharding,
+    make_mesh,
+    tree_shardings,
+)
+
+# -- block arithmetic --------------------------------------------------------
+
+
+def _norm_block(idx: tuple, shape: tuple) -> tuple:
+    """devices_indices_map slices → ((start, stop), ...) per dim."""
+    out = []
+    for sl, dim in zip(idx, shape):
+        start = 0 if sl.start is None else int(sl.start)
+        stop = dim if sl.stop is None else int(sl.stop)
+        out.append((start, stop))
+    return tuple(out)
+
+
+def _vol(block: tuple) -> int:
+    v = 1
+    for a, b in block:
+        v *= max(b - a, 0)
+    return v
+
+
+def _overlap(b1: Optional[tuple], b2: Optional[tuple]) -> int:
+    if b1 is None or b2 is None:
+        return 0
+    v = 1
+    for (a1, s1), (a2, s2) in zip(b1, b2):
+        v *= max(min(s1, s2) - max(a1, a2), 0)
+        if v == 0:
+            return 0
+    return v
+
+
+# -- the plan ----------------------------------------------------------------
+
+
+@dataclass
+class LeafPlan:
+    """Transfer accounting for ONE pytree leaf."""
+
+    path: str
+    nbytes: int
+    bytes_stay: int
+    bytes_ici: int
+    bytes_dcn: int
+    bytes_naive: int
+
+    @property
+    def bytes_moved(self) -> int:
+        return self.bytes_ici + self.bytes_dcn
+
+
+@dataclass
+class ReshardPlan:
+    """The full-tree transfer plan for one (old layout) → (new layout)."""
+
+    old_shape: Optional[MeshShape]
+    new_shape: Optional[MeshShape]
+    leaves: list[LeafPlan] = field(default_factory=list)
+    #: resident bytes per NEW-mesh device id after the reshard — what the
+    #: memory-constrained shape chooser filters on
+    per_device_bytes: dict[int, int] = field(default_factory=dict)
+    #: plan computation wall time, stamped by the caller
+    replan_ms: float = 0.0
+
+    def _sum(self, attr: str) -> int:
+        return sum(getattr(l, attr) for l in self.leaves)
+
+    @property
+    def bytes_total(self) -> int:
+        return self._sum("nbytes")
+
+    @property
+    def bytes_stay(self) -> int:
+        return self._sum("bytes_stay")
+
+    @property
+    def bytes_ici(self) -> int:
+        return self._sum("bytes_ici")
+
+    @property
+    def bytes_dcn(self) -> int:
+        return self._sum("bytes_dcn")
+
+    @property
+    def bytes_moved(self) -> int:
+        return self.bytes_ici + self.bytes_dcn
+
+    @property
+    def bytes_naive(self) -> int:
+        return self._sum("bytes_naive")
+
+    @property
+    def max_device_bytes(self) -> int:
+        return max(self.per_device_bytes.values(), default=0)
+
+    def summary(self) -> dict:
+        """The per-resize record (resize_events / bench artifacts)."""
+        return {
+            "old_shape": self.old_shape.describe() if self.old_shape else None,
+            "new_shape": self.new_shape.describe() if self.new_shape else None,
+            "bytes_total": self.bytes_total,
+            "bytes_stay": self.bytes_stay,
+            "bytes_moved": self.bytes_moved,
+            "bytes_ici": self.bytes_ici,
+            "bytes_dcn": self.bytes_dcn,
+            "bytes_naive": self.bytes_naive,
+            "max_device_bytes": self.max_device_bytes,
+            "replan_ms": self.replan_ms,
+        }
+
+
+def _leaf_plan(path: str, leaf: Any, old_sh, new_sh,
+               new_ids: set) -> tuple[LeafPlan, dict[int, int]]:
+    shape = tuple(getattr(leaf, "shape", ()) or ())
+    dtype = np.dtype(getattr(leaf, "dtype", np.float32))
+    itemsize = dtype.itemsize
+    nbytes = itemsize * math.prod(shape) if shape else itemsize
+
+    old_map = {d.id: _norm_block(idx, shape)
+               for d, idx in old_sh.devices_indices_map(shape).items()}
+    new_map = {d.id: _norm_block(idx, shape)
+               for d, idx in new_sh.devices_indices_map(shape).items()}
+
+    # distinct grid cells of the OLD sharding held by devices that exist
+    # on the new mesh: any needed byte inside one of these can be fetched
+    # device-to-device; bytes outside are only on departing devices
+    held_cells = {old_map[i] for i in old_map if i in new_ids}
+
+    stay = ici = dcn = 0
+    scatter = 0
+    per_dev: dict[int, int] = {}
+    for dev_id, need in new_map.items():
+        need_elems = _vol(need)
+        need_b = need_elems * itemsize
+        per_dev[dev_id] = need_b
+        scatter += need_b
+        own = _overlap(need, old_map.get(dev_id))
+        # old cells partition the array, so summing per-cell overlaps
+        # inside `need` is exact coverage, never double-counted
+        covered = sum(_overlap(need, cell) for cell in held_cells)
+        stay += own * itemsize
+        ici += (covered - own) * itemsize
+        dcn += (need_elems - covered) * itemsize
+    # the shape-blind bound: gather one full copy, then send every new
+    # device its shard (what a checkpoint round-trip costs, ignoring disk)
+    naive = nbytes + scatter
+    return (LeafPlan(path=path, nbytes=nbytes, bytes_stay=stay,
+                     bytes_ici=ici, bytes_dcn=dcn, bytes_naive=naive),
+            per_dev)
+
+
+def plan_reshard(tree: Any, old_shardings: Any, new_shardings: Any,
+                 old_shape: Optional[MeshShape] = None,
+                 new_shape: Optional[MeshShape] = None) -> ReshardPlan:
+    """Compute the transfer plan for resharding ``tree`` (concrete arrays
+    or ShapeDtypeStructs — only shapes/dtypes are read) from
+    ``old_shardings`` to ``new_shardings`` (matching pytrees of
+    NamedSharding)."""
+    plan = ReshardPlan(old_shape=old_shape, new_shape=new_shape)
+    leaves = jax.tree_util.tree_leaves_with_path(tree)
+    old_leaves = jax.tree.leaves(old_shardings)
+    new_leaves = jax.tree.leaves(new_shardings)
+    if not new_leaves:
+        return plan
+    new_ids = {d.id for d in new_leaves[0].mesh.devices.flat}
+    for (path, leaf), old_sh, new_sh in zip(leaves, old_leaves, new_leaves):
+        lp, per_dev = _leaf_plan(jax.tree_util.keystr(path), leaf,
+                                 old_sh, new_sh, new_ids)
+        plan.leaves.append(lp)
+        for i, b in per_dev.items():
+            plan.per_device_bytes[i] = plan.per_device_bytes.get(i, 0) + b
+    return plan
+
+
+# -- shape choice ------------------------------------------------------------
+
+
+def candidate_shapes(n_devices: int,
+                     base: Optional[MeshShape] = None) -> list[MeshShape]:
+    """All dp×fsdp factorizations of ``n_devices`` (the axes the elastic
+    trainer re-splits live), inheriting the base shape's tp/sp/ep when
+    they divide the new world and resetting them to 1 otherwise."""
+    base = base or MeshShape()
+    fixed = base.tp * base.sp * base.ep
+    if fixed > 1 and n_devices % fixed == 0:
+        rem, tp, sp, ep = n_devices // fixed, base.tp, base.sp, base.ep
+    else:
+        rem, tp, sp, ep = n_devices, 1, 1, 1
+    out = []
+    for dp in range(1, rem + 1):
+        if rem % dp == 0:
+            out.append(MeshShape(dp=dp, fsdp=rem // dp, tp=tp, sp=sp, ep=ep))
+    return out
+
+
+def choose_shape(
+    tree: Any,
+    old_shardings: Any,
+    n_devices: int,
+    devices: Sequence[jax.Device],
+    sharding_kind: str = "fsdp",
+    candidates: Optional[Sequence[MeshShape]] = None,
+    max_bytes_per_device: Optional[int] = None,
+    base: Optional[MeshShape] = None,
+) -> tuple[MeshShape, ReshardPlan]:
+    """Pick the minimal-transfer axis assignment for an unconstrained
+    resize to ``n_devices``.
+
+    Evaluates a reshard plan per candidate shape (dp×fsdp factorizations
+    by default) against the live layout and returns the cheapest one.
+    Candidates whose post-reshard resident bytes would overflow
+    ``max_bytes_per_device`` are dropped first — this is the dp→fsdp
+    escape hatch for small worlds: when the replicated model no longer
+    fits one chip, the only surviving candidates shard it.  Ties prefer
+    the dp-dominant split (cheapest steady-state collectives: one grad
+    all-reduce, no param all-gathers)."""
+    cands = list(candidates) if candidates is not None else candidate_shapes(
+        n_devices, base=base)
+    scored: list[tuple[tuple, MeshShape, ReshardPlan]] = []
+    overflow: list[tuple[tuple, MeshShape, ReshardPlan]] = []
+    for shape in cands:
+        mesh = make_mesh(shape.size, shape.to_spec(), devices=devices)
+        new_sh = tree_shardings(mesh, tree, sharding_kind)
+        plan = plan_reshard(tree, old_shardings, new_sh,
+                            old_shape=None, new_shape=shape)
+        rank = (plan.bytes_moved, -shape.dp, shape.key())
+        if (max_bytes_per_device is not None
+                and plan.max_device_bytes > max_bytes_per_device):
+            overflow.append((rank, shape, plan))
+            continue
+        scored.append((rank, shape, plan))
+    if not scored:
+        if not overflow:
+            raise ValueError(f"no candidate shapes for {n_devices} devices")
+        # every split overflows the budget: least-overflowing wins (the
+        # caller asked for an impossible budget; shard as hard as we can)
+        overflow.sort(key=lambda t: (t[2].max_device_bytes, t[0]))
+        _, shape, plan = overflow[0]
+        return shape, plan
+    scored.sort(key=lambda t: t[0])
+    _, shape, plan = scored[0]
+    return shape, plan
+
+
+def propose_shape(n_devices: int, state_bytes: int,
+                  max_bytes_per_device: Optional[int] = None,
+                  base: Optional[MeshShape] = None) -> MeshShape:
+    """Control-plane shape proposal, no meshes required: pure-dp unless
+    replicating ``state_bytes`` per chip would overflow the budget, in
+    which case the smallest sufficient factor moves into fsdp.
+
+    This is what an autoscaler's ``mesh_shape_for`` hook calls at *plan*
+    time: shrinking a job below the world size where its state still
+    replicates must come with a layout change, hinted early enough for
+    the prewarm pipeline to compile the hybrid mesh before pods move."""
+    base = base or MeshShape()
+    fixed = base.tp * base.sp * base.ep
+    if fixed > 1 and n_devices % fixed == 0:
+        rem = n_devices // fixed
+        tp, sp, ep = base.tp, base.sp, base.ep
+    else:
+        rem, tp, sp, ep = n_devices, 1, 1, 1
+    for fsdp in sorted(d for d in range(1, rem + 1) if rem % d == 0):
+        # ceil, not floor: a chip really holds ceil(bytes/fsdp) — floor
+        # would bless an over-budget layout right at the boundary, the
+        # exact regime this OOM-escape hook exists for
+        if (max_bytes_per_device is None
+                or -(-state_bytes // fsdp) <= max_bytes_per_device):
+            return MeshShape(dp=rem // fsdp, fsdp=fsdp, tp=tp, sp=sp, ep=ep)
+    return MeshShape(dp=1, fsdp=rem, tp=tp, sp=sp, ep=ep)
+
+
+# -- compiled-HLO collective accounting --------------------------------------
+
+_COLLECTIVE_OPS = ("all-reduce", "all-gather", "reduce-scatter",
+                   "collective-permute", "all-to-all")
+_DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4, "s64": 8, "u64": 8, "f64": 8,
+    "c64": 8, "c128": 16,
+}
+_SHAPE_RE = re.compile(r"\b([a-z]+\d*)\[([\d,]*)\]")
+_GROUPS_RE = re.compile(r"replica_groups=\{(\{[\d,{}]*\})\}")
+_IOTA_RE = re.compile(
+    r"replica_groups=\[(\d+),(\d+)\]<=\[([\d,]+)\](?:T\(([\d,]+)\))?")
+_PAIRS_RE = re.compile(r"source_target_pairs=\{([\d,{}]*)\}")
+
+
+def _shape_bytes(result: str, async_start: bool = False) -> int:
+    sizes = []
+    for dt, dims in _SHAPE_RE.findall(result):
+        if dt not in _DTYPE_BYTES:
+            continue
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        sizes.append(n * _DTYPE_BYTES[dt])
+    if not sizes:
+        return 0
+    if async_start:
+        # a `-start` op's result tuple aliases the operand alongside the
+        # output (plus context scalars): summing would double-count the
+        # payload vs the sync lowering of the same program.  The output
+        # is the largest member (all-gather grows, permute preserves) —
+        # count that one.
+        return max(sizes)
+    return sum(sizes)
+
+
+def _parse_groups(line: str) -> list[tuple[int, ...]]:
+    m = _GROUPS_RE.search(line)
+    if m:
+        return [tuple(int(x) for x in g.split(",") if x)
+                for g in re.findall(r"\{([\d,]*)\}", m.group(1))]
+    m = _IOTA_RE.search(line)
+    if m:  # iota form: [rows,cols]<=[dims]T(perm)
+        rows, cols = int(m.group(1)), int(m.group(2))
+        dims = [int(x) for x in m.group(3).split(",")]
+        ids = np.arange(math.prod(dims)).reshape(dims)
+        if m.group(4):
+            ids = ids.transpose([int(x) for x in m.group(4).split(",")])
+        return [tuple(int(x) for x in row)
+                for row in ids.reshape(rows, cols)]
+    m = _PAIRS_RE.search(line)
+    if m:  # collective-permute: each (src, dst) pair is a 2-group
+        return [tuple(int(x) for x in g.split(",") if x)
+                for g in re.findall(r"\{([\d,]*)\}", m.group(1))]
+    return []
+
+
+def _axes_of_groups(groups: list[tuple[int, ...]], mesh) -> str:
+    """Attribute replica groups to the mesh axes their members vary on."""
+    coords: dict[int, tuple[int, ...]] = {}
+    for c in np.ndindex(mesh.devices.shape):
+        coords[mesh.devices[c].id] = c
+    axes: set[int] = set()
+    for g in groups:
+        known = [coords[i] for i in g if i in coords]
+        if len(known) < 2:
+            continue
+        ref = known[0]
+        for other in known[1:]:
+            axes.update(d for d in range(len(ref)) if other[d] != ref[d])
+    if not axes:
+        return "none"
+    names = list(mesh.axis_names)
+    return "+".join(names[d] for d in sorted(axes))
+
+
+def collective_stats(compiled_or_text: Any, mesh) -> dict:
+    """Per-mesh-axis collective census of a compiled executable.
+
+    Returns ``{axis_label: {"ops": {op_name: count}, "bytes": int}}``
+    where ``axis_label`` is the mesh axis (or ``"a+b"`` combination) the
+    op's replica groups span and ``bytes`` sums result payload sizes —
+    the per-step communication volume attributable to that axis."""
+    txt = (compiled_or_text if isinstance(compiled_or_text, str)
+           else compiled_or_text.as_text())
+    out: dict[str, dict] = {}
+    for line in txt.splitlines():
+        m = re.search(
+            r"=\s*(.*?)\s+(all-reduce|all-gather|reduce-scatter|"
+            r"collective-permute|all-to-all)(-start)?(?:\.\d+)?\(", line)
+        if m is None:
+            continue
+        result, op, started = m.group(1), m.group(2), bool(m.group(3))
+        axis = _axes_of_groups(_parse_groups(line), mesh)
+        slot = out.setdefault(axis, {"ops": {}, "bytes": 0})
+        slot["ops"][op] = slot["ops"].get(op, 0) + 1
+        slot["bytes"] += _shape_bytes(result, async_start=started)
+    return out
+
+
+def total_collective_counts(stats: dict) -> dict[str, int]:
+    """Flatten :func:`collective_stats` to ``{op: count}`` totals."""
+    out: dict[str, int] = {}
+    for slot in stats.values():
+        for op, n in slot["ops"].items():
+            out[op] = out.get(op, 0) + n
+    return out

@@ -21,7 +21,8 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+
+from edl_tpu.parallel.compat import get_abstract_mesh, shard_map
 
 _NEG_INF = -1e30
 
@@ -224,8 +225,6 @@ def ring_flash_attention_sharded(
     """Ring attention whose per-chunk math runs in the pallas flash
     kernels — long-context AND sequence-parallel at once.  Same contract
     as :func:`ring_attention_sharded`; GQA kv heads pass unrepeated."""
-    from jax.sharding import get_abstract_mesh
-
     mesh = get_abstract_mesh()
     if mesh is None or mesh.empty:
         raise RuntimeError(
@@ -308,8 +307,6 @@ def ring_attention_sharded(
     batch over dp×fsdp, heads over tp, sequence ringed over sp — the long-
     context attention path the transformer routes to when the mesh has
     sp > 1 (edl_tpu.models.transformer._attention_block)."""
-    from jax.sharding import get_abstract_mesh
-
     mesh = get_abstract_mesh()
     if mesh is None or mesh.empty:
         raise RuntimeError("ring_attention_sharded requires a mesh context")

@@ -12,8 +12,21 @@ membership change is handled by
        with the new shardings — XLA moves only what must move),
     4. resuming; the task queue replays any work the lost workers held.
 
-Step functions are compiled once per mesh size and cached, so oscillating
-between sizes does not recompile.
+Step functions are compiled once per mesh *layout* (size AND axis split)
+and cached, so oscillating between layouts does not recompile.
+
+Resizes move the parallelism **shape**, not just the world size: a
+target may be a bare int (the legacy dp-dominant walk through the
+trainer's spec) or a full :class:`MeshShape`, re-splitting the
+dp×fsdp×… axes live.  Every resize runs a **replan** phase first
+(edl_tpu.parallel.replan): an exact per-leaf transfer plan pricing what
+stays put, what hops device-to-device, and what the naive
+gather-then-scatter bound would cost — recorded per event
+(``replan_ms``, ``bytes_moved``, ``bytes_naive``) so the claim that a
+live re-split beats a checkpoint round-trip is an audited number, not a
+slogan.  The state itself moves by ``jax.device_put`` with the new
+shardings (device-to-device), with a host-path retry available as an
+opt-in fallback for device sets with no direct transfer path.
 
 Resizes are **transactional**: the new mesh, shardings, and compiled step
 are staged and the live state is resharded into fresh buffers before
@@ -47,11 +60,13 @@ from edl_tpu.observability.collector import get_counters
 from edl_tpu.observability.logging import get_logger
 from edl_tpu.observability.tracing import get_tracer
 from edl_tpu.parallel.mesh import (
+    MeshShape,
     MeshSpec,
     dp_sharding,
     make_mesh,
     tree_shardings,
 )
+from edl_tpu.parallel.replan import plan_reshard
 
 log = get_logger("runtime.elastic")
 
@@ -64,8 +79,20 @@ BUILD_WAIT_TIMEOUT_S = 300.0
 
 def _reshard(tree: Any, shardings: Any) -> Any:
     """The reshard hop (seam for fault injection in tests): device_put
-    with NamedShardings moves/reshards across device sets in one hop."""
+    with NamedShardings moves/reshards across device sets in one hop,
+    device-to-device — XLA moves only the bytes the plan says must move."""
     return jax.device_put(tree, shardings)
+
+
+def _reshard_host(tree: Any, shardings: Any) -> Any:
+    """Host-path fallback: pull the tree to host memory, then place the
+    new shards from there.  Strictly worse than the device-to-device hop
+    (it pays the full gather the plan's ``bytes_naive`` bound prices),
+    but it survives device sets with no direct transfer path between
+    them — the cross-slice case ``jax.device_put`` may refuse."""
+    import numpy as np
+
+    return jax.device_put(jax.tree.map(np.asarray, tree), shardings)
 
 
 @dataclass
@@ -78,13 +105,16 @@ class TrainState:
 @dataclass
 class _MeshBundle:
     """Everything bound to ONE concrete mesh, staged and committed as a
-    unit.  Cached per (size, device ids): a resize back to a previously
-    seen size must reuse the exact Mesh object its jitted functions were
-    compiled against — rebuilding "equal" shardings over a fresh Mesh
-    leaves the cached executable bound to the old object (the stale
-    step-cache bug this dataclass exists to make impossible)."""
+    unit.  Cached per (size, axis split, device ids): a resize back to a
+    previously seen layout must reuse the exact Mesh object its jitted
+    functions were compiled against — rebuilding "equal" shardings over a
+    fresh Mesh leaves the cached executable bound to the old object (the
+    stale step-cache bug this dataclass exists to make impossible).  Two
+    layouts of the same size over the same devices (dp4 vs dp2×fsdp2) are
+    DIFFERENT bundles — the shape is part of the identity."""
 
     mesh: Any
+    shape: MeshShape
     param_shardings: Any
     opt_shardings: Any
     batch_sharding: Any
@@ -122,26 +152,33 @@ class ElasticTrainer:
         devices: Optional[Sequence[jax.Device]] = None,
         initial_world_size: Optional[int] = None,
         prewarm_cache_limit: int = 4,
+        reshard_host_fallback: bool = False,
     ) -> None:
         self.loss_fn = loss_fn
         self.optimizer = optimizer
         self.spec = spec
         self.param_sharding_kind = param_sharding
+        #: opt-in: retry a failed device-to-device reshard through host
+        #: memory before rolling back (for device sets with no direct
+        #: transfer path — cross-slice moves).  Off by default: on one
+        #: slice a device_put failure is an OOM, and the host path would
+        #: OOM the same way after paying the full gather.
+        self.reshard_host_fallback = reshard_host_fallback
         self._devices = list(devices) if devices is not None else jax.devices()
-        self._step_cache: dict[tuple[int, tuple], _MeshBundle] = {}
+        self._step_cache: dict[tuple, _MeshBundle] = {}
         #: guards the step cache + build coordination: resize() on the
         #: caller thread and prewarm on its background thread must agree
         #: on who compiles a given size exactly once
         self._cache_lock = threading.RLock()
         #: key → Event for a bundle currently compiling; a resize of a
-        #: size that is mid-prewarm waits for THAT compile (finishing a
+        #: layout that is mid-prewarm waits for THAT compile (finishing a
         #: partially paid compile) instead of duplicating it
-        self._building: dict[tuple[int, tuple], threading.Event] = {}
+        self._building: dict[tuple, threading.Event] = {}
         #: speculative (prewarm-built) bundles not yet used by a resize,
-        #: oldest first — hints for sizes that never arrive are evicted
+        #: oldest first — hints for layouts that never arrive are evicted
         #: beyond ``prewarm_cache_limit`` so a chatty planner can't grow
         #: the executable cache without bound
-        self._prewarm_unused: list[tuple[int, tuple]] = []
+        self._prewarm_unused: list[tuple] = []
         self.prewarm_cache_limit = max(int(prewarm_cache_limit), 1)
         #: abstract (shape/dtype) pytree of the last stepped batch — what
         #: prewarm AOT-compiles against; None until the first step
@@ -159,7 +196,7 @@ class ElasticTrainer:
         n0 = initial_world_size or len(self._devices)
         # the first build has no previous mesh to fall back to — a
         # failure here is a constructor failure, not a rollback
-        self._commit(*self._stage(n0))
+        self._commit(*self._stage(self._resolve_target(n0)))
 
     # -- public API --------------------------------------------------------
 
@@ -167,8 +204,33 @@ class ElasticTrainer:
     def world_size(self) -> int:
         return self.mesh.size
 
-    def resize(self, n_devices: int) -> bool:
-        """Rebuild the mesh over ``n_devices`` and reshard live state.
+    @property
+    def shape(self) -> MeshShape:
+        """The live mesh's concrete axis split."""
+        return MeshShape.of_mesh(self.mesh)
+
+    def _resolve_target(self, target) -> MeshShape:
+        """Any resize/prewarm target → concrete MeshShape.  Bare ints go
+        through ``self.spec`` (the legacy wildcard path, so ``resize(n)``
+        keeps its exact historical layout walk); MeshShapes pass
+        through, letting callers re-split the axes live."""
+        return MeshShape.resolve(target, spec=self.spec)
+
+    def matches(self, target) -> bool:
+        """True when the live mesh already has the target layout.  An
+        unresolvable target (e.g. a pod count the spec's fixed axes don't
+        divide) is simply "not this layout" — the elastic loop polls this
+        every step with whatever count the autoscaler landed, and a bad
+        count must soft-fail at resize(), never crash the step loop."""
+        try:
+            return self._resolve_target(target) == self.shape
+        except (TypeError, ValueError):
+            return False
+
+    def resize(self, target) -> bool:
+        """Rebuild the mesh for ``target`` — an int world size (legacy
+        dp-dominant walk via the trainer's spec) or a full
+        :class:`MeshShape` (live dp×fsdp×… re-split) — and reshard state.
 
         Transactional: the new world is fully staged (mesh, shardings,
         compiled step, state resharded into fresh buffers) before the
@@ -176,77 +238,92 @@ class ElasticTrainer:
         and the trainer keeps stepping on it; returns False and bumps
         ``resizes_failed``.  Returns True on success (or no-op).
         """
-        if n_devices == self.world_size:
+        try:
+            shape = self._resolve_target(target)
+        except Exception as exc:
+            # an unresolvable target is a failed resize, not a crash —
+            # the historical contract (spec.resolve used to raise inside
+            # the staged try): keep training on the world we have
+            self.resizes_failed += 1
+            log.warn("mesh resize failed; rolled back",
+                     want=repr(target)[:60], keep_size=self.world_size,
+                     step=self.state.step, error=str(exc)[:200])
+            get_counters().inc("resizes_failed")
+            return False
+        if shape == self.shape:
             return True
         try:
-            bundle, new_params, new_opt = self._stage(n_devices)
+            bundle, new_params, new_opt = self._stage(shape)
         except Exception as exc:
             # nothing was committed: self.mesh/_step_fn/state are the
             # previous world's, still coherent — keep training on them
             self.resizes_failed += 1
             log.warn("mesh resize failed; rolled back",
-                     want_size=n_devices, keep_size=self.world_size,
+                     want_size=shape.size, want_shape=shape.describe(),
+                     keep_size=self.world_size,
                      step=self.state.step, error=str(exc)[:200])
             get_tracer().instant("resize_rolled_back", category="chaos",
-                                 want_size=n_devices,
+                                 want_size=shape.size,
+                                 want_shape=shape.describe(),
                                  keep_size=self.world_size,
                                  error=str(exc)[:120])
             get_counters().inc("resizes_failed")
             return False
         self._commit(bundle, new_params, new_opt)
         self.resizes += 1
-        evt = dict(self._last_split, size=n_devices, step=self.state.step)
+        evt = dict(self._last_split, size=shape.size, step=self.state.step)
         self.resize_events.append(evt)
         get_tracer().instant("mesh_resized", category="elastic", **evt)
         get_counters().inc("prewarm_hits" if evt["prewarm_hit"]
                            else "prewarm_misses")
-        # the compile/reshard split as scrape-able distributions, next to
-        # the per-event list the bench reads
+        # the replan/compile/reshard split as scrape-able distributions,
+        # next to the per-event list the bench reads
         from edl_tpu.observability.metrics import get_registry
 
-        get_registry().histogram(
+        hist = get_registry().histogram(
             "resize_phase_seconds",
-            help="mesh-resize latency by phase").observe(
-                evt["compile_ms"] / 1000.0, phase="compile")
-        get_registry().histogram(
-            "resize_phase_seconds").observe(
-                evt["reshard_ms"] / 1000.0, phase="reshard")
-        log.info("mesh resized", world_size=n_devices,
+            help="mesh-resize latency by phase")
+        hist.observe(evt["replan_ms"] / 1000.0, phase="replan")
+        hist.observe(evt["compile_ms"] / 1000.0, phase="compile")
+        hist.observe(evt["reshard_ms"] / 1000.0, phase="reshard")
+        log.info("mesh resized", world_size=shape.size,
+                 shape=evt["shape"], replan_ms=evt["replan_ms"],
                  compile_ms=evt["compile_ms"], reshard_ms=evt["reshard_ms"],
+                 bytes_moved=evt["bytes_moved"],
                  prewarm_hit=evt["prewarm_hit"], step=self.state.step)
         return True
 
-    def prewarm(self, sizes: Sequence[int],
+    def prewarm(self, sizes: Sequence,
                 wait: bool = False) -> Optional[threading.Thread]:
         """Speculatively compile the mesh bundles for likely next world
-        sizes on a background thread, so a later :meth:`resize` to one of
-        them pays only the reshard hop.
+        layouts on a background thread, so a later :meth:`resize` to one
+        of them pays only the reshard hop.
 
         Feed it the autoscaler/planner's hints — the plan knows the next
-        parallelism before the pods ever move, which is exactly the
-        compile window.  Sizes that are invalid, current, already cached,
-        or already compiling are skipped.  Speculative bundles that no
-        resize ever uses are evicted beyond ``prewarm_cache_limit``
-        (oldest first), so hints for sizes that never arrive stay
-        bounded.  A prewarm failure is logged and counted, never raised —
-        the inline-compile path still rules.
+        parallelism (count OR full mesh shape) before the pods ever move,
+        which is exactly the compile window.  Targets that are invalid,
+        current, already cached, or already compiling are skipped.
+        Speculative bundles that no resize ever uses are evicted beyond
+        ``prewarm_cache_limit`` (oldest first), so hints for layouts that
+        never arrive stay bounded.  A prewarm failure is logged and
+        counted, never raised — the inline-compile path still rules.
 
         Returns the worker thread (joined already when ``wait=True``),
         or None when there was nothing to do."""
-        wanted = []
+        wanted: list[MeshShape] = []
         with self._cache_lock:
-            for n in sizes:
+            for target in sizes:
                 try:
-                    n = int(n)
+                    shape = self._resolve_target(target)
                 except (TypeError, ValueError):
                     continue
-                if (n < 1 or n > len(self._devices) or n == self.world_size
-                        or n in wanted):
+                if (shape.size < 1 or shape.size > len(self._devices)
+                        or shape == self.shape or shape in wanted):
                     continue
-                key = self._cache_key(n)
+                key = self._cache_key(shape)
                 if key in self._step_cache or key in self._building:
                     continue
-                wanted.append(n)
+                wanted.append(shape)
         if not wanted:
             return None
         # NON-daemon, deliberately: a daemon thread still inside XLA's
@@ -261,8 +338,8 @@ class ElasticTrainer:
             t.join()
         return t
 
-    def is_building(self, n_devices: int) -> bool:
-        """True while a speculative build for ``n_devices`` is in flight.
+    def is_building(self, target) -> bool:
+        """True while a speculative build for ``target`` is in flight.
 
         The elastic loop's deferral predicate: a resize whose bundle is
         still compiling does not have to stall waiting for it — training
@@ -270,8 +347,12 @@ class ElasticTrainer:
         steps later, when the staged bundle is ready.  (Correct because a
         resize is never a correctness event, only a capacity adjustment:
         the new pods idle a moment longer, the step loop never stops.)"""
+        try:
+            key = self._cache_key(target)
+        except (TypeError, ValueError):
+            return False  # unresolvable target: nothing can be building
         with self._cache_lock:
-            return self._cache_key(n_devices) in self._building
+            return key in self._building
 
     def prewarm_quiesce(self, timeout_s: float = 10.0) -> bool:
         """Block until no speculative build is in flight; True when quiet.
@@ -292,20 +373,22 @@ class ElasticTrainer:
                 return False
             evs[0].wait(remaining)
 
-    def _prewarm_bg(self, sizes: tuple) -> None:
-        for n in sizes:
+    def _prewarm_bg(self, shapes: tuple) -> None:
+        for shape in shapes:
             t0 = time.perf_counter()
             try:
-                bundle, cached = self._acquire_bundle(n, source="prewarm")
+                bundle, cached = self._acquire_bundle(shape, source="prewarm")
             except Exception as exc:
                 log.warn("mesh prewarm failed; resize will compile inline",
-                         size=n, error=str(exc)[:200])
+                         size=shape.size, shape=shape.describe(),
+                         error=str(exc)[:200])
                 get_counters().inc("prewarms_failed")
                 continue
             if cached:
                 continue  # someone else built it meanwhile
             get_tracer().instant(
-                "mesh_prewarmed", category="elastic", size=n,
+                "mesh_prewarmed", category="elastic", size=shape.size,
+                shape=shape.describe(),
                 compile_ms=round((time.perf_counter() - t0) * 1000, 1))
             get_counters().inc("mesh_prewarms")
 
@@ -331,14 +414,19 @@ class ElasticTrainer:
 
     # -- internals ---------------------------------------------------------
 
-    def _cache_key(self, n_devices: int) -> tuple[int, tuple]:
-        """Cache key for a world of ``n_devices``: size + the identities
-        of the devices it would span.  Size alone is NOT enough — it let
-        a resize back to a previously-seen size reuse jitted functions
-        whose captured shardings were bound to the *old* Mesh object."""
-        return n_devices, tuple(
+    def _cache_key(self, target) -> tuple:
+        """Cache key for a target layout: size + the full axis split +
+        the identities of the devices it would span.  Size alone is NOT
+        enough — it let a resize back to a previously-seen size reuse
+        jitted functions whose captured shardings were bound to the *old*
+        Mesh object; and size+devices alone would alias dp4 with
+        dp2×fsdp2, which compile different programs.  (The leading size
+        element is redundant with the shape but kept first so key[0]
+        stays the world size for observers.)"""
+        shape = self._resolve_target(target)
+        return shape.size, shape.key(), tuple(
             getattr(d, "id", i) for i, d in
-            enumerate(self._devices[:n_devices]))
+            enumerate(self._devices[:shape.size]))
 
     def _remember_batch(self, batch: Any) -> None:
         """Track the stepped batch's abstract shape — the signature
@@ -360,18 +448,18 @@ class ElasticTrainer:
             self._batch_abstract = jax.tree.map(
                 lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), batch)
 
-    def _acquire_bundle(self, n_devices: int, source: str = "resize"
+    def _acquire_bundle(self, shape: MeshShape, source: str = "resize"
                         ) -> tuple[_MeshBundle, bool]:
-        """Fetch or build the bundle for ``n_devices``; returns
+        """Fetch or build the bundle for ``shape``; returns
         ``(bundle, was_cached)``.
 
         Exactly-once compile across threads: whoever wins the build slot
-        compiles; a concurrent caller of the same size (the classic race:
-        resize() of a size that is mid-prewarm) parks on the builder's
-        event and picks up the finished bundle — paying only the
-        *remainder* of a compile that started earlier, which is the whole
-        point of speculation."""
-        key = self._cache_key(n_devices)
+        compiles; a concurrent caller of the same layout (the classic
+        race: resize() of a shape that is mid-prewarm) parks on the
+        builder's event and picks up the finished bundle — paying only
+        the *remainder* of a compile that started earlier, which is the
+        whole point of speculation."""
+        key = self._cache_key(shape)
         while True:
             with self._cache_lock:
                 bundle = self._step_cache.get(key)
@@ -400,13 +488,13 @@ class ElasticTrainer:
             # step loop blocked forever on another thread's compile
             if not ev.wait(BUILD_WAIT_TIMEOUT_S):
                 raise RuntimeError(
-                    f"mesh bundle build for size {n_devices} still in "
+                    f"mesh bundle build for {shape.describe()} still in "
                     f"flight after {BUILD_WAIT_TIMEOUT_S}s — wedged "
                     "compile; keeping the current world")
             # loop: the builder either cached the bundle (hit next pass)
             # or failed (this thread takes over the build slot)
         try:
-            bundle = self._build_bundle(n_devices, source)
+            bundle = self._build_bundle(shape, source)
             with self._cache_lock:
                 # cache only once fully compiled: a compile that failed
                 # halfway must not leave a poisoned entry for the retry.
@@ -427,7 +515,7 @@ class ElasticTrainer:
         never-resized-to bundles past ``prewarm_cache_limit``.  Entries a
         resize used (and the live world) are exempt — they are the
         oscillation cache that predates prewarm."""
-        live_key = self._cache_key(self.world_size) if self.mesh else None
+        live_key = self._cache_key(self.shape) if self.mesh else None
         while len(self._prewarm_unused) > self.prewarm_cache_limit:
             victim = self._prewarm_unused.pop(0)
             if victim == live_key:
@@ -437,10 +525,11 @@ class ElasticTrainer:
                          size=victim[0])
                 get_counters().inc("prewarms_evicted")
 
-    def _build_bundle(self, n_devices: int, source: str) -> _MeshBundle:
-        mesh = make_mesh(n_devices, self.spec, devices=self._devices)
+    def _build_bundle(self, shape: MeshShape, source: str) -> _MeshBundle:
+        mesh = make_mesh(shape.size, shape.to_spec(), devices=self._devices)
         bundle = _MeshBundle(
             mesh=mesh,
+            shape=shape,
             param_shardings=tree_shardings(
                 mesh, self.state.params, self.param_sharding_kind),
             opt_shardings=tree_shardings(
@@ -480,25 +569,69 @@ class ElasticTrainer:
                      "compile inline", size=bundle.mesh.size,
                      error=str(exc)[:200])
 
-    def _stage(self, n_devices: int) -> tuple[_MeshBundle, Any, Any]:
+    def _stage(self, shape: MeshShape) -> tuple[_MeshBundle, Any, Any]:
         """Build (or fetch) everything the new world needs WITHOUT
-        touching live state: the mesh bundle plus the state resharded
-        into fresh buffers.  device_put copies — the previous arrays stay
-        valid until :meth:`_commit`, which is what makes rollback free.
-        Records the compile/reshard wall-time split in ``_last_split``."""
+        touching live state: the mesh bundle, the transfer plan, and the
+        state resharded into fresh buffers.  device_put copies — the
+        previous arrays stay valid until :meth:`_commit`, which is what
+        makes rollback free.  Records the replan/compile/reshard
+        wall-time split (plus the plan's byte accounting) in
+        ``_last_split``."""
         t0 = time.perf_counter()
-        bundle, cached = self._acquire_bundle(n_devices)
+        bundle, cached = self._acquire_bundle(shape)
         t1 = time.perf_counter()
-        new_params = _reshard(self.state.params, bundle.param_shardings)
-        new_opt = _reshard(self.state.opt_state, bundle.opt_shardings)
+        # replan: price the move before making it.  Exact per-leaf
+        # accounting of what stays, what hops device-to-device, and what
+        # the naive gather-scatter bound would have cost — the recorded
+        # evidence that a shape change moved less than a checkpoint
+        # round-trip.  Pure book-keeping on abstract shapes: milliseconds
+        # next to a compile, and never touches device memory.  (The
+        # constructor's first build has no old layout to plan from.)
+        if self.mesh is not None:
+            plan = plan_reshard(
+                (self.state.params, self.state.opt_state),
+                (self._param_shardings, self._opt_shardings),
+                (bundle.param_shardings, bundle.opt_shardings),
+                old_shape=self.shape, new_shape=shape)
+        else:
+            from edl_tpu.parallel.replan import ReshardPlan
+
+            plan = ReshardPlan(old_shape=None, new_shape=shape)
         t2 = time.perf_counter()
+        transfer = "device"
+        try:
+            new_params = _reshard(self.state.params, bundle.param_shardings)
+            new_opt = _reshard(self.state.opt_state, bundle.opt_shardings)
+        except Exception as exc:
+            if not self.reshard_host_fallback:
+                raise
+            # no direct path between the device sets (cross-slice): pay
+            # the gather-scatter bound through host memory rather than
+            # failing the resize.  Counted — a deployment seeing these
+            # has a topology problem worth knowing about.
+            log.warn("device-to-device reshard failed; retrying via host",
+                     shape=shape.describe(), error=str(exc)[:200])
+            get_counters().inc("reshard_host_fallbacks")
+            new_params = _reshard_host(self.state.params,
+                                       bundle.param_shardings)
+            new_opt = _reshard_host(self.state.opt_state,
+                                    bundle.opt_shardings)
+            transfer = "host"
+        t3 = time.perf_counter()
         self._last_split = {
             # bundle-acquisition wall time: ~0 on a cache hit, the full
             # compile when built inline, the residual wait when a resize
             # landed mid-prewarm
             "compile_ms": round((t1 - t0) * 1000, 2),
-            "reshard_ms": round((t2 - t1) * 1000, 2),
+            "replan_ms": round((t2 - t1) * 1000, 3),
+            "reshard_ms": round((t3 - t2) * 1000, 2),
             "prewarm_hit": bool(cached and bundle.source == "prewarm"),
+            "shape": shape.describe(),
+            "bytes_moved": plan.bytes_moved,
+            "bytes_ici": plan.bytes_ici,
+            "bytes_dcn": plan.bytes_dcn,
+            "bytes_naive": plan.bytes_naive,
+            "transfer": transfer,
         }
         return bundle, new_params, new_opt
 
@@ -519,7 +652,7 @@ class ElasticTrainer:
         with self._cache_lock:
             # the bundle is live: it graduated from speculation, so it is
             # no longer an eviction candidate
-            key = self._cache_key(bundle.mesh.size)
+            key = self._cache_key(bundle.shape)
             if key in self._prewarm_unused:
                 self._prewarm_unused.remove(key)
 

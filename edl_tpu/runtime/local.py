@@ -48,6 +48,11 @@ class RunReport:
     #: speculation moved the compile off the hot path
     resize_compile_ms: list[float] = field(default_factory=list)
     resize_reshard_ms: list[float] = field(default_factory=list)
+    #: per-resize reparallelization record: how long the transfer plan
+    #: took to compute and how many bytes it said must move — the
+    #: evidence that a shape change beat the gather-scatter bound
+    resize_replan_ms: list[float] = field(default_factory=list)
+    resize_bytes_moved: list[int] = field(default_factory=list)
     prewarm_hits: int = 0
     #: steps spent training on the OLD world while the new world's bundle
     #: was still compiling (deferred resize — the zero-stall alternative
@@ -77,6 +82,7 @@ class LocalElasticJob:
         max_devices: Optional[int] = None,
         prewarm_neighbors: bool = True,
         resize_defer_s: float = 30.0,
+        shape_for: Optional[Callable[[int], object]] = None,
     ) -> None:
         self.job = job
         self.cluster = cluster
@@ -85,6 +91,14 @@ class LocalElasticJob:
         self.fetch = fetch
         self.batch_size = batch_size
         self.max_devices = max_devices or len(trainer._devices)
+        #: reparallelization policy: maps an observed pod count to the
+        #: mesh layout this job should train on at that world size — an
+        #: int (legacy pure-dp walk) or a MeshShape (live dp×fsdp…
+        #: re-split, e.g. replan.propose_shape pivoting dp→fsdp when the
+        #: replicated state would overflow per-chip memory at small
+        #: worlds).  None keeps the historical behavior: the pod count IS
+        #: the target.
+        self.shape_for = shape_for
         #: speculative compile policy: after every commit, prewarm the
         #: adjacent valid world sizes — an elastic job's next resize is
         #: overwhelmingly one hop along the grow/shrink trace, so the
@@ -114,9 +128,26 @@ class LocalElasticJob:
         the snap is a belt-and-braces guard for unit-policy jobs)."""
         return self._snap(self.cluster.job_pods(self.job).running or 1)
 
-    def _neighbor_sizes(self, current: int) -> list[int]:
+    def _target_for(self, n: int):
+        """Pod count → resize target: the shape policy's layout when one
+        is configured, else the count itself (pure-dp legacy path).  A
+        raising policy degrades to the bare count — this runs every step
+        of the training loop, and a layout hint must never kill the job
+        (same guard the autoscaler's mesh_shape_for hook gets)."""
+        n = self._snap(n)
+        if self.shape_for is None:
+            return n
+        try:
+            return self.shape_for(n)
+        except Exception as exc:
+            log.warn("shape policy failed; using bare count",
+                     job=self.job.full_name, count=n, error=str(exc)[:200])
+            return n
+
+    def _neighbor_sizes(self, current: int) -> list:
         """The adjacent valid world sizes (next divisor of the batch in
-        each direction) — the prewarm candidates."""
+        each direction), mapped through the shape policy — the prewarm
+        candidates."""
         out = []
         for n in range(current + 1, self.max_devices + 1):
             if self.batch_size % n == 0:
@@ -126,17 +157,27 @@ class LocalElasticJob:
             if n == 1 or self.batch_size % n == 0:
                 out.append(n)
                 break
+        if self.shape_for is not None:
+            out = [self.shape_for(n) for n in out]
         return out
 
-    def prewarm_for_parallelism(self, parallelism: int) -> None:
+    def prewarm_for_parallelism(self, target) -> None:
         """Autoscaler plan hint → speculative mesh compile.
 
         Wire this to :attr:`Autoscaler.hint_sink` (via a uid match): the
-        plan knows the next parallelism before any pod moves, so the
-        mesh bundle for the size this loop will eventually observe can
-        compile off the hot path.  Applies the same clamp/snap rule the
-        loop itself will apply when the pods land."""
-        self.trainer.prewarm([self._snap(parallelism)])
+        plan knows the next parallelism — a count, or a full target
+        MeshShape when the autoscaler runs a shape policy — before any
+        pod moves, so the bundle for the layout this loop will eventually
+        observe can compile off the hot path.  Count hints go through the
+        same clamp/snap/shape rules the loop itself will apply when the
+        pods land; shape hints are taken as-is (the planner already chose
+        the layout)."""
+        from edl_tpu.parallel.mesh import MeshShape
+
+        if isinstance(target, MeshShape):
+            self.trainer.prewarm([target])
+        else:
+            self.trainer.prewarm([self._target_for(int(target))])
 
     def run(
         self,
@@ -156,11 +197,12 @@ class LocalElasticJob:
             fetch=self.fetch, batch_size=self.batch_size,
         )
         defer_deadline: Optional[float] = None
-        defer_target: Optional[int] = None
+        defer_target = None
         for batch in batches:
-            want = self.desired_world_size()
+            want = self._target_for(self.desired_world_size())
             resized_at = None
-            if want == self.trainer.world_size:
+            settled = self.trainer.matches(want)
+            if settled:
                 defer_deadline = defer_target = None
             else:
                 if (self.resize_defer_s > 0
@@ -169,19 +211,20 @@ class LocalElasticJob:
                     # the world we have instead of stalling the step loop
                     # on the compile — the resize commits a few steps
                     # from now, when the staged bundle is ready.  The
-                    # budget is per TARGET: a plan that revises the size
-                    # mid-deferral starts a fresh window for the new
-                    # size's compile instead of inheriting a spent one.
+                    # budget is per TARGET: a plan that revises the
+                    # target mid-deferral starts a fresh window for the
+                    # new layout's compile instead of inheriting a spent
+                    # one.
                     now = time.perf_counter()
                     if defer_deadline is None or want != defer_target:
                         defer_target = want
                         defer_deadline = now + self.resize_defer_s
                     if now < defer_deadline:
                         report.resize_deferred_steps += 1
-                        want = self.trainer.world_size
-            if want != self.trainer.world_size:
+                        settled = True
+            if not settled:
                 defer_deadline = defer_target = None
-                before = self.trainer.world_size
+                before = self.trainer.shape.describe()
                 resized_at = time.perf_counter()
                 ok = self.trainer.resize(want)
                 report.resizes += 1
@@ -190,12 +233,16 @@ class LocalElasticJob:
                     evt = self.trainer.resize_events[-1]
                     report.resize_compile_ms.append(evt["compile_ms"])
                     report.resize_reshard_ms.append(evt["reshard_ms"])
+                    report.resize_replan_ms.append(evt["replan_ms"])
+                    report.resize_bytes_moved.append(evt["bytes_moved"])
                     report.prewarm_hits += int(evt["prewarm_hit"])
                 if ok and self.prewarm_neighbors:
                     # next hop along the grow/shrink trace, compiled now
-                    self.trainer.prewarm(self._neighbor_sizes(want))
+                    self.trainer.prewarm(
+                        self._neighbor_sizes(self.trainer.world_size))
                 log.info("elastic resize applied", job=self.job.full_name,
-                         from_size=before, to_size=want,
+                         from_shape=before,
+                         to_shape=self.trainer.shape.describe(),
                          step=self.trainer.state.step)
             loss = self.trainer.step(batch)
             if resized_at is not None:

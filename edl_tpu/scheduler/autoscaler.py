@@ -59,6 +59,7 @@ class Autoscaler:
         loop_seconds: float = DEFAULT_LOOP_SECONDS,
         resize_cooldown_s: float = DEFAULT_RESIZE_COOLDOWN_S,
         min_resize_delta: int = DEFAULT_MIN_RESIZE_DELTA,
+        mesh_shape_for: Optional[Callable[[str, int], object]] = None,
         clock=time.monotonic,
     ) -> None:
         self.cluster = cluster
@@ -92,7 +93,17 @@ class Autoscaler:
         #: only the reshard hop.  Must be cheap and non-blocking (it runs
         #: on the scaling loop); exceptions are swallowed and logged —
         #: hints are an optimization, never a dependency.
-        self.hint_sink: Optional[Callable[[str, int], None]] = None
+        self.hint_sink: Optional[Callable[[str, object], None]] = None
+        #: reparallelization policy hook: maps ``(uid, target_count)`` to
+        #: the mesh layout the job should run at that world size (a
+        #: MeshShape, or the count unchanged).  When set, hint_sink fires
+        #: ``(uid, target_shape)`` instead of the bare count, so the
+        #: runtime prewarms — and later commits — the SHAPE the planner
+        #: chose, e.g. ``replan.propose_shape`` pivoting dp→fsdp when a
+        #: shrink would overflow per-chip memory with replicated state.
+        #: Planning/actuation still walk instance counts; the shape is
+        #: carried alongside, never instead.
+        self.mesh_shape_for = mesh_shape_for
 
     # -- event intake (reference autoscaler.go:159-171) --------------------
 
@@ -182,10 +193,20 @@ class Autoscaler:
             if self.hint_sink is not None:
                 # hint BEFORE actuation: the plan is the earliest moment
                 # the next parallelism is known, and every tick of head
-                # start is compile time off the eventual resize
+                # start is compile time off the eventual resize.  With a
+                # shape policy the hint carries the full target layout
+                # (uid, MeshShape); shape-policy failures degrade to the
+                # bare count — a hint is never a dependency.
                 for uid, n in target.items():
+                    hint = n
+                    if self.mesh_shape_for is not None:
+                        try:
+                            hint = self.mesh_shape_for(uid, n)
+                        except Exception as exc:
+                            log.warn("mesh shape policy failed; hinting "
+                                     "bare count", job=uid, error=str(exc))
                     try:
-                        self.hint_sink(uid, n)
+                        self.hint_sink(uid, hint)
                     except Exception as exc:
                         log.warn("prewarm hint sink failed", job=uid,
                                  error=str(exc))

@@ -26,6 +26,7 @@ from edl_tpu.models.planning import (
     format_plan_table,
 )
 from edl_tpu.models.transformer import LLAMA3_8B
+from edl_tpu.parallel.compat import set_mesh
 
 BIG_LEAF_BYTES = 32 << 20  # anything larger must not be replicated
 
@@ -104,7 +105,7 @@ def test_one_step_at_8b_layer_shapes_on_8dev_mesh():
     shardings = jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
                              is_leaf=lambda x: isinstance(x, P))
 
-    with jax.set_mesh(mesh):
+    with set_mesh(mesh):
         params = jax.jit(
             lambda: T.init(jax.random.key(0), cfg),
             out_shardings=shardings)()
@@ -137,7 +138,7 @@ def test_one_step_at_8b_layer_shapes_on_8dev_mesh():
         updates, opt_state = opt.update(grads, opt_state, params)
         return optax.apply_updates(params, updates), opt_state, loss
 
-    with jax.set_mesh(mesh):
+    with set_mesh(mesh):
         params, opt_state, loss = step(params, opt_state, tokens, targets)
     loss = float(loss)
     # next-token CE on random tokens starts near ln(vocab)

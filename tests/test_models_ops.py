@@ -12,8 +12,18 @@ import pytest
 
 from edl_tpu.models import mlp, transformer, word2vec
 from edl_tpu.ops.flash_attention import attention, reference_attention
+from edl_tpu.parallel.compat import set_mesh
 from edl_tpu.parallel.mesh import MeshSpec, make_mesh
 from edl_tpu.parallel.ring_attention import ring_attention
+
+#: the flash-kernel ring wraps pallas custom-calls in shard_map; the old
+#: jax on some worker images miscompiles that composition under jit (its
+#: sharding-remover pass replaces the kernel's manual-sharded result with
+#: a mismatched shape).  The jnp ring and everything else runs on both —
+#: only the pallas-in-shard_map tests need the modern partitioner.
+requires_modern_shard_map = pytest.mark.skipif(
+    not hasattr(jax, "shard_map"),
+    reason="legacy jax SPMD partitioner miscompiles pallas inside shard_map")
 
 
 # -- flash attention kernel (pallas interpret mode == runs on CPU) -----------
@@ -170,6 +180,7 @@ def test_ring_attention_matches_reference(causal):
                                atol=2e-5, rtol=2e-5)
 
 
+@requires_modern_shard_map
 @pytest.mark.parametrize("hk", [4, 2])
 def test_ring_flash_attention_matches_reference(hk):
     """The flash-kernel ring (pallas per chunk + lse combine + ring-level
@@ -197,7 +208,7 @@ def test_ring_flash_attention_matches_reference(hk):
                                   jnp.repeat(v, rep, axis=2), causal=True)
         return jnp.sum(out ** 2), out
 
-    with jax.set_mesh(mesh):
+    with set_mesh(mesh):
         (_, out), grads = jax.jit(
             jax.value_and_grad(f_ring, argnums=(0, 1, 2), has_aux=True)
         )(q, k, v)
@@ -210,6 +221,7 @@ def test_ring_flash_attention_matches_reference(hk):
                                    atol=5e-4, rtol=5e-4)
 
 
+@requires_modern_shard_map
 def test_ring_flash_falls_back_on_unaligned_chunks():
     # sc = 64 per device is not 128-aligned: the flash ring must route to
     # the jnp ring (a truncating pallas grid would silently drop rows)
@@ -222,7 +234,7 @@ def test_ring_flash_falls_back_on_unaligned_chunks():
     q = jax.random.normal(kq, (b, s, h, d), jnp.float32)
     k = jax.random.normal(kk, (b, s, hk, d), jnp.float32)
     v = jax.random.normal(kv, (b, s, hk, d), jnp.float32)
-    with jax.set_mesh(mesh):
+    with set_mesh(mesh):
         out = jax.jit(lambda q, k, v: ring_flash_attention_sharded(
             q, k, v, causal=True, interpret=True))(q, k, v)
     ref = reference_attention(q, jnp.repeat(k, h // hk, axis=2),
@@ -297,7 +309,7 @@ def test_transformer_sharded_train_step_on_mesh():
     tokens = jax.device_put(jnp.zeros((4, 16), jnp.int32), batch_sh)
     targets = jax.device_put(jnp.ones((4, 16), jnp.int32), batch_sh)
 
-    with jax.set_mesh(mesh):
+    with set_mesh(mesh):
         # out_shardings pins grads to the param layout (as ElasticTrainer
         # does); without it XLA may legally re-shard outputs.
         loss, grads = jax.jit(
@@ -414,7 +426,7 @@ def test_transformer_ring_attention_on_sp_mesh():
     sp_params = jax.device_put(params, shardings)
     sp_tokens = jax.device_put(
         tokens, NamedSharding(mesh, transformer.batch_partition_spec()))
-    with jax.set_mesh(mesh):
+    with set_mesh(mesh):
         out = jax.jit(lambda p, t: transformer.apply(p, t, cfg))(
             sp_params, sp_tokens)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),

@@ -1,0 +1,532 @@
+"""Dynamic reparallelization: MeshShape resolution, transfer-plan
+accounting, minimal-transfer shape choice, the live dp×fsdp resize
+through the transactional path, and the shape-hint control-plane flow.
+
+Runs on the virtual 8-device CPU mesh (tests/conftest.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from edl_tpu.models import mlp
+from edl_tpu.parallel.mesh import (
+    MeshShape,
+    MeshSpec,
+    make_mesh,
+    tree_shardings,
+)
+from edl_tpu.parallel.replan import (
+    candidate_shapes,
+    choose_shape,
+    collective_stats,
+    plan_reshard,
+    propose_shape,
+    total_collective_counts,
+)
+from edl_tpu.runtime.elastic import ElasticTrainer
+
+
+def synthetic_classification(n=512, dim=16, classes=4, seed=0):
+    rng = np.random.default_rng(seed)
+    centers = rng.normal(size=(classes, dim)) * 3
+    y = rng.integers(0, classes, size=n)
+    x = centers[y] + rng.normal(size=(n, dim))
+    return x.astype(np.float32), y.astype(np.int32)
+
+
+def make_trainer(n0=4, kind="fsdp", spec=None, **kw):
+    params = mlp.init(jax.random.key(0), [16, 32, 4])
+    return ElasticTrainer(
+        mlp.loss_fn, params, optax.adam(1e-2),
+        spec=spec or MeshSpec(dp=-1),
+        param_sharding=kind, initial_world_size=n0, **kw,
+    )
+
+
+# -- MeshShape ---------------------------------------------------------------
+
+
+def test_mesh_shape_resolution_paths():
+    assert MeshShape.resolve(4, spec=MeshSpec(dp=-1)) == MeshShape(dp=4)
+    assert MeshShape.resolve(8, spec=MeshSpec(dp=2, fsdp=-1)) == \
+        MeshShape(dp=2, fsdp=4)
+    s = MeshShape(dp=2, fsdp=2)
+    assert MeshShape.resolve(s) is s
+    assert s.size == 4 and s.describe() == "dp2xfsdp2"
+    assert MeshShape().describe() == "1"
+    with pytest.raises(ValueError):
+        MeshShape(dp=-1)  # shapes are concrete; wildcards live in specs
+    with pytest.raises(ValueError):
+        MeshShape.resolve(6, spec=MeshSpec(dp=4))  # 6 not resolvable
+
+
+def test_candidate_shapes_enumerate_dp_fsdp_splits():
+    cands = {c.key() for c in candidate_shapes(4)}
+    assert cands == {MeshShape(dp=4).key(), MeshShape(dp=2, fsdp=2).key(),
+                     MeshShape(fsdp=4).key()}
+    # tp/sp inherited when they divide, reset otherwise
+    base = MeshShape(tp=2)
+    assert all(c.tp == 2 for c in candidate_shapes(8, base=base))
+    assert all(c.tp == 1 for c in candidate_shapes(3, base=base))
+
+
+# -- transfer-plan accounting ------------------------------------------------
+
+
+def _mesh_shardings(shape, tree, devices, kind="fsdp"):
+    mesh = make_mesh(shape.size, shape.to_spec(), devices=devices)
+    return mesh, tree_shardings(mesh, tree, kind)
+
+
+def test_shape_preserving_plan_moves_nothing_and_beats_naive():
+    devs = jax.devices()[:4]
+    tree = {"w": jnp.zeros((16, 32)), "b": jnp.zeros((4,))}
+    shape = MeshShape(dp=2, fsdp=2)
+    _, sh = _mesh_shardings(shape, tree, devs)
+    plan = plan_reshard(tree, sh, sh, shape, shape)
+    assert plan.bytes_moved == 0
+    assert plan.bytes_naive > 0
+    assert plan.bytes_moved < plan.bytes_naive  # strict, the headline claim
+
+
+def test_grow_plan_classifies_ici_vs_dcn():
+    devs = jax.devices()
+    tree = {"w": jnp.zeros((16, 32))}
+    _, sh2 = _mesh_shardings(MeshShape(fsdp=2), tree, devs[:2])
+    _, sh4 = _mesh_shardings(MeshShape(fsdp=4), tree, devs[:4])
+    grow = plan_reshard(tree, sh2, sh4, MeshShape(fsdp=2), MeshShape(fsdp=4))
+    # every byte the joiners need exists on a surviving device → pure ici
+    assert grow.bytes_ici > 0 and grow.bytes_dcn == 0
+    assert grow.bytes_stay + grow.bytes_ici == grow.bytes_total
+    # shrink: shards held ONLY by departing devices must cross the
+    # boundary (the host/DCN residue the fallback path exists for)
+    shrink = plan_reshard(tree, sh4, sh2,
+                          MeshShape(fsdp=4), MeshShape(fsdp=2))
+    assert shrink.bytes_dcn > 0
+    assert shrink.bytes_moved < shrink.bytes_naive
+
+
+def test_plan_handles_uneven_divisibility():
+    """A leaf whose dims don't divide the new axis size is replicated by
+    fsdp_sharding — the plan must account it as such, not crash or
+    invent fractional shards."""
+    devs = jax.devices()[:3]
+    tree = {"odd": jnp.zeros((7, 5)), "even": jnp.zeros((6, 4))}
+    m1, sh1 = _mesh_shardings(MeshShape(dp=3), tree, devs)
+    m3, sh3 = _mesh_shardings(MeshShape(fsdp=3), tree, devs)
+    # 7 and 5 both indivisible by 3 → replicated; 6 divides → sharded
+    assert sh3["odd"].spec == jax.sharding.PartitionSpec()
+    assert sh3["even"].spec != jax.sharding.PartitionSpec()
+    plan = plan_reshard(tree, sh1, sh3, MeshShape(dp=3), MeshShape(fsdp=3))
+    odd = next(l for l in plan.leaves if "odd" in l.path)
+    even = next(l for l in plan.leaves if "even" in l.path)
+    # replicated → every device already holds it, nothing moves
+    assert odd.bytes_moved == 0 and odd.bytes_stay == 3 * odd.nbytes
+    # sharded-from-replicated → devices drop bytes, fetch none
+    assert even.bytes_moved == 0
+    assert plan.max_device_bytes == odd.nbytes + even.nbytes // 3
+
+
+def test_choose_shape_minimizes_transfer_and_respects_memory():
+    devs = jax.devices()[:4]
+    tree = {"w": jnp.zeros((16, 32)), "b": jnp.zeros((4,))}
+    shape0 = MeshShape(dp=4)
+    _, sh0 = _mesh_shardings(shape0, tree, devs)
+    # unconstrained from pure-dp: staying pure-dp moves zero bytes and
+    # wins the dp-dominant tie-break
+    best, plan = choose_shape(tree, sh0, 4, devs, "fsdp")
+    assert best == shape0 and plan.bytes_moved == 0
+    # a per-chip budget below the replicated footprint forces the fsdp
+    # pivot — the dp→fsdp escape hatch
+    total = sum(l.nbytes for l in jax.tree.leaves(tree))
+    best2, plan2 = choose_shape(tree, sh0, 4, devs, "fsdp",
+                                max_bytes_per_device=total // 2)
+    assert best2.fsdp > 1
+    assert plan2.max_device_bytes <= total // 2
+    # impossible budget: hardest sharding wins rather than an exception
+    best3, _ = choose_shape(tree, sh0, 4, devs, "fsdp",
+                            max_bytes_per_device=1)
+    assert best3.fsdp == 4
+
+
+def test_propose_shape_pivots_dp_to_fsdp_on_memory_pressure():
+    # fits replicated → pure dp
+    assert propose_shape(8, state_bytes=100, max_bytes_per_device=100) == \
+        MeshShape(dp=8)
+    # half fits → fsdp 2
+    assert propose_shape(8, 100, 50) == MeshShape(dp=4, fsdp=2)
+    # nothing fits → shard as hard as the world allows
+    assert propose_shape(8, 100, 1) == MeshShape(fsdp=8)
+    # no budget → legacy behavior
+    assert propose_shape(6, 100) == MeshShape(dp=6)
+    # fixed tp rides along
+    assert propose_shape(8, 100, 50, base=MeshShape(tp=2)) == \
+        MeshShape(dp=2, fsdp=2, tp=2)
+
+
+def test_collective_stats_attributes_axes():
+    from edl_tpu.parallel.compat import shard_map
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = make_mesh(4, MeshSpec(dp=2, fsdp=2))
+
+    def body(x):
+        return jax.lax.psum(x, "dp")
+
+    f = shard_map(body, mesh=mesh, in_specs=P("dp", "fsdp"),
+                  out_specs=P(None, "fsdp"), check_vma=False)
+    x = jax.device_put(jnp.ones((4, 4)),
+                       NamedSharding(mesh, P("dp", "fsdp")))
+    stats = collective_stats(jax.jit(f).lower(x).compile(), mesh)
+    assert "dp" in stats and stats["dp"]["ops"].get("all-reduce", 0) >= 1
+    assert stats["dp"]["bytes"] > 0
+    assert total_collective_counts(stats)["all-reduce"] >= 1
+
+
+# -- the live dp×fsdp shape change (acceptance) ------------------------------
+
+
+def test_live_shape_change_4x1_to_2x2_preserves_state():
+    """The headline: a (4,1)→(2,2) re-split on 4 CPU devices goes through
+    the transactional resize — no checkpoint round-trip, loss continuity
+    exact, params bit-identical, recorded bytes_moved strictly under the
+    plan's own gather-scatter bound."""
+    x, y = synthetic_classification()
+    t = make_trainer(n0=4, kind="fsdp")
+    for i in range(8):
+        t.step((x[i * 64:(i + 1) * 64], y[i * 64:(i + 1) * 64]))
+    ev_before = t.eval_loss((x, y))
+    before = jax.tree.map(np.asarray, t.state.params)
+    assert t.shape == MeshShape(dp=4)
+
+    assert t.resize(MeshShape(dp=2, fsdp=2)) is True
+    assert t.shape == MeshShape(dp=2, fsdp=2)
+    assert t.world_size == 4  # same chips, different split
+
+    after = jax.tree.map(np.asarray, t.state.params)
+    assert jax.tree.all(jax.tree.map(
+        lambda a, b: bool(np.array_equal(a, b)), before, after))
+    assert abs(t.eval_loss((x, y)) - ev_before) < 1e-5
+
+    evt = t.resize_events[-1]
+    assert evt["shape"] == "dp2xfsdp2"
+    assert evt["replan_ms"] >= 0.0 and evt["transfer"] == "device"
+    assert evt["bytes_moved"] < evt["bytes_naive"]  # strict (== 0 here)
+
+    # params really are fsdp-sharded now (not silently replicated)
+    w = t.state.params["w1"]
+    assert max(s.data.nbytes for s in w.addressable_shards) == w.nbytes // 2
+
+    # and it keeps learning on the new layout
+    for i in range(10):
+        t.step((x[i * 32:(i + 1) * 32], y[i * 32:(i + 1) * 32]))
+    assert np.isfinite(t.eval_loss((x, y)))
+
+
+def test_shape_preserving_resize_degenerates_to_pure_dp_bit_identically():
+    """resize(n) through the int path and resize(MeshShape(dp=n)) are the
+    SAME layout: identical cache key, identical mesh, bit-identical
+    state — the legacy path is a degenerate case of the shape path, not
+    a parallel implementation."""
+    x, y = synthetic_classification(n=128)
+    a = make_trainer(n0=2, kind="replicated")
+    b = make_trainer(n0=2, kind="replicated")
+    a.step((x[:64], y[:64]))
+    b.step((x[:64], y[:64]))
+    assert a.resize(4) is True
+    assert b.resize(MeshShape(dp=4)) is True
+    assert a._cache_key(4) == b._cache_key(MeshShape(dp=4))
+    assert a.shape == b.shape == MeshShape(dp=4)
+    pa = jax.tree.map(np.asarray, a.state.params)
+    pb = jax.tree.map(np.asarray, b.state.params)
+    assert jax.tree.all(jax.tree.map(
+        lambda u, v: bool(np.array_equal(u, v)), pa, pb))
+    # the int resize is a no-op against the equal shape (and vice versa)
+    assert a.matches(MeshShape(dp=4)) and b.matches(4)
+    assert a.resize(MeshShape(dp=4)) is True and a.resizes == 1
+
+
+def test_same_size_different_shapes_are_distinct_cache_entries():
+    x, y = synthetic_classification(n=128)
+    t = make_trainer(n0=4, kind="fsdp")
+    t.step((x[:64], y[:64]))
+    assert t.resize(MeshShape(dp=2, fsdp=2))
+    t.step((x[:64], y[:64]))
+    assert t.resize(4)  # back to pure dp — a cache hit, not a recompile
+    keys = set(t._step_cache)
+    assert len(keys) == 2 and {k[0] for k in keys} == {4}
+    # oscillating back reuses the exact staged mesh (stale-mesh guard)
+    mesh_22 = t._step_cache[t._cache_key(MeshShape(dp=2, fsdp=2))].mesh
+    assert t.resize(MeshShape(dp=2, fsdp=2))
+    assert t.mesh is mesh_22
+
+
+def test_shape_resize_rollback_restores_old_layout(monkeypatch):
+    """A mid-reshard failure during a SHAPE change rolls back to the old
+    layout (mesh identity, shape, live training) and the retry lands."""
+    from edl_tpu.runtime import elastic as elastic_mod
+
+    x, y = synthetic_classification(n=128)
+    t = make_trainer(n0=4, kind="fsdp")
+    t.step((x[:64], y[:64]))
+    old_mesh, old_shape = t.mesh, t.shape
+    ev0 = t.eval_loss((x[:64], y[:64]))
+
+    calls = []
+    real = elastic_mod._reshard
+
+    def failing(tree, shardings):
+        calls.append(1)
+        if len(calls) == 2:  # params staged, opt-state put explodes
+            raise RuntimeError("injected: transfer failed mid-reshard")
+        return real(tree, shardings)
+
+    monkeypatch.setattr(elastic_mod, "_reshard", failing)
+    assert t.resize(MeshShape(dp=2, fsdp=2)) is False
+    assert t.mesh is old_mesh and t.shape == old_shape
+    assert t.resizes_failed == 1 and t.resizes == 0
+    assert t.eval_loss((x[:64], y[:64])) == pytest.approx(ev0, rel=1e-6)
+    assert np.isfinite(t.step((x[:64], y[:64])))
+    monkeypatch.setattr(elastic_mod, "_reshard", real)
+    assert t.resize(MeshShape(dp=2, fsdp=2)) is True
+    assert t.shape == MeshShape(dp=2, fsdp=2)
+
+
+def test_host_fallback_retries_then_rolls_back(monkeypatch):
+    """With the opt-in enabled, a failed device-to-device reshard retries
+    through host memory (counted); when the host path fails too, the
+    transactional rollback still holds."""
+    from edl_tpu.observability.collector import get_counters
+    from edl_tpu.runtime import elastic as elastic_mod
+
+    x, y = synthetic_classification(n=128)
+    t = make_trainer(n0=4, kind="fsdp", reshard_host_fallback=True)
+    t.step((x[:64], y[:64]))
+    before = get_counters().get("reshard_host_fallbacks")
+
+    monkeypatch.setattr(
+        elastic_mod, "_reshard",
+        lambda tree, sh: (_ for _ in ()).throw(
+            RuntimeError("injected: no direct transfer path")))
+    assert t.resize(MeshShape(dp=2, fsdp=2)) is True  # host path saved it
+    assert t.shape == MeshShape(dp=2, fsdp=2)
+    assert t.resize_events[-1]["transfer"] == "host"
+    assert get_counters().get("reshard_host_fallbacks") == before + 1
+
+    # both paths down → rollback, not a half-moved world
+    monkeypatch.setattr(
+        elastic_mod, "_reshard_host",
+        lambda tree, sh: (_ for _ in ()).throw(
+            RuntimeError("injected: host path down too")))
+    assert t.resize(4) is False
+    assert t.shape == MeshShape(dp=2, fsdp=2)
+    assert np.isfinite(t.step((x[:64], y[:64])))
+
+
+def test_shape_prewarm_hits_skip_compile():
+    x, y = synthetic_classification(n=128)
+    t = make_trainer(n0=4, kind="fsdp")
+    t.step((x[:64], y[:64]))
+    t.prewarm([MeshShape(dp=2, fsdp=2)], wait=True)
+    assert t.resize(MeshShape(dp=2, fsdp=2))
+    evt = t.resize_events[-1]
+    assert evt["prewarm_hit"] is True
+    assert evt["compile_ms"] < 100.0
+
+
+def test_resize_phase_histogram_gains_replan_phase():
+    from edl_tpu.observability.metrics import get_registry
+
+    x, y = synthetic_classification(n=128)
+    t = make_trainer(n0=2, kind="replicated")
+    t.step((x[:64], y[:64]))
+    assert t.resize(4)
+    rendered = get_registry().render()
+    assert 'edl_resize_phase_seconds_count{phase="replan"}' in rendered
+    assert 'edl_resize_phase_seconds_count{phase="reshard"}' in rendered
+
+
+# -- control plane: shape hints ---------------------------------------------
+
+
+def test_autoscaler_shape_policy_hints_full_shape():
+    """With mesh_shape_for set, hint_sink fires (uid, MeshShape) at plan
+    time; without it, the bare count (back-compat)."""
+    from edl_tpu.api.types import (
+        RESOURCE_CPU, RESOURCE_MEMORY, ResourceRequirements, TrainerSpec,
+        TrainingJob, TrainingJobSpec,
+    )
+    from edl_tpu.cluster.fake import FakeCluster
+    from edl_tpu.scheduler.autoscaler import Autoscaler
+
+    def mk_job(name):
+        return TrainingJob(name=name, spec=TrainingJobSpec(
+            fault_tolerant=True,
+            trainer=TrainerSpec(
+                min_instance=2, max_instance=8,
+                resources=ResourceRequirements(
+                    requests={RESOURCE_CPU: "1", RESOURCE_MEMORY: "100M"},
+                    limits={RESOURCE_CPU: "1", RESOURCE_MEMORY: "100M"}))))
+
+    c = FakeCluster()
+    c.add_node("n0", cpu_milli=8000, memory_mega=100_000)
+    hints = []
+    a = Autoscaler(
+        c, max_load_desired=1.0,
+        mesh_shape_for=lambda uid, n: propose_shape(
+            n, state_bytes=100, max_bytes_per_device=50))
+    a.hint_sink = lambda uid, target: hints.append((uid, target))
+    job = mk_job("shaped")
+    c.create_resources(job)
+    a.on_add(job)
+    a.tick()
+    assert hints, "plan should have hinted"
+    uid, target = hints[-1]
+    assert uid == job.full_name
+    assert isinstance(target, MeshShape) and target.fsdp == 2
+
+    # a broken shape policy degrades to the bare count, never kills the tick
+    hints.clear()
+    a.mesh_shape_for = lambda uid, n: (_ for _ in ()).throw(ValueError("x"))
+    for i in range(4):
+        c.add_system_pod(f"sys-{i}", "n0", cpu_request_milli=1000,
+                         memory_request_mega=100)
+    a.tick()
+    if hints:  # a shrink plan fired: the hint is the raw int
+        assert isinstance(hints[-1][1], int)
+
+
+def test_local_job_shape_policy_reparallelizes_live():
+    """End-to-end: a LocalElasticJob with a shape_for policy commits the
+    policy's layout when the pod count moves — the full dp→fsdp pivot
+    through the real run loop, hint-prewarmed."""
+    from edl_tpu.api.types import (
+        RESOURCE_CPU, RESOURCE_MEMORY, ResourceRequirements, TrainerSpec,
+        TrainingJob, TrainingJobSpec,
+    )
+    from edl_tpu.cluster.fake import FakeCluster
+    from edl_tpu.coord import local_service
+    from edl_tpu.runtime.data import ShardRegistry
+    from edl_tpu.runtime.local import LocalElasticJob
+
+    x, y = synthetic_classification(n=1024)
+    coord = local_service(passes=2)
+    reg = ShardRegistry()
+    reg.add_arrays(coord, (x, y), num_shards=8)
+
+    cluster = FakeCluster()
+    cluster.add_node("n0", cpu_milli=10_000, memory_mega=100_000)
+    job = TrainingJob(name="reparallel", spec=TrainingJobSpec(
+        fault_tolerant=True,
+        trainer=TrainerSpec(
+            min_instance=2, max_instance=4,
+            resources=ResourceRequirements(
+                requests={RESOURCE_CPU: "1", RESOURCE_MEMORY: "100M"},
+                limits={RESOURCE_CPU: "1", RESOURCE_MEMORY: "100M"}))))
+    cluster.create_resources(job)
+    cluster.update_trainer_parallelism(job, 2)
+    cluster.reconcile()
+
+    t = make_trainer(n0=2, kind="fsdp")
+    state_bytes = sum(l.nbytes for l in jax.tree.leaves(t.state.params))
+    # budget forces fsdp=2 at every world size >= 2
+    policy = lambda n: propose_shape(  # noqa: E731
+        n, state_bytes=state_bytes,
+        max_bytes_per_device=state_bytes // 2 + 1)
+    runner = LocalElasticJob(job, cluster, t, coord, reg.fetch,
+                             batch_size=64, shape_for=policy,
+                             resize_defer_s=0)
+    grown = []
+
+    def on_step(step, loss, world):
+        if step == 3 and not grown:
+            cluster.update_trainer_parallelism(job, 4)
+            cluster.reconcile()
+            grown.append(True)
+
+    report = runner.run(max_steps=20, on_step=on_step)
+    assert report.resizes >= 1
+    assert t.shape == MeshShape(dp=2, fsdp=2)  # policy's 4-chip layout
+    assert report.resize_bytes_moved and report.resize_replan_ms
+    losses = np.asarray(report.losses)
+    assert np.isfinite(losses).all()
+    # loss continuity across the reparallelizing resize
+    b = report.resize_steps[-1]
+    pre = losses[max(b - 3, 0):b].mean() if b else losses[0]
+    post = losses[b:b + 3].mean()
+    assert post < max(pre, 0.05) * 2.0
+
+
+def test_unresolvable_resize_target_soft_fails():
+    """A pod count the spec's fixed axes don't divide is a FAILED resize
+    (counted, rolled back), never an exception out of the step loop —
+    the autoscaler can land any count it likes (review finding #1)."""
+    t = make_trainer(n0=4, kind="replicated", spec=MeshSpec(dp=-1, tp=2))
+    x, y = synthetic_classification(n=128)
+    t.step((x[:64], y[:64]))
+    assert t.matches(3) is False          # no crash
+    assert t.is_building(3) is False      # no crash
+    failed_before = t.resizes_failed
+    assert t.resize(3) is False           # soft-fail, old world live
+    assert t.resizes_failed == failed_before + 1
+    assert t.world_size == 4
+    assert np.isfinite(t.step((x[:64], y[:64])))
+    assert t.resize(8) is True            # a divisible count still lands
+
+
+def test_propose_shape_uses_ceil_division_at_the_budget_boundary():
+    """Per-chip footprint is ceil(bytes/fsdp); floor blessed over-budget
+    layouts exactly at the boundary (review finding #2)."""
+    # 101 B over fsdp=2 is 51 B/chip > 50 — must shard harder, not stop
+    s = propose_shape(8, state_bytes=101, max_bytes_per_device=50)
+    assert s.fsdp == 4 and -(-101 // s.fsdp) <= 50
+    # exact fits still accepted
+    assert propose_shape(8, 100, 50) == MeshShape(dp=4, fsdp=2)
+
+
+def test_collective_stats_async_start_counts_payload_once():
+    """`-start` async collectives return (operand alias, output, ...):
+    the census must count the payload once, not sum the tuple (review
+    finding: sync vs async lowering of one program must agree)."""
+    mesh = make_mesh(2, MeshSpec(dp=2))
+    sync = ('%ag = f32[8,4]{1,0} all-gather(f32[4,4]{1,0} %p), '
+            'replica_groups={{0,1}}, dimensions={0}')
+    async_ = ('%ags = (f32[4,4]{1,0}, f32[8,4]{1,0}) '
+              'all-gather-start(f32[4,4]{1,0} %p), '
+              'replica_groups={{0,1}}, dimensions={0}')
+    s_sync = collective_stats(sync, mesh)
+    s_async = collective_stats(async_, mesh)
+    assert s_sync["dp"]["bytes"] == 8 * 4 * 4
+    assert s_async["dp"]["bytes"] == s_sync["dp"]["bytes"]
+    assert s_async["dp"]["ops"] == {"all-gather": 1}
+
+
+def test_local_job_shape_policy_exception_degrades_to_count():
+    """A raising shape_for policy must not kill the step loop: the
+    target degrades to the bare count (review finding)."""
+    from edl_tpu.api.types import (
+        RESOURCE_CPU, RESOURCE_MEMORY, ResourceRequirements, TrainerSpec,
+        TrainingJob, TrainingJobSpec,
+    )
+    from edl_tpu.cluster.fake import FakeCluster
+    from edl_tpu.runtime.local import LocalElasticJob
+
+    cluster = FakeCluster()
+    cluster.add_node("n0", cpu_milli=8000, memory_mega=100_000)
+    job = TrainingJob(name="j", spec=TrainingJobSpec(
+        fault_tolerant=True,
+        trainer=TrainerSpec(
+            min_instance=2, max_instance=4,
+            resources=ResourceRequirements(
+                requests={RESOURCE_CPU: "1", RESOURCE_MEMORY: "100M"},
+                limits={RESOURCE_CPU: "1", RESOURCE_MEMORY: "100M"}))))
+    t = make_trainer(n0=2, kind="replicated")
+
+    def bad_policy(n):
+        raise ValueError("no factorization for you")
+
+    runner = LocalElasticJob(job, cluster, t, None, None, batch_size=64,
+                             shape_for=bad_policy)
+    assert runner._target_for(4) == 4  # degraded to the bare count
