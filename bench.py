@@ -1197,6 +1197,69 @@ def _parse_world_phases(text: str) -> list[dict]:
     return records
 
 
+def coord_ha_leg(cycles: int = 5) -> dict:
+    """Coordinator HA failover latency (doc/coordinator_ha.md): SIGKILL
+    the primary of a replicated pair and measure how long the
+    multi-endpoint client is dark — from the kill to its next acked
+    operation on the promoted standby.  The killed node is respawned as
+    a standby and re-attached (REPLICATE) each cycle, so the number also
+    covers the steady-state operator loop, not just the first failover.
+    No accelerator dependence; the headline is the control-plane half of
+    the 'coordinator death is a failover, not a reform storm' claim."""
+    import signal
+    import socket
+    import statistics
+    import tempfile
+
+    from edl_tpu.coord import CoordClient, spawn_ha_pair, spawn_server
+    from edl_tpu.observability.collector import get_counters
+
+    def raw(port: int, line: str) -> str:
+        with socket.create_connection(("127.0.0.1", port), timeout=3) as s:
+            s.settimeout(3)
+            s.sendall((line + "\n").encode())
+            return s.makefile("rb").readline().decode().strip()
+
+    tmp = tempfile.mkdtemp(prefix="edl-bench-ha-")
+    pr, sb = spawn_ha_pair(tmp, repl_lease_ms=1000)
+    nodes = {pr.port: pr, sb.port: sb}
+    state_of = {pr.port: os.path.join(tmp, "coord-a.state"),
+                sb.port: os.path.join(tmp, "coord-b.state")}
+    client = CoordClient("127.0.0.1", pr.port, timeout=2.0,
+                         reconnect_window_s=20.0, promote_grace_s=0.3,
+                         endpoints=[("127.0.0.1", sb.port)])
+    failover_ms = []
+    try:
+        client.kv_set("sentinel", b"0")
+        for i in range(cycles):
+            victim = client.port
+            survivor = next(p for p in nodes if p != victim)
+            nodes[victim].process.send_signal(signal.SIGKILL)
+            nodes[victim].process.wait(timeout=10)
+            t0 = time.monotonic()
+            client.kv_set("sentinel", str(i + 1).encode())
+            failover_ms.append((time.monotonic() - t0) * 1000.0)
+            assert client.port == survivor, "client did not fail over"
+            nodes[victim] = spawn_server(
+                port=victim, standby=True, state_file=state_of[victim],
+                repl_lease_ms=1000)
+            raw(survivor, f"REPLICATE 127.0.0.1:{victim}")
+        fence = int(raw(client.port, "ROLE").split(" ")[2])
+    finally:
+        client.close()
+        for handle in nodes.values():
+            handle.stop()
+    return {
+        "cycles": cycles,
+        "failover_ms_p50": round(statistics.median(failover_ms), 1),
+        "failover_ms_max": round(max(failover_ms), 1),
+        "failover_ms": [round(x, 1) for x in failover_ms],
+        "fence_after": fence,  # == cycles: one promotion per kill
+        "client_failovers": get_counters().get("coord_failovers"),
+        "fencing_rejects": get_counters().get("coord_fencing_rejects"),
+    }
+
+
 def reform_latency_leg() -> dict:
     """The REAL fault-tolerance path's latency (VERDICT r2 weak #3): the
     supervised world dance — child teardown → membership settle →
@@ -1573,6 +1636,12 @@ def main() -> None:
     # an external SIGKILL would orphan the coord server and workers.
     reform = _run_leg("reform", timeout_s=560)
 
+    # coordinator HA: primary-kill → promoted-standby failover latency
+    # (control plane only, no accelerator)
+    coord_ha = _run_leg("coord_ha", timeout_s=180,
+                        extra_env={"JAX_PLATFORMS": "cpu",
+                                   "PALLAS_AXON_POOL_IPS": ""})
+
     # Headline discipline (VERDICT r5 weak #4): LEAD with metrics that
     # can still move — contended admission latency, the MFU suite,
     # reform/resize latencies.  The saturated packing ratio (100 % vs the
@@ -1607,7 +1676,7 @@ def main() -> None:
                    "large": large, "long_context": long_ctx,
                    "model_zoo": zoo, "elastic": elastic,
                    "reparallel": reparallel, "reform": reform,
-                   "tpu_world_cycle": tpu_cycle},
+                   "coord_ha": coord_ha, "tpu_world_cycle": tpu_cycle},
     }
     print(json.dumps(result))
     # Compact headline summary as the LAST stdout line: the driver records
@@ -1640,6 +1709,11 @@ def main() -> None:
         "crash_reform_s": reform.get("crash_reform_s"),
         "graceful_reform_s": reform.get("graceful_reform_s"),
         "join_from_spawn_s": reform.get("join_total_from_spawn_s"),
+        # HA control plane: a coordinator death is a sub-second-scale
+        # failover (client dark time), never a reform
+        "coord_ha_failover_ms_p50": coord_ha.get("failover_ms_p50"),
+        "coord_ha_failover_ms_max": coord_ha.get("failover_ms_max"),
+        "coord_ha_fence_after": coord_ha.get("fence_after"),
         "elastic_resizes": elastic.get("resizes"),
         "elastic_resizes_failed": elastic.get("resizes_failed"),
         "elastic_stalls_detected": elastic.get("stalls_detected"),
@@ -1696,6 +1770,8 @@ if __name__ == "__main__":
             out = model_zoo_leg()
         elif leg == "elastic":
             out = elastic_leg()
+        elif leg == "coord_ha":
+            out = coord_ha_leg()
         elif leg == "reparallel":
             out = reparallel_leg()
         elif leg == "reform":

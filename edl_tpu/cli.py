@@ -122,6 +122,10 @@ def cmd_coordinator(args) -> int:
     argv = ["--port", str(args.port)]
     if args.state_file:
         argv += ["--state-file", args.state_file]
+    if args.standby:
+        argv += ["--standby"]
+    if args.replicate_to:
+        argv += ["--replicate-to", args.replicate_to]
     if args.health_port is not None:
         # explicit flag wins over the env; when absent, coord_server.main
         # owns the EDL_HEALTH_PORT fallback (one policy, one place)
@@ -328,6 +332,13 @@ def build_parser() -> argparse.ArgumentParser:
                    default=os.environ.get("EDL_COORD_STATE_FILE", ""),
                    help="write-through durability file (restart with the "
                         "same path to resume queue/KV/epoch state)")
+    c.add_argument("--standby", action="store_true",
+                   default=os.environ.get("EDL_COORD_STANDBY", "") == "1",
+                   help="start as a warm HA standby (doc/coordinator_ha.md)")
+    c.add_argument("--replicate-to",
+                   default=os.environ.get("EDL_COORD_REPLICATE_TO", ""),
+                   help="host:port[,host:port] standbys this primary "
+                        "streams its state to before acking mutations")
     c.add_argument("--health-port", type=int, default=None,
                    help="HTTP GET /healthz port; default from "
                         "EDL_HEALTH_PORT (compiled manifests set 8080), "

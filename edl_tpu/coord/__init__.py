@@ -17,13 +17,14 @@ the canonical signatures).
 from edl_tpu.coord.service import (
     DEFAULT_MEMBER_TTL_MS,
     DEFAULT_TASK_TIMEOUT_MS,
+    CoordFenced,
     LeaseStatus,
     PyCoordService,
     QueueStats,
 )
 from edl_tpu.coord.bindings import NativeCoordService, native_available
-from edl_tpu.coord.client import CoordClient
-from edl_tpu.coord.server import spawn_server
+from edl_tpu.coord.client import CoordClient, CoordUnavailable
+from edl_tpu.coord.server import spawn_ha_pair, spawn_server
 
 
 def local_service(task_timeout_ms: int = DEFAULT_TASK_TIMEOUT_MS,
@@ -38,6 +39,8 @@ def local_service(task_timeout_ms: int = DEFAULT_TASK_TIMEOUT_MS,
 
 __all__ = [
     "CoordClient",
+    "CoordFenced",
+    "CoordUnavailable",
     "DEFAULT_MEMBER_TTL_MS",
     "DEFAULT_TASK_TIMEOUT_MS",
     "LeaseStatus",
@@ -46,5 +49,6 @@ __all__ = [
     "QueueStats",
     "local_service",
     "native_available",
+    "spawn_ha_pair",
     "spawn_server",
 ]

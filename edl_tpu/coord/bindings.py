@@ -89,6 +89,12 @@ def _load() -> Optional[ctypes.CDLL]:
         "edl_kv_del": ([vp, cp], i32),
         "edl_kv_cas": ([vp, cp, cp, i64, cp, i64], i32),
         "edl_kv_keys": ([vp, cp, cp, i64], i64),
+        "edl_svc_snapshot": ([vp, cp, i64], i64),
+        "edl_svc_snapshot_repl": ([vp, i64, cp, i64], i64),
+        "edl_svc_restore": ([vp, cp, i64], i32),
+        "edl_svc_restore_repl": ([vp, cp, i64, i64], i32),
+        "edl_svc_fence": ([vp], i64),
+        "edl_svc_stream_version": ([vp], i64),
     }
     for name, (argtypes, restype) in sigs.items():
         fn = getattr(lib, name)
@@ -292,6 +298,38 @@ class NativeCoordService:
         n, buf = self._grown(lambda b, cap: self._lib.edl_kv_keys(
             self._h, prefix.encode(), b, cap))
         return [k for k in buf.raw[:max(n, 0)].decode().splitlines() if k]
+
+    # -- snapshot / restore (HA replication + durability parity) -----------
+    #
+    # The native snapshot format is THE format (coord.cc Snapshot) —
+    # PyCoordService.snapshot() emits the same text, and the cross-backend
+    # tests in tests/test_coord_ha.py restore each one into the other.
+
+    def snapshot(self, include_members: bool = False) -> str:
+        if include_members:
+            n, buf = self._grown(lambda b, cap: self._lib.edl_svc_snapshot_repl(
+                self._h, self._clock(), b, cap))
+        else:
+            n, buf = self._grown(lambda b, cap: self._lib.edl_svc_snapshot(
+                self._h, b, cap))
+        return buf.raw[:max(n, 0)].decode()
+
+    def restore(self, blob: str) -> bool:
+        data = blob.encode()
+        return bool(self._lib.edl_svc_restore(self._h, data, len(data)))
+
+    def restore_repl(self, blob: str) -> bool:
+        """Clear-then-restore including members (fresh TTLs) — the
+        standby-side apply the native server runs per SYNC."""
+        data = blob.encode()
+        return bool(self._lib.edl_svc_restore_repl(self._h, data, len(data),
+                                                   self._clock()))
+
+    def fence(self) -> int:
+        return self._lib.edl_svc_fence(self._h)
+
+    def stream_version(self) -> int:
+        return self._lib.edl_svc_stream_version(self._h)
 
     def _grown(self, call):
         """Run a fill-buffer C call, growing the buffer until it fits."""

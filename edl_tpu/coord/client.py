@@ -23,6 +23,20 @@ class CoordError(RuntimeError):
     pass
 
 
+class CoordUnavailable(CoordError, OSError):
+    """No coordination endpoint could serve the call within the deadline
+    budget: every endpoint was down, fenced, or unreachable for the whole
+    window.  Subclasses BOTH CoordError and OSError so every existing
+    ``except (OSError, CoordError)`` outage handler keeps working while
+    callers that care can catch the typed failure."""
+
+
+class _Fenced(CoordError):
+    """Internal: the active endpoint answered ``ERR fenced`` — it is a
+    standby or a deposed primary.  Drives the failover path in
+    :meth:`CoordClient._call_traced`; never escapes the client."""
+
+
 #: Reconnect backoff envelope: first retry lands within ~50 ms (a blip —
 #: e.g. one dropped connection — must not stall a step boundary), doubling
 #: to a 2 s ceiling (a coordinator POD restart takes seconds; hammering it
@@ -70,14 +84,42 @@ class CoordClient:
     succeeds again.  Hooks run on the calling thread, under the client's
     request lock — keep them cheap and non-reentrant (no coord calls).
     Hooks are process-local: they do not survive pickling (a deserialized
-    client starts with both unset)."""
+    client starts with both unset).
+
+    **HA failover** (doc/coordinator_ha.md): pass ``endpoints`` — a list
+    of ``"host:port"`` strings or ``(host, port)`` tuples covering the
+    primary AND its standbys — and the retry loop becomes a failover
+    loop.  On a connection break or an ``ERR fenced`` reply the client
+    probes every endpoint's ROLE, re-targets a live primary if one
+    exists, and otherwise (after ``promote_grace_s`` of outage, so a
+    blip never deposes a healthy primary) PROMOTEs the standby holding
+    the highest replicated stream position with a fencing token that
+    beats every token seen.  In-flight long-polls simply re-park on the
+    new primary (the chunked WAITEPOCH/KVWAIT re-issue rides the same
+    retry path).  ``coord_failovers`` / ``coord_fencing_rejects`` land
+    in the shared metrics registry.  When every endpoint stays down the
+    call raises :class:`CoordUnavailable` once ``reconnect_window_s``
+    (the per-call deadline budget) lapses — it never hangs forever."""
 
     def __init__(self, host: str, port: int, timeout: float = 10.0,
-                 reconnect_window_s: float = 20.0) -> None:
+                 reconnect_window_s: float = 20.0,
+                 endpoints=None, promote_grace_s: float = 0.5) -> None:
         self.host = host
         self.port = port
         self.timeout = timeout
         self.reconnect_window_s = reconnect_window_s
+        self.promote_grace_s = promote_grace_s
+        eps: list[tuple[str, int]] = [(host, int(port))]
+        for ep in endpoints or []:
+            if isinstance(ep, str):
+                h, _, p = ep.rpartition(":")
+                ep = (h, p)
+            ep = (ep[0], int(ep[1]))
+            if ep not in eps:
+                eps.append(ep)
+        #: every coordination endpoint (active one first at construction);
+        #: failover re-points host/port at whichever member is primary
+        self.endpoints = eps
         self._lock = threading.Lock()
         self._rng = random.Random()
         #: set once a WAIT command comes back ERR (older server): every
@@ -89,31 +131,89 @@ class CoordClient:
         # (un)pickled into fresh processes during the elastic dance, and a
         # world child spawned while the coordinator pod restarts must not
         # die on ConnectionRefused when a 2 s wait would have connected.
+        # With an endpoint set, every member is tried each round — a child
+        # spawned mid-failover connects to whoever answers.
         deadline = time.monotonic() + max(self.reconnect_window_s, 0.0)
         attempt = 0
+        last_exc: Optional[OSError] = None
         while True:
-            try:
-                self._connect()
+            connected = False
+            for h, p in self.endpoints:
+                # clamp every connect to the REMAINING budget: against
+                # black-holed (no-RST) endpoints an unclamped per-dial
+                # timeout would overshoot the documented 2x-budget bound
+                # by N_endpoints x timeout
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 and attempt > 0:
+                    break
+                try:
+                    self.host, self.port = h, p
+                    self._connect(connect_timeout=min(
+                        self.timeout, max(remaining, 0.05)))
+                    connected = True
+                    break
+                except OSError as exc:
+                    last_exc = exc
+            if connected:
                 break
-            except OSError:
-                if time.monotonic() >= deadline:
-                    raise
-                time.sleep(backoff_delay(attempt, self._rng))
-                attempt += 1
+            self.host, self.port = self.endpoints[0]
+            if time.monotonic() >= deadline:
+                raise CoordUnavailable(
+                    f"no coordination endpoint reachable within "
+                    f"{self.reconnect_window_s}s "
+                    f"(tried {self.endpoints}): {last_exc}") from last_exc
+            time.sleep(backoff_delay(attempt, self._rng))
+            attempt += 1
+        # endpoint-set discovery: the supervisor publishes the full HA
+        # set to the coord-endpoints KV key (runtime/multihost.py), so a
+        # client constructed knowing ONE address learns the standbys it
+        # will need when that address dies.  One short side-channel
+        # exchange — never the riding connection, never the retry loop
+        # (discovery must not promote anyone as a side effect); a fenced
+        # or pre-HA server just leaves the set as configured.
+        self._discover_endpoints()
 
-    def _connect(self) -> None:
-        self._sock = socket.create_connection((self.host, self.port),
-                                              timeout=self.timeout)
+    def _discover_endpoints(self) -> None:
+        r = self._raw_exchange((self.host, self.port),
+                               "KVGET coord-endpoints")
+        if not r or r[0] != "OK" or len(r) < 2:
+            return
+        try:
+            import json
+
+            eps = json.loads(bytes.fromhex(r[1]).decode())
+        except (ValueError, UnicodeDecodeError):
+            return
+        for ep_s in eps:
+            if not isinstance(ep_s, str) or ":" not in ep_s:
+                continue
+            h, _, p = ep_s.rpartition(":")
+            try:
+                ep = (h, int(p))
+            except ValueError:
+                continue
+            if ep not in self.endpoints:
+                self.endpoints.append(ep)
+
+    def _connect(self, connect_timeout: Optional[float] = None) -> None:
+        self._sock = socket.create_connection(
+            (self.host, self.port),
+            timeout=self.timeout if connect_timeout is None
+            else connect_timeout)
+        self._sock.settimeout(self.timeout)  # operational I/O timeout
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._rfile = self._sock.makefile("rb")
 
     # Picklable by address: a deserialized client opens its own connection.
     # This is what lets the elastic supervisor hand a coord handle to its
     # per-world child processes (runtime.multihost) — sockets can't cross
-    # a process boundary, addresses can.
+    # a process boundary, addresses can.  The endpoint SET crosses too, so
+    # a child spawned during a failover finds the promoted standby.
     def __getstate__(self) -> dict:
         return {"host": self.host, "port": self.port, "timeout": self.timeout,
-                "reconnect_window_s": self.reconnect_window_s}
+                "reconnect_window_s": self.reconnect_window_s,
+                "endpoints": list(self.endpoints),
+                "promote_grace_s": self.promote_grace_s}
 
     def __setstate__(self, state: dict) -> None:
         self.__init__(**state)
@@ -132,7 +232,13 @@ class CoordClient:
         """Returns (response tokens, retransmitted) — ``retransmitted`` is
         True iff the request was re-sent after a connection break, i.e.
         the only window in which an executed-but-unacked duplicate is
-        possible (kv_cas narrows its lost-ack inference to exactly this)."""
+        possible (kv_cas narrows its lost-ack inference to exactly this;
+        an ``ERR fenced`` reply proves the op did NOT execute, so a
+        fenced-then-failed-over retry does not widen the window).
+
+        Raises :class:`CoordUnavailable` when the per-call deadline
+        budget (``reconnect_window_s``) lapses with no endpoint serving —
+        the typed bound that replaced the unbounded outage-riding loop."""
         line = (" ".join(parts) + "\n").encode()
         retransmitted = False
         # per-reform request load is a recorded fact, not a guess: every
@@ -143,6 +249,7 @@ class CoordClient:
             t0 = time.monotonic()
             deadline = t0 + self.reconnect_window_s
             attempt = 0
+            outage_since: Optional[float] = None
             while True:
                 try:
                     self._sock.sendall(line)
@@ -150,22 +257,150 @@ class CoordClient:
                     if not resp:
                         raise CoordError(
                             "coordination server closed the connection")
+                    r = resp.decode().strip().split(" ")
+                    if r[0] == "ERR" and len(r) > 1 and r[1] == "fenced":
+                        # standby / deposed primary: the op did not run —
+                        # fail over and re-send it at the real primary
+                        get_counters().inc("coord_fencing_rejects")
+                        raise _Fenced(" ".join(r))
                     if attempt:
                         self._note_recovered(time.monotonic() - t0)
-                    return resp.decode().strip().split(" "), retransmitted
-                except (OSError, CoordError):
+                    return r, retransmitted
+                except (OSError, CoordError) as exc:
                     now = time.monotonic()
                     if now >= deadline:
-                        raise
-                    retransmitted = True
+                        raise CoordUnavailable(
+                            f"call {parts[0]} exhausted its "
+                            f"{self.reconnect_window_s}s deadline budget "
+                            f"across {self.endpoints}: {exc}") from exc
+                    if not isinstance(exc, _Fenced):
+                        retransmitted = True
+                    if outage_since is None:
+                        outage_since = now
                     self._note_degraded(attempt, now - t0)
                     time.sleep(backoff_delay(attempt, self._rng))
                     attempt += 1
-                    try:
-                        self.close()
-                        self._connect()
-                    except OSError:
-                        pass  # server still down; keep retrying
+                    # grace is anchored at the FIRST failure, not call
+                    # start: a long-poll chunk can park healthy for up to
+                    # a second before a blip, and that healthy time must
+                    # not count toward deposing the primary
+                    self._reconnect_failover(
+                        allow_promote=time.monotonic() - outage_since
+                        >= self.promote_grace_s)
+
+    # -- failover ----------------------------------------------------------
+
+    def _reconnect_failover(self, allow_promote: bool) -> None:
+        """Re-establish a connection to SOME serving endpoint.
+
+        Single endpoint: plain redial (the pre-HA behavior).  Endpoint
+        set: probe every member's ROLE; prefer a live unfenced primary
+        (highest fence wins if two claim it — the older one will fence
+        itself on its next replication exchange), else — once the outage
+        outlasted ``promote_grace_s`` — promote the standby holding the
+        highest replicated stream position with a token beating every
+        token seen.  Best-effort: on total failure the caller's retry
+        loop (budget-bounded) comes back here."""
+        try:
+            self.close()
+        except OSError:
+            pass
+        if len(self.endpoints) == 1:
+            try:
+                self._connect()
+            except OSError:
+                pass  # still down; the caller's budget rules
+            return
+        roles: dict[tuple[str, int], tuple[str, int, int]] = {}
+        for ep in self.endpoints:
+            info = self._probe_role(ep)
+            if info is not None:
+                roles[ep] = info
+        target = None
+        promoted_fence = None
+        primaries = [(fence, ep) for ep, (role, fence, _v) in roles.items()
+                     if role == "primary"]
+        if primaries:
+            target = max(primaries)[1]
+        elif allow_promote and roles:
+            # fenced nodes are candidates too: a deposed ex-primary holds
+            # the newest state any reachable node has (and one that was
+            # re-attached as a mirror reports standby again) — excluding
+            # it would strand the job on a promotable, current node.  A
+            # SUSPENDED node (strict-mode primary with no standby link)
+            # is deliberately NOT a candidate: promoting a mirror around
+            # it is safe (strict acks nothing un-mirrored) and the
+            # suspension ends in deposition when its link heals.
+            standbys = [(v, fence, ep)
+                        for ep, (role, fence, v) in roles.items()
+                        if role in ("standby", "fenced")]
+            if standbys:
+                # promotion rule: the standby holding the LATEST durably
+                # persisted stream position, under a token that beats
+                # every fence any reachable node has seen
+                _v, _f, ep = max(standbys)
+                new_fence = max(f for (_r, f, _sv) in roles.values()) + 1
+                if self._send_promote(ep, new_fence):
+                    target = ep
+                    promoted_fence = new_fence
+        if target is None:
+            try:
+                self._connect()
+            except OSError:
+                pass
+            return
+        prev = (self.host, self.port)
+        self.host, self.port = target
+        try:
+            self._connect()
+        except OSError:
+            self.host, self.port = prev
+            return
+        if target != prev:
+            from edl_tpu.observability.tracing import get_tracer
+
+            get_counters().inc("coord_failovers")
+            get_tracer().instant(
+                "coord_failover", category="chaos",
+                from_endpoint=f"{prev[0]}:{prev[1]}",
+                to_endpoint=f"{target[0]}:{target[1]}",
+                promoted=promoted_fence is not None,
+                fence=promoted_fence if promoted_fence is not None
+                else roles[target][1])
+
+    def _raw_exchange(self, ep: tuple[str, int],
+                      line: str) -> Optional[list[str]]:
+        """One command over a dedicated short-timeout socket (never the
+        riding connection); None when unreachable."""
+        try:
+            with socket.create_connection(
+                    ep, timeout=min(self.timeout, 2.0)) as s:
+                s.settimeout(min(self.timeout, 2.0))
+                s.sendall((line + "\n").encode())
+                return s.makefile("rb").readline().decode().strip().split(" ")
+        except OSError:
+            return None
+
+    def _probe_role(self, ep: tuple[str, int]
+                    ) -> Optional[tuple[str, int, int]]:
+        """(role, fence, stream_version), or None when unreachable.
+        A pre-HA server answers ROLE with ERR unknown — treated as a
+        plain primary so mixed fleets degrade to the old behavior."""
+        r = self._raw_exchange(ep, "ROLE")
+        if r is None:
+            return None
+        if r[0] == "OK" and len(r) >= 4:
+            try:
+                return r[1], int(r[2]), int(r[3])
+            except ValueError:
+                return None
+        if self._verb_unknown(r):
+            return "primary", 0, -1  # pre-HA server
+        return None
+
+    def _send_promote(self, ep: tuple[str, int], fence: int) -> bool:
+        r = self._raw_exchange(ep, f"PROMOTE {fence}")
+        return r is not None and r[0] == "OK"
 
     def _note_degraded(self, attempt: int, elapsed_s: float) -> None:
         """Record the outage once (trace + counter) and fire the hook on

@@ -183,4 +183,34 @@ int64_t edl_kv_keys(void* h, const char* prefix, char* buf, int64_t cap) {
   return CopyOut(out, buf, cap);
 }
 
+// ---- snapshot / restore (HA replication + durability parity) ----
+
+int64_t edl_svc_snapshot(void* h, char* buf, int64_t cap) {
+  return CopyOut(static_cast<Service*>(h)->Snapshot(), buf, cap);
+}
+
+int64_t edl_svc_snapshot_repl(void* h, int64_t now_ms, char* buf,
+                              int64_t cap) {
+  return CopyOut(static_cast<Service*>(h)->SnapshotRepl(now_ms), buf, cap);
+}
+
+int edl_svc_restore(void* h, const char* blob, int64_t len) {
+  return static_cast<Service*>(h)->Restore(std::string(blob, len)) ? 1 : 0;
+}
+
+int edl_svc_restore_repl(void* h, const char* blob, int64_t len,
+                         int64_t now_ms) {
+  return static_cast<Service*>(h)->RestoreRepl(std::string(blob, len), now_ms)
+             ? 1
+             : 0;
+}
+
+int64_t edl_svc_fence(void* h) {
+  return static_cast<Service*>(h)->fence.load();
+}
+
+int64_t edl_svc_stream_version(void* h) {
+  return static_cast<Service*>(h)->StreamVersion();
+}
+
 }  // extern "C"

@@ -198,6 +198,17 @@ void TaskQueue::MaybeAdvancePass() {
   }
 }
 
+void TaskQueue::Clear() {
+  std::lock_guard<std::mutex> lock(mu_);
+  todo_.clear();
+  leased_.clear();
+  done_.clear();
+  pass_ = 0;
+  next_id_ = 0;
+  dropped_ = 0;
+  version_.fetch_add(1);
+}
+
 bool TaskQueue::AllDone() const {
   std::lock_guard<std::mutex> lock(mu_);
   return todo_.empty() && leased_.empty() && pass_ + 1 >= total_passes_;
@@ -234,12 +245,15 @@ void TaskQueue::SerializeTo(std::string* out) const {
   for (const auto& kv : leased_) pending.push_back(&kv.second.task);
   std::sort(pending.begin(), pending.end(),
             [](const Task* a, const Task* b) { return a->id < b->id; });
+  // empty binary fields serialize as "-" (the wire protocol's framing):
+  // a bare trailing space would fail the stream parser and silently drop
+  // the entry from a restored/replicated snapshot
   for (const Task* t : pending)
     *out += "T " + std::to_string(t->id) + " " + std::to_string(t->failures) +
-            " " + HexEncode(t->payload) + "\n";
+            " " + (t->payload.empty() ? "-" : HexEncode(t->payload)) + "\n";
   for (const auto& t : done_)
     *out += "D " + std::to_string(t.id) + " " + std::to_string(t.failures) +
-            " " + HexEncode(t.payload) + "\n";
+            " " + (t.payload.empty() ? "-" : HexEncode(t.payload)) + "\n";
 }
 
 void TaskQueue::RestoreLine(const std::string& line) {
@@ -262,7 +276,8 @@ void TaskQueue::RestoreLine(const std::string& line) {
     Task t;
     std::string hex;
     ss >> t.id >> t.failures >> hex;
-    if (ss.fail() || !HexDecode(hex, &t.payload)) return;
+    if (ss.fail()) return;
+    if (hex != "-" && !HexDecode(hex, &t.payload)) return;
     if (tag == "T")
       todo_.push_back(std::move(t));
     else
@@ -337,6 +352,25 @@ void Membership::ForceEpoch(int64_t epoch) {
   }
 }
 
+void Membership::ResetMembers() {
+  std::lock_guard<std::mutex> lock(mu_);
+  members_.clear();
+}
+
+void Membership::RestoreMember(const std::string& name,
+                               const std::string& address, int64_t now_ms) {
+  std::lock_guard<std::mutex> lock(mu_);
+  MemberInfo& m = members_[name];
+  m.name = name;
+  m.address = address;
+  m.deadline_ms = now_ms + ttl_ms_;
+}
+
+void Membership::RefreshAll(int64_t now_ms) {
+  std::lock_guard<std::mutex> lock(mu_);
+  for (auto& kv : members_) kv.second.deadline_ms = now_ms + ttl_ms_;
+}
+
 std::vector<MemberInfo> Membership::Members(int64_t now_ms) {
   Expire(now_ms);
   std::lock_guard<std::mutex> lock(mu_);
@@ -396,6 +430,12 @@ std::vector<std::string> KvStore::Keys(const std::string& prefix) const {
   return out;
 }
 
+void KvStore::Clear() {
+  std::lock_guard<std::mutex> lock(mu_);
+  if (!kv_.empty()) version_.fetch_add(1);
+  kv_.clear();
+}
+
 std::vector<std::pair<std::string, std::string>> KvStore::Items() const {
   std::lock_guard<std::mutex> lock(mu_);
   std::vector<std::pair<std::string, std::string>> out(kv_.begin(), kv_.end());
@@ -410,12 +450,36 @@ std::string Service::Snapshot() const {
   queue.SerializeTo(&out);
   out += "E " + std::to_string(membership.Epoch()) + "\n";
   for (const auto& kv : kv.Items())
-    out += "K " + HexEncode(kv.first) + " " + HexEncode(kv.second) + "\n";
+    out += "K " + HexEncode(kv.first) + " " +
+           (kv.second.empty() ? "-" : HexEncode(kv.second)) + "\n";
+  // HA bookkeeping: fencing token + replication stream position, so a
+  // restarted standby knows which position it durably holds (promotion
+  // picks the standby with the highest persisted position) and a
+  // restarted primary keeps its fence.  Old binaries skip the line.
+  out += "F " + std::to_string(fence.load()) + " " +
+         std::to_string(StreamVersion()) + "\n";
   out += ".\n";
   return out;
 }
 
-bool Service::Restore(const std::string& blob) {
+std::string Service::SnapshotRepl(int64_t now_ms) {
+  std::string out = Snapshot();
+  // splice M member lines before the terminator: the standby must mirror
+  // the member SET (a failover that forgot the members would bounce
+  // every heartbeat into a rejoin, bumping the epoch and reforming every
+  // world).  Deadlines are process-local and deliberately not shipped.
+  out.erase(out.size() - 2);  // ".\n"
+  for (const auto& m : membership.Members(now_ms))
+    out += "M " + HexEncode(m.name) + " " +
+           (m.address.empty() ? "-" : HexEncode(m.address)) + "\n";
+  out += ".\n";
+  return out;
+}
+
+namespace {
+
+bool RestoreImpl(Service* svc, const std::string& blob,
+                 int64_t member_now_ms) {
   // Validate framing BEFORE applying anything: a truncated blob (crash
   // mid-write would need to defeat the atomic rename, but be defensive)
   // must not leave a half-restored service, and a malformed line must
@@ -428,34 +492,78 @@ bool Service::Restore(const std::string& blob) {
   std::istringstream ss(blob);
   std::string line;
   std::getline(ss, line);  // magic, checked above
+  bool have_f = false;
+  int64_t f_fence = 0, f_version = 0;
   while (std::getline(ss, line)) {
     if (line.empty() || line == ".") continue;
     switch (line[0]) {
       case 'Q':
       case 'T':
       case 'D':
-        queue.RestoreLine(line);
+        svc->queue.RestoreLine(line);
         break;
       case 'E': {
         std::istringstream ls(line);
         std::string tag;
         int64_t epoch = 0;
         ls >> tag >> epoch;
-        if (!ls.fail()) membership.ForceEpoch(epoch);
+        if (!ls.fail()) svc->membership.ForceEpoch(epoch);
         break;
       }
       case 'K': {
         std::istringstream ls(line);
         std::string tag, hk, hv, k, v;
         ls >> tag >> hk >> hv;
-        if (HexDecode(hk, &k) && HexDecode(hv, &v)) kv.Set(k, v);
+        if (hv == "-") hv.clear();
+        if (HexDecode(hk, &k) && HexDecode(hv, &v)) svc->kv.Set(k, v);
+        break;
+      }
+      case 'F': {
+        std::istringstream ls(line);
+        std::string tag;
+        ls >> tag >> f_fence >> f_version;
+        if (!ls.fail()) have_f = true;
+        break;
+      }
+      case 'M': {
+        if (member_now_ms < 0) break;  // disk restore: members re-Join
+        std::istringstream ls(line);
+        std::string tag, hn, ha, name, addr;
+        ls >> tag >> hn >> ha;
+        if (ha == "-") ha.clear();
+        if (HexDecode(hn, &name) && HexDecode(ha, &addr))
+          svc->membership.RestoreMember(name, addr, member_now_ms);
         break;
       }
       default:
         break;  // forward compatibility: skip unknown sections
     }
   }
+  if (have_f) {
+    if (f_fence > svc->fence.load()) svc->fence.store(f_fence);
+    // re-anchor the exported stream position at the recorded one: the
+    // restore's own mutation counting is process-local noise
+    svc->version_base.store(f_version - svc->DurableVersion());
+  }
   return true;
+}
+
+}  // namespace
+
+bool Service::Restore(const std::string& blob) {
+  return RestoreImpl(this, blob, /*member_now_ms=*/-1);
+}
+
+bool Service::RestoreRepl(const std::string& blob, int64_t now_ms) {
+  // framing check BEFORE the clear: a torn stream must not wipe the
+  // standby's last good mirror
+  if (blob.rfind("EDLCOORD1\n", 0) != 0 || blob.size() < 13 ||
+      blob.compare(blob.size() - 3, 3, "\n.\n") != 0)
+    return false;
+  queue.Clear();
+  kv.Clear();
+  membership.ResetMembers();
+  return RestoreImpl(this, blob, now_ms);
 }
 
 bool Service::SaveTo(const std::string& path) const {

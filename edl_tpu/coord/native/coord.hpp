@@ -105,6 +105,10 @@ class TaskQueue {
   // moves, so a LEASE that rolls the pass over is durable before its ack.
   int64_t DurableVersion() const { return version_.load(); }
 
+  // Replication restore: drop every task and reset pass bookkeeping so a
+  // full-snapshot apply can never leave deleted entries behind.
+  void Clear();
+
  private:
   struct Leased {
     Task task;
@@ -154,6 +158,19 @@ class Membership {
   // would mis-order them).  Members are NOT restored — they re-Join when
   // their heartbeats bounce, each bumping the epoch further.
   void ForceEpoch(int64_t epoch);
+  // Replication-restore surface (HA standby mirror).  The standby's
+  // member table is a shadow of the primary's — never epoch-authoritative
+  // — so these mutate WITHOUT bumping the epoch (ForceEpoch carries it):
+  // ResetMembers drops the table, RestoreMember seeds one entry with a
+  // fresh TTL (deadlines are process-local monotonic time and cannot
+  // cross hosts), RefreshAll re-arms every deadline at promotion so a
+  // member of an idle job gets a full TTL to re-heartbeat before the new
+  // primary's first expiry sweep can prune it (which would bump the
+  // epoch and reform every world the failover promised not to touch).
+  void ResetMembers();
+  void RestoreMember(const std::string& name, const std::string& address,
+                     int64_t now_ms);
+  void RefreshAll(int64_t now_ms);
   // Sorted by name — this order IS the rank assignment for an epoch
   // (replacing the reference's IP-sort ranks, docker/k8s_tools.py:113-121,
   // with an explicit, coordinator-owned ordering).
@@ -183,6 +200,9 @@ class KvStore {
            const std::string& value);
   std::vector<std::string> Keys(const std::string& prefix) const;
   std::vector<std::pair<std::string, std::string>> Items() const;
+  // Replication restore: a full-snapshot apply clears first so a key the
+  // primary deleted cannot linger on the standby.
+  void Clear();
 
   int64_t DurableVersion() const { return version_.load(); }
 
@@ -201,13 +221,36 @@ struct Service {
   Service(int64_t task_timeout_ms, int passes, int64_t member_ttl_ms)
       : queue(task_timeout_ms, passes), membership(member_ttl_ms) {}
 
-  // Whole-service snapshot (queue + membership epoch + KV) as a
-  // versioned, binary-safe text blob; Restore applies one.  Used by the
-  // server's write-through persistence so a coordinator pod restart keeps
-  // the job's accounting, checkpoint pointers and epoch ordering — the
-  // role of the reference's etcd sidecar (pkg/jobparser.go:167-184).
+  // HA control-plane state.  `fence` is the monotonically-increasing
+  // fencing token (bumped by every promotion; durable via the snapshot's
+  // F line) that makes split-brain safe: a deposed primary's replication
+  // stream carries a stale fence and is rejected, at which point it
+  // fences itself off from clients.  `version_base` re-anchors the
+  // replication stream position across restarts and promotions:
+  // DurableVersion() is a process-local mutation count, so the exported
+  // position is base + DurableVersion(), seeded from the snapshot's F
+  // line — monotonic along any chain of failovers.
+  std::atomic<int64_t> fence{0};
+  std::atomic<int64_t> version_base{0};
+  int64_t StreamVersion() const {
+    return version_base.load() + DurableVersion();
+  }
+
+  // Whole-service snapshot (queue + membership epoch + KV + the HA F
+  // line) as a versioned, binary-safe text blob; Restore applies one.
+  // Used by the server's write-through persistence so a coordinator pod
+  // restart keeps the job's accounting, checkpoint pointers and epoch
+  // ordering — the role of the reference's etcd sidecar
+  // (pkg/jobparser.go:167-184).
   std::string Snapshot() const;
   bool Restore(const std::string& blob);
+  // Replication-stream snapshot/apply (HA primary → standby): the disk
+  // format plus M member lines (old Restore ignores unknown tags, so the
+  // formats stay mutually forward-compatible).  RestoreRepl CLEARS the
+  // queue/KV first — deletions must propagate — and seeds members with
+  // fresh TTLs at `now_ms` (deadlines never cross processes).
+  std::string SnapshotRepl(int64_t now_ms);
+  bool RestoreRepl(const std::string& blob, int64_t now_ms);
   // Atomic, host-crash-durable file write-through (temp + fsync + rename +
   // directory fsync) / startup load.
   bool SaveTo(const std::string& path) const;

@@ -30,6 +30,21 @@
 //                                     epoch moves off <epoch>, or timeout)
 //   METRICS                        -> OK <requests> <parked> <fired>
 //
+// HA control-plane verbs (doc/coordinator_ha.md).  A node that is not the
+// fenced-in primary answers every OTHER verb — reads and long-polls
+// included — with "ERR fenced <fence>", so a client can never observe
+// stale epoch/KV state from a standby or a deposed primary:
+//   ROLE                           -> OK <primary|standby|fenced> <fence> <ver>
+//   SYNC <fence> <ver> <hexblob>   -> OK <ver> | ERR fenced <fence>
+//                                     (primary→standby full-state stream;
+//                                     the standby persists BEFORE acking)
+//   REPLHB <fence>                 -> OK <fence> | ERR fenced <fence>
+//                                     (replication lease heartbeat)
+//   PROMOTE <fence>                -> OK <fence> <ver> | ERR stale <fence>
+//                                     (standby→primary iff <fence> beats
+//                                     every token this node has seen)
+//   REPLICATE <host:port>          -> OK  (attach a standby to stream to)
+//
 // Thread-per-connection; the core is mutex-guarded so this scales to the
 // O(100) workers a single job needs.  The WAIT verbs are what let that
 // same thread-per-connection shape serve event-driven coordination: a
@@ -41,7 +56,10 @@
 // of the 20 Hz sleep-poll loops the Python runtime used to run.
 
 #include <arpa/inet.h>
+#include <fcntl.h>
+#include <netdb.h>
 #include <netinet/in.h>
+#include <poll.h>
 #include <netinet/tcp.h>
 #include <sys/socket.h>
 #include <sys/time.h>
@@ -54,6 +72,7 @@
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
+#include <limits>
 #include <mutex>
 #include <sstream>
 #include <string>
@@ -90,20 +109,87 @@ std::mutex g_persist_mu;
 // semantics via _exit) at the flagged point — "tmp" = after writing the
 // temp file, BEFORE the rename (the mid-persist power-loss window);
 // "acked" = after the rename+dir-fsync, before the response is written
-// (the op is durable but the client never hears OK).  Drives the
-// power-loss durability tests without filesystem fault injection.
+// (the op is durable but the client never hears OK); "repl" = the
+// replication-stream window — on a primary, after the SYNC line is
+// written to the standby's socket but before the client is acked; on a
+// standby, after the streamed state is durably persisted but before the
+// primary hears the ack.  Drives the power-loss + failover durability
+// tests without filesystem fault injection.
 int g_crash_on_persist = 0;       // 0 = disabled; N = trip on Nth persist
-std::string g_crash_point;        // "tmp" | "acked"
+std::string g_crash_point;        // "tmp" | "acked" | "repl"
 std::atomic<int> g_persist_count{0};
+std::atomic<int> g_repl_count{0};  // Nth replication event (point "repl")
 
-void MaybePersist() {
+// ---------------------------------------------------------------------------
+// HA: primary/standby replication with fenced failover.
+//
+// The primary streams its full versioned snapshot (SnapshotRepl) to every
+// attached standby synchronously, AFTER the local persist and BEFORE the
+// client ack — the same discipline MaybePersist already enforces for
+// disk.  A standby applies the stream clear-then-restore, persists its
+// own state file, and only then acks; promotion (client-driven, see
+// CoordClient) therefore can never select a standby claiming a position
+// it does not durably hold.  Fencing: every promotion bumps the fencing
+// token; a deposed primary discovers the newer token on its next
+// replication exchange (or lease heartbeat) and fences ITSELF — from
+// that point every client verb, reads and parked long-polls included,
+// answers "ERR fenced".  Liveness vs consistency: an UNREACHABLE standby
+// does not block the primary (a dead standby must not take down the
+// job); only a standby that answers with a higher fence does.
+// ---------------------------------------------------------------------------
+
+enum Role { kPrimary = 0, kStandby = 1, kFenced = 2 };
+std::atomic<int> g_role{kPrimary};
+const char* RoleName(int r) {
+  return r == kPrimary ? "primary" : r == kStandby ? "standby" : "fenced";
+}
+
+struct Replica {
+  std::string host;
+  int port = 0;
+  int fd = -1;
+  int64_t next_dial_ms = 0;  // dial backoff while the standby is down
+  // stream position THIS replica acked — per-replica, so one standby
+  // missing a SYNC (while another acked) still gets its catch-up from
+  // the keeper thread instead of silently falling behind forever
+  int64_t acked_version = -1;
+};
+std::vector<Replica> g_replicas;   // guarded by g_repl_mu
+std::mutex g_repl_mu;              // serializes the replication channel
+std::mutex g_ha_mu;                // serializes SYNC/PROMOTE role moves
+int64_t g_repl_lease_ms = 3000;
+std::atomic<int64_t> g_last_repl_ok_ms{0};
+//: lock-free fast-path flag for EnsureLease: the fencing gate runs on
+//: EVERY client verb and must not contend on g_repl_mu (which the keeper
+//: thread can hold across multi-second blocking replica I/O) while the
+//: lease is fresh or replication is off
+std::atomic<bool> g_has_replicas{false};
+// Lease policy under partition (doc/coordinator_ha.md): default is
+// AVAILABLE — a primary that cannot reach any standby keeps serving (a
+// dead mirror must not halt the job; the cost is a split-brain write
+// window while truly partitioned).  --repl-lease-strict flips to
+// CONSISTENT: once the lease expires without a successful exchange the
+// primary suspends (ERR fenced, recoverable — it resumes when a standby
+// answers again) so a deposed-but-partitioned primary can never ack.
+bool g_repl_lease_strict = false;
+constexpr int64_t kReplDialBackoffMs = 1000;
+
+std::atomic<int64_t> g_fencing_rejects{0};
+std::atomic<int64_t> g_repl_syncs{0};    // streams acked (primary) /
+                                         // applied (standby)
+std::atomic<int64_t> g_repl_errors{0};
+std::atomic<int64_t> g_promotions{0};
+
+void MaybePersist(bool force = false) {
   if (g_state_file.empty()) return;
   std::lock_guard<std::mutex> lock(g_persist_mu);
   // Read the version BEFORE snapshotting: a concurrent mutation landing
   // mid-snapshot then re-triggers persistence on its own command, never
   // the reverse (recording a version whose state was not yet written).
+  // `force` persists even at an unmoved version — promotion changes the
+  // fencing token, which lives outside the durable-version counter.
   int64_t version = g_service->DurableVersion();
-  if (version == g_persisted_version.load()) return;
+  if (!force && version == g_persisted_version.load()) return;
   int n = g_persist_count.fetch_add(1) + 1;
   bool trip = g_crash_on_persist != 0 && n == g_crash_on_persist;
   // "tmp" = simulated power loss mid-persist, injected INSIDE SaveTo at
@@ -179,6 +265,224 @@ int64_t CurrentWaitGen() {
   return g_wait_gen;
 }
 
+std::string FencedReply() {
+  g_fencing_rejects.fetch_add(1);
+  return "ERR fenced " + std::to_string(g_service->fence.load());
+}
+
+void SelfFence(int64_t newer_fence) {
+  int expect = kPrimary;
+  if (!g_role.compare_exchange_strong(expect, kFenced)) return;
+  std::fprintf(stderr,
+               "edl-coord: FENCED — a peer holds fencing token %lld "
+               "(ours %lld); this node no longer serves\n",
+               static_cast<long long>(newer_fence),
+               static_cast<long long>(g_service->fence.load()));
+  // wake every parked long-poll so it returns ERR fenced NOW instead of
+  // at its next re-check tick
+  NotifyWaiters();
+}
+
+// One request/response exchange with a replica over its persistent
+// connection (redialing under backoff).  Returns 1 on an OK ack, 0 when
+// the replica is unreachable, -1 when it rejected us with a newer fence
+// (the caller must self-fence).  Caller holds g_repl_mu.
+int ReplicaExchange(Replica& r, const std::string& line, bool is_sync) {
+  int64_t now = NowMs();
+  if (r.fd < 0) {
+    if (now < r.next_dial_ms) return 0;
+    // getaddrinfo, not inet_pton: replica endpoints are k8s service DNS
+    // names in real deployments — a name that silently never resolved
+    // would leave an "HA pair" with zero replication behind green acks
+    addrinfo hints{};
+    hints.ai_family = AF_UNSPEC;
+    hints.ai_socktype = SOCK_STREAM;
+    addrinfo* res = nullptr;
+    if (getaddrinfo(r.host.c_str(), std::to_string(r.port).c_str(),
+                    &hints, &res) != 0 || res == nullptr) {
+      r.next_dial_ms = now + kReplDialBackoffMs;
+      return 0;
+    }
+    int fd = socket(res->ai_family, res->ai_socktype, res->ai_protocol);
+    // non-blocking connect with a bounded poll: this runs with
+    // g_repl_mu held on the client-ack path, and a black-holed standby
+    // (no RST) would otherwise pin it for the kernel's SYN-retry
+    // minutes — 'an UNREACHABLE standby does not block the primary'
+    // must hold for the connect too, not just the 5 s I/O below
+    bool connected = false;
+    if (fd >= 0) {
+      int flags = fcntl(fd, F_GETFL, 0);
+      fcntl(fd, F_SETFL, flags | O_NONBLOCK);
+      int rc = connect(fd, res->ai_addr, res->ai_addrlen);
+      if (rc != 0 && errno == EINPROGRESS) {
+        pollfd p{fd, POLLOUT, 0};
+        if (poll(&p, 1, 1000) == 1 && (p.revents & POLLOUT)) {
+          int err = 0;
+          socklen_t len = sizeof(err);
+          getsockopt(fd, SOL_SOCKET, SO_ERROR, &err, &len);
+          rc = err == 0 ? 0 : -1;
+        } else {
+          rc = -1;
+        }
+      }
+      if (rc == 0) {
+        fcntl(fd, F_SETFL, flags);  // timed blocking I/O from here on
+        connected = true;
+      }
+    }
+    if (!connected) {
+      if (fd >= 0) close(fd);
+      freeaddrinfo(res);
+      r.next_dial_ms = NowMs() + kReplDialBackoffMs;
+      return 0;
+    }
+    freeaddrinfo(res);
+    int one = 1;
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    timeval tv{5, 0};
+    setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+    setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
+    r.fd = fd;
+  }
+  size_t off = 0;
+  while (off < line.size()) {
+    ssize_t w = write(r.fd, line.data() + off, line.size() - off);
+    if (w <= 0) {
+      close(r.fd);
+      r.fd = -1;
+      r.next_dial_ms = now + kReplDialBackoffMs;
+      return 0;
+    }
+    off += static_cast<size_t>(w);
+  }
+  if (is_sync && g_crash_point == "repl" && !g_replicas.empty() &&
+      g_crash_on_persist != 0 &&
+      g_repl_count.fetch_add(1) + 1 == g_crash_on_persist) {
+    // primary-side replication-window crash: the stream is on the wire
+    // but the client will never hear OK — at-least-once retries against
+    // the promoted standby must converge
+    _exit(137);
+  }
+  std::string resp;
+  char c;
+  while (resp.find('\n') == std::string::npos && resp.size() < 256) {
+    ssize_t n = read(r.fd, &c, 1);
+    if (n <= 0) {
+      close(r.fd);
+      r.fd = -1;
+      r.next_dial_ms = NowMs() + kReplDialBackoffMs;
+      return 0;
+    }
+    resp.push_back(c);
+  }
+  if (resp.rfind("OK", 0) == 0) return 1;
+  if (resp.rfind("ERR fenced", 0) == 0) {
+    // self-fence ONLY on a genuinely newer token: a stale or
+    // misconfigured rejector (e.g. a re-attached node that still thinks
+    // it is primary at an older fence) must not depose the rightful
+    // primary — that would turn a recoverable config error into a total
+    // control-plane outage
+    long long newer = -1;
+    std::sscanf(resp.c_str(), "ERR fenced %lld", &newer);
+    if (newer > g_service->fence.load()) {
+      SelfFence(newer);
+      return -1;
+    }
+    g_repl_errors.fetch_add(1);
+    return 0;
+  }
+  // protocol-level refusal that is not a fence (e.g. a replica that is
+  // itself a primary mid-reconfiguration): count and keep serving
+  g_repl_errors.fetch_add(1);
+  return 0;
+}
+
+// Stream the current snapshot to every attached standby.  Returns false
+// iff this node got fenced (the caller replaces its client reply).
+bool StreamToReplicas() {
+  if (g_role.load() != kPrimary) return false;
+  std::lock_guard<std::mutex> lk(g_repl_mu);
+  if (g_replicas.empty()) return true;
+  int64_t sv = g_service->StreamVersion();
+  int64_t now = NowMs();
+  bool all_current = true;
+  bool any_behind_ready = false;
+  for (auto& r : g_replicas) {
+    all_current &= r.acked_version >= sv;
+    any_behind_ready |= r.acked_version < sv &&
+                        (r.fd >= 0 || now >= r.next_dial_ms);
+  }
+  if (!any_behind_ready)
+    // everyone current, or down-and-backing-off: current is fine either
+    // way; down means STRICT mode must refuse to ack what no mirror
+    // holds (AVAILABLE mode serves on — the documented tradeoff)
+    return all_current || !g_repl_lease_strict;
+  std::string blob = g_service->SnapshotRepl(now);
+  std::string line = "SYNC " + std::to_string(g_service->fence.load()) +
+                     " " + std::to_string(sv) + " " +
+                     edlcoord::HexEncode(blob) + "\n";
+  bool any_ok = false;
+  for (auto& r : g_replicas) {
+    if (r.acked_version >= sv) {
+      any_ok = true;  // this mirror already holds the position
+      continue;
+    }
+    int rc = ReplicaExchange(r, line, /*is_sync=*/true);
+    if (rc == -1) return false;  // fenced (SelfFence already ran)
+    if (rc == 1) {
+      r.acked_version = sv;
+      any_ok = true;
+    } else if (rc == 0) {
+      g_repl_errors.fetch_add(1);
+    }
+  }
+  if (any_ok) {
+    g_last_repl_ok_ms.store(NowMs());
+    g_repl_syncs.fetch_add(1);
+  }
+  // strict mode: an op NO standby acked must not be acked to the client
+  // — the promoted standby is then never missing an acked op, which is
+  // what makes promoting around a suspended primary safe
+  return any_ok || !g_repl_lease_strict;
+}
+
+// Replication lease: a primary that has not successfully exchanged with a
+// standby within g_repl_lease_ms must re-verify its claim before serving
+// — this is what makes a GC-paused-then-resumed primary discover its
+// deposition BEFORE handing a client stale state, instead of at its next
+// mutation.  An unreachable standby leaves the lease unrenewed but does
+// not block serving (availability when the standby is simply dead).
+// Returns false iff fenced.
+bool EnsureLease() {
+  if (g_role.load() != kPrimary) return false;
+  // lock-free fast path: this gate runs on EVERY client verb (and every
+  // parked-wait wakeup) — while replication is off or the lease is
+  // fresh it must not contend on g_repl_mu, which the keeper thread can
+  // hold across multi-second blocking replica I/O
+  if (!g_has_replicas.load()) return true;
+  if (NowMs() - g_last_repl_ok_ms.load() < g_repl_lease_ms) return true;
+  std::lock_guard<std::mutex> lk(g_repl_mu);
+  if (g_replicas.empty()) return true;
+  if (NowMs() - g_last_repl_ok_ms.load() < g_repl_lease_ms) return true;
+  std::string line =
+      "REPLHB " + std::to_string(g_service->fence.load()) + "\n";
+  bool any_ok = false;
+  for (auto& r : g_replicas) {
+    int rc = ReplicaExchange(r, line, /*is_sync=*/false);
+    if (rc == -1) return false;
+    if (rc == 1) any_ok = true;
+  }
+  if (any_ok) {
+    g_last_repl_ok_ms.store(NowMs());
+    return true;
+  }
+  // no standby reachable and the lease is expired: AVAILABLE mode keeps
+  // serving (a dead mirror must not halt the job), STRICT mode suspends
+  // — recoverable, unlike a self-fence: serving resumes the moment a
+  // standby answers a later probe
+  return !g_repl_lease_strict;
+}
+
 using edlcoord::HexDecode;
 using edlcoord::HexEncode;
 
@@ -192,9 +496,25 @@ std::vector<std::string> Split(const std::string& line) {
 
 std::string HandleImpl(const std::string& line);
 
+// Control-plane verbs that every role answers; everything else is gated
+// on being the fenced-in primary.
+bool IsControlVerb(const std::string& cmd) {
+  return cmd == "PING" || cmd == "CONFIG" || cmd == "METRICS" ||
+         cmd == "ROLE" || cmd == "SYNC" || cmd == "REPLHB" ||
+         cmd == "PROMOTE" || cmd == "REPLICATE";
+}
+
 // One bad line must never take down the coordinator for the whole job.
 std::string Handle(const std::string& line) {
   g_requests.fetch_add(1);
+  std::string cmd = line.substr(0, line.find(' '));
+  const bool control = IsControlVerb(cmd);
+  if (!control) {
+    // Fencing gate: reads, writes and long-polls alike — a standby or a
+    // deposed primary must never hand a client stale epoch/KV state.
+    if (g_role.load() != kPrimary) return FencedReply();
+    if (!EnsureLease()) return FencedReply();
+  }
   std::string resp;
   try {
     resp = HandleImpl(line);
@@ -203,9 +523,14 @@ std::string Handle(const std::string& line) {
   }
   // Persist BEFORE acking: once a worker sees OK for a COMPLETE or KVSET
   // — or an OK LEASE whose side effect rolled the pass over — a
-  // coordinator restart must not forget it.
-  if (g_service->DurableVersion() != g_persisted_version.load())
+  // coordinator restart must not forget it.  Replicate on the same
+  // boundary: an acked op is on the standby before the client hears OK,
+  // so a failover forgets nothing the client could have acted on — and a
+  // deposed primary learns its fate HERE and refuses the ack.
+  if (g_service->DurableVersion() != g_persisted_version.load()) {
     MaybePersist();
+    if (!control && !StreamToReplicas()) resp = FencedReply();
+  }
   // Wake parked long-polls AFTER the persist boundary, so a waiter that
   // fires and immediately acts can never observe un-persisted state.
   NotifyWaiters();
@@ -225,6 +550,143 @@ std::string HandleImpl(const std::string& line) {
   if (cmd == "CONFIG")
     return "OK " + std::to_string(g_task_timeout_ms) + " " +
            std::to_string(g_passes) + " " + std::to_string(g_member_ttl_ms);
+
+  // -- HA control plane ----------------------------------------------------
+
+  if (cmd == "ROLE") {
+    const char* role = RoleName(g_role.load());
+    // a strict-mode primary whose lease lapsed unanswered is SUSPENDED:
+    // it answers every verb ERR fenced but is not deposed — report the
+    // distinction so a client's failover probe routes around it
+    // (promoting a reachable mirror) instead of re-targeting it forever
+    if (g_role.load() == kPrimary && g_repl_lease_strict &&
+        g_has_replicas.load() &&
+        NowMs() - g_last_repl_ok_ms.load() > g_repl_lease_ms)
+      role = "suspended";
+    return std::string("OK ") + role + " " +
+           std::to_string(g_service->fence.load()) + " " +
+           std::to_string(g_service->StreamVersion());
+  }
+
+  if (cmd == "SYNC" && args.size() == 4) {
+    std::lock_guard<std::mutex> ha(g_ha_mu);
+    const int64_t f = std::stoll(args[1]);
+    if (g_role.load() == kPrimary) {
+      // fence == ours from another primary is the dual-primary collision
+      // (two clients raced PROMOTE onto different standbys): equal
+      // tokens can never depose each other through the stale-rejector
+      // check, so the RECEIVER yields — one deterministic survivor
+      // instead of silent divergence
+      if (f == g_service->fence.load()) SelfFence(f);
+      return FencedReply();  // a deposed primary is streaming at us
+    }
+    if (f < g_service->fence.load()) return FencedReply();
+    std::string blob;
+    if (!HexDecode(args[3], &blob)) return "ERR hex";
+    if (!g_service->RestoreRepl(blob, NowMs())) return "ERR badblob";
+    if (f > g_service->fence.load()) g_service->fence.store(f);
+    // a self-fenced ex-primary accepting a stream is provably a mirror
+    // again: demote to standby so the pair regains real redundancy (and
+    // the client's failover probe sees a promotable node, not a corpse)
+    if (g_role.load() == kFenced) g_role.store(kStandby);
+    g_repl_syncs.fetch_add(1);
+    // persist BEFORE acking: the ack is the primary's licence to ack its
+    // client, and promotion trusts the position this node claims — an
+    // unpersisted claim would be a lie a crash exposes
+    MaybePersist();
+    if (g_crash_point == "repl" && g_crash_on_persist != 0 &&
+        g_repl_count.fetch_add(1) + 1 == g_crash_on_persist) {
+      // standby-side replication-window crash: durably applied but the
+      // primary never hears the ack — a restart must come back owning
+      // exactly the position it persisted
+      _exit(137);
+    }
+    return "OK " + std::to_string(g_service->StreamVersion());
+  }
+
+  if (cmd == "REPLHB" && args.size() == 2) {
+    std::lock_guard<std::mutex> ha(g_ha_mu);
+    const int64_t f = std::stoll(args[1]);
+    if (g_role.load() == kPrimary) {
+      if (f == g_service->fence.load()) SelfFence(f);  // see SYNC
+      return FencedReply();
+    }
+    if (f < g_service->fence.load()) return FencedReply();
+    if (f > g_service->fence.load()) g_service->fence.store(f);
+    return "OK " + std::to_string(g_service->fence.load());
+  }
+
+  if (cmd == "PROMOTE" && args.size() == 2) {
+    std::lock_guard<std::mutex> ha(g_ha_mu);
+    const int64_t f = std::stoll(args[1]);
+    const int64_t cur = g_service->fence.load();
+    if (g_role.load() == kPrimary) {
+      // idempotent for racing promoters: the token only ratchets up
+      if (f < cur) return "ERR stale " + std::to_string(cur);
+      g_service->fence.store(f);
+      return "OK " + std::to_string(f) + " " +
+             std::to_string(g_service->StreamVersion());
+    }
+    if (f <= cur) return "ERR stale " + std::to_string(cur);
+    g_service->fence.store(f);
+    g_role.store(kPrimary);
+    g_promotions.fetch_add(1);
+    // every mirrored member gets a full TTL to re-heartbeat HERE before
+    // the first expiry sweep may prune it — pruning would bump the epoch
+    // and reform the very worlds the failover exists to not touch
+    g_service->membership.RefreshAll(NowMs());
+    g_last_repl_ok_ms.store(NowMs());  // no standby yet; lease is ours
+    MaybePersist(/*force=*/true);  // the new fence must survive a restart
+    std::fprintf(stderr, "edl-coord: promoted to primary, fence=%lld\n",
+                 static_cast<long long>(f));
+    NotifyWaiters();
+    return "OK " + std::to_string(f) + " " +
+           std::to_string(g_service->StreamVersion());
+  }
+
+  if (cmd == "REPLICATE" && args.size() == 2) {
+    if (g_role.load() != kPrimary) return FencedReply();
+    const size_t colon = args[1].rfind(':');
+    if (colon == std::string::npos) return "ERR bad-endpoint";
+    const int64_t sv0 = g_service->StreamVersion();
+    {
+      std::lock_guard<std::mutex> lk(g_repl_mu);
+      bool known = false;
+      for (auto& r : g_replicas)
+        if (args[1] == r.host + ":" + std::to_string(r.port)) {
+          known = true;
+          // re-attach of a (possibly restarted) mirror: force a fresh
+          // catch-up — its in-memory state is unknown
+          r.acked_version = -1;
+          r.next_dial_ms = 0;
+          if (r.fd >= 0) {
+            close(r.fd);
+            r.fd = -1;
+          }
+        }
+      if (!known) {
+        Replica r;
+        r.host = args[1].substr(0, colon);
+        r.port = std::atoi(args[1].substr(colon + 1).c_str());
+        g_replicas.push_back(r);
+      }
+      g_has_replicas.store(true);
+    }
+    // catch the standby up NOW, synchronously: until its first SYNC a
+    // mirror holds only its stale file, and promoting it would forget
+    // every op acked since — OK here means "the standby is current",
+    // so a failed catch-up must answer ERR behind, not a false OK the
+    // operator loop reads as restored redundancy
+    if (!StreamToReplicas()) return FencedReply();
+    {
+      std::lock_guard<std::mutex> lk(g_repl_mu);
+      for (const auto& r : g_replicas)
+        if (args[1] == r.host + ":" + std::to_string(r.port) &&
+            r.acked_version < sv0)
+          return "ERR behind";
+    }
+    return "OK";
+  }
 
   if (cmd == "LEASE" && args.size() == 2) {
     edlcoord::Lease lease;
@@ -325,6 +787,14 @@ std::string HandleImpl(const std::string& line) {
                           std::chrono::milliseconds(timeout_ms);
     bool parked = false;
     for (;;) {
+      // a wait that outlives this node's primacy must not hand the
+      // waiter a stale epoch — SelfFence notifies, so this fires fast.
+      // The lease is re-verified too (cheap when fresh): a GC-paused
+      // deposed primary resuming INSIDE this loop would otherwise run
+      // the expiry sweep below, fabricate an epoch bump from its frozen
+      // member table, and fire the waiter with phantom membership before
+      // the keeper thread gets around to fencing it.
+      if (g_role.load() != kPrimary || !EnsureLease()) return FencedReply();
       const int64_t gen = CurrentWaitGen();
       s.membership.Members(NowMs());  // expiry sweep (may bump the epoch)
       const int64_t epoch = s.membership.Epoch();
@@ -353,6 +823,8 @@ std::string HandleImpl(const std::string& line) {
                           std::chrono::milliseconds(timeout_ms);
     bool parked = false;
     for (;;) {
+      // same role + lease re-verification as WAITEPOCH
+      if (g_role.load() != kPrimary || !EnsureLease()) return FencedReply();
       const int64_t gen = CurrentWaitGen();
       std::string v;
       if (s.kv.Get(key, &v)) {
@@ -395,12 +867,20 @@ std::string HandleImpl(const std::string& line) {
 // and `curl` speak, nothing more.  Serving it from the coord process (not
 // a sidecar) is the point: a wedge that stops command processing also
 // stops this socket's accept loop, so the probe fails and k8s restarts us.
+// On a non-primary the membership mirror must NOT be TTL-swept (the
+// standby sees no heartbeats; sweeping would corrupt the epoch it is
+// guarding for promotion) — probes there observe without expiring.
+int64_t ProbeSweepNow() {
+  return g_role.load() == kPrimary ? NowMs()
+                                   : std::numeric_limits<int64_t>::min();
+}
+
 std::string HealthBody() {
   int64_t todo, leased, done, dropped;
   g_service->queue.Stats(&todo, &leased, &done, &dropped);
   // Members() sweeps expired members exactly like the MEMBERS command —
   // the probe must observe (and persist) the same truth workers would.
-  size_t members = g_service->membership.Members(NowMs()).size();
+  size_t members = g_service->membership.Members(ProbeSweepNow()).size();
   std::ostringstream js;
   js << "{\"status\":\"ok\",\"pass\":" << g_service->queue.CurrentPass()
      << ",\"tasks\":{\"todo\":" << todo << ",\"leased\":" << leased
@@ -410,7 +890,10 @@ std::string HealthBody() {
      << ",\"requests_served\":" << g_requests.load()
      << ",\"longpolls_parked\":" << g_longpolls_parked.load()
      << ",\"longpolls_fired\":" << g_longpolls_fired.load()
-     << ",\"persisted_version\":" << g_persisted_version.load() << "}";
+     << ",\"persisted_version\":" << g_persisted_version.load()
+     << ",\"role\":\"" << RoleName(g_role.load()) << "\""
+     << ",\"fence\":" << g_service->fence.load()
+     << ",\"stream_version\":" << g_service->StreamVersion() << "}";
   return js.str();
 }
 
@@ -421,7 +904,7 @@ std::string HealthBody() {
 std::string MetricsBody() {
   int64_t todo, leased, done, dropped;
   g_service->queue.Stats(&todo, &leased, &done, &dropped);
-  size_t members = g_service->membership.Members(NowMs()).size();
+  size_t members = g_service->membership.Members(ProbeSweepNow()).size();
   std::ostringstream out;
   auto counter = [&out](const char* name, const char* help, int64_t v) {
     out << "# HELP " << name << " " << help << "\n"
@@ -456,6 +939,26 @@ std::string MetricsBody() {
         static_cast<int64_t>(members));
   gauge("edl_coord_persisted_version", "last durably persisted version", "",
         g_persisted_version.load());
+  // HA: role (0=primary 1=standby 2=fenced), fencing token, replication
+  // stream position + the fencing/replication counters
+  gauge("edl_coord_role", "0=primary 1=standby 2=fenced", "",
+        g_role.load());
+  gauge("edl_coord_fence", "fencing token (bumped by every promotion)", "",
+        g_service->fence.load());
+  gauge("edl_coord_stream_version", "replication stream position", "",
+        g_service->StreamVersion());
+  counter("edl_coord_fencing_rejects_total",
+          "commands rejected because this node is not the fenced-in "
+          "primary",
+          g_fencing_rejects.load());
+  counter("edl_coord_repl_syncs_total",
+          "replication streams acked (primary) / applied (standby)",
+          g_repl_syncs.load());
+  counter("edl_coord_repl_errors_total",
+          "replication exchanges that failed (standby unreachable)",
+          g_repl_errors.load());
+  counter("edl_coord_promotions_total",
+          "standby-to-primary promotions served", g_promotions.load());
   return out.str();
 }
 
@@ -488,12 +991,16 @@ void ServeHealth(int fd) {
   if (method == "GET" && (path == "/healthz" || path == "/")) {
     body = HealthBody();
     // the sweep inside HealthBody may have bumped the epoch; make it
-    // durable on the same boundary every command uses
+    // durable AND mirrored on the same boundary every command uses — a
+    // persisted-but-unstreamed epoch bump would survive locally yet
+    // regress on the standby a failover promotes moments later
     MaybePersist();
+    StreamToReplicas();
   } else if (method == "GET" && path == "/metrics") {
     body = MetricsBody();
     content_type = "text/plain; version=0.0.4; charset=utf-8";
     MaybePersist();  // same sweep-durability boundary as /healthz
+    StreamToReplicas();
   } else {
     status = "404 Not Found";
     body = "{\"error\":\"not found\"}";
@@ -549,6 +1056,8 @@ int main(int argc, char** argv) {
   int passes = 1;
   int64_t member_ttl_ms = edlcoord::kDefaultMemberTtlMs;
   std::string state_file;
+  bool standby = false;
+  std::string replicate_to;  // "host:port[,host:port...]"
   for (int i = 1; i + 1 < argc; i += 2) {
     std::string flag = argv[i];
     if (flag == "--port") port = std::atoi(argv[i + 1]);
@@ -557,6 +1066,11 @@ int main(int argc, char** argv) {
     if (flag == "--passes") passes = std::atoi(argv[i + 1]);
     if (flag == "--member-ttl-ms") member_ttl_ms = std::atoll(argv[i + 1]);
     if (flag == "--state-file") state_file = argv[i + 1];
+    if (flag == "--standby") standby = std::atoi(argv[i + 1]) != 0;
+    if (flag == "--replicate-to") replicate_to = argv[i + 1];
+    if (flag == "--repl-lease-ms") g_repl_lease_ms = std::atoll(argv[i + 1]);
+    if (flag == "--repl-lease-strict")
+      g_repl_lease_strict = std::atoi(argv[i + 1]) != 0;
     if (flag == "--crash-on-persist") {
       // "<N>:<point>" e.g. "2:tmp" — test-only fault injection
       std::string v = argv[i + 1];
@@ -588,6 +1102,28 @@ int main(int argc, char** argv) {
                  "edl-coord: state file %s exists but could not be "
                  "restored; starting with empty state\n",
                  state_file.c_str());
+  }
+  // HA wiring: role from flags (the state file carries fence + stream
+  // position across restarts, never the role — a respawned pod is told
+  // what it is by its manifest/harness, not by a file that predates the
+  // failover it missed).
+  if (standby) g_role.store(kStandby);
+  if (!replicate_to.empty()) {
+    size_t start = 0;
+    while (start < replicate_to.size()) {
+      size_t comma = replicate_to.find(',', start);
+      if (comma == std::string::npos) comma = replicate_to.size();
+      std::string ep = replicate_to.substr(start, comma - start);
+      size_t colon = ep.rfind(':');
+      if (colon != std::string::npos) {
+        Replica r;
+        r.host = ep.substr(0, colon);
+        r.port = std::atoi(ep.substr(colon + 1).c_str());
+        g_replicas.push_back(r);
+      }
+      start = comma + 1;
+    }
+    g_has_replicas.store(!g_replicas.empty());
   }
 
   int srv = socket(AF_INET, SOCK_STREAM, 0);
@@ -695,7 +1231,28 @@ int main(int argc, char** argv) {
                 static_cast<long long>(todo), static_cast<long long>(done),
                 static_cast<long long>(g_service->membership.Epoch()));
   }
+  if (standby || !g_replicas.empty())
+    std::printf("edl-coord role=%s fence=%lld version=%lld\n",
+                RoleName(g_role.load()),
+                static_cast<long long>(g_service->fence.load()),
+                static_cast<long long>(g_service->StreamVersion()));
   std::fflush(stdout);
+
+  // Replication keeper (primary side): keeps the lease warm while idle —
+  // so fencing is discovered within a lease period even with no client
+  // traffic — and pushes catch-up streams to a standby that was down or
+  // freshly attached (REPLICATE) without waiting for the next mutation.
+  // started unconditionally: a promoted standby can grow replicas later
+  // via REPLICATE, and must then keep ITS lease warm too
+  std::thread([]() {
+    for (;;) {
+      usleep(static_cast<useconds_t>(
+          std::max<int64_t>(g_repl_lease_ms / 3, 100) * 1000));
+      if (g_role.load() != kPrimary) continue;
+      StreamToReplicas();
+      EnsureLease();
+    }
+  }).detach();
 
   for (;;) {
     int fd = accept(srv, nullptr, nullptr);

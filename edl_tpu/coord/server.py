@@ -57,18 +57,30 @@ def spawn_server(
     crash_on_persist: str | None = None,
     health_port: int | None = None,
     die_with_parent: bool = True,
+    standby: bool = False,
+    replicate_to: str | None = None,
+    repl_lease_ms: int | None = None,
+    repl_lease_strict: bool = False,
 ) -> ServerHandle:
     """Start edl-coord-server (port 0 = ephemeral) and wait until it
     reports its listening port.  ``state_file`` enables write-through
     durability: restart the server with the same file and it resumes the
     job's queue accounting, KV and epoch (the etcd-sidecar role).
-    ``crash_on_persist`` ("N:tmp" | "N:acked") is test-only fault
-    injection for the power-loss durability tests.  ``die_with_parent``
-    (default on) SIGKILLs the server when the spawning process dies —
-    spawn_server callers are tests/benches/demos, and an interrupted
-    harness must not leave a coordinator squatting on the state file
-    (the deployed coordinator path, ``edl-tpu coordinator`` → execv,
-    never goes through here)."""
+    ``crash_on_persist`` ("N:tmp" | "N:acked" | "N:repl") is test-only
+    fault injection for the power-loss/failover durability tests.
+    ``die_with_parent`` (default on) SIGKILLs the server when the
+    spawning process dies — spawn_server callers are tests/benches/demos,
+    and an interrupted harness must not leave a coordinator squatting on
+    the state file (the deployed coordinator path, ``edl-tpu
+    coordinator`` → execv, never goes through here).
+
+    HA (doc/coordinator_ha.md): ``standby=True`` starts a warm mirror
+    that rejects every client verb with ``ERR fenced`` until PROMOTEd;
+    ``replicate_to="host:port[,host:port]"`` makes a primary stream its
+    versioned snapshot there before acking any mutation;
+    ``repl_lease_ms`` tunes how stale the replication lease may go before
+    the primary re-verifies its claim (the split-brain read guard).  See
+    :func:`spawn_ha_pair` for the one-call pair."""
     if not ensure_built():
         raise RuntimeError("cannot build the native coordination server "
                            "(g++ unavailable?)")
@@ -83,6 +95,14 @@ def spawn_server(
         cmd += ["--state-file", str(state_file)]
     if crash_on_persist:
         cmd += ["--crash-on-persist", crash_on_persist]
+    if standby:
+        cmd += ["--standby", "1"]
+    if replicate_to:
+        cmd += ["--replicate-to", str(replicate_to)]
+    if repl_lease_ms is not None:
+        cmd += ["--repl-lease-ms", str(repl_lease_ms)]
+    if repl_lease_strict:
+        cmd += ["--repl-lease-strict", "1"]
     # mirror the CLI/env convention: None or a negative value = disabled
     health_enabled = health_port is not None and health_port >= 0
     if health_enabled:
@@ -148,6 +168,50 @@ def spawn_server(
                         health_port=bound_health)
 
 
+def spawn_ha_pair(
+    state_dir: str,
+    task_timeout_ms: int = DEFAULT_TASK_TIMEOUT_MS,
+    passes: int = 1,
+    member_ttl_ms: int = DEFAULT_MEMBER_TTL_MS,
+    repl_lease_ms: int = 3000,
+    health_port: int | None = None,
+    primary_port: int = 0,
+    standby_port: int = 0,
+    crash_on_persist: str | None = None,
+) -> tuple[ServerHandle, ServerHandle]:
+    """Start a replicated coordinator pair: a warm standby first, then a
+    primary streaming to it.  Returns ``(primary, standby)``; point a
+    multi-endpoint :class:`~edl_tpu.coord.client.CoordClient` at both.
+    Each node persists to its own state file under ``state_dir``, so a
+    SIGKILLed member can be respawned (as a standby of whoever is primary
+    then, re-attached via the REPLICATE verb) without losing its fence or
+    stream position.  ``crash_on_persist`` goes to the PRIMARY (the
+    "N:repl" stream-window injection).  A fixed nonzero ``health_port``
+    goes to the primary; the standby gets ``health_port + 1`` (two
+    processes cannot share one port — pass 0 for ephemeral both)."""
+    os.makedirs(state_dir, exist_ok=True)
+    standby_health = health_port
+    if health_port is not None and health_port > 0:
+        standby_health = health_port + 1
+    standby = spawn_server(
+        port=standby_port, task_timeout_ms=task_timeout_ms, passes=passes,
+        member_ttl_ms=member_ttl_ms, standby=True,
+        state_file=os.path.join(state_dir, "coord-b.state"),
+        repl_lease_ms=repl_lease_ms, health_port=standby_health)
+    try:
+        primary = spawn_server(
+            port=primary_port, task_timeout_ms=task_timeout_ms,
+            passes=passes, member_ttl_ms=member_ttl_ms,
+            state_file=os.path.join(state_dir, "coord-a.state"),
+            replicate_to=f"127.0.0.1:{standby.port}",
+            repl_lease_ms=repl_lease_ms, health_port=health_port,
+            crash_on_persist=crash_on_persist)
+    except Exception:
+        standby.stop()
+        raise
+    return primary, standby
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(description="edl_tpu coordination server")
     ap.add_argument("--port", type=int,
@@ -166,6 +230,30 @@ def main(argv: list[str] | None = None) -> int:
                     default=os.environ.get("EDL_COORD_STATE_FILE", ""),
                     help="write-through durability file; restart with the "
                          "same path to resume the job's coordination state")
+    ap.add_argument("--standby", action="store_true",
+                    default=os.environ.get("EDL_COORD_STANDBY", "") == "1",
+                    help="start as a warm HA standby: mirror a primary's "
+                         "replication stream, answer every client verb "
+                         "ERR fenced until promoted "
+                         "(doc/coordinator_ha.md)")
+    ap.add_argument("--replicate-to",
+                    default=os.environ.get("EDL_COORD_REPLICATE_TO", ""),
+                    help="host:port[,host:port] standby set this primary "
+                         "streams its versioned state to before acking "
+                         "any mutation")
+    ap.add_argument("--repl-lease-ms", type=int,
+                    default=int(os.environ.get("EDL_COORD_REPL_LEASE_MS",
+                                               "3000")),
+                    help="staleness bound on the replication lease before "
+                         "a primary re-verifies its claim (split-brain "
+                         "read guard)")
+    ap.add_argument("--repl-lease-strict", action="store_true",
+                    default=os.environ.get("EDL_COORD_REPL_LEASE_STRICT",
+                                           "") == "1",
+                    help="consistency over availability under partition: "
+                         "a primary with no reachable standby SUSPENDS "
+                         "(recoverable) once the lease lapses, instead "
+                         "of continuing to serve")
     ap.add_argument("--health-port", type=int, default=None,
                     help="HTTP GET /healthz port (the probe target the "
                          "compiled coordinator manifest points at); "
@@ -191,6 +279,13 @@ def main(argv: list[str] | None = None) -> int:
     ]
     if args.state_file:
         cmd += ["--state-file", args.state_file]
+    if args.standby:
+        cmd += ["--standby", "1"]
+    if args.replicate_to:
+        cmd += ["--replicate-to", args.replicate_to]
+    cmd += ["--repl-lease-ms", str(args.repl_lease_ms)]
+    if args.repl_lease_strict:
+        cmd += ["--repl-lease-strict", "1"]
     if args.health_port >= 0:
         cmd += ["--health-port", str(args.health_port)]
     os.execv(str(SERVER_PATH), cmd)

@@ -19,6 +19,26 @@ from typing import Optional
 DEFAULT_TASK_TIMEOUT_MS = 16_000  # reference docker/paddle_k8s:30
 DEFAULT_MAX_TASK_FAILURES = 3
 DEFAULT_MEMBER_TTL_MS = 15_000
+#: how stale the replication lease may go before a primary re-verifies
+#: its claim against the standbys (doc/coordinator_ha.md)
+DEFAULT_REPL_LEASE_S = 3.0
+
+
+class CoordFenced(RuntimeError):
+    """This node is not the fenced-in primary: it is a standby, or a
+    deposed primary that discovered a newer fencing token.  Every verb —
+    reads and long-polls included — raises this instead of serving state
+    that may be stale; a multi-endpoint client treats it as the signal to
+    fail over (see :class:`~edl_tpu.coord.client.CoordClient`).
+
+    ``fence`` carries the raiser's token when known: a primary whose
+    replication exchange meets this exception deposes itself ONLY if
+    that token beats its own — a stale rejector must not fence the
+    rightful primary."""
+
+    def __init__(self, msg: str, fence: Optional[int] = None) -> None:
+        super().__init__(msg)
+        self.fence = fence
 
 
 class LeaseStatus(enum.Enum):
@@ -55,7 +75,18 @@ def _now_ms() -> int:
 
 
 class PyCoordService:
-    """One job's coordination state: queue + membership + kv."""
+    """One job's coordination state: queue + membership + kv.
+
+    HA surface (the Python twin of the native server's primary/standby
+    machinery — doc/coordinator_ha.md): construct with ``role="standby"``
+    for a warm mirror, attach it to a primary with
+    :meth:`add_replica`, and every acked mutation on the primary streams
+    a versioned snapshot to it via :meth:`sync_from` (persist-before-ack
+    collapses to apply-before-return in-process).  Fencing: a node whose
+    ``role`` is not ``"primary"`` raises :class:`CoordFenced` from every
+    verb — reads and long-polls included — and a deposed primary fences
+    itself the moment a standby answers its stream or lease probe with a
+    newer token."""
 
     def __init__(
         self,
@@ -64,6 +95,9 @@ class PyCoordService:
         member_ttl_ms: int = DEFAULT_MEMBER_TTL_MS,
         max_task_failures: int = DEFAULT_MAX_TASK_FAILURES,
         clock=_now_ms,
+        role: str = "primary",
+        repl_lease_s: float = DEFAULT_REPL_LEASE_S,
+        repl_lease_strict: bool = False,
     ) -> None:
         self._lock = threading.RLock()
         #: wakes long-poll waiters (wait_epoch / kv_wait) the instant a
@@ -92,30 +126,357 @@ class PyCoordService:
         self._members: dict[str, tuple[str, int]] = {}  # name -> (addr, deadline)
         # kv
         self._kv: dict[str, bytes] = {}
+        # HA control plane (see class docstring)
+        self.role = role  # "primary" | "standby" | "fenced"
+        self.fence = 0
+        self._version = 0        # durable-version counter (native twin)
+        self._version_base = 0   # re-anchors the stream position
+        self._replicas: list = []
+        self._repl_acked: dict[int, int] = {}  # id(replica) -> position
+        self._last_repl_ok = time.monotonic()
+        self._repl_lease_s = repl_lease_s
+        #: partition policy: False (default) = AVAILABLE, a primary with
+        #: no reachable standby keeps serving; True = CONSISTENT, it
+        #: suspends (CoordFenced, recoverable) once the lease lapses
+        #: without a successful exchange — see doc/coordinator_ha.md
+        self._repl_lease_strict = repl_lease_strict
+        self.fencing_rejects = 0
+        self.repl_syncs = 0
+        self.repl_errors = 0
+        self.promotions = 0
 
     def member_ttl_ms(self) -> int:
         return self._ttl_ms
+
+    # -- HA: fencing gate + replication stream ------------------------------
+
+    def stream_version(self) -> int:
+        """Replication stream position: monotonic along a failover chain
+        (the process-local mutation counter re-anchored by snapshots)."""
+        with self._lock:
+            return self._version_base + self._version
+
+    def _bump(self) -> None:
+        """A snapshot-visible field changed (native DurableVersion twin);
+        caller holds the lock."""
+        self._version += 1
+
+    def _check_serving(self) -> None:
+        """Fencing gate, called (lock held) before serving any verb: a
+        non-primary never answers, and a primary whose replication lease
+        went stale re-verifies its claim first — so a GC-paused-then-
+        resumed primary discovers its deposition BEFORE handing a client
+        stale epoch/KV state."""
+        if self.role != "primary":
+            self.fencing_rejects += 1
+            raise CoordFenced(
+                f"coordinator is {self.role} (fence {self.fence})",
+                fence=self.fence)
+        if (self._replicas
+                and time.monotonic() - self._last_repl_ok
+                > self._repl_lease_s):
+            any_ok = False
+            for replica in self._replicas:
+                try:
+                    replica.repl_heartbeat(self.fence)
+                except CoordFenced as exc:
+                    if not self._deposed_by(exc):
+                        continue  # stale rejector, not a deposition
+                    raise CoordFenced(
+                        f"deposed: a standby holds a newer fence than "
+                        f"{self.fence}") from None
+                except Exception:
+                    self.repl_errors += 1  # unreachable ≠ deposed
+                else:
+                    any_ok = True
+                    self._last_repl_ok = time.monotonic()
+            if not any_ok and self._repl_lease_strict:
+                # CONSISTENT mode: suspend rather than risk acking on a
+                # partitioned, possibly-deposed claim.  Recoverable — the
+                # role is untouched, so serving resumes when a standby
+                # answers a later probe.
+                self.fencing_rejects += 1
+                raise CoordFenced(
+                    f"replication lease expired with no reachable "
+                    f"standby (strict mode, fence {self.fence})",
+                    fence=self.fence)
+
+    def _self_fence(self) -> None:
+        if self.role == "fenced":
+            return
+        self.role = "fenced"
+        # wake every parked long-poll so it raises CoordFenced NOW
+        self._cond.notify_all()
+
+    def _deposed_by(self, exc: CoordFenced) -> bool:
+        """A replica's fencing reject deposes us only when it carries a
+        GENUINELY newer token — a stale/misconfigured rejector must not
+        fence the rightful primary.  Self-fences and returns True when it
+        does."""
+        if exc.fence is not None and exc.fence <= self.fence:
+            self.repl_errors += 1
+            return False
+        self._self_fence()
+        return True
+
+    def _replicate(self) -> None:
+        """Stream the current snapshot to every replica (lock held; runs
+        after the mutation, before the caller's return — the in-process
+        equivalent of the native server's persist-then-replicate-then-ack
+        pipeline).  An unreachable replica degrades, a replica holding a
+        newer fence deposes us: the mutation stays applied locally but
+        the caller sees :class:`CoordFenced` instead of an ack, exactly
+        the at-least-once contract a retried client op expects."""
+        if not self._replicas or self.role != "primary":
+            return
+        sv = self._version_base + self._version
+        behind = [r for r in self._replicas
+                  if self._repl_acked.get(id(r), -1) < sv]
+        if not behind:
+            return
+        blob = self.snapshot(include_members=True)
+        any_ok = False
+        for replica in behind:
+            try:
+                replica.sync_from(self.fence, sv, blob)
+                # per-replica position: one mirror missing a stream
+                # (while another acked) still gets its catch-up later
+                self._repl_acked[id(replica)] = sv
+                any_ok = True
+            except CoordFenced as exc:
+                if not self._deposed_by(exc):
+                    continue  # stale rejector, not a deposition
+                raise CoordFenced(
+                    f"deposed while replicating at fence {self.fence}"
+                ) from None
+            except Exception:
+                self.repl_errors += 1
+        if any_ok:
+            self._last_repl_ok = time.monotonic()
+            self.repl_syncs += 1
+        elif self._repl_lease_strict:
+            # strict mode: an op NO standby acked must not be acked to
+            # the caller (applied locally but unacked — the at-least-once
+            # retry lands once a mirror is back); role untouched, so this
+            # is a recoverable suspension, not a deposition
+            self.fencing_rejects += 1
+            raise CoordFenced(
+                f"no standby acked the stream (strict mode, fence "
+                f"{self.fence})", fence=self.fence)
+
+    def add_replica(self, replica) -> None:
+        """Attach a warm standby and catch it up NOW: until its first
+        stream a mirror holds nothing, and promoting it would forget
+        every op acked since."""
+        with self._lock:
+            self._replicas.append(replica)
+            self._repl_acked.pop(id(replica), None)
+            if self.role == "primary":
+                self._replicate()
+
+    def sync_from(self, fence: int, version: int, blob: str) -> int:
+        """Standby side of the stream: apply the primary's snapshot.
+        Rejects (with the newer token) a stream whose fence is stale —
+        the split-brain door a deposed primary knocks on."""
+        with self._lock:
+            if self.role == "primary":
+                if fence == self.fence:
+                    # dual-primary collision (racing promoters landed the
+                    # same token on two nodes): equal tokens can never
+                    # depose each other via the stale-rejector rule, so
+                    # the RECEIVER yields — one deterministic survivor
+                    self._self_fence()
+                self.fencing_rejects += 1
+                raise CoordFenced(
+                    f"stale stream fence {fence} (ours {self.fence})",
+                    fence=self.fence)
+            if fence < self.fence:
+                self.fencing_rejects += 1
+                raise CoordFenced(
+                    f"stale stream fence {fence} (ours {self.fence})",
+                    fence=self.fence)
+            if not self._restore(blob, clear=True, with_members=True):
+                # a torn blob must not ratchet the fence or advertise a
+                # position this node does not hold (the native twin
+                # answers ERR badblob); the primary counts a repl error
+                self.repl_errors += 1
+                raise ValueError("torn replication blob rejected")
+            self.fence = max(self.fence, fence)
+            if self.role == "fenced":
+                # a self-fenced ex-primary accepting a stream is provably
+                # a mirror again: regain standby status (and real
+                # redundancy for the pair)
+                self.role = "standby"
+            self._version_base = version - self._version
+            self.repl_syncs += 1
+            return self._version_base + self._version
+
+    def repl_heartbeat(self, fence: int) -> int:
+        """Replication lease probe (primary → standby)."""
+        with self._lock:
+            if self.role == "primary":
+                if fence == self.fence:
+                    # dual-primary collision: the receiver yields (see
+                    # sync_from) but still rejects this exchange
+                    self._self_fence()
+                self.fencing_rejects += 1
+                raise CoordFenced(
+                    f"stale lease fence {fence} (ours {self.fence})",
+                    fence=self.fence)
+            if fence < self.fence:
+                self.fencing_rejects += 1
+                raise CoordFenced(
+                    f"stale lease fence {fence} (ours {self.fence})",
+                    fence=self.fence)
+            self.fence = max(self.fence, fence)
+            return self.fence
+
+    def promote(self, fence: int) -> int:
+        """Become the primary under fencing token ``fence`` (must beat
+        every token this node has seen).  Members mirrored from the old
+        primary get a full TTL to re-heartbeat here, so a failover prunes
+        nobody and bumps no epoch."""
+        with self._lock:
+            if self.role == "primary":
+                if fence < self.fence:
+                    raise CoordFenced(f"stale promote token {fence} "
+                                      f"(fence {self.fence})")
+                self.fence = max(self.fence, fence)
+                return self.fence
+            if fence <= self.fence:
+                raise CoordFenced(f"stale promote token {fence} "
+                                  f"(fence {self.fence})")
+            self.fence = fence
+            self.role = "primary"
+            now = self._clock()
+            self._members = {n: (a, now + self._ttl_ms)
+                             for n, (a, _) in self._members.items()}
+            self._last_repl_ok = time.monotonic()
+            self.promotions += 1
+            self._cond.notify_all()
+            return self.fence
+
+    # -- snapshot / restore (native-format parity) --------------------------
+
+    def snapshot(self, include_members: bool = False) -> str:
+        """The native snapshot format, byte-compatible with
+        ``Service::Snapshot`` / ``SnapshotRepl`` (coord.cc) — one format,
+        both backends, so cross-backend restores and the format tests in
+        tests/test_coord_ha.py hold the two implementations together."""
+        def hx(b: bytes) -> str:
+            # empty binary fields frame as "-" (the wire convention): a
+            # bare trailing space would be dropped by the stream parser
+            return b.hex() if b else "-"
+
+        with self._lock:
+            out = ["EDLCOORD1",
+                   f"Q {self._pass} {self._next_id} {self._dropped}"]
+            pending = sorted(
+                list(self._todo) + [l.task for l in self._leased.values()],
+                key=lambda t: t.id)
+            out += [f"T {t.id} {t.failures} {hx(t.payload)}"
+                    for t in pending]
+            out += [f"D {t.id} {t.failures} {hx(t.payload)}"
+                    for t in self._done]
+            out.append(f"E {self._epoch}")
+            out += [f"K {k.encode().hex()} {hx(v)}"
+                    for k, v in sorted(self._kv.items())]
+            out.append(f"F {self.fence} "
+                       f"{self._version_base + self._version}")
+            if include_members:
+                out += [f"M {n.encode().hex()} {hx(a.encode())}"
+                        for n, (a, _) in sorted(self._members.items())]
+            out.append(".\n")
+            return "\n".join(out)
+
+    def restore(self, blob: str) -> bool:
+        """Disk-restore semantics (the native LoadFrom twin): queue, KV,
+        epoch and fence come back; members re-Join when their heartbeats
+        bounce."""
+        with self._lock:
+            return self._restore(blob, clear=False, with_members=False)
+
+    def _restore(self, blob: str, clear: bool, with_members: bool) -> bool:
+        if not blob.startswith("EDLCOORD1\n") or not blob.endswith("\n.\n"):
+            return False  # torn blob must not wipe the last good mirror
+        if clear:
+            self._todo.clear()
+            self._leased.clear()
+            self._done.clear()
+            self._pass = 0
+            self._next_id = 0
+            self._dropped = 0
+            self._kv.clear()
+            self._members.clear()
+            self._bump()
+        def unhex(tok: str) -> bytes:
+            return b"" if tok in ("-", "") else bytes.fromhex(tok)
+
+        now = self._clock()
+        recorded = None
+        for line in blob.splitlines()[1:]:
+            if not line or line == ".":
+                continue
+            parts = line.split(" ")
+            tag = parts[0]
+            try:
+                if tag == "Q":
+                    self._pass, self._next_id, self._dropped = (
+                        int(parts[1]), int(parts[2]), int(parts[3]))
+                elif tag in ("T", "D"):
+                    t = _Task(int(parts[1]),
+                              unhex(parts[3]) if len(parts) > 3 else b"",
+                              failures=int(parts[2]))
+                    (self._todo.append(t) if tag == "T"
+                     else self._done.append(t))
+                elif tag == "E":
+                    self._epoch = max(self._epoch, int(parts[1]))
+                elif tag == "K":
+                    self._kv[bytes.fromhex(parts[1]).decode()] = \
+                        unhex(parts[2]) if len(parts) > 2 else b""
+                elif tag == "F":
+                    if int(parts[1]) > self.fence:
+                        self.fence = int(parts[1])
+                    recorded = int(parts[2])
+                elif tag == "M" and with_members:
+                    self._members[bytes.fromhex(parts[1]).decode()] = (
+                        unhex(parts[2]).decode()
+                        if len(parts) > 2 else "",
+                        now + self._ttl_ms)
+                # unknown tags: forward compatibility, skip
+            except (IndexError, ValueError):
+                continue  # one malformed line must not kill the restore
+        self._bump()
+        if recorded is not None:
+            self._version_base = recorded - self._version
+        return True
 
     # -- task queue --------------------------------------------------------
 
     def add_task(self, payload: bytes) -> int:
         with self._lock:
+            self._check_serving()
             t = _Task(self._next_id, bytes(payload))
             self._next_id += 1
             self._todo.append(t)
+            self._bump()
+            self._replicate()
             return t.id
 
     def lease(self, worker: str) -> tuple[LeaseStatus, int, bytes]:
         now = self._clock()
         with self._lock:
+            self._check_serving()
             self._redispatch_locked(now)
             self._maybe_advance_pass()
             if not self._todo:
                 finished = not self._leased and self._pass + 1 >= self._total_passes
                 status = LeaseStatus.DONE if finished else LeaseStatus.EMPTY
+                self._replicate()  # a rollover can land inside a LEASE
                 return (status, -1, b"")
             t = self._todo.popleft()
             self._leased[t.id] = _Leased(t, worker, now + self._timeout_ms)
+            self._replicate()
             return (LeaseStatus.OK, t.id, t.payload)
 
     def complete(self, task_id: int, worker: Optional[str] = None) -> bool:
@@ -123,6 +484,7 @@ class PyCoordService:
         is rejected unless that worker still holds the lease — so a timed-out
         straggler's late completion can't void a re-dispatched lease."""
         with self._lock:
+            self._check_serving()
             leased = self._leased.get(task_id)
             if leased is None:
                 return False  # late completion after re-dispatch
@@ -130,11 +492,14 @@ class PyCoordService:
                 return False  # lease moved to another worker
             del self._leased[task_id]
             self._done.append(leased.task)
+            self._bump()  # pending→done is a snapshot-visible move
             self._maybe_advance_pass()
+            self._replicate()
             return True
 
     def fail(self, task_id: int, worker: Optional[str] = None) -> bool:
         with self._lock:
+            self._check_serving()
             leased = self._leased.get(task_id)
             if leased is None:
                 return False
@@ -147,7 +512,9 @@ class PyCoordService:
                 self._dropped += 1  # poison pill: drop, don't wedge the pass
             else:
                 self._todo.append(t)
+            self._bump()  # failure count / dropped counter changed
             self._maybe_advance_pass()
+            self._replicate()
             return True
 
     def renew(self, task_id: int, worker: str) -> bool:
@@ -155,6 +522,7 @@ class PyCoordService:
         so the 16 s re-dispatch clock measures *silence*, not shard size)."""
         now = self._clock()
         with self._lock:
+            self._check_serving()
             leased = self._leased.get(task_id)
             if leased is None or (worker and leased.worker != worker):
                 return False
@@ -163,10 +531,12 @@ class PyCoordService:
 
     def redispatch(self) -> int:
         with self._lock:
+            self._check_serving()
             return self._redispatch_locked(self._clock())
 
     def release_worker(self, worker: str) -> int:
         with self._lock:
+            self._check_serving()
             mine = [tid for tid, l in self._leased.items() if l.worker == worker]
             for tid in mine:
                 self._todo.append(self._leased.pop(tid).task)
@@ -174,15 +544,18 @@ class PyCoordService:
 
     def all_done(self) -> bool:
         with self._lock:
+            self._check_serving()
             return (not self._todo and not self._leased
                     and self._pass + 1 >= self._total_passes)
 
     def current_pass(self) -> int:
         with self._lock:
+            self._check_serving()
             return self._pass
 
     def stats(self) -> QueueStats:
         with self._lock:
+            self._check_serving()
             return QueueStats(len(self._todo), len(self._leased),
                               len(self._done), self._dropped, self._pass)
 
@@ -208,23 +581,30 @@ class PyCoordService:
                 # dropped as a poison pill): later passes would be empty
                 # too — finish now instead of livelocking on EMPTY.
                 self._pass = self._total_passes - 1
+            # reached from lease() too: a rollover must stream/persist
+            # even though LEASE itself is not a mutating command
+            self._bump()
 
     # -- membership --------------------------------------------------------
 
     def join(self, name: str, address: str = "") -> int:
         now = self._clock()
         with self._lock:
+            self._check_serving()
             prev = self._members.get(name)
             change = prev is None or prev[0] != address
             self._members[name] = (address, now + self._ttl_ms)
             if change:
                 self._epoch += 1
+                self._bump()
                 self._cond.notify_all()
+            self._replicate()
             return self._epoch
 
     def heartbeat(self, name: str) -> bool:
         now = self._clock()
         with self._lock:
+            self._check_serving()
             if name not in self._members:
                 return False
             addr, _ = self._members[name]
@@ -233,25 +613,32 @@ class PyCoordService:
 
     def leave(self, name: str) -> bool:
         with self._lock:
+            self._check_serving()
             if self._members.pop(name, None) is None:
                 return False
             self._epoch += 1
+            self._bump()
             self._cond.notify_all()
+            self._replicate()
             return True
 
     def expire_members(self) -> int:
         now = self._clock()
         with self._lock:
+            self._check_serving()
             dead = [n for n, (_, dl) in self._members.items() if dl <= now]
             for n in dead:
                 del self._members[n]
             if dead:
                 self._epoch += 1
+                self._bump()
                 self._cond.notify_all()
+            self._replicate()
             return len(dead)
 
     def epoch(self) -> int:
         with self._lock:
+            self._check_serving()
             return self._epoch
 
     # -- long-poll waits ---------------------------------------------------
@@ -276,6 +663,9 @@ class PyCoordService:
         parked = False
         with self._cond:
             while True:
+                # a wait that outlives this node's primacy must not hand
+                # the waiter a stale epoch (_self_fence notifies the cond)
+                self._check_serving()
                 self.expire_members()  # TTL truth, like MEMBERS' sweep
                 if self._epoch != known_epoch:
                     if parked:
@@ -299,6 +689,7 @@ class PyCoordService:
         parked = False
         with self._cond:
             while True:
+                self._check_serving()  # see wait_epoch
                 self.expire_members()
                 v = self._kv.get(key)
                 if v is not None:
@@ -339,6 +730,10 @@ class PyCoordService:
             from edl_tpu.observability.metrics import get_registry
 
             registry = get_registry()
+        # Every callback reads private state under the lock instead of the
+        # public verbs: those are fencing-gated, and a standby's /metrics
+        # must keep answering (scraping a mirror is how an operator SEES
+        # that it is a mirror) while its client surface refuses.
         registry.counter_fn("coord_requests",
                             lambda: self.requests_served,
                             help="protocol requests served")
@@ -348,18 +743,41 @@ class PyCoordService:
         registry.counter_fn("coord_longpolls_fired",
                             lambda: self.longpolls_fired,
                             help="parked waits woken by an event")
-        registry.gauge_fn("coord_membership_epoch", self.epoch,
+        registry.gauge_fn("coord_membership_epoch",
+                          lambda: self._epoch,
                           help="membership epoch")
         registry.gauge_fn("coord_members",
-                          lambda: len(self.members()[1]),
+                          lambda: len(self._members),
                           help="live members")
-        registry.gauge_fn("coord_pass", self.current_pass,
+        registry.gauge_fn("coord_pass", lambda: self._pass,
                           help="current task-queue pass")
-        for state in ("todo", "leased", "done", "dropped"):
+        queue_len = {"todo": lambda: len(self._todo),
+                     "leased": lambda: len(self._leased),
+                     "done": lambda: len(self._done),
+                     "dropped": lambda: self._dropped}
+        for state, fn in queue_len.items():
             registry.gauge_fn(
-                "coord_queue_tasks",
-                lambda s=state: getattr(self.stats(), s),
+                "coord_queue_tasks", fn,
                 help="task queue depth by state", state=state)
+        # HA plane, name-matched to the native /metrics exposition
+        role_code = {"primary": 0, "standby": 1, "fenced": 2}
+        registry.gauge_fn("coord_role",
+                          lambda: role_code.get(self.role, 2),
+                          help="0=primary 1=standby 2=fenced")
+        registry.gauge_fn("coord_fence", lambda: self.fence,
+                          help="fencing token (bumped by every promotion)")
+        registry.gauge_fn("coord_stream_version", self.stream_version,
+                          help="replication stream position")
+        registry.counter_fn("coord_fencing_rejects",
+                            lambda: self.fencing_rejects,
+                            help="verbs rejected: not the fenced-in "
+                                 "primary")
+        registry.counter_fn("coord_repl_syncs", lambda: self.repl_syncs,
+                            help="replication streams acked/applied")
+        registry.counter_fn("coord_repl_errors", lambda: self.repl_errors,
+                            help="replication exchanges that failed")
+        registry.counter_fn("coord_promotions", lambda: self.promotions,
+                            help="standby-to-primary promotions")
 
     def members(self) -> tuple[int, list[tuple[str, str]]]:
         """(epoch, [(name, address)]) name-sorted — this order IS the rank
@@ -373,24 +791,32 @@ class PyCoordService:
 
     def kv_set(self, key: str, value: bytes) -> None:
         with self._lock:
+            self._check_serving()
             self._kv[key] = bytes(value)
+            self._bump()
             self._cond.notify_all()
+            self._replicate()
 
     def kv_get(self, key: str) -> Optional[bytes]:
         with self._lock:
+            self._check_serving()
             return self._kv.get(key)
 
     def kv_del(self, key: str) -> bool:
         with self._lock:
+            self._check_serving()
             removed = self._kv.pop(key, None) is not None
             if removed:
+                self._bump()
                 self._cond.notify_all()
+                self._replicate()
             return removed
 
     def kv_cas(self, key: str, expect: bytes, value: bytes) -> bool:
         """Set iff current == expect (empty expect: must not exist) — the
         slot-claim primitive (role of etcd pserver slots)."""
         with self._lock:
+            self._check_serving()
             cur = self._kv.get(key)
             if expect == b"":
                 if cur is not None:
@@ -398,11 +824,14 @@ class PyCoordService:
             elif cur != expect:
                 return False
             self._kv[key] = bytes(value)
+            self._bump()
             self._cond.notify_all()
+            self._replicate()
             return True
 
     def kv_keys(self, prefix: str = "") -> list[str]:
         with self._lock:
+            self._check_serving()
             return sorted(k for k in self._kv if k.startswith(prefix))
 
     def close(self) -> None:  # interface parity with the native handle

@@ -244,6 +244,16 @@ class FaultContext:
     #: non-kubelet drills: SIGKILL + respawn the coord server process
     #: (durable state file carries recovery) — provided by the harness
     restart_coordinator: Optional[Callable[[], None]] = None
+    #: HA coordinator pair (doc/coordinator_ha.md): True when ``coord``
+    #: is a multi-endpoint client over a primary/standby pair.  Flips
+    #: KillCoordinator's recovery contract from "the respawn answers
+    #: again" to "a client failover was OBSERVED and zero world reforms
+    #: were triggered" — sub-second promotion, not a reform storm.
+    ha: bool = False
+    #: HA drills: SIGKILL the current primary (no respawn — the standby's
+    #: promotion IS the recovery).  Harness-installed; falls back to
+    #: ``restart_coordinator`` when unset.
+    kill_primary: Optional[Callable[[], None]] = None
     #: quiet-failure hooks (the watchdog drills).  ``stall`` wedges the
     #: training loop for a duration (None = until escalation unwedges
     #: it); ``wedge`` freezes one collective participant (e.g. SIGSTOP a
@@ -373,11 +383,51 @@ class KillTrainer(FaultAction):
 @dataclass
 class KillCoordinator(FaultAction):
     """SIGKILL the coordinator pod/process; durable state (the state file
-    on the job volume) carries recovery when the replacement starts."""
+    on the job volume) carries recovery when the replacement starts.
+
+    In HA mode (``ctx.ha``) the contract hardens: the kill takes down the
+    PRIMARY of a replicated pair and recovery means the multi-endpoint
+    client was observed failing over (``coord_failovers`` moved) with the
+    promoted standby answering — while **zero** world reforms fire.  A
+    reform slipping through is recorded loudly
+    (``coord_ha_reform_leaks``) so the drill's assertion has evidence,
+    not just a green predicate."""
 
     kind: str = "kill_coordinator"
 
     def fire(self, ctx: FaultContext):
+        if ctx.ha:
+            kill = ctx.kill_primary or ctx.restart_coordinator
+            if kill is None:
+                raise RuntimeError(
+                    "HA KillCoordinator needs a kill_primary (or "
+                    "restart_coordinator) callable")
+            counters = get_counters()
+            before_failovers = counters.total("coord_failovers")
+            before_reforms = counters.total("world_reforms")
+            leak_recorded = [False]
+            log.warn("fault: killing HA primary coordinator")
+            kill()
+
+            def recovered() -> bool:
+                # failover observed first (the counter is the client's
+                # own record of re-targeting), then the promoted standby
+                # answering the probe
+                if counters.total("coord_failovers") <= before_failovers:
+                    return False
+                if not ctx.coord_alive():
+                    return False
+                if (counters.total("world_reforms") > before_reforms
+                        and not leak_recorded[0]):
+                    # the failover was supposed to be invisible to every
+                    # world; a reform leaking through fails the HA claim
+                    leak_recorded[0] = True
+                    log.warn("HA coordinator failover leaked a world "
+                             "reform")
+                    counters.inc("coord_ha_reform_leaks")
+                return True
+
+            return FIRED, recovered
         if ctx.kubelet is not None:
             coords = [n for n in ctx.kubelet.live_pods()
                       if "-coordinator-" in n]

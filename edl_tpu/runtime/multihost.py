@@ -93,6 +93,12 @@ _LEAVE_KEY = "leave-intent/{epoch}"
 #: processes.  (EDL_TRACE_ID env covers cold spawns; the KV covers warm
 #: pre-spawned children whose env predates the reform.)
 _TRACE_KEY = "trace/{epoch}"
+#: coordination-endpoint SET (JSON ["host:port", ...]) published by every
+#: supervisor whose coord client is HA-aware: tooling and late joiners
+#: discover the primary AND its standbys from whichever endpoint they
+#: reached first, so a failover mid-join still lands (the endpoint set
+#: rides the replication stream like any other KV)
+_COORD_ENDPOINTS_KEY = "coord-endpoints"
 
 
 def _gen_from_key(key: str) -> Optional[int]:
@@ -1435,6 +1441,17 @@ def run_elastic_worker(
         ew.clear_eviction()
     except Exception:
         pass  # coordinator briefly unreachable; join's retry path rules
+    # HA: publish the coordination endpoint SET so tooling and late
+    # joiners that only know one endpoint can discover the standbys (the
+    # key replicates with everything else, so it survives the failover
+    # it exists to describe).  Supervisors race benignly: same value.
+    eps = getattr(coord, "endpoints", None)
+    if eps and len(eps) > 1:
+        try:
+            coord.kv_set(_COORD_ENDPOINTS_KEY, json.dumps(
+                [f"{h}:{p}" for h, p in eps]).encode())
+        except Exception:
+            pass  # discovery metadata, never a formation failure
     ew.join()
     # Reform timeline into the process tracer (the reference had no
     # tracing at all, SURVEY §5.1); EDL_MH_TRACE=<dir> dumps a chrome

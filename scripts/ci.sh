@@ -200,6 +200,109 @@ EOF
 JAX_PLATFORMS=cpu python "$TELE_TMP/reform_span_smoke.py"
 rm -rf "$TELE_TMP"
 
+echo "== coordinator HA smoke (primary SIGKILL mid-run: 1 failover, 0 reforms)"
+# A supervised training run against a replicated coordinator pair loses
+# its PRIMARY to SIGKILL mid-run: training must resume against the
+# promoted standby with exactly one observed client failover and ZERO
+# world reforms, and the promoted standby's /metrics must stay green
+# under the strict exposition parser.  Runs from a real file (spawn-
+# context world children re-import __main__).
+HA_TMP="$(mktemp -d)"
+cat > "$HA_TMP/ha_smoke.py" <<'EOF'
+import functools, os, signal, sys, tempfile, threading, time
+import urllib.request
+
+import numpy as np
+
+sys.path.insert(0, os.getcwd())
+
+
+def _init_state():
+    return {"step": np.zeros((), np.int32)}
+
+
+def _load_state(path):
+    from edl_tpu.runtime.multihost import load_numpy_tree
+
+    return load_numpy_tree(path, _init_state())
+
+
+def _train_world(world, state, should_stop, *, done_at=30, heartbeat=None):
+    step = int(state["step"])
+    while step < done_at:
+        if should_stop():
+            return {"step": np.asarray(step, np.int32)}, True
+        step += 1
+        if heartbeat is not None:
+            heartbeat(step)
+        time.sleep(0.1)
+    return {"step": np.asarray(step, np.int32)}, False
+
+
+def main():
+    from tests.test_observability import parse_prometheus
+    from edl_tpu.coord import CoordClient, spawn_ha_pair
+    from edl_tpu.observability.collector import get_counters
+    from edl_tpu.runtime.multihost import run_elastic_worker, save_numpy_tree
+
+    tmp = tempfile.mkdtemp(prefix="edl-ci-ha-")
+    pr, sb = spawn_ha_pair(tmp, member_ttl_ms=6000, repl_lease_ms=1000,
+                           health_port=0)
+    client = CoordClient("127.0.0.1", pr.port, timeout=2.0,
+                         reconnect_window_s=15.0, promote_grace_s=0.3,
+                         endpoints=[("127.0.0.1", sb.port)])
+    # assassin: SIGKILL the primary once the world is PROVABLY
+    # mid-training (the stall-watchdog heartbeat file shows step >= 5),
+    # so the failover always lands inside the training window
+    def assassinate():
+        hb = os.path.join(tmp, "hb-w0")
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            try:
+                if int(open(hb).read().strip()) >= 5:
+                    break
+            except (OSError, ValueError):
+                pass
+            time.sleep(0.1)
+        pr.process.send_signal(signal.SIGKILL)
+    threading.Thread(target=assassinate, daemon=True).start()
+    try:
+        outcome = run_elastic_worker(
+            client, "w0",
+            init_state=_init_state,
+            train_world=functools.partial(_train_world, done_at=60),
+            save_state=save_numpy_tree, load_state=_load_state,
+            ckpt_dir=tmp, settle_s=0.1, warm_spawn=False,
+            reform_grace_s=2.0, stall_floor_s=30.0)
+        assert outcome.step == 60, outcome
+        c = get_counters()
+        assert c.get("coord_failovers") == 1, c.snapshot()
+        assert c.get("world_reforms") == 0, c.snapshot()
+        assert c.get("coord_fencing_rejects") == 0, c.snapshot()
+        # strict exposition parse on the PROMOTED standby
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{sb.health_port}/metrics",
+                timeout=5) as r:
+            s = parse_prometheus(r.read().decode())
+        assert s["edl_coord_role"] == 0, s       # promoted: primary
+        assert s["edl_coord_fence"] == 1, s      # exactly one promotion
+        assert s["edl_coord_promotions_total"] == 1, s
+        # epoch == 2: the worker's join (1) + its graceful leave (2) —
+        # membership survived the failover with NO rejoin/expiry churn
+        assert s["edl_coord_membership_epoch"] == 2, s
+        print("HA smoke OK: failovers=1 reforms=0 fence=1 step=60")
+    finally:
+        client.close()
+        pr.stop()
+        sb.stop()
+
+
+if __name__ == "__main__":
+    main()
+EOF
+JAX_PLATFORMS=cpu python "$HA_TMP/ha_smoke.py"
+rm -rf "$HA_TMP"
+
 echo "== reshard smoke (dynamic reparallelization + dryrun sharding checks)"
 # A dp→fsdp reparallelizing resize on CPU devices through the
 # transactional path: zero failures, state preserved, a nonzero replan

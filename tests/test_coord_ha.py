@@ -1,0 +1,762 @@
+"""Coordinator HA: replicated control plane with fenced failover.
+
+The paper's control plane (master + etcd) outlives any one process; our
+single coordinator was the SPOF (ROADMAP #5).  These tests pin the HA
+contract on BOTH backends (native edl-coord-server pair and in-process
+PyCoordService pair):
+
+* every acked mutation is on the standby before the client hears OK
+  (stream-before-ack, the replication twin of persist-before-ack);
+* a standby answers every client verb — reads and long-polls included —
+  with the fencing error until promoted;
+* promotion picks the standby with the highest durably-held stream
+  position, under a token that beats every token seen;
+* a deposed primary (GC-pause shape) fences ITSELF before serving stale
+  state, and clients observe ``coord_fencing_rejects``;
+* the multi-endpoint client fails over transparently — in-flight
+  long-polls re-park on the new primary — and raises a typed
+  :class:`CoordUnavailable` within its deadline budget when every
+  endpoint is down, instead of riding the outage forever.
+"""
+
+from __future__ import annotations
+
+import json
+import signal
+import socket
+import threading
+import time
+
+import pytest
+
+from edl_tpu.coord import (
+    CoordClient,
+    CoordFenced,
+    CoordUnavailable,
+    NativeCoordService,
+    PyCoordService,
+    native_available,
+    spawn_ha_pair,
+    spawn_server,
+)
+from edl_tpu.observability.collector import get_counters
+
+pytestmark = pytest.mark.multihost
+
+
+def _kill9(handle) -> None:
+    handle.process.send_signal(signal.SIGKILL)
+    handle.process.wait(timeout=10)
+
+
+def _wait_stopped(pid: int, timeout_s: float = 5.0) -> None:
+    """Block until the kernel reports the process stopped ('T' state).
+    SIGSTOP delivery is asynchronous to the sender under load — issuing
+    the next client op before the stop lands lets the 'paused' primary
+    serve it and no failover happens (observed flake)."""
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        with open(f"/proc/{pid}/stat") as f:
+            if f.read().rsplit(")", 1)[1].split()[0] == "T":
+                return
+        time.sleep(0.01)
+    raise TimeoutError(f"pid {pid} never stopped")
+
+
+def _raw(port: int, line: str, timeout: float = 3.0) -> str:
+    """One command over a fresh socket — bypasses the client's failover
+    so a fenced node's own answer is observable."""
+    with socket.create_connection(("127.0.0.1", port),
+                                  timeout=timeout) as s:
+        s.settimeout(timeout)
+        s.sendall((line + "\n").encode())
+        return s.makefile("rb").readline().decode().strip()
+
+
+def _ha_client(primary, standby, **kw):
+    kw.setdefault("timeout", 2.0)
+    kw.setdefault("reconnect_window_s", 12.0)
+    kw.setdefault("promote_grace_s", 0.2)
+    return CoordClient("127.0.0.1", primary.port,
+                       endpoints=[("127.0.0.1", standby.port)], **kw)
+
+
+# ---------------------------------------------------------------------------
+# Python backend: in-process pair
+# ---------------------------------------------------------------------------
+
+class TestPyBackend:
+    def _pair(self):
+        pr = PyCoordService()
+        sb = PyCoordService(role="standby")
+        pr.add_replica(sb)
+        return pr, sb
+
+    def test_stream_before_ack_and_promotion(self):
+        pr, sb = self._pair()
+        pr.add_task(b"shard-0")
+        pr.join("w0", "a0")
+        pr.kv_set("ckpt/1", b"/gen-1")
+        # everything acked on the primary is already on the standby
+        assert sb.promote(1) == 1
+        assert sb.kv_get("ckpt/1") == b"/gen-1"
+        assert sb.stats().todo == 1
+        epoch, members = sb.members()
+        assert (epoch, members) == (1, [("w0", "a0")])
+        # failover is invisible to membership: heartbeat accepted, no
+        # rejoin, no epoch bump
+        assert sb.heartbeat("w0")
+        assert sb.epoch() == 1
+
+    def test_standby_rejects_reads_writes_and_waits(self):
+        _pr, sb = self._pair()
+        for op in (lambda: sb.kv_get("k"),
+                   lambda: sb.kv_set("k", b"v"),
+                   lambda: sb.epoch(),
+                   lambda: sb.members(),
+                   lambda: sb.stats(),
+                   lambda: sb.lease("w"),
+                   lambda: sb.wait_epoch(0, 0.05),
+                   lambda: sb.kv_wait("k", 0.05)):
+            with pytest.raises(CoordFenced):
+                op()
+        assert sb.fencing_rejects >= 8
+
+    def test_deposed_primary_self_fences_on_stream(self):
+        pr, sb = self._pair()
+        pr.kv_set("k", b"v")
+        sb.promote(1)
+        # the GC-pause shape: the old primary wakes and writes — its
+        # stream is rejected with the newer fence and it fences itself;
+        # the mutation is never acked (the client's retry lands on the
+        # promoted standby)
+        with pytest.raises(CoordFenced):
+            pr.kv_set("k", b"stale")
+        assert pr.role == "fenced"
+        with pytest.raises(CoordFenced):
+            pr.kv_get("k")
+        with pytest.raises(CoordFenced):
+            pr.wait_epoch(0, 0.05)
+        assert sb.kv_get("k") == b"v"
+
+    def test_lease_guard_fences_reads_without_a_mutation(self):
+        # reads alone must discover the deposition: the replication lease
+        # forces a heartbeat exchange once stale, and the newer fence
+        # fences the old primary BEFORE it hands out stale epoch/KV
+        pr = PyCoordService(repl_lease_s=0.05)
+        sb = PyCoordService(role="standby")
+        pr.add_replica(sb)
+        pr.kv_set("k", b"v")
+        sb.promote(1)
+        time.sleep(0.1)  # lease goes stale (the simulated pause)
+        with pytest.raises(CoordFenced):
+            pr.kv_get("k")
+        assert pr.role == "fenced"
+
+    def test_promote_requires_winning_token(self):
+        pr, sb = self._pair()
+        pr.kv_set("k", b"v")
+        sb.promote(3)
+        with pytest.raises(CoordFenced):
+            sb.promote(2)  # re-promote with a losing token: refused
+        assert sb.promote(5) == 5  # ratchet up is idempotent-safe
+        # a standby that saw fence 5 via a later stream refuses 5
+        sb2 = PyCoordService(role="standby")
+        sb.add_replica(sb2)
+        sb.kv_set("k2", b"v2")
+        assert sb2.fence == 5
+        with pytest.raises(CoordFenced):
+            sb2.promote(5)
+
+    def test_parked_longpoll_wakes_fenced(self):
+        pr, sb = self._pair()
+        pr.join("w0")
+        sb.promote(1)
+        out = []
+
+        def waiter():
+            try:
+                pr.wait_epoch(1, 10.0)
+            except CoordFenced:
+                out.append("fenced")
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        time.sleep(0.1)
+        # the deposed primary discovers the fence on its next exchange;
+        # _self_fence must wake the parked waiter promptly
+        with pytest.raises(CoordFenced):
+            pr.kv_set("k", b"v")
+        t.join(timeout=5)
+        assert out == ["fenced"]
+
+    def test_stale_rejector_does_not_depose_rightful_primary(self):
+        # a misconfigured replica that believes it is primary at an OLDER
+        # fence rejects our stream — but its token loses, so the rightful
+        # primary must keep serving (a config error must not become a
+        # total control-plane outage)
+        pr, sb = self._pair()
+        pr.kv_set("k", b"v")
+        sb.promote(1)          # sb is the rightful fence-1 primary now
+        stale = PyCoordService()  # role primary, fence 0
+        sb.add_replica(stale)
+        sb.kv_set("k2", b"v2")  # stream rejected by the stale "primary"
+        assert sb.role == "primary" and sb.kv_get("k2") == b"v2"
+        assert sb.repl_errors >= 1
+
+    def test_fenced_mirror_regains_standby_on_stream(self):
+        pr, sb = self._pair()
+        pr.kv_set("k", b"v")
+        sb.promote(1)
+        with pytest.raises(CoordFenced):
+            pr.kv_set("k", b"stale")  # deposed: pr self-fences
+        assert pr.role == "fenced"
+        # the operator loop re-attaches the corpse as sb's mirror: the
+        # first accepted stream demotes fenced -> standby (redundancy is
+        # back), and it is promotable again after sb dies
+        sb.add_replica(pr)
+        sb.kv_set("k3", b"v3")
+        assert pr.role == "standby"
+        assert pr.promote(2) == 2
+        assert pr.kv_get("k3") == b"v3"
+
+    def test_unreachable_standby_degrades_not_blocks(self):
+        class Dead:
+            def sync_from(self, *a):
+                raise OSError("unreachable")
+
+            def repl_heartbeat(self, *a):
+                raise OSError("unreachable")
+
+        pr = PyCoordService(repl_lease_s=0.0)
+        pr.add_replica(Dead())
+        pr.kv_set("k", b"v")  # a dead standby must not take down the job
+        assert pr.kv_get("k") == b"v"
+        assert pr.repl_errors >= 1
+
+    def test_strict_lease_suspends_without_standby_and_recovers(self):
+        class Flaky:
+            def __init__(self):
+                self.up = True
+
+            def sync_from(self, *a):
+                if not self.up:
+                    raise OSError("unreachable")
+
+            def repl_heartbeat(self, *a):
+                if not self.up:
+                    raise OSError("unreachable")
+
+        flaky = Flaky()
+        pr = PyCoordService(repl_lease_s=0.0, repl_lease_strict=True)
+        pr.add_replica(flaky)
+        pr.kv_set("k", b"v")
+        flaky.up = False
+        # CONSISTENT mode: no reachable standby past the lease -> suspend
+        # (reads included), but the role is untouched...
+        with pytest.raises(CoordFenced):
+            pr.kv_get("k")
+        assert pr.role == "primary"
+        # ...so serving resumes the moment the standby answers again
+        flaky.up = True
+        assert pr.kv_get("k") == b"v"
+
+    def test_dual_primary_equal_fence_receiver_yields(self):
+        # two clients raced PROMOTE onto two standbys with the SAME
+        # token: equal fences can never depose each other through the
+        # stale-rejector rule, so the first exchange makes the RECEIVER
+        # yield — one deterministic survivor
+        a = PyCoordService(role="standby")
+        b = PyCoordService(role="standby")
+        a.promote(1)
+        b.promote(1)
+        a.add_replica(b)
+        # add_replica's catch-up stream hits b while b is still primary:
+        # b (the receiver) yields — and the NEXT stream finds a fenced
+        # mirror and demotes it to standby, so the loser converges into
+        # a's replica instead of lingering as a corpse
+        a.kv_set("k", b"v")
+        assert a.role == "primary" and b.role == "standby"
+        assert a.kv_get("k") == b"v"
+        a.kv_set("k2", b"v2")  # a keeps serving as the single survivor
+        assert a.role == "primary"
+        # and b is a faithful mirror again: promotable with b's state
+        assert b.promote(2) == 2
+        assert b.kv_get("k2") == b"v2"
+
+
+# ---------------------------------------------------------------------------
+# Snapshot format parity: one format, both backends
+# ---------------------------------------------------------------------------
+
+@pytest.mark.skipif(not native_available(), reason="no native core")
+class TestSnapshotParity:
+    def _populate(self, svc):
+        svc.add_task(b"shard-0")
+        svc.add_task(b"shard-1")
+        st, tid, _ = svc.lease("w0")
+        svc.complete(tid, "w0")
+        svc.join("w0", "addr-0")
+        svc.join("w1", "addr-1")
+        svc.kv_set("ckpt/2", b"/gen-2")
+
+    def test_python_blob_restores_into_native(self):
+        py = PyCoordService()
+        self._populate(py)
+        native = NativeCoordService()
+        assert native.restore_repl(py.snapshot(include_members=True))
+        assert native.kv_get("ckpt/2") == b"/gen-2"
+        assert native.stats().todo == 1 and native.stats().done == 1
+        epoch, members = native.members()
+        assert members == [("w0", "addr-0"), ("w1", "addr-1")]
+        assert epoch == py.epoch()
+
+    def test_empty_fields_survive_the_stream(self):
+        # empty binary fields frame as "-": a bare trailing space would
+        # be dropped by the stream parser — an empty-addr member (the
+        # common join(name) case), an empty KV value, and an empty task
+        # payload must all survive replication on both backends
+        py = PyCoordService()
+        py.join("w0")                      # address ""
+        py.kv_set("flag", b"")
+        py.add_task(b"")
+        native = NativeCoordService()
+        assert native.restore_repl(py.snapshot(include_members=True))
+        assert native.members()[1] == [("w0", "")]
+        assert native.kv_get("flag") == b""
+        st, _tid, payload = native.lease("w")
+        assert st.name == "OK" and payload == b""
+        # and back: native blob into a python standby
+        py2 = PyCoordService(role="standby")
+        py2.sync_from(0, 9, native.snapshot(include_members=True))
+        py2.promote(1)
+        assert py2.members()[1] == [("w0", "")]
+        assert py2.kv_get("flag") == b""
+
+    def test_torn_blob_rejected_without_ratcheting_position(self):
+        sb = PyCoordService(role="standby")
+        pr = PyCoordService()
+        pr.add_replica(sb)
+        pr.kv_set("k", b"v")
+        good = sb.stream_version()
+        with pytest.raises(ValueError):
+            sb.sync_from(5, 99, "EDLCOORD1\ntruncated")  # no terminator
+        # a torn stream must not ratchet the fence or advertise a
+        # position this node does not hold
+        assert sb.fence == 0 and sb.stream_version() == good
+        assert sb.promote(1) == 1
+        assert sb.kv_get("k") == b"v"  # last good mirror intact
+
+    def test_native_blob_restores_into_python(self):
+        native = NativeCoordService()
+        self._populate(native)
+        py = PyCoordService(role="standby")
+        py.sync_from(0, 7, native.snapshot(include_members=True))
+        py.promote(1)
+        assert py.kv_get("ckpt/2") == b"/gen-2"
+        assert py.stats().todo == 1 and py.stats().done == 1
+        assert py.members()[1] == [("w0", "addr-0"), ("w1", "addr-1")]
+
+
+# ---------------------------------------------------------------------------
+# Native backend: real server pair over TCP
+# ---------------------------------------------------------------------------
+
+class TestNativePair:
+    def test_failover_preserves_state_and_membership(self, tmp_path):
+        pr, sb = spawn_ha_pair(str(tmp_path), member_ttl_ms=5000,
+                               repl_lease_ms=1000)
+        c = _ha_client(pr, sb)
+        try:
+            c.add_task(b"shard-0")
+            c.kv_set("ckpt/1", b"/gen-1")
+            assert c.join("w0", "a0") == 1
+            before = get_counters().get("coord_failovers")
+            _kill9(pr)
+            # the next call transparently fails over AND promotes
+            assert c.kv_get("ckpt/1") == b"/gen-1"
+            assert (c.host, c.port) == ("127.0.0.1", sb.port)
+            assert get_counters().get("coord_failovers") == before + 1
+            # queue + membership + epoch all survived: no rejoin storm
+            assert c.stats().todo == 1
+            assert c.heartbeat("w0")
+            assert c.epoch() == 1
+            assert _raw(sb.port, "ROLE").startswith("OK primary 1 ")
+        finally:
+            c.close()
+            pr.stop()
+            sb.stop()
+
+    def test_longpoll_reparks_on_promoted_standby(self, tmp_path):
+        pr, sb = spawn_ha_pair(str(tmp_path), member_ttl_ms=5000,
+                               repl_lease_ms=1000)
+        c = _ha_client(pr, sb)
+        fired = []
+        try:
+            c.join("w0", "a0")
+            t = threading.Thread(
+                target=lambda: fired.append(c.wait_epoch(1, 20.0)))
+            t.start()
+            time.sleep(0.3)  # the wait is parked on the primary
+            _kill9(pr)
+            # a second client's join on the promoted standby must wake
+            # the re-parked wait with the new epoch
+            c2 = _ha_client(sb, sb)
+            try:
+                c2.join("w1", "a1")
+            finally:
+                c2.close()
+            t.join(timeout=15)
+            assert fired == [2], fired
+        finally:
+            c.close()
+            pr.stop()
+            sb.stop()
+
+    def test_gc_paused_primary_comes_back_fenced(self, tmp_path):
+        pr, sb = spawn_ha_pair(str(tmp_path), member_ttl_ms=10000,
+                               repl_lease_ms=300)
+        c = _ha_client(pr, sb)
+        try:
+            c.kv_set("k", b"v")
+            c.join("w0", "a0")
+            # GC-style pause: the primary freezes, the client times out
+            # and promotes the standby
+            pr.process.send_signal(signal.SIGSTOP)
+            _wait_stopped(pr.process.pid)
+            assert c.kv_get("k") == b"v"  # served by the new primary
+            assert (c.host, c.port) == ("127.0.0.1", sb.port)
+            # the stale primary resumes with an expired replication
+            # lease: its FIRST verb re-verifies against the standby,
+            # discovers the newer fence, and self-fences — writes, reads
+            # and long-polls all refuse before any stale state escapes
+            pr.process.send_signal(signal.SIGCONT)
+            time.sleep(0.1)
+            assert _raw(pr.port, "KVSET k 646561").startswith("ERR fenced")
+            assert _raw(pr.port, "KVGET k").startswith("ERR fenced")
+            assert _raw(pr.port, "WAITEPOCH 0 100").startswith("ERR fenced")
+            assert _raw(pr.port, "ROLE").startswith("OK fenced")
+            # a client pinned to the fenced node observes the typed
+            # reject counter and a bounded typed failure
+            before = get_counters().get("coord_fencing_rejects")
+            c_stale = CoordClient("127.0.0.1", pr.port, timeout=1.0,
+                                  reconnect_window_s=0.8)
+            t0 = time.monotonic()
+            with pytest.raises(CoordUnavailable):
+                c_stale.kv_get("k")
+            assert time.monotonic() - t0 < 2 * 0.8 + 1.0
+            assert get_counters().get("coord_fencing_rejects") > before
+            c_stale.close()
+            # truth lives with the promoted standby
+            assert c.kv_get("k") == b"v"
+        finally:
+            c.close()
+            pr.stop()
+            sb.stop()
+
+    def test_all_endpoints_dead_returns_within_twice_budget(self, tmp_path):
+        pr, sb = spawn_ha_pair(str(tmp_path))
+        budget = 1.5
+        c = _ha_client(pr, sb, reconnect_window_s=budget)
+        try:
+            c.kv_set("k", b"v")
+            _kill9(pr)
+            _kill9(sb)
+            t0 = time.monotonic()
+            with pytest.raises(CoordUnavailable):
+                c.kv_get("k")
+            assert time.monotonic() - t0 < 2 * budget
+            # the constructor honors the same typed bound
+            t0 = time.monotonic()
+            with pytest.raises(CoordUnavailable):
+                CoordClient("127.0.0.1", pr.port, timeout=1.0,
+                            reconnect_window_s=budget,
+                            endpoints=[("127.0.0.1", sb.port)])
+            assert time.monotonic() - t0 < 2 * budget + 1.0
+        finally:
+            c.close()
+            pr.stop()
+            sb.stop()
+
+    def test_second_standby_catches_up_after_outage(self, tmp_path):
+        # per-replica stream positions: standby B missing a SYNC while A
+        # acked it must still receive its catch-up (from the keeper
+        # thread) once it returns — else promoting B later would silently
+        # lose acked state
+        sb1 = spawn_server(standby=True,
+                           state_file=str(tmp_path / "b1.state"))
+        sb2 = spawn_server(standby=True,
+                           state_file=str(tmp_path / "b2.state"))
+        pr = spawn_server(
+            state_file=str(tmp_path / "a.state"),
+            replicate_to=f"127.0.0.1:{sb1.port},127.0.0.1:{sb2.port}",
+            repl_lease_ms=600)
+        c = CoordClient("127.0.0.1", pr.port, timeout=2.0,
+                        reconnect_window_s=8.0)
+        try:
+            c.kv_set("k", b"v1")
+            _kill9(sb2)
+            c.kv_set("k", b"v2")  # sb1 acks; sb2 is down
+            sv = int(_raw(pr.port, "ROLE").split(" ")[3])
+            assert int(_raw(sb1.port, "ROLE").split(" ")[3]) == sv
+            sb2b = spawn_server(port=sb2.port, standby=True,
+                                state_file=str(tmp_path / "b2.state"))
+            deadline = time.monotonic() + 10
+            caught_up = -1
+            while time.monotonic() < deadline:
+                caught_up = int(_raw(sb2.port, "ROLE").split(" ")[3])
+                if caught_up >= sv:
+                    break
+                time.sleep(0.1)
+            assert caught_up >= sv, (caught_up, sv)
+            assert _raw(sb2.port, "PROMOTE 1").startswith("OK 1 ")
+            assert _raw(sb2.port, "KVGET k") == "OK " + b"v2".hex()
+            sb2b.stop()
+        finally:
+            c.close()
+            pr.stop()
+            sb1.stop()
+            sb2.stop()
+
+    def test_respawned_old_primary_rejoins_as_standby(self, tmp_path):
+        pr, sb = spawn_ha_pair(str(tmp_path), repl_lease_ms=500)
+        c = _ha_client(pr, sb)
+        try:
+            c.kv_set("k", b"v1")
+            old_port = pr.port
+            _kill9(pr)
+            assert c.kv_get("k") == b"v1"  # failover + promotion
+            # respawn the dead node as a STANDBY of the new primary on
+            # its old endpoint, re-attach via REPLICATE, and verify the
+            # next mutation streams to it
+            pr2 = spawn_server(port=old_port, standby=True,
+                               state_file=str(tmp_path / "coord-a.state"),
+                               repl_lease_ms=500)
+            assert _raw(c.port, f"REPLICATE 127.0.0.1:{old_port}") == "OK"
+            c.kv_set("k", b"v2")
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline:
+                role = _raw(old_port, "ROLE").split(" ")
+                if int(role[3]) >= 2:  # caught up past the first stream
+                    break
+                time.sleep(0.05)
+            assert role[1] == "standby" and role[2] == "1", role
+            # second failover: back onto the respawned node
+            _kill9(sb)
+            assert c.kv_get("k") == b"v2"
+            assert (c.host, c.port) == ("127.0.0.1", old_port)
+            assert _raw(old_port, "ROLE").startswith("OK primary 2 ")
+            pr2.stop()
+        finally:
+            c.close()
+            pr.stop()
+            sb.stop()
+
+
+# ---------------------------------------------------------------------------
+# Replication-stream crash injection (satellite: crash_on_persist "N:repl")
+# ---------------------------------------------------------------------------
+
+class TestStrictMode:
+    def test_suspended_primary_is_routed_around(self, tmp_path):
+        # strict pair, asymmetric outage: the standby dies, so the
+        # primary suspends (nothing un-mirrored may be acked) and its
+        # ROLE reports "suspended" — the client must not re-target it
+        # forever, and once a mirror is back the client promotes IT
+        pr, sb = spawn_ha_pair(str(tmp_path), repl_lease_ms=400)
+        # restart the pair strict (spawn_ha_pair has no strict knob: the
+        # scenario needs the primary strict, which is enough)
+        pr.stop()
+        pr = spawn_server(state_file=str(tmp_path / "coord-a.state"),
+                          replicate_to=f"127.0.0.1:{sb.port}",
+                          repl_lease_ms=400, repl_lease_strict=True)
+        c = _ha_client(pr, sb, reconnect_window_s=4.0)
+        try:
+            c.kv_set("k", b"v1")  # mirrored, acked
+            _kill9(sb)
+            # no mirror: strict refuses the ack; with no promotable
+            # candidate the call fails typed and budget-bounded
+            t0 = time.monotonic()
+            with pytest.raises(CoordUnavailable):
+                c.kv_set("k", b"v2")
+            assert time.monotonic() - t0 < 2 * 4.0 + 1.0
+            time.sleep(0.5)  # lease lapses -> ROLE reports suspended
+            assert _raw(pr.port, "ROLE").startswith("OK suspended")
+            # a mirror returns (respawned from its file, holding every
+            # acked op): the client promotes IT around the suspended
+            # primary and the job resumes
+            sb2 = spawn_server(port=sb.port, standby=True,
+                               state_file=str(tmp_path / "coord-b.state"))
+            c.kv_set("k", b"v3")
+            assert (c.host, c.port) == ("127.0.0.1", sb.port)
+            assert c.kv_get("k") == b"v3"
+            # the suspended ex-primary deposes at its next lease probe
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline:
+                if _raw(pr.port, "ROLE").startswith("OK fenced"):
+                    break
+                time.sleep(0.1)
+            assert _raw(pr.port, "ROLE").startswith("OK fenced")
+            sb2.stop()
+        finally:
+            c.close()
+            pr.stop()
+            sb.stop()
+
+    def test_health_sweep_epoch_bump_reaches_the_standby(self, tmp_path):
+        import urllib.request
+
+        # a /healthz-probe TTL sweep bumps the epoch with no client
+        # command in flight; the bump must stream to the mirror before a
+        # failover can serve a regressed epoch / resurrected member
+        pr, sb = spawn_ha_pair(str(tmp_path), member_ttl_ms=300,
+                               repl_lease_ms=60000, health_port=0)
+        c = _ha_client(pr, sb)
+        try:
+            c.join("w0", "a0")          # epoch 1, mirrored
+            time.sleep(0.5)             # TTL lapses, nobody heartbeats
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{pr.health_port}/healthz",
+                    timeout=5) as r:
+                assert b'"epoch":2' in r.read()  # the sweep bumped it
+            _kill9(pr)
+            assert c.epoch() == 2       # the promoted mirror agrees
+            _e, members = c.members()
+            assert members == []        # the expired member stayed dead
+        finally:
+            c.close()
+            pr.stop()
+            sb.stop()
+
+
+class TestReplCrashInjection:
+    def test_primary_dies_streaming_before_ack(self, tmp_path):
+        # the primary exits after the SYNC is on the wire but before the
+        # client is acked: the standby must come to own that exact state,
+        # and the client's at-least-once retry converges on it
+        pr, sb = spawn_ha_pair(str(tmp_path), repl_lease_ms=1000,
+                               crash_on_persist="2:repl")
+        c = _ha_client(pr, sb)
+        try:
+            c.kv_set("k1", b"v1")          # stream 1, acked
+            c.kv_set("k2", b"v2")          # stream 2: primary dies unacked
+            pr.process.wait(timeout=10)
+            assert pr.process.returncode == 137
+            # the retry rode the failover; both writes visible on the
+            # promoted standby
+            assert c.kv_get("k1") == b"v1"
+            assert c.kv_get("k2") == b"v2"
+            assert _raw(sb.port, "ROLE").startswith("OK primary")
+        finally:
+            c.close()
+            pr.stop()
+            sb.stop()
+
+    def test_standby_persists_before_acking(self, tmp_path):
+        # the STANDBY dies after persisting the streamed state but before
+        # acking: restarted from its own file, it must own exactly the
+        # position it persisted — the promotion-safety half of the claim
+        # ("never promotes with a version it hasn't durably persisted")
+        sb = spawn_server(standby=True,
+                          state_file=str(tmp_path / "sb.state"),
+                          crash_on_persist="1:repl")
+        pr = spawn_server(state_file=str(tmp_path / "pr.state"),
+                          replicate_to=f"127.0.0.1:{sb.port}",
+                          repl_lease_ms=1000)
+        c = CoordClient("127.0.0.1", pr.port, timeout=2.0,
+                        reconnect_window_s=5.0)
+        try:
+            c.kv_set("k", b"v")  # standby persists the stream, then dies
+            sb.process.wait(timeout=10)
+            assert sb.process.returncode == 137
+            # the primary never heard the ack — it served anyway
+            # (availability) and will catch the standby up on respawn
+            assert c.kv_get("k") == b"v"
+            sb2 = spawn_server(standby=True,
+                               state_file=str(tmp_path / "sb.state"))
+            role = _raw(sb2.port, "ROLE").split(" ")
+            assert role[1] == "standby" and int(role[3]) >= 1, role
+            # what it persisted is what it serves after promotion
+            assert _raw(sb2.port, "PROMOTE 1").startswith("OK 1 ")
+            assert _raw(sb2.port, "KVGET k") == "OK " + b"v".hex()
+            sb2.stop()
+        finally:
+            c.close()
+            pr.stop()
+            sb.stop()
+
+
+# ---------------------------------------------------------------------------
+# Fault engine: HA KillCoordinator drill (failover observed, zero reforms)
+# ---------------------------------------------------------------------------
+
+def test_ha_kill_coordinator_drill(tmp_path):
+    from edl_tpu.runtime.faults import (
+        FaultContext, FaultPlan, FaultPlanEngine, KillCoordinator,
+    )
+
+    pr, sb = spawn_ha_pair(str(tmp_path), repl_lease_ms=1000)
+    c = _ha_client(pr, sb)
+    try:
+        c.kv_set("k", b"v")
+        ctx = FaultContext(coord=c, ha=True,
+                           kill_primary=lambda: _kill9(pr))
+        engine = FaultPlanEngine(
+            FaultPlan([KillCoordinator(at_step=1)]), ctx)
+        before_reforms = get_counters().total("world_reforms")
+        engine(step=1)
+        # drive the client so the failover actually happens, then let the
+        # engine observe it
+        deadline = time.monotonic() + 15
+        while not engine.quiescent() and time.monotonic() < deadline:
+            assert c.kv_get("k") == b"v"
+            engine.tick()
+            time.sleep(0.05)
+        assert engine.recovered == ["kill_coordinator"]
+        assert get_counters().total("world_reforms") == before_reforms
+        assert get_counters().total("coord_ha_reform_leaks") == 0
+    finally:
+        c.close()
+        pr.stop()
+        sb.stop()
+
+
+# ---------------------------------------------------------------------------
+# Supervisor integration: endpoint-set publication
+# ---------------------------------------------------------------------------
+
+def test_supervisor_publishes_endpoint_set(tmp_path):
+    # the multihost supervisor publishes its client's endpoint SET so
+    # tooling/late joiners discover the standbys; pinned here without
+    # spawning worlds by exercising the same code path the supervisor
+    # runs (multihost.run_elastic_worker writes _COORD_ENDPOINTS_KEY)
+    from edl_tpu.runtime import multihost
+
+    pr, sb = spawn_ha_pair(str(tmp_path))
+    c = _ha_client(pr, sb)
+    try:
+        eps = getattr(c, "endpoints")
+        c.kv_set(multihost._COORD_ENDPOINTS_KEY, json.dumps(
+            [f"{h}:{p}" for h, p in eps]).encode())
+        raw = c.kv_get(multihost._COORD_ENDPOINTS_KEY)
+        assert json.loads(raw.decode()) == [
+            f"127.0.0.1:{pr.port}", f"127.0.0.1:{sb.port}"]
+        # a client that knows only ONE address discovers the full set at
+        # construction — the reason the supervisor publishes it
+        c_single = CoordClient("127.0.0.1", pr.port, timeout=2.0,
+                               reconnect_window_s=12.0,
+                               promote_grace_s=0.2)
+        assert ("127.0.0.1", sb.port) in c_single.endpoints
+        # and it survives the failover it describes: the death of the
+        # only address it was configured with
+        _kill9(pr)
+        assert c_single.kv_get(
+            multihost._COORD_ENDPOINTS_KEY) is not None
+        assert (c_single.host, c_single.port) == ("127.0.0.1", sb.port)
+        c_single.close()
+        raw = c.kv_get(multihost._COORD_ENDPOINTS_KEY)
+        assert f"127.0.0.1:{sb.port}" in json.loads(raw.decode())
+    finally:
+        c.close()
+        pr.stop()
+        sb.stop()

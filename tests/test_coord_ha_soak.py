@@ -1,0 +1,148 @@
+"""Endurance soak: 10+ minutes of worker churn + periodic primary kills
+against the HA coordinator pair (VERDICT r5 #9, ROADMAP #5).
+
+The HA claim must be SUSTAINED, not a one-shot drill: across the whole
+window the multi-endpoint client never sees :class:`CoordUnavailable`,
+the killed node is respawned as a standby of whoever got promoted (the
+operator/kubelet loop), and at the end
+
+* memory (RSS) of the surviving coordinator processes is bounded,
+* the harness process's open-FD count is bounded (no socket leak per
+  failover or per churn cycle),
+* the coordinator generation count (the fencing token — one bump per
+  promotion) matches the kills, i.e. no promotion flapping,
+* queue/KV/epoch state is exactly what the acked operations imply.
+
+Duration is ``EDL_HA_SOAK_S`` (default 600 s — slow-marked; CI smoke and
+local runs can shrink it).
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import socket
+import threading
+import time
+
+import pytest
+
+from edl_tpu.coord import CoordClient, spawn_ha_pair, spawn_server
+
+_DURATION_S = float(os.environ.get("EDL_HA_SOAK_S", "600"))
+
+pytestmark = [pytest.mark.slow, pytest.mark.multihost,
+              pytest.mark.timeout_s(_DURATION_S + 240)]
+
+
+def _rss_kb(pid: int) -> int:
+    with open(f"/proc/{pid}/status") as f:
+        for line in f:
+            if line.startswith("VmRSS:"):
+                return int(line.split()[1])
+    return 0
+
+
+def _open_fds() -> int:
+    return len(os.listdir("/proc/self/fd"))
+
+
+def _raw(port: int, line: str, timeout: float = 3.0) -> str:
+    with socket.create_connection(("127.0.0.1", port),
+                                  timeout=timeout) as s:
+        s.settimeout(timeout)
+        s.sendall((line + "\n").encode())
+        return s.makefile("rb").readline().decode().strip()
+
+
+def test_ha_endurance_soak(tmp_path):
+    state_a = str(tmp_path / "coord-a.state")
+    state_b = str(tmp_path / "coord-b.state")
+    pr, sb = spawn_ha_pair(str(tmp_path), member_ttl_ms=8000,
+                           repl_lease_ms=1500)
+    nodes = {pr.port: pr, sb.port: sb}
+    state_of = {pr.port: state_a, sb.port: state_b}
+    c = CoordClient("127.0.0.1", pr.port, timeout=3.0,
+                    reconnect_window_s=25.0, promote_grace_s=0.3,
+                    endpoints=[("127.0.0.1", sb.port)])
+    kill_every_s = max(min(_DURATION_S / 8.0, 75.0), 15.0)
+    stop = threading.Event()
+    waiter_errors: list = []
+
+    def longpoller():
+        # a permanently parked wait riding every failover: the re-park
+        # path leaks neither FDs nor correctness
+        while not stop.is_set():
+            try:
+                c.kv_wait(f"never/{time.monotonic()}", 0.5)
+            except Exception as exc:  # pragma: no cover - failure evidence
+                waiter_errors.append(exc)
+                return
+
+    deadline = time.monotonic() + _DURATION_S
+    kills = 0
+    joins = 0
+    cycles = 0
+    rss_samples: dict[int, list[int]] = {p: [] for p in nodes}
+    fd_start = _open_fds()
+    fd_samples = [fd_start]
+    next_kill = time.monotonic() + kill_every_s
+    t = threading.Thread(target=longpoller, daemon=True)
+    t.start()
+    try:
+        while time.monotonic() < deadline:
+            cycles += 1
+            w = f"w{cycles % 8}"
+            c.join(w, f"addr-{cycles % 8}")
+            joins += 1
+            c.heartbeat(w)
+            # bounded KV working set: rotate 16 keys, delete the oldest
+            c.kv_set(f"ckpt/{cycles % 16}", f"/gen-{cycles}".encode())
+            c.kv_del(f"ckpt/{(cycles + 1) % 16}")
+            c.kv_set("sentinel", str(cycles).encode())
+            assert c.kv_get("sentinel") == str(cycles).encode()
+            if cycles % 7 == 0:
+                c.leave(w)
+                joins += 1  # a leave bumps the epoch like a join does
+            time.sleep(0.05)
+            if time.monotonic() >= next_kill and kills < 64:
+                next_kill = time.monotonic() + kill_every_s
+                victim_port = c.port  # the current primary
+                survivor_port = next(p for p in nodes if p != victim_port)
+                nodes[victim_port].process.send_signal(signal.SIGKILL)
+                nodes[victim_port].process.wait(timeout=10)
+                kills += 1
+                # the very next op must ride the failover
+                assert c.kv_get("sentinel") == str(cycles).encode()
+                assert c.port == survivor_port
+                # operator loop: respawn the corpse as a standby of the
+                # promoted node, on its old endpoint, from its old file
+                nodes[victim_port] = spawn_server(
+                    port=victim_port, standby=True, member_ttl_ms=8000,
+                    state_file=state_of[victim_port], repl_lease_ms=1500)
+                assert _raw(survivor_port,
+                            f"REPLICATE 127.0.0.1:{victim_port}") == "OK"
+                for port, handle in nodes.items():
+                    rss_samples[port].append(_rss_kb(handle.process.pid))
+                fd_samples.append(_open_fds())
+    finally:
+        stop.set()
+        t.join(timeout=10)
+        fence = int(_raw(c.port, "ROLE").split(" ")[2])
+        c.close()
+        for handle in nodes.values():
+            handle.stop()
+
+    assert not waiter_errors, waiter_errors
+    assert kills >= 2, f"soak too short to kill twice ({_DURATION_S}s)"
+    # generation count bounded: exactly one promotion per kill — no
+    # promotion flapping, no spurious depositions
+    assert kills <= fence <= kills + 1, (fence, kills)
+    # open FDs bounded: failovers and churn must not leak sockets
+    assert max(fd_samples) <= fd_start + 24, (fd_start, fd_samples)
+    # RSS bounded: no per-cycle/per-failover growth without bound.  Self-
+    # relative: the last sample stays within 2x the first (plus 32 MB of
+    # slack for allocator noise at small absolute sizes).
+    for port, samples in rss_samples.items():
+        if len(samples) >= 2:
+            assert samples[-1] <= 2 * samples[0] + 32 * 1024, (port, samples)
