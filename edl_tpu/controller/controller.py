@@ -47,6 +47,7 @@ class Controller:
         resize_cooldown_s: float = 0.0,
         min_resize_delta: int = 1,
         mesh_shape_for=None,
+        goodput_curves=None,
     ) -> None:
         self.cluster = cluster
         self.autoscaler = Autoscaler(
@@ -57,6 +58,7 @@ class Controller:
             resize_cooldown_s=resize_cooldown_s,
             min_resize_delta=min_resize_delta,
             mesh_shape_for=mesh_shape_for,
+            goodput_curves=goodput_curves,
         )
         self._updater_convert_seconds = updater_convert_seconds
         self._updater_confirm_seconds = updater_confirm_seconds

@@ -5,6 +5,10 @@ the flight recorder."""
 from edl_tpu.observability.collector import (
     Collector, Counters, JobInfo, Sample, get_counters,
 )
+from edl_tpu.observability.goodput import (
+    CurveStore, GoodputLedger, ScalingCurve, get_process_ledger,
+    set_process_ledger,
+)
 from edl_tpu.observability.logging import get_logger
 from edl_tpu.observability.metrics import (
     Counter, Gauge, Histogram, MetricsRegistry, dump_flight_record,
@@ -15,8 +19,10 @@ from edl_tpu.observability.tracing import (
     set_trace_id,
 )
 
-__all__ = ["Collector", "Counter", "Counters", "Gauge", "Histogram",
-           "JobInfo", "MetricsRegistry", "Sample", "Tracer",
-           "current_trace_id", "dump_flight_record", "get_counters",
-           "get_logger", "get_registry", "get_tracer", "new_trace_id",
-           "profile_step", "set_trace_id"]
+__all__ = ["Collector", "Counter", "Counters", "CurveStore", "Gauge",
+           "GoodputLedger", "Histogram", "JobInfo", "MetricsRegistry",
+           "Sample", "ScalingCurve", "Tracer", "current_trace_id",
+           "dump_flight_record", "get_counters", "get_logger",
+           "get_process_ledger", "get_registry", "get_tracer",
+           "new_trace_id", "profile_step", "set_process_ledger",
+           "set_trace_id"]

@@ -1,0 +1,614 @@
+"""Goodput ledger: attribute every chip-second, measure the scaling curve.
+
+The telemetry plane (observability/metrics.py) says *what happened*;
+this module says *what it cost*.  A :class:`GoodputLedger` is a per-job
+chip-second ledger driven by the events the stack already emits: a phase
+state machine fed by resize events (ElasticTrainer), checkpoint pauses
+(ElasticCheckpointer), stall detection (StallWatchdog), and the
+multihost supervisor's world lifecycle.  Every instant of wall-clock is
+attributed to exactly one phase, weighted by the world size holding
+chips at that instant, so
+
+    Σ_phase attributed_chip_seconds  ==  ∫ world_size dt
+
+— the **conservation invariant** (:meth:`GoodputLedger.conserves`),
+checked against an independently maintained integral so a wiring bug on
+any attribution path (a missed accrual, a double count) diverges the two
+sides instead of silently mis-pricing a job.
+
+Phase taxonomy (the chip-second buckets ROADMAP #3's planner will price):
+
+===================  ========================================================
+``productive``       stepping: chips converting time into training progress
+``compile``          mesh-bundle/step compilation on the resize path
+``reshard``          replan + state movement of a resize (device_put hops)
+``checkpoint_pause`` step-loop pauses paid to checkpointing
+``stall``            detected silent hangs (watchdog breach → next beat)
+``reform_dark``      world death → training resumed (the elastic dark time)
+``queued``           job admitted but no world formed yet
+``idle``             held chips with nothing to run (drained, tearing down)
+===================  ========================================================
+
+Overlaps are resolved by a LIFO phase *stack*: the innermost (most
+recently entered) phase accrues — a checkpoint pause that a resize lands
+inside attributes the resize window to ``reshard`` and only the
+remainder to ``checkpoint_pause``.  Durations measured elsewhere (a
+resize event's ``compile_ms``, an async save's recorded pause) are moved
+retroactively with :meth:`GoodputLedger.note_span`, which *transfers*
+chip-seconds between phases and therefore can never break conservation.
+
+The **scaling-curve store** is the second half: every steady-state
+window contributes a ``(world_size, mesh_shape, tok/s, MFU)`` sample,
+aggregated per job into a throughput-vs-world-size curve
+(:class:`ScalingCurve`) and persisted in coordinator KV
+(:class:`CurveStore`, key ``goodput-curve/<job>``) — so it rides the HA
+replication stream, survives a primary failover, and outlives any one
+trainer process.  ``marginal_tokens_per_second_per_chip`` is the number
+the goodput-driven scheduler (ROADMAP #3) will allocate by; this PR the
+autoscaler only *logs* it (advisory — see ``Autoscaler.goodput_curves``).
+
+Every process exposes its ledger as ``edl_goodput_*`` series
+(:func:`register_metrics`), and flight records embed the full snapshot
+(metrics.dump_flight_record), so the post-mortem for a hang includes
+what the hang cost.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Callable, Optional
+
+# -- phase taxonomy ----------------------------------------------------------
+
+PRODUCTIVE = "productive"
+COMPILE = "compile"
+RESHARD = "reshard"
+CHECKPOINT_PAUSE = "checkpoint_pause"
+STALL = "stall"
+REFORM_DARK = "reform_dark"
+QUEUED = "queued"
+IDLE = "idle"
+
+#: every phase the ledger knows; attribution to anything else raises
+ALL_PHASES = (PRODUCTIVE, COMPILE, RESHARD, CHECKPOINT_PAUSE, STALL,
+              REFORM_DARK, QUEUED, IDLE)
+
+#: phases that are *lost* time (everything but productive) — what the
+#: ``edl_goodput_lost_seconds{phase=...}`` gauges report
+LOST_PHASES = tuple(p for p in ALL_PHASES if p != PRODUCTIVE)
+
+
+class GoodputLedger:
+    """Per-job chip-second ledger with a LIFO phase stack.
+
+    Thread-safe: the runtime touches it from the step loop, the
+    checkpoint thread, and the watchdog poller concurrently.  All public
+    methods are cheap (a clock read + dict arithmetic under one lock).
+
+    ``world_size`` weights the accrual: one second at world size 4 is 4
+    chip-seconds.  A supervisor that only speaks for its own member slot
+    runs its ledger at world size 1; an in-process trainer tracks its
+    mesh size (ElasticTrainer updates the process ledger on commit).
+    """
+
+    def __init__(self, job: str = "", world_size: int = 1,
+                 base_phase: str = QUEUED,
+                 clock: Callable[[], float] = time.monotonic) -> None:
+        if base_phase not in ALL_PHASES:
+            raise ValueError(f"unknown phase {base_phase!r}")
+        self.job = job
+        self._clock = clock
+        self._lock = threading.Lock()
+        now = clock()
+        self._t0 = now
+        self._last = now          # attribution accrual timestamp
+        self._integral_t = now    # independent conservation-integral stamp
+        self._world = max(int(world_size), 0)
+        self._attributed: dict[str, float] = {p: 0.0 for p in ALL_PHASES}
+        self._stack: list[str] = [base_phase]
+        self._integral = 0.0      # ∫ world_size dt, chip-seconds
+        self._tokens = 0.0        # total training tokens (optional feed)
+        self._closed = False      # closed: accrual frozen at close time
+
+    # -- accrual core --------------------------------------------------------
+
+    def _accrue_locked(self, now: float) -> None:
+        """Attribute the elapsed window to the innermost active phase AND
+        advance the independent integral.  Deliberately two code paths
+        over the same clock reads: a bug in either (a skipped accrual, a
+        stack operation that forgot to settle) makes them diverge, which
+        is exactly what :meth:`conserves` exists to catch."""
+        if self._closed:
+            return
+        dt = now - self._last
+        if dt > 0:
+            self._attributed[self._stack[-1]] += dt * self._world
+            self._last = now
+        di = now - self._integral_t
+        if di > 0:
+            self._integral += di * self._world
+            self._integral_t = now
+
+    # -- world size ----------------------------------------------------------
+
+    @property
+    def world_size(self) -> int:
+        with self._lock:
+            return self._world
+
+    def set_world_size(self, n: int) -> None:
+        """World size changed (resize committed, world formed/shrank):
+        settle the old rate first, then accrue at the new one."""
+        with self._lock:
+            self._accrue_locked(self._clock())
+            self._world = max(int(n), 0)
+
+    # -- phase stack ---------------------------------------------------------
+
+    def current_phase(self) -> str:
+        with self._lock:
+            return self._stack[-1]
+
+    def enter(self, phase: str) -> bool:
+        """Push ``phase``; it accrues until exited (or something nests
+        inside it).  Idempotent: entering a phase already on the stack is
+        a no-op returning False, so two detectors firing on the same
+        event (e.g. two watchdogs seeing one stall) cannot double-push."""
+        if phase not in ALL_PHASES:
+            raise ValueError(f"unknown phase {phase!r}")
+        with self._lock:
+            if phase in self._stack:
+                return False
+            self._accrue_locked(self._clock())
+            self._stack.append(phase)
+            return True
+
+    def exit(self, phase: str) -> bool:
+        """Pop the topmost occurrence of ``phase`` — wherever it sits: a
+        world that dies mid-checkpoint exits phases out of LIFO order,
+        and the ledger must keep counting rather than assert about it.
+        No-op (False) when the phase is not active or is the base."""
+        with self._lock:
+            now = self._clock()
+            for i in range(len(self._stack) - 1, 0, -1):
+                if self._stack[i] == phase:
+                    self._accrue_locked(now)
+                    del self._stack[i]
+                    return True
+            return False
+
+    def phase(self, p: str) -> "_PhaseCtx":
+        """``with ledger.phase(RESHARD): ...`` — enter/exit bracketed."""
+        return _PhaseCtx(self, p)
+
+    def reset(self, phase: str) -> None:
+        """Collapse the whole stack to ``phase`` — the world-death path:
+        whatever the process was mid-way through (a checkpoint, a
+        resize), the chips are now dark until the reform lands, and every
+        half-open phase is settled at this instant."""
+        if phase not in ALL_PHASES:
+            raise ValueError(f"unknown phase {phase!r}")
+        with self._lock:
+            self._accrue_locked(self._clock())
+            self._stack = [phase]
+
+    # -- retroactive attribution --------------------------------------------
+
+    def note_span(self, phase: str, seconds: float,
+                  world_size: Optional[int] = None) -> float:
+        """Move ``seconds × world_size`` chip-seconds from the currently
+        accruing phase into ``phase`` — for durations measured where they
+        happened (a resize event's compile_ms, an async save's recorded
+        pause) rather than bracketed live.  A *transfer*, so conservation
+        is preserved by construction; clamped so the source phase never
+        goes negative (a span reported larger than what the source has
+        accrued — clock skew, an overlapping bracket — moves what exists
+        and no more).  Returns the chip-seconds actually moved."""
+        if phase not in ALL_PHASES:
+            raise ValueError(f"unknown phase {phase!r}")
+        if seconds <= 0:
+            return 0.0
+        with self._lock:
+            self._accrue_locked(self._clock())
+            src = self._stack[-1]
+            if src == phase:
+                return 0.0
+            ws = self._world if world_size is None else max(int(world_size), 0)
+            move = min(seconds * ws, self._attributed[src])
+            self._attributed[src] -= move
+            self._attributed[phase] += move
+            return move
+
+    def add_tokens(self, n: float) -> None:
+        """Optional progress feed: total trained tokens, for artifacts
+        that want tokens-per-chip-second next to the fraction."""
+        with self._lock:
+            self._tokens += n
+
+    def close(self) -> None:
+        """Freeze the ledger: one final accrual at this instant, then
+        every read returns the settled numbers forever.
+
+        The lifecycle owner (the multihost supervisor at worker exit)
+        calls this so the ``edl_goodput_*`` callback gauges registered
+        over this ledger stop drifting: without the freeze, every SCRAPE
+        of a long-lived process would keep accruing wall time into a
+        finished job's last phase, decaying its goodput fraction toward
+        zero after the job ended.  Idempotent; mutations after close are
+        no-ops on the attribution (the final snapshot is the record)."""
+        with self._lock:
+            self._accrue_locked(self._clock())
+            self._closed = True
+
+    # -- readout -------------------------------------------------------------
+
+    def chip_seconds(self, phase: str) -> float:
+        with self._lock:
+            self._accrue_locked(self._clock())
+            return self._attributed[phase]
+
+    def attributed_total(self) -> float:
+        with self._lock:
+            self._accrue_locked(self._clock())
+            return sum(self._attributed.values())
+
+    def goodput_fraction(self) -> float:
+        """Productive chip-seconds over all attributed chip-seconds
+        (0.0 before anything accrued)."""
+        with self._lock:
+            self._accrue_locked(self._clock())
+            total = sum(self._attributed.values())
+            return self._attributed[PRODUCTIVE] / total if total > 0 else 0.0
+
+    def conservation_error(self) -> float:
+        """|Σ attributed − ∫ world dt| as a fraction of the integral."""
+        with self._lock:
+            self._accrue_locked(self._clock())
+            total = sum(self._attributed.values())
+            if self._integral <= 0:
+                return 0.0 if total == 0 else float("inf")
+            return abs(total - self._integral) / self._integral
+
+    def conserves(self, tolerance: float = 0.01) -> bool:
+        """The invariant: attributed chip-seconds sum to the wall-clock ×
+        world-size integral within ``tolerance`` (default 1 %)."""
+        return self.conservation_error() <= tolerance
+
+    def snapshot(self) -> dict:
+        """Everything an artifact/flight-record wants, in one dict."""
+        with self._lock:
+            now = self._clock()
+            self._accrue_locked(now)
+            # a closed ledger's wall clock ends at its close instant
+            # (_last froze there), not at whenever someone reads it
+            end = self._last if self._closed else now
+            total = sum(self._attributed.values())
+            return {
+                "job": self.job,
+                "world_size": self._world,
+                "wall_seconds": round(end - self._t0, 3),
+                "chip_seconds": {p: round(v, 3)
+                                 for p, v in self._attributed.items()},
+                "attributed_chip_seconds": round(total, 3),
+                "integral_chip_seconds": round(self._integral, 3),
+                "goodput_fraction": round(
+                    self._attributed[PRODUCTIVE] / total, 4) if total else 0.0,
+                "lost_seconds": {p: round(self._attributed[p], 3)
+                                 for p in LOST_PHASES
+                                 if self._attributed[p] > 0},
+                "conservation_error_pct": round(
+                    100.0 * (abs(total - self._integral) / self._integral
+                             if self._integral > 0 else 0.0), 4),
+                "tokens": round(self._tokens, 1),
+                "current_phase": self._stack[-1],
+            }
+
+
+class _PhaseCtx:
+    def __init__(self, ledger: GoodputLedger, phase: str) -> None:
+        self._ledger, self._phase = ledger, phase
+        self._entered = False
+
+    def __enter__(self) -> GoodputLedger:
+        self._entered = self._ledger.enter(self._phase)
+        return self._ledger
+
+    def __exit__(self, *exc) -> None:
+        if self._entered:
+            self._ledger.exit(self._phase)
+
+
+# -- process ledger ----------------------------------------------------------
+#
+# One ledger per process, installed by whoever owns the job's lifecycle
+# (the multihost supervisor, a bench harness, a local elastic driver);
+# the runtime's attribution call sites (trainer resize, checkpoint
+# pause, watchdog stall) feed it best-effort through the helpers below,
+# so wiring is zero-config: no ledger installed → every helper is a
+# no-op and nothing anywhere slows down or fails.
+
+_process_ledger: Optional[GoodputLedger] = None
+_process_lock = threading.Lock()
+
+
+def set_process_ledger(ledger: Optional[GoodputLedger]
+                       ) -> Optional[GoodputLedger]:
+    """Install (or clear, with None) the process-wide ledger; returns it."""
+    global _process_ledger
+    with _process_lock:
+        _process_ledger = ledger
+    return ledger
+
+
+def get_process_ledger() -> Optional[GoodputLedger]:
+    return _process_ledger
+
+
+def note_span(phase: str, seconds: float,
+              world_size: Optional[int] = None) -> None:
+    """Best-effort retroactive attribution on the process ledger."""
+    led = _process_ledger
+    if led is not None:
+        try:
+            led.note_span(phase, seconds, world_size=world_size)
+        except Exception:
+            pass  # accounting must never fail the runtime
+
+
+def enter_phase(phase: str) -> None:
+    led = _process_ledger
+    if led is not None:
+        try:
+            led.enter(phase)
+        except Exception:
+            pass
+
+
+def exit_phase(phase: str) -> None:
+    led = _process_ledger
+    if led is not None:
+        try:
+            led.exit(phase)
+        except Exception:
+            pass
+
+
+def set_world_size(n: int) -> None:
+    led = _process_ledger
+    if led is not None:
+        try:
+            led.set_world_size(n)
+        except Exception:
+            pass
+
+
+# -- /metrics exposure -------------------------------------------------------
+
+def register_metrics(ledger: GoodputLedger, registry=None) -> None:
+    """Expose the ledger as ``edl_goodput_*`` series on the shared
+    registry (callback gauges/counters, evaluated at scrape time):
+
+    * ``edl_goodput_fraction{job=}`` — productive over attributed;
+    * ``edl_goodput_chip_seconds{job=,phase=}`` — per-phase attribution
+      (a GAUGE, deliberately: ``note_span`` transfers chip-seconds
+      *between* phases, so a single phase's total may step down even
+      though the overall sum only grows — counter semantics would read
+      that as a process restart);
+    * ``edl_goodput_lost_seconds{job=,phase=}`` — the non-productive
+      buckets alone, the series a dashboard alerts on;
+    * ``edl_goodput_world_size{job=}`` — the accrual weight right now.
+    """
+    if registry is None:
+        from edl_tpu.observability.metrics import get_registry
+
+        registry = get_registry()
+    job = ledger.job
+    registry.gauge_fn("goodput_fraction", ledger.goodput_fraction,
+                      help="productive chip-seconds over attributed",
+                      job=job)
+    registry.gauge_fn("goodput_world_size",
+                      lambda: ledger.world_size,
+                      help="current chip-second accrual weight", job=job)
+    for phase in ALL_PHASES:
+        registry.gauge_fn(
+            "goodput_chip_seconds",
+            (lambda p=phase: ledger.chip_seconds(p)),
+            help="attributed chip-seconds by phase", job=job, phase=phase)
+    for phase in LOST_PHASES:
+        registry.gauge_fn(
+            "goodput_lost_seconds",
+            (lambda p=phase: ledger.chip_seconds(p)),
+            help="non-productive chip-seconds by phase", job=job,
+            phase=phase)
+
+
+# -- scaling curve -----------------------------------------------------------
+
+class ScalingCurve:
+    """Per-job throughput-vs-world-size curve, aggregated from
+    steady-state window samples.
+
+    Each ``(world_size, mesh_shape)`` cell keeps a running mean of the
+    observed tokens/second (and MFU when reported) plus the sample
+    count; :meth:`tokens_per_second` answers per world size with the
+    best shape's mean — the planner cares what the job *can* do at N
+    chips, and the runtime's shape policy already picks the layout.
+    """
+
+    def __init__(self, job: str = "") -> None:
+        self.job = job
+        #: (world_size, shape) → {"tok_s": mean, "mfu_pct": mean|None,
+        #:                         "n": count}
+        self._cells: dict[tuple[int, str], dict] = {}
+        self._lock = threading.Lock()
+
+    def observe(self, world_size: int, tokens_per_second: float,
+                shape: str = "", mfu_pct: Optional[float] = None) -> None:
+        """Fold one steady-state window sample into the curve."""
+        key = (int(world_size), shape)
+        with self._lock:
+            cell = self._cells.get(key)
+            if cell is None:
+                cell = {"tok_s": 0.0, "mfu_pct": None, "n": 0, "mfu_n": 0}
+                self._cells[key] = cell
+            n = cell["n"]
+            cell["tok_s"] = (cell["tok_s"] * n + tokens_per_second) / (n + 1)
+            if mfu_pct is not None:
+                # weighted by the number of samples that actually
+                # REPORTED mfu — tok/s samples without one must not
+                # dilute the mean
+                m = cell.get("mfu_n", 0)
+                prev = cell["mfu_pct"]
+                cell["mfu_pct"] = (mfu_pct if prev is None
+                                   else (prev * m + mfu_pct) / (m + 1))
+                cell["mfu_n"] = m + 1
+            cell["n"] = n + 1
+
+    def world_sizes(self) -> list[int]:
+        with self._lock:
+            return sorted({ws for ws, _ in self._cells})
+
+    def sample_count(self) -> int:
+        with self._lock:
+            return sum(c["n"] for c in self._cells.values())
+
+    def tokens_per_second(self, world_size: int) -> Optional[float]:
+        """Best mean tok/s observed at ``world_size`` across shapes."""
+        with self._lock:
+            vals = [c["tok_s"] for (ws, _), c in self._cells.items()
+                    if ws == world_size]
+            return max(vals) if vals else None
+
+    def nearest_world_size(self, world_size: int) -> Optional[int]:
+        """The measured size a question about ``world_size`` should be
+        answered from: the largest measured size ≤ it, else the smallest
+        measured size (an extrapolating reader must know it is reading
+        the curve's edge — the returned size says which point ruled)."""
+        sizes = self.world_sizes()
+        if not sizes:
+            return None
+        smaller = [ws for ws in sizes if ws <= world_size]
+        return max(smaller) if smaller else min(sizes)
+
+    def marginal_tokens_per_second_per_chip(self, world_size: int
+                                            ) -> Optional[float]:
+        """The scheduler's number: d(throughput)/d(chips) at
+        ``world_size``, as the slope from the nearest smaller measured
+        size (average tok/s per chip when ``world_size`` is the smallest
+        measured point — the first chips have no smaller anchor)."""
+        here = self.tokens_per_second(world_size)
+        if here is None:
+            return None
+        smaller = [ws for ws in self.world_sizes() if ws < world_size]
+        if not smaller:
+            return here / world_size if world_size else None
+        prev = max(smaller)
+        prev_tok = self.tokens_per_second(prev)
+        if prev_tok is None:  # pragma: no cover - sizes imply samples
+            return None
+        return (here - prev_tok) / (world_size - prev)
+
+    # -- (de)serialization — the KV wire format ------------------------------
+
+    def to_json(self) -> str:
+        with self._lock:
+            cells = [{"world_size": ws, "shape": sh, **c}
+                     for (ws, sh), c in sorted(self._cells.items())]
+        return json.dumps({"job": self.job, "version": 1, "cells": cells})
+
+    @classmethod
+    def from_json(cls, raw: str) -> "ScalingCurve":
+        doc = json.loads(raw)
+        curve = cls(job=doc.get("job", ""))
+        for cell in doc.get("cells", []):
+            key = (int(cell["world_size"]), cell.get("shape", ""))
+            curve._cells[key] = {
+                "tok_s": float(cell["tok_s"]),
+                "mfu_pct": cell.get("mfu_pct"),
+                "n": int(cell.get("n", 1)),
+                # older blobs without the count: one sample iff a mean
+                # exists (keeps the weighting sane across re-loads)
+                "mfu_n": int(cell.get(
+                    "mfu_n", 1 if cell.get("mfu_pct") is not None else 0)),
+            }
+        return curve
+
+    def summary(self) -> dict:
+        """world_size → mean tok/s (artifact/log form)."""
+        return {ws: round(self.tokens_per_second(ws), 1)
+                for ws in self.world_sizes()}
+
+
+#: KV key template the curve persists under — a plain coordinator KV key,
+#: so it streams to the HA standby with every other mutation and is
+#: GC-exempt (not per-generation; prune_generations never touches it)
+CURVE_KEY = "goodput-curve/{job}"
+
+
+class CurveStore:
+    """Persist one job's :class:`ScalingCurve` in coordinator KV.
+
+    The local curve is authoritative for this writer (one driver per job
+    records windows); every :meth:`record` folds the sample in and
+    republishes the whole JSON under ``goodput-curve/<job>`` — small
+    (one cell per (size, shape)), idempotent, and riding the coordinator's
+    persistence + HA replication, which is what makes the curve survive
+    both trainer restarts and a primary failover.  Readers (autoscaler,
+    tooling) use :meth:`load` against any coordinator endpoint.
+    """
+
+    def __init__(self, coord, job: str, registry=None) -> None:
+        self._coord = coord
+        self.job = job
+        self.curve = ScalingCurve(job=job)
+        self._registry = registry
+
+    @property
+    def key(self) -> str:
+        return CURVE_KEY.format(job=self.job)
+
+    def record(self, world_size: int, tokens_per_second: float,
+               shape: str = "", mfu_pct: Optional[float] = None) -> None:
+        """Fold a steady-state sample in, persist, refresh the gauges."""
+        self.curve.observe(world_size, tokens_per_second, shape=shape,
+                           mfu_pct=mfu_pct)
+        self._coord.kv_set(self.key, self.curve.to_json().encode())
+        self._sync_metrics()
+
+    def load(self) -> Optional[ScalingCurve]:
+        """The persisted curve, from whichever coordinator answers."""
+        raw = self._coord.kv_get(self.key)
+        if not raw:
+            return None
+        try:
+            return ScalingCurve.from_json(raw.decode())
+        except (ValueError, KeyError):
+            return None
+
+    def _sync_metrics(self) -> None:
+        """Curve cells as real gauges (set on record, labels dynamic):
+        ``edl_goodput_curve_tokens_per_second{job=,world_size=}`` and the
+        marginal-throughput-per-chip series the scheduler will read."""
+        registry = self._registry
+        if registry is None:
+            from edl_tpu.observability.metrics import get_registry
+
+            registry = get_registry()
+        tok = registry.gauge("goodput_curve_tokens_per_second",
+                             help="per-job throughput curve sample mean")
+        marg = registry.gauge(
+            "goodput_marginal_tokens_per_second_per_chip",
+            help="marginal throughput per added chip at world_size")
+        for ws in self.curve.world_sizes():
+            tok.set(self.curve.tokens_per_second(ws),
+                    job=self.job, world_size=ws)
+            m = self.curve.marginal_tokens_per_second_per_chip(ws)
+            if m is not None:
+                marg.set(m, job=self.job, world_size=ws)
+
+
+def load_curve(coord, job: str) -> Optional[ScalingCurve]:
+    """Read-only curve fetch (the autoscaler/tooling side of CurveStore)."""
+    return CurveStore(coord, job).load()

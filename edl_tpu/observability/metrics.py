@@ -429,6 +429,17 @@ def dump_flight_record(dir_path: str, reason: str,
         "metrics_text": registry.render(),
         "trace_events": [asdict(e) for e in tracer.events()],
     }
+    # the goodput ledger snapshot rides along: the post-mortem for a
+    # hang includes what the hang cost (best-effort — processes without
+    # a ledger, or with a wedged one, still get their flight record)
+    try:
+        from edl_tpu.observability.goodput import get_process_ledger
+
+        ledger = get_process_ledger()
+        if ledger is not None:
+            doc["goodput"] = ledger.snapshot()
+    except Exception:
+        pass
     fd, tmp = tempfile.mkstemp(dir=dir_path, prefix=".flightrec-")
     with os.fdopen(fd, "w") as f:
         json.dump(doc, f)

@@ -199,8 +199,18 @@ class ElasticCheckpointer:
         (or :meth:`close`) — fingerprinting mid-write files would bake a
         torn snapshot into the manifest.  Prefer :meth:`save_async`, which
         finalizes each step automatically."""
+        t0 = time.monotonic()
         self.wait_pending()  # one persist pipeline: saves never overlap
-        return self._persist(step, tree, wait=wait, best_effort=best_effort)
+        try:
+            return self._persist(step, tree, wait=wait,
+                                 best_effort=best_effort)
+        finally:
+            # goodput: a synchronous save bills the step loop for the
+            # whole persist — attribute it (no-op without a ledger)
+            from edl_tpu.observability import goodput
+
+            goodput.note_span(goodput.CHECKPOINT_PAUSE,
+                              time.monotonic() - t0)
 
     def _persist(self, step: int, tree: Any, wait: bool,
                  best_effort: bool) -> bool:
@@ -280,12 +290,15 @@ class ElasticCheckpointer:
         import jax
 
         t0 = time.monotonic()
+        from edl_tpu.observability import goodput
+
         if skip_if_busy:
             t = self._inflight
             if t is not None and t.is_alive():
                 get_counters().inc("checkpoint_async_skipped")
                 pause = time.monotonic() - t0
                 self.async_pauses_s.append(pause)
+                goodput.note_span(goodput.CHECKPOINT_PAUSE, pause)
                 return pause
         self.wait_pending()
         host_tree = jax.device_get(tree)
@@ -306,6 +319,9 @@ class ElasticCheckpointer:
         get_registry().histogram(
             "checkpoint_pause_seconds",
             help="step-loop pause per async checkpoint save").observe(pause)
+        # goodput: only the snapshot+handoff pause is the step loop's
+        # cost — the background persist overlaps training and is free
+        goodput.note_span(goodput.CHECKPOINT_PAUSE, pause)
         return pause
 
     def _persist_bg(self, step: int, host_tree: Any,

@@ -252,6 +252,7 @@ class ElasticTrainer:
             return False
         if shape == self.shape:
             return True
+        old_world = self.world_size
         try:
             bundle, new_params, new_opt = self._stage(shape)
         except Exception as exc:
@@ -286,6 +287,20 @@ class ElasticTrainer:
         hist.observe(evt["replan_ms"] / 1000.0, phase="replan")
         hist.observe(evt["compile_ms"] / 1000.0, phase="compile")
         hist.observe(evt["reshard_ms"] / 1000.0, phase="reshard")
+        # goodput attribution (best-effort; no-op without a process
+        # ledger): the compile window and the replan+reshard window were
+        # paid at the OLD world size — those chips were held, not
+        # stepping — and the ledger's accrual weight moves to the new
+        # size at the commit this event records
+        from edl_tpu.observability import goodput
+
+        goodput.note_span(goodput.COMPILE, evt["compile_ms"] / 1000.0,
+                          world_size=old_world)
+        goodput.note_span(
+            goodput.RESHARD,
+            (evt["replan_ms"] + evt["reshard_ms"]) / 1000.0,
+            world_size=old_world)
+        goodput.set_world_size(shape.size)
         log.info("mesh resized", world_size=shape.size,
                  shape=evt["shape"], replan_ms=evt["replan_ms"],
                  compile_ms=evt["compile_ms"], reshard_ms=evt["reshard_ms"],

@@ -726,6 +726,11 @@ class WorkerOutcome:
     step: Optional[int] = None
     #: True when this worker left because its peers evicted it (straggler)
     evicted: bool = False
+    #: final goodput-ledger snapshot for this member slot (chip-second
+    #: attribution across the run: productive/reform_dark/stall/queued…,
+    #: plus the conservation verdict) — None only when goodput accounting
+    #: itself failed, never because the run was short
+    goodput: Optional[dict] = None
 
 
 def _write_result(path: str, result: dict) -> None:
@@ -1367,6 +1372,21 @@ def run_elastic_worker(
     respawn on a 1-core box).  A crash inside the delay window falls
     back to a cold spawn — the pre-warm-spawn behavior."""
     ew = ElasticWorld(coord, name, address=address, settle_s=settle_s)
+    # Goodput ledger for this member slot: one chip-second per second,
+    # attributed queued → productive/reform_dark/stall across the run
+    # (world sizes multiply across members — each supervisor speaks only
+    # for its own share, so a fleet sum never double-counts).  A ledger a
+    # CALLER installed is fed instead of replaced; one left by a previous
+    # supervisor run in this process is retired.
+    from edl_tpu.observability import goodput
+
+    ledger = goodput.get_process_ledger()
+    if ledger is None or getattr(ledger, "_edl_supervisor", None):
+        ledger = goodput.GoodputLedger(job=name, world_size=1,
+                                       base_phase=goodput.QUEUED)
+        ledger._edl_supervisor = name
+        goodput.set_process_ledger(ledger)
+        goodput.register_metrics(ledger)
     if stall_floor_s is None:
         stall_floor_s = float(os.environ.get("EDL_MH_STALL_FLOOR_S", "60"))
     hb_path = (os.path.join(ckpt_dir, f"hb-{name}")
@@ -1520,6 +1540,11 @@ def run_elastic_worker(
                 wd_box["wd"] = wd
                 last_hb: Optional[str] = None
                 world_t0 = time.monotonic()
+                #: goodput: the formation/spawn window stays queued (first
+                #: world) or reform_dark (reforms) until the child proves
+                #: progress — its first heartbeat (or its start, when no
+                #: watchdog heartbeats exist to observe)
+                world_productive = False
                 # publish the reform-trace correlation + spawn wall-time
                 # BEFORE the child exists, so even its first instruction
                 # is attributable (the spawn_imports phase starts here)
@@ -1560,6 +1585,12 @@ def run_elastic_worker(
                          warm=child_conn is not None)
                 announced = False
                 stall_killed = False
+                if wd is None:
+                    # no heartbeat channel: optimistically call the world
+                    # productive from its start — better than billing an
+                    # entire healthy world to dark time
+                    ledger.reset(goodput.PRODUCTIVE)
+                    world_productive = True
                 while child.exitcode is None:
                     child.join(timeout=0.1)
                     if wd is not None and not stall_killed:
@@ -1570,6 +1601,11 @@ def run_elastic_worker(
                             hb = None
                         if hb and hb != last_hb:
                             last_hb = hb
+                            if not world_productive:
+                                # first observed progress: the reform's
+                                # dark window ends here
+                                ledger.reset(goodput.PRODUCTIVE)
+                                world_productive = True
                             try:
                                 wd.beat(int(hb))
                             except ValueError:
@@ -1633,10 +1669,16 @@ def run_elastic_worker(
                     except Exception as exc:  # GC must never kill a worker
                         log.warn("generation prune failed", error=str(exc))
                     if not result["stopped"]:  # queue drained — job done
+                        ledger.reset(goodput.IDLE)
                         break
                     if announced:  # our own graceful leave completed
+                        ledger.reset(goodput.IDLE)
                         break
-                    # stopped on a membership change: wait for it to land
+                    # stopped on a membership change: the chips are dark
+                    # from this boundary until the reformed world's first
+                    # beat — the graceful-reform share of elastic overhead
+                    ledger.reset(goodput.REFORM_DARK)
+                    # wait for the membership change to land
                     try:
                         ew.wait_epoch_past(plan.epoch,
                                            timeout_s=reform_grace_s)
@@ -1652,6 +1694,10 @@ def run_elastic_worker(
                          exitcode=child.exitcode)
                 tracer.instant("world_reform", category="membership",
                                epoch=plan.epoch, exitcode=child.exitcode)
+                # goodput: whatever phase the world died inside (a stall
+                # window, a checkpoint) settles HERE — chips are dark
+                # until the reform's next world proves progress
+                ledger.reset(goodput.REFORM_DARK)
                 if flight_dir and not stall_killed:
                     # fault escalation (the stall path dumped already via
                     # the watchdog): capture the pre-reform evidence
@@ -1726,6 +1772,30 @@ def run_elastic_worker(
                             process_name=f"supervisor-{name}")
             except Exception as exc:  # tracing never fails the worker
                 log.warn("trace dump failed", error=str(exc))
+    # final goodput accounting, machine-parseable like world_phases: the
+    # soak/bench harnesses parse this line from worker logs, and the
+    # snapshot rides the outcome for in-process callers
+    goodput_snap: Optional[dict] = None
+    try:
+        if getattr(ledger, "_edl_supervisor", None) == name:
+            # freeze OUR ledger: the callback gauges registered over it
+            # keep serving its FINAL numbers instead of drifting — a
+            # scrape after the worker returns must not keep accruing
+            # wall time into a finished job's last phase.  A ledger the
+            # CALLER installed stays live (its lifecycle, its close).
+            ledger.close()
+        goodput_snap = ledger.snapshot()
+        print(f"[{name}] goodput_ledger "
+              f"fraction={goodput_snap['goodput_fraction']} "
+              f"conserves={int(ledger.conserves())} "
+              f"attributed_s={goodput_snap['attributed_chip_seconds']} "
+              f"wall_s={goodput_snap['wall_seconds']} "
+              + " ".join(f"{p}_s={v}" for p, v in
+                         sorted(goodput_snap["chip_seconds"].items())
+                         if v > 0),
+              flush=True)
+    except Exception as exc:  # accounting must never fail the worker
+        log.warn("goodput snapshot failed", error=str(exc))
     if last_path is None:
         found = ew.latest_state(ew.epoch() + 1)
         last_path = found[1] if found else None
@@ -1741,7 +1811,7 @@ def run_elastic_worker(
         raise RuntimeError(
             "no state generation was ever published — trained state lost")
     return WorkerOutcome(state_path=last_path, step=last_step,
-                         evicted=evicted_self)
+                         evicted=evicted_self, goodput=goodput_snap)
 
 
 # -- numpy-tree state helpers (the default save/load for DP-replicated

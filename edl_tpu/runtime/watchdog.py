@@ -150,7 +150,13 @@ class StallWatchdog:
                 self._last_step = step
             else:
                 self._last_step += 1
-            self._stalled = None
+            was_stalled, self._stalled = self._stalled, None
+        if was_stalled is not None:
+            # the hang resolved: close the goodput stall window the
+            # breach opened (no-op without a process ledger)
+            from edl_tpu.observability import goodput
+
+            goodput.exit_phase(goodput.STALL)
 
     # -- deadline model ------------------------------------------------------
 
@@ -209,6 +215,13 @@ class StallWatchdog:
                              silent_s=round(stall.silent_s, 3),
                              deadline_s=round(stall.deadline_s, 3))
         get_counters().inc("stalls_detected", scope=self.scope)
+        # goodput: chips are dark from here until the next beat (or the
+        # escalation's world reset) — attribute the silence ALREADY spent
+        # retroactively, then keep accruing as `stall` until it clears
+        from edl_tpu.observability import goodput
+
+        goodput.note_span(goodput.STALL, stall.silent_s)
+        goodput.enter_phase(goodput.STALL)
         if self.flight_dir:
             # the stall IS the post-mortem moment: capture the trace ring
             # and every counter before escalation mutates the world

@@ -60,6 +60,7 @@ class Autoscaler:
         resize_cooldown_s: float = DEFAULT_RESIZE_COOLDOWN_S,
         min_resize_delta: int = DEFAULT_MIN_RESIZE_DELTA,
         mesh_shape_for: Optional[Callable[[str, int], object]] = None,
+        goodput_curves: Optional[Callable[[str], object]] = None,
         clock=time.monotonic,
     ) -> None:
         self.cluster = cluster
@@ -104,6 +105,19 @@ class Autoscaler:
         #: Planning/actuation still walk instance counts; the shape is
         #: carried alongside, never instead.
         self.mesh_shape_for = mesh_shape_for
+        #: goodput advisory hook: maps a job uid to its measured
+        #: :class:`~edl_tpu.observability.goodput.ScalingCurve` (e.g.
+        #: ``lambda uid: goodput.load_curve(coord, uid)``).  When set,
+        #: every actuated plan logs the job's marginal
+        #: tokens-per-second-per-chip at the target size and exports it
+        #: as ``edl_autoscaler_marginal_tokens_per_chip{job=}`` —
+        #: ADVISORY this PR: the packing decision is unchanged (the
+        #: goodput-driven planner is ROADMAP #3); this is the measured
+        #: substrate it will consume, surfaced where the decision is made.
+        self.goodput_curves = goodput_curves
+        #: log of (uid, target, measured_at, marginal) advisories, for
+        #: tests/observability
+        self.advisory_history: list[dict] = []
 
     # -- event intake (reference autoscaler.go:159-171) --------------------
 
@@ -137,6 +151,14 @@ class Autoscaler:
                 # a long-lived controller must not leak one float per
                 # deleted job)
                 self._last_resize.pop(evt.job.full_name, None)
+                # and the advisory gauge series: a deleted job must stop
+                # being reported, not freeze at its last marginal value
+                # (nor grow the series set without bound as jobs churn)
+                from edl_tpu.observability.metrics import get_registry
+
+                get_registry().gauge(
+                    "autoscaler_marginal_tokens_per_chip").remove(
+                        job=evt.job.full_name)
 
     def tick(self) -> dict[str, int]:
         """One plan-and-actuate pass; returns the actuated targets
@@ -210,8 +232,44 @@ class Autoscaler:
                     except Exception as exc:
                         log.warn("prewarm hint sink failed", job=uid,
                                  error=str(exc))
+            self._advise_goodput(target)
         self._scale_all_jobs(target)
         return target
+
+    def _advise_goodput(self, target: dict[str, int]) -> None:
+        """Log each actuated job's measured marginal throughput per chip
+        at its new target (advisory — the allocation itself is unchanged
+        this PR; consuming the curve in the packing decision is ROADMAP
+        #3).  A missing/raising curve source degrades to silence — the
+        advisory is never a dependency."""
+        if self.goodput_curves is None:
+            return
+        from edl_tpu.observability.collector import get_counters
+        from edl_tpu.observability.metrics import get_registry
+
+        for uid, n in target.items():
+            try:
+                curve = self.goodput_curves(uid)
+                if curve is None:
+                    continue
+                at = curve.nearest_world_size(n)
+                marginal = (curve.marginal_tokens_per_second_per_chip(at)
+                            if at is not None else None)
+            except Exception as exc:
+                log.warn("goodput curve lookup failed", job=uid,
+                         error=str(exc)[:200])
+                continue
+            if marginal is None:
+                continue
+            advisory = {"job": uid, "target": n, "measured_at": at,
+                        "marginal_tok_s_per_chip": round(marginal, 2)}
+            log.info("goodput advisory", **advisory)
+            self.advisory_history.append(advisory)
+            get_counters().inc("autoscaler_goodput_advisories")
+            get_registry().gauge(
+                "autoscaler_marginal_tokens_per_chip",
+                help="measured marginal tok/s per chip at the plan's "
+                     "target (advisory)").set(marginal, job=uid)
 
     def run(self) -> None:
         """Timed loop (role of Run + ticker, reference autoscaler.go:451-459)."""

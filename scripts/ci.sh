@@ -303,6 +303,122 @@ EOF
 JAX_PLATFORMS=cpu python "$HA_TMP/ha_smoke.py"
 rm -rf "$HA_TMP"
 
+echo "== goodput smoke (chip-second ledger conservation + curve in coord KV)"
+# Part A: an in-process trainer eats one injected resize with the process
+# ledger installed — compile/reshard chip-seconds attributed, curve
+# samples at both world sizes persisted in coordinator KV, the
+# edl_goodput_* series green under the strict exposition parser, and the
+# conservation invariant (attributed == wall x world within 1 %) held.
+# Part B: a short SUPERVISED run with one stall->kill->reform — the
+# supervisor's own ledger attributes queued/productive/stall/reform_dark
+# and still conserves through the kill.  Real file: spawn-context world
+# children re-import __main__.
+GP_TMP="$(mktemp -d)"
+cat > "$GP_TMP/goodput_smoke.py" <<'EOF'
+import functools, os, sys, tempfile
+
+sys.path.insert(0, os.getcwd())
+
+
+def main():
+    import jax, numpy as np, optax
+
+    from tests.test_observability import parse_prometheus
+    from tests.test_telemetry import (_tele_init_state, _tele_load_state,
+                                      _tele_train_world)
+    from edl_tpu.coord.client import CoordClient
+    from edl_tpu.coord.server import spawn_server
+    from edl_tpu.models import mlp
+    from edl_tpu.observability import goodput
+    from edl_tpu.observability.goodput import CurveStore, GoodputLedger
+    from edl_tpu.observability.metrics import get_registry
+    from edl_tpu.parallel.mesh import MeshSpec
+    from edl_tpu.runtime.elastic import ElasticTrainer
+    from edl_tpu.runtime.multihost import run_elastic_worker, save_numpy_tree
+
+    h = spawn_server(member_ttl_ms=3000, task_timeout_ms=4000)
+    client = CoordClient("127.0.0.1", h.port)
+    try:
+        # -- part A: injected resize + curve samples into coord KV ------
+        led = goodput.set_process_ledger(GoodputLedger(
+            job="ci/goodput", world_size=2, base_phase=goodput.QUEUED))
+        goodput.register_metrics(led)
+        params = mlp.init(jax.random.key(0), [16, 32, 4])
+        tr = ElasticTrainer(mlp.loss_fn, params, optax.adam(1e-2),
+                            spec=MeshSpec(dp=-1), initial_world_size=2)
+        rng = np.random.default_rng(0)
+        batch = (rng.normal(size=(64, 16)).astype(np.float32),
+                 rng.integers(0, 4, 64).astype(np.int32))
+        store = CurveStore(client, "ci/goodput")
+        import time as _t
+        tr.step(batch)
+        led.reset(goodput.PRODUCTIVE)
+
+        def window(n):
+            t0 = _t.perf_counter()
+            for _ in range(n):
+                tr.step(batch)
+            return 64 * n / (_t.perf_counter() - t0)
+
+        store.record(2, window(30), shape=tr.shape.describe())
+        assert tr.resize(4), "injected resize failed"
+        store.record(4, window(30), shape=tr.shape.describe())
+        snap = led.snapshot()
+        assert led.conserves(0.01), snap
+        assert 0.0 < snap["goodput_fraction"] <= 1.0, snap
+        # strictly positive: resize(4) without a prewarm always pays an
+        # inline compile, so a regressed compile-attribution path (the
+        # note_span wiring going no-op) must fail here, not pass green
+        assert snap["chip_seconds"]["compile"] > 0.0, snap
+        assert snap["chip_seconds"]["reshard"] > 0.0, snap
+        # curve samples present in coordinator KV, both world sizes
+        raw = client.kv_get("goodput-curve/ci/goodput")
+        assert raw is not None, "curve never persisted"
+        curve = goodput.load_curve(client, "ci/goodput")
+        assert curve.world_sizes() == [2, 4], curve.summary()
+        # edl_goodput_* green under the strict parser
+        series = parse_prometheus(get_registry().render())
+        frac = series['edl_goodput_fraction{job="ci/goodput"}']
+        assert 0.0 < frac <= 1.0, frac
+        assert series[
+            'edl_goodput_chip_seconds{job="ci/goodput",'
+            'phase="reshard"}'] > 0
+        assert series['edl_goodput_curve_tokens_per_second'
+                      '{job="ci/goodput",world_size="4"}'] > 0
+        goodput.set_process_ledger(None)
+
+        # -- part B: supervised stall->kill->reform conserves -----------
+        tmp = tempfile.mkdtemp(prefix="edl-ci-goodput-")
+        outcome = run_elastic_worker(
+            client, "gp0",
+            init_state=_tele_init_state,
+            train_world=functools.partial(
+                _tele_train_world, marker=os.path.join(tmp, "wedged"),
+                done_at=14, wedge_at=5),
+            save_state=save_numpy_tree, load_state=_tele_load_state,
+            ckpt_dir=tmp, settle_s=0.1, warm_spawn=False,
+            reform_grace_s=2.0, stall_floor_s=1.5, stall_k=6.0)
+        assert outcome.step == 14, outcome
+        g = outcome.goodput
+        assert g is not None, "supervisor ledger missing"
+        assert g["conservation_error_pct"] < 1.0, g
+        assert 0.0 < g["goodput_fraction"] <= 1.0, g
+        assert g["chip_seconds"]["reform_dark"] > 0, g   # the kill's cost
+        assert g["chip_seconds"]["stall"] > 0, g         # the wedge's cost
+        print("goodput smoke OK: fraction_A=%.3f fraction_B=%.3f "
+              "curve=%s" % (frac, g["goodput_fraction"], curve.summary()))
+    finally:
+        client.close()
+        h.stop()
+
+
+if __name__ == "__main__":
+    main()
+EOF
+JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+  python "$GP_TMP/goodput_smoke.py"
+rm -rf "$GP_TMP"
+
 echo "== reshard smoke (dynamic reparallelization + dryrun sharding checks)"
 # A dp→fsdp reparallelizing resize on CPU devices through the
 # transactional path: zero failures, state preserved, a nonzero replan

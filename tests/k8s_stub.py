@@ -407,6 +407,271 @@ class _Watch:
             time.sleep(0.01)
 
 
+# -- HTTP wire mode ----------------------------------------------------------
+#
+# The in-memory module above exercises K8sCluster's method BODIES; the
+# HTTP mode exercises its method bodies THROUGH REAL SOCKETS (VERDICT r5
+# #7): the same schema-enforcing StubState served by a threaded HTTP
+# apiserver, with a kubernetes-shaped client module whose API classes
+# serialize every call over the wire.  What this adds over in-memory:
+# watch streams arrive as bytes on a live connection (flushed
+# incrementally, ended by the server-side timeout), 410 Gone is a real
+# HTTP status the client maps back to ApiException, and 409 conflicts
+# cross the wire before the autoscaler's retry loop sees them.
+
+def to_wire(v: Any) -> Any:
+    """JSON-encode the stub's value graph; _Obj nodes become tagged dicts
+    so attribute access survives the round trip."""
+    if isinstance(v, _Obj):
+        return {"__obj__": {k: to_wire(x) for k, x in v.__dict__.items()}}
+    if isinstance(v, dict):
+        return {k: to_wire(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        return [to_wire(x) for x in v]
+    return v
+
+
+def from_wire(v: Any) -> Any:
+    if isinstance(v, dict):
+        if set(v) == {"__obj__"}:
+            return _Obj(**{k: from_wire(x) for k, x in v["__obj__"].items()})
+        return {k: from_wire(x) for k, x in v.items()}
+    if isinstance(v, list):
+        return [from_wire(x) for x in v]
+    return v
+
+
+class StubApiServer:
+    """The stub apiserver behind a real HTTP listener.
+
+    * ``POST /call`` — one API method call: ``{"api": "core|batch|apps|
+      custom", "method": ..., "args": [...], "kwargs": {...}}`` → 200
+      ``{"result": ...}``; an :class:`ApiException` maps to its real
+      HTTP status with ``{"error": {"status", "reason"}}`` in the body.
+    * ``GET /watch?resource_version=N&timeout_seconds=T`` — the custom-
+      object watch as a line-delimited JSON stream, flushed per event,
+      closed at the server-side timeout; a compacted rv answers 410
+      before any event flows (etcd semantics, now with a status line).
+    """
+
+    def __init__(self, state: StubState) -> None:
+        import http.server
+        import json
+        import threading
+
+        apis = {"core": _CoreV1Api(state), "batch": _BatchV1Api(state),
+                "apps": _AppsV1Api(state), "custom": _CustomObjectsApi(state)}
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def log_message(self, *a):  # keep test output clean
+                pass
+
+            def _json(self, status: int, doc: dict) -> None:
+                body = json.dumps(doc).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_POST(self) -> None:
+                if self.path != "/call":
+                    self._json(404, {"error": {"status": 404,
+                                               "reason": self.path}})
+                    return
+                n = int(self.headers.get("Content-Length", "0"))
+                req = json.loads(self.rfile.read(n).decode())
+                api = apis.get(req.get("api"))
+                method = getattr(api, req.get("method", ""), None)
+                if api is None or method is None:
+                    self._json(404, {"error": {
+                        "status": 404,
+                        "reason": f"{req.get('api')}.{req.get('method')}"}})
+                    return
+                try:
+                    result = method(*from_wire(req.get("args") or []),
+                                    **from_wire(req.get("kwargs") or {}))
+                except ApiException as exc:
+                    self._json(exc.status, {"error": {
+                        "status": exc.status, "reason": exc.reason}})
+                    return
+                except Exception as exc:  # stub bug: surface it loudly
+                    self._json(500, {"error": {"status": 500,
+                                               "reason": repr(exc)}})
+                    return
+                self._json(200, {"result": to_wire(result)})
+
+            def do_GET(self) -> None:
+                import time
+                import urllib.parse
+
+                parsed = urllib.parse.urlparse(self.path)
+                if parsed.path != "/watch":
+                    self._json(404, {"error": {"status": 404,
+                                               "reason": self.path}})
+                    return
+                q = urllib.parse.parse_qs(parsed.query)
+                rv = int((q.get("resource_version") or ["0"])[0] or 0)
+                timeout = float((q.get("timeout_seconds") or ["30"])[0])
+                if rv < state.custom_compacted_rv:
+                    self._json(410, {"error": {
+                        "status": 410,
+                        "reason": "too old resource version (compacted)"}})
+                    return
+                # stream: headers now, one JSON line per event, flushed —
+                # HTTP/1.0 connection-close delimits the body, so the
+                # client sees the stream end exactly at the timeout
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.end_headers()
+                deadline = time.monotonic() + timeout
+                try:
+                    while time.monotonic() < deadline:
+                        for erv, typ, obj in list(state.custom_events):
+                            if erv > rv:
+                                rv = erv
+                                line = json.dumps(
+                                    {"type": typ, "object": obj})
+                                self.wfile.write(line.encode() + b"\n")
+                                self.wfile.flush()
+                        time.sleep(0.01)
+                except (BrokenPipeError, ConnectionResetError):
+                    pass  # watcher hung up (Watch.stop)
+
+        self._httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0),
+                                                      Handler)
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        name="stub-apiserver", daemon=True)
+        self._thread.start()
+
+    @property
+    def url(self) -> str:
+        host, port = self._httpd.server_address
+        return f"http://{host}:{port}"
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5)
+
+
+class _HTTPApi:
+    """Client-side proxy: every attribute is a method that POSTs the
+    call over the wire and raises :class:`ApiException` on an API-error
+    status, exactly as the real kubernetes client surfaces them."""
+
+    def __init__(self, base_url: str, api: str) -> None:
+        self._base = base_url
+        self._api = api
+
+    def __getattr__(self, method: str):
+        import json
+        import urllib.error
+        import urllib.request
+
+        def call(*args, **kwargs):
+            body = json.dumps({"api": self._api, "method": method,
+                               "args": to_wire(list(args)),
+                               "kwargs": to_wire(kwargs)}).encode()
+            req = urllib.request.Request(
+                self._base + "/call", data=body,
+                headers={"Content-Type": "application/json"})
+            try:
+                with urllib.request.urlopen(req, timeout=30) as r:
+                    return from_wire(json.loads(r.read().decode()
+                                                ).get("result"))
+            except urllib.error.HTTPError as exc:
+                try:
+                    err = json.loads(exc.read().decode()).get("error") or {}
+                except ValueError:
+                    err = {}
+                raise ApiException(err.get("status", exc.code),
+                                   err.get("reason", "")) from None
+
+        return call
+
+
+class _HTTPWatch:
+    """Client half of the watch stream: a chunk-at-a-time GET whose
+    line-delimited events are yielded as they arrive on the socket."""
+
+    def __init__(self, base_url: str) -> None:
+        self._base = base_url
+        self._resp = None
+        self._stopped = False
+
+    def stop(self) -> None:
+        self._stopped = True
+        resp = self._resp
+        if resp is not None:
+            try:
+                resp.close()
+            except OSError:
+                pass
+
+    def stream(self, func, *args, resource_version="0",
+               timeout_seconds=30, **kwargs):
+        import json
+        import urllib.error
+        import urllib.request
+
+        url = (f"{self._base}/watch?resource_version={resource_version}"
+               f"&timeout_seconds={timeout_seconds}")
+        try:
+            # socket inactivity timeout ABOVE the server-side window: the
+            # server closing the stream at its timeout is the normal end
+            self._resp = urllib.request.urlopen(
+                url, timeout=float(timeout_seconds) + 10)
+        except urllib.error.HTTPError as exc:
+            try:
+                err = json.loads(exc.read().decode()).get("error") or {}
+            except ValueError:
+                err = {}
+            raise ApiException(err.get("status", exc.code),
+                               err.get("reason", "")) from None
+        try:
+            for line in self._resp:
+                if self._stopped:
+                    break
+                line = line.strip()
+                if line:
+                    yield json.loads(line.decode())
+        except OSError:
+            if not self._stopped:
+                raise
+        finally:
+            # generator close (K8sCluster.watch's finally → w.stop(), or
+            # a bare stream.close()) must release the socket NOW — not
+            # at GC — and end the server handler's streaming loop
+            # instead of leaving it writing until its timeout
+            self.stop()
+
+
+def build_http_module(base_url: str) -> types.ModuleType:
+    """A ``kubernetes``-shaped module whose every API call crosses real
+    sockets to a :class:`StubApiServer` (same attribute surface as
+    :func:`build_module`)."""
+    kubernetes = types.ModuleType("kubernetes")
+    client = types.ModuleType("kubernetes.client")
+    config = types.ModuleType("kubernetes.config")
+    exceptions = types.ModuleType("kubernetes.client.exceptions")
+    watch = types.ModuleType("kubernetes.watch")
+
+    exceptions.ApiException = ApiException
+    client.exceptions = exceptions
+    client.CoreV1Api = lambda: _HTTPApi(base_url, "core")
+    client.BatchV1Api = lambda: _HTTPApi(base_url, "batch")
+    client.AppsV1Api = lambda: _HTTPApi(base_url, "apps")
+    client.CustomObjectsApi = lambda: _HTTPApi(base_url, "custom")
+    config.load_kube_config = lambda *_a, **_k: None
+    config.load_incluster_config = lambda: None
+    watch.Watch = lambda: _HTTPWatch(base_url)
+    kubernetes.client = client
+    kubernetes.config = config
+    kubernetes.watch = watch
+    return kubernetes
+
+
 def build_module(state: StubState) -> types.ModuleType:
     """A module object that satisfies every ``kubernetes.*`` attribute
     K8sCluster touches."""

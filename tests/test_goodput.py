@@ -1,0 +1,444 @@
+"""Goodput ledger + scaling curve (doc/observability.md §goodput).
+
+Correctness of the chip-second attribution machine — phase-transition
+edge cases (overlapping resize+checkpoint, stall during reform, world
+death mid-phase), the conservation invariant under a seeded randomized
+fault campaign — plus the curve store's coordinator-KV persistence,
+including across a primary kill/failover on the HA pair (reusing the
+test_coord_ha harness), and the advisory surface the autoscaler logs.
+"""
+
+from __future__ import annotations
+
+import random
+import signal
+import time
+
+import pytest
+
+from edl_tpu.observability import goodput
+from edl_tpu.observability.goodput import (
+    ALL_PHASES,
+    CHECKPOINT_PAUSE,
+    COMPILE,
+    CurveStore,
+    GoodputLedger,
+    IDLE,
+    PRODUCTIVE,
+    QUEUED,
+    REFORM_DARK,
+    RESHARD,
+    STALL,
+    ScalingCurve,
+)
+
+
+class Clock:
+    def __init__(self) -> None:
+        self.t = 0.0
+
+    def __call__(self) -> float:
+        return self.t
+
+
+def make(world_size=2, base=QUEUED):
+    clock = Clock()
+    return GoodputLedger(job="t", world_size=world_size, base_phase=base,
+                         clock=clock), clock
+
+
+# ---------------------------------------------------------------------------
+# phase state machine
+# ---------------------------------------------------------------------------
+
+def test_baseline_accrues_to_base_phase_weighted_by_world():
+    led, clock = make(world_size=4)
+    clock.t = 2.0
+    assert led.chip_seconds(QUEUED) == 8.0
+    assert led.goodput_fraction() == 0.0
+    assert led.conserves(1e-9)
+
+
+def test_world_size_change_settles_old_rate_first():
+    led, clock = make(world_size=2)
+    led.reset(PRODUCTIVE)
+    clock.t = 1.0
+    led.set_world_size(8)
+    clock.t = 2.0
+    # 1 s @ 2 chips + 1 s @ 8 chips, every one of them productive
+    assert led.chip_seconds(PRODUCTIVE) == 10.0
+    assert led.conserves(1e-9)
+
+
+def test_overlapping_resize_inside_checkpoint_pause():
+    """The classic overlap: a resize lands while a checkpoint pause is
+    open.  The inner (resize) window attributes to reshard; only the
+    remainder of the pause attributes to checkpoint_pause — and nothing
+    is counted twice (conservation stays exact)."""
+    led, clock = make(world_size=2)
+    led.reset(PRODUCTIVE)
+    clock.t = 1.0
+    led.enter(CHECKPOINT_PAUSE)
+    clock.t = 1.5
+    led.enter(RESHARD)           # resize begins mid-pause
+    clock.t = 2.5
+    led.exit(RESHARD)
+    clock.t = 3.0
+    led.exit(CHECKPOINT_PAUSE)
+    clock.t = 4.0
+    snap = led.snapshot()
+    assert snap["chip_seconds"][RESHARD] == 2.0           # 1 s × 2
+    assert snap["chip_seconds"][CHECKPOINT_PAUSE] == 2.0  # (.5+.5) × 2
+    assert snap["chip_seconds"][PRODUCTIVE] == 4.0        # 1 s + 1 s
+    assert led.conserves(1e-9)
+
+
+def test_stall_during_reform_settles_without_double_count():
+    """A stall detected while the process is already in reform dark time
+    (the watchdog breach racing a world death): the stall window nests,
+    the reform's reset collapses both, and conservation holds."""
+    led, clock = make(world_size=2)
+    led.reset(REFORM_DARK)
+    clock.t = 1.0
+    led.enter(STALL)
+    clock.t = 2.0
+    led.reset(REFORM_DARK)       # the escalation kills → reform continues
+    clock.t = 3.0
+    led.reset(PRODUCTIVE)
+    snap = led.snapshot()
+    assert snap["chip_seconds"][STALL] == 2.0
+    assert snap["chip_seconds"][REFORM_DARK] == 4.0
+    assert led.conserves(1e-9)
+
+
+def test_world_death_mid_phase_exits_out_of_order():
+    """A world that dies mid-checkpoint leaves its phases half-open and
+    possibly exits them out of LIFO order; the ledger keeps counting."""
+    led, clock = make(world_size=2)
+    led.reset(PRODUCTIVE)
+    clock.t = 1.0
+    led.enter(CHECKPOINT_PAUSE)
+    led.enter(RESHARD)
+    clock.t = 2.0
+    # out-of-order: the OUTER phase is exited first
+    assert led.exit(CHECKPOINT_PAUSE)
+    clock.t = 3.0
+    # death: whatever is still open (reshard) settles into the reset
+    led.reset(REFORM_DARK)
+    clock.t = 4.0
+    snap = led.snapshot()
+    assert snap["chip_seconds"][RESHARD] == 4.0  # 1-2 inner + 2-3 (still top)
+    assert snap["chip_seconds"][REFORM_DARK] == 2.0
+    assert led.conserves(1e-9)
+
+
+def test_enter_is_idempotent_and_exit_of_absent_is_noop():
+    led, clock = make()
+    assert led.enter(STALL) is True
+    assert led.enter(STALL) is False      # two detectors, one push
+    assert led.exit(STALL) is True
+    assert led.exit(STALL) is False       # second exit: no-op
+    assert led.exit(COMPILE) is False     # never entered
+    with pytest.raises(ValueError):
+        led.enter("not-a-phase")
+    assert led.conserves(1e-9)
+
+
+def test_note_span_transfers_and_clamps():
+    led, clock = make(world_size=2)
+    led.reset(PRODUCTIVE)
+    clock.t = 2.0  # 4 chip-seconds productive
+    moved = led.note_span(COMPILE, 1.0)  # 2 chip-seconds across
+    assert moved == 2.0
+    # over-reported span: clamped to what the source actually has
+    moved = led.note_span(RESHARD, 100.0)
+    assert moved == 2.0
+    snap = led.snapshot()
+    assert snap["chip_seconds"][PRODUCTIVE] == 0.0
+    assert snap["chip_seconds"][COMPILE] == 2.0
+    assert snap["chip_seconds"][RESHARD] == 2.0
+    assert led.conserves(1e-9)  # transfers can never break conservation
+
+
+def test_conservation_under_seeded_fault_campaign():
+    """A seeded randomized campaign of every mutation the runtime can
+    throw at the ledger — nested enters, out-of-order exits, mid-phase
+    world deaths (reset), retroactive note_spans, world-size changes —
+    must keep attributed == integral exactly, at every step.  Three
+    seeds; each campaign is deterministic and reproducible."""
+    for seed in (0, 7, 1234):
+        rng = random.Random(seed)
+        led, clock = make(world_size=2)
+        for _ in range(1500):
+            clock.t += rng.random() * 3.0
+            op = rng.randrange(6)
+            phase = rng.choice(ALL_PHASES)
+            if op == 0:
+                led.enter(phase)
+            elif op == 1:
+                led.exit(phase)
+            elif op == 2:
+                led.reset(phase)
+            elif op == 3:
+                led.note_span(phase, rng.random() * 5.0)
+            elif op == 4:
+                led.set_world_size(rng.randrange(0, 9))
+            else:
+                led.snapshot()  # readout mid-flight must not perturb
+        assert led.conserves(1e-9), (seed, led.snapshot())
+        snap = led.snapshot()
+        assert snap["attributed_chip_seconds"] == pytest.approx(
+            snap["integral_chip_seconds"], abs=1e-6)
+        assert all(v >= 0 for v in snap["chip_seconds"].values()), snap
+
+
+def test_close_freezes_accrual_for_scrapes():
+    """A finished job's ledger must stop accruing: the callback gauges
+    registered over it would otherwise drift on every scrape, decaying
+    the fraction toward zero after the job ended."""
+    led, clock = make(world_size=2, base=PRODUCTIVE)
+    clock.t = 3.0
+    led.close()
+    frozen = led.snapshot()
+    clock.t = 100.0              # scrapes long after the job finished
+    assert led.snapshot() == frozen
+    assert led.chip_seconds(PRODUCTIVE) == 6.0
+    assert led.goodput_fraction() == 1.0
+    led.close()                  # idempotent
+    assert led.conserves(1e-9)
+
+
+def test_mfu_mean_weighted_by_reporting_samples():
+    c = ScalingCurve("j")
+    for _ in range(10):
+        c.observe(2, 100.0)      # no mfu reported
+    c.observe(2, 100.0, mfu_pct=50.0)
+    c.observe(2, 100.0, mfu_pct=60.0)
+    cell = c._cells[(2, "")]
+    assert cell["mfu_pct"] == pytest.approx(55.0)  # not diluted by the 10
+    rt = ScalingCurve.from_json(c.to_json())
+    rt.observe(2, 100.0, mfu_pct=61.0)
+    assert rt._cells[(2, "")]["mfu_pct"] == pytest.approx(
+        (55.0 * 2 + 61.0) / 3)
+
+
+def test_goodput_fraction_bounds():
+    led, clock = make(world_size=1)
+    led.reset(PRODUCTIVE)
+    clock.t = 3.0
+    led.enter(STALL)
+    clock.t = 4.0
+    frac = led.goodput_fraction()
+    assert 0.0 < frac <= 1.0
+    assert frac == pytest.approx(0.75)
+
+
+# ---------------------------------------------------------------------------
+# /metrics + flight-record surfaces
+# ---------------------------------------------------------------------------
+
+def test_register_metrics_renders_strict_exposition():
+    from edl_tpu.observability.metrics import MetricsRegistry
+    from tests.test_observability import parse_prometheus
+
+    led, clock = make(world_size=2)
+    led.reset(PRODUCTIVE)
+    clock.t = 2.0
+    led.enter(STALL)
+    clock.t = 3.0
+    reg = MetricsRegistry()
+    goodput.register_metrics(led, reg)
+    series = parse_prometheus(reg.render())
+    assert series['edl_goodput_fraction{job="t"}'] == pytest.approx(4 / 6)
+    assert series['edl_goodput_chip_seconds{job="t",phase="stall"}'] \
+        == pytest.approx(2.0)
+    assert series['edl_goodput_lost_seconds{job="t",phase="stall"}'] \
+        == pytest.approx(2.0)
+    assert series['edl_goodput_world_size{job="t"}'] == 2
+
+
+def test_flight_record_embeds_ledger_snapshot(tmp_path):
+    import json
+
+    from edl_tpu.observability.metrics import dump_flight_record
+
+    led, clock = make(world_size=2)
+    led.reset(PRODUCTIVE)
+    clock.t = 2.0
+    goodput.set_process_ledger(led)
+    try:
+        path = dump_flight_record(str(tmp_path), "test-stall")
+        doc = json.loads(open(path).read())
+        assert doc["goodput"]["chip_seconds"]["productive"] == 4.0
+        assert doc["goodput"]["job"] == "t"
+    finally:
+        goodput.set_process_ledger(None)
+    # and without a ledger the record simply has no goodput key
+    path = dump_flight_record(str(tmp_path), "test-bare")
+    assert "goodput" not in json.loads(open(path).read())
+
+
+def test_watchdog_stall_feeds_process_ledger():
+    from edl_tpu.runtime.watchdog import StallWatchdog
+
+    led, lclock = make(world_size=2, base=PRODUCTIVE)
+    goodput.set_process_ledger(led)
+    try:
+        wclock = Clock()
+        wd = StallWatchdog(floor_s=0.5, k=2.0, scope="gp-unit",
+                           clock=wclock)
+        wd.beat(1)
+        wclock.t = 2.0
+        assert wd.check() is not None
+        assert led.current_phase() == STALL
+        # the breach retro-attributed the silence already spent
+        assert led.chip_seconds(STALL) >= 0.0
+        wd.beat(2)                       # hang resolved
+        assert led.current_phase() == PRODUCTIVE
+        assert led.conserves(1e-6)
+    finally:
+        goodput.set_process_ledger(None)
+
+
+# ---------------------------------------------------------------------------
+# scaling curve + KV persistence
+# ---------------------------------------------------------------------------
+
+def test_curve_aggregation_and_marginals():
+    c = ScalingCurve("j")
+    c.observe(2, 100.0, shape="dp2", mfu_pct=60.0)
+    c.observe(2, 120.0, shape="dp2", mfu_pct=62.0)
+    c.observe(4, 180.0, shape="dp4")
+    c.observe(4, 150.0, shape="dp2xfsdp2")
+    assert c.tokens_per_second(2) == 110.0
+    assert c.tokens_per_second(4) == 180.0  # best shape rules
+    assert c.marginal_tokens_per_second_per_chip(2) == pytest.approx(55.0)
+    assert c.marginal_tokens_per_second_per_chip(4) == pytest.approx(35.0)
+    assert c.nearest_world_size(3) == 2
+    assert c.nearest_world_size(100) == 4
+    assert c.nearest_world_size(1) == 2
+    assert c.marginal_tokens_per_second_per_chip(7) is None  # unmeasured
+    rt = ScalingCurve.from_json(c.to_json())
+    assert rt.summary() == c.summary()
+    assert rt.sample_count() == 4
+
+
+def test_curve_store_roundtrip_on_py_backend():
+    from edl_tpu.coord import PyCoordService
+    from edl_tpu.observability.metrics import MetricsRegistry
+
+    svc = PyCoordService()
+    reg = MetricsRegistry()
+    store = CurveStore(svc, "ns/job", registry=reg)
+    store.record(2, 1000.0, shape="dp2", mfu_pct=50.0)
+    store.record(4, 1800.0, shape="dp4")
+    # persisted under the documented key, loadable by a fresh reader
+    assert svc.kv_get("goodput-curve/ns/job") is not None
+    loaded = goodput.load_curve(svc, "ns/job")
+    assert loaded.world_sizes() == [2, 4]
+    assert loaded.tokens_per_second(4) == 1800.0
+    # curve gauges refreshed on record
+    text = reg.render()
+    assert ('edl_goodput_curve_tokens_per_second'
+            '{job="ns/job",world_size="4"} 1800') in text
+    assert 'edl_goodput_marginal_tokens_per_second_per_chip' in text
+
+
+@pytest.mark.multihost
+def test_curve_survives_primary_failover(tmp_path):
+    """The acceptance property: curve samples recorded against the HA
+    pair's primary are readable from the promoted standby after a
+    SIGKILL — the curve rides the replication stream like any KV
+    (test_coord_ha harness: spawn_ha_pair + multi-endpoint client)."""
+    from edl_tpu.coord import CoordClient, native_available, spawn_ha_pair
+
+    if not native_available():
+        pytest.skip("no native coordinator core")
+    pr, sb = spawn_ha_pair(str(tmp_path), repl_lease_ms=1000)
+    c = CoordClient("127.0.0.1", pr.port, timeout=2.0,
+                    reconnect_window_s=12.0, promote_grace_s=0.2,
+                    endpoints=[("127.0.0.1", sb.port)])
+    try:
+        store = CurveStore(c, "ha/job")
+        store.record(2, 900.0, shape="dp2")
+        store.record(4, 1500.0, shape="dp4")
+        pr.process.send_signal(signal.SIGKILL)
+        pr.process.wait(timeout=10)
+        # the next read transparently fails over and promotes
+        survived = goodput.load_curve(c, "ha/job")
+        assert (c.host, c.port) == ("127.0.0.1", sb.port)
+        assert survived is not None
+        assert survived.world_sizes() == [2, 4]
+        assert survived.tokens_per_second(4) == 1500.0
+        # and the promoted primary accepts NEW samples onto the curve
+        store.record(8, 2100.0, shape="dp8")
+        assert goodput.load_curve(c, "ha/job").world_sizes() == [2, 4, 8]
+    finally:
+        c.close()
+        pr.stop()
+        sb.stop()
+
+
+# ---------------------------------------------------------------------------
+# autoscaler advisory
+# ---------------------------------------------------------------------------
+
+def test_autoscaler_logs_marginal_throughput_advisory():
+    """With a curve source configured, every actuated plan logs the
+    job's measured marginal tok/s-per-chip at the target — and the
+    packing decision itself is UNCHANGED (advisory this PR; consuming
+    it is ROADMAP #3)."""
+    from tests.test_autoscaler import cluster_with, mk_job, submit
+
+    from edl_tpu.scheduler.autoscaler import Autoscaler
+
+    curve = ScalingCurve("default/example")
+    curve.observe(2, 1000.0)
+    curve.observe(8, 3000.0)
+
+    c = cluster_with(cpu_milli=10_000)
+    baseline = Autoscaler(cluster_with(cpu_milli=10_000))
+    with_curve = Autoscaler(
+        c, goodput_curves=lambda uid: curve
+        if uid == "default/example" else None)
+    job = mk_job("example", lo=2, hi=10)
+    submit(baseline.cluster, baseline, mk_job("example", lo=2, hi=10))
+    submit(c, with_curve, job)
+    t_base = baseline.tick()
+    t_curve = with_curve.tick()
+    assert t_curve == t_base  # the plan is not perturbed by the curve
+    assert with_curve.advisory_history, "no advisory logged"
+    adv = with_curve.advisory_history[-1]
+    assert adv["job"] == "default/example"
+    assert adv["target"] == t_curve["default/example"]
+    # target 10 > largest measured 8 → answered from the curve edge
+    assert adv["measured_at"] == 8
+    assert adv["marginal_tok_s_per_chip"] == pytest.approx(
+        (3000.0 - 1000.0) / 6, abs=0.1)
+    from edl_tpu.observability.collector import get_counters
+    from edl_tpu.observability.metrics import get_registry
+
+    assert get_counters().get("autoscaler_goodput_advisories") >= 1
+    gauge = get_registry().gauge("autoscaler_marginal_tokens_per_chip")
+    assert {"job": "default/example"} in gauge.label_sets()
+    # deleting the job removes its advisory series (no frozen gauges)
+    with_curve.on_del(job)
+    with_curve.drain_events()
+    assert {"job": "default/example"} not in gauge.label_sets()
+
+
+def test_autoscaler_curve_failure_degrades_to_silence():
+    from tests.test_autoscaler import cluster_with, mk_job, submit
+
+    from edl_tpu.scheduler.autoscaler import Autoscaler
+
+    def broken(uid):
+        raise RuntimeError("curve store unreachable")
+
+    c = cluster_with(cpu_milli=10_000)
+    a = Autoscaler(c, goodput_curves=broken)
+    job = mk_job("example", lo=2, hi=10)
+    submit(c, a, job)
+    target = a.tick()             # plan proceeds; advisory just absent
+    assert target
+    assert a.advisory_history == []

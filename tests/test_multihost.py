@@ -34,7 +34,11 @@ from edl_tpu.coord.server import spawn_server
 # every test here budgets its own subprocess waits (up to ~600 s on a
 # loaded box) — the conftest SIGALRM ceiling must sit ABOVE them, or the
 # per-test tripwire turns legitimate slow runs into flakes
-pytestmark = [pytest.mark.multihost, pytest.mark.timeout_s(840)]
+# every scenario here forms a >=2-process jax.distributed world — gated
+# on the conftest capability probe so an environment whose CPU backend
+# lacks multiprocess collectives skips with a reason instead of failing
+pytestmark = [pytest.mark.multihost, pytest.mark.timeout_s(840),
+              pytest.mark.needs_multiprocess_collectives]
 
 #: Enough data that scenarios are still mid-job when we inject faults
 #: (shards × rows ÷ batch = 512 global steps).
