@@ -1425,6 +1425,132 @@ def goodput_leg() -> dict:
     }
 
 
+def determinism_leg() -> dict:
+    """Accuracy-consistent elasticity, measured: the same seeded job run
+    twice — a control that never resizes and a run resized 4→2→8
+    mid-training with one injected kill-mid-accumulation (restored from
+    checkpoint + cursor meta) and a live stall watchdog — must produce
+    the identical loss trajectory with every row trained exactly once.
+    The headline is the measured divergence (bitwise-zero in replicated
+    accumulation mode on CPU), not a claim."""
+    import tempfile
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    import optax
+
+    from edl_tpu.coord import local_service
+    from edl_tpu.models import mlp
+    from edl_tpu.observability.collector import get_counters
+    from edl_tpu.parallel.mesh import MeshSpec
+    from edl_tpu.runtime.checkpoint import ElasticCheckpointer
+    from edl_tpu.runtime.data import ShardRegistry
+    from edl_tpu.runtime.elastic import (AccumulationAborted,
+                                         ElasticTrainer)
+    from edl_tpu.runtime.virtual import (VirtualBatches, VirtualConfig,
+                                         VirtualWorkerLoop,
+                                         loss_divergence,
+                                         trajectories_equivalent)
+    from edl_tpu.runtime.watchdog import StallWatchdog
+
+    rng = np.random.default_rng(1)
+    n = 4096
+    y = rng.integers(0, 4, n).astype(np.int32)
+    x = rng.normal(size=(n, 16)).astype(np.float32)
+    reg = ShardRegistry()
+    ids = reg.register_arrays((x, y), num_shards=16)
+    cfg = VirtualConfig(vw_count=8, global_batch=64, job_seed=7)
+    steps = 40
+    schedule = lambda s: 4 if s < 14 else (2 if s < 27 else 8)  # noqa: E731
+
+    def trainer(world, mode):
+        params = mlp.init(jax.random.key(0), [16, 32, 4])
+        return ElasticTrainer(mlp.loss_fn, params, optax.adam(1e-2),
+                              spec=MeshSpec(dp=-1), initial_world_size=world,
+                              accum_mode=mode)
+
+    def control(mode):
+        loop = VirtualWorkerLoop(trainer(4, mode), cfg,
+                                 VirtualBatches(cfg, ids, reg.get, passes=2))
+        return loop.run(max_steps=steps, world_size_for=lambda s: 4)
+
+    t0 = time.perf_counter()
+    ctrl = control("replicated")
+
+    # the resized run: kv-backed cursors, checkpoint cadence, watchdog
+    # armed, one kill mid-accumulation at step 20 (restored + replayed)
+    kv = local_service()
+    ck = ElasticCheckpointer(tempfile.mkdtemp(prefix="edl-bench-det-"))
+    wd = StallWatchdog(floor_s=30.0, k=8.0, scope="bench-determinism")
+    wd.start(poll_s=1.0)
+    c0_remaps = get_counters().get("vw_remaps")
+    try:
+        tr = trainer(4, "replicated")
+        vb = VirtualBatches(cfg, ids, reg.get, passes=2)
+        loop = VirtualWorkerLoop(tr, cfg, vb, kv=kv, job="bench-det",
+                                 checkpointer=ck, ckpt_every=10)
+        rep1 = loop.run(max_steps=20, world_size_for=schedule,
+                        on_step=lambda s, l, w: wd.beat(s))
+        micro = vb.next_step()
+        try:
+            tr.step_accumulate(micro, abort_after=3)  # the injected kill
+        except AccumulationAborted:
+            pass
+        tr2 = trainer(2, "replicated")
+        # SAME report: the resumed loop stitches its losses + row ledger
+        # onto the killed run's, so the exactly-once accounting below is
+        # VirtualRunReport's own, not a re-implementation
+        loop2 = VirtualWorkerLoop(tr2, cfg,
+                                  VirtualBatches(cfg, ids, reg.get,
+                                                 passes=2),
+                                  kv=kv, job="bench-det",
+                                  checkpointer=ck, ckpt_every=10,
+                                  report=rep1)
+        restored = loop2.restore_latest()
+        rep = loop2.run(max_steps=steps, world_size_for=schedule,
+                        on_step=lambda s, l, w: wd.beat(s))
+    finally:
+        wd.stop()
+    div = loss_divergence(ctrl.losses, rep.losses)
+    rows_duplicated = rep.rows_duplicated()
+    rows_dropped = rep.rows_missing(expected=steps * cfg.global_batch)
+
+    # the dp-packed perf mode rides the same walk under the documented
+    # float bound (no kill — this measures the reduction-order envelope)
+    ctrl_dp = control("dp")
+    loop_dp = VirtualWorkerLoop(trainer(4, "dp"), cfg,
+                                VirtualBatches(cfg, ids, reg.get, passes=2))
+    rep_dp = loop_dp.run(max_steps=steps, world_size_for=schedule)
+    div_dp = loss_divergence(ctrl_dp.losses, rep_dp.losses)
+
+    out = {
+        "steps": steps,
+        "walk": "4->2->8 + kill@20 + restore",
+        "restored_from_step": restored,
+        "max_loss_divergence": div["max_loss_divergence"],
+        "resized_vs_control_final_loss_delta": div["final_loss_delta"],
+        "bitwise": div["bitwise"],
+        "equivalent_within_policy": trajectories_equivalent(
+            ctrl.losses, rep.losses),
+        "dp_mode_max_divergence": div_dp["max_loss_divergence"],
+        "dp_mode_equivalent": trajectories_equivalent(
+            ctrl_dp.losses, rep_dp.losses),
+        "rows_duplicated": rows_duplicated,
+        "rows_dropped": rows_dropped,
+        "vw_remaps_total": get_counters().get("vw_remaps") - c0_remaps,
+        "resizes": rep.resizes,
+        "stalls_detected": get_counters().get(
+            "stalls_detected", scope="bench-determinism"),
+        "wall_s": round(time.perf_counter() - t0, 2),
+    }
+    assert out["equivalent_within_policy"], out
+    assert out["rows_duplicated"] == 0 and out["rows_dropped"] == 0, out
+    assert out["vw_remaps_total"] > 0, out
+    return out
+
+
 def reform_latency_leg() -> dict:
     """The REAL fault-tolerance path's latency (VERDICT r2 weak #3): the
     supervised world dance — child teardown → membership settle →
@@ -1815,6 +1941,15 @@ def main() -> None:
                    "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
                    "PALLAS_AXON_POOL_IPS": ""})
 
+    # accuracy-consistent elasticity: resized 4→2→8 (+ kill + restore)
+    # vs unresized control — measured loss divergence + exactly-once
+    # row accounting (CPU mesh — it is a semantics number)
+    determinism = _run_leg(
+        "determinism", timeout_s=420,
+        extra_env={"JAX_PLATFORMS": "cpu",
+                   "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+                   "PALLAS_AXON_POOL_IPS": ""})
+
     # Headline discipline (VERDICT r5 weak #4): LEAD with metrics that
     # can still move — contended admission latency, the MFU suite,
     # reform/resize latencies.  The saturated packing ratio (100 % vs the
@@ -1850,6 +1985,7 @@ def main() -> None:
                    "model_zoo": zoo, "elastic": elastic,
                    "reparallel": reparallel, "reform": reform,
                    "coord_ha": coord_ha, "goodput": goodput_r,
+                   "determinism": determinism,
                    "tpu_world_cycle": tpu_cycle},
     }
     print(json.dumps(result))
@@ -1899,6 +2035,19 @@ def main() -> None:
             goodput_r.get("marginal_tok_s_per_chip_at_4"),
         "goodput_curve_survived_failover":
             goodput_r.get("curve_survived_failover"),
+        # accuracy-consistent elasticity: a resize must be invisible to
+        # the loss curve — the measured divergence of the 4→2→8 walk
+        # (with an injected kill) vs the unresized control, and the
+        # exactly-once row ledger
+        "max_loss_divergence": determinism.get("max_loss_divergence"),
+        "resized_vs_control_final_loss_delta":
+            determinism.get("resized_vs_control_final_loss_delta"),
+        "determinism_bitwise": determinism.get("bitwise"),
+        "rows_duplicated": determinism.get("rows_duplicated"),
+        "rows_dropped": determinism.get("rows_dropped"),
+        "determinism_vw_remaps": determinism.get("vw_remaps_total"),
+        "determinism_dp_mode_max_divergence":
+            determinism.get("dp_mode_max_divergence"),
         "elastic_resizes": elastic.get("resizes"),
         "elastic_resizes_failed": elastic.get("resizes_failed"),
         "elastic_stalls_detected": elastic.get("stalls_detected"),
@@ -1961,6 +2110,8 @@ if __name__ == "__main__":
             out = goodput_leg()
         elif leg == "reparallel":
             out = reparallel_leg()
+        elif leg == "determinism":
+            out = determinism_leg()
         elif leg == "reform":
             out = reform_latency_leg()
         elif leg == "tpu_world_cycle":

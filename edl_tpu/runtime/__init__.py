@@ -26,6 +26,14 @@ _EXPORTS = {
     "FaultPlanEngine": ("edl_tpu.runtime.faults", "FaultPlanEngine"),
     "StallWatchdog": ("edl_tpu.runtime.watchdog", "StallWatchdog"),
     "Stall": ("edl_tpu.runtime.watchdog", "Stall"),
+    # accuracy-consistent elasticity (virtual workers)
+    "VirtualConfig": ("edl_tpu.runtime.virtual", "VirtualConfig"),
+    "VirtualBatches": ("edl_tpu.runtime.virtual", "VirtualBatches"),
+    "VirtualWorkerLoop": ("edl_tpu.runtime.virtual", "VirtualWorkerLoop"),
+    "OwnershipMap": ("edl_tpu.runtime.virtual", "OwnershipMap"),
+    "CursorStore": ("edl_tpu.runtime.virtual", "CursorStore"),
+    "AccumulationAborted": ("edl_tpu.runtime.elastic",
+                            "AccumulationAborted"),
 }
 
 __all__ = list(_EXPORTS)

@@ -55,6 +55,13 @@ log = get_logger("runtime.checkpoint")
 
 _MANIFEST_DIRNAME = ".integrity"
 
+#: integrity-manifest schema version.  v1 (pre-versioned) manifests had
+#: only {step, files}; v2 adds {"version": 2, "meta": [size, crc] |
+#: None} fingerprinting the training-meta sidecar (data cursors + RNG
+#: lineage).  verify()/restore() accept both — an old store keeps
+#: restoring unchanged.
+_MANIFEST_VERSION = 2
+
 
 def _fingerprint_tree(root: Path) -> dict[str, list]:
     """Relative path → [size, crc32] for every regular file under root."""
@@ -99,6 +106,9 @@ class ElasticCheckpointer:
         #: steps whose Orbax save was submitted with wait=False and whose
         #: integrity manifest is therefore owed at finalize time
         self._unfinalized: set[int] = set()
+        #: training-meta sidecars owed by async saves (written with the
+        #: manifest at finalize, same reason: never fingerprint mid-write)
+        self._pending_meta: dict[int, dict] = {}
         #: the async pipeline: at most ONE persist thread in flight
         self._inflight: Optional[threading.Thread] = None
         self._async_error: Optional[BaseException] = None
@@ -121,14 +131,90 @@ class ElasticCheckpointer:
     def _manifest_path(self, step: int) -> Path:
         return self.directory / _MANIFEST_DIRNAME / f"{step}.json"
 
+    def _meta_path(self, step: int) -> Path:
+        return self.directory / _MANIFEST_DIRNAME / f"{step}.meta.json"
+
     def _step_dir(self, step: int) -> Path:
         return Path(self._mgr.directory) / str(step)
+
+    def _write_meta(self, step: int, meta: dict) -> None:
+        """Persist the training-meta sidecar (data cursors, RNG lineage
+        — anything restore needs to resume training semantics, not just
+        state).  Atomic + fsync'd like the manifest; written BEFORE the
+        manifest so the manifest can fingerprint it."""
+        payload = json.dumps({"step": step, "meta": meta},
+                             sort_keys=True).encode()
+        dest = self._meta_path(step)
+        dest.parent.mkdir(parents=True, exist_ok=True)
+        tmp = dest.with_suffix(f".{os.getpid()}.tmp")
+        with open(tmp, "wb") as f:
+            f.write(payload)
+            f.flush()
+            os.fsync(f.fileno())
+        try:
+            os.replace(tmp, dest)
+        except OSError:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+
+    def _drop_stale_meta(self, step: int) -> None:
+        """A meta-less save of a step must not leave an EARLIER save's
+        sidecar behind for the new manifest to fingerprint as valid —
+        stale cursors presented as verified would replay/skip rows on
+        restore, exactly what the sidecar exists to prevent."""
+        try:
+            self._meta_path(step).unlink()
+        except OSError:
+            pass
+
+    def load_meta(self, step: int) -> Optional[dict]:
+        """The step's training-meta sidecar, or None.  A torn sidecar
+        (unparseable, or mismatching the manifest's fingerprint) is
+        reported and returns None — the TORN-CURSOR fallback: callers
+        re-derive cursors from the step count instead of trusting a
+        half-written blob.  The checkpoint itself stays restorable —
+        params are covered by their own manifest entries."""
+        mpath = self._meta_path(step)
+        if not mpath.exists():
+            return None
+        try:
+            raw = mpath.read_bytes()
+            doc = json.loads(raw.decode())
+            meta = doc["meta"]
+        except (OSError, ValueError, KeyError) as exc:
+            log.warn("torn training-meta sidecar; cursors fall back to "
+                     "derive-from-step", step=step, error=str(exc)[:120])
+            get_counters().inc("checkpoint_meta_torn")
+            return None
+        try:
+            with open(self._manifest_path(step)) as f:
+                manifest = json.load(f)
+        except (OSError, ValueError):
+            manifest = None
+        expect = (manifest or {}).get("meta")
+        if expect is not None and expect != [len(raw),
+                                             zlib.crc32(raw) & 0xFFFFFFFF]:
+            log.warn("training-meta sidecar fails manifest fingerprint; "
+                     "cursors fall back to derive-from-step", step=step)
+            get_counters().inc("checkpoint_meta_torn")
+            return None
+        return meta
 
     def _write_manifest(self, step: int) -> None:
         root = self._step_dir(step)
         if not root.is_dir():  # layout drift — never fail the save for it
             return
-        manifest = {"step": step, "files": _fingerprint_tree(root)}
+        manifest = {"version": _MANIFEST_VERSION, "step": step,
+                    "files": _fingerprint_tree(root)}
+        mpath = self._meta_path(step)
+        if mpath.exists():
+            try:
+                raw = mpath.read_bytes()
+                manifest["meta"] = [len(raw), zlib.crc32(raw) & 0xFFFFFFFF]
+            except OSError:
+                manifest["meta"] = None
         dest = self._manifest_path(step)
         dest.parent.mkdir(parents=True, exist_ok=True)
         # per-process tmp name: in a collective save every rank writes the
@@ -156,7 +242,10 @@ class ElasticCheckpointer:
             return
         live = {str(s) for s in self._mgr.all_steps()}
         for entry in mdir.glob("*.json"):
-            if entry.stem not in live:
+            stem = entry.stem  # "5" for 5.json, "5.meta" for 5.meta.json
+            if stem.endswith(".meta"):
+                stem = stem[:-len(".meta")]
+            if stem not in live:
                 try:
                     entry.unlink()
                 except OSError:
@@ -185,7 +274,7 @@ class ElasticCheckpointer:
     # -- save/restore -------------------------------------------------------
 
     def save(self, step: int, tree: Any, wait: bool = True,
-             best_effort: bool = False) -> bool:
+             best_effort: bool = False, meta: Optional[dict] = None) -> bool:
         """Persist ``tree`` at ``step``; returns True on success.
 
         ``best_effort`` is the graceful-degradation mode the fault drills
@@ -198,12 +287,21 @@ class ElasticCheckpointer:
         step's integrity manifest is owed and written by :meth:`finalize`
         (or :meth:`close`) — fingerprinting mid-write files would bake a
         torn snapshot into the manifest.  Prefer :meth:`save_async`, which
-        finalizes each step automatically."""
+        finalizes each step automatically.
+
+        ``meta`` is the training-meta sidecar (versioned manifest v2):
+        data cursors + RNG lineage, anything a restore needs to resume
+        training *semantics* exactly-once rather than silently replaying
+        or skipping examples.  Read it back with :meth:`load_meta`."""
         t0 = time.monotonic()
         self.wait_pending()  # one persist pipeline: saves never overlap
         try:
+            # meta passed only when present: test seams (and subclasses)
+            # wrap _persist with the historical 4-arg signature
             return self._persist(step, tree, wait=wait,
-                                 best_effort=best_effort)
+                                 best_effort=best_effort,
+                                 **({"meta": meta} if meta is not None
+                                    else {}))
         finally:
             # goodput: a synchronous save bills the step loop for the
             # whole persist — attribute it (no-op without a ledger)
@@ -213,7 +311,7 @@ class ElasticCheckpointer:
                               time.monotonic() - t0)
 
     def _persist(self, step: int, tree: Any, wait: bool,
-                 best_effort: bool) -> bool:
+                 best_effort: bool, meta: Optional[dict] = None) -> bool:
         """The persist body shared by the sync and async paths — must only
         ever run on one thread at a time (callers serialize through
         :meth:`wait_pending`)."""
@@ -240,11 +338,19 @@ class ElasticCheckpointer:
             # fingerprint only finalized files: an in-flight save's files
             # are still being written, so its manifest must wait for
             # finalize() — verify() treats the step as unverifiable, not
-            # corrupt, until then
+            # corrupt, until then.  Meta first: the manifest fingerprints
+            # the sidecar, so load_meta can detect a torn one.
+            if meta is not None:
+                self._write_meta(step, meta)
+            else:
+                self._drop_stale_meta(step)
             self._write_manifest(step)
             self._unfinalized.discard(step)
+            self._pending_meta.pop(step, None)
         else:
             self._unfinalized.add(step)
+            if meta is not None:
+                self._pending_meta[step] = meta
         if self._save_failure_streak:
             log.info("checkpoint saves recovered", step=step,
                      after_failures=self._save_failure_streak)
@@ -269,7 +375,8 @@ class ElasticCheckpointer:
 
     def save_async(self, step: int, tree: Any,
                    best_effort: bool = False,
-                   skip_if_busy: bool = False) -> float:
+                   skip_if_busy: bool = False,
+                   meta: Optional[dict] = None) -> float:
         """Checkpoint ``step`` without stalling the step loop.
 
         Snapshots ``tree`` device→host on the calling thread (the only
@@ -305,7 +412,7 @@ class ElasticCheckpointer:
         # non-daemon: a persist mid-write at interpreter exit must be
         # joined, not torn down under the C++ IO/serialization stack
         t = threading.Thread(target=self._persist_bg,
-                             args=(step, host_tree, best_effort),
+                             args=(step, host_tree, best_effort, meta),
                              name=f"ckpt-persist-{step}")
         self._inflight = t
         t.start()
@@ -325,11 +432,13 @@ class ElasticCheckpointer:
         return pause
 
     def _persist_bg(self, step: int, host_tree: Any,
-                    best_effort: bool) -> None:
+                    best_effort: bool, meta: Optional[dict] = None) -> None:
         t0 = time.monotonic()
         try:
             if self._persist(step, host_tree, wait=True,
-                             best_effort=best_effort):
+                             best_effort=best_effort,
+                             **({"meta": meta} if meta is not None
+                                else {})):
                 get_tracer().instant(
                     "checkpoint_async_persisted", category="checkpoint",
                     step=step,
@@ -361,8 +470,14 @@ class ElasticCheckpointer:
         self.wait_pending()
         self._mgr.wait_until_finished()
         for step in sorted(self._unfinalized):
+            meta = self._pending_meta.pop(step, None)
+            if meta is not None:
+                self._write_meta(step, meta)
+            else:
+                self._drop_stale_meta(step)
             self._write_manifest(step)
         self._unfinalized.clear()
+        self._pending_meta.clear()
 
     def latest_step(self) -> Optional[int]:
         self.wait_pending()

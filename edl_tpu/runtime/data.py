@@ -23,15 +23,50 @@ from edl_tpu.observability.logging import get_logger
 log = get_logger("runtime.data")
 
 
+def shard_sizes(n: int, num_shards: int) -> list[int]:
+    """The deterministic shard-size contract, as pure arithmetic:
+    ``np.array_split`` semantics — the first ``n % num_shards`` shards
+    get ``n // num_shards + 1`` rows, the rest ``n // num_shards``.
+    The virtual-worker schedule (runtime.virtual) plans against these
+    sizes without materializing any array, so the two layers can only
+    agree."""
+    base, extra = divmod(int(n), int(num_shards))
+    return [base + 1] * extra + [base] * (num_shards - extra)
+
+
 def _row_splits(arrays: tuple[np.ndarray, ...],
                 num_shards: int) -> list[np.ndarray]:
     """The one sharding contract both publication modes share: row-split
-    index sets for ``num_shards`` shards (deterministic, order-preserving)."""
+    index sets for ``num_shards`` shards — a pure function of
+    ``(n, num_shards)``, order-preserving and contiguous.
+
+    The contract is ASSERTED, not assumed: every consumer of the shard
+    stream — lease racing, the virtual-worker ownership schedule, a
+    seeder re-writing files after a takeover — relies on every process
+    at every world size deriving the IDENTICAL shard→rows map, so a
+    drift in the split rule (a numpy behavior change, a refactor to a
+    different splitter) must fail loudly here rather than silently
+    training different data per worker."""
     n = arrays[0].shape[0]
     for a in arrays:
         if a.shape[0] != n:
             raise ValueError("all arrays must share the leading dim")
-    return np.array_split(np.arange(n), num_shards)
+    splits = np.array_split(np.arange(n), num_shards)
+    sizes = [len(s) for s in splits]
+    if sizes != shard_sizes(n, num_shards):
+        raise AssertionError(
+            f"shard split drifted from the (n={n}, num_shards="
+            f"{num_shards}) size contract: {sizes}")
+    pos = 0
+    for i, s in enumerate(splits):
+        if len(s) and (s[0] != pos or s[-1] != pos + len(s) - 1):
+            raise AssertionError(
+                f"shard {i} is not the contiguous order-preserving "
+                f"slice starting at row {pos}")
+        pos += len(s)
+    if pos != n:
+        raise AssertionError(f"shards cover {pos} rows of {n}")
+    return splits
 
 
 class ShardRegistry:

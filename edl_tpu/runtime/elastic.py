@@ -54,6 +54,7 @@ from dataclasses import dataclass
 from typing import Any, Callable, Optional, Sequence
 
 import jax
+import numpy as np
 import optax
 
 from edl_tpu.observability.collector import get_counters
@@ -95,11 +96,23 @@ def _reshard_host(tree: Any, shardings: Any) -> Any:
     return jax.device_put(jax.tree.map(np.asarray, tree), shardings)
 
 
+class AccumulationAborted(RuntimeError):
+    """Chaos seam: an injected kill landed mid-accumulation.  Nothing
+    was applied — the optimizer update is atomic, so recovery is a
+    plain restore-and-replay of the whole step (the property the
+    kill-mid-accumulation drill in tests/test_accuracy_elasticity.py
+    proves keeps the loss trajectory unchanged)."""
+
+
 @dataclass
 class TrainState:
     params: Any
     opt_state: Any
     step: int = 0
+    #: the job-level RNG root the virtual-worker layer derives per-VW
+    #: keys from (runtime.virtual.vw_key) — carried here so checkpoint
+    #: meta can persist the lineage with the state it seeds
+    job_seed: Optional[int] = None
 
 
 @dataclass
@@ -130,6 +143,10 @@ class _MeshBundle:
     #: who built it ("resize" inline, or "prewarm" speculatively) — the
     #: provenance behind the prewarm_hits counter
     source: str = "resize"
+    #: lazily-built gradient-accumulation functions (step_accumulate):
+    #: compiled on first accumulated step per bundle, cached with the
+    #: bundle so resizing back to a seen layout reuses them
+    accum: Any = None
 
 
 class ElasticTrainer:
@@ -153,11 +170,31 @@ class ElasticTrainer:
         initial_world_size: Optional[int] = None,
         prewarm_cache_limit: int = 4,
         reshard_host_fallback: bool = False,
+        rng_in_loss: bool = False,
+        accum_mode: str = "dp",
     ) -> None:
         self.loss_fn = loss_fn
         self.optimizer = optimizer
         self.spec = spec
         self.param_sharding_kind = param_sharding
+        #: loss_fn signature: False → loss_fn(params, batch) (the plain
+        #: step path); True → loss_fn(params, batch, key) — dropout /
+        #: in-model augmentation draws from the per-VW key lineage.
+        #: rng_in_loss trainers step through :meth:`step_accumulate`
+        #: (which carries the keys); the keyless step()/eval paths
+        #: cannot feed them.
+        self.rng_in_loss = rng_in_loss
+        #: gradient-accumulation compute placement (doc/
+        #: accuracy_elasticity.md): "dp" packs micro-batches into
+        #: data-parallel rounds of mesh width (the perf path;
+        #: float-bounded equivalence across world sizes), "replicated"
+        #: runs one micro-batch at a time with the batch replicated —
+        #: every device computes identically, no cross-device gradient
+        #: reduction, so the accumulated update is BITWISE identical at
+        #: any world size (CPU; pure-dp param sharding)
+        if accum_mode not in ("dp", "replicated"):
+            raise ValueError(f"unknown accum_mode {accum_mode!r}")
+        self.accum_mode = accum_mode
         #: opt-in: retry a failed device-to-device reshard through host
         #: memory before rolling back (for device sets with no direct
         #: transfer path — cross-slice moves).  Off by default: on one
@@ -409,6 +446,10 @@ class ElasticTrainer:
 
     def step(self, batch) -> float:
         """One training step on the current mesh; returns the scalar loss."""
+        if self.rng_in_loss:
+            raise ValueError(
+                "rng_in_loss trainers step via step_accumulate(micro, "
+                "rng_keys=...) — the plain step path carries no key")
         self._remember_batch(batch)
         batch = jax.device_put(batch, self._batch_sharding)
         fn = self._step_fn
@@ -426,6 +467,151 @@ class ElasticTrainer:
     def eval_loss(self, batch) -> float:
         batch = jax.device_put(batch, self._batch_sharding)
         return float(self._eval_fn(self.state.params, batch))
+
+    # -- constant-effective-batch accumulation -----------------------------
+
+    def _batch_width(self) -> int:
+        """How many micro-batches one dp-packed round absorbs: the
+        product of the mesh's batch axes (the same dp+fsdp convention
+        dp_sharding shards over)."""
+        return (self.mesh.shape.get("dp", 1)
+                * self.mesh.shape.get("fsdp", 1))
+
+    def _accum_fns(self) -> dict:
+        """Lazily compile the accumulation functions for the LIVE
+        bundle (cached on it, so oscillating layouts reuse their
+        executables): a micro/round gradient fn and the single-update
+        apply fn.  Built on first use — trainers that never accumulate
+        never pay the compiles."""
+        bundle = self._bundle
+        if bundle.accum is not None:
+            return bundle.accum
+        import jax.numpy as jnp
+
+        from edl_tpu.parallel.mesh import replicated as _replicated
+
+        loss_fn = self.loss_fn
+        optimizer = self.optimizer
+        param_sh = bundle.param_shardings
+        opt_sh = bundle.opt_shardings
+        repl = _replicated(bundle.mesh)
+        fns: dict = {"repl_sharding": repl}
+        if self.rng_in_loss:
+            fns["grad_repl"] = jax.jit(
+                jax.value_and_grad(lambda p, b, k: loss_fn(p, b, k)),
+                in_shardings=(param_sh, repl, None),
+                out_shardings=(None, param_sh))
+        else:
+            grad = jax.value_and_grad(loss_fn)
+            fns["grad_repl"] = jax.jit(
+                grad, in_shardings=(param_sh, repl),
+                out_shardings=(None, param_sh))
+            fns["grad_dp"] = jax.jit(
+                grad, in_shardings=(param_sh, bundle.batch_sharding),
+                out_shardings=(None, param_sh))
+
+        def apply(params, opt_state, gsum, scale):
+            grads = jax.tree.map(lambda g: g * scale, gsum)
+            updates, new_opt = optimizer.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), new_opt
+
+        fns["apply"] = jax.jit(
+            apply, in_shardings=(param_sh, opt_sh, param_sh, None),
+            out_shardings=(param_sh, opt_sh), donate_argnums=(0, 1))
+        bundle.accum = fns
+        return fns
+
+    def step_accumulate(self, micro_batches: Sequence,
+                        rng_keys: Optional[Sequence] = None,
+                        abort_after: Optional[int] = None) -> float:
+        """One CONSTANT-effective-batch step: gradients of the V
+        micro-batches (one per virtual worker, in VW order) are
+        accumulated and applied as a single optimizer update, so the
+        update — and therefore the loss trajectory — matches the
+        never-resized run's at any world size.
+
+        Execution by ``accum_mode``:
+
+        * ``"dp"`` — micro-batches are packed into rounds of mesh
+          batch-width (each physical worker slot computes its owned
+          VW's micro-batch data-parallel), ``ceil(V/N)`` rounds per
+          step; requires the width to divide V (the
+          ``VirtualConfig.snap_world`` contract).  Equivalence across
+          world sizes is float-bounded: the all-reduce regroups with N.
+        * ``"replicated"`` — micro-batches run one at a time with the
+          batch replicated; no cross-device gradient reduction exists,
+          so the accumulated update is bitwise identical at any world
+          size (CPU, pure-dp param sharding) — the mode the bitwise
+          acceptance leg runs.
+
+        The mean of the micro losses is returned (== the full-batch
+        loss for mean-reduction loss_fns).  ``abort_after=k`` is the
+        kill-mid-accumulation chaos seam: raises
+        :class:`AccumulationAborted` after ``k`` micro-batches, BEFORE
+        the apply — state is untouched, so crash recovery is a plain
+        restore-and-replay of the step.
+
+        ``rng_keys`` (one per VW) are required for ``rng_in_loss``
+        trainers (dropout and friends draw from the per-VW lineage);
+        they force the replicated path — a packed round would smear one
+        key over many VWs."""
+        import jax.numpy as jnp
+
+        V = len(micro_batches)
+        if V == 0:
+            raise ValueError("step_accumulate needs at least 1 micro-batch")
+        if self.rng_in_loss and (rng_keys is None or len(rng_keys) != V):
+            raise ValueError("rng_in_loss trainer needs one rng key per "
+                             "micro-batch")
+        fns = self._accum_fns()
+        width = self._batch_width()
+        use_dp = (self.accum_mode == "dp" and not self.rng_in_loss
+                  and width > 1 and V % width == 0)
+        gsum = None
+        lsum = 0.0
+        done = 0
+
+        def accumulate(loss, grads):
+            nonlocal gsum, lsum
+            gsum = grads if gsum is None else jax.tree.map(jnp.add,
+                                                           gsum, grads)
+            lsum += float(loss)
+
+        def maybe_abort():
+            if abort_after is not None and done >= abort_after:
+                raise AccumulationAborted(
+                    f"injected kill after {done}/{V} micro-batches "
+                    f"at step {self.state.step}")
+
+        if use_dp:
+            rounds = V // width
+            for r in range(rounds):
+                chunk = micro_batches[r * width:(r + 1) * width]
+                round_batch = jax.tree.map(
+                    lambda *xs: np.concatenate(xs, axis=0), *chunk)
+                round_batch = jax.device_put(round_batch,
+                                             self._batch_sharding)
+                accumulate(*fns["grad_dp"](self.state.params, round_batch))
+                done += width
+                maybe_abort()
+            scale = 1.0 / rounds
+        else:
+            for v, mb in enumerate(micro_batches):
+                b = jax.device_put(mb, fns["repl_sharding"])
+                if self.rng_in_loss:
+                    loss, grads = fns["grad_repl"](self.state.params, b,
+                                                   rng_keys[v])
+                else:
+                    loss, grads = fns["grad_repl"](self.state.params, b)
+                accumulate(loss, grads)
+                done += 1
+                maybe_abort()
+            scale = 1.0 / V
+        self.state.params, self.state.opt_state = fns["apply"](
+            self.state.params, self.state.opt_state, gsum,
+            np.float32(scale))
+        self.state.step += 1
+        return lsum * scale
 
     # -- internals ---------------------------------------------------------
 
@@ -569,7 +755,8 @@ class ElasticTrainer:
         leaves the compile-on-first-call jit fallback.  Idempotent per
         batch shape; a rare concurrent double-compile is harmless."""
         batch_abstract, batch_spec = self._batch_abstract, self._batch_spec
-        if batch_abstract is None or bundle.batch_spec == batch_spec:
+        if (batch_abstract is None or bundle.batch_spec == batch_spec
+                or self.rng_in_loss):  # keyless step_fn is never called
             return
         try:
             abstract = lambda t: jax.tree.map(  # noqa: E731
@@ -655,6 +842,7 @@ class ElasticTrainer:
         """The commit point: after this the trainer is entirely on the
         new world.  Pure assignments — nothing here can fail halfway."""
         self.mesh = bundle.mesh
+        self._bundle = bundle
         self._param_shardings = bundle.param_shardings
         self._opt_shardings = bundle.opt_shardings
         self._batch_sharding = bundle.batch_sharding

@@ -883,6 +883,26 @@ def _world_child(plan: WorldPlan, cfg: WorkerConfig, result_path: str,
     world = WorldHandle(epoch=plan.epoch, rank=plan.rank,
                         world_size=plan.world_size,
                         coordinator=plan.coordinator, members=plan.members)
+    # Accuracy-consistent elasticity (runtime.virtual): when the job
+    # runs V fixed virtual workers (EDL_MH_VWS), the leader of every
+    # formed world republishes the VW→member ownership map to
+    # coordinator KV — the remap-on-epoch-bump half of deterministic
+    # data ownership, counted (vw_remaps) and HA-replicated.
+    # Best-effort: determinism bookkeeping must never abort a world.
+    if world.is_leader:
+        try:
+            vws = int(os.environ.get("EDL_MH_VWS", "0") or 0)
+            if vws > 0:
+                from edl_tpu.runtime.virtual import OwnershipMap
+
+                # keyed by job (EDL_MH_JOB): two jobs sharing one
+                # coordinator must not overwrite each other's map
+                OwnershipMap.publish_for(
+                    cfg.coord, vws, plan.members,
+                    job=os.environ.get("EDL_MH_JOB", "job"))
+        except Exception as exc:
+            print(f"[{cfg.name}] vw-map publish failed (non-fatal): "
+                  f"{str(exc)[:120]}", file=sys.stderr, flush=True)
     try:
         # Backend creation in a multi-process world is itself a collective
         # (every process exchanges device topology through the coordination

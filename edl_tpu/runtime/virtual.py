@@ -1,0 +1,652 @@
+"""Virtual workers: training semantics that are world-size-invariant.
+
+The elastic stack *survives* any membership event (fault campaigns,
+stall escalation, transactional resize, coordinator failover), but until
+now a resize silently changed **what the model trains on**: shard leases
+landed on whichever worker grabbed them first, per-host RNG was keyed by
+the current world, and the effective global batch drifted with the pod
+count.  Multi-tenant users cannot hand a job to an autoscaler that
+corrupts run-to-run comparability.
+
+This module decouples the job's training semantics from its current
+size, the EasyScale framing (arxiv 2208.14228): fix **V virtual
+workers** at job submission and make every source of nondeterminism a
+function of the *job*, never of the physical world:
+
+* **Deterministic data ownership** — VW ``v`` owns shards
+  ``v, v+V, v+2V, …`` of the deterministic shard stream
+  (:func:`edl_tpu.runtime.data._row_splits` pins the stream itself);
+  its row stream is those shards' rows concatenated in registration
+  order.  Physical workers are assigned whole VWs by
+  :class:`OwnershipMap` — remapped on every membership epoch, counted
+  (``vw_remaps``) and published to coordinator KV so the map rides HA
+  replication.  No lease racing: batch content at global step ``s`` is
+  a pure function of ``(dataset, V, s)``.
+* **Consumed-offset cursors** — :class:`VirtualBatches` tracks one
+  row-offset per VW, checkpointable mid-shard
+  (:class:`CursorStore` / checkpoint ``meta``), so a resize or crash
+  resumes the stream **exactly-once**: no row trained twice, none
+  dropped.
+* **Splittable RNG lineage** — per-VW keys are *derived*, never
+  carried: ``fold_in(fold_in(key(job_seed), vw_id), step)``.  Because
+  the lineage is a pure function of job-level identifiers, "splitting
+  and merging with the mesh" at a resize is a no-op — any physical
+  layout derives identical draws for VW ``v`` at step ``s``.
+* **Constant effective batch** — :class:`VirtualWorkerLoop` drives
+  :meth:`ElasticTrainer.step_accumulate`: the V micro-batches of a step
+  are accumulated in fixed VW order and applied as ONE optimizer
+  update, so the update equals the never-resized run's (bitwise in
+  replicated accumulation mode on CPU; float-bounded in the dp-packed
+  perf mode — see doc/accuracy_elasticity.md for the tolerance policy).
+
+The acceptance proof lives in ``tests/test_accuracy_elasticity.py`` and
+the ``determinism`` bench leg: a run resized 4→2→8 mid-training matches
+the unresized control's loss trajectory, including under an injected
+kill-mid-accumulation and a coordinator failover.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional, Sequence
+
+import numpy as np
+
+from edl_tpu.observability.collector import get_counters
+from edl_tpu.observability.logging import get_logger
+from edl_tpu.observability.tracing import get_tracer
+
+log = get_logger("runtime.virtual")
+
+#: coordinator KV keys (prefix + job name).  Both ride the HA
+#: replication stream like any other KV write, so a promoted standby
+#: serves the identical map/cursors after a primary kill.
+VW_MAP_KEY = "vw-map/{job}"
+VW_CURSOR_KEY = "vw-cursor/{job}"
+
+#: loss-trajectory tolerance policy (doc/accuracy_elasticity.md): the
+#: dp-packed accumulation mode reorders floating-point reductions with
+#: the world size, so "identical" means within this envelope; the
+#: replicated mode is held to bitwise on CPU by the tests themselves.
+DEFAULT_LOSS_ATOL = 5e-3
+DEFAULT_LOSS_RTOL = 1e-3
+
+
+# -- RNG lineage -------------------------------------------------------------
+
+
+def vw_key(job_seed: int, vw_id: int, step: int):
+    """The per-(virtual worker, step) RNG key: a pure fold of job-level
+    identifiers, so every physical layout derives the identical key.
+
+    This IS the "split/merge with the mesh" story: there is no carried
+    RNG state to split — a resize changes which physical worker derives
+    VW ``v``'s key, never the key itself.  Dropout / data-augmentation
+    draws keyed this way are invisible to the loss curve across any
+    resize."""
+    import jax
+
+    key = jax.random.key(int(job_seed))
+    key = jax.random.fold_in(key, int(vw_id))
+    return jax.random.fold_in(key, int(step))
+
+
+def vw_keys(job_seed: int, vw_count: int, step: int) -> list:
+    """All V keys for one global step, in VW order."""
+    return [vw_key(job_seed, v, step) for v in range(vw_count)]
+
+
+# -- job-level configuration -------------------------------------------------
+
+
+@dataclass(frozen=True)
+class VirtualConfig:
+    """Everything fixed at job submission that training semantics may
+    depend on.  Nothing here may change at a resize."""
+
+    #: V — the virtual world size.  Choose it as the largest world the
+    #: autoscaler may ever grant (or an LCM-friendly multiple); any
+    #: physical world must divide it for the dp-packed accumulation
+    #: path, and :meth:`snap_world` snaps arbitrary pod counts down.
+    vw_count: int
+    #: B — the effective global batch, constant through every resize.
+    global_batch: int
+    job_seed: int = 0
+
+    def __post_init__(self) -> None:
+        if self.vw_count < 1:
+            raise ValueError(f"vw_count must be >= 1, got {self.vw_count}")
+        if self.global_batch % self.vw_count != 0:
+            raise ValueError(
+                f"global_batch {self.global_batch} must divide evenly "
+                f"into vw_count {self.vw_count} micro-batches")
+
+    @property
+    def micro_batch(self) -> int:
+        """Rows per VW micro-step: B / V."""
+        return self.global_batch // self.vw_count
+
+    def snap_world(self, n: int) -> int:
+        """Largest world size <= n that divides V (>= 1).  The virtual
+        layer's analogue of the batch-divisor snap: a physical world
+        must run whole VWs, ceil(V/N) each, with N | V so every step's
+        accumulation covers exactly the V micro-batches."""
+        n = max(int(n), 1)
+        while n > 1 and self.vw_count % n != 0:
+            n -= 1
+        return n
+
+
+# -- deterministic ownership -------------------------------------------------
+
+
+def assign_ownership(vw_count: int, workers: Sequence[str]) -> dict[int, str]:
+    """VW id → physical worker, deterministically: workers are taken in
+    sorted-name order (the same stable rank order the multihost world
+    uses) and VW ``v`` lands on worker ``v mod N`` — each physical
+    worker runs ceil(V/N) VW micro-steps per global step."""
+    ws = sorted(dict.fromkeys(workers))
+    if not ws:
+        raise ValueError("ownership needs at least one worker")
+    return {v: ws[v % len(ws)] for v in range(vw_count)}
+
+
+class OwnershipMap:
+    """The live VW→worker assignment, remapped on every membership
+    change and published to coordinator KV (rides HA replication).
+
+    Replaces first-come lease racing: which worker *executes* VW ``v``
+    is policy (this map); *what* VW ``v`` trains on is fixed by the
+    schedule — so a remap moves work, never data order."""
+
+    def __init__(self, vw_count: int, workers: Sequence[str]) -> None:
+        self.vw_count = int(vw_count)
+        self.mapping = assign_ownership(self.vw_count, workers)
+        self.remaps = 0
+
+    def remap(self, workers: Sequence[str]) -> int:
+        """Re-assign for a new worker set; returns how many VWs moved
+        (and counts them into ``vw_remaps``)."""
+        new = assign_ownership(self.vw_count, workers)
+        moved = sum(1 for v in new if new[v] != self.mapping.get(v))
+        if moved:
+            get_counters().inc("vw_remaps", moved)
+            get_tracer().instant("vw_remapped", category="elastic",
+                                 moved=moved, workers=len(set(workers)),
+                                 vw_count=self.vw_count)
+            self.remaps += 1
+        self.mapping = new
+        return moved
+
+    def owned_by(self, worker: str) -> list[int]:
+        return [v for v, w in self.mapping.items() if w == worker]
+
+    # -- KV round-trip (HA-replicated) ----------------------------------
+
+    def to_json(self) -> bytes:
+        return json.dumps({"vw_count": self.vw_count,
+                           "mapping": {str(v): w for v, w in
+                                       sorted(self.mapping.items())}},
+                          sort_keys=True).encode()
+
+    def publish(self, kv, job: str = "job") -> None:
+        kv.kv_set(VW_MAP_KEY.format(job=job), self.to_json())
+
+    @classmethod
+    def load(cls, kv, job: str = "job") -> Optional["OwnershipMap"]:
+        raw = kv.kv_get(VW_MAP_KEY.format(job=job))
+        if raw is None:
+            return None
+        try:
+            doc = json.loads(raw.decode())
+            m = cls.__new__(cls)
+            m.vw_count = int(doc["vw_count"])
+            m.mapping = {int(v): w for v, w in doc["mapping"].items()}
+            m.remaps = 0
+            return m
+        except (ValueError, KeyError, TypeError) as exc:
+            log.warn("torn vw-map in KV; ignoring", error=str(exc)[:120])
+            return None
+
+    @classmethod
+    def publish_for(cls, kv, vw_count: int, workers: Sequence[str],
+                    job: str = "job") -> "OwnershipMap":
+        """One-shot leader-side publication (the multihost world child's
+        hook): load the previous map, remap onto ``workers`` so the
+        moved-VW delta is counted, publish, return the new map."""
+        prev = cls.load(kv, job)
+        if prev is not None and prev.vw_count == int(vw_count):
+            prev.remap(workers)
+            prev.publish(kv, job)
+            return prev
+        m = cls(vw_count, workers)
+        m.publish(kv, job)
+        return m
+
+
+# -- deterministic shard schedule + cursors ----------------------------------
+
+
+class VirtualShardSchedule:
+    """VW ``v`` owns shards ``v, v+V, …`` (by position in the
+    deterministic shard list); its row stream is those shards' rows in
+    order.  Pure geometry — resolves (vw, stream offset) to concrete
+    (shard position, row) pairs, including mid-shard."""
+
+    def __init__(self, vw_count: int, shard_sizes: Sequence[int]) -> None:
+        self.vw_count = int(vw_count)
+        self.shard_sizes = [int(s) for s in shard_sizes]
+        #: global row id base per shard (row identity for the
+        #: exactly-once accounting)
+        self.shard_base = np.concatenate(
+            ([0], np.cumsum(self.shard_sizes)))[:-1]
+        self._owned = {v: list(range(v, len(self.shard_sizes),
+                                     self.vw_count))
+                       for v in range(self.vw_count)}
+
+    def owned_shards(self, vw: int) -> list[int]:
+        return self._owned[vw]
+
+    def stream_len(self, vw: int) -> int:
+        return sum(self.shard_sizes[s] for s in self._owned[vw])
+
+    def rows(self, vw: int, lo: int, hi: int) -> list[tuple[int, int, int]]:
+        """Stream slice [lo, hi) of VW ``vw`` as
+        ``(shard_index, row_in_shard, global_row_id)`` triples — the
+        resolver a mid-shard cursor resumes through."""
+        out: list[tuple[int, int, int]] = []
+        off = 0
+        for s in self._owned[vw]:
+            n = self.shard_sizes[s]
+            a, b = max(lo - off, 0), min(hi - off, n)
+            for r in range(a, b):
+                out.append((s, r, int(self.shard_base[s]) + r))
+            off += n
+            if off >= hi:
+                break
+        if len(out) != hi - lo:
+            raise IndexError(
+                f"vw {vw} stream slice [{lo},{hi}) exceeds stream "
+                f"length {self.stream_len(vw)}")
+        return out
+
+
+class CursorStore:
+    """Per-job consumed-offset cursors in coordinator KV.  Every write
+    rides the HA replication stream, so a promoted standby serves the
+    identical cursors after a primary kill — the coordinator-failover
+    half of the exactly-once guarantee."""
+
+    def __init__(self, kv, job: str = "job") -> None:
+        self._kv = kv
+        self._key = VW_CURSOR_KEY.format(job=job)
+
+    def save(self, state: dict) -> None:
+        self._kv.kv_set(self._key, json.dumps(state, sort_keys=True).encode())
+
+    def load(self) -> Optional[dict]:
+        raw = self._kv.kv_get(self._key)
+        if raw is None:
+            return None
+        try:
+            return json.loads(raw.decode())
+        except ValueError as exc:
+            # torn cursor blob: callers fall back to the pure
+            # derive-from-step cursors (VirtualBatches.cursors_for_step)
+            log.warn("torn vw-cursor blob in KV; deriving from step",
+                     error=str(exc)[:120])
+            get_counters().inc("vw_cursor_torn")
+            return None
+
+
+class VirtualBatches:
+    """The deterministic micro-batch stream: step ``s`` yields V
+    micro-batches (one per VW, in VW order) whose content is a pure
+    function of (dataset, V, s) — never of the physical world.
+
+    Stateful only through the per-VW consumed-offset cursors, which are
+    checkpointable (:meth:`state` / :meth:`restore`) at micro-step
+    granularity, including mid-shard — the exactly-once resume point a
+    resize or crash recovers through.
+    """
+
+    def __init__(self, cfg: VirtualConfig, shard_ids: Sequence[int],
+                 fetch_shard: Callable[[int], tuple[np.ndarray, ...]],
+                 passes: int = 1) -> None:
+        self.cfg = cfg
+        self.shard_ids = list(shard_ids)
+        self.fetch_shard = fetch_shard
+        self.passes = int(passes)
+        sizes = [int(fetch_shard(sid)[0].shape[0]) for sid in self.shard_ids]
+        self.schedule = VirtualShardSchedule(cfg.vw_count, sizes)
+        #: steps per pass: bounded by the *shortest* VW stream (trailing
+        #: rows that cannot fill a full micro-batch on every VW are
+        #: dropped deterministically — identically at any world size —
+        #: and accounted separately from lost rows)
+        m = cfg.micro_batch
+        self.steps_per_pass = min(
+            self.schedule.stream_len(v) // m for v in range(cfg.vw_count))
+        if self.steps_per_pass == 0:
+            # a VW with no full micro-batch would make the whole stream
+            # yield zero steps SILENTLY (and poison cursors_for_step
+            # with a division by zero) — reject at construction: either
+            # the dataset is too small for V or the shard count starves
+            # some VW (fewer shards than virtual workers)
+            starved = [v for v in range(cfg.vw_count)
+                       if self.schedule.stream_len(v) < m]
+            raise ValueError(
+                f"virtual workers {starved} own fewer than one "
+                f"micro-batch ({m} rows) of the shard stream "
+                f"({len(self.shard_ids)} shards, sizes {sizes[:8]}…) — "
+                f"lower vw_count or publish more/larger shards")
+        self.rows_dropped_remainder = sum(
+            self.schedule.stream_len(v) - self.steps_per_pass * m
+            for v in range(cfg.vw_count)) * self.passes
+        self.step = 0
+        self.cursors = {v: 0 for v in range(cfg.vw_count)}
+        self.pass_no = 0
+        #: global row ids of the most recent step's micro-batches, per
+        #: VW — the loop commits them to its exactly-once ledger only
+        #: after the optimizer update applied
+        self.last_step_rows: list[np.ndarray] = []
+        self._cache: dict[int, tuple[np.ndarray, ...]] = {}
+
+    # -- cursors ---------------------------------------------------------
+
+    def state(self) -> dict:
+        """Checkpointable cursor state (JSON-safe)."""
+        return {"version": 1, "step": self.step, "pass": self.pass_no,
+                "cursors": {str(v): int(off)
+                            for v, off in self.cursors.items()}}
+
+    def restore(self, state: dict) -> None:
+        self.step = int(state["step"])
+        self.pass_no = int(state["pass"])
+        self.cursors = {int(v): int(off)
+                        for v, off in state["cursors"].items()}
+
+    def cursors_for_step(self, step: int) -> dict:
+        """Pure fallback when the persisted cursor blob is torn: in the
+        aligned schedule (all VWs advance m rows per step) the cursors
+        are derivable from the step count alone."""
+        m = self.cfg.micro_batch
+        within = int(step) % self.steps_per_pass
+        return {"version": 1, "step": int(step),
+                "pass": int(step) // self.steps_per_pass,
+                "cursors": {str(v): within * m
+                            for v in range(self.cfg.vw_count)}}
+
+    # -- the stream ------------------------------------------------------
+
+    def _fetch(self, shard_pos: int) -> tuple[np.ndarray, ...]:
+        sid = self.shard_ids[shard_pos]
+        arrays = self._cache.get(sid)
+        if arrays is None:
+            arrays = self.fetch_shard(sid)
+            # bounded shard cache: one resident shard per VW plus slack
+            # for micro-batches straddling a boundary
+            if len(self._cache) > self.cfg.vw_count + 2:
+                self._cache.pop(next(iter(self._cache)))
+            self._cache[sid] = arrays
+        return arrays
+
+    def next_step(self) -> Optional[list[tuple[np.ndarray, ...]]]:
+        """The next global step's V micro-batches (VW order), or None
+        when every pass is exhausted.  Advances the cursors."""
+        if self.pass_no >= self.passes:
+            return None
+        m = self.cfg.micro_batch
+        within = self.step - self.pass_no * self.steps_per_pass
+        if within >= self.steps_per_pass:
+            # pass boundary: drop each VW's remainder (deterministic),
+            # rewind the streams for the next pass
+            self.pass_no += 1
+            self.cursors = {v: 0 for v in self.cursors}
+            if self.pass_no >= self.passes:
+                return None
+        micro: list[tuple[np.ndarray, ...]] = []
+        rows_per_vw: list[np.ndarray] = []
+        for v in range(self.cfg.vw_count):
+            lo = self.cursors[v]
+            triples = self.schedule.rows(v, lo, lo + m)
+            per_leaf: Optional[list[list[np.ndarray]]] = None
+            ids = np.empty((m,), np.int64)
+            for i, (shard_pos, row, gid) in enumerate(triples):
+                arrays = self._fetch(shard_pos)
+                if per_leaf is None:
+                    per_leaf = [[] for _ in arrays]
+                for j, a in enumerate(arrays):
+                    per_leaf[j].append(a[row])
+                ids[i] = gid
+            micro.append(tuple(np.stack(col) for col in per_leaf))
+            rows_per_vw.append(ids)
+            self.cursors[v] = lo + m
+        self.step += 1
+        self.last_step_rows = rows_per_vw
+        return micro
+
+    @property
+    def total_steps(self) -> int:
+        return self.steps_per_pass * self.passes
+
+
+# -- the reference loop + equivalence helpers --------------------------------
+
+
+@dataclass
+class VirtualRunReport:
+    losses: list[float] = field(default_factory=list)
+    world_sizes: list[int] = field(default_factory=list)
+    resizes: int = 0
+    vw_moves: int = 0
+    #: exactly-once ledger: global row id → times an APPLIED update
+    #: trained on it (rows consumed by an aborted accumulation are
+    #: re-fetched on restore and must appear exactly once here)
+    rows_trained: dict[int, int] = field(default_factory=dict)
+
+    def rows_duplicated(self) -> int:
+        return sum(c - 1 for c in self.rows_trained.values() if c > 1)
+
+    def rows_missing(self, expected: int) -> int:
+        return expected - len(self.rows_trained)
+
+
+class VirtualWorkerLoop:
+    """Single-controller reference loop over the virtual-worker layer:
+    the loop the equivalence harness, the CI determinism smoke, and the
+    bench ``determinism`` leg all drive.
+
+    Per global step: snap the desired world to a divisor of V, apply
+    the resize at the step boundary (remapping + publishing the
+    ownership map), assemble the V micro-batches, derive the per-VW RNG
+    keys, and run ONE accumulated optimizer update.  Checkpoints at a
+    cadence carry the cursor + RNG meta so a crash resumes exactly-once.
+    """
+
+    def __init__(self, trainer, cfg: VirtualConfig,
+                 batches: VirtualBatches,
+                 kv=None, job: str = "job",
+                 checkpointer=None, ckpt_every: int = 0,
+                 augment: Optional[Callable[[tuple, Any], tuple]] = None,
+                 report: Optional[VirtualRunReport] = None) -> None:
+        self.trainer = trainer
+        self.cfg = cfg
+        self.batches = batches
+        self.kv = kv
+        self.job = job
+        self.checkpointer = checkpointer
+        self.ckpt_every = int(ckpt_every)
+        #: host-side deterministic augmentation: (micro_batch, key) →
+        #: micro_batch.  Draws keyed by the VW lineage, so augmentation
+        #: is identical at any world size.
+        self.augment = augment
+        self.report = report or VirtualRunReport()
+        self.ownership: Optional[OwnershipMap] = None
+        self.cursors = CursorStore(kv, job) if kv is not None else None
+        try:
+            self.trainer.state.job_seed = cfg.job_seed
+        except AttributeError:
+            pass
+
+    # -- checkpoint/restore ---------------------------------------------
+
+    def _meta(self) -> dict:
+        return {"cursor": self.batches.state(),
+                "rng": {"job_seed": self.cfg.job_seed,
+                        "vw_count": self.cfg.vw_count},
+                "global_batch": self.cfg.global_batch}
+
+    def restore_latest(self) -> Optional[int]:
+        """Restore trainer state + cursors from the newest verified
+        checkpoint (plus KV cursors when available).  Returns the
+        restored step or None.  A torn/missing cursor meta falls back
+        to the pure derive-from-step cursors — the torn-cursor path."""
+        if self.checkpointer is None:
+            return None
+        step = self.checkpointer.latest_verified_step()
+        if step is None:
+            return None
+        tree = {"params": self.trainer.state.params,
+                "opt": self.trainer.state.opt_state}
+        restored = self.checkpointer.restore(tree, step=step)
+        self.trainer.state.params = restored["params"]
+        self.trainer.state.opt_state = restored["opt"]
+        self.trainer.state.step = step
+        meta = self.checkpointer.load_meta(step)
+        if meta is not None:
+            # the sidecar persists the INVARIANTS precisely so a restart
+            # under a drifted config cannot silently resume cursors from
+            # a different schedule (other V ⇒ other ownership ⇒ rows
+            # duplicated/skipped) — mismatch is a configuration error,
+            # not a recoverable fallback
+            rng = meta.get("rng") or {}
+            expect = {"vw_count": self.cfg.vw_count,
+                      "job_seed": self.cfg.job_seed,
+                      "global_batch": self.cfg.global_batch}
+            got = {"vw_count": rng.get("vw_count"),
+                   "job_seed": rng.get("job_seed"),
+                   "global_batch": meta.get("global_batch")}
+            drift = {k: (got[k], expect[k]) for k in expect
+                     if got[k] is not None and got[k] != expect[k]}
+            if drift:
+                raise ValueError(
+                    f"checkpoint step {step} was written under a "
+                    f"different virtual-worker config: {drift} "
+                    "(got, want) — resuming would break exactly-once "
+                    "and the RNG lineage; restore with the original "
+                    "VirtualConfig")
+        cursor = (meta or {}).get("cursor")
+        if cursor is None and self.cursors is not None:
+            kv_state = self.cursors.load()
+            if kv_state is not None and int(kv_state.get("step", -1)) == step:
+                cursor = kv_state
+        if cursor is None:
+            cursor = self.batches.cursors_for_step(step)
+            log.warn("cursor meta missing/torn; derived from step",
+                     step=step)
+        self.batches.restore(cursor)
+        return step
+
+    # -- the loop --------------------------------------------------------
+
+    def _apply_world(self, n: int) -> None:
+        n = self.cfg.snap_world(n)
+        workers = [f"pw{i}" for i in range(n)]
+        if self.ownership is None:
+            self.ownership = OwnershipMap(self.cfg.vw_count, workers)
+            if self.kv is not None:
+                self.ownership.publish(self.kv, self.job)
+        if not self.trainer.matches(n):
+            if self.trainer.resize(n):
+                self.report.resizes += 1
+                moved = self.ownership.remap(workers)
+                self.report.vw_moves += moved
+                if self.kv is not None:
+                    self.ownership.publish(self.kv, self.job)
+
+    def run(self, max_steps: Optional[int] = None,
+            world_size_for: Optional[Callable[[int], int]] = None,
+            on_step: Optional[Callable[[int, float, int], None]] = None
+            ) -> VirtualRunReport:
+        while True:
+            step = self.batches.step
+            if max_steps is not None and len(self.report.losses) >= max_steps:
+                break
+            if world_size_for is not None:
+                self._apply_world(world_size_for(step))
+            elif self.ownership is None:
+                self._apply_world(self.trainer.world_size)
+            micro = self.batches.next_step()
+            if micro is None:
+                break
+            # derive the per-VW keys only when something consumes them —
+            # key folds are host-side jax dispatches in the hot loop
+            keys = None
+            if self.augment is not None or self.trainer.rng_in_loss:
+                keys = vw_keys(self.cfg.job_seed, self.cfg.vw_count,
+                               self.batches.step - 1)
+            if self.augment is not None:
+                micro = [self.augment(mb, k) for mb, k in zip(micro, keys)]
+            loss = self.trainer.step_accumulate(
+                micro, rng_keys=keys if self.trainer.rng_in_loss else None)
+            # the update APPLIED: commit this step's rows to the
+            # exactly-once ledger and persist the cursors (KV write
+            # rides HA replication)
+            for ids in self.batches.last_step_rows:
+                for gid in ids.tolist():
+                    self.report.rows_trained[gid] = (
+                        self.report.rows_trained.get(gid, 0) + 1)
+            if self.cursors is not None:
+                self.cursors.save(self.batches.state())
+            self.report.losses.append(float(loss))
+            self.report.world_sizes.append(self.trainer.world_size)
+            if (self.checkpointer is not None and self.ckpt_every
+                    and self.batches.step % self.ckpt_every == 0):
+                self.checkpointer.save(
+                    self.batches.step,
+                    {"params": self.trainer.state.params,
+                     "opt": self.trainer.state.opt_state},
+                    meta=self._meta())
+            if on_step is not None:
+                on_step(self.batches.step, float(loss),
+                        self.trainer.world_size)
+        return self.report
+
+
+# -- divergence accounting ---------------------------------------------------
+
+
+def loss_divergence(control: Sequence[float],
+                    resized: Sequence[float]) -> dict:
+    """Compare two loss trajectories; records the divergence gauge
+    (``edl_determinism_loss_divergence``) the observability plane
+    scrapes and the bench/CI assert on."""
+    n = min(len(control), len(resized))
+    diffs = [abs(control[i] - resized[i]) for i in range(n)]
+    max_div = max(diffs) if diffs else float("nan")
+    final_delta = (abs(control[n - 1] - resized[n - 1]) if n
+                   else float("nan"))
+    from edl_tpu.observability.metrics import get_registry
+
+    get_registry().gauge(
+        "determinism_loss_divergence",
+        help="max |loss_resized - loss_control| over the compared "
+             "trajectory").set(max_div if diffs else 0.0)
+    return {"steps_compared": n,
+            "max_loss_divergence": max_div,
+            "final_loss_delta": final_delta,
+            "bitwise": bool(diffs) and max_div == 0.0}
+
+
+def trajectories_equivalent(control: Sequence[float],
+                            resized: Sequence[float],
+                            atol: float = DEFAULT_LOSS_ATOL,
+                            rtol: float = DEFAULT_LOSS_RTOL) -> bool:
+    """The documented tolerance policy: pointwise
+    ``|a-b| <= atol + rtol*|a|`` over the common prefix, which must be
+    non-empty and cover both trajectories."""
+    if len(control) != len(resized) or not control:
+        return False
+    return all(abs(a - b) <= atol + rtol * abs(a)
+               for a, b in zip(control, resized))

@@ -472,4 +472,56 @@ if JAX_PLATFORMS=cpu EDL_DRYRUN_INJECT=replicate \
 fi
 echo "dryrun sharding checks OK (n=2,4,8 + injected-regression control)"
 
+echo "== determinism smoke (scripted 2→1→2 resize vs unresized control)"
+# Accuracy-consistent elasticity tripwire: the SAME seeded job run with
+# a scripted 2→1→2 resize must match the unresized control's loss
+# trajectory within the documented policy (bitwise here: replicated
+# accumulation on CPU), with every row trained exactly once and the
+# virtual-worker remaps actually counted — a regression that lets a
+# resize touch data order, RNG lineage, or the effective batch fails
+# here, not in a user's A/B run.
+JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+python - <<'EOF'
+import jax, numpy as np, optax
+
+from edl_tpu.coord import local_service
+from edl_tpu.models import mlp
+from edl_tpu.observability.collector import get_counters
+from edl_tpu.parallel.mesh import MeshSpec
+from edl_tpu.runtime.data import ShardRegistry
+from edl_tpu.runtime.elastic import ElasticTrainer
+from edl_tpu.runtime.virtual import (VirtualBatches, VirtualConfig,
+                                     VirtualWorkerLoop, loss_divergence,
+                                     trajectories_equivalent)
+
+rng = np.random.default_rng(1)
+y = rng.integers(0, 4, 1024).astype(np.int32)
+x = rng.normal(size=(1024, 16)).astype(np.float32)
+reg = ShardRegistry()
+ids = reg.register_arrays((x, y), num_shards=8)
+cfg = VirtualConfig(vw_count=4, global_batch=32, job_seed=5)
+
+def run(schedule):
+    params = mlp.init(jax.random.key(0), [16, 32, 4])
+    tr = ElasticTrainer(mlp.loss_fn, params, optax.adam(1e-2),
+                        spec=MeshSpec(dp=-1), initial_world_size=2,
+                        accum_mode="replicated")
+    loop = VirtualWorkerLoop(tr, cfg, VirtualBatches(cfg, ids, reg.get),
+                             kv=local_service(), job="ci-det")
+    return loop.run(max_steps=18, world_size_for=schedule)
+
+c0 = get_counters().get("vw_remaps")
+ctrl = run(lambda s: 2)
+res = run(lambda s: 2 if s < 6 else (1 if s < 12 else 2))
+div = loss_divergence(ctrl.losses, res.losses)
+assert trajectories_equivalent(ctrl.losses, res.losses), div
+assert div["bitwise"], div
+assert res.resizes == 2, res.resizes
+assert get_counters().get("vw_remaps") - c0 > 0, "remaps never counted"
+assert res.rows_duplicated() == 0
+assert res.rows_missing(expected=18 * cfg.global_batch) == 0
+print("determinism smoke OK:", div, "vw_remaps",
+      get_counters().get("vw_remaps") - c0)
+EOF
+
 echo "CI OK"

@@ -1,0 +1,674 @@
+"""Accuracy-consistent elasticity: the equivalence harness.
+
+The acceptance property of the virtual-worker layer
+(edl_tpu.runtime.virtual): a run whose world is resized mid-training
+produces a loss trajectory IDENTICAL to the never-resized control —
+bitwise on this CPU backend in replicated accumulation mode, within the
+documented tolerance in the dp-packed mode — with every data row
+trained exactly once, including under an injected kill-mid-accumulation,
+a detected stall, and a coordinator-primary kill with failover.
+
+Also home to the satellite regressions: the `_row_splits` determinism
+contract, the versioned checkpoint meta (cursors + RNG lineage) with
+its torn-cursor fallback, and exactly-once re-dispatch of a dead
+worker's unconsumed offsets across a resize.
+"""
+
+from __future__ import annotations
+
+import json
+import signal
+import time
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import optax  # noqa: E402
+
+from edl_tpu.coord import local_service  # noqa: E402
+from edl_tpu.models import mlp  # noqa: E402
+from edl_tpu.observability.collector import get_counters  # noqa: E402
+from edl_tpu.parallel.mesh import MeshSpec  # noqa: E402
+from edl_tpu.runtime.checkpoint import ElasticCheckpointer  # noqa: E402
+from edl_tpu.runtime.data import ShardRegistry, _row_splits, shard_sizes  # noqa: E402
+from edl_tpu.runtime.elastic import (  # noqa: E402
+    AccumulationAborted,
+    ElasticTrainer,
+)
+from edl_tpu.runtime.virtual import (  # noqa: E402
+    CursorStore,
+    OwnershipMap,
+    VirtualBatches,
+    VirtualConfig,
+    VirtualWorkerLoop,
+    assign_ownership,
+    loss_divergence,
+    trajectories_equivalent,
+    vw_key,
+    vw_keys,
+)
+
+SEED = 3
+N_ROWS = 2048
+N_SHARDS = 16
+CFG = VirtualConfig(vw_count=8, global_batch=64, job_seed=SEED)
+
+
+def _dataset(n=N_ROWS):
+    rng = np.random.default_rng(1)
+    y = rng.integers(0, 4, n).astype(np.int32)
+    x = rng.normal(size=(n, 16)).astype(np.float32)
+    return x, y
+
+
+def _registry(n=N_ROWS, shards=N_SHARDS):
+    reg = ShardRegistry()
+    ids = reg.register_arrays(_dataset(n), num_shards=shards)
+    return reg, ids
+
+
+def _trainer(world=4, accum_mode="replicated", loss=mlp.loss_fn, **kw):
+    params = mlp.init(jax.random.key(0), [16, 32, 4])
+    return ElasticTrainer(loss, params, optax.adam(1e-2),
+                          spec=MeshSpec(dp=-1), initial_world_size=world,
+                          accum_mode=accum_mode, **kw)
+
+
+def _loop(schedule, max_steps=20, cfg=CFG, kv=None, job="job",
+          ckpt=None, ckpt_every=0, augment=None, on_step=None, **trainer_kw):
+    reg, ids = _registry()
+    tr = _trainer(world=schedule(0) if schedule else 4, **trainer_kw)
+    vb = VirtualBatches(cfg, ids, reg.get, passes=2)
+    loop = VirtualWorkerLoop(tr, cfg, vb, kv=kv, job=job,
+                             checkpointer=ckpt, ckpt_every=ckpt_every,
+                             augment=augment)
+    report = loop.run(max_steps=max_steps, world_size_for=schedule,
+                      on_step=on_step)
+    return loop, report
+
+
+RESIZE_4_2_8 = lambda s: 4 if s < 7 else (2 if s < 14 else 8)  # noqa: E731
+CONTROL_4 = lambda s: 4  # noqa: E731
+
+
+# ---------------------------------------------------------------------------
+# satellite: the _row_splits determinism contract
+# ---------------------------------------------------------------------------
+
+class TestRowSplitsContract:
+    def test_sizes_match_pure_arithmetic(self):
+        for n, k in [(10, 3), (2048, 16), (7, 7), (100, 1), (5, 8)]:
+            arrays = (np.arange(n, dtype=np.float32),)
+            splits = _row_splits(arrays, k)
+            assert [len(s) for s in splits] == shard_sizes(n, k)
+
+    def test_order_preserving_contiguous_cover(self):
+        splits = _row_splits((np.arange(101, dtype=np.float32),), 7)
+        flat = np.concatenate(splits)
+        assert np.array_equal(flat, np.arange(101))
+
+    def test_registry_shard_map_invariant_to_world_size(self):
+        """Two registries built from the same arrays — by processes that
+        will run at DIFFERENT world sizes — must hold the identical
+        shard id → row map: world size appears nowhere in the split."""
+        data = _dataset(300)
+        maps = []
+        for _world_size in (2, 8):  # the split must not see this
+            reg = ShardRegistry()
+            ids = reg.register_arrays(data, num_shards=11)
+            maps.append({sid: tuple(reg.get(sid)[1].tolist())
+                         for sid in ids})
+        assert maps[0] == maps[1]
+
+
+# ---------------------------------------------------------------------------
+# RNG lineage
+# ---------------------------------------------------------------------------
+
+class TestRngLineage:
+    def test_key_is_pure_function_of_job_identifiers(self):
+        a = vw_key(SEED, 3, 17)
+        b = vw_key(SEED, 3, 17)
+        assert jax.random.key_data(a).tolist() == \
+            jax.random.key_data(b).tolist()
+
+    def test_keys_distinct_across_vw_and_step(self):
+        seen = set()
+        for v in range(4):
+            for s in range(4):
+                seen.add(tuple(jax.random.key_data(
+                    vw_key(SEED, v, s)).tolist()))
+        assert len(seen) == 16
+
+    def test_lineage_independent_of_physical_mapping(self):
+        """The whole point: remapping VWs onto a different world derives
+        the SAME keys — there is no per-host RNG state to migrate."""
+        keys_a = vw_keys(SEED, 8, 5)
+        # "resize": different ownership, same lineage
+        assign_ownership(8, ["pw0", "pw1"])
+        keys_b = vw_keys(SEED, 8, 5)
+        for ka, kb in zip(keys_a, keys_b):
+            assert jax.random.key_data(ka).tolist() == \
+                jax.random.key_data(kb).tolist()
+
+
+# ---------------------------------------------------------------------------
+# ownership map
+# ---------------------------------------------------------------------------
+
+class TestOwnership:
+    def test_assignment_deterministic_and_balanced(self):
+        m = assign_ownership(8, ["w1", "w0"])  # order must not matter
+        assert m == assign_ownership(8, ["w0", "w1"])
+        per = {}
+        for v, w in m.items():
+            per.setdefault(w, []).append(v)
+        assert sorted(len(vs) for vs in per.values()) == [4, 4]
+
+    def test_remap_counts_moved_vws(self):
+        c0 = get_counters().get("vw_remaps")
+        m = OwnershipMap(8, [f"w{i}" for i in range(4)])
+        moved = m.remap(["w0", "w1"])  # shrink 4 → 2
+        # VWs on w2/w3 must move (4 of 8); w0/w1's keep their owner
+        assert moved == 4
+        assert get_counters().get("vw_remaps") == c0 + 4
+        assert m.remap(["w0", "w1"]) == 0  # no change → no count
+
+    def test_kv_roundtrip_and_publish_for_delta(self):
+        kv = local_service()
+        m = OwnershipMap(8, ["w0", "w1", "w2", "w3"])
+        m.publish(kv, job="j")
+        loaded = OwnershipMap.load(kv, job="j")
+        assert loaded.mapping == m.mapping
+        c0 = get_counters().get("vw_remaps")
+        m2 = OwnershipMap.publish_for(kv, 8, ["w0", "w1"], job="j")
+        assert get_counters().get("vw_remaps") == c0 + 4
+        assert OwnershipMap.load(kv, job="j").mapping == m2.mapping
+
+    def test_torn_map_returns_none(self):
+        kv = local_service()
+        kv.kv_set("vw-map/j", b"{torn")
+        assert OwnershipMap.load(kv, job="j") is None
+
+
+# ---------------------------------------------------------------------------
+# the deterministic batch stream + cursors
+# ---------------------------------------------------------------------------
+
+class TestVirtualBatches:
+    def test_stream_is_world_size_free_and_reproducible(self):
+        reg, ids = _registry()
+        a = VirtualBatches(CFG, ids, reg.get)
+        b = VirtualBatches(CFG, ids, reg.get)
+        for _ in range(5):
+            ma, mb = a.next_step(), b.next_step()
+            for ta, tb in zip(ma, mb):
+                for la, lb in zip(ta, tb):
+                    assert np.array_equal(la, lb)
+
+    def test_cursor_restore_mid_shard_resumes_exactly_once(self):
+        """Crash after k steps with cursors pointing MID-shard; a fresh
+        instance restored from the snapshot continues the stream with no
+        row duplicated and none dropped."""
+        reg, ids = _registry(n=320, shards=5)  # shard=64, V streams mix
+        cfg = VirtualConfig(vw_count=4, global_batch=16, job_seed=0)
+        full = VirtualBatches(cfg, ids, reg.get)
+        seen_control = []
+        while (mb := full.next_step()) is not None:
+            seen_control.append(np.concatenate(full.last_step_rows))
+        crashed = VirtualBatches(cfg, ids, reg.get)
+        seen: list[np.ndarray] = []
+        for _ in range(7):  # cursor 28 rows into a 64-row shard
+            crashed.next_step()
+            seen.append(np.concatenate(crashed.last_step_rows))
+        snap = crashed.state()
+        resumed = VirtualBatches(cfg, ids, reg.get)
+        resumed.restore(json.loads(json.dumps(snap)))  # via-serialization
+        while (mb := resumed.next_step()) is not None:
+            seen.append(np.concatenate(resumed.last_step_rows))
+        got = np.sort(np.concatenate(seen))
+        want = np.sort(np.concatenate(seen_control))
+        assert np.array_equal(got, want)
+        assert len(np.unique(got)) == len(got)  # exactly-once
+
+    def test_cursors_for_step_matches_actual(self):
+        reg, ids = _registry()
+        vb = VirtualBatches(CFG, ids, reg.get)
+        for _ in range(9):
+            vb.next_step()
+        derived = vb.cursors_for_step(9)
+        assert derived["cursors"] == vb.state()["cursors"]
+        assert derived["pass"] == vb.state()["pass"]
+
+    def test_remainder_rows_accounted_deterministically(self):
+        reg, ids = _registry(n=300, shards=6)  # streams don't divide m
+        cfg = VirtualConfig(vw_count=2, global_batch=16, job_seed=0)
+        vb = VirtualBatches(cfg, ids, reg.get)
+        n_steps = 0
+        while vb.next_step() is not None:
+            n_steps += 1
+        assert n_steps == vb.total_steps
+        assert n_steps * 16 + vb.rows_dropped_remainder == 300
+
+    def test_starved_vw_stream_rejected_loudly(self):
+        """Fewer shards than virtual workers would leave some VW with an
+        EMPTY stream — the loop would silently train on nothing; the
+        constructor must refuse instead."""
+        reg, ids = _registry(n=300, shards=6)
+        with pytest.raises(ValueError, match="fewer than one micro-batch"):
+            VirtualBatches(VirtualConfig(vw_count=8, global_batch=64,
+                                         job_seed=0), ids, reg.get)
+
+    def test_cursor_store_torn_blob_counts_and_falls_back(self):
+        kv = local_service()
+        store = CursorStore(kv, job="j")
+        store.save({"step": 4, "pass": 0, "cursors": {"0": 8}})
+        assert store.load()["step"] == 4
+        c0 = get_counters().get("vw_cursor_torn")
+        kv.kv_set("vw-cursor/j", b"\xff{torn")
+        assert store.load() is None
+        assert get_counters().get("vw_cursor_torn") == c0 + 1
+
+
+# ---------------------------------------------------------------------------
+# constant effective batch (gradient accumulation)
+# ---------------------------------------------------------------------------
+
+class TestAccumulation:
+    def _micro(self, B=64, V=8):
+        x, y = _dataset(B)
+        m = B // V
+        return [(x[v * m:(v + 1) * m], y[v * m:(v + 1) * m])
+                for v in range(V)], (x, y)
+
+    def test_replicated_mode_bitwise_across_world_sizes(self):
+        micro, _ = self._micro()
+        trajs = {}
+        for w in (1, 2, 4, 8):
+            tr = _trainer(world=w, accum_mode="replicated")
+            trajs[w] = [tr.step_accumulate(micro) for _ in range(4)]
+        for w in (2, 4, 8):
+            assert trajs[w] == trajs[1]  # BITWISE
+
+    def test_dp_mode_matches_full_batch_step_within_tolerance(self):
+        micro, full = self._micro()
+        tr_a = _trainer(world=4, accum_mode="dp")
+        tr_b = _trainer(world=4)
+        for _ in range(4):
+            la = tr_a.step_accumulate(micro)
+            lb = tr_b.step(full)
+            assert abs(la - lb) < 1e-5
+
+    def test_dp_mode_bounded_across_world_sizes(self):
+        micro, _ = self._micro()
+        t2 = _trainer(world=2, accum_mode="dp")
+        t8 = _trainer(world=8, accum_mode="dp")
+        for _ in range(4):
+            assert abs(t2.step_accumulate(micro)
+                       - t8.step_accumulate(micro)) < 1e-5
+
+    def test_abort_mid_accumulation_leaves_state_untouched(self):
+        micro, _ = self._micro()
+        tr = _trainer(world=2, accum_mode="replicated")
+        before = jax.tree.map(np.asarray, tr.state.params)
+        step0 = tr.state.step
+        with pytest.raises(AccumulationAborted):
+            tr.step_accumulate(micro, abort_after=3)
+        after = jax.tree.map(np.asarray, tr.state.params)
+        assert tr.state.step == step0
+        for a, b in zip(jax.tree.leaves(before), jax.tree.leaves(after)):
+            assert np.array_equal(a, b)
+        # the replayed step applies normally
+        tr.step_accumulate(micro)
+        assert tr.state.step == step0 + 1
+
+    def test_rng_in_loss_requires_keys_and_is_layout_invariant(self):
+        def noisy_loss(params, batch, key):
+            x, y = batch
+            return mlp.loss_fn(params, (x + 0.05 * jax.random.normal(
+                key, x.shape), y))
+
+        micro, _ = self._micro()
+        tr = _trainer(world=2, accum_mode="replicated", loss=noisy_loss,
+                      rng_in_loss=True)
+        with pytest.raises(ValueError):
+            tr.step_accumulate(micro)
+        with pytest.raises(ValueError):
+            tr.step(micro[0])
+        trajs = {}
+        for w in (2, 8):
+            t = _trainer(world=w, accum_mode="replicated", loss=noisy_loss,
+                         rng_in_loss=True)
+            trajs[w] = [t.step_accumulate(micro,
+                                          rng_keys=vw_keys(SEED, 8, s))
+                        for s in range(3)]
+        assert trajs[2] == trajs[8]  # dropout draws ride the VW lineage
+
+
+# ---------------------------------------------------------------------------
+# satellite: versioned checkpoint meta (cursors + RNG) + torn fallback
+# ---------------------------------------------------------------------------
+
+class TestCheckpointMeta:
+    META = {"cursor": {"version": 1, "step": 6, "pass": 0,
+                       "cursors": {"0": 48, "1": 48}},
+            "rng": {"job_seed": SEED, "vw_count": 8}}
+
+    def test_sync_save_meta_roundtrip_versioned(self, tmp_path):
+        ck = ElasticCheckpointer(tmp_path)
+        ck.save(6, {"w": np.ones((4,), np.float32)}, meta=self.META)
+        assert ck.load_meta(6) == self.META
+        manifest = json.loads(
+            (tmp_path / ".integrity" / "6.json").read_text())
+        assert manifest["version"] == 2
+        assert manifest["meta"] is not None
+        assert ck.verify(6)
+        ck.close()
+
+    def test_async_save_meta_lands_at_finalize(self, tmp_path):
+        ck = ElasticCheckpointer(tmp_path)
+        ck.save_async(3, {"w": np.ones((4,), np.float32)}, meta=self.META)
+        ck.finalize()
+        assert ck.load_meta(3) == self.META
+        ck.close()
+
+    def test_torn_meta_counts_and_returns_none_but_step_restores(
+            self, tmp_path):
+        """The torn-cursor fallback: a half-written sidecar must not
+        poison the checkpoint — params restore, load_meta says None, the
+        caller derives cursors from the step."""
+        ck = ElasticCheckpointer(tmp_path)
+        tree = {"w": np.arange(4, dtype=np.float32)}
+        ck.save(6, tree, meta=self.META)
+        mpath = tmp_path / ".integrity" / "6.meta.json"
+        mpath.write_bytes(mpath.read_bytes()[:11])  # tear it
+        c0 = get_counters().get("checkpoint_meta_torn")
+        assert ck.load_meta(6) is None
+        assert get_counters().get("checkpoint_meta_torn") == c0 + 1
+        restored = ck.restore({"w": np.zeros((4,), np.float32)})
+        assert np.array_equal(restored["w"], tree["w"])
+        ck.close()
+
+    def test_meta_fingerprint_mismatch_detected(self, tmp_path):
+        ck = ElasticCheckpointer(tmp_path)
+        ck.save(2, {"w": np.ones((2,), np.float32)}, meta=self.META)
+        mpath = tmp_path / ".integrity" / "2.meta.json"
+        # VALID json, wrong bytes: only the manifest fingerprint can
+        # tell a silent rewrite from the one save() persisted
+        mpath.write_text(json.dumps(
+            {"step": 2, "meta": {"cursor": "forged"}}))
+        assert ck.load_meta(2) is None
+        ck.close()
+
+    def test_v1_manifest_still_verifies_and_restores(self, tmp_path):
+        """Old stores (pre-version manifests: {step, files} only) keep
+        restoring — the schema change is backward compatible."""
+        ck = ElasticCheckpointer(tmp_path)
+        tree = {"w": np.ones((3,), np.float32)}
+        ck.save(1, tree)
+        mp = tmp_path / ".integrity" / "1.json"
+        doc = json.loads(mp.read_text())
+        mp.write_text(json.dumps({"step": 1, "files": doc["files"]}))
+        assert ck.verify(1)
+        assert ck.load_meta(1) is None  # no sidecar, no error
+        restored = ck.restore({"w": np.zeros((3,), np.float32)})
+        assert np.array_equal(restored["w"], tree["w"])
+        ck.close()
+
+    def test_metaless_resave_drops_stale_sidecar(self, tmp_path):
+        """Re-saving the same step WITHOUT meta (a rollback replay
+        through a meta-less path) must not leave the earlier save's
+        sidecar behind for the new manifest to bless as valid — stale
+        cursors presented as verified would replay/skip rows."""
+        ck = ElasticCheckpointer(tmp_path)
+        ck.save(4, {"w": np.ones((2,), np.float32)}, meta=self.META)
+        assert ck.load_meta(4) == self.META
+        ck.save(4, {"w": np.full((2,), 2.0, np.float32)})  # no meta
+        assert not (tmp_path / ".integrity" / "4.meta.json").exists()
+        assert ck.load_meta(4) is None
+        ck.close()
+
+    def test_meta_pruned_with_its_step(self, tmp_path):
+        ck = ElasticCheckpointer(tmp_path, max_to_keep=1)
+        for s in (1, 2):
+            ck.save(s, {"w": np.full((2,), float(s), np.float32)},
+                    meta=self.META)
+        names = {p.name for p in (tmp_path / ".integrity").glob("*.json")}
+        assert "2.json" in names and "2.meta.json" in names
+        assert "1.json" not in names and "1.meta.json" not in names
+        ck.close()
+
+
+# ---------------------------------------------------------------------------
+# the equivalence harness
+# ---------------------------------------------------------------------------
+
+@pytest.mark.timeout_s(420)
+class TestEquivalence:
+    def test_resize_4_2_8_matches_unresized_control(self):
+        """THE acceptance run: same job, one world resized 4→2→8
+        mid-training, one never resized — identical loss curves
+        (bitwise on this backend in replicated accumulation mode),
+        every row trained exactly once, remaps counted."""
+        kv = local_service()
+        c0 = get_counters().get("vw_remaps")
+        _, ctrl = _loop(CONTROL_4, max_steps=20)
+        loop, res = _loop(RESIZE_4_2_8, max_steps=20, kv=kv, job="acc")
+        div = loss_divergence(ctrl.losses, res.losses)
+        assert div["steps_compared"] == 20
+        assert div["bitwise"], div
+        assert trajectories_equivalent(ctrl.losses, res.losses)
+        assert res.resizes == 2
+        assert res.world_sizes[0] == 4 and 2 in res.world_sizes \
+            and res.world_sizes[-1] == 8
+        assert res.rows_duplicated() == 0
+        assert res.rows_missing(expected=20 * CFG.global_batch) == 0
+        assert get_counters().get("vw_remaps") > c0
+        # ownership + cursors live in (HA-replicable) coordinator KV
+        assert OwnershipMap.load(kv, job="acc") is not None
+        assert CursorStore(kv, job="acc").load()["step"] == 20
+
+    def test_dp_packed_mode_within_documented_tolerance(self):
+        """The perf accumulation mode reorders float reductions with the
+        world size; the equivalence guarantee is the documented bound,
+        not bitwise — assert it holds through the same 4→2→8 walk."""
+        _, ctrl = _loop(CONTROL_4, max_steps=16, accum_mode="dp")
+        _, res = _loop(RESIZE_4_2_8, max_steps=16, accum_mode="dp")
+        assert trajectories_equivalent(ctrl.losses, res.losses)
+        div = loss_divergence(ctrl.losses, res.losses)
+        assert div["max_loss_divergence"] < 1e-3, div
+
+    def test_rng_augmentation_rides_the_lineage(self):
+        """Host-side data augmentation drawn from per-VW keys is
+        identical at any world size — and actually does something."""
+        def augment(mb, key):
+            x, y = mb
+            return (x + 0.05 * np.asarray(jax.random.normal(key, x.shape)),
+                    y)
+
+        _, ctrl = _loop(CONTROL_4, max_steps=12, augment=augment)
+        _, res = _loop(RESIZE_4_2_8, max_steps=12, augment=augment)
+        assert ctrl.losses == res.losses  # bitwise
+        _, bare = _loop(CONTROL_4, max_steps=12)
+        assert ctrl.losses != bare.losses  # the augmentation is live
+
+    def test_kill_mid_accumulation_restores_exactly_once(self, tmp_path):
+        """The injected-fault leg: a worker dies INSIDE a step's
+        accumulation (after 3 of 8 micro-grads).  Nothing partial was
+        applied, so restore-from-checkpoint + cursor meta replays the
+        step and the full trajectory still equals the control's —
+        with no row trained twice and none dropped."""
+        _, ctrl = _loop(CONTROL_4, max_steps=20)
+
+        reg, ids = _registry()
+        cfg = CFG
+        ck = ElasticCheckpointer(tmp_path / "ck")
+        tr = _trainer(world=4)
+        vb = VirtualBatches(cfg, ids, reg.get, passes=2)
+        kv = local_service()
+        loop = VirtualWorkerLoop(tr, cfg, vb, kv=kv, job="kill",
+                                 checkpointer=ck, ckpt_every=5)
+        rep1 = loop.run(max_steps=10, world_size_for=RESIZE_4_2_8)
+        # the kill: step 11's accumulation dies between micro-grads —
+        # its rows were FETCHED (cursors advanced in memory) but the
+        # update never applied, and the in-memory cursors die with the
+        # process
+        micro = vb.next_step()
+        assert micro is not None
+        with pytest.raises(AccumulationAborted):
+            tr.step_accumulate(micro, abort_after=3)
+        # recovery on a FRESH trainer (world 2 — the shrunken survivor
+        # set), restored from the last checkpoint (step 10) + cursors
+        tr2 = _trainer(world=2)
+        vb2 = VirtualBatches(cfg, ids, reg.get, passes=2)
+        loop2 = VirtualWorkerLoop(tr2, cfg, vb2, kv=kv, job="kill",
+                                  checkpointer=ck, ckpt_every=5)
+        restored_step = loop2.restore_latest()
+        assert restored_step == 10
+        rep2 = loop2.run(max_steps=10, world_size_for=RESIZE_4_2_8)
+        stitched = rep1.losses + rep2.losses
+        assert stitched == ctrl.losses  # bitwise, kill and all
+        # exactly-once across the APPLIED updates of the whole run: the
+        # aborted step's rows reappear exactly once, in rep2's replay
+        rows: dict[int, int] = {}
+        for rep in (rep1, rep2):
+            for gid, c in rep.rows_trained.items():
+                rows[gid] = rows.get(gid, 0) + c
+        assert sum(rows.values()) == 20 * cfg.global_batch
+        assert all(c == 1 for c in rows.values())
+        ck.close()
+
+    def test_restore_rejects_drifted_virtual_config(self, tmp_path):
+        """A restart under a different VirtualConfig must refuse the
+        checkpoint's cursors loudly: a changed V changes the ownership
+        schedule, so resuming old offsets would duplicate/skip rows and
+        fork the RNG lineage silently."""
+        reg, ids = _registry()
+        ck = ElasticCheckpointer(tmp_path / "ck")
+        tr = _trainer(world=4)
+        loop = VirtualWorkerLoop(tr, CFG,
+                                 VirtualBatches(CFG, ids, reg.get),
+                                 checkpointer=ck, ckpt_every=5)
+        loop.run(max_steps=5, world_size_for=CONTROL_4)
+        drifted = VirtualConfig(vw_count=4, global_batch=64, job_seed=SEED)
+        loop2 = VirtualWorkerLoop(_trainer(world=4), drifted,
+                                  VirtualBatches(drifted, ids, reg.get),
+                                  checkpointer=ck, ckpt_every=5)
+        with pytest.raises(ValueError, match="different virtual-worker"):
+            loop2.restore_latest()
+        # the ORIGINAL config still restores fine
+        loop3 = VirtualWorkerLoop(_trainer(world=4), CFG,
+                                  VirtualBatches(CFG, ids, reg.get),
+                                  checkpointer=ck, ckpt_every=5)
+        assert loop3.restore_latest() == 5
+        ck.close()
+
+    def test_stall_mid_run_detected_and_invisible_to_loss(self):
+        """A wedged step (the watchdog's quiet-failure class) must be
+        DETECTED yet leave the trajectory untouched — wall-clock noise
+        is not training semantics."""
+        from edl_tpu.runtime.watchdog import StallWatchdog
+
+        _, ctrl = _loop(CONTROL_4, max_steps=12)
+        wd = StallWatchdog(floor_s=0.4, k=8.0, scope="acc-elastic-test")
+        wd.start(poll_s=0.05)
+        stalled = []
+
+        def on_step(step, loss, world):
+            wd.beat(step)
+            if step == 6 and not stalled:
+                stalled.append(True)
+                time.sleep(1.2)  # the wedge
+
+        try:
+            _, res = _loop(RESIZE_4_2_8, max_steps=12, on_step=on_step)
+        finally:
+            wd.stop()
+        assert get_counters().get("stalls_detected",
+                                  scope="acc-elastic-test") >= 1
+        assert ctrl.losses == res.losses
+
+    def test_coordinator_failover_preserves_cursors_and_equivalence(
+            self, tmp_path):
+        """Coordinator-primary SIGKILL mid-run: the ownership map and
+        cursors ride HA replication, the client fails over, the run
+        completes, and the trajectory still equals the control —
+        the control-plane fault leaves no semantic fingerprint."""
+        from edl_tpu.coord import CoordClient, native_available, \
+            spawn_ha_pair
+
+        if not native_available():
+            pytest.skip("no native coordinator core")
+        _, ctrl = _loop(CONTROL_4, max_steps=16)
+        pr, sb = spawn_ha_pair(str(tmp_path), repl_lease_ms=1000)
+        client = CoordClient("127.0.0.1", pr.port, timeout=2.0,
+                             reconnect_window_s=12.0, promote_grace_s=0.2,
+                             endpoints=[("127.0.0.1", sb.port)])
+        killed = []
+
+        def on_step(step, loss, world):
+            if step == 8 and not killed:
+                killed.append(True)
+                pr.process.send_signal(signal.SIGKILL)
+                pr.process.wait(timeout=10)
+
+        try:
+            _, res = _loop(RESIZE_4_2_8, max_steps=16, kv=client,
+                           job="ha", on_step=on_step)
+            assert ctrl.losses == res.losses
+            # the promoted standby serves the final cursors + map
+            assert (client.host, client.port) == ("127.0.0.1", sb.port)
+            assert CursorStore(client, job="ha").load()["step"] == 16
+            assert OwnershipMap.load(client, job="ha") is not None
+            assert res.rows_duplicated() == 0
+        finally:
+            client.close()
+            pr.stop()
+            sb.stop()
+
+
+# ---------------------------------------------------------------------------
+# satellite: exactly-once re-dispatch across a resize (dead worker's shards)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.timeout_s(300)
+def test_dead_workers_offsets_reowned_exactly_once(tmp_path):
+    """A worker dies MID-SHARD and the world shrinks 4→2: the dead
+    worker's virtual workers — including their partially-consumed
+    offsets — are re-owned by the remapped survivors, and counting every
+    row across the whole run shows none duplicated, none dropped."""
+    reg, ids = _registry(n=640, shards=5)  # 128-row shards: always mid-shard
+    cfg = VirtualConfig(vw_count=4, global_batch=32, job_seed=0)
+    kv = local_service()
+    ck = ElasticCheckpointer(tmp_path / "ck")
+    tr = _trainer(world=4)
+    vb = VirtualBatches(cfg, ids, reg.get, passes=1)
+    loop = VirtualWorkerLoop(tr, cfg, vb, kv=kv, job="redispatch",
+                             checkpointer=ck, ckpt_every=1)
+    rep1 = loop.run(max_steps=7, world_size_for=lambda s: 4)
+    before = OwnershipMap.load(kv, job="redispatch").mapping
+    assert len(set(before.values())) == 4
+    # pw2/pw3 die; cursors at step 7 sit mid-shard (7*8=56 of 128 rows)
+    tr2 = _trainer(world=2)
+    vb2 = VirtualBatches(cfg, ids, reg.get, passes=1)
+    loop2 = VirtualWorkerLoop(tr2, cfg, vb2, kv=kv, job="redispatch",
+                              checkpointer=ck, ckpt_every=0)
+    assert loop2.restore_latest() == 7
+    rep2 = loop2.run(world_size_for=lambda s: 2)  # drain the pass
+    after = OwnershipMap.load(kv, job="redispatch").mapping
+    assert set(after.values()) == {"pw0", "pw1"}
+    # every VW the dead workers owned is re-owned by a survivor
+    orphaned = [v for v, w in before.items() if w in ("pw2", "pw3")]
+    assert orphaned and all(after[v] in ("pw0", "pw1") for v in orphaned)
+    # exactly-once across the WHOLE run
+    rows: dict[int, int] = {}
+    for rep in (rep1, rep2):
+        for gid, c in rep.rows_trained.items():
+            rows[gid] = rows.get(gid, 0) + c
+    total = len(rep1.losses + rep2.losses) * cfg.global_batch
+    assert sum(rows.values()) == total
+    assert all(c == 1 for c in rows.values()), \
+        f"duplicated rows: {[g for g, c in rows.items() if c > 1][:5]}"
+    assert len(rows) + vb2.rows_dropped_remainder == 640
+    ck.close()
