@@ -1,4 +1,4 @@
-"""Resource model: TrainingJob spec/status types and quantity arithmetic."""
+"""Resource model: TrainingJob/ServingJob spec/status types and quantity arithmetic."""
 
 from edl_tpu.api.quantity import Quantity
 from edl_tpu.api.types import (
@@ -6,13 +6,18 @@ from edl_tpu.api.types import (
     MasterSpec,
     PserverSpec,
     ResourceRequirements,
+    ServingJob,
+    ServingSpec,
     TrainerSpec,
     TrainingJob,
     TrainingJobSpec,
     TrainingJobStatus,
     TpuTopology,
 )
-from edl_tpu.api.validation import ValidationError, set_defaults_and_validate
+from edl_tpu.api.validation import (ValidationError,
+                                    set_defaults_and_validate,
+                                    set_defaults_and_validate_serving,
+                                    validate_any)
 
 __all__ = [
     "Quantity",
@@ -20,6 +25,8 @@ __all__ = [
     "MasterSpec",
     "PserverSpec",
     "ResourceRequirements",
+    "ServingJob",
+    "ServingSpec",
     "TrainerSpec",
     "TrainingJob",
     "TrainingJobSpec",
@@ -27,4 +34,6 @@ __all__ = [
     "TpuTopology",
     "ValidationError",
     "set_defaults_and_validate",
+    "set_defaults_and_validate_serving",
+    "validate_any",
 ]

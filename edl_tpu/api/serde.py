@@ -27,6 +27,8 @@ from edl_tpu.api.types import (
     MasterSpec,
     PserverSpec,
     ResourceRequirements,
+    ServingJob,
+    ServingSpec,
     TpuTopology,
     TrainerSpec,
     TrainingJob,
@@ -38,12 +40,14 @@ log = get_logger("serde")
 
 API_VERSION = "edl.tpu/v1"
 KIND = "TrainingJob"
+KIND_SERVING = "ServingJob"
 
 #: CRD coordinates (k8s/crd.yaml; role of the reference's
 #: pkg/apis/paddlepaddle/v1/types.go:12-28 constants).
 CRD_GROUP = "edl.tpu"
 CRD_VERSION = "v1"
 CRD_PLURAL = "trainingjobs"
+SERVING_CRD_PLURAL = "servingjobs"
 
 
 #: kebab → snake aliases (mirrors the declarations in k8s/crd.yaml; keep
@@ -81,14 +85,54 @@ def _known_snake_fields() -> frozenset[str]:
 _KNOWN_SNAKE_FIELDS = _known_snake_fields()
 
 
-def _norm(d: dict[str, Any]) -> dict[str, Any]:
+def _kebab(snake: str) -> str:
+    return snake.replace("_", "-")
+
+
+def _camel(snake: str) -> str:
+    head, *rest = snake.split("_")
+    return head + "".join(p.title() for p in rest)
+
+
+def _spelling_aliases(fields: "frozenset[str] | set[str]") -> dict[str, str]:
+    """kebab-case + lowerCamelCase alias → snake_case canonical, derived
+    mechanically from the field names so a newly added spec field gets
+    its aliases (and its CRD declarations — the lockstep test walks this
+    same derivation) for free."""
+    out: dict[str, str] = {}
+    for f in fields:
+        for alias in (_kebab(f), _camel(f)):
+            if alias != f:
+                out[alias] = f
+    return out
+
+
+def _serving_fields() -> frozenset[str]:
+    import dataclasses
+
+    return frozenset(f.name for f in dataclasses.fields(ServingSpec))
+
+
+#: ServingJob alias set: every multi-word field of the server section
+#: plus the job-level keys, in both the kebab and k8s-native camelCase
+#: spellings (minReplicas/maxBatchSize is what anyone porting an HPA or
+#: Deployment writes).  Declared in k8s/crd.yaml in lockstep —
+#: tests/test_serving_spec.py cross-checks the two.
+SERVING_ALIASES: dict[str, str] = _spelling_aliases(
+    _serving_fields() | {"host_network", "node_selector"})
+
+
+def _norm(d: dict[str, Any], aliases: "dict[str, str] | None" = None,
+          known: "frozenset[str] | None" = None) -> dict[str, Any]:
     # Snake_case wins when both spellings are present (the CRD schema,
     # k8s/crd.yaml, declares both so neither is apiserver-pruned; a manifest
     # carrying both must resolve deterministically, not by dict order).
+    aliases = KEBAB_ALIASES if aliases is None else aliases
+    known = _KNOWN_SNAKE_FIELDS if known is None else known
     out: dict[str, Any] = {}
     for k, v in d.items():
-        nk = KEBAB_ALIASES.get(k, k)
-        if nk == k and "-" in k and k.replace("-", "_") in _KNOWN_SNAKE_FIELDS:
+        nk = aliases.get(k, k)
+        if nk == k and "-" in k and k.replace("-", "_") in known:
             # e.g. 'etcd-endpoint': a kebab spelling of a real field that the
             # CRD schema does not declare. kubectl apply would prune it; here
             # the field would fall back to its default. Surface that.
@@ -210,6 +254,119 @@ def job_to_dict(job: TrainingJob) -> dict[str, Any]:
     if t.topology is not None:
         doc["spec"]["trainer"]["topology"] = str(t.topology)
     return doc
+
+
+def serving_job_from_dict(doc: dict[str, Any]) -> ServingJob:
+    """ServingJob manifest → resource (doc/serving.md).  The manifest
+    shape mirrors TrainingJob's: job-level image/port under ``spec``,
+    the replica fleet under ``spec.server`` (the serving analogue of
+    ``spec.trainer``); snake_case canonical, kebab + camelCase accepted
+    per :data:`SERVING_ALIASES`."""
+    if doc.get("kind", KIND_SERVING) != KIND_SERVING:
+        raise ValueError(
+            f"not a {KIND_SERVING} manifest: kind={doc.get('kind')!r}")
+    fields = _serving_fields() | {"host_network", "node_selector",
+                                  "name", "namespace", "labels",
+                                  "image", "port", "server",
+                                  "requests", "limits"}
+    meta = _norm(doc.get("metadata") or {}, SERVING_ALIASES, fields)
+    spec = _norm(doc.get("spec") or {}, SERVING_ALIASES, fields)
+    s = _norm(spec.get("server") or {}, SERVING_ALIASES, fields)
+    serving = ServingSpec(
+        model_dir=str(s.get("model_dir", "")),
+        model=str(s.get("model", ServingSpec.model)),
+        min_replicas=int(s.get("min_replicas", 1)),
+        max_replicas=int(s.get("max_replicas", 1)),
+        resources=_resources(s.get("resources")),
+        topology=(TpuTopology.parse(str(s["topology"]))
+                  if s.get("topology") else None),
+        slo_p99_ms=float(s.get("slo_p99_ms", 100.0)),
+        target_qps_per_replica=float(s.get("target_qps_per_replica", 0.0)),
+        max_batch_size=int(s.get("max_batch_size", 8)),
+        max_queue_ms=float(s.get("max_queue_ms", 2.0)),
+        drain_timeout_s=float(s.get("drain_timeout_s", 30.0)),
+        reload_poll_s=float(s.get("reload_poll_s", 5.0)),
+        env={k: str(v) for k, v in (s.get("env") or {}).items()},
+    )
+    return ServingJob(
+        name=meta.get("name", ""),
+        namespace=meta.get("namespace", "default"),
+        labels=dict(meta.get("labels") or {}),
+        image=spec.get("image", ""),
+        port=int(spec.get("port", 0)),
+        host_network=bool(spec.get("host_network", False)),
+        node_selector=dict(spec.get("node_selector") or {}),
+        spec=serving,
+    )
+
+
+def serving_job_to_dict(job: ServingJob) -> dict[str, Any]:
+    s = job.spec
+    server: dict[str, Any] = {
+        "model_dir": s.model_dir,
+        "model": s.model,
+        "min_replicas": s.min_replicas,
+        "max_replicas": s.max_replicas,
+        "slo_p99_ms": s.slo_p99_ms,
+        "target_qps_per_replica": s.target_qps_per_replica,
+        "max_batch_size": s.max_batch_size,
+        "max_queue_ms": s.max_queue_ms,
+        "drain_timeout_s": s.drain_timeout_s,
+        "reload_poll_s": s.reload_poll_s,
+        "env": {k: str(v) for k, v in sorted(s.env.items())},
+        "resources": {
+            "requests": {k: str(v) for k, v in s.resources.requests.items()},
+            "limits": {k: str(v) for k, v in s.resources.limits.items()},
+        },
+    }
+    if s.topology is not None:
+        server["topology"] = str(s.topology)
+    return {
+        "apiVersion": API_VERSION,
+        "kind": KIND_SERVING,
+        "metadata": {"name": job.name, "namespace": job.namespace,
+                     "labels": dict(job.labels)},
+        "spec": {
+            "image": job.image,
+            "port": job.port,
+            "host_network": job.host_network,
+            "node_selector": dict(job.node_selector),
+            "server": server,
+        },
+    }
+
+
+def serving_job_from_yaml(text: str) -> ServingJob:
+    import yaml
+
+    return serving_job_from_dict(yaml.safe_load(text))
+
+
+def serving_job_to_yaml(job: ServingJob) -> str:
+    import yaml
+
+    return yaml.safe_dump(serving_job_to_dict(job), sort_keys=False)
+
+
+def manifest_from_dict(doc: dict[str, Any]) -> "TrainingJob | ServingJob":
+    """Kind-dispatching decode: the one entry point for code (CLI
+    submit/validate, the CRD sync loop) that accepts either job kind."""
+    if doc.get("kind", KIND) == KIND_SERVING:
+        return serving_job_from_dict(doc)
+    return job_from_dict(doc)
+
+
+def manifest_to_dict(job: "TrainingJob | ServingJob") -> dict[str, Any]:
+    if isinstance(job, ServingJob):
+        return serving_job_to_dict(job)
+    return job_to_dict(job)
+
+
+def load_manifest_file(path: str) -> "TrainingJob | ServingJob":
+    import yaml
+
+    with open(path) as f:
+        return manifest_from_dict(yaml.safe_load(f.read()))
 
 
 def status_to_dict(status: "TrainingJobStatus") -> dict[str, Any]:

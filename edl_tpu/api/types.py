@@ -45,9 +45,16 @@ DEFAULT_PASSES = 1  # reference pkg/jobparser.go:58-60
 TRAINER_LABEL = "edl-tpu-job"
 COORDINATOR_LABEL = "edl-tpu-job-coordinator"
 PSERVER_LABEL = "edl-tpu-job-pserver"
+#: marks a ServingJob's model-server pods (the first non-training
+#: workload on the substrate — doc/serving.md)
+SERVING_LABEL = "edl-tpu-serving"
 #: marks a DCN-spanning (multi-slice) job's trainer pods, so the cluster
 #: inventory knows not to pin the job to one ICI domain.
 MULTI_DOMAIN_LABEL = "edl-tpu-multi-domain"
+
+#: default model-server port (the inference RPC surface; distinct from
+#: the coordinator's 7164 so a job may run both side by side)
+DEFAULT_SERVING_PORT = 8500
 
 
 def _as_qmap(m: "dict[str, Quantity | str | int] | None") -> dict[str, Quantity]:
@@ -167,6 +174,59 @@ class MasterSpec:
 
 
 @dataclass
+class ServingSpec:
+    """One elastic inference fleet: replicated model servers behind a
+    Service, continuously batched, SLO-autoscaled (doc/serving.md).
+
+    The serving analogue of :class:`TrainerSpec` — ``min_replicas`` /
+    ``max_replicas`` is the elastic dial the SLO policy moves, and a
+    replica may itself be a multi-chip mesh (``topology``), resized with
+    the same prewarmed :class:`~edl_tpu.runtime.elastic._MeshBundle`
+    machinery training uses."""
+
+    #: checkpoint-lineage directory weights load (and rolling reloads
+    #: watch) — an :class:`~edl_tpu.runtime.checkpoint.ElasticCheckpointer`
+    #: store; the serving twin of ``trainer.workspace``
+    model_dir: str = ""
+    #: model architecture the server pod builds before restoring from
+    #: the lineage (``kind:dims``, e.g. ``mlp:784,256,10``) — emitted as
+    #: EDL_SERVING_MODEL; a lineage whose tree doesn't match this shape
+    #: fails the pod at startup instead of serving garbage
+    model: str = "mlp:16,32,4"
+    min_replicas: int = 1
+    max_replicas: int = 1
+    resources: ResourceRequirements = field(default_factory=ResourceRequirements)
+    topology: Optional[TpuTopology] = None
+    #: p99 latency objective in milliseconds — what the autoscaler's
+    #: serving policy defends (scale-up fires when the windowed p99
+    #: crosses it); 0 disables latency-driven scaling
+    slo_p99_ms: float = 100.0
+    #: per-replica throughput target; above it a scale-up fires even
+    #: with latency headroom, and sustained load far below it (with p99
+    #: comfortably inside the SLO) lets replicas drain away.  0 = scale
+    #: on latency alone.
+    target_qps_per_replica: float = 0.0
+    #: continuous-batching admission: each serve iteration packs up to
+    #: this many queued requests into the compiled step (the compiled
+    #: batch shape — fixed, so no recompiles as load moves)
+    max_batch_size: int = 8
+    #: how long an admitted request may wait for co-batchees before the
+    #: iteration launches anyway (milliseconds); 0 = launch immediately
+    #: with whatever is queued
+    max_queue_ms: float = 2.0
+    #: graceful scale-down budget: a draining replica finishes its queue
+    #: within this bound before it is removed (never dropping requests)
+    drain_timeout_s: float = 30.0
+    #: cadence at which replicas watch ``model_dir`` for a newer
+    #: verified checkpoint generation to roll onto; 0 disables the watch
+    #: (reloads become explicit API calls)
+    reload_poll_s: float = 5.0
+    #: user environment for server pods (same merge contract as
+    #: ``TrainerSpec.env``: user values win)
+    env: dict = field(default_factory=dict)
+
+
+@dataclass
 class TrainingJobSpec:
     """reference pkg/resource/training_job.go:109-131."""
 
@@ -235,6 +295,12 @@ class TrainingJob:
     spec: TrainingJobSpec = field(default_factory=TrainingJobSpec)
     status: TrainingJobStatus = field(default_factory=TrainingJobStatus)
 
+    #: replica-group protocol (shared with ServingJob): what kind of pod
+    #: this job's elastic dial creates, and how the phase machine treats
+    #: a failed one.  The cluster backends and the updater read these
+    #: instead of hard-coding "trainer".
+    replica_role = "trainer"
+
     # -- helpers, reference pkg/resource/training_job.go:185-207 -----------
 
     def elastic(self) -> bool:
@@ -250,6 +316,77 @@ class TrainingJob:
     def need_tpu(self) -> bool:
         """role of NeedGPU() (training_job.go:203-207)."""
         return self.tpu_chips_per_trainer() > 0
+
+    # -- replica-group protocol --------------------------------------------
+
+    def group_range(self) -> tuple[int, int]:
+        """(min, max) of the elastic replica dial."""
+        return (self.spec.trainer.min_instance, self.spec.trainer.max_instance)
+
+    def group_resources(self) -> ResourceRequirements:
+        return self.spec.trainer.resources
+
+    def tpu_chips_per_replica(self) -> int:
+        return self.tpu_chips_per_trainer()
+
+    def replaceable_on_failure(self) -> bool:
+        """True when the group controller replaces a failed pod (the FT
+        elastic path); False = zero failure budget (static barrier)."""
+        return self.spec.fault_tolerant
+
+    @property
+    def full_name(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+
+@dataclass
+class ServingJob:
+    """The user-facing serving object — the first non-training workload
+    on the substrate (ROADMAP #4; doc/serving.md): a replicated model
+    server fleet with continuous batching, SLO-driven autoscaling, and
+    rolling weight reloads from the elastic checkpoint lineage.
+
+    Shares the :class:`TrainingJob` metadata/status shape (phases,
+    per-role replica states) so the controller's phase machine, the CLI
+    status verb and `kubectl get sj` all read the same lifecycle."""
+
+    name: str = ""
+    namespace: str = "default"
+    labels: dict[str, str] = field(default_factory=dict)
+    image: str = ""
+    port: int = 0
+    host_network: bool = False
+    node_selector: dict[str, str] = field(default_factory=dict)
+    spec: ServingSpec = field(default_factory=ServingSpec)
+    status: TrainingJobStatus = field(default_factory=TrainingJobStatus)
+
+    replica_role = "server"
+
+    def elastic(self) -> bool:
+        return self.spec.min_replicas < self.spec.max_replicas
+
+    def tpu_chips_per_replica(self) -> int:
+        """Chips one server replica occupies (a replica may be a
+        multi-chip mesh — ``topology`` — serving a sharded model)."""
+        if self.spec.topology is not None and self.spec.topology.chips:
+            return self.spec.topology.chips
+        return self.spec.resources.tpu_limit().value()
+
+    def need_tpu(self) -> bool:
+        return self.tpu_chips_per_replica() > 0
+
+    # -- replica-group protocol --------------------------------------------
+
+    def group_range(self) -> tuple[int, int]:
+        return (self.spec.min_replicas, self.spec.max_replicas)
+
+    def group_resources(self) -> ResourceRequirements:
+        return self.spec.resources
+
+    def replaceable_on_failure(self) -> bool:
+        """ReplicaSet semantics: a crashed server is always replaced —
+        the fleet degrades, it never statically fails."""
+        return True
 
     @property
     def full_name(self) -> str:

@@ -65,3 +65,58 @@ def set_defaults_and_validate(job: T.TrainingJob) -> T.TrainingJob:
             )
 
     return job
+
+
+def set_defaults_and_validate_serving(job: T.ServingJob) -> T.ServingJob:
+    """ServingJob defaulting + validation (doc/serving.md).  Mutates
+    ``job`` in place, raises ValidationError on a bad spec — the same
+    gate shape training jobs pass through."""
+    if not job.name:
+        raise ValidationError("job name must not be empty")
+    if not job.image:
+        job.image = T.DEFAULT_IMAGE
+    if job.port == 0:
+        job.port = T.DEFAULT_SERVING_PORT
+
+    s = job.spec
+    if s.min_replicas < 1:
+        raise ValidationError("server.min_replicas must be >= 1")
+    if s.max_replicas < s.min_replicas:
+        raise ValidationError(
+            f"server.max_replicas ({s.max_replicas}) must be >= "
+            f"min_replicas ({s.min_replicas})")
+    if s.slo_p99_ms < 0:
+        raise ValidationError("server.slo_p99_ms must be >= 0 (0 disables)")
+    if s.target_qps_per_replica < 0:
+        raise ValidationError("server.target_qps_per_replica must be >= 0")
+    if job.elastic() and s.slo_p99_ms == 0 and s.target_qps_per_replica == 0:
+        raise ValidationError(
+            "an elastic serving job (min_replicas < max_replicas) needs a "
+            "scaling signal: set slo_p99_ms and/or target_qps_per_replica")
+    if s.max_batch_size < 1:
+        raise ValidationError("server.max_batch_size must be >= 1")
+    if s.max_queue_ms < 0:
+        raise ValidationError("server.max_queue_ms must be >= 0")
+    if s.drain_timeout_s <= 0:
+        s.drain_timeout_s = 30.0
+    if s.reload_poll_s < 0:
+        raise ValidationError("server.reload_poll_s must be >= 0 "
+                              "(0 disables the lineage watch)")
+    if s.topology is not None:
+        if s.topology.chips < 1:
+            raise ValidationError(f"invalid TPU topology {s.topology}")
+        lim = s.resources.tpu_limit().value()
+        if lim and lim != s.topology.chips:
+            raise ValidationError(
+                f"tpu limit ({lim}) disagrees with topology {s.topology} "
+                f"({s.topology.chips} chips)")
+    return job
+
+
+def validate_any(job) -> None:
+    """Kind-dispatching gate: the controller's submit/modify path takes
+    either job kind through its matching validator."""
+    if isinstance(job, T.ServingJob):
+        set_defaults_and_validate_serving(job)
+    else:
+        set_defaults_and_validate(job)

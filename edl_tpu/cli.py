@@ -140,39 +140,52 @@ def cmd_launch(args) -> int:
 
 
 def cmd_submit(args) -> int:
-    from edl_tpu.api.serde import job_to_dict, load_job_file
-    from edl_tpu.api.validation import set_defaults_and_validate
+    from edl_tpu.api.serde import load_manifest_file, manifest_to_dict
+    from edl_tpu.api.types import ServingJob
+    from edl_tpu.api.validation import validate_any
 
-    job = load_job_file(args.manifest)
-    set_defaults_and_validate(job)  # reject locally before touching the API
+    job = load_manifest_file(args.manifest)  # kind-dispatching decode
+    validate_any(job)  # reject locally before touching the API
     cluster = _build_cluster(args)
+    serving = isinstance(job, ServingJob)
     if getattr(args, "fake", False):
         # no CR store in the fake backend: materialize directly (demo path)
         cluster.create_resources(job)
+    elif serving:
+        cluster.create_serving_job_cr(manifest_to_dict(job))
     else:
         # Submission = creating the CR; the controller's sync loop
         # validates, materializes and tracks phases (the reference's flow:
         # kubectl create CR → informer onAdd, pkg/controller.go:110-148).
-        cluster.create_training_job_cr(job_to_dict(job))
+        cluster.create_training_job_cr(manifest_to_dict(job))
+    lo, hi = job.group_range()
     log.info("job submitted", job=job.full_name,
-             trainers=f"{job.spec.trainer.min_instance}"
-                      f"-{job.spec.trainer.max_instance}",
+             kind=type(job).__name__,
+             replicas=f"{lo}-{hi}",
              elastic=job.elastic())
     return 0
 
 
 def cmd_delete(args) -> int:
-    from edl_tpu.api.types import TrainingJob
+    from edl_tpu.api.types import ServingJob, TrainingJob
 
     cluster = _build_cluster(args)
     if not getattr(args, "fake", False):
         # the controller's sync loop observes the CR deletion and tears
-        # the job down (reference onDelete, pkg/controller.go:156-161)
+        # the job down (reference onDelete, pkg/controller.go:156-161).
+        # Both kinds are tried: the verb takes a name, not a kind.
         cluster.delete_training_job_cr(args.name)
+        if hasattr(cluster, "delete_serving_job_cr"):
+            cluster.delete_serving_job_cr(args.name)
     # also delete pod resources directly so the verb works when no
     # controller is running (the reference's del_jobs.sh role)
     cluster.delete_resources(
         TrainingJob(name=args.name, namespace=args.namespace))
+    try:
+        cluster.delete_resources(
+            ServingJob(name=args.name, namespace=args.namespace))
+    except KeyError:
+        pass  # no serving group under this name (the common case)
     log.info("job deleted", job=f"{args.namespace}/{args.name}")
     return 0
 
@@ -191,6 +204,8 @@ def format_status(cluster, namespace: str, name: str) -> str:
     cr = None
     if hasattr(cluster, "get_training_job_cr"):
         cr = cluster.get_training_job_cr(name, namespace=namespace)
+    if cr is None and hasattr(cluster, "get_serving_job_cr"):
+        cr = cluster.get_serving_job_cr(name, namespace=namespace)
     if cr is not None and cr.get("status"):
         from edl_tpu.api.serde import status_from_dict
 
@@ -222,7 +237,7 @@ def format_job_list(cluster) -> str:
     """One line per TrainingJob CR with its recorded phase — the
     `kubectl get tj` table (the CRD's printer columns, k8s/crd.yaml)
     without kubectl."""
-    rows = [("NAMESPACE", "NAME", "PHASE", "MIN", "MAX", "REASON")]
+    rows = [("NAMESPACE", "NAME", "KIND", "PHASE", "MIN", "MAX", "REASON")]
     for cr in cluster.list_training_job_crs():
         meta = cr.get("metadata") or {}
         trainer = (cr.get("spec") or {}).get("trainer") or {}
@@ -230,11 +245,30 @@ def format_job_list(cluster) -> str:
         rows.append((
             meta.get("namespace", "default"),
             meta.get("name", ""),
+            "TrainingJob",
             status.get("phase", "None"),
             str(trainer.get("min_instance", trainer.get("min-instance", ""))),
             str(trainer.get("max_instance", trainer.get("max-instance", ""))),
             (status.get("reason") or "")[:48],
         ))
+    if hasattr(cluster, "list_serving_job_crs"):
+        for cr in cluster.list_serving_job_crs():
+            meta = cr.get("metadata") or {}
+            server = (cr.get("spec") or {}).get("server") or {}
+            status = cr.get("status") or {}
+            rows.append((
+                meta.get("namespace", "default"),
+                meta.get("name", ""),
+                "ServingJob",
+                status.get("phase", "None"),
+                str(server.get("min_replicas",
+                               server.get("min-replicas",
+                                          server.get("minReplicas", "")))),
+                str(server.get("max_replicas",
+                               server.get("max-replicas",
+                                          server.get("maxReplicas", "")))),
+                (status.get("reason") or "")[:48],
+            ))
     if len(rows) == 1:
         return "no TrainingJobs found"
     widths = [max(len(r[i]) for r in rows) for i in range(len(rows[0]))]
@@ -255,16 +289,18 @@ def cmd_list(args) -> int:
 
 
 def cmd_validate(args) -> int:
-    from edl_tpu.api.serde import job_to_yaml, load_job_file
-    from edl_tpu.api.validation import ValidationError, set_defaults_and_validate
+    import yaml
+
+    from edl_tpu.api.serde import load_manifest_file, manifest_to_dict
+    from edl_tpu.api.validation import ValidationError, validate_any
 
     try:
-        job = load_job_file(args.manifest)
-        set_defaults_and_validate(job)
+        job = load_manifest_file(args.manifest)
+        validate_any(job)
     except (ValidationError, ValueError, OSError) as exc:
         print(f"INVALID: {exc}", file=sys.stderr)
         return 1
-    print(job_to_yaml(job), end="")
+    print(yaml.safe_dump(manifest_to_dict(job), sort_keys=False), end="")
     return 0
 
 
@@ -348,11 +384,13 @@ def build_parser() -> argparse.ArgumentParser:
     c = sub.add_parser("launch", help="pod-role entrypoint")
     c.add_argument("verb",
                    choices=["start_coordinator", "start_trainer",
-                            "start_static_trainer", "start_pserver"])
+                            "start_static_trainer", "start_pserver",
+                            "start_server"])
     c.add_argument("rest", nargs="*")
     c.set_defaults(fn=cmd_launch)
 
-    c = sub.add_parser("submit", help="submit a TrainingJob manifest")
+    c = sub.add_parser("submit", help="submit a TrainingJob or "
+                                      "ServingJob manifest")
     _add_cluster_flags(c)
     c.add_argument("manifest")
     c.set_defaults(fn=cmd_submit)

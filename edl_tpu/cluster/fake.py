@@ -174,10 +174,11 @@ class FakeCluster(Cluster):
         self.reconcile()
 
     def job_pods(self, job: TrainingJob) -> PodCounts:
+        role = getattr(job, "replica_role", "trainer")
         with self._lock:
             total = running = pending = succeeded = failed = 0
             for p in self._pods.values():
-                if p.job_uid != job.full_name or p.role != "trainer":
+                if p.job_uid != job.full_name or p.role != role:
                     continue
                 total += 1
                 if p.deletion_timestamp:
@@ -193,11 +194,13 @@ class FakeCluster(Cluster):
             return PodCounts(total, running, pending, succeeded, failed)
 
     def create_resources(self, job: TrainingJob) -> None:
+        # works for both replica-group kinds: a TrainingJob's trainer
+        # group and a ServingJob's server group are the same dial
         with self._lock:
             if job.full_name in self._groups:
                 raise ConflictError(f"job {job.full_name} already exists")
             self._groups[job.full_name] = _TrainerGroup(
-                job_uid=job.full_name, parallelism=job.spec.trainer.min_instance
+                job_uid=job.full_name, parallelism=job.group_range()[0]
             )
             self._job_specs[job.full_name] = job
         self.reconcile()
@@ -243,13 +246,15 @@ class FakeCluster(Cluster):
                 spec = self._job_specs.get(g.job_uid)
                 if spec is None:
                     continue
+                role = getattr(spec, "replica_role", "trainer")
                 # coordinator ReplicaSet semantics for FT jobs (role of the
                 # master RS, reference pkg/jobparser.go:167-227): keep ONE
                 # live coordinator pod; a Failed one is replaced.  Off by
                 # default: the pure-bookkeeping scheduler scenarios elide
                 # aux pods (they hold no chips); the process-backed kubelet
                 # turns it on to run the job's coordinator for real.
-                if spec.spec.fault_tolerant and self.materialize_aux_pods:
+                if (getattr(spec.spec, "fault_tolerant", False)
+                        and self.materialize_aux_pods):
                     coords = [
                         p for p in self._pods.values()
                         if p.job_uid == g.job_uid and p.role == "coordinator"
@@ -271,7 +276,7 @@ class FakeCluster(Cluster):
                         )
                 pods = [
                     p for p in self._pods.values()
-                    if p.job_uid == g.job_uid and p.role == "trainer"
+                    if p.job_uid == g.job_uid and p.role == role
                 ]
                 live = [
                     p for p in pods
@@ -290,8 +295,9 @@ class FakeCluster(Cluster):
                 # survivors disagree with (the dead pod is still in
                 # theirs).  Enforce the budget at the Job-controller level
                 # too: once any trainer Failed, never replace (ADVICE r5
-                # item 3).
-                if (not spec.spec.fault_tolerant
+                # item 3).  Serving replicas are ReplicaSet-semantics:
+                # always replaceable.
+                if (not spec.replaceable_on_failure()
                         and any(p.phase == PodPhase.FAILED for p in pods)):
                     continue
                 # surplus: delete newest first (creation-order, not name-order)
@@ -301,15 +307,15 @@ class FakeCluster(Cluster):
                 # missing: create
                 for i in range(g.parallelism - len(live)):
                     seq = next(self._aux_pods_seq)
-                    name = f"{spec.name}-trainer-{seq}"
-                    res = spec.spec.trainer.resources
+                    name = f"{spec.name}-{role}-{seq}"
+                    res = spec.group_resources()
                     pod = FakePod(
-                        name=name, job_uid=g.job_uid, role="trainer", seq=seq,
+                        name=name, job_uid=g.job_uid, role=role, seq=seq,
                         cpu_request_milli=res.cpu_request().milli_value(),
                         cpu_limit_milli=res.cpu_limit().milli_value(),
                         memory_request_mega=res.memory_request().scaled_value(6),
                         memory_limit_mega=res.memory_limit().scaled_value(6),
-                        tpu_limit=spec.tpu_chips_per_trainer(),
+                        tpu_limit=spec.tpu_chips_per_replica(),
                     )
                     self._pods[name] = pod
             # schedule Pending pods
@@ -355,7 +361,10 @@ class FakeCluster(Cluster):
 
     def _allows_multi_domain(self, job_uid: str) -> bool:
         spec = self._job_specs.get(job_uid)
-        return spec is not None and spec.spec.trainer.allow_multi_domain
+        if spec is None:
+            return False
+        trainer = getattr(spec.spec, "trainer", None)
+        return trainer is not None and trainer.allow_multi_domain
 
     def _find_node_for(self, pod: FakePod) -> Optional[str]:
         idle = {

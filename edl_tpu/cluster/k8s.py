@@ -23,6 +23,7 @@ from edl_tpu.api.types import (
     MULTI_DOMAIN_LABEL,
     PSERVER_LABEL,
     RESOURCE_TPU,
+    SERVING_LABEL,
     TRAINER_LABEL,
     TrainingJob,
 )
@@ -140,6 +141,11 @@ class K8sCluster(Cluster):
         return r
 
     def get_trainer_parallelism(self, job: TrainingJob) -> int:
+        if getattr(job, "replica_role", "trainer") == "server":
+            apps = kubernetes.client.AppsV1Api()
+            rs = apps.read_namespaced_replica_set(
+                f"{job.name}-server", job.namespace)
+            return int(rs.spec.replicas or 0)
         tj = self._batch.read_namespaced_job(_trainer_name(job), job.namespace)
         return int(tj.spec.parallelism or 0)
 
@@ -148,7 +154,23 @@ class K8sCluster(Cluster):
         """Fresh-read then replace; a 409 (stale resourceVersion — someone
         wrote between our read and replace) surfaces as ConflictError so the
         autoscaler's bounded retry re-reads and tries again (reference
-        autoscaler.go:339-376 does the same 5-retry refresh-then-write)."""
+        autoscaler.go:339-376 does the same 5-retry refresh-then-write).
+        The replica-group dial is workload-agnostic: a TrainingJob's dial
+        is the trainer Job's ``parallelism``, a ServingJob's the server
+        ReplicaSet's ``replicas``."""
+        if getattr(job, "replica_role", "trainer") == "server":
+            apps = kubernetes.client.AppsV1Api()
+            name = f"{job.name}-server"
+            rs = apps.read_namespaced_replica_set(name, job.namespace)
+            rs.spec.replicas = parallelism
+            try:
+                apps.replace_namespaced_replica_set(name, job.namespace, rs)
+            except kubernetes.client.exceptions.ApiException as exc:
+                if exc.status == 409:
+                    raise ConflictError(
+                        f"resourceVersion conflict updating {name}") from exc
+                raise
+            return
         name = _trainer_name(job)
         tj = self._batch.read_namespaced_job(name, job.namespace)
         tj.spec.parallelism = parallelism
@@ -161,7 +183,10 @@ class K8sCluster(Cluster):
             raise
 
     def job_pods(self, job: TrainingJob) -> PodCounts:
-        sel = f"{TRAINER_LABEL}={job.name}"
+        label = (SERVING_LABEL
+                 if getattr(job, "replica_role", "trainer") == "server"
+                 else TRAINER_LABEL)
+        sel = f"{label}={job.name}"
         total = running = pending = succeeded = failed = 0
         for pod in self._core.list_namespaced_pod(
             job.namespace, label_selector=sel
@@ -195,10 +220,14 @@ class K8sCluster(Cluster):
         also never rewrites a running job's pod specs (its only actuation
         is TrainerJob.Spec.Parallelism, autoscaler.go:339-376).  Changing
         a template field means delete + resubmit."""
-        from edl_tpu.controller.jobparser import parse_to_manifests
+        from edl_tpu.controller.jobparser import (parse_serving_manifests,
+                                                   parse_to_manifests)
 
         apps = kubernetes.client.AppsV1Api()
-        for manifest in parse_to_manifests(job):
+        manifests = (parse_serving_manifests(job)
+                     if getattr(job, "replica_role", "trainer") == "server"
+                     else parse_to_manifests(job))
+        for manifest in manifests:
             try:
                 if manifest["kind"] == "Job":
                     self._batch.create_namespaced_job(job.namespace, manifest)
@@ -234,6 +263,22 @@ class K8sCluster(Cluster):
 
     def delete_resources(self, job: TrainingJob) -> None:
         apps = kubernetes.client.AppsV1Api()
+        if getattr(job, "replica_role", "trainer") == "server":
+            # ServingJob: server ReplicaSet + its Service, nothing else
+            try:
+                apps.delete_namespaced_replica_set(
+                    f"{job.name}-server", job.namespace,
+                    propagation_policy="Foreground")
+            except kubernetes.client.exceptions.ApiException as exc:
+                if exc.status != 404:
+                    raise
+            try:
+                self._core.delete_namespaced_service(
+                    f"{job.name}-serve", job.namespace)
+            except kubernetes.client.exceptions.ApiException as exc:
+                if exc.status != 404:
+                    raise
+            return
         for rs in (f"{job.name}-coordinator", f"{job.name}-pserver"):
             try:
                 apps.delete_namespaced_replica_set(
@@ -359,6 +404,65 @@ class K8sCluster(Cluster):
                 return False
             raise
 
+    # -- ServingJob custom resources (kind dispatch mirror of the
+    #    TrainingJob CR surface; plural servingjobs, k8s/crd.yaml) ---------
+
+    def list_serving_job_crs(self) -> list[dict]:
+        from edl_tpu.api.serde import CRD_GROUP, CRD_VERSION, SERVING_CRD_PLURAL
+
+        out = self._custom.list_cluster_custom_object(
+            CRD_GROUP, CRD_VERSION, SERVING_CRD_PLURAL)
+        return list(out.get("items") or [])
+
+    def get_serving_job_cr(self, name: str, namespace: str | None = None
+                           ) -> dict | None:
+        from edl_tpu.api.serde import CRD_GROUP, CRD_VERSION, SERVING_CRD_PLURAL
+
+        try:
+            return self._custom.get_namespaced_custom_object(
+                CRD_GROUP, CRD_VERSION, namespace or self.namespace,
+                SERVING_CRD_PLURAL, name)
+        except kubernetes.client.exceptions.ApiException as exc:
+            if exc.status == 404:
+                return None
+            raise
+
+    def create_serving_job_cr(self, manifest: dict) -> None:
+        from edl_tpu.api.serde import CRD_GROUP, CRD_VERSION, SERVING_CRD_PLURAL
+
+        ns = ((manifest.get("metadata") or {}).get("namespace")
+              or self.namespace)
+        self._custom.create_namespaced_custom_object(
+            CRD_GROUP, CRD_VERSION, ns, SERVING_CRD_PLURAL, manifest)
+
+    def delete_serving_job_cr(self, name: str, namespace: str | None = None
+                              ) -> bool:
+        from edl_tpu.api.serde import CRD_GROUP, CRD_VERSION, SERVING_CRD_PLURAL
+
+        try:
+            self._custom.delete_namespaced_custom_object(
+                CRD_GROUP, CRD_VERSION, namespace or self.namespace,
+                SERVING_CRD_PLURAL, name)
+            return True
+        except kubernetes.client.exceptions.ApiException as exc:
+            if exc.status == 404:
+                return False
+            raise
+
+    def patch_serving_job_status(self, name: str, status: dict,
+                                 namespace: str | None = None) -> bool:
+        from edl_tpu.api.serde import CRD_GROUP, CRD_VERSION, SERVING_CRD_PLURAL
+
+        try:
+            self._custom.patch_namespaced_custom_object_status(
+                CRD_GROUP, CRD_VERSION, namespace or self.namespace,
+                SERVING_CRD_PLURAL, name, {"status": status})
+            return True
+        except kubernetes.client.exceptions.ApiException as exc:
+            if exc.status == 404:
+                return False
+            raise
+
     def list_pods(self, job_uid: str | None = None, role: str | None = None
                   ) -> list["PodView"]:
         """Pods as lightweight records with the FakePod attribute surface
@@ -366,7 +470,8 @@ class K8sCluster(Cluster):
         out = []
         role_labels = {"trainer": TRAINER_LABEL,
                        "master": COORDINATOR_LABEL,
-                       "pserver": PSERVER_LABEL}
+                       "pserver": PSERVER_LABEL,
+                       "server": SERVING_LABEL}
         if job_uid is not None or role is not None:
             # Job-scoped callers (PodDiscovery polls every 5 s): a
             # namespaced LIST with a label selector, not a full-cluster

@@ -22,12 +22,12 @@ from __future__ import annotations
 import threading
 from typing import Optional
 
-from edl_tpu.api.types import JobPhase, TrainingJob
-from edl_tpu.api.validation import ValidationError, set_defaults_and_validate
+from edl_tpu.api.types import JobPhase, ServingJob, TrainingJob
+from edl_tpu.api.validation import ValidationError, validate_any
 from edl_tpu.cluster.base import Cluster
 from edl_tpu.controller.updater import TrainingJobUpdater
 from edl_tpu.observability.logging import get_logger
-from edl_tpu.scheduler.autoscaler import Autoscaler
+from edl_tpu.scheduler.autoscaler import Autoscaler, ServingScaler
 from edl_tpu.scheduler.topology import SliceShapePolicy, UNIT_POLICY
 
 log = get_logger("controller")
@@ -48,6 +48,9 @@ class Controller:
         min_resize_delta: int = 1,
         mesh_shape_for=None,
         goodput_curves=None,
+        serving_stats_for=None,
+        serving_loop_seconds: float = 2.0,
+        coord_for=None,
     ) -> None:
         self.cluster = cluster
         self.autoscaler = Autoscaler(
@@ -60,6 +63,22 @@ class Controller:
             mesh_shape_for=mesh_shape_for,
             goodput_curves=goodput_curves,
         )
+        #: SLO-driven replica scaling for ServingJob kinds — fed by
+        #: ``serving_stats_for(uid)`` (windowed p50/p99/qps; scraped
+        #: from replica /metrics in a deployment, read off the
+        #: in-process fleet in the harness), actuating the same cluster
+        #: replica-group dial the trainer autoscaler uses
+        self.serving_scaler = ServingScaler(
+            cluster=cluster,
+            stats_for=serving_stats_for,
+            loop_seconds=serving_loop_seconds,
+        )
+        #: optional ``coord_for(job) -> kv-client | None`` hook: on job
+        #: deletion the controller sweeps the job's coordinator KV
+        #: (goodput curve, vw map/cursors, serving generation —
+        #: edl_tpu.coord.gc.JOB_KV_PREFIXES); without it those keys
+        #: outlive the job on any shared coordinator
+        self.coord_for = coord_for
         self._updater_convert_seconds = updater_convert_seconds
         self._updater_confirm_seconds = updater_confirm_seconds
         self._updaters: dict[str, TrainingJobUpdater] = {}
@@ -68,12 +87,14 @@ class Controller:
     # -- lifecycle ---------------------------------------------------------
 
     def start(self) -> None:
-        """Run the scaling loop in the background
+        """Run the scaling loops in the background
         (role of Controller.Run, reference pkg/controller.go:64-76)."""
         self.autoscaler.start()
+        self.serving_scaler.start()
 
     def stop(self) -> None:
         self.autoscaler.stop()
+        self.serving_scaler.stop()
         with self._lock:
             updaters = list(self._updaters.values())
         for u in updaters:
@@ -82,9 +103,11 @@ class Controller:
     # -- TrainingJob CRUD (role of onAdd/onUpdate/onDelete,
     #    reference pkg/controller.go:110-161) ------------------------------
 
-    def submit(self, job: TrainingJob) -> TrainingJobUpdater:
-        """Validate, spawn the job's actor, register with the autoscaler."""
-        set_defaults_and_validate(job)  # raises ValidationError on bad spec
+    def submit(self, job: "TrainingJob | ServingJob") -> TrainingJobUpdater:
+        """Validate, spawn the job's actor, register with the matching
+        scaler (trainer autoscaler for TrainingJob, the SLO policy for
+        ServingJob — the updater lifecycle actor is shared)."""
+        validate_any(job)  # raises ValidationError on bad spec
         with self._lock:
             if job.full_name in self._updaters:
                 raise ValidationError(f"job {job.full_name} already submitted")
@@ -95,16 +118,24 @@ class Controller:
                 confirm_seconds=self._updater_confirm_seconds,
             )
             self._updaters[job.full_name] = updater
-        self.autoscaler.on_add(job)
-        log.info("job submitted", job=job.full_name)
+        if isinstance(job, ServingJob):
+            self.serving_scaler.on_add(job)
+        else:
+            self.autoscaler.on_add(job)
+        log.info("job submitted", job=job.full_name,
+                 kind=type(job).__name__)
         return updater
 
-    def modify(self, job: TrainingJob) -> None:
-        set_defaults_and_validate(job)  # same gate as submit
+    def modify(self, job: "TrainingJob | ServingJob") -> None:
+        validate_any(job)  # same gate as submit
         with self._lock:
             updater = self._updaters.get(job.full_name)
         if updater is None:
             raise KeyError(f"job {job.full_name} not found")
+        if isinstance(job, ServingJob):
+            updater.modify(job)
+            self.serving_scaler.on_update(job)
+            return
         old = updater.job.spec
         if old.trainer.allow_multi_domain != job.spec.trainer.allow_multi_domain:
             # The flag is baked into the running pods' labels (the cluster
@@ -118,14 +149,39 @@ class Controller:
         updater.modify(job)
         self.autoscaler.on_update(job)
 
-    def delete(self, job: TrainingJob) -> None:
+    def delete(self, job: "TrainingJob | ServingJob") -> None:
         with self._lock:
             updater = self._updaters.pop(job.full_name, None)
         if updater is not None:
             updater.notify_delete()
             updater.join(timeout=10)
-        self.autoscaler.on_del(job)
+        if isinstance(job, ServingJob):
+            self.serving_scaler.on_del(job)
+        else:
+            self.autoscaler.on_del(job)
+        self._gc_job_kv(job)
         log.info("job deleted", job=job.full_name)
+
+    def _gc_job_kv(self, job) -> None:
+        """Sweep the deleted job's coordinator KV (goodput curve, vw
+        map/cursors, serving generation): job-scoped keys deliberately
+        survive every reform/failover, so deletion is the ONLY moment
+        they can be collected — on a shared coordinator they would
+        otherwise leak forever (and poison a resubmitted job under the
+        same name with the dead job's curve and cursors).  Best-effort:
+        teardown never fails on an unreachable coordinator."""
+        if self.coord_for is None:
+            return
+        try:
+            coord = self.coord_for(job)
+            if coord is None:
+                return
+            from edl_tpu.coord.gc import gc_job_kv
+
+            gc_job_kv(coord, job.full_name)
+        except Exception as exc:
+            log.warn("job KV sweep failed", job=job.full_name,
+                     error=str(exc)[:200])
 
     # -- introspection -----------------------------------------------------
 

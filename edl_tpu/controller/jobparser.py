@@ -25,9 +25,12 @@ from typing import Any
 from edl_tpu.api.types import (
     COORDINATOR_LABEL,
     DEFAULT_PORT,
+    DEFAULT_SERVING_PORT,
     MULTI_DOMAIN_LABEL,
     PSERVER_LABEL,
+    SERVING_LABEL,
     TRAINER_LABEL,
+    ServingJob,
     TrainingJob,
 )
 
@@ -364,3 +367,122 @@ def parse_to_manifests(job: TrainingJob) -> list[dict[str, Any]]:
         out.append(ps)
     out.append(parse_to_trainer(job))
     return out
+
+
+# -- ServingJob compilation (doc/serving.md) ---------------------------------
+
+def serving_pod_env(job: ServingJob) -> dict[str, str]:
+    """EDL_SERVING_* env contract for server pods — consumed by the
+    ``start_server`` launcher verb (runtime/serving.py serve_main), the
+    serving twin of :func:`pod_env`.  User env merges LAST so the
+    documented "user values win" contract holds."""
+    s = job.spec
+    env = {
+        "EDL_JOB_NAME": job.name,
+        "EDL_NAMESPACE": job.namespace,
+        "EDL_ROLE": "server",
+        "EDL_SERVING_PORT": str(job.port or DEFAULT_SERVING_PORT),
+        "EDL_SERVING_MODEL_DIR": s.model_dir,
+        "EDL_SERVING_MODEL": s.model,
+        "EDL_SERVING_SLO_P99_MS": str(s.slo_p99_ms),
+        "EDL_SERVING_MAX_BATCH": str(s.max_batch_size),
+        "EDL_SERVING_MAX_QUEUE_MS": str(s.max_queue_ms),
+        "EDL_SERVING_DRAIN_S": str(s.drain_timeout_s),
+        "EDL_SERVING_RELOAD_POLL_S": str(s.reload_poll_s),
+    }
+    if s.topology is not None:
+        env["EDL_TPU_TOPOLOGY"] = str(s.topology)
+    env.update({k: str(v) for k, v in s.env.items()})
+    return env
+
+
+def parse_to_server_group(job: ServingJob) -> dict[str, Any]:
+    """Model-server ReplicaSet: ``replicas`` is the elastic dial the SLO
+    policy moves (the serving analogue of the trainer Job's
+    ``parallelism``).  The READINESS probe is load-bearing — it is the
+    ready gate: a replica still compiling its serving step answers
+    /healthz 503, the Service holds traffic off it, and the compile
+    never rides a request."""
+    s = job.spec
+    container = {
+        "name": "server",
+        "image": job.image,
+        "command": ["python", "-m", "edl_tpu.runtime.launcher",
+                    "start_server"],
+        "env": [{"name": k, "value": v}
+                for k, v in serving_pod_env(job).items()]
+        + list(_DOWNWARD_ENV),
+        "ports": [
+            {"containerPort": job.port or DEFAULT_SERVING_PORT,
+             "name": "serve"},
+            {"containerPort": HEALTH_PORT, "name": "health"},
+        ],
+        "resources": _resources_dict(s.resources),
+        "readinessProbe": {
+            "httpGet": {"path": "/healthz", "port": HEALTH_PORT},
+            "periodSeconds": 2,
+            "timeoutSeconds": 2,
+        },
+        "livenessProbe": {
+            "httpGet": {"path": "/healthz", "port": HEALTH_PORT},
+            "initialDelaySeconds": 30,
+            "periodSeconds": 10,
+            "timeoutSeconds": 2,
+            "failureThreshold": 3,
+        },
+    }
+    return {
+        "kind": "ReplicaSet",
+        "apiVersion": "apps/v1",
+        "metadata": {
+            "name": f"{job.name}-server",
+            "namespace": job.namespace,
+            "labels": {SERVING_LABEL: job.name},
+        },
+        "spec": {
+            "replicas": s.min_replicas,
+            "template": {
+                "metadata": {
+                    "labels": {SERVING_LABEL: job.name},
+                    "annotations": {
+                        "prometheus.io/scrape": "true",
+                        "prometheus.io/path": "/metrics",
+                        "prometheus.io/port": str(HEALTH_PORT),
+                    },
+                },
+                "spec": {
+                    "restartPolicy": "Always",
+                    "nodeSelector": dict(job.node_selector),
+                    "hostNetwork": job.host_network,
+                    "containers": [container],
+                },
+            },
+        },
+    }
+
+
+def parse_to_serving_service(job: ServingJob) -> dict[str, Any]:
+    """The traffic front door: a Service over READY server pods — what
+    makes the readiness gate an actual traffic gate (an unready replica
+    is not an endpoint)."""
+    return {
+        "kind": "Service",
+        "apiVersion": "v1",
+        "metadata": {
+            "name": f"{job.name}-serve",
+            "namespace": job.namespace,
+            "labels": {SERVING_LABEL: job.name},
+        },
+        "spec": {
+            "selector": {SERVING_LABEL: job.name},
+            "ports": [
+                {"name": "serve", "port": job.port or DEFAULT_SERVING_PORT},
+                {"name": "health", "port": HEALTH_PORT},
+            ],
+        },
+    }
+
+
+def parse_serving_manifests(job: ServingJob) -> list[dict[str, Any]]:
+    """All manifests for a ServingJob: the replica set + its Service."""
+    return [parse_to_server_group(job), parse_to_serving_service(job)]

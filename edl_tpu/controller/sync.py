@@ -38,8 +38,8 @@ import threading
 import time
 from typing import Any, Optional, Protocol
 
-from edl_tpu.api.serde import job_from_dict, status_to_dict
-from edl_tpu.api.types import JobPhase, TrainingJob
+from edl_tpu.api.serde import manifest_from_dict, status_to_dict
+from edl_tpu.api.types import JobPhase, ServingJob, TrainingJob
 from edl_tpu.controller.controller import Controller
 from edl_tpu.observability.logging import get_logger
 
@@ -216,21 +216,51 @@ class TrainingJobSyncLoop:
     # -- one reconcile tick ------------------------------------------------
 
     def run_once(self) -> None:
-        """One list → diff → dispatch → status write-back pass."""
+        """One list → diff → dispatch → status write-back pass.  Both
+        job kinds ride the same diff: ServingJob CRs (when the store
+        exposes ``list_serving_job_crs``) are listed alongside
+        TrainingJobs each tick — the watch stream covers training CRs
+        only, so serving reconcile latency is bounded by the periodic
+        LIST, which every tick here is."""
         lister = getattr(self.store, "list_training_job_crs_with_rv", None)
         if lister is not None:
             items, rv = lister()
             self._last_rv = rv or None
         else:
             items = self.store.list_training_job_crs()
+        serving_lister = getattr(self.store, "list_serving_job_crs", None)
+        serving_items: list[dict] = []
+        if serving_lister is not None:
+            # NO try/except: like the training LIST above, a failed
+            # serving LIST must abort the whole tick (caught by _run's
+            # tick guard).  Swallowing it would leave every registered
+            # ServingJob out of `listed`, and the delete pass below
+            # would tear down live fleets — and permanently sweep their
+            # job-scoped coordinator KV — on a single apiserver blip.
+            serving_items = [dict(cr, kind=cr.get("kind", "ServingJob"))
+                             for cr in serving_lister()]
         listed: dict[str, dict] = {}
-        for cr in items:
+        for cr in list(items) + serving_items:
             meta = cr.get("metadata") or {}
             name = meta.get("name", "")
             if not name:
                 continue
             ns = meta.get("namespace", "default")
-            listed[f"{ns}/{name}"] = cr
+            uid = f"{ns}/{name}"
+            if uid in listed:
+                # a TrainingJob and a ServingJob may legally share a
+                # name across their two CRDs, but the controller keys
+                # jobs by ns/name — adopting the second kind would
+                # repoint the first kind's updater at the wrong object.
+                # First listed (training) wins; say so loudly.
+                log.error("CR kind collision: this uid is already "
+                          "managed by another kind; the later CR is "
+                          "IGNORED — rename one of them",
+                          job=uid,
+                          kept=listed[uid].get("kind", "TrainingJob"),
+                          ignored=cr.get("kind", "ServingJob"))
+                continue
+            listed[uid] = cr
 
         for uid, cr in listed.items():
             spec = cr.get("spec") or {}
@@ -317,7 +347,7 @@ class TrainingJobSyncLoop:
         if self._rejected_specs.get(uid) == spec:
             return  # unchanged invalid spec: don't re-reject every tick
         try:
-            job = job_from_dict(cr)
+            job = manifest_from_dict(cr)
             self.controller.submit(job)
         except Exception as exc:
             # Any failure to turn an arbitrary user dict into a registered
@@ -335,7 +365,8 @@ class TrainingJobSyncLoop:
                 "reason": f"invalid spec: {exc}",
                 "replica_statuses": [],
             }, name=meta.get("name", ""),
-                namespace=meta.get("namespace", "default"))
+                namespace=meta.get("namespace", "default"),
+                serving=cr.get("kind") == "ServingJob")
             return
         self._rejected_specs.pop(uid, None)
         self._seen_specs[uid] = spec
@@ -344,7 +375,7 @@ class TrainingJobSyncLoop:
 
     def _on_update(self, uid: str, cr: dict, spec: Any) -> None:
         try:
-            job = job_from_dict(cr)
+            job = manifest_from_dict(cr)
             self.controller.modify(job)
         except Exception as exc:  # same rejection surface as _on_add
             # Keep managing the last valid spec, but (a) record the spec so
@@ -396,19 +427,24 @@ class TrainingJobSyncLoop:
                 status["reason"] = (f"spec update rejected: {reason}; "
                                     "running with last valid spec")
             self._patch_status(uid, status, name=job.name,
-                               namespace=job.namespace)
+                               namespace=job.namespace,
+                               serving=isinstance(job, ServingJob))
 
     def _patch_status(self, uid: str, status: dict, *, name: str,
-                      namespace: str) -> None:
+                      namespace: str, serving: bool = False) -> None:
         if self._written_status.get(uid) == status:
             return
+        patch = self.store.patch_training_job_status
+        if serving:
+            patch = getattr(self.store, "patch_serving_job_status", None)
+            if patch is None:  # store predates the serving kind
+                return
         deadline, delay = self._patch_backoff.get(uid, (0.0, 0.0))
         now = time.monotonic()
         if now < deadline:
             return  # this job is backing off; others are unaffected
         try:
-            if self.store.patch_training_job_status(name, status,
-                                                    namespace=namespace):
+            if patch(name, status, namespace=namespace):
                 self._written_status[uid] = status
             self._patch_backoff.pop(uid, None)
         except Exception as exc:

@@ -29,7 +29,7 @@ from edl_tpu.api.types import (
     TrainingJob,
     TrainingResourceStatus,
 )
-from edl_tpu.api.validation import ValidationError, set_defaults_and_validate
+from edl_tpu.api.validation import ValidationError, validate_any
 from edl_tpu.cluster.base import Cluster, PodPhase
 from edl_tpu.observability.logging import get_logger
 
@@ -43,7 +43,7 @@ log = get_logger("updater")
 #: cluster pod-role name → TrainingResourceType (reference
 #: pkg/apis/paddlepaddle/v1/types.go:139-147).
 ROLE_TYPES = (("master", "MASTER"), ("pserver", "PSERVER"),
-              ("trainer", "TRAINER"))
+              ("trainer", "TRAINER"), ("server", "SERVER"))
 
 _POD_TO_RESOURCE_STATE = {
     PodPhase.PENDING: ResourceState.STARTING,
@@ -71,6 +71,11 @@ def compute_replica_statuses(cluster: Cluster, job_uid: str
         by_role.setdefault(p.role, []).append(p)
     statuses: list[TrainingResourceStatus] = []
     for role, rtype in ROLE_TYPES:
+        if role == "server" and role not in by_role:
+            # the serving role only reports when it exists: a
+            # TrainingJob's status keeps its historical three rows, a
+            # ServingJob grows its SERVER row from live pods
+            continue
         states = {
             p.name: _POD_TO_RESOURCE_STATE.get(p.phase, ResourceState.NONE)
             for p in by_role.get(role, ())
@@ -149,7 +154,7 @@ class TrainingJobUpdater:
     def init_resource(self) -> None:
         """None → Creating → Running|Failed (reference :417-449)."""
         try:
-            set_defaults_and_validate(self.job)
+            validate_any(self.job)  # kind-dispatching: training OR serving
         except ValidationError as exc:
             self._set_phase(JobPhase.FAILED, f"invalid spec: {exc}")
             return
@@ -173,17 +178,17 @@ class TrainingJobUpdater:
                 log.error("ready-wait: job_pods failed",
                           job=self.job.full_name, error=str(exc))
                 counts = None
+            min_replicas = self.job.group_range()[0]
             if counts is not None:
-                if counts.running >= self.job.spec.trainer.min_instance:
+                if counts.running >= min_replicas:
                     self._refresh_replica_statuses()
                     self._set_phase(JobPhase.RUNNING)
                     return
                 if self._now() > deadline:
                     self._set_phase(
                         JobPhase.FAILED,
-                        f"timed out waiting for "
-                        f"{self.job.spec.trainer.min_instance}"
-                        f" running trainers (have {counts.running})",
+                        f"timed out waiting for {min_replicas}"
+                        f" running replicas (have {counts.running})",
                     )
                     self._release()
                     return
@@ -210,10 +215,11 @@ class TrainingJobUpdater:
         self._refresh_replica_statuses()
 
         active = counts.running + counts.pending
-        if self.job.spec.fault_tolerant:
-            # FT: failed only when ALL trainers have failed (reference :359-368)
+        if self.job.replaceable_on_failure():
+            # FT trainers / serving replicas: failed only when ALL
+            # replicas have failed (reference :359-368)
             if counts.failed > 0 and active == 0 and counts.succeeded == 0:
-                self._set_phase(JobPhase.FAILED, "all trainers failed")
+                self._set_phase(JobPhase.FAILED, "all replicas failed")
                 self._release()
                 return
         else:
@@ -247,7 +253,7 @@ class TrainingJobUpdater:
         if counts.running != desired:
             self._set_phase(
                 JobPhase.SCALING,
-                f"trainers {counts.running} -> {desired}")
+                f"replicas {counts.running} -> {desired}")
         else:
             self._set_phase(JobPhase.RUNNING)
 

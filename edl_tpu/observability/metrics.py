@@ -46,8 +46,17 @@ from typing import Callable, Iterable, Optional
 #: Fixed histogram buckets (seconds) covering the stack's latency range:
 #: sub-ms step pauses up to the 120 s formation budget.  Fixed — not
 #: adaptive — so series from different processes/rounds are mergeable.
+#: The DEFAULT for histograms that don't declare their own boundaries;
+#: per-histogram buckets are accepted at first registration (serving
+#: request latencies are ms-scale and would crush into two of these).
 DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
                    1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0)
+
+#: ms-scale boundaries for request-latency histograms (seconds): 0.2 ms
+#: to 2.5 s, dense where an inference SLO lives.  Fixed like
+#: DEFAULT_BUCKETS so serving series merge across replicas/rounds.
+SERVING_LATENCY_BUCKETS = (0.0002, 0.0005, 0.001, 0.0025, 0.005, 0.01,
+                           0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5)
 
 #: rendered-name prefix: one namespace for every series the stack emits
 PREFIX = "edl_"
@@ -308,9 +317,25 @@ class MetricsRegistry:
         return self._get_or_create(name, Gauge, help=help)
 
     def histogram(self, name: str, help: str = "",
-                  buckets: Iterable[float] = DEFAULT_BUCKETS) -> Histogram:
-        return self._get_or_create(name, Histogram, help=help,
-                                   buckets=buckets)
+                  buckets: Optional[Iterable[float]] = None) -> Histogram:
+        """Get-or-create a histogram family.  ``buckets`` (first
+        registration only) sets per-histogram boundaries — ms-scale
+        serving latencies must not crush into the coarse
+        :data:`DEFAULT_BUCKETS`; omitted/None means "whatever the family
+        already uses, DEFAULT_BUCKETS for a new one".  Re-registering an
+        existing family with DIFFERENT explicit boundaries raises: two
+        call sites silently disagreeing on buckets would merge
+        incomparable distributions under one series name."""
+        fam = self._get_or_create(
+            name, Histogram, help=help,
+            buckets=DEFAULT_BUCKETS if buckets is None else buckets)
+        if buckets is not None:
+            want = tuple(sorted(float(b) for b in buckets))
+            if fam.buckets != want:
+                raise ValueError(
+                    f"histogram {name!r} already registered with buckets "
+                    f"{fam.buckets}; refusing conflicting {want}")
+        return fam
 
     def gauge_fn(self, name: str, fn: Callable[[], float], help: str = "",
                  **labels) -> None:

@@ -34,6 +34,13 @@ _EXPORTS = {
     "CursorStore": ("edl_tpu.runtime.virtual", "CursorStore"),
     "AccumulationAborted": ("edl_tpu.runtime.elastic",
                             "AccumulationAborted"),
+    # elastic inference serving (doc/serving.md)
+    "ElasticServer": ("edl_tpu.runtime.serving", "ElasticServer"),
+    "ServingReplica": ("edl_tpu.runtime.serving", "ServingReplica"),
+    "ServingFleet": ("edl_tpu.runtime.serving", "ServingFleet"),
+    "ServeRequest": ("edl_tpu.runtime.serving", "ServeRequest"),
+    "PoissonTraffic": ("edl_tpu.runtime.serving", "PoissonTraffic"),
+    "RequestDropped": ("edl_tpu.runtime.serving", "RequestDropped"),
 }
 
 __all__ = list(_EXPORTS)

@@ -479,6 +479,19 @@ class ElasticCheckpointer:
         self._unfinalized.clear()
         self._pending_meta.clear()
 
+    def refresh(self) -> None:
+        """Re-read the step store from disk.  Orbax's CheckpointManager
+        caches its step list, so a generation written by ANOTHER process
+        (the trainer feeding a serving fleet's lineage, a peer host's
+        collective save) is invisible until a reload — cross-process
+        readers call this before ``latest_verified_step``.  Best-effort:
+        an orbax without ``reload()`` keeps the cached view."""
+        self.wait_pending()
+        try:
+            self._mgr.reload()
+        except AttributeError:
+            pass
+
     def latest_step(self) -> Optional[int]:
         self.wait_pending()
         return self._mgr.latest_step()

@@ -325,7 +325,7 @@ def main(argv: list[str] | None = None) -> int:
     if not argv:
         print("usage: launcher "
               "{start_coordinator|start_trainer|start_static_trainer|"
-              "start_pserver}",
+              "start_pserver|start_server}",
               file=sys.stderr)
         return 2
     verb = argv[0]
@@ -333,6 +333,12 @@ def main(argv: list[str] | None = None) -> int:
     default_port = int(env.get("EDL_COORD_PORT", "7164"))
     if verb == "start_coordinator":
         return start_coordinator(default_port, argv[1:])
+    if verb == "start_server":
+        # ServingJob replica (doc/serving.md): continuous-batching model
+        # server fed from the EDL_SERVING_* contract the jobparser emits
+        from edl_tpu.runtime.serving import serve_main
+
+        return serve_main(env)
     if verb == "start_static_trainer":
         # non-FT pods (jobparser emits this verb when fault_tolerant is
         # off): barrier on the exact trainer count via the pod API —

@@ -58,6 +58,9 @@ class RunReport:
     #: was still compiling (deferred resize — the zero-stall alternative
     #: to blocking on an in-flight speculative compile)
     resize_deferred_steps: int = 0
+    #: the VirtualRunReport when the run was driven by VirtualBatches
+    #: (exactly-once row ledger, vw moves); None on the lease path
+    virtual: Optional[object] = None
 
     @property
     def first_loss(self) -> float:
@@ -83,6 +86,11 @@ class LocalElasticJob:
         prewarm_neighbors: bool = True,
         resize_defer_s: float = 30.0,
         shape_for: Optional[Callable[[int], object]] = None,
+        virtual=None,
+        shard_ids: Optional[list] = None,
+        fetch_shard: Optional[Callable] = None,
+        passes: int = 1,
+        use_virtual_batches: bool = True,
     ) -> None:
         self.job = job
         self.cluster = cluster
@@ -91,6 +99,19 @@ class LocalElasticJob:
         self.fetch = fetch
         self.batch_size = batch_size
         self.max_devices = max_devices or len(trainer._devices)
+        #: ROADMAP #2 (bounded slice): give the harness a VirtualConfig
+        #: (plus the shard stream) and the run is DRIVEN BY VirtualBatches
+        #: — the deterministic virtual-worker schedule with exactly-once
+        #: cursors — instead of first-come task leases, so the reference
+        #: loop and this production-path harness stop diverging.
+        #: ``use_virtual_batches=False`` is the opt-out knob (the legacy
+        #: lease path); with no ``virtual`` config the lease path is the
+        #: only option and remains the default behavior.
+        self.virtual = virtual
+        self.shard_ids = shard_ids
+        self.fetch_shard = fetch_shard
+        self.passes = int(passes)
+        self.use_virtual_batches = use_virtual_batches
         #: reparallelization policy: maps an observed pod count to the
         #: mesh layout this job should train on at that world size — an
         #: int (legacy pure-dp walk) or a MeshShape (live dp×fsdp…
@@ -190,7 +211,15 @@ class LocalElasticJob:
         atomic, so there is never a half-resized step — the reshard dance
         the reference never had to do (pservers held the params) collapses
         to one device_put between steps.
+
+        With a :class:`~edl_tpu.runtime.virtual.VirtualConfig` configured
+        (and not opted out), the drive is the deterministic virtual-worker
+        stream instead: see :meth:`_run_virtual`.
         """
+        if (self.use_virtual_batches and self.virtual is not None
+                and self.shard_ids is not None
+                and self.fetch_shard is not None):
+            return self._run_virtual(max_steps, on_step)
         report = RunReport()
         batches = TaskLeaseBatches(
             self.coord, worker=f"{self.job.full_name}/driver",
@@ -263,4 +292,50 @@ class LocalElasticJob:
                 on_step(report.steps, loss, self.trainer.world_size)
             if max_steps is not None and report.steps >= max_steps:
                 break
+        return report
+
+    def _run_virtual(
+        self,
+        max_steps: Optional[int],
+        on_step: Optional[Callable[[int, float, int], None]],
+    ) -> RunReport:
+        """The VirtualBatches drive (ROADMAP #2 REMAINING, bounded
+        slice): delegate the step semantics to
+        :class:`~edl_tpu.runtime.virtual.VirtualWorkerLoop` — the SAME
+        reference loop the equivalence harness, CI determinism smoke and
+        bench leg run — while THIS class keeps supplying the production
+        inputs: the desired world from live cluster pods, cursors/
+        ownership published to this job's coordinator.  Batch content,
+        RNG lineage and the effective global batch are therefore pure
+        functions of the job, never of the pod count, and the harness's
+        loss trajectory is resize-invariant (pinned bitwise by
+        tests/test_local_virtual.py)."""
+        from edl_tpu.runtime.virtual import (VirtualBatches,
+                                             VirtualWorkerLoop)
+
+        batches = VirtualBatches(self.virtual, self.shard_ids,
+                                 self.fetch_shard, passes=self.passes)
+        kv = self.coord if hasattr(self.coord, "kv_set") else None
+        loop = VirtualWorkerLoop(self.trainer, self.virtual, batches,
+                                 kv=kv, job=self.job.full_name)
+
+        def world_for(step: int) -> int:
+            return self.virtual.snap_world(self.desired_world_size())
+
+        vr = loop.run(max_steps=max_steps, world_size_for=world_for,
+                      on_step=on_step)
+        report = RunReport(
+            steps=len(vr.losses), losses=list(vr.losses),
+            world_sizes=list(vr.world_sizes), resizes=vr.resizes)
+        for evt in self.trainer.resize_events:
+            if evt.get("step") is None:
+                continue
+            report.resize_compile_ms.append(evt["compile_ms"])
+            report.resize_reshard_ms.append(evt["reshard_ms"])
+            report.resize_replan_ms.append(evt["replan_ms"])
+            report.resize_bytes_moved.append(evt["bytes_moved"])
+            report.prewarm_hits += int(evt["prewarm_hit"])
+        #: the exactly-once evidence rides along for callers that know
+        #: they ran virtually (rows_duplicated()/rows_missing())
+        report.virtual = vr
         return report

@@ -1,0 +1,1125 @@
+"""Elastic inference serving — the first non-training workload on the
+substrate (ROADMAP #4; doc/serving.md).
+
+Training proved the elastic machinery (prewarmed mesh bundles, hint→
+compile pipelines, transactional resizes, HA-replicated KV); serving is
+where it pays off fastest: QPS moves in minutes, and a scale-up that
+compiles on the traffic path blows the latency SLO.  This module turns
+the substrate user-facing:
+
+* **ElasticServer** — the forward-only twin of
+  :class:`~edl_tpu.runtime.elastic.ElasticTrainer`: the same
+  ``_MeshBundle`` machinery (per-layout compile cache, exactly-once
+  background builds, AOT against the known batch shape, transactional
+  resize with rollback) compiled for ``apply_fn(params, batch)`` instead
+  of a train step.  A replica may be a multi-chip mesh serving a sharded
+  model, resized live like a trainer.
+* **ServingReplica** — one model-server loop with **continuous
+  batching** (Orca, OSDI '22): every iteration packs whatever requests
+  the admission queue holds (up to ``max_batch_size``, padded to the
+  fixed compiled shape — no recompiles as load moves) into one serve
+  step; per-request latency lands in an ms-scale histogram and the SLO
+  violation counter.  Weight swaps apply **between** iterations, so a
+  reload never touches an in-flight request.
+* **ServingFleet** — the replica set: least-queue routing over READY
+  replicas, **hint→prewarm scale-up** (the autoscaler's plan builds and
+  AOT-compiles the new replica's serving step BEFORE traffic shifts —
+  the ready gate opens only once the compile is done, so the compile is
+  off the traffic path; hits/misses counted like mesh prewarm),
+  **graceful drain** on scale-down (zero dropped requests), and
+  **rolling weight reloads** from the elastic checkpoint lineage —
+  replicas swap to generation N+1 one at a time behind the ready gate.
+* **ServingScaler** lives in :mod:`edl_tpu.scheduler.autoscaler`: the
+  serving policy that targets p99-vs-SLO instead of trainer load.
+
+Scrape names (``edl_`` prefix): ``serving_request_seconds`` (histogram,
+:data:`~edl_tpu.observability.metrics.SERVING_LATENCY_BUCKETS`),
+``serving_queue_depth`` (histogram, observed per iteration),
+``serving_requests_total`` / ``serving_slo_violations_total`` /
+``serving_dropped_requests_total`` / ``serving_reloads_total`` /
+``serving_prewarm_hits_total`` / ``serving_prewarm_misses_total``
+(counters), ``serving_replicas_ready`` / ``serving_replicas_active`` /
+``serving_weight_generation`` (gauges, labeled ``job=``).
+"""
+
+from __future__ import annotations
+
+import collections
+import itertools
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Callable, Optional, Sequence
+
+import numpy as np
+
+from edl_tpu.observability.collector import get_counters
+from edl_tpu.observability.logging import get_logger
+from edl_tpu.observability.metrics import SERVING_LATENCY_BUCKETS, get_registry
+from edl_tpu.observability.tracing import get_tracer
+
+log = get_logger("runtime.serving")
+
+#: coordinator KV key carrying the fleet's current weight generation —
+#: rides HA replication like vw-map/vw-cursor, and is swept with them on
+#: job deletion (edl_tpu.coord.gc.JOB_KV_PREFIXES)
+SERVING_GEN_KEY = "serving-gen/{job}"
+
+#: replica lifecycle states
+BUILDING = "building"
+READY = "ready"
+RELOADING = "reloading"
+DRAINING = "draining"
+STOPPED = "stopped"
+
+
+def _request_hist():
+    return get_registry().histogram(
+        "serving_request_seconds",
+        help="end-to-end request latency (enqueue to reply)",
+        buckets=SERVING_LATENCY_BUCKETS)
+
+
+def _queue_hist():
+    return get_registry().histogram(
+        "serving_queue_depth",
+        help="admission-queue depth observed at each serve iteration",
+        buckets=(0, 1, 2, 4, 8, 16, 32, 64, 128, 256))
+
+
+@dataclass
+class ServeRequest:
+    """One in-flight inference request: a single example (tuple of
+    per-example arrays, no batch dim) and its completion future."""
+
+    payload: tuple
+    id: int = 0
+    t_enqueue: float = 0.0
+    t_done: float = 0.0
+
+    def __post_init__(self) -> None:
+        self._done = threading.Event()
+        self.result: Any = None
+        self.error: Optional[BaseException] = None
+
+    def complete(self, result: Any) -> None:
+        self.t_done = time.perf_counter()
+        self.result = result
+        self._done.set()
+
+    def fail(self, exc: BaseException) -> None:
+        self.t_done = time.perf_counter()
+        self.error = exc
+        self._done.set()
+
+    def wait(self, timeout: Optional[float] = None):
+        """Block for the reply; raises the replica-side error if the
+        request failed (a dropped request surfaces, never hangs)."""
+        if not self._done.wait(timeout):
+            raise TimeoutError(f"request {self.id} not served in {timeout}s")
+        if self.error is not None:
+            raise self.error
+        return self.result
+
+    @property
+    def latency_s(self) -> float:
+        return self.t_done - self.t_enqueue
+
+
+class RequestDropped(RuntimeError):
+    """The replica stopped without serving this request (only a forced,
+    non-draining stop can cause it — counted, asserted zero in bench/CI)."""
+
+
+class ElasticServer:
+    """Forward-only elastic model server over a resizable mesh — built
+    by wrapping :class:`ElasticTrainer`'s ``_MeshBundle`` machinery
+    (compile cache keyed by layout+devices, exactly-once background
+    builds, speculative prewarm, transactional resize with rollback)
+    around ``apply_fn(params, batch) -> outputs`` instead of a train
+    step.  ``serve()`` replaces ``step()``; there is no optimizer state
+    to speak of (an identity transformation keeps the trainer's
+    staging/reshard path intact with zero extra bytes)."""
+
+    def __init__(self, apply_fn: Callable[[Any, Any], Any], params: Any,
+                 **trainer_kwargs) -> None:
+        import optax
+
+        from edl_tpu.runtime.elastic import ElasticTrainer
+
+        self.apply_fn = apply_fn
+        outer = self
+
+        class _ForwardTrainer(ElasticTrainer):
+            """The subclass seam: same bundle lifecycle, forward-only
+            compilation.  Defined per-server so ``apply_fn`` closes over
+            cleanly without threading extra constructor args through the
+            trainer's signature."""
+
+            def _compile_step(self, bundle):
+                import jax
+
+                fwd = jax.jit(
+                    outer.apply_fn,
+                    in_shardings=(bundle.param_shardings,
+                                  bundle.batch_sharding))
+                return fwd, fwd
+
+            def _ensure_aot(self, bundle) -> None:
+                import jax
+
+                batch_abstract = self._batch_abstract
+                batch_spec = self._batch_spec
+                if batch_abstract is None or bundle.batch_spec == batch_spec:
+                    return
+                try:
+                    abstract = jax.tree.map(
+                        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype),
+                        self.state.params)
+                    compiled = bundle.step_fn.lower(
+                        abstract, batch_abstract).compile()
+                    bundle.compiled_step = compiled
+                    bundle.batch_spec = batch_spec
+                except Exception as exc:
+                    log.warn("AOT serve compile failed; first request "
+                             "will compile inline", size=bundle.mesh.size,
+                             error=str(exc)[:200])
+
+        self._trainer = _ForwardTrainer(
+            loss_fn=apply_fn, params=params,
+            optimizer=optax.identity(), **trainer_kwargs)
+
+    # -- the serving surface ------------------------------------------------
+
+    def serve(self, batch) -> Any:
+        """One forward pass on the current mesh (AOT executable when the
+        batch shape is known — the compile never rides a request)."""
+        t = self._trainer
+        t._remember_batch(batch)
+        import jax
+
+        batch = jax.device_put(batch, t._batch_sharding)
+        fn = t._step_fn
+        if (t._compiled_step is not None
+                and t._bundle_batch_spec == t._batch_spec):
+            fn = t._compiled_step
+        return fn(t.state.params, batch)
+
+    def warmup(self, batch) -> None:
+        """Teach the server its batch shape, AOT-compile the live
+        bundle, and run one real forward — the ready gate's compile
+        step: a replica warms up BEFORE traffic routes to it, so the
+        first request pays neither the compile nor the first-dispatch
+        overhead (transfer path setup, executable load)."""
+        import jax
+
+        t = self._trainer
+        t._remember_batch(batch)
+        t._ensure_aot(t._bundle)
+        # re-sync the committed fast-path pointers (commit happened
+        # before the AOT existed)
+        t._compiled_step = t._bundle.compiled_step
+        t._bundle_batch_spec = t._bundle.batch_spec
+        jax.block_until_ready(self.serve(batch))
+
+    def load_params(self, params: Any) -> None:
+        """Swap to new-generation weights: reshard onto the live
+        bundle's shardings (same tree structure — the lineage guarantees
+        it) and replace.  Callers serialize swaps between serve
+        iterations (ServingReplica does)."""
+        import jax
+
+        t = self._trainer
+        t.state.params = jax.device_put(params, t._param_shardings)
+
+    def params_host(self) -> Any:
+        """Host copy of the live weights (the restore template for
+        lineage reloads)."""
+        import jax
+
+        return jax.device_get(self._trainer.state.params)
+
+    # -- elastic passthroughs ----------------------------------------------
+
+    def resize(self, target) -> bool:
+        return self._trainer.resize(target)
+
+    def prewarm(self, sizes, wait: bool = False):
+        return self._trainer.prewarm(sizes, wait=wait)
+
+    @property
+    def world_size(self) -> int:
+        return self._trainer.world_size
+
+    @property
+    def resize_events(self) -> list:
+        return self._trainer.resize_events
+
+
+class ServingReplica:
+    """One replicated model server: an admission queue drained by a
+    continuous-batching loop over an :class:`ElasticServer`.
+
+    Each iteration admits up to ``max_batch_size`` queued requests
+    (waiting at most ``max_queue_ms`` for co-batchees once the first is
+    in hand), pads them to the fixed compiled shape, runs ONE serve
+    step, and completes every future with its row — so throughput
+    scales with load while the compiled shape (and therefore the
+    executable) never changes.  Weight swaps and drain both happen at
+    iteration boundaries: an in-flight request is never dropped by a
+    reload or a scale-down."""
+
+    def __init__(self, name: str, build: Callable[[], ElasticServer],
+                 example_batch: tuple, max_batch_size: int = 8,
+                 max_queue_ms: float = 2.0, job: str = "job",
+                 slo_p99_ms: float = 0.0,
+                 on_done: Optional[Callable[[ServeRequest], None]] = None
+                 ) -> None:
+        self.name = name
+        self.job = job
+        self.max_batch_size = max(int(max_batch_size), 1)
+        self.max_queue_ms = max(float(max_queue_ms), 0.0)
+        self.slo_p99_ms = float(slo_p99_ms)
+        self._build = build
+        self._example_batch = example_batch
+        self._on_done = on_done
+        self.server: Optional[ElasticServer] = None
+        self.state = BUILDING
+        self.generation: int = 0
+        self.iterations = 0
+        self.requests_served = 0
+        self._queue: "collections.deque[ServeRequest]" = collections.deque()
+        self._cond = threading.Condition()
+        self._pending_weights: Optional[tuple[Any, int]] = None
+        self._swap_applied = threading.Event()
+        self._ready_at: Optional[float] = None
+        self._built = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # metric handles resolved ONCE: the serve loop is the path whose
+        # p99 the SLO defends — per-iteration registry lookups (a global
+        # lock each) have no business on it
+        self._hist = _request_hist()
+        self._qhist = _queue_hist()
+        self._counters = get_counters()
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> "ServingReplica":
+        """Build (compile) on a background thread, then serve.  The
+        replica reports READY only once the serving step is compiled —
+        the ready gate that keeps the compile off the traffic path."""
+        self._thread = threading.Thread(target=self._run,
+                                        name=f"serving-{self.name}")
+        self._thread.start()
+        return self
+
+    def wait_ready(self, timeout_s: float = 120.0) -> bool:
+        return self._built.wait(timeout_s) and self.state != STOPPED
+
+    def _run(self) -> None:
+        t0 = time.perf_counter()
+        try:
+            self.server = self._build()
+            self.server.warmup(self._example_batch)
+        except Exception as exc:
+            log.error("replica build failed", replica=self.name,
+                      error=str(exc)[:200])
+            self.state = STOPPED
+            self._built.set()
+            self._fail_queue(exc)
+            return
+        build_s = time.perf_counter() - t0
+        with self._cond:
+            if self.state == BUILDING:
+                self.state = READY
+            self._ready_at = time.perf_counter()
+        self._built.set()
+        get_tracer().instant("serving_replica_ready", category="serving",
+                             replica=self.name,
+                             build_ms=round(build_s * 1000, 1))
+        log.info("serving replica ready", replica=self.name,
+                 build_ms=round(build_s * 1000, 1))
+        self._loop()
+
+    def stop(self, drain: bool = True, timeout_s: float = 30.0) -> bool:
+        """Stop serving.  ``drain=True`` (the graceful path) serves out
+        the queue first — zero dropped requests; ``drain=False`` fails
+        whatever is left (each one counted ``serving_dropped_requests``
+        and surfaced to its waiter as :class:`RequestDropped`)."""
+        with self._cond:
+            self.state = DRAINING if drain else STOPPED
+            self._cond.notify_all()
+        t = self._thread
+        if t is not None:
+            t.join(timeout_s)
+        with self._cond:
+            self.state = STOPPED
+            self._cond.notify_all()
+        self._fail_queue(RequestDropped(
+            f"replica {self.name} stopped before serving"))
+        return t is None or not t.is_alive()
+
+    def _fail_queue(self, exc: BaseException) -> None:
+        dropped = []
+        with self._cond:
+            while self._queue:
+                dropped.append(self._queue.popleft())
+        for req in dropped:
+            self._counters.inc("serving_dropped_requests", job=self.job)
+            req.fail(exc)
+
+    # -- admission ----------------------------------------------------------
+
+    def submit(self, req: ServeRequest) -> None:
+        with self._cond:
+            if self.state == STOPPED:
+                raise RequestDropped(f"replica {self.name} is stopped")
+            self._queue.append(req)
+            self._cond.notify_all()
+
+    def queue_depth(self) -> int:
+        with self._cond:
+            return len(self._queue)
+
+    def routable(self) -> bool:
+        return self.state == READY
+
+    def gate(self) -> bool:
+        """READY → RELOADING, atomically: the reload gate must not
+        clobber a concurrent stop()'s DRAINING/STOPPED (the serve loop
+        would never see the drain signal and a forced timeout would drop
+        the queue).  True iff this call took the gate."""
+        with self._cond:
+            if self.state != READY:
+                return False
+            self.state = RELOADING
+            return True
+
+    def ungate(self) -> None:
+        """RELOADING → READY — only if still gated; a stop() that won
+        the race keeps its state."""
+        with self._cond:
+            if self.state == RELOADING:
+                self.state = READY
+            self._cond.notify_all()
+
+    # -- weight reload ------------------------------------------------------
+
+    def swap_weights(self, params: Any, generation: int,
+                     timeout_s: float = 30.0) -> bool:
+        """Hand the loop new weights; applied at the next iteration
+        boundary (never mid-batch).  Blocks until applied."""
+        self._swap_applied.clear()
+        with self._cond:
+            if self.state == STOPPED:
+                return False
+            self._pending_weights = (params, generation)
+            self._cond.notify_all()
+        return self._swap_applied.wait(timeout_s)
+
+    def _maybe_swap(self) -> None:
+        with self._cond:
+            pending, self._pending_weights = self._pending_weights, None
+        if pending is None:
+            return
+        params, generation = pending
+        t0 = time.perf_counter()
+        self.server.load_params(params)
+        self.generation = generation
+        self._swap_applied.set()
+        self._counters.inc("serving_reloads", job=self.job)
+        get_tracer().instant(
+            "serving_weights_reloaded", category="serving",
+            replica=self.name, generation=generation,
+            swap_ms=round((time.perf_counter() - t0) * 1000, 2))
+        get_registry().gauge(
+            "serving_weight_generation",
+            help="checkpoint generation the replica serves"
+        ).set(generation, job=self.job, replica=self.name)
+
+    # -- the continuous-batching loop ---------------------------------------
+
+    def _admit(self) -> Optional[list[ServeRequest]]:
+        """Block for the next batch: the first queued request opens an
+        admission window of ``max_queue_ms`` (or until the batch is
+        full) — iteration-level batching, so a lone request never waits
+        for a full batch and a burst packs the step."""
+        with self._cond:
+            while not self._queue:
+                if self.state in (DRAINING, STOPPED):
+                    return None
+                if self._pending_weights is not None:
+                    return []  # idle swap: wake the loop to apply it
+                self._cond.wait(0.1)
+            if self.state == STOPPED:
+                return None  # forced stop: stop() fails the queue
+            if self.max_queue_ms > 0 and self.state == READY:
+                deadline = time.perf_counter() + self.max_queue_ms / 1000.0
+                while (len(self._queue) < self.max_batch_size
+                       and self.state == READY):
+                    remaining = deadline - time.perf_counter()
+                    if remaining <= 0:
+                        break
+                    self._cond.wait(remaining)
+            batch = [self._queue.popleft()
+                     for _ in range(min(len(self._queue),
+                                        self.max_batch_size))]
+        return batch
+
+    def _loop(self) -> None:
+        import jax
+
+        while True:
+            self._maybe_swap()
+            reqs = self._admit()
+            if reqs is None:
+                with self._cond:
+                    if self.state == DRAINING and self._queue:
+                        continue  # raced a late submit while draining
+                self._maybe_swap()  # a swap racing the drain still lands
+                return
+            if not reqs:
+                continue  # woke for an idle weight swap (applied above)
+            self._qhist.observe(self.queue_depth() + len(reqs),
+                                replica=self.name)
+            n = len(reqs)
+            # pad to the compiled shape: the executable is fixed at
+            # max_batch_size rows, so admission depth never recompiles
+            rows = [r.payload for r in reqs]
+            rows += [rows[-1]] * (self.max_batch_size - n)
+            batch = tuple(np.stack(col) for col in zip(*rows))
+            try:
+                out = self.server.serve(batch)
+                host = jax.tree.map(np.asarray, jax.device_get(out))
+            except Exception as exc:
+                log.error("serve iteration failed", replica=self.name,
+                          error=str(exc)[:200])
+                for req in reqs:
+                    self._counters.inc("serving_request_errors",
+                                       job=self.job)
+                    req.fail(exc)
+                continue
+            self.iterations += 1
+            for i, req in enumerate(reqs):
+                req.complete(jax.tree.map(lambda a: a[i], host))
+                self.requests_served += 1
+                lat = req.latency_s
+                self._hist.observe(lat, job=self.job)
+                self._counters.inc("serving_requests", job=self.job)
+                if self.slo_p99_ms and lat * 1000.0 > self.slo_p99_ms:
+                    self._counters.inc("serving_slo_violations",
+                                       job=self.job)
+                if self._on_done is not None:
+                    self._on_done(req)
+
+
+@dataclass
+class FleetStats:
+    """One windowed observation of the fleet — what the SLO autoscaling
+    policy (:class:`~edl_tpu.scheduler.autoscaler.ServingScaler`)
+    consumes."""
+
+    p50_ms: float = 0.0
+    p99_ms: float = 0.0
+    qps: float = 0.0
+    queue_depth: int = 0
+    replicas_ready: int = 0
+    replicas_active: int = 0
+    requests_windowed: int = 0
+
+
+class ServingFleet:
+    """The replica set behind one serving Service: least-queue routing,
+    hint→prewarm scale-up, graceful drain scale-down, rolling reloads.
+
+    ``build_server()`` makes one replica's :class:`ElasticServer`; the
+    fleet assigns each replica its device slice (``devices`` split into
+    ``chips_per_replica`` runs), so replicas never contend for a chip.
+    """
+
+    def __init__(
+        self,
+        apply_fn: Callable[[Any, Any], Any],
+        init_params: Any,
+        example_row: tuple,
+        *,
+        job: str = "job",
+        max_batch_size: int = 8,
+        max_queue_ms: float = 2.0,
+        slo_p99_ms: float = 0.0,
+        drain_timeout_s: float = 30.0,
+        chips_per_replica: int = 1,
+        devices: Optional[Sequence] = None,
+        kv=None,
+        window: int = 2048,
+    ) -> None:
+        import jax
+
+        self.apply_fn = apply_fn
+        self.init_params = init_params
+        self.job = job
+        self.max_batch_size = max(int(max_batch_size), 1)
+        self.max_queue_ms = float(max_queue_ms)
+        self.slo_p99_ms = float(slo_p99_ms)
+        self.drain_timeout_s = float(drain_timeout_s)
+        self.chips_per_replica = max(int(chips_per_replica), 1)
+        self._devices = list(devices) if devices is not None else jax.devices()
+        self._kv = kv
+        #: the fixed compiled batch: example_row stacked to max_batch_size
+        self.example_batch = tuple(
+            np.stack([np.asarray(a)] * self.max_batch_size)
+            for a in example_row)
+        self._lock = threading.RLock()
+        self._ids = itertools.count(1)
+        self._rr = itertools.count()
+        #: routable replicas (the active set the autoscaler dials)
+        self._replicas: list[ServingReplica] = []
+        #: hint-built standbys: compiling/compiled but NOT routable —
+        #: a later scale_to() activates them (the prewarm hit)
+        self._hinted: list[ServingReplica] = []
+        #: lifetime count of drained/failed replicas — references are
+        #: DROPPED once stopped (each retired replica holds a full set
+        #: of weights plus compiled executables; retaining them turns a
+        #: scale-oscillating fleet into a slow OOM)
+        self.replicas_retired = 0
+        #: weights a post-hoc scale-up must adopt (updated by every
+        #: rolling reload so a replica created later serves the fleet's
+        #: CURRENT generation, not the boot weights)
+        self._gen_params = init_params
+        self.generation = 0
+        self.prewarm_hits = 0
+        self.prewarm_misses = 0
+        #: rolling completion window: (t_done, latency_s)
+        self._window: "collections.deque[tuple[float, float]]" = (
+            collections.deque(maxlen=max(int(window), 16)))
+        self._watcher: Optional[_WeightWatcher] = None
+        self.register_metrics()
+
+    # -- replica construction ----------------------------------------------
+
+    def _max_replicas(self) -> int:
+        return max(len(self._devices) // self.chips_per_replica, 1)
+
+    def _slot_devices(self, slot: int):
+        n = self.chips_per_replica
+        lo = (slot * n) % max(len(self._devices) - n + 1, 1)
+        return self._devices[lo:lo + n]
+
+    def _new_replica(self, slot: int) -> ServingReplica:
+        devs = self._slot_devices(slot)
+        params = self.init_params
+
+        def build() -> ElasticServer:
+            return ElasticServer(self.apply_fn, params, devices=devs,
+                                 initial_world_size=len(devs))
+
+        r = ServingReplica(
+            name=f"{self.job}/r{slot}", build=build,
+            example_batch=self.example_batch,
+            max_batch_size=self.max_batch_size,
+            max_queue_ms=self.max_queue_ms, job=self.job,
+            slo_p99_ms=self.slo_p99_ms, on_done=self._record)
+        r.slot = slot
+        return r.start()
+
+    def _next_slot(self) -> int:
+        """Smallest device slot no live replica occupies — a drained
+        replica's chips are reusable by the next scale-up."""
+        used = {getattr(r, "slot", -1) for r in self._replicas + self._hinted}
+        slot = 0
+        while slot in used:
+            slot += 1
+        return slot
+
+    # -- scaling ------------------------------------------------------------
+
+    def hint(self, target: int) -> int:
+        """The autoscaler's plan hint: start building (and AOT-compiling)
+        the replicas a scale-up to ``target`` will need, BEFORE the
+        actuation/pods/traffic move — the serving twin of
+        ``ElasticTrainer.prewarm``.  Returns how many builds started.
+        Never blocks; never touches routing."""
+        started = 0
+        with self._lock:
+            target = min(int(target), self._max_replicas())
+            want = target - len(self._replicas) - len(self._hinted)
+            for _ in range(max(want, 0)):
+                self._hinted.append(self._new_replica(self._next_slot()))
+                started += 1
+        if started:
+            get_counters().inc("serving_prewarms", started, job=self.job)
+            log.info("serving prewarm hint", job=self.job, target=target,
+                     builds_started=started)
+        return started
+
+    def scale_to(self, target: int, wait_ready_s: float = 120.0) -> int:
+        """Actuate the replica count.  Growing first adopts hint-built
+        standbys (each one a recorded ``serving_prewarm_hit`` — its
+        compile started back at plan time, off the traffic path), then
+        builds the remainder inline (misses).  Shrinking drains the
+        newest replicas gracefully: routing stops immediately, queued
+        requests are served out, nothing is dropped.  Returns the new
+        active count."""
+        to_stop: list[ServingReplica] = []
+        adopted_total = 0
+        with self._lock:
+            target = max(1, min(int(target), self._max_replicas()))
+            while len(self._replicas) > target:
+                to_stop.append(self._replicas.pop())
+        # fill-then-prune, bounded: a replica whose background build
+        # FAILED (state STOPPED) must not be counted as active capacity
+        # forever — prune it and retry the slot a bounded number of
+        # times; persistent failures leave the fleet under target, which
+        # the scaler observes (replicas_active < target) and re-plans.
+        for _attempt in range(3):
+            adopted: list[ServingReplica] = []
+            with self._lock:
+                while len(self._replicas) < target:
+                    if self._hinted:
+                        r = self._hinted.pop(0)
+                        if r.state == STOPPED:
+                            # the standby's build already failed: not a
+                            # prewarm hit — drop it and fill the slot
+                            # from the next source
+                            self.replicas_retired += 1
+                            get_counters().inc(
+                                "serving_replica_build_failures",
+                                job=self.job)
+                            continue
+                        self.prewarm_hits += 1
+                        get_counters().inc("serving_prewarm_hits",
+                                           job=self.job)
+                    else:
+                        r = self._new_replica(self._next_slot())
+                        self.prewarm_misses += 1
+                        get_counters().inc("serving_prewarm_misses",
+                                           job=self.job)
+                    self._replicas.append(r)
+                    adopted.append(r)
+            for r in adopted:
+                # the ready gate: traffic only routes to a replica once
+                # its serving step is compiled — with a hint's head
+                # start this wait is ~0; without one it is the inline
+                # compile, which still never rides a REQUEST (existing
+                # replicas keep serving; the router skips BUILDING ones)
+                r.wait_ready(wait_ready_s)
+                if (self.generation and r.server is not None
+                        and r.state != STOPPED):
+                    r.swap_weights(self._gen_params, self.generation)
+            adopted_total += len(adopted)
+            with self._lock:
+                dead = [r for r in self._replicas if r.state == STOPPED]
+                for r in dead:
+                    self._replicas.remove(r)
+                    self.replicas_retired += 1
+            for r in dead:
+                log.warn("serving replica build failed; slot retried",
+                         replica=r.name)
+                get_counters().inc("serving_replica_build_failures",
+                                   job=self.job)
+            if not dead:
+                break
+        for r in to_stop:
+            r.stop(drain=True, timeout_s=self.drain_timeout_s)
+            with self._lock:
+                self.replicas_retired += 1
+        if to_stop or adopted_total:
+            get_tracer().instant(
+                "serving_scaled", category="serving", job=self.job,
+                target=target, adopted=adopted_total,
+                drained=len(to_stop), prewarm_hits=self.prewarm_hits)
+        return len(self._replicas)
+
+    # -- routing ------------------------------------------------------------
+
+    def submit(self, payload: tuple) -> ServeRequest:
+        """Admit one request: routed to the READY replica with the
+        shortest queue (a building/reloading replica receives no new
+        traffic; with none ready — transient, e.g. a single replica
+        mid-build — the request queues on the least-loaded live replica
+        and waits rather than failing)."""
+        req = ServeRequest(payload=tuple(np.asarray(a) for a in payload),
+                           id=next(self._ids),
+                           t_enqueue=time.perf_counter())
+        while True:
+            with self._lock:
+                live = [r for r in self._replicas if r.state != STOPPED]
+                ready = [r for r in live if r.routable()]
+                pool = ready or live
+                if not pool:
+                    raise RequestDropped(f"fleet {self.job} has no replicas")
+                # round-robin among equal queue depths so single-burst
+                # traffic spreads instead of piling on replica 0
+                k = next(self._rr)
+                target = min(
+                    range(len(pool)),
+                    key=lambda i: (pool[i].queue_depth(),
+                                   (i - k) % len(pool)))
+                replica = pool[target]
+            try:
+                replica.submit(req)
+                return req
+            except RequestDropped:
+                continue  # raced a stop; re-route
+
+    def _record(self, req: ServeRequest) -> None:
+        with self._lock:
+            self._window.append((req.t_done, req.latency_s))
+
+    # -- observation --------------------------------------------------------
+
+    def replicas_ready(self) -> int:
+        with self._lock:
+            return sum(1 for r in self._replicas if r.routable())
+
+    def replicas_active(self) -> int:
+        with self._lock:
+            return len(self._replicas)
+
+    def queue_depth(self) -> int:
+        with self._lock:
+            return sum(r.queue_depth() for r in self._replicas)
+
+    def stats(self, window_s: float = 10.0) -> FleetStats:
+        """Windowed p50/p99/qps over recent completions — the signal the
+        SLO policy scales on (a replica-side histogram would smear the
+        whole run; scaling needs the last few seconds)."""
+        now = time.perf_counter()
+        with self._lock:
+            window = list(self._window)
+            saturated = len(window) == self._window.maxlen
+            ready, active = (sum(1 for r in self._replicas if r.routable()),
+                             len(self._replicas))
+            depth = sum(r.queue_depth() for r in self._replicas)
+        recent = [(t, lat) for t, lat in window if now - t <= window_s]
+        if recent:
+            lats = np.sort(np.asarray([lat for _, lat in recent]))
+            p50 = float(lats[int(0.50 * (len(lats) - 1))]) * 1000.0
+            p99 = float(lats[int(0.99 * (len(lats) - 1))]) * 1000.0
+        else:
+            p50 = p99 = 0.0
+        # QPS denominator: normally the window length — but when the
+        # bounded deque EVICTED completions that were still inside the
+        # window (high load), dividing the kept count by the full window
+        # under-reports the rate exactly when the scaling policy needs
+        # it; the span actually covered by the kept entries is the
+        # honest denominator then
+        denom = window_s
+        if saturated and recent and (now - window[0][0]) <= window_s:
+            denom = max(now - recent[0][0], 1e-3)
+        return FleetStats(
+            p50_ms=round(p50, 3), p99_ms=round(p99, 3),
+            qps=round(len(recent) / denom, 2), queue_depth=depth,
+            replicas_ready=ready, replicas_active=active,
+            requests_windowed=len(recent))
+
+    def register_metrics(self, registry=None) -> None:
+        reg = registry if registry is not None else get_registry()
+        reg.gauge_fn("serving_replicas_ready", self.replicas_ready,
+                     help="replicas currently routable", job=self.job)
+        reg.gauge_fn("serving_replicas_active", self.replicas_active,
+                     help="replicas in the active set", job=self.job)
+        reg.gauge_fn("serving_fleet_queue_depth", self.queue_depth,
+                     help="queued requests across the fleet", job=self.job)
+
+    # -- rolling weight reloads --------------------------------------------
+
+    def rolling_reload(self, params: Any, generation: int) -> int:
+        """Swap every active replica to ``generation`` ONE AT A TIME
+        behind the ready gate: while a replica reloads it takes no new
+        traffic (peers absorb it), its queued requests are served before
+        the swap applies, and in-flight iterations always finish on the
+        weights they started with — zero dropped requests by
+        construction.  A single-replica fleet swaps in place (the
+        iteration boundary is the gate).  Returns replicas swapped."""
+        self._gen_params = params
+        swapped = 0
+        with self._lock:
+            replicas = list(self._replicas)
+        for r in replicas:
+            if r.state == STOPPED:
+                continue
+            with self._lock:
+                others_ready = sum(1 for o in self._replicas
+                                   if o is not r and o.routable())
+            # the gate is a CAS under the REPLICA's lock: a concurrent
+            # stop()/drain that won the state must not be clobbered
+            gate = bool(others_ready) and r.gate()
+            # wait for the gated replica's queue to empty so the swap
+            # lands between iterations with nothing of the old
+            # generation left waiting
+            deadline = time.perf_counter() + self.drain_timeout_s
+            while gate and r.queue_depth() > 0 \
+                    and time.perf_counter() < deadline:
+                time.sleep(0.001)
+            if r.swap_weights(params, generation,
+                              timeout_s=self.drain_timeout_s):
+                swapped += 1
+            if gate:
+                r.ungate()
+        self.generation = generation
+        if self._kv is not None:
+            try:
+                self._kv.kv_set(SERVING_GEN_KEY.format(job=self.job),
+                                str(generation).encode())
+            except Exception as exc:  # KV is observability here, not truth
+                log.warn("serving generation publish failed", job=self.job,
+                         error=str(exc)[:120])
+        log.info("rolling reload complete", job=self.job,
+                 generation=generation, replicas=swapped)
+        return swapped
+
+    def reload_from_lineage(self, checkpointer) -> Optional[int]:
+        """Roll onto the newest VERIFIED checkpoint generation if it is
+        newer than what the fleet serves (the elastic-checkpoint lineage
+        is the weight source of truth; a torn/corrupt step falls back
+        exactly as training restores do).  Returns the generation rolled
+        to, or None when already current."""
+        refresh = getattr(checkpointer, "refresh", None)
+        if refresh is not None:
+            # the lineage is written by ANOTHER process (the trainer);
+            # without a refresh the manager's cached step list never
+            # shows generation N+1
+            refresh()
+        step = checkpointer.latest_verified_step()
+        if step is None or step <= self.generation:
+            return None
+        with self._lock:
+            template = next((r.server for r in self._replicas
+                             if r.server is not None), None)
+        if template is None:
+            return None
+        restored = checkpointer.restore({"params": template.params_host()},
+                                        step=step)
+        self.rolling_reload(restored["params"], step)
+        return step
+
+    def watch_lineage(self, checkpointer, poll_s: float = 5.0
+                      ) -> "_WeightWatcher":
+        """Background thread polling the lineage for new generations —
+        the deployed path's reload driver (``reload_poll_s``)."""
+        self._watcher = _WeightWatcher(self, checkpointer, poll_s)
+        self._watcher.start()
+        return self._watcher
+
+    # -- teardown -----------------------------------------------------------
+
+    def stop(self, drain: bool = True) -> None:
+        if self._watcher is not None:
+            self._watcher.stop()
+        with self._lock:
+            replicas = self._replicas + self._hinted
+            self._replicas, self._hinted = [], []
+        for r in replicas:
+            r.stop(drain=drain, timeout_s=self.drain_timeout_s)
+
+
+class _WeightWatcher(threading.Thread):
+    def __init__(self, fleet: ServingFleet, checkpointer,
+                 poll_s: float) -> None:
+        super().__init__(name=f"serving-reload-{fleet.job}", daemon=True)
+        self.fleet = fleet
+        self.checkpointer = checkpointer
+        self.poll_s = max(float(poll_s), 0.1)
+        # NOT named _stop: threading.Thread owns a private _stop()
+        # method, and shadowing it with an Event breaks Thread.join()
+        self._halt = threading.Event()
+
+    def run(self) -> None:
+        while not self._halt.wait(self.poll_s):
+            try:
+                self.fleet.reload_from_lineage(self.checkpointer)
+            except Exception as exc:  # keep watching; a bad gen is skipped
+                log.warn("lineage reload failed", job=self.fleet.job,
+                         error=str(exc)[:200])
+
+    def stop(self) -> None:
+        self._halt.set()
+        self.join(timeout=5)
+
+
+# -- traffic generation (bench/CI/test harness) ------------------------------
+
+
+class PoissonTraffic:
+    """Seeded Poisson (exponential inter-arrival) open-loop traffic
+    against a fleet — the load model the serving bench leg and the CI
+    smoke drive: arrivals don't wait for replies, so a latency
+    regression shows up as queue growth and p99, exactly like
+    production."""
+
+    def __init__(self, fleet: ServingFleet, make_row: Callable[[int], tuple],
+                 qps: float, seed: int = 0) -> None:
+        self.fleet = fleet
+        self.make_row = make_row
+        self.qps = float(qps)
+        self.rng = np.random.default_rng(seed)
+        self.sent: list[ServeRequest] = []
+
+    def run(self, duration_s: float,
+            on_sent: Optional[Callable[[int], None]] = None
+            ) -> list[ServeRequest]:
+        """Fire requests for ``duration_s``; returns them all (callers
+        wait()/assert).  Runs open-loop on the calling thread."""
+        t_end = time.perf_counter() + duration_s
+        i = len(self.sent)
+        next_at = time.perf_counter()
+        while True:
+            now = time.perf_counter()
+            if now >= t_end:
+                return self.sent
+            if now < next_at:
+                time.sleep(min(next_at - now, 0.005))
+                continue
+            self.sent.append(self.fleet.submit(self.make_row(i)))
+            if on_sent is not None:
+                on_sent(i)
+            i += 1
+            next_at += float(self.rng.exponential(1.0 / self.qps))
+
+    def await_all(self, timeout_s: float = 30.0) -> dict:
+        """Wait for every sent request; returns the closed-loop tally
+        the bench/CI assert on (served / dropped / errors / latencies)."""
+        served = dropped = errors = timeouts = 0
+        lats: list[float] = []
+        deadline = time.perf_counter() + timeout_s
+        for req in self.sent:
+            try:
+                # past the shared deadline, poll instead of waiting: a
+                # wedged tail must cost O(ms) per request, not 100 ms
+                # each across thousands
+                req.wait(max(deadline - time.perf_counter(), 0.001))
+                served += 1
+                lats.append(req.latency_s)
+            except RequestDropped:
+                dropped += 1
+            except TimeoutError:
+                timeouts += 1
+            except Exception:
+                errors += 1
+        lat = np.sort(np.asarray(lats)) if lats else np.asarray([0.0])
+        return {
+            "sent": len(self.sent), "served": served,
+            "dropped": dropped, "errors": errors, "timeouts": timeouts,
+            "p50_ms": round(float(lat[int(0.50 * (len(lat) - 1))]) * 1e3, 3),
+            "p99_ms": round(float(lat[int(0.99 * (len(lat) - 1))]) * 1e3, 3),
+            "max_ms": round(float(lat[-1]) * 1e3, 3),
+        }
+
+
+# -- pod entrypoint ----------------------------------------------------------
+
+
+def serve_main(env=None) -> int:
+    """The ``start_server`` launcher verb: run one replica's model
+    server from the EDL_SERVING_* env contract the jobparser emits.
+
+    Loads the newest verified checkpoint generation from
+    ``EDL_SERVING_MODEL_DIR`` (the elastic lineage — an
+    ``ElasticCheckpointer`` store holding ``{"params": ...}``), builds
+    the model named by ``EDL_SERVING_MODEL`` (``mlp:IN,HID..,OUT``),
+    serves JSON ``POST /predict`` on ``EDL_SERVING_PORT``, watches the
+    lineage for rolling reloads, and answers ``/healthz`` 503 until the
+    serving step is compiled — the readiness gate the pod template
+    probes, which is what keeps the compile off the traffic path."""
+    import json as _json
+    import os
+    import signal
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    from edl_tpu.runtime.checkpoint import ElasticCheckpointer
+
+    env = os.environ if env is None else env
+    model_dir = env.get("EDL_SERVING_MODEL_DIR", "")
+    if not model_dir:
+        print("error: EDL_SERVING_MODEL_DIR not set (the jobparser emits "
+              "it from spec.server.model_dir)")
+        return 2
+    model = env.get("EDL_SERVING_MODEL", "mlp:16,32,4")
+    kind, _, shape = model.partition(":")
+    if kind != "mlp":
+        print(f"error: unknown EDL_SERVING_MODEL kind {kind!r}")
+        return 2
+    sizes = [int(x) for x in shape.split(",")]
+    import jax
+
+    from edl_tpu.models import mlp
+
+    ckpt = ElasticCheckpointer(model_dir)
+    template = {"params": mlp.init(jax.random.key(0), sizes)}
+    step = ckpt.latest_verified_step()
+    params = (ckpt.restore(template, step=step)["params"]
+              if step is not None else template["params"])
+    job = f"{env.get('EDL_NAMESPACE', 'default')}/{env.get('EDL_JOB_NAME', 'serving')}"
+    fleet = ServingFleet(
+        lambda p, b: mlp.apply(p, b[0]), params,
+        example_row=(np.zeros((sizes[0],), np.float32),),
+        job=job,
+        max_batch_size=int(env.get("EDL_SERVING_MAX_BATCH", "8")),
+        max_queue_ms=float(env.get("EDL_SERVING_MAX_QUEUE_MS", "2.0")),
+        slo_p99_ms=float(env.get("EDL_SERVING_SLO_P99_MS", "0")),
+        drain_timeout_s=float(env.get("EDL_SERVING_DRAIN_S", "30")))
+    fleet.generation = step or 0
+    fleet.scale_to(1)
+    poll_s = float(env.get("EDL_SERVING_RELOAD_POLL_S", "5"))
+    if poll_s > 0:
+        fleet.watch_lineage(ckpt, poll_s)
+
+    health_port = int(env.get("EDL_HEALTH_PORT", "8080"))
+    health = None
+    if health_port >= 0:
+        from edl_tpu.observability.health import serve_health
+
+        health = serve_health(health_port,
+                              {"replica_ready":
+                               lambda: fleet.replicas_ready() >= 1})
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_POST(self):  # noqa: N802 (http.server casing)
+            if self.path != "/predict":
+                self.send_error(404)
+                return
+            try:
+                body = self.rfile.read(
+                    int(self.headers.get("Content-Length", "0")))
+                row = _json.loads(body.decode())["inputs"]
+                req = fleet.submit((np.asarray(row, np.float32),))
+                out = req.wait(timeout=30.0)
+                payload = _json.dumps({
+                    "outputs": np.asarray(out).tolist(),
+                    "generation": fleet.generation,
+                    "latency_ms": round(req.latency_s * 1000, 3),
+                }).encode()
+            except Exception as exc:
+                self.send_error(500, str(exc)[:120])
+                return
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+            self.wfile.write(payload)
+
+        def log_message(self, *a):  # quiet; metrics carry the signal
+            pass
+
+    srv = ThreadingHTTPServer(
+        ("0.0.0.0", int(env.get("EDL_SERVING_PORT", "8500"))), Handler)
+    log.info("model server ready", job=job, generation=fleet.generation,
+             port=srv.server_address[1])
+    stop = threading.Event()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            signal.signal(sig, lambda *_: stop.set())
+        except ValueError:
+            pass  # not the main thread (tests)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        while not stop.wait(0.5):
+            pass
+    finally:
+        srv.shutdown()
+        fleet.stop(drain=True)  # graceful: finish the queue, drop nothing
+        if health is not None:
+            health.shutdown()
+    return 0

@@ -362,3 +362,249 @@ class Autoscaler:
                 except Exception as exc:
                     log.warn("error updating trainer group", job=uid,
                              error=str(exc), remaining_retry=UPDATE_RETRIES - retry - 1)
+
+
+# -- serving: SLO-driven replica scaling -------------------------------------
+
+
+class ServingScaler:
+    """The serving policy: scale replica counts on p99-vs-SLO and
+    per-replica throughput instead of trainer load (doc/serving.md).
+
+    Where :class:`Autoscaler` packs trainer counts against cluster
+    capacity, a serving fleet defends a LATENCY objective: the windowed
+    p99 crossing ``slo_p99_ms`` (or QPS exceeding the per-replica
+    target) grows the fleet immediately; sustained headroom shrinks it
+    after a cooldown.  Scale-ups fire :attr:`hint_sink` BEFORE
+    actuation — the same head start the training prewarm pipeline gets:
+    the new replica's serving step AOT-compiles while the pod is still
+    being created, so the ready gate opens (and traffic shifts) with the
+    compile already paid.
+
+    ``stats_for(uid)`` supplies the signal — a
+    :class:`~edl_tpu.runtime.serving.FleetStats`-shaped object (windowed
+    p50/p99/qps/queue depth), scraped from replica /metrics in a real
+    deployment, read off the in-process fleet in the harness.
+    ``actuate(uid, n)`` applies the plan; when None, the cluster's
+    replica-group dial (``update_trainer_parallelism`` — the group dial
+    is workload-agnostic) is used with the same bounded retries the
+    trainer path gets.  Deterministic like Autoscaler: :meth:`tick` runs
+    one pass; :meth:`run` wraps it for production.
+    """
+
+    def __init__(
+        self,
+        cluster: Optional[Cluster] = None,
+        stats_for: Optional[Callable[[str], object]] = None,
+        actuate: Optional[Callable[[str, int], None]] = None,
+        loop_seconds: float = 2.0,
+        scale_down_cooldown_s: float = 30.0,
+        scale_up_cooldown_s: float = 2.0,
+        shrink_headroom: float = 0.3,
+        clock=time.monotonic,
+    ) -> None:
+        self.cluster = cluster
+        self.stats_for = stats_for
+        self.actuate = actuate
+        self.loop_seconds = loop_seconds
+        #: a shrink must wait this long after ANY scaling action — p99
+        #: recovers slowly after a resize and a premature shrink would
+        #: oscillate; scale-UPS take only the short up-cooldown (an SLO
+        #: breach is an emergency, flapping protection still applies)
+        self.scale_down_cooldown_s = scale_down_cooldown_s
+        self.scale_up_cooldown_s = scale_up_cooldown_s
+        #: shrink only while p99 is under this fraction of the SLO (and
+        #: the queue is empty) — the hysteresis band between "breach ⇒
+        #: grow" and "idle ⇒ shrink"
+        self.shrink_headroom = shrink_headroom
+        self._clock = clock
+        self.jobs: dict[str, object] = {}  # uid → ServingJob
+        self._last_change: dict[str, float] = {}
+        self._targets: dict[str, int] = {}
+        self.plan_history: list[dict] = []
+        #: fires (uid, target_replicas) the moment a plan is decided,
+        #: BEFORE actuation — wire to ServingFleet.hint (in-process) or
+        #: to whatever warms pods in a deployment.  Exceptions are
+        #: swallowed: hints are an optimization, never a dependency.
+        self.hint_sink: Optional[Callable[[str, int], None]] = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- registry ----------------------------------------------------------
+
+    def on_add(self, job) -> None:
+        self.jobs[job.full_name] = job
+        self._targets.setdefault(job.full_name, job.spec.min_replicas)
+
+    def on_update(self, job) -> None:
+        self.jobs[job.full_name] = job
+
+    def on_del(self, job) -> None:
+        self.jobs.pop(job.full_name, None)
+        self._last_change.pop(job.full_name, None)
+        self._targets.pop(job.full_name, None)
+        from edl_tpu.observability.metrics import get_registry
+
+        get_registry().gauge("serving_target_replicas").remove(
+            job=job.full_name)
+
+    # -- the policy --------------------------------------------------------
+
+    def decide(self, job, stats, current: int) -> Optional[int]:
+        """Pure policy: (spec, windowed stats, current replicas) → new
+        target, or None to hold.  Grow on an SLO p99 breach or QPS above
+        the per-replica target (queue pressure adds replicas
+        proportionally, not one-at-a-time — a traffic step function
+        should converge in one or two plans); shrink one step at a time
+        inside the headroom band."""
+        s = job.spec
+        lo, hi = job.group_range()
+        current = max(int(current), 1)
+        # no window yet (cold fleet, idle service): nothing to decide on
+        if stats is None or stats.requests_windowed == 0:
+            return None
+        want = current
+        if s.slo_p99_ms and stats.p99_ms > s.slo_p99_ms:
+            # breach: add capacity for the queue we can see — at least
+            # one replica, more when the backlog is deep
+            backlog = stats.queue_depth / max(s.max_batch_size, 1)
+            want = current + max(1, min(int(backlog / max(current, 1)),
+                                        current))
+        if s.target_qps_per_replica:
+            import math
+
+            by_qps = int(math.ceil(stats.qps / s.target_qps_per_replica))
+            want = max(want, by_qps)
+        if want <= current:
+            # consider shrinking: p99 comfortably inside the SLO, no
+            # queue, and the remaining replicas could absorb the load
+            fits_after = (not s.target_qps_per_replica
+                          or stats.qps <= s.target_qps_per_replica
+                          * (current - 1))
+            if (current > lo and stats.queue_depth == 0 and fits_after
+                    and (not s.slo_p99_ms
+                         or stats.p99_ms < s.slo_p99_ms
+                         * self.shrink_headroom)):
+                want = current - 1
+        want = max(lo, min(want, hi))
+        return want if want != current else None
+
+    def tick(self) -> dict[str, int]:
+        """One observe-decide-hint-actuate pass; returns actuated
+        targets."""
+        actuated: dict[str, int] = {}
+        now = self._clock()
+        for uid, job in list(self.jobs.items()):
+            stats = None
+            if self.stats_for is not None:
+                try:
+                    stats = self.stats_for(uid)
+                except Exception as exc:
+                    log.warn("serving stats source failed", job=uid,
+                             error=str(exc)[:200])
+                    continue
+            current = self._current(uid, job, stats)
+            target = self.decide(job, stats, current)
+            if target is None:
+                continue
+            last = self._last_change.get(uid, -1e18)
+            cooldown = (self.scale_up_cooldown_s if target > current
+                        else self.scale_down_cooldown_s)
+            if now - last < cooldown:
+                from edl_tpu.observability.collector import get_counters
+
+                get_counters().inc("resizes_suppressed",
+                                   reason="serving_cooldown")
+                continue
+            self._plan(uid, job, stats, current, target, now)
+            actuated[uid] = target
+        return actuated
+
+    def _current(self, uid: str, job, stats) -> int:
+        if stats is not None and getattr(stats, "replicas_active", 0):
+            return stats.replicas_active
+        if self.cluster is not None:
+            try:
+                return self.cluster.get_trainer_parallelism(job)
+            except Exception:
+                pass
+        return self._targets.get(uid, job.spec.min_replicas)
+
+    def _plan(self, uid: str, job, stats, current: int, target: int,
+              now: float) -> None:
+        from edl_tpu.observability.collector import get_counters
+        from edl_tpu.observability.metrics import get_registry
+
+        direction = "up" if target > current else "down"
+        log.info("serving scaling plan", job=uid, replicas=current,
+                 target=target, direction=direction,
+                 p99_ms=getattr(stats, "p99_ms", None),
+                 qps=getattr(stats, "qps", None),
+                 queue=getattr(stats, "queue_depth", None),
+                 slo_p99_ms=job.spec.slo_p99_ms)
+        self.plan_history.append({
+            "job": uid, "from": current, "target": target,
+            "p99_ms": getattr(stats, "p99_ms", None),
+            "qps": getattr(stats, "qps", None)})
+        get_counters().inc("autoscaler_serving_plans", direction=direction)
+        get_registry().gauge(
+            "serving_target_replicas",
+            help="the serving policy's current replica target"
+        ).set(target, job=uid)
+        if self.hint_sink is not None and target > current:
+            # hint BEFORE actuation: the plan is the earliest moment the
+            # new replica count is known — every millisecond of head
+            # start is serve-step compile time off the traffic path
+            try:
+                self.hint_sink(uid, target)
+            except Exception as exc:
+                log.warn("serving prewarm hint sink failed", job=uid,
+                         error=str(exc)[:200])
+        self._targets[uid] = target
+        self._last_change[uid] = now
+        if self.actuate is not None:
+            try:
+                self.actuate(uid, target)
+            except Exception as exc:
+                log.warn("serving actuation failed", job=uid,
+                         error=str(exc)[:200])
+            return
+        if self.cluster is not None:
+            for retry in range(UPDATE_RETRIES):
+                try:
+                    self.cluster.update_trainer_parallelism(job, target)
+                    break
+                except Exception as exc:
+                    log.warn("error updating server group", job=uid,
+                             error=str(exc),
+                             remaining_retry=UPDATE_RETRIES - retry - 1)
+
+    # -- loop --------------------------------------------------------------
+
+    def run(self) -> None:
+        while not self._stop.is_set():
+            self.tick()
+            self._stop.wait(self.loop_seconds)
+
+    def start(self) -> None:
+        self.register_metrics()
+        self._thread = threading.Thread(target=self.run, daemon=True,
+                                        name="serving-scaler")
+        self._thread.start()
+
+    def register_metrics(self, registry=None) -> None:
+        if registry is None:
+            from edl_tpu.observability.metrics import get_registry
+
+            registry = get_registry()
+        registry.gauge_fn("serving_jobs_tracked",
+                          lambda: len(self.jobs),
+                          help="serving jobs under SLO autoscaling")
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    def is_alive(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()

@@ -472,6 +472,68 @@ if JAX_PLATFORMS=cpu EDL_DRYRUN_INJECT=replicate \
 fi
 echo "dryrun sharding checks OK (n=2,4,8 + injected-regression control)"
 
+echo "== serving smoke (traffic through a prewarmed scale-up + rolling reload)"
+# Elastic inference tripwire (doc/serving.md): Poisson traffic against a
+# continuous-batching fleet through ONE hint→prewarm scale-up (the hit
+# asserted — the serve-step compile stayed off the traffic path) and one
+# rolling weight reload, with p99 under the smoke SLO, ZERO dropped
+# requests, and the edl_serving_* series green under the strict parser.
+JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=4" \
+python - <<'EOF'
+import threading, time
+
+import jax, numpy as np
+
+from tests.test_observability import parse_prometheus
+from edl_tpu.models import mlp
+from edl_tpu.observability.collector import get_counters
+from edl_tpu.observability.metrics import get_registry
+from edl_tpu.runtime.serving import PoissonTraffic, ServingFleet
+
+SLO_MS = 150.0  # smoke SLO: generous for loaded CI hosts
+params = mlp.init(jax.random.key(0), [16, 32, 4])
+fleet = ServingFleet(lambda p, b: mlp.apply(p, b[0]), params,
+                     example_row=(np.zeros((16,), np.float32),),
+                     job="ci/serving", max_batch_size=8, max_queue_ms=1.0,
+                     slo_p99_ms=SLO_MS, drain_timeout_s=10.0)
+try:
+    fleet.scale_to(1)
+    traffic = PoissonTraffic(
+        fleet, lambda i: (np.full((16,), i % 9, np.float32),),
+        qps=200, seed=3)
+    # the autoscaler-plan moment: hint FIRST (build starts off the
+    # traffic path), actuate second — the adoption is the prewarm hit
+    fleet.hint(2)
+    traffic.run(1.0)
+    fleet.scale_to(2)
+    assert fleet.prewarm_hits >= 1, "scale-up missed the prewarmed replica"
+    # rolling reload to generation 2 while traffic keeps flowing
+    p2 = jax.tree.map(lambda a: a * 1.01, params)
+    rl = threading.Thread(target=lambda: fleet.rolling_reload(p2, 2))
+    rl.start(); traffic.run(1.5); rl.join()
+    tally = traffic.await_all(timeout_s=30.0)
+    assert tally["dropped"] == 0, tally
+    assert tally["errors"] == 0 and tally["timeouts"] == 0, tally
+    assert tally["p99_ms"] <= SLO_MS, tally
+    assert fleet.generation == 2
+    # a post-reload answer comes from generation 2's weights
+    got = np.asarray(fleet.submit((np.ones((16,), np.float32),)).wait(10))
+    want = np.asarray(mlp.apply(p2, np.ones((1, 16), np.float32)))[0]
+    assert np.allclose(got, want)
+finally:
+    fleet.stop()
+c = get_counters()
+assert c.get("serving_dropped_requests", job="ci/serving") == 0
+s = parse_prometheus(get_registry().render())
+assert s['edl_serving_requests_total{job="ci/serving"}'] >= tally["served"]
+assert s['edl_serving_prewarm_hits_total{job="ci/serving"}'] >= 1
+assert s['edl_serving_reloads_total{job="ci/serving"}'] >= 2
+assert s['edl_serving_request_seconds_count{job="ci/serving"}'] > 0
+print("serving smoke OK:", {k: tally[k] for k in
+                            ("served", "p50_ms", "p99_ms", "dropped")},
+      "prewarm_hits", fleet.prewarm_hits, "generation", fleet.generation)
+EOF
+
 echo "== determinism smoke (scripted 2→1→2 resize vs unresized control)"
 # Accuracy-consistent elasticity tripwire: the SAME seeded job run with
 # a scripted 2→1→2 resize must match the unresized control's loss

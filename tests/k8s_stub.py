@@ -370,6 +370,35 @@ class _AppsV1Api:
     def create_namespaced_replica_set(self, namespace: str, manifest: dict):
         self._s.replicasets[(namespace, manifest["metadata"]["name"])] = manifest
 
+    def _get(self, namespace: str, name: str) -> dict:
+        try:
+            return self._s.replicasets[(namespace, name)]
+        except KeyError:
+            raise ApiException(404, f"replicaset {name}") from None
+
+    def read_namespaced_replica_set(self, name: str, namespace: str) -> _Obj:
+        m = self._get(namespace, name)
+        return _Obj(
+            metadata=_Obj(name=name,
+                          resource_version=(m.get("metadata") or {})
+                          .get("resourceVersion", 0)),
+            spec=_Obj(replicas=(m.get("spec") or {}).get("replicas", 0)))
+
+    def replace_namespaced_replica_set(self, name: str, namespace: str,
+                                       body: _Obj):
+        """The serving replica dial (K8sCluster ServingJob actuation) —
+        same optimistic-concurrency semantics as the trainer Job."""
+        m = self._get(namespace, name)
+        meta = m.setdefault("metadata", {})
+        if self._s.conflicts_to_inject > 0:
+            self._s.conflicts_to_inject -= 1
+            meta["resourceVersion"] = meta.get("resourceVersion", 0) + 1
+            raise ApiException(409, "resourceVersion conflict")
+        if body.metadata.resource_version != meta.get("resourceVersion", 0):
+            raise ApiException(409, "resourceVersion conflict")
+        m.setdefault("spec", {})["replicas"] = body.spec.replicas
+        meta["resourceVersion"] = meta.get("resourceVersion", 0) + 1
+
     def delete_namespaced_replica_set(self, name: str, namespace: str,
                                       propagation_policy: str = ""):
         if (namespace, name) not in self._s.replicasets:

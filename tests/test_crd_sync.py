@@ -390,9 +390,10 @@ def test_list_verb_shows_recorded_phases(control_plane, capsys):
     wait_phase(sync, state, "job1", "Running")
     out = format_job_list(cluster)
     lines = out.splitlines()
-    assert lines[0].split()[:3] == ["NAMESPACE", "NAME", "PHASE"]
+    assert lines[0].split()[:4] == ["NAMESPACE", "NAME", "KIND", "PHASE"]
     row = [l for l in lines if " job1 " in f" {l} "][0]
     assert "Running" in row and "2" in row and "4" in row
+    assert "TrainingJob" in row
 
 
 def test_allow_multi_domain_flip_rejected_in_place(control_plane):

@@ -1,0 +1,129 @@
+"""ROADMAP #2 (bounded slice): the LocalElasticJob harness driven by
+VirtualBatches instead of first-come task leases — the reference loop
+and the production-path harness stop diverging.
+
+The pin: the SAME seeded job run through LocalElasticJob with a
+mid-run autoscaler-style resize matches (a) the never-resized
+VirtualWorkerLoop control BITWISE (replicated accumulation on CPU) and
+(b) trains every row exactly once.  The legacy lease path stays behind
+the ``use_virtual_batches=False`` opt-out."""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+import optax
+import pytest
+
+from edl_tpu.api.types import (
+    ResourceRequirements,
+    TrainerSpec,
+    TrainingJob,
+    TrainingJobSpec,
+)
+from edl_tpu.cluster.fake import FakeCluster
+from edl_tpu.coord import local_service
+from edl_tpu.models import mlp
+from edl_tpu.parallel.mesh import MeshSpec
+from edl_tpu.runtime.data import ShardRegistry, TaskLeaseBatches
+from edl_tpu.runtime.elastic import ElasticTrainer
+from edl_tpu.runtime.local import LocalElasticJob
+from edl_tpu.runtime.virtual import (
+    VirtualBatches,
+    VirtualConfig,
+    VirtualWorkerLoop,
+    loss_divergence,
+)
+
+CFG = VirtualConfig(vw_count=4, global_batch=32, job_seed=11)
+
+
+def _data():
+    rng = np.random.default_rng(4)
+    x = rng.normal(size=(1024, 16)).astype(np.float32)
+    y = rng.integers(0, 4, 1024).astype(np.int32)
+    reg = ShardRegistry()
+    ids = reg.register_arrays((x, y), num_shards=8)
+    return reg, ids
+
+
+def _trainer(world: int = 2) -> ElasticTrainer:
+    params = mlp.init(jax.random.key(0), [16, 32, 4])
+    return ElasticTrainer(mlp.loss_fn, params, optax.adam(1e-2),
+                          spec=MeshSpec(dp=-1), initial_world_size=world,
+                          accum_mode="replicated")
+
+
+def _job(lo=1, hi=8) -> TrainingJob:
+    return TrainingJob(name="vj", spec=TrainingJobSpec(
+        fault_tolerant=True,
+        trainer=TrainerSpec(min_instance=lo, max_instance=hi,
+                            resources=ResourceRequirements(
+                                requests={"cpu": "1"}))))
+
+
+def test_harness_virtual_drive_matches_control_bitwise():
+    if len(jax.devices()) < 4:
+        pytest.skip("needs a 4-device virtual CPU mesh")
+    reg, ids = _data()
+
+    # control: the reference loop, never resized, world 2
+    loop = VirtualWorkerLoop(_trainer(2), CFG,
+                             VirtualBatches(CFG, ids, reg.get),
+                             kv=local_service(), job="ctl")
+    control = loop.run(max_steps=16, world_size_for=lambda s: 2)
+
+    # the harness: LocalElasticJob on a live FakeCluster, pods 2→4 mid-run
+    cluster = FakeCluster()
+    for i in range(2):
+        cluster.add_node(f"n{i}", cpu_milli=16000, memory_mega=64000)
+    job = _job()
+    cluster.create_resources(job)
+    cluster.update_trainer_parallelism(job, 2)
+    coord = local_service()
+    runner = LocalElasticJob(
+        job, cluster, _trainer(2), coord, fetch=None, batch_size=32,
+        virtual=CFG, shard_ids=ids, fetch_shard=reg.get,
+        prewarm_neighbors=False)
+    grown = []
+
+    def on_step(step, loss, world):
+        if step >= 8 and not grown:
+            cluster.update_trainer_parallelism(job, 4)  # the autoscaler dial
+            grown.append(True)
+
+    report = runner.run(max_steps=16, on_step=on_step)
+
+    assert report.steps == 16
+    assert report.resizes == 1
+    assert set(report.world_sizes) == {2, 4}
+    div = loss_divergence(control.losses, report.losses)
+    assert div["bitwise"], div  # the resize is invisible to the loss curve
+    # exactly-once: the virtual evidence rides on the report
+    assert report.virtual is not None
+    assert report.virtual.rows_duplicated() == 0
+    assert report.virtual.rows_missing(expected=16 * CFG.global_batch) == 0
+    # and the harness published cursors/ownership to the job's coordinator
+    assert coord.kv_get(f"vw-map/{job.full_name}") is not None
+    assert coord.kv_get(f"vw-cursor/{job.full_name}") is not None
+
+
+def test_opt_out_keeps_the_lease_path():
+    """use_virtual_batches=False (or no virtual config at all) is the
+    legacy task-lease drive, unchanged."""
+    reg, ids = _data()
+    cluster = FakeCluster()
+    cluster.add_node("n0", cpu_milli=16000, memory_mega=64000)
+    job = _job()
+    cluster.create_resources(job)
+    coord = local_service()
+    reg.enqueue(coord, ids[:2])
+    runner = LocalElasticJob(
+        job, cluster, _trainer(1), coord, fetch=reg.fetch, batch_size=32,
+        virtual=CFG, shard_ids=ids, fetch_shard=reg.get,
+        use_virtual_batches=False, prewarm_neighbors=False)
+    report = runner.run(max_steps=4)
+    assert report.steps == 4
+    assert report.virtual is None  # lease path: no virtual evidence
+    assert isinstance(TaskLeaseBatches(coord, "w", reg.fetch, 32),
+                      TaskLeaseBatches)

@@ -454,6 +454,42 @@ class TestMetricsRegistry:
     """The unified plane: one registry, Prometheus exposition, and the
     Counters facade absorbed into it."""
 
+    def test_per_histogram_buckets(self):
+        """Serving latencies are ms-scale: a histogram may declare its
+        own boundaries at first registration, while omitting buckets
+        keeps DEFAULT_BUCKETS (existing series unchanged) and a
+        conflicting re-registration raises instead of silently merging
+        incomparable distributions under one name."""
+        import pytest
+
+        from edl_tpu.observability.metrics import (DEFAULT_BUCKETS,
+                                                   SERVING_LATENCY_BUCKETS,
+                                                   MetricsRegistry)
+
+        r = MetricsRegistry()
+        h = r.histogram("serving_request_seconds",
+                        buckets=SERVING_LATENCY_BUCKETS)
+        assert h.buckets == SERVING_LATENCY_BUCKETS
+        h.observe(0.0007)   # would crush into DEFAULT's first bucket
+        h.observe(0.003)
+        d = r.histogram("resize_phase_seconds")  # default boundaries
+        assert d.buckets == DEFAULT_BUCKETS
+        d.observe(0.3)
+        series = parse_prometheus(r.render())
+        # the ms-scale resolution is real: 0.0007 and 0.003 land in
+        # DIFFERENT custom buckets (DEFAULT's 0.001 lumps half of them)
+        assert series['edl_serving_request_seconds_bucket{le="0.001"}'] == 1
+        assert series['edl_serving_request_seconds_bucket{le="0.005"}'] == 2
+        assert series['edl_serving_request_seconds_count'] == 2
+        assert series['edl_resize_phase_seconds_bucket{le="0.5"}'] == 1
+        # same-name re-registration: omitted/matching buckets fine,
+        # conflicting boundaries refused
+        assert r.histogram("serving_request_seconds") is h
+        assert r.histogram("serving_request_seconds",
+                           buckets=SERVING_LATENCY_BUCKETS) is h
+        with pytest.raises(ValueError, match="buckets"):
+            r.histogram("serving_request_seconds", buckets=(1.0, 2.0))
+
     def test_counter_gauge_histogram_render_conform(self):
         from edl_tpu.observability.metrics import MetricsRegistry
 

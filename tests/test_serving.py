@@ -1,0 +1,531 @@
+"""Elastic inference serving (doc/serving.md): continuous batching,
+hint→prewarm scale-up behind the ready gate, graceful drain, rolling
+weight reloads from the checkpoint lineage, SLO-driven autoscaling, the
+ServingJob control-plane lifecycle, and job-scoped coordinator-KV GC."""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from edl_tpu.api.types import (
+    JobPhase,
+    ResourceRequirements,
+    ServingJob,
+    ServingSpec,
+)
+from edl_tpu.cluster.fake import FakeCluster
+from edl_tpu.models import mlp
+from edl_tpu.observability.collector import get_counters
+from edl_tpu.runtime.serving import (
+    ElasticServer,
+    PoissonTraffic,
+    ServingFleet,
+    ServingReplica,
+)
+from edl_tpu.scheduler.autoscaler import ServingScaler
+
+PARAMS = mlp.init(jax.random.key(0), [16, 32, 4])
+
+
+def apply_fn(p, b):
+    return mlp.apply(p, b[0])
+
+
+def make_fleet(job="t/svc", **kw) -> ServingFleet:
+    kw.setdefault("max_batch_size", 8)
+    kw.setdefault("max_queue_ms", 1.0)
+    kw.setdefault("drain_timeout_s", 5.0)
+    return ServingFleet(apply_fn, PARAMS,
+                        example_row=(np.zeros((16,), np.float32),),
+                        job=job, **kw)
+
+
+def row(i: int) -> tuple:
+    return (np.full((16,), i % 7, np.float32),)
+
+
+def expected(x_row: np.ndarray, params=PARAMS) -> np.ndarray:
+    return np.asarray(mlp.apply(params, x_row[None, :]))[0]
+
+
+# ---------------------------------------------------------- ElasticServer
+
+def test_elastic_server_forward_parity_and_reload():
+    srv = ElasticServer(apply_fn, PARAMS, initial_world_size=1)
+    batch = (np.random.default_rng(0).normal(size=(8, 16))
+             .astype(np.float32),)
+    srv.warmup(batch)
+    out = np.asarray(srv.serve(batch))
+    assert np.allclose(out, np.asarray(mlp.apply(PARAMS, batch[0])))
+    # weight swap: outputs flip to the new generation's
+    p2 = jax.tree.map(lambda a: a + 1.0, PARAMS)
+    srv.load_params(p2)
+    out2 = np.asarray(srv.serve(batch))
+    assert np.allclose(out2, np.asarray(mlp.apply(p2, batch[0])))
+
+
+def test_elastic_server_resize_preserves_outputs():
+    """A serving replica is elastic like a trainer: the mesh resizes
+    live (same _MeshBundle machinery) and the forward outputs are
+    unchanged — no checkpoint round-trip, no weight loss."""
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >= 2 devices")
+    srv = ElasticServer(apply_fn, PARAMS, initial_world_size=1)
+    batch = (np.ones((8, 16), np.float32),)
+    srv.warmup(batch)
+    before = np.asarray(srv.serve(batch))
+    assert srv.resize(2)
+    assert srv.world_size == 2
+    assert np.allclose(np.asarray(srv.serve(batch)), before, atol=1e-5)
+
+
+# ---------------------------------------------------- continuous batching
+
+def test_replica_batches_a_burst_into_few_iterations():
+    built = []
+
+    def build():
+        s = ElasticServer(apply_fn, PARAMS, initial_world_size=1)
+        built.append(s)
+        return s
+
+    r = ServingReplica("t/r0", build,
+                       example_batch=(np.zeros((8, 16), np.float32),),
+                       max_batch_size=8, max_queue_ms=5.0, job="t/cb")
+    r.start()
+    assert r.wait_ready(60)
+    from edl_tpu.runtime.serving import ServeRequest
+
+    reqs = [ServeRequest(payload=row(i), id=i,
+                         t_enqueue=time.perf_counter()) for i in range(24)]
+    for q in reqs:
+        r.submit(q)
+    for i, q in enumerate(reqs):
+        got = np.asarray(q.wait(10))
+        assert np.allclose(got, expected(row(i)[0])), i  # per-row correct
+    # 24 requests over batch-8 admission: packed, not one-per-iteration
+    assert r.iterations <= 6, r.iterations
+    assert r.requests_served == 24
+    r.stop(drain=True)
+
+
+def test_lone_request_is_not_held_for_a_full_batch():
+    fleet = make_fleet(job="t/lone")
+    try:
+        fleet.scale_to(1)
+        req = fleet.submit(row(3))
+        got = np.asarray(req.wait(10))
+        assert np.allclose(got, expected(row(3)[0]))
+        assert req.latency_s < 1.0  # admission window is ms-scale
+    finally:
+        fleet.stop()
+
+
+# ------------------------------------------------- scale up/down + drain
+
+def test_hint_then_scale_up_is_a_prewarm_hit():
+    fleet = make_fleet(job="t/hint")
+    try:
+        fleet.scale_to(1)
+        c0 = get_counters().get("serving_prewarm_hits", job="t/hint")
+        fleet.hint(2)  # the autoscaler's plan hint: build starts NOW
+        fleet.scale_to(2)  # actuation adopts the hint-built replica
+        assert fleet.prewarm_hits == 1
+        assert get_counters().get("serving_prewarm_hits",
+                                  job="t/hint") == c0 + 1
+        assert fleet.replicas_ready() == 2
+    finally:
+        fleet.stop()
+
+
+def test_scale_down_drains_without_dropping():
+    fleet = make_fleet(job="t/drain")
+    try:
+        fleet.scale_to(2)
+        reqs = [fleet.submit(row(i)) for i in range(64)]
+        fleet.scale_to(1)  # drains the departing replica's queue first
+        for i, q in enumerate(reqs):
+            np.asarray(q.wait(10))  # every single one served
+        assert fleet.replicas_active() == 1
+        assert get_counters().get("serving_dropped_requests",
+                                  job="t/drain") == 0
+    finally:
+        fleet.stop()
+
+
+def test_forced_stop_counts_drops_and_surfaces_them():
+    from edl_tpu.runtime.serving import RequestDropped
+
+    fleet = make_fleet(job="t/forced", max_queue_ms=50.0)
+    fleet.scale_to(1)
+    c0 = get_counters().get("serving_dropped_requests", job="t/forced")
+    reqs = [fleet.submit(row(i)) for i in range(32)]
+    fleet.stop(drain=False)  # the UNgraceful path
+    outcomes = []
+    for q in reqs:
+        try:
+            q.wait(5)
+            outcomes.append("served")
+        except RequestDropped:
+            outcomes.append("dropped")
+    dropped = outcomes.count("dropped")
+    assert dropped == get_counters().get("serving_dropped_requests",
+                                         job="t/forced") - c0
+    # a dropped request FAILS its future loudly — it never hangs a caller
+
+
+# ------------------------------------------------------- rolling reloads
+
+def test_rolling_reload_under_traffic_swaps_all_and_drops_nothing():
+    fleet = make_fleet(job="t/reload")
+    try:
+        fleet.scale_to(2)
+        p2 = jax.tree.map(lambda a: a * 2.0, PARAMS)
+        traffic = PoissonTraffic(fleet, row, qps=250, seed=3)
+        done = []
+        t = threading.Thread(target=lambda: done.append(
+            fleet.rolling_reload(p2, generation=5)))
+        th = threading.Thread(target=lambda: traffic.run(0.8))
+        th.start()
+        time.sleep(0.2)
+        t.start()
+        th.join()
+        t.join()
+        tally = traffic.await_all()
+        assert tally["dropped"] == 0 and tally["errors"] == 0, tally
+        assert done == [2]  # every replica swapped, one at a time
+        assert fleet.generation == 5
+        # post-reload answers come from generation 5's weights
+        req = fleet.submit(row(1))
+        assert np.allclose(np.asarray(req.wait(5)),
+                           expected(row(1)[0], p2))
+        assert get_counters().get("serving_reloads", job="t/reload") >= 2
+    finally:
+        fleet.stop()
+
+
+def test_reload_from_checkpoint_lineage(tmp_path):
+    """The deployed reload driver: generation N+1 appears in the elastic
+    checkpoint lineage (verified manifest) → the fleet rolls onto it;
+    a generation it already serves is a no-op."""
+    from edl_tpu.runtime.checkpoint import ElasticCheckpointer
+
+    ckpt = ElasticCheckpointer(tmp_path / "lineage", max_to_keep=3)
+    ckpt.save(1, {"params": PARAMS})
+    fleet = make_fleet(job="t/lineage", kv=None)
+    try:
+        fleet.scale_to(1)
+        fleet.generation = 1
+        assert fleet.reload_from_lineage(ckpt) is None  # already current
+        p2 = jax.tree.map(lambda a: a + 3.0, PARAMS)
+        ckpt.save(2, {"params": p2})
+        assert fleet.reload_from_lineage(ckpt) == 2
+        req = fleet.submit(row(2))
+        assert np.allclose(np.asarray(req.wait(5)),
+                           expected(row(2)[0], p2))
+    finally:
+        fleet.stop()
+        ckpt.close()
+
+
+def test_generation_published_to_coordinator_kv():
+    from edl_tpu.coord import PyCoordService
+
+    kv = PyCoordService()
+    fleet = make_fleet(job="t/gen", kv=kv)
+    try:
+        fleet.scale_to(1)
+        fleet.rolling_reload(jax.tree.map(lambda a: a + 1, PARAMS), 9)
+        assert kv.kv_get("serving-gen/t/gen") == b"9"
+    finally:
+        fleet.stop()
+
+
+# ------------------------------------------------------- the SLO policy
+
+def _job(lo=1, hi=8, slo=50.0, qps_target=0.0, batch=8) -> ServingJob:
+    return ServingJob(name="svc", spec=ServingSpec(
+        min_replicas=lo, max_replicas=hi, slo_p99_ms=slo,
+        target_qps_per_replica=qps_target, max_batch_size=batch))
+
+
+def _stats(p99=10.0, qps=10.0, depth=0, active=2, windowed=20):
+    from edl_tpu.runtime.serving import FleetStats
+
+    return FleetStats(p50_ms=p99 / 3, p99_ms=p99, qps=qps,
+                      queue_depth=depth, replicas_ready=active,
+                      replicas_active=active, requests_windowed=windowed)
+
+
+def test_policy_grows_on_p99_breach_and_holds_inside_slo():
+    sc = ServingScaler()
+    job = _job(slo=50.0)
+    assert sc.decide(job, _stats(p99=80.0, active=2), 2) == 3
+    assert sc.decide(job, _stats(p99=30.0, depth=1, active=2), 2) is None
+
+
+def test_policy_breach_with_deep_backlog_adds_proportionally():
+    sc = ServingScaler()
+    job = _job(slo=50.0, batch=8)
+    # queue of 64 ≈ 8 batches over 2 replicas → grow by more than one
+    assert sc.decide(job, _stats(p99=90.0, depth=64, active=2), 2) == 4
+
+
+def test_policy_qps_target_scales_by_throughput():
+    sc = ServingScaler()
+    job = _job(slo=0.0, qps_target=30.0)
+    assert sc.decide(job, _stats(p99=1.0, qps=100.0, active=2), 2) == 4
+    # and caps at max_replicas
+    job2 = _job(hi=3, slo=0.0, qps_target=10.0)
+    assert sc.decide(job2, _stats(qps=500.0, active=2), 2) == 3
+
+
+def test_policy_shrinks_only_with_headroom_and_empty_queue():
+    sc = ServingScaler()
+    job = _job(lo=1, slo=50.0)
+    assert sc.decide(job, _stats(p99=5.0, depth=0, active=3), 3) == 2
+    assert sc.decide(job, _stats(p99=5.0, depth=4, active=3), 3) is None
+    assert sc.decide(job, _stats(p99=30.0, depth=0, active=3), 3) is None
+    assert sc.decide(job, _stats(p99=5.0, depth=0, active=1), 1) is None
+    # a cold window (no requests) decides nothing
+    assert sc.decide(job, _stats(windowed=0), 3) is None
+
+
+def test_tick_hints_before_actuating_and_respects_cooldown():
+    clock = [100.0]
+    calls: list[str] = []
+    stats = {"default/svc": _stats(p99=80.0, active=2)}
+    sc = ServingScaler(stats_for=lambda uid: stats[uid],
+                       actuate=lambda uid, n: calls.append(f"act:{n}"),
+                       clock=lambda: clock[0])
+    sc.hint_sink = lambda uid, n: calls.append(f"hint:{n}")
+    sc.on_add(_job())
+    out = sc.tick()
+    assert out == {"default/svc": 3}
+    assert calls == ["hint:3", "act:3"]  # hint FIRST — the head start
+    # breach persists inside the up-cooldown: suppressed, no thrash
+    stats["default/svc"] = _stats(p99=80.0, active=3)
+    assert sc.tick() == {}
+    clock[0] += 10.0
+    assert sc.tick() == {"default/svc": 4}
+    # shrink waits out the longer down-cooldown
+    stats["default/svc"] = _stats(p99=2.0, active=4)
+    clock[0] += 10.0
+    assert sc.tick() == {}
+    clock[0] += sc.scale_down_cooldown_s
+    assert sc.tick() == {"default/svc": 3}
+
+
+def test_scaler_drives_a_live_fleet_through_a_burst():
+    """Closed loop: Poisson burst → p99 breaches → scaler hints+scales
+    the real fleet → burst absorbed with zero drops."""
+    fleet = make_fleet(job="default/svc", slo_p99_ms=60.0, max_queue_ms=0.5)
+    try:
+        fleet.scale_to(1)
+        job = _job(lo=1, hi=3, slo=60.0)
+        sc = ServingScaler(
+            stats_for=lambda uid: fleet.stats(window_s=2.0),
+            actuate=lambda uid, n: fleet.scale_to(n))
+        sc.hint_sink = lambda uid, n: fleet.hint(n)
+        sc.on_add(job)
+        traffic = PoissonTraffic(fleet, row, qps=400, seed=7)
+        th = threading.Thread(target=lambda: traffic.run(2.0))
+        th.start()
+        grew = False
+        for _ in range(40):
+            time.sleep(0.05)
+            if sc.tick():
+                grew = True
+        th.join()
+        tally = traffic.await_all(timeout_s=30)
+        assert tally["dropped"] == 0 and tally["errors"] == 0, tally
+        assert grew or fleet.stats().p99_ms <= 60.0
+    finally:
+        fleet.stop()
+
+
+# ------------------------------------------- control plane + phases + GC
+
+def _cluster(nodes=4) -> FakeCluster:
+    c = FakeCluster()
+    for i in range(nodes):
+        c.add_node(f"n{i}", cpu_milli=8000, memory_mega=32000)
+    return c
+
+
+def _serving_job(name="svc", lo=2, hi=6) -> ServingJob:
+    return ServingJob(name=name, spec=ServingSpec(
+        min_replicas=lo, max_replicas=hi, slo_p99_ms=50.0,
+        resources=ResourceRequirements(requests={"cpu": "1"})))
+
+
+def test_controller_lifecycle_on_fake_cluster():
+    from edl_tpu.controller.controller import Controller
+
+    cluster = _cluster()
+    ctl = Controller(cluster, updater_convert_seconds=0.05,
+                     updater_confirm_seconds=0.05)
+    try:
+        job = _serving_job()
+        u = ctl.submit(job)
+        deadline = time.monotonic() + 10
+        while u.phase != JobPhase.RUNNING and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert u.phase == JobPhase.RUNNING
+        pods = cluster.list_pods(job_uid="default/svc", role="server")
+        assert len(pods) == 2
+        # serving jobs register with the SLO scaler, NOT the trainer
+        # packing loop
+        assert "default/svc" in ctl.serving_scaler.jobs
+        assert "default/svc" not in ctl.autoscaler.jobs
+        # per-role status carries a SERVER row from live pods
+        from edl_tpu.controller.updater import compute_replica_statuses
+
+        rows = {s.resource_type: s
+                for s in compute_replica_statuses(cluster, "default/svc")}
+        assert rows["SERVER"].state.value == "Running"
+        assert len(rows["SERVER"].resource_states) == 2
+        # the replica dial scales the group (SCALING phase surfaces)
+        cluster.update_trainer_parallelism(job, 4)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if len(cluster.list_pods(job_uid="default/svc",
+                                     role="server")) == 4:
+                break
+            time.sleep(0.02)
+        assert len(cluster.list_pods(job_uid="default/svc",
+                                     role="server")) == 4
+        ctl.delete(job)
+        assert cluster.list_pods(job_uid="default/svc") == []
+    finally:
+        ctl.stop()
+
+
+def test_failed_server_pod_is_replaced():
+    """ReplicaSet semantics: a crashed server is replaced, the job never
+    statically fails (replaceable_on_failure)."""
+    cluster = _cluster()
+    job = _serving_job(lo=2, hi=2)
+    job.image = "img"
+    cluster.create_resources(job)
+    pods = cluster.list_pods(job_uid="default/svc", role="server")
+    assert len(pods) == 2
+    cluster.kill_pod(pods[0].name)
+    live = [p for p in cluster.list_pods(job_uid="default/svc",
+                                         role="server")
+            if p.phase.value == "Running"]
+    assert len(live) == 2  # replacement spawned
+
+
+def test_job_deletion_sweeps_job_scoped_coordinator_kv():
+    """The GC satellite: goodput-curve/vw-map/vw-cursor/serving-gen keys
+    outlive every reform and failover but NOT the job — controller
+    delete sweeps exactly the deleted job's keys."""
+    from edl_tpu.controller.controller import Controller
+    from edl_tpu.coord import PyCoordService
+    from edl_tpu.coord.gc import JOB_KV_PREFIXES, gc_job_kv
+
+    coord = PyCoordService()
+    for prefix in JOB_KV_PREFIXES:
+        coord.kv_set(f"{prefix}default/svc", b"x")
+        coord.kv_set(f"{prefix}default/other", b"y")
+    # the survivor sharing a NAME PREFIX with the victim must survive the
+    # sweep (exact-uid scoping, not startswith)
+    coord.kv_set("vw-map/default/svc2", b"z")
+
+    cluster = _cluster()
+    ctl = Controller(cluster, updater_convert_seconds=0.05,
+                     updater_confirm_seconds=0.05,
+                     coord_for=lambda job: coord)
+    try:
+        job = _serving_job()
+        ctl.submit(job)
+        ctl.delete(job)
+        for prefix in JOB_KV_PREFIXES:
+            assert coord.kv_get(f"{prefix}default/svc") is None, prefix
+            assert coord.kv_get(f"{prefix}default/other") == b"y", prefix
+        assert coord.kv_get("vw-map/default/svc2") == b"z"
+    finally:
+        ctl.stop()
+    # direct-call form (prune path / operator tooling)
+    coord.kv_set("goodput-curve/j", b"x")
+    coord.kv_set("vw-cursor/j", b"x")
+    assert gc_job_kv(coord, "j") == 2
+    assert gc_job_kv(coord, "j") == 0  # idempotent
+
+
+def test_serving_cr_drives_controller_through_stub_apiserver(control_plane):
+    """Deployed path: `kubectl apply` a ServingJob CR → sync loop →
+    controller materializes the server ReplicaSet + Service → pods come
+    up → the CR's recorded status reaches Running → delete tears down."""
+    cluster, controller, sync, state = control_plane
+    from tests.k8s_stub import make_pod
+
+    cr = {
+        "apiVersion": "edl.tpu/v1",
+        "kind": "ServingJob",
+        "metadata": {"name": "svc1", "namespace": "default"},
+        "spec": {
+            "image": "edl-tpu/serve:latest",
+            "server": {"minReplicas": 2, "max-replicas": 4,
+                       "slo_p99_ms": 50,
+                       "resources": {"requests": {"cpu": "1"}}},
+        },
+    }
+    cluster.create_serving_job_cr(cr)
+    sync.run_once()
+    assert ("default", "svc1-server") in state.replicasets
+    assert ("default", "svc1-serve") in state.services
+    # kubelet: server pods come up Running
+    for i in range(2):
+        state.pods.append(make_pod(
+            f"svc1-server-{i}", phase="Running", node="a0",
+            labels={"edl-tpu-serving": "svc1"}, cpu="1"))
+    deadline = time.monotonic() + 15
+    recorded = None
+    while time.monotonic() < deadline:
+        sync.run_once()
+        obj = state.custom_objects.get(
+            ("edl.tpu", "default", "servingjobs", "svc1"))
+        recorded = (obj or {}).get("status")
+        if recorded and recorded.get("phase") == "Running":
+            break
+        time.sleep(0.05)
+    assert recorded and recorded["phase"] == "Running", recorded
+    server_rows = [r for r in recorded["replica_statuses"]
+                   if r["resource_type"] == "SERVER"]
+    assert server_rows and server_rows[0]["state"] == "Running"
+    # kubectl delete sj svc1 → full teardown
+    cluster.delete_serving_job_cr("svc1")
+    sync.run_once()
+    assert ("default", "svc1-server") not in state.replicasets
+
+
+# --------------------------------------------------------------- metrics
+
+def test_serving_series_render_under_the_strict_parser():
+    from edl_tpu.observability.metrics import get_registry
+    from tests.test_observability import parse_prometheus
+
+    fleet = make_fleet(job="t/metrics")
+    try:
+        fleet.scale_to(1)
+        for i in range(12):
+            fleet.submit(row(i)).wait(10)
+        series = parse_prometheus(get_registry().render())
+        assert series['edl_serving_requests_total{job="t/metrics"}'] >= 12
+        assert series['edl_serving_replicas_ready{job="t/metrics"}'] == 1
+        # the ms-scale histogram actually resolves ms latencies: at
+        # least one strictly-sub-DEFAULT-bucket boundary carries counts
+        key = ('edl_serving_request_seconds_bucket'
+               '{job="t/metrics",le="0.0005"}')
+        assert key in series
+        assert series['edl_serving_request_seconds_count'
+                      '{job="t/metrics"}'] >= 12
+    finally:
+        fleet.stop()

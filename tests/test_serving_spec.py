@@ -1,0 +1,231 @@
+"""ServingJob spec surface: serde round-trips (kebab/camel), CRD
+declaration lockstep, validation defaulting, and the compiled manifests
+(replica ReplicaSet + Service) — the test_spec_parity.py discipline
+applied to the serving kind (doc/serving.md)."""
+
+from __future__ import annotations
+
+import pathlib
+
+import pytest
+import yaml
+
+from edl_tpu.api import serde
+from edl_tpu.api.types import (
+    DEFAULT_IMAGE,
+    DEFAULT_SERVING_PORT,
+    SERVING_LABEL,
+    ResourceRequirements,
+    ServingJob,
+    ServingSpec,
+)
+from edl_tpu.api.validation import (
+    ValidationError,
+    set_defaults_and_validate_serving,
+    validate_any,
+)
+from edl_tpu.controller.jobparser import (
+    HEALTH_PORT,
+    parse_serving_manifests,
+    parse_to_server_group,
+    parse_to_serving_service,
+    serving_pod_env,
+)
+
+CRD_PATH = pathlib.Path(__file__).resolve().parent.parent / "k8s" / "crd.yaml"
+
+
+def make_job(**server) -> ServingJob:
+    defaults = dict(model_dir="/models/m", min_replicas=2, max_replicas=8,
+                    slo_p99_ms=50.0, max_batch_size=16)
+    defaults.update(server)
+    return ServingJob(name="svc", namespace="prod",
+                      image="edl-tpu/serve:latest", port=8500,
+                      spec=ServingSpec(**defaults))
+
+
+# ------------------------------------------------------------------- serde
+
+def test_round_trip_preserves_everything():
+    job = make_job(env={"A": "1"}, target_qps_per_replica=40.0,
+                   max_queue_ms=1.5, drain_timeout_s=7.0, reload_poll_s=2.0,
+                   resources=ResourceRequirements(
+                       limits={"google.com/tpu": "4"}))
+    doc = serde.serving_job_to_dict(job)
+    assert doc["kind"] == "ServingJob"
+    back = serde.serving_job_from_dict(doc)
+    assert back == job
+    assert serde.serving_job_from_yaml(serde.serving_job_to_yaml(job)) == job
+
+
+def test_kebab_and_camel_spellings_accepted():
+    doc = {
+        "kind": "ServingJob", "metadata": {"name": "svc"},
+        "spec": {"hostNetwork": True, "server": {
+            "modelDir": "/m",
+            "minReplicas": 2,
+            "max-replicas": 8,
+            "sloP99Ms": 50,
+            "max-batch-size": 32,
+            "maxQueueMs": 3,
+            "drain-timeout-s": 9,
+            "target-qps-per-replica": 25,
+            "reloadPollS": 1,
+        }},
+    }
+    job = serde.serving_job_from_dict(doc)
+    s = job.spec
+    assert job.host_network is True
+    assert s.model_dir == "/m"
+    assert (s.min_replicas, s.max_replicas) == (2, 8)
+    assert s.slo_p99_ms == 50.0
+    assert s.max_batch_size == 32
+    assert s.max_queue_ms == 3.0
+    assert s.drain_timeout_s == 9.0
+    assert s.target_qps_per_replica == 25.0
+    assert s.reload_poll_s == 1.0
+
+
+def test_snake_wins_when_both_spellings_present():
+    doc = {"kind": "ServingJob", "metadata": {"name": "svc"},
+           "spec": {"server": {"min_replicas": 3, "minReplicas": 5}}}
+    assert serde.serving_job_from_dict(doc).spec.min_replicas == 3
+
+
+def test_kind_dispatch():
+    sj = serde.manifest_from_dict(serde.serving_job_to_dict(make_job()))
+    assert isinstance(sj, ServingJob)
+    tj = serde.manifest_from_dict({"kind": "TrainingJob",
+                                   "metadata": {"name": "t"}, "spec": {}})
+    assert not isinstance(tj, ServingJob)
+    with pytest.raises(ValueError):
+        serde.serving_job_from_dict({"kind": "TrainingJob",
+                                     "metadata": {"name": "t"}})
+
+
+# ---------------------------------------------------------- CRD lockstep
+
+def _serving_crd_schema() -> dict:
+    for doc in yaml.safe_load_all(CRD_PATH.read_text()):
+        if (doc and doc.get("kind") == "CustomResourceDefinition"
+                and doc["spec"]["names"]["plural"] == "servingjobs"):
+            return doc["spec"]["versions"][0]["schema"]["openAPIV3Schema"]
+    raise AssertionError("servingjobs CRD missing from k8s/crd.yaml")
+
+
+def test_every_alias_is_declared_in_the_crd():
+    """The serde alias set is DERIVED from the spec dataclass; the CRD
+    must declare every spelling (canonical + aliases) or a conformant
+    apiserver prunes what the CLI accepts — the exact drift class
+    test_crd_pruning.py exists for, now covering the serving kind."""
+    schema = _serving_crd_schema()
+    spec_props = schema["properties"]["spec"]["properties"]
+    server_props = spec_props["server"]["properties"]
+    serving_fields = serde._serving_fields()
+    for alias, snake in serde.SERVING_ALIASES.items():
+        where = server_props if snake in serving_fields else spec_props
+        assert snake in where, f"canonical {snake} undeclared"
+        assert alias in where, f"alias {alias} (-> {snake}) undeclared"
+
+
+def test_crd_schema_survives_stub_pruning():
+    """A manifest written in mixed spellings keeps every field through a
+    conformant apiserver's structural-schema pruning (the shipped stub's
+    admission path)."""
+    from tests.k8s_stub import load_crd_schemas, prune_per_schema
+
+    schema = load_crd_schemas()[("edl.tpu", "servingjobs")]
+    spec = {"image": "i", "server": {"minReplicas": 2, "max-replicas": 4,
+                                     "slo_p99_ms": 9.5}}
+    pruned = prune_per_schema(spec, schema["properties"]["spec"])
+    assert pruned == spec
+
+
+# ------------------------------------------------------------- validation
+
+def test_defaults_applied():
+    job = ServingJob(name="svc", spec=ServingSpec(min_replicas=1,
+                                                  max_replicas=1))
+    set_defaults_and_validate_serving(job)
+    assert job.image == DEFAULT_IMAGE
+    assert job.port == DEFAULT_SERVING_PORT
+
+
+@pytest.mark.parametrize("server,err", [
+    (dict(min_replicas=0), "min_replicas"),
+    (dict(min_replicas=4, max_replicas=2), "max_replicas"),
+    (dict(slo_p99_ms=-1), "slo_p99_ms"),
+    (dict(max_batch_size=0), "max_batch_size"),
+    (dict(max_queue_ms=-0.5), "max_queue_ms"),
+    (dict(target_qps_per_replica=-2), "target_qps_per_replica"),
+])
+def test_rejections(server, err):
+    job = ServingJob(name="svc", spec=ServingSpec(**server))
+    with pytest.raises(ValidationError, match=err):
+        set_defaults_and_validate_serving(job)
+
+
+def test_elastic_needs_a_scaling_signal():
+    job = ServingJob(name="svc", spec=ServingSpec(
+        min_replicas=1, max_replicas=4, slo_p99_ms=0.0,
+        target_qps_per_replica=0.0))
+    with pytest.raises(ValidationError, match="scaling signal"):
+        set_defaults_and_validate_serving(job)
+    job.spec.slo_p99_ms = 25.0
+    set_defaults_and_validate_serving(job)  # now fine
+
+
+def test_validate_any_dispatches():
+    job = make_job()
+    validate_any(job)
+    with pytest.raises(ValidationError):
+        validate_any(ServingJob(name="", spec=ServingSpec()))
+
+
+def test_topology_chip_limit_agreement():
+    from edl_tpu.api.types import TpuTopology
+
+    job = make_job(topology=TpuTopology.parse("2x2"),
+                   resources=ResourceRequirements(
+                       limits={"google.com/tpu": "8"}))
+    with pytest.raises(ValidationError, match="disagrees"):
+        set_defaults_and_validate_serving(job)
+    job.spec.resources = ResourceRequirements(
+        limits={"google.com/tpu": "4"})
+    set_defaults_and_validate_serving(job)
+    assert job.tpu_chips_per_replica() == 4
+
+
+# -------------------------------------------------------------- jobparser
+
+def test_manifests_are_replicaset_plus_service():
+    job = make_job()
+    mans = parse_serving_manifests(job)
+    assert [m["kind"] for m in mans] == ["ReplicaSet", "Service"]
+    rs = parse_to_server_group(job)
+    assert rs["metadata"]["name"] == "svc-server"
+    assert rs["metadata"]["namespace"] == "prod"
+    assert rs["spec"]["replicas"] == job.spec.min_replicas
+    assert rs["metadata"]["labels"] == {SERVING_LABEL: "svc"}
+    pod = rs["spec"]["template"]["spec"]
+    assert pod["restartPolicy"] == "Always"  # ReplicaSet semantics
+    c = pod["containers"][0]
+    assert c["command"][-1] == "start_server"
+    # the ready gate: readiness probes /healthz, which is 503 until the
+    # serving step is compiled — traffic shifts only after
+    assert c["readinessProbe"]["httpGet"]["port"] == HEALTH_PORT
+    assert c["livenessProbe"]["httpGet"]["path"] == "/healthz"
+
+    svc = parse_to_serving_service(job)
+    assert svc["spec"]["selector"] == {SERVING_LABEL: "svc"}
+    assert {p["port"] for p in svc["spec"]["ports"]} == {8500, HEALTH_PORT}
+
+
+def test_pod_env_contract_and_user_override():
+    job = make_job(env={"EDL_SERVING_MAX_BATCH": "64", "EXTRA": "x"})
+    env = serving_pod_env(job)
+    assert env["EDL_SERVING_MODEL_DIR"] == "/models/m"
+    assert env["EDL_SERVING_SLO_P99_MS"] == "50.0"
+    assert env["EDL_SERVING_MAX_BATCH"] == "64"  # user wins
+    assert env["EXTRA"] == "x"
+    assert env["EDL_ROLE"] == "server"
