@@ -1261,16 +1261,19 @@ def coord_ha_leg(cycles: int = 5) -> dict:
 
 
 def serving_leg() -> dict:
-    """Elastic inference serving under SLO (ROADMAP #4; doc/serving.md):
-    a continuous-batching fleet eats seeded Poisson traffic through (1)
-    a LIVE SLO-driven scale-up — the scaler's hint prewarms the new
-    replica's serving step before traffic shifts, so the compile never
-    rides a request — and (2) a rolling weight reload to the next
-    checkpoint generation, replicas swapping one at a time behind the
-    ready gate.  The headline is the first user-facing latency number
-    this substrate produces: p50/p99 vs the SLO, with ZERO dropped
-    requests and the prewarm hit asserted (the elasticity claim,
-    measured at the request level)."""
+    """Elastic inference serving under SLO, SCRAPE-FED (ROADMAP #4;
+    doc/serving.md + doc/observability.md §scrape-plane): a
+    continuous-batching fleet eats seeded Poisson traffic through (1) a
+    LIVE SLO-driven scale-up where the ServingScaler's ONLY signal is a
+    MetricsScraper polling the fleet's real HTTP ``/metrics`` — the
+    in-process stats hook is disabled; the policy sees exactly what a
+    production scraper can see — and (2) a rolling weight reload to the
+    next checkpoint generation.  The AlertEngine watches the same
+    scraped view; after the run an injected SLO breach must fire the
+    fast-burn rule within 2 evaluation windows.  Headline: p50/p99 vs
+    the SLO with ZERO drops through the scrape-fed scale-up, the
+    request-span phase p99s (queue/forward), and the scrape plane's own
+    sweep/staleness latencies."""
     import tempfile as _tempfile
     import threading
 
@@ -1281,6 +1284,11 @@ def serving_leg() -> dict:
 
     from edl_tpu.models import mlp
     from edl_tpu.observability.collector import get_counters
+    from edl_tpu.observability.metrics import get_registry
+    from edl_tpu.observability.scrape import (
+        AlertEngine, BurnRateRule, FleetView, MetricsScraper, ScrapeTarget,
+        TargetDownRule,
+    )
     from edl_tpu.runtime.checkpoint import ElasticCheckpointer
     from edl_tpu.runtime.serving import PoissonTraffic, ServingFleet
     from edl_tpu.scheduler.autoscaler import ServingScaler
@@ -1301,6 +1309,21 @@ def serving_leg() -> dict:
     fleet.generation = 1
     fleet.scale_to(1)
 
+    # THE SCRAPE PLANE IS THE SIGNAL PATH: the fleet serves its real
+    # /metrics over HTTP, a MetricsScraper sweeps it, and the scaler is
+    # fed from the FleetView rollup — the harness hook is never wired
+    metrics_srv = fleet.serve_metrics(0, host="127.0.0.1", publish=False)
+    scraper = MetricsScraper(interval_s=0.25, timeout_s=2.0,
+                             stale_after_s=2.0)
+    scraper.add_target(ScrapeTarget(
+        name="serving-fleet", addr=f"127.0.0.1:"
+        f"{metrics_srv.server_address[1]}", labels={"job": JOB}))
+    view = FleetView(scraper, window_s=2.0)
+    burn_rule = BurnRateRule(budget_fraction=0.001, fast_window_s=2.0,
+                             slow_window_s=10.0, fast_factor=14.4,
+                             slow_factor=6.0, min_requests=50)
+    engine = AlertEngine(view, rules=[burn_rule, TargetDownRule()])
+
     # scaling signal: BOTH policy halves are armed — the p99-vs-SLO
     # guard, and a 200 qps/replica throughput target.  On a CPU host one
     # replica absorbs the whole burst inside the SLO (capacity ≈ kqps),
@@ -1310,9 +1333,8 @@ def serving_leg() -> dict:
     job = ServingJob(name="serving", namespace="bench", spec=ServingSpec(
         min_replicas=1, max_replicas=3, slo_p99_ms=SLO_P99_MS,
         target_qps_per_replica=200.0, max_batch_size=8))
-    scaler = ServingScaler(stats_for=lambda uid: fleet.stats(window_s=2.0),
-                           actuate=lambda uid, n: fleet.scale_to(n),
-                           scale_up_cooldown_s=1.0)
+    scaler = ServingScaler(actuate=lambda uid, n: fleet.scale_to(n),
+                           scale_up_cooldown_s=1.0).feed_from(view)
     scaler.hint_sink = lambda uid, n: fleet.hint(n)
     scaler.on_add(job)
 
@@ -1323,8 +1345,11 @@ def serving_leg() -> dict:
     stop_scaler = threading.Event()
 
     def scaler_loop():
+        # sweep-then-tick: the plan is only ever made from scraped data
         while not stop_scaler.wait(0.25):
+            scraper.sweep()
             scaler.tick()
+            engine.evaluate()
 
     st = threading.Thread(target=scaler_loop)
     try:
@@ -1351,7 +1376,7 @@ def serving_leg() -> dict:
 
         tally = traffic.await_all(timeout_s=60.0)
         c = get_counters()
-        stats = fleet.stats(window_s=5.0)
+        scraped_stats = view.stats_for(JOB)  # what the scaler saw
         lats = sorted(r.latency_s for r in traffic.sent
                       if r.error is None and r.t_done)
         replicas_after = fleet.replicas_active()
@@ -1360,6 +1385,25 @@ def serving_leg() -> dict:
         reloads = c.get("serving_reloads", job=JOB)
         violations = c.get("serving_slo_violations", job=JOB)
         dropped = c.get("serving_dropped_requests", job=JOB)
+
+        # phase 4 — the injected SLO breach: bump the violation counter
+        # the replicas themselves own, then watch the scraped burn-rate
+        # rule catch it.  The acceptance bound: the FAST-burn rule fires
+        # within 2 evaluation windows of the data landing on a sweep.
+        stop_scaler.set()
+        if st.is_alive():
+            st.join()
+        c.inc("serving_requests", 400, job=JOB)
+        c.inc("serving_slo_violations", 200, job=JOB)
+        evals_to_fire = None
+        for i in range(1, 5):
+            scraper.sweep()
+            firing = {a.rule for a in engine.evaluate()}
+            if "slo_fast_burn" in firing:
+                evals_to_fire = i
+                break
+            time.sleep(0.25)
+        alerts_fired = int(c.total("alerts_fired"))
     finally:
         # teardown BEFORE any assert: replica loops are non-daemon
         # threads (XLA-teardown safety), so an assertion failure must
@@ -1367,7 +1411,8 @@ def serving_leg() -> dict:
         stop_scaler.set()
         if st.is_alive():
             st.join()
-        fleet.stop()
+        scraper.stop()
+        fleet.stop()  # also shuts the /metrics route down
         lineage.close()
 
     def pct(q):
@@ -1378,6 +1423,12 @@ def serving_leg() -> dict:
         "burst": {"sent": sent_burst - sent_steady},
         "reload": {"sent": len(traffic.sent) - sent_burst},
     }
+    reg = get_registry()
+
+    def hist_p(name: str, q: float, **labels):
+        v = reg.histogram(name).quantile_bucket(q, **labels)
+        return round(v * 1000.0, 3) if v is not None else None
+
     out = {
         "slo_p99_ms": SLO_P99_MS,
         "serving_p50_ms": pct(0.50),
@@ -1398,10 +1449,27 @@ def serving_leg() -> dict:
         "prewarm_hits": prewarm_hits,
         "replicas_final": replicas_after,
         "scaled_up_live": replicas_after > 1,
+        "scaler_fed_from_scrape_only": True,  # structural: no stats hook
         "rolling_reload_generation": generation,
         "reload_swaps": reloads,
-        "window_stats": {"p50_ms": stats.p50_ms, "p99_ms": stats.p99_ms,
-                         "qps": stats.qps},
+        # what the scaler actually saw (scraped) at the end of the run
+        "scraped_window_stats": {"p50_ms": scraped_stats.p50_ms,
+                                 "p99_ms": scraped_stats.p99_ms,
+                                 "qps": scraped_stats.qps},
+        # the scrape plane's own latencies (bucket-resolution p-values)
+        "scrape_sweep_ms_p50": hist_p("scrape_sweep_seconds", 0.50),
+        "scrape_staleness_ms_p99": hist_p("scrape_staleness_seconds",
+                                          0.99),
+        "scrape_sweeps": scraper.sweeps,
+        # the request-span taxonomy: where the latency lives, by phase
+        "serving_span_queue_ms_p99": hist_p("serving_span_seconds", 0.99,
+                                            phase="queue"),
+        "serving_span_forward_ms_p99": hist_p("serving_span_seconds",
+                                              0.99, phase="forward"),
+        # alerting: the injected breach and how fast the fast-burn rule
+        # caught it (evaluation windows after the data landed)
+        "alerts_fired": alerts_fired,
+        "fast_burn_evals_to_fire": evals_to_fire,
         "phases": phases,
     }
     # the acceptance gates, enforced in-leg so a regression fails the
@@ -1413,6 +1481,11 @@ def serving_leg() -> dict:
     assert out["scaled_up_live"], out
     assert out["rolling_reload_generation"] == 2, out
     assert out["serving_p99_ms"] <= SLO_P99_MS, out
+    assert out["scrape_sweeps"] >= 8, out
+    assert out["serving_span_queue_ms_p99"] is not None, out
+    assert out["serving_span_forward_ms_p99"] is not None, out
+    assert out["alerts_fired"] >= 1, out
+    assert evals_to_fire is not None and evals_to_fire <= 2, out
     return out
 
 
@@ -2214,6 +2287,20 @@ def main() -> None:
         "serving_scaled_up_live": serving.get("scaled_up_live"),
         "serving_reload_generation":
             serving.get("rolling_reload_generation"),
+        # the scrape plane (PR 11): the scaler above was fed ONLY from
+        # scraped replica /metrics — these are the plane's own numbers
+        # plus the request-span phase split and the injected-breach
+        # alert latency
+        "scrape_sweep_ms_p50": serving.get("scrape_sweep_ms_p50"),
+        "scrape_staleness_ms_p99":
+            serving.get("scrape_staleness_ms_p99"),
+        "serving_span_queue_ms_p99":
+            serving.get("serving_span_queue_ms_p99"),
+        "serving_span_forward_ms_p99":
+            serving.get("serving_span_forward_ms_p99"),
+        "alerts_fired": serving.get("alerts_fired"),
+        "fast_burn_evals_to_fire":
+            serving.get("fast_burn_evals_to_fire"),
         # accuracy-consistent elasticity: a resize must be invisible to
         # the loss curve — the measured divergence of the 4→2→8 walk
         # (with an injected kill) vs the unresized control, and the

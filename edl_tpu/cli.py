@@ -17,6 +17,8 @@ surface:
                 pkg/apis/paddlepaddle/v1/types.go:154-162)
   list          all TrainingJobs with recorded phases (`kubectl get tj`)
   validate      parse+default+validate a manifest, print the result
+  fleet         one-screen fleet dashboard from scraped /metrics
+                (doc/observability.md §scrape-plane)
 """
 
 from __future__ import annotations
@@ -41,6 +43,48 @@ def _build_cluster(args):
     return K8sCluster(kubeconfig=args.kubeconfig, namespace=args.namespace)
 
 
+def _build_scraper(args):
+    """A MetricsScraper from the shared scrape flags (None when no
+    source was requested): static --scrape-targets plus dynamic
+    discovery over the coordinator's KV (--scrape-coord)."""
+    targets = [a.strip() for a in
+               (getattr(args, "scrape_targets", "") or "").split(",")
+               if a.strip()]
+    coord_ep = getattr(args, "scrape_coord", "") or ""
+    if not targets and not coord_ep:
+        return None
+    from edl_tpu.observability.scrape import (
+        MetricsScraper, kv_targets, static_targets,
+    )
+
+    discover = []
+    if coord_ep:
+        from edl_tpu.coord.client import CoordClient
+
+        host, _, port = coord_ep.rpartition(":")
+        if not host or not port.isdigit():
+            print(f"error: --scrape-coord wants host:port, got "
+                  f"{coord_ep!r}", file=sys.stderr)
+            raise SystemExit(2)
+        discover.append(kv_targets(CoordClient(host, int(port))))
+    return MetricsScraper(
+        targets=static_targets(targets),
+        discover=discover,
+        interval_s=getattr(args, "scrape_interval", 1.0))
+
+
+def _add_scrape_flags(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--scrape-targets", default="",
+                   help="comma-separated host:port /metrics endpoints "
+                        "to scrape statically")
+    p.add_argument("--scrape-coord", default="",
+                   help="coordinator host:port whose KV is polled for "
+                        "dynamic targets (supervisor metrics-addr-* and "
+                        "TTL'd serving-metrics-addr/* keys)")
+    p.add_argument("--scrape-interval", type=float, default=1.0,
+                   help="per-target scrape cadence (jittered)")
+
+
 def cmd_controller(args) -> int:
     from edl_tpu.controller.controller import Controller
     from edl_tpu.scheduler.topology import POW2_POLICY, UNIT_POLICY
@@ -51,6 +95,10 @@ def cmd_controller(args) -> int:
         max_load_desired=args.max_load_desired,
         shape_policy=POW2_POLICY if args.pow2_shapes else UNIT_POLICY,
         autoscaler_loop_seconds=args.loop_seconds,
+        # scrape plane: with a source configured, the serving scaler is
+        # fed from scraped replica /metrics instead of any in-process
+        # hook (doc/observability.md §scrape-plane)
+        scraper=_build_scraper(args),
     )
     log.info("controller starting", max_load_desired=args.max_load_desired,
              loop_seconds=args.loop_seconds)
@@ -288,6 +336,49 @@ def cmd_list(args) -> int:
     return 0
 
 
+def cmd_fleet(args) -> int:
+    """One-screen fleet dashboard off the scrape plane: discover + sweep
+    the fleet's /metrics endpoints, roll them up (FleetView), evaluate
+    the alert rules, render.  ``--watch`` repaints every interval;
+    default is sweep-a-few-times-then-print (scriptable)."""
+    from edl_tpu.observability.scrape import (
+        AlertEngine, FleetView, render_fleet_dashboard,
+    )
+
+    scraper = _build_scraper(args)
+    if scraper is None:
+        print("error: no scrape source — pass --scrape-targets and/or "
+              "--scrape-coord", file=sys.stderr)
+        return 2
+    view = FleetView(scraper, window_s=args.window)
+    engine = AlertEngine(view, flight_dir=args.flight_dir or None)
+    try:
+        if args.watch:
+            while True:
+                scraper.sweep()
+                engine.evaluate()
+                print("\033[2J\033[H", end="")  # clear + home
+                print(render_fleet_dashboard(view, engine))
+                time.sleep(args.scrape_interval)
+        # one-shot: a few sweeps so rates/deltas have two samples to
+        # difference, then a single render.  Sleep the FULL interval
+        # between sweeps — targets are due-gated on it, so a shorter
+        # nap would make every sweep after the first scrape nothing and
+        # render a zero dashboard for a live fleet
+        for i in range(max(int(args.sweeps), 1)):
+            scraper.sweep()
+            if i < args.sweeps - 1:
+                time.sleep(args.scrape_interval)
+        engine.evaluate()
+        print(render_fleet_dashboard(view, engine))
+    except KeyboardInterrupt:
+        pass
+    finally:
+        scraper.stop()
+    firing = engine.firing()
+    return 3 if firing and args.check else 0
+
+
 def cmd_validate(args) -> int:
     import yaml
 
@@ -348,6 +439,7 @@ def build_parser() -> argparse.ArgumentParser:
                    help="stream TrainingJob watch events between periodic "
                         "full LISTs (the reference informer model); "
                         "--no-watch = pure poll-list every tick")
+    _add_scrape_flags(c)
     c.set_defaults(fn=cmd_controller)
 
     c = sub.add_parser("collector", help="cluster metrics TSV")
@@ -409,6 +501,23 @@ def build_parser() -> argparse.ArgumentParser:
                                     "(the `kubectl get tj` table)")
     _add_cluster_flags(c)
     c.set_defaults(fn=cmd_list)
+
+    c = sub.add_parser("fleet", help="one-screen fleet dashboard from "
+                                     "scraped /metrics (the scrape "
+                                     "plane's operator surface)")
+    _add_scrape_flags(c)
+    c.add_argument("--window", type=float, default=10.0,
+                   help="rollup window for qps/p99 (seconds)")
+    c.add_argument("--sweeps", type=int, default=3,
+                   help="one-shot mode: sweeps before rendering (≥2 so "
+                        "rates have deltas)")
+    c.add_argument("--watch", action="store_true",
+                   help="repaint every --scrape-interval until ^C")
+    c.add_argument("--flight-dir", default="",
+                   help="dump a flight record when an alert rule fires")
+    c.add_argument("--check", action="store_true",
+                   help="exit 3 if any alert is firing (CI/cron probes)")
+    c.set_defaults(fn=cmd_fleet)
 
     c = sub.add_parser("validate", help="validate a manifest")
     c.add_argument("manifest")

@@ -51,6 +51,8 @@ class Controller:
         serving_stats_for=None,
         serving_loop_seconds: float = 2.0,
         coord_for=None,
+        scraper=None,
+        scrape_window_s: float = 10.0,
     ) -> None:
         self.cluster = cluster
         self.autoscaler = Autoscaler(
@@ -63,11 +65,28 @@ class Controller:
             mesh_shape_for=mesh_shape_for,
             goodput_curves=goodput_curves,
         )
+        #: the scrape plane (observability/scrape.py): when a
+        #: MetricsScraper is handed in (the ``edl-tpu controller
+        #: --scrape-targets/--scrape-coord`` flags build one), the
+        #: controller owns its lifecycle, rolls it up through a
+        #: FleetView, and feeds the serving scaler FROM SCRAPED REPLICA
+        #: /metrics — the deployed signal path (ROADMAP #4's
+        #: observability half).  ``serving_stats_for`` remains the
+        #: in-process test seam and wins when explicitly given.
+        self.scraper = scraper
+        self.fleet_view = None
+        if scraper is not None:
+            from edl_tpu.observability.scrape import FleetView
+
+            self.fleet_view = FleetView(scraper, window_s=scrape_window_s)
+            if serving_stats_for is None:
+                serving_stats_for = self.fleet_view.stats_for
         #: SLO-driven replica scaling for ServingJob kinds — fed by
         #: ``serving_stats_for(uid)`` (windowed p50/p99/qps; scraped
-        #: from replica /metrics in a deployment, read off the
-        #: in-process fleet in the harness), actuating the same cluster
-        #: replica-group dial the trainer autoscaler uses
+        #: from replica /metrics in a deployment via the FleetView
+        #: above, read off the in-process fleet in the harness),
+        #: actuating the same cluster replica-group dial the trainer
+        #: autoscaler uses
         self.serving_scaler = ServingScaler(
             cluster=cluster,
             stats_for=serving_stats_for,
@@ -89,12 +108,16 @@ class Controller:
     def start(self) -> None:
         """Run the scaling loops in the background
         (role of Controller.Run, reference pkg/controller.go:64-76)."""
+        if self.scraper is not None:
+            self.scraper.start()
         self.autoscaler.start()
         self.serving_scaler.start()
 
     def stop(self) -> None:
         self.autoscaler.stop()
         self.serving_scaler.stop()
+        if self.scraper is not None:
+            self.scraper.stop()
         with self._lock:
             updaters = list(self._updaters.values())
         for u in updaters:

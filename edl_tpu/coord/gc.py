@@ -31,6 +31,10 @@ JOB_KV_PREFIXES = (
     "vw-map/",
     "vw-cursor/",
     "serving-gen/",
+    # serving replicas' published /metrics addresses (TTL'd values —
+    # observability/scrape.py stamps an expiry the scraper honors — but
+    # the keys themselves only leave KV here or via AddrPublisher.stop)
+    "serving-metrics-addr/",
 )
 
 

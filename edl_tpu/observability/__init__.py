@@ -11,18 +11,27 @@ from edl_tpu.observability.goodput import (
 )
 from edl_tpu.observability.logging import get_logger
 from edl_tpu.observability.metrics import (
-    Counter, Gauge, Histogram, MetricsRegistry, dump_flight_record,
-    get_registry,
+    Counter, ExpositionError, Gauge, Histogram, MetricsRegistry,
+    dump_flight_record, get_registry, iter_samples, parse_exposition,
+)
+from edl_tpu.observability.scrape import (
+    AlertEngine, AlertRule, BurnRateRule, ConservationRule, FleetView,
+    GoodputCollapseRule, MetricsScraper, ScrapeTarget, TargetDownRule,
+    render_fleet_dashboard,
 )
 from edl_tpu.observability.tracing import (
     Tracer, current_trace_id, get_tracer, new_trace_id, profile_step,
     set_trace_id,
 )
 
-__all__ = ["Collector", "Counter", "Counters", "CurveStore", "Gauge",
+__all__ = ["AlertEngine", "AlertRule", "BurnRateRule", "Collector",
+           "ConservationRule", "Counter", "Counters", "CurveStore",
+           "ExpositionError", "FleetView", "Gauge", "GoodputCollapseRule",
            "GoodputLedger", "Histogram", "JobInfo", "MetricsRegistry",
-           "Sample", "ScalingCurve", "Tracer", "current_trace_id",
+           "MetricsScraper", "Sample", "ScalingCurve", "ScrapeTarget",
+           "TargetDownRule", "Tracer", "current_trace_id",
            "dump_flight_record", "get_counters", "get_logger",
            "get_process_ledger", "get_registry", "get_tracer",
-           "new_trace_id", "profile_step", "set_process_ledger",
-           "set_trace_id"]
+           "iter_samples", "new_trace_id", "parse_exposition",
+           "profile_step", "render_fleet_dashboard",
+           "set_process_ledger", "set_trace_id"]

@@ -411,6 +411,12 @@ def register_metrics(ledger: GoodputLedger, registry=None) -> None:
     registry.gauge_fn("goodput_world_size",
                       lambda: ledger.world_size,
                       help="current chip-second accrual weight", job=job)
+    registry.gauge_fn(
+        "goodput_conservation_error_pct",
+        lambda: 100.0 * ledger.conservation_error(),
+        help="|attributed - integral| as % of the world-size integral "
+             "(>1% breaks the conservation invariant; alerted on by the "
+             "scrape plane's ConservationRule)", job=job)
     for phase in ALL_PHASES:
         registry.gauge_fn(
             "goodput_chip_seconds",

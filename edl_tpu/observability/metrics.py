@@ -394,6 +394,175 @@ class MetricsRegistry:
         return "\n".join(lines) + "\n"
 
 
+# -- strict exposition parsing ----------------------------------------------
+#
+# Promoted out of tests/test_observability.py: the conformance oracle the
+# tests hold every /metrics route to is the SAME parser the scrape plane
+# (observability/scrape.py) trusts in production — one grammar, one
+# implementation.  Deliberately strict: metric-name and label grammar,
+# HELP/TYPE placement, histogram le-monotonicity and the _sum/_count
+# contract.  A scraper is strict; so is this.
+
+_PARSE_METRIC_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>[^}]*)\})? "
+    r"(?P<value>[0-9eE+.\-]+|\+Inf|-Inf|NaN)$")
+_PARSE_LABEL_RE = re.compile(
+    r'^(?P<k>[a-zA-Z_][a-zA-Z0-9_]*)="(?P<v>(?:[^"\\]|\\.)*)"$')
+
+
+class ExpositionError(AssertionError):
+    """A grammar/contract violation in Prometheus exposition text.
+
+    Subclasses AssertionError so the strictness tests that predate the
+    promotion (``assert``-shaped) keep passing unchanged."""
+
+
+def _split_label_pairs(labels: str) -> list[str]:
+    """Split a label body on commas outside quoted values."""
+    out, cur, in_q, esc = [], "", False, False
+    for ch in labels:
+        if esc:
+            cur += ch
+            esc = False
+        elif ch == "\\":
+            cur += ch
+            esc = True
+        elif ch == '"':
+            cur += ch
+            in_q = not in_q
+        elif ch == "," and not in_q:
+            out.append(cur)
+            cur = ""
+        else:
+            cur += ch
+    if cur:
+        out.append(cur)
+    return out
+
+
+def _unescape_label_value(value: str) -> str:
+    """Single left-to-right scan: sequential str.replace would corrupt
+    values where one escape's output abuts another's trigger (spec form
+    ``dir\\\\name`` must yield ``dir\\name``, not a newline)."""
+    if "\\" not in value:
+        return value
+    out: list[str] = []
+    i = 0
+    n = len(value)
+    while i < n:
+        ch = value[i]
+        if ch == "\\" and i + 1 < n:
+            nxt = value[i + 1]
+            if nxt == "n":
+                out.append("\n")
+                i += 2
+                continue
+            if nxt in ('"', "\\"):
+                out.append(nxt)
+                i += 2
+                continue
+        out.append(ch)
+        i += 1
+    return "".join(out)
+
+
+def iter_samples(text: str) -> list[tuple[str, dict, float]]:
+    """Parse exposition text into structured ``(name, labels, value)``
+    samples, enforcing the full strict grammar (see
+    :func:`parse_exposition`).  This is the form the scrape plane
+    ingests — label values are unescaped back to their raw form."""
+    samples: list[tuple[str, dict, float]] = []
+    seen: set[str] = set()
+    typed: dict[str, str] = {}
+    for line in text.splitlines():
+        if not line.strip():
+            continue
+        if line.startswith("# HELP "):
+            if len(line.split(" ", 3)) < 3:
+                raise ExpositionError(f"bad HELP: {line!r}")
+            continue
+        if line.startswith("# TYPE "):
+            parts = line.split(" ")
+            if len(parts) < 4:
+                raise ExpositionError(f"bad TYPE: {line!r}")
+            if parts[3] not in ("counter", "gauge", "histogram",
+                               "summary", "untyped"):
+                raise ExpositionError(f"unknown type: {line!r}")
+            if parts[2] in typed:
+                raise ExpositionError(f"duplicate TYPE for {parts[2]}")
+            typed[parts[2]] = parts[3]
+            continue
+        if line.startswith("#"):
+            raise ExpositionError(f"unknown comment: {line!r}")
+        m = _PARSE_METRIC_RE.match(line)
+        if not m:
+            raise ExpositionError(f"malformed sample line: {line!r}")
+        labels_body = m.group("labels")
+        labels: dict[str, str] = {}
+        if labels_body:
+            for pair in _split_label_pairs(labels_body):
+                lm = _PARSE_LABEL_RE.match(pair)
+                if not lm:
+                    raise ExpositionError(
+                        f"bad label {pair!r} in {line!r}")
+                labels[lm.group("k")] = _unescape_label_value(lm.group("v"))
+        key = m.group("name") + ("{" + labels_body + "}"
+                                 if labels_body else "")
+        if key in seen:
+            raise ExpositionError(f"duplicate series: {key}")
+        seen.add(key)
+        v = m.group("value")
+        value = (math.inf if v == "+Inf"
+                 else -math.inf if v == "-Inf" else float(v))
+        samples.append((m.group("name"), labels, value))
+    _check_histogram_contracts(samples, typed)
+    return samples
+
+
+def _check_histogram_contracts(samples, typed) -> None:
+    """Histogram contracts: buckets monotone in le AND in count, with a
+    terminal +Inf bucket."""
+    for name, kind in typed.items():
+        if kind != "histogram":
+            continue
+        by_labels: dict[tuple, list[tuple[float, float]]] = {}
+        for sname, labels, v in samples:
+            if sname != name + "_bucket":
+                continue
+            if "le" not in labels:
+                raise ExpositionError(f"{name}: bucket without le")
+            le_raw = labels["le"]
+            le = math.inf if le_raw == "+Inf" else float(le_raw)
+            rest = tuple(sorted((k, lv) for k, lv in labels.items()
+                                if k != "le"))
+            by_labels.setdefault(rest, []).append((le, v))
+        for rest, buckets in by_labels.items():
+            buckets.sort()
+            if buckets[-1][0] != math.inf:
+                raise ExpositionError(f"{name}: no +Inf bucket")
+            counts = [c for _, c in buckets]
+            if counts != sorted(counts):
+                raise ExpositionError(f"{name}: non-monotone buckets")
+
+
+def parse_exposition(text: str) -> dict[str, float]:
+    """Strict Prometheus text-format (0.0.4) parser: exposition text →
+    ``{series_key: float}``, raising :class:`ExpositionError` on any
+    grammar or histogram-contract violation.  ``series_key`` is the
+    sample line's name + literal label body (escaped form), matching
+    what the exposition renders — ``edl_x_total{job="a"}``."""
+    series: dict[str, float] = {}
+    for name, labels, value in iter_samples(text):
+        if labels:
+            inner = ",".join(f'{k}="{escape_label_value(v)}"'
+                             for k, v in labels.items())
+            series[name + "{" + inner + "}"] = value
+        else:
+            series[name] = value
+    return series
+
+
 #: Process-wide registry — what get_counters() is backed by and what
 #: every /metrics route renders (mirrors tracing.get_tracer()).
 _default_registry = MetricsRegistry()
@@ -411,33 +580,72 @@ CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
 _flight_seq = [0]
 _flight_seq_lock = threading.Lock()
+#: ONE dump at a time per process: StallWatchdog escalation and an
+#: AlertEngine rule can both fire inside the same incident — two
+#: concurrent dumps would interleave their temp-file prunes and write
+#: two near-identical records for one event.  The lock serializes them;
+#: the cooldown map dedupes same-reason dumps inside a window.
+_dump_lock = threading.RLock()
+_last_dump: dict[tuple[str, str], tuple[float, str]] = {}
 
 
 def dump_flight_record(dir_path: str, reason: str,
                        extra: Optional[dict] = None,
                        tracer=None, registry: Optional[MetricsRegistry] = None,
-                       keep: int = 20) -> str:
+                       keep: int = 20,
+                       cooldown_s: Optional[float] = None) -> str:
     """Dump the process's trace ring + counters + metrics snapshot to a
     timestamped ``flightrec-<utc>-<reason>-<pid>.json`` under
     ``dir_path`` and return its path.
 
     Called on stall/fault escalation (StallWatchdog, the multihost
-    supervisor) so the post-mortem evidence — what the process was doing,
-    how long each recent phase took, every counter's value at the moment
-    of escalation — exists on disk even when nobody had a profiler or a
-    scraper attached.  Atomic (temp + rename); prunes to the ``keep``
-    newest records so an escalation loop cannot fill the disk.
-    """
-    from dataclasses import asdict
+    supervisor) and on alert-rule firings (observability/scrape.py's
+    AlertEngine) so the post-mortem evidence — what the process was
+    doing, how long each recent phase took, every counter's value at the
+    moment of escalation — exists on disk even when nobody had a
+    profiler or a scraper attached.  Atomic (temp + rename); prunes to
+    the ``keep`` newest records so an escalation loop cannot fill the
+    disk.
 
+    Dumps are serialized through one process-wide lock (a watchdog
+    breach and an alert firing in the same incident must not interleave
+    their prunes), and ``cooldown_s`` (default: the
+    ``EDL_FLIGHTREC_COOLDOWN_S`` env var, else 0 = off) dedupes
+    SAME-reason dumps inside the window — the deduped call returns the
+    previous record's path and bumps ``flight_dumps_deduped_total``.
+    Different reasons never dedupe each other: a stall dump and an alert
+    dump for the same incident are both evidence.
+    """
     from edl_tpu.observability.collector import get_counters
     from edl_tpu.observability.tracing import get_tracer
 
     os.makedirs(dir_path, exist_ok=True)
     tracer = tracer if tracer is not None else get_tracer()
     registry = registry if registry is not None else get_registry()
+    if cooldown_s is None:
+        try:
+            cooldown_s = float(
+                os.environ.get("EDL_FLIGHTREC_COOLDOWN_S", "0"))
+        except ValueError:
+            cooldown_s = 0.0
     stamp = time.strftime("%Y%m%dT%H%M%S", time.gmtime())
     slug = re.sub(r"[^a-zA-Z0-9_-]", "-", reason)[:48] or "event"
+    with _dump_lock:
+        if cooldown_s > 0:
+            prev = _last_dump.get((dir_path, slug))
+            if prev is not None and time.monotonic() - prev[0] < cooldown_s:
+                get_counters().inc("flight_dumps_deduped", reason=slug)
+                return prev[1]
+        return _dump_flight_record_locked(
+            dir_path, reason, slug, stamp, extra, tracer, registry, keep)
+
+
+def _dump_flight_record_locked(dir_path, reason, slug, stamp, extra,
+                               tracer, registry, keep) -> str:
+    from dataclasses import asdict
+
+    from edl_tpu.observability.collector import get_counters
+
     with _flight_seq_lock:
         _flight_seq[0] += 1
         seq = _flight_seq[0]
@@ -469,6 +677,7 @@ def dump_flight_record(dir_path: str, reason: str,
     with os.fdopen(fd, "w") as f:
         json.dump(doc, f)
     os.replace(tmp, path)
+    _last_dump[(dir_path, slug)] = (time.monotonic(), path)
     _prune_flight_records(dir_path, keep)
     return path
 

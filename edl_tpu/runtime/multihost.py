@@ -1441,6 +1441,7 @@ def run_elastic_worker(
     # (one server outlives many worlds)
     wd_box: dict = {"wd": None}
     metrics_srv = None
+    metrics_addr_pub = None
     if metrics_port is not None and metrics_port >= 0:
         from edl_tpu.observability.health import serve_health
 
@@ -1462,6 +1463,22 @@ def run_elastic_worker(
                 f.write(f"127.0.0.1:{addr[1]}")
         except OSError:
             pass
+        # the KV twin: a MetricsScraper on another host discovers this
+        # supervisor through the coordinator (kv_targets), not the
+        # filesystem; TTL'd + refreshed so a SIGKILLed supervisor's key
+        # expires instead of lingering as a dead target forever
+        try:
+            from edl_tpu.observability.scrape import (
+                SUPERVISOR_METRICS_ADDR_PREFIX, AddrPublisher,
+                publish_host,
+            )
+
+            metrics_addr_pub = AddrPublisher(
+                coord, f"{SUPERVISOR_METRICS_ADDR_PREFIX}{name}",
+                f"{publish_host()}:{addr[1]}")
+            metrics_addr_pub.start()
+        except Exception as exc:
+            log.warn("metrics addr KV publish failed", error=str(exc))
         log.info("supervisor metrics serving", port=addr[1])
 
     def spawn_warm():
@@ -1779,6 +1796,11 @@ def run_elastic_worker(
             os.environ.pop("EDL_TRACE_ID", None)
         else:
             os.environ["EDL_TRACE_ID"] = prev_env_trace
+        if metrics_addr_pub is not None:
+            try:
+                metrics_addr_pub.stop()  # deletes the KV key on the way
+            except Exception:
+                pass
         if metrics_srv is not None:
             try:
                 metrics_srv.shutdown()

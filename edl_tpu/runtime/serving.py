@@ -34,6 +34,8 @@ the substrate user-facing:
 
 Scrape names (``edl_`` prefix): ``serving_request_seconds`` (histogram,
 :data:`~edl_tpu.observability.metrics.SERVING_LATENCY_BUCKETS`),
+``serving_span_seconds{phase=admit|queue|batch|forward|respond}``
+(histogram — the request-span taxonomy, doc/serving.md),
 ``serving_queue_depth`` (histogram, observed per iteration),
 ``serving_requests_total`` / ``serving_slo_violations_total`` /
 ``serving_dropped_requests_total`` / ``serving_reloads_total`` /
@@ -87,20 +89,52 @@ def _queue_hist():
         buckets=(0, 1, 2, 4, 8, 16, 32, 64, 128, 256))
 
 
+def _span_hist():
+    return get_registry().histogram(
+        "serving_span_seconds",
+        help="per-request phase latency (admit/queue/batch/forward/"
+             "respond — the request-span taxonomy)",
+        buckets=SERVING_LATENCY_BUCKETS)
+
+
 @dataclass
 class ServeRequest:
     """One in-flight inference request: a single example (tuple of
-    per-example arrays, no batch dim) and its completion future."""
+    per-example arrays, no batch dim), its completion future, and the
+    per-phase timestamps the request-span taxonomy is cut from
+    (doc/serving.md §request spans):
+
+    * **admit** — ``t_enqueue → t_queued``: routing, until the replica's
+      admission queue holds the request;
+    * **queue** — ``t_queued → t_admit``: waiting in the queue (+ the
+      co-batchee admission window);
+    * **batch** — ``t_admit → t_forward0``: padding/stacking to the
+      compiled shape;
+    * **forward** — ``t_forward0 → t_forward1``: the serve step + host
+      readback;
+    * **respond** — ``t_forward1 → t_done``: per-row completion.
+
+    ``trace_id`` (propagated from the ``/predict`` ``X-EDL-Trace-Id``
+    header, or any caller) makes the request's phases first-class
+    ``TraceEvent`` spans; without one, spans are emitted only for SLO
+    violations so a p99 breach is attributable to a phase without
+    flooding the trace ring at full qps."""
 
     payload: tuple
     id: int = 0
     t_enqueue: float = 0.0
+    t_queued: float = 0.0
+    t_admit: float = 0.0
+    t_forward0: float = 0.0
+    t_forward1: float = 0.0
     t_done: float = 0.0
+    trace_id: Optional[str] = None
 
     def __post_init__(self) -> None:
         self._done = threading.Event()
         self.result: Any = None
         self.error: Optional[BaseException] = None
+        self.slo_violation = False
 
     def complete(self, result: Any) -> None:
         self.t_done = time.perf_counter()
@@ -300,6 +334,7 @@ class ServingReplica:
         # lock each) have no business on it
         self._hist = _request_hist()
         self._qhist = _queue_hist()
+        self._shist = _span_hist()
         self._counters = get_counters()
 
     # -- lifecycle ----------------------------------------------------------
@@ -374,6 +409,7 @@ class ServingReplica:
         with self._cond:
             if self.state == STOPPED:
                 raise RequestDropped(f"replica {self.name} is stopped")
+            req.t_queued = time.perf_counter()
             self._queue.append(req)
             self._cond.notify_all()
 
@@ -464,6 +500,9 @@ class ServingReplica:
             batch = [self._queue.popleft()
                      for _ in range(min(len(self._queue),
                                         self.max_batch_size))]
+        t_admit = time.perf_counter()
+        for r in batch:
+            r.t_admit = t_admit
         return batch
 
     def _loop(self) -> None:
@@ -488,6 +527,7 @@ class ServingReplica:
             rows = [r.payload for r in reqs]
             rows += [rows[-1]] * (self.max_batch_size - n)
             batch = tuple(np.stack(col) for col in zip(*rows))
+            t_fwd0 = time.perf_counter()
             try:
                 out = self.server.serve(batch)
                 host = jax.tree.map(np.asarray, jax.device_get(out))
@@ -499,18 +539,67 @@ class ServingReplica:
                                        job=self.job)
                     req.fail(exc)
                 continue
+            t_fwd1 = time.perf_counter()
             self.iterations += 1
+            # iteration-level phases observed once per request so the
+            # phase histograms and the request histogram share a
+            # denominator (serving_span_queue_ms_p99 answers "where did
+            # the p99 go" against the same population)
             for i, req in enumerate(reqs):
+                req.t_forward0, req.t_forward1 = t_fwd0, t_fwd1
                 req.complete(jax.tree.map(lambda a: a[i], host))
                 self.requests_served += 1
                 lat = req.latency_s
                 self._hist.observe(lat, job=self.job)
+                self._shist.observe(
+                    max(req.t_queued - req.t_enqueue, 0.0), phase="admit")
+                self._shist.observe(
+                    max(req.t_admit - req.t_queued, 0.0), phase="queue")
+                self._shist.observe(
+                    max(t_fwd0 - req.t_admit, 0.0), phase="batch")
+                self._shist.observe(t_fwd1 - t_fwd0, phase="forward")
+                self._shist.observe(
+                    max(req.t_done - t_fwd1, 0.0), phase="respond")
                 self._counters.inc("serving_requests", job=self.job)
                 if self.slo_p99_ms and lat * 1000.0 > self.slo_p99_ms:
+                    req.slo_violation = True
                     self._counters.inc("serving_slo_violations",
                                        job=self.job)
+                if req.trace_id or req.slo_violation:
+                    self._emit_request_spans(req)
                 if self._on_done is not None:
                     self._on_done(req)
+
+    def _emit_request_spans(self, req: ServeRequest) -> None:
+        """Turn one request's phase timestamps into a TraceEvent span
+        tree (admit → queue → batch → forward → respond under one
+        ``serving_request`` root).  Emitted for requests carrying a
+        propagated trace_id and for SLO violations — the exemplar-style
+        bridge from a scraped ``edl_serving_request_seconds`` breach to
+        the phase that caused it."""
+        from edl_tpu.observability.tracing import new_trace_id
+
+        tracer = get_tracer()
+        tid = req.trace_id or new_trace_id()
+        lat_ms = round(req.latency_s * 1000.0, 3)
+        # the root span doubles as the exemplar: the trace_id a scraped
+        # histogram breach joins to, carrying the phase split inline
+        root = tracer.record_span(
+            "serving_request", "serving", req.t_enqueue, req.t_done,
+            trace_id=tid, replica=self.name, job=self.job,
+            request_id=req.id, latency_ms=lat_ms,
+            slo_violation=req.slo_violation,
+            queue_ms=round(max(req.t_admit - req.t_queued, 0.0) * 1e3, 3),
+            forward_ms=round((req.t_forward1 - req.t_forward0) * 1e3, 3))
+        for phase, t0, t1 in (
+                ("admit", req.t_enqueue, req.t_queued),
+                ("queue", req.t_queued, req.t_admit),
+                ("batch", req.t_admit, req.t_forward0),
+                ("forward", req.t_forward0, req.t_forward1),
+                ("respond", req.t_forward1, req.t_done)):
+            tracer.record_span(f"serving_request.{phase}", "serving",
+                               t0, max(t1, t0), trace_id=tid,
+                               parent_id=root)
 
 
 @dataclass
@@ -592,7 +681,13 @@ class ServingFleet:
         #: rolling completion window: (t_done, latency_s)
         self._window: "collections.deque[tuple[float, float]]" = (
             collections.deque(maxlen=max(int(window), 16)))
+        #: recent traced / SLO-violating requests with their phase split
+        #: (the exemplar ring the dashboard and flight records read)
+        self.exemplars: "collections.deque[dict]" = (
+            collections.deque(maxlen=64))
         self._watcher: Optional[_WeightWatcher] = None
+        self._metrics_srv = None
+        self._addr_publisher = None
         self.register_metrics()
 
     # -- replica construction ----------------------------------------------
@@ -732,15 +827,19 @@ class ServingFleet:
 
     # -- routing ------------------------------------------------------------
 
-    def submit(self, payload: tuple) -> ServeRequest:
+    def submit(self, payload: tuple,
+               trace_id: Optional[str] = None) -> ServeRequest:
         """Admit one request: routed to the READY replica with the
         shortest queue (a building/reloading replica receives no new
         traffic; with none ready — transient, e.g. a single replica
         mid-build — the request queues on the least-loaded live replica
-        and waits rather than failing)."""
+        and waits rather than failing).  ``trace_id`` (the ``/predict``
+        ``X-EDL-Trace-Id`` header, or any caller's id) makes the
+        request's phase spans first-class trace events."""
         req = ServeRequest(payload=tuple(np.asarray(a) for a in payload),
                            id=next(self._ids),
-                           t_enqueue=time.perf_counter())
+                           t_enqueue=time.perf_counter(),
+                           trace_id=trace_id)
         while True:
             with self._lock:
                 live = [r for r in self._replicas if r.state != STOPPED]
@@ -765,6 +864,18 @@ class ServingFleet:
     def _record(self, req: ServeRequest) -> None:
         with self._lock:
             self._window.append((req.t_done, req.latency_s))
+            if req.trace_id or req.slo_violation:
+                # exemplar-style: the recent traced/violating requests,
+                # joinable from a scraped histogram breach to a phase
+                self.exemplars.append({
+                    "trace_id": req.trace_id,
+                    "latency_ms": round(req.latency_s * 1e3, 3),
+                    "slo_violation": req.slo_violation,
+                    "queue_ms": round(
+                        max(req.t_admit - req.t_queued, 0.0) * 1e3, 3),
+                    "forward_ms": round(
+                        (req.t_forward1 - req.t_forward0) * 1e3, 3),
+                })
 
     # -- observation --------------------------------------------------------
 
@@ -821,6 +932,41 @@ class ServingFleet:
                      help="replicas in the active set", job=self.job)
         reg.gauge_fn("serving_fleet_queue_depth", self.queue_depth,
                      help="queued requests across the fleet", job=self.job)
+
+    def serve_metrics(self, port: int = 0, host: str = "0.0.0.0",
+                      publish: bool = True, replica: Optional[str] = None,
+                      ttl_s: Optional[float] = None):
+        """Serve this process's ``/metrics`` + ``/healthz`` (shared
+        registry — every ``edl_serving_*`` series this fleet records)
+        and, when a coordinator KV client was given (``kv=``) and
+        ``publish`` is True, publish the bound address under the TTL'd
+        ``serving-metrics-addr/<job>/<replica>`` key so the scrape plane
+        discovers it without kubectl.  Returns the HTTP server (also
+        shut down by :meth:`stop`)."""
+        from edl_tpu.observability.health import serve_health
+        from edl_tpu.observability.scrape import (
+            DEFAULT_ADDR_TTL_S, SERVING_METRICS_ADDR_PREFIX, AddrPublisher,
+        )
+
+        self._metrics_srv = serve_health(
+            port, {"replicas_ready": lambda: self.replicas_ready() >= 1},
+            host=host)
+        bound = self._metrics_srv.server_address[1]
+        if publish and self._kv is not None:
+            import os as _os
+            import socket as _socket
+
+            from edl_tpu.observability.scrape import publish_host
+
+            rep = replica or f"{_socket.gethostname()}-{_os.getpid()}"
+            key = f"{SERVING_METRICS_ADDR_PREFIX}{self.job}/{rep}"
+            self._addr_publisher = AddrPublisher(
+                self._kv, key, f"{publish_host(host)}:{bound}",
+                ttl_s=ttl_s if ttl_s is not None else DEFAULT_ADDR_TTL_S)
+            self._addr_publisher.start()
+            log.info("serving metrics published", job=self.job, key=key,
+                     port=bound)
+        return self._metrics_srv
 
     # -- rolling weight reloads --------------------------------------------
 
@@ -907,6 +1053,12 @@ class ServingFleet:
     def stop(self, drain: bool = True) -> None:
         if self._watcher is not None:
             self._watcher.stop()
+        if self._addr_publisher is not None:
+            self._addr_publisher.stop()  # best-effort kv_del of the key
+            self._addr_publisher = None
+        if self._metrics_srv is not None:
+            self._metrics_srv.shutdown()
+            self._metrics_srv = None
         with self._lock:
             replicas = self._replicas + self._hinted
             self._replicas, self._hinted = [], []
@@ -1051,10 +1203,25 @@ def serve_main(env=None) -> int:
     params = (ckpt.restore(template, step=step)["params"]
               if step is not None else template["params"])
     job = f"{env.get('EDL_NAMESPACE', 'default')}/{env.get('EDL_JOB_NAME', 'serving')}"
+    # coordinator KV (optional): where the replica publishes its
+    # /metrics address so the scrape plane discovers it — set
+    # EDL_COORD_ENDPOINT (host:port) on the pod/harness to enable;
+    # without it the replica still serves /metrics, just undiscovered
+    kv = None
+    coord_ep = env.get("EDL_COORD_ENDPOINT", "")
+    if coord_ep and ":" in coord_ep:
+        from edl_tpu.coord.client import CoordClient
+
+        chost, _, cport = coord_ep.rpartition(":")
+        try:
+            kv = CoordClient(chost, int(cport))
+        except Exception as exc:
+            print(f"warning: coordinator {coord_ep} unreachable "
+                  f"({str(exc)[:80]}); metrics address not published")
     fleet = ServingFleet(
         lambda p, b: mlp.apply(p, b[0]), params,
         example_row=(np.zeros((sizes[0],), np.float32),),
-        job=job,
+        job=job, kv=kv,
         max_batch_size=int(env.get("EDL_SERVING_MAX_BATCH", "8")),
         max_queue_ms=float(env.get("EDL_SERVING_MAX_QUEUE_MS", "2.0")),
         slo_p99_ms=float(env.get("EDL_SERVING_SLO_P99_MS", "0")),
@@ -1068,11 +1235,15 @@ def serve_main(env=None) -> int:
     health_port = int(env.get("EDL_HEALTH_PORT", "8080"))
     health = None
     if health_port >= 0:
-        from edl_tpu.observability.health import serve_health
-
-        health = serve_health(health_port,
-                              {"replica_ready":
-                               lambda: fleet.replicas_ready() >= 1})
+        # the readiness gate AND the scrape endpoint: the bound address
+        # is published to coordinator KV (TTL'd
+        # serving-metrics-addr/<job>/<replica>) when a coordinator is
+        # reachable, so the MetricsScraper finds this replica without
+        # kubectl
+        health = fleet.serve_metrics(
+            health_port, publish=True,
+            replica=env.get("EDL_POD_NAME") or None,
+            ttl_s=float(env.get("EDL_SERVING_METRICS_TTL_S", "30")))
 
     class Handler(BaseHTTPRequestHandler):
         def do_POST(self):  # noqa: N802 (http.server casing)
@@ -1083,7 +1254,13 @@ def serve_main(env=None) -> int:
                 body = self.rfile.read(
                     int(self.headers.get("Content-Length", "0")))
                 row = _json.loads(body.decode())["inputs"]
-                req = fleet.submit((np.asarray(row, np.float32),))
+                # the header contract (doc/serving.md): X-EDL-Trace-Id
+                # rides into the request's phase spans and back out on
+                # the reply, so a client-observed slow call is joinable
+                # to its server-side span tree
+                trace_id = self.headers.get("X-EDL-Trace-Id") or None
+                req = fleet.submit((np.asarray(row, np.float32),),
+                                   trace_id=trace_id)
                 out = req.wait(timeout=30.0)
                 payload = _json.dumps({
                     "outputs": np.asarray(out).tolist(),
@@ -1095,6 +1272,8 @@ def serve_main(env=None) -> int:
                 return
             self.send_response(200)
             self.send_header("Content-Type", "application/json")
+            if trace_id:
+                self.send_header("X-EDL-Trace-Id", trace_id)
             self.send_header("Content-Length", str(len(payload)))
             self.end_headers()
             self.wfile.write(payload)
@@ -1119,7 +1298,13 @@ def serve_main(env=None) -> int:
             pass
     finally:
         srv.shutdown()
-        fleet.stop(drain=True)  # graceful: finish the queue, drop nothing
+        fleet.stop(drain=True)  # graceful: finish the queue, drop
+        # nothing; also unpublishes the metrics address + stops /metrics
         if health is not None:
             health.shutdown()
+        if kv is not None:
+            try:
+                kv.close()
+            except Exception:
+                pass
     return 0

@@ -383,9 +383,12 @@ class ServingScaler:
 
     ``stats_for(uid)`` supplies the signal — a
     :class:`~edl_tpu.runtime.serving.FleetStats`-shaped object (windowed
-    p50/p99/qps/queue depth), scraped from replica /metrics in a real
-    deployment, read off the in-process fleet in the harness.
-    ``actuate(uid, n)`` applies the plan; when None, the cluster's
+    p50/p99/qps/queue depth).  The PRODUCTION source is the scrape
+    plane: :meth:`feed_from` wires a
+    :class:`~edl_tpu.observability.scrape.FleetView` built over scraped
+    replica ``/metrics`` (what the bench serving leg and deployments
+    run); handing the in-process ``fleet.stats`` directly remains as a
+    test seam.  ``actuate(uid, n)`` applies the plan; when None, the cluster's
     replica-group dial (``update_trainer_parallelism`` — the group dial
     is workload-agnostic) is used with the same bounded retries the
     trainer path gets.  Deterministic like Autoscaler: :meth:`tick` runs
@@ -429,6 +432,16 @@ class ServingScaler:
         self.hint_sink: Optional[Callable[[str, int], None]] = None
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+
+    def feed_from(self, view) -> "ServingScaler":
+        """Feed the policy from the scrape plane: ``view`` is a
+        :class:`~edl_tpu.observability.scrape.FleetView` whose
+        ``stats_for(uid)`` rolls scraped replica ``/metrics`` up into
+        the FleetStats shape :meth:`decide` consumes.  This is the
+        deployed wiring (ROADMAP #4's observability half): the scaler
+        sees exactly what a scraper can see — no in-process hook."""
+        self.stats_for = view.stats_for
+        return self
 
     # -- registry ----------------------------------------------------------
 

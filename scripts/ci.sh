@@ -534,6 +534,126 @@ print("serving smoke OK:", {k: tally[k] for k in
       "prewarm_hits", fleet.prewarm_hits, "generation", fleet.generation)
 EOF
 
+echo "== scrape-plane smoke (HA pair + serving fleet under the MetricsScraper)"
+# The fleet scrape plane end-to-end (doc/observability.md §scrape-plane):
+# an HA coordinator pair and a live serving fleet are discovered/scraped
+# by a MetricsScraper — the fleet via its TTL'd serving-metrics-addr KV
+# key, the coordinators as static targets — then (1) FleetView's
+# qps/p99 rollup is held against the fleet's own FleetStats within
+# tolerance, (2) an injected SLO breach fires the fast-burn rule within
+# 2 evaluation windows, and (3) the SCRAPE-FED ServingScaler reproduces
+# the scale-up decision the hook-fed policy test pins
+# (tests/test_serving.py::test_policy_grows_on_p99_breach: 2 → 3).
+JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=4" \
+python - <<'EOF'
+import tempfile, threading, time
+
+import jax, numpy as np
+
+from edl_tpu.api.types import ServingJob, ServingSpec
+from edl_tpu.coord.client import CoordClient
+from edl_tpu.coord.server import spawn_ha_pair
+from edl_tpu.models import mlp
+from edl_tpu.observability.collector import get_counters
+from edl_tpu.observability.metrics import get_registry
+from edl_tpu.observability.scrape import (
+    AlertEngine, BurnRateRule, FleetView, MetricsScraper, ScrapeTarget,
+    kv_targets, render_fleet_dashboard)
+from edl_tpu.runtime.serving import PoissonTraffic, ServingFleet
+from edl_tpu.scheduler.autoscaler import ServingScaler
+
+JOB = "ci/scrape"
+SLO_MS = 150.0
+primary, standby = spawn_ha_pair(
+    tempfile.mkdtemp(prefix="edl-ci-scrape-"), health_port=0)
+client = CoordClient("127.0.0.1", primary.port)
+params = mlp.init(jax.random.key(0), [16, 32, 4])
+fleet = ServingFleet(lambda p, b: mlp.apply(p, b[0]), params,
+                     example_row=(np.zeros((16,), np.float32),),
+                     job=JOB, max_batch_size=8, max_queue_ms=1.0,
+                     slo_p99_ms=SLO_MS, kv=client)
+try:
+    fleet.scale_to(1)
+    fleet.serve_metrics(0, host="127.0.0.1", publish=True, replica="r0")
+    scraper = MetricsScraper(discover=[kv_targets(client)],
+                             interval_s=0.2, timeout_s=2.0)
+    scraper.add_target(ScrapeTarget(
+        name="coord/primary", addr=f"127.0.0.1:{primary.health_port}",
+        labels={"role": "coordinator"}))
+    scraper.add_target(ScrapeTarget(
+        name="coord/standby", addr=f"127.0.0.1:{standby.health_port}",
+        labels={"role": "coordinator"}))
+    view = FleetView(scraper, window_s=2.0)
+    engine = AlertEngine(view, rules=[BurnRateRule(
+        budget_fraction=0.001, fast_window_s=2.0, slow_window_s=10.0,
+        fast_factor=14.4, min_requests=50)])
+    # dynamic discovery: the fleet's TTL'd KV key became a target
+    scraper.sweep()
+    names = {t.name for t in scraper.targets()}
+    assert f"serving/{JOB}/r0" in names, names
+    # traffic while sweeping, then the same-instant parity check
+    traffic = PoissonTraffic(
+        fleet, lambda i: (np.full((16,), i % 9, np.float32),),
+        qps=200, seed=4)
+    halt = threading.Event()
+    def sweeper():
+        while not halt.wait(0.2):
+            scraper.sweep()
+    t = threading.Thread(target=sweeper); t.start()
+    traffic.run(3.0)
+    scraper.sweep()
+    st = view.stats_for(JOB)
+    own = fleet.stats(window_s=2.0)
+    halt.set(); t.join()
+    tally = traffic.await_all(timeout_s=30.0)
+    assert tally["dropped"] == 0 and tally["errors"] == 0, tally
+    assert st.requests_windowed > 0, st
+    assert 0.6 * own.qps <= st.qps <= 1.4 * own.qps, (st, own)
+    assert st.p99_ms <= max(own.p99_ms * 4, 5.0), (st, own)
+    assert own.p99_ms <= max(st.p99_ms * 4, 5.0), (st, own)
+    # both HA members' coordinator series landed on one sweep config
+    assert scraper.latest("edl_coord_members", agg="max") is not None
+    states = {s["name"]: s["state"] for s in scraper.target_states()}
+    assert states["coord/primary"] == "up", states
+    assert states["coord/standby"] == "up", states
+    # injected SLO breach: large observations + violations land in the
+    # replica-owned series; the scraped view must (a) push the policy to
+    # the PINNED hook-fed decision and (b) fire the fast-burn rule
+    # within 2 evaluation windows
+    h = get_registry().histogram("serving_request_seconds")
+    for _ in range(60):
+        h.observe(SLO_MS / 1000.0 * 1.6, job=JOB)
+    get_counters().inc("serving_requests", 60, job=JOB)
+    get_counters().inc("serving_slo_violations", 60, job=JOB)
+    evals = None
+    for i in range(1, 4):
+        scraper.sweep()
+        if "slo_fast_burn" in {a.rule for a in engine.evaluate()}:
+            evals = i
+            break
+        time.sleep(0.2)
+    assert evals is not None and evals <= 2, evals
+    breach = view.stats_for(JOB)
+    assert breach.p99_ms > SLO_MS, breach
+    sc = ServingScaler().feed_from(view)
+    job = ServingJob(name="scrape", namespace="ci", spec=ServingSpec(
+        min_replicas=1, max_replicas=8, slo_p99_ms=SLO_MS))
+    decision = sc.decide(job, sc.stats_for(JOB), 2)
+    assert decision == 3, decision  # the pinned hook-fed decision
+    dash = render_fleet_dashboard(view, engine)
+    assert JOB in dash and "slo_fast_burn" in dash, dash
+    print("scrape smoke OK:", {"scraped_qps": st.qps, "own_qps": own.qps,
+                               "scraped_p99_ms": st.p99_ms,
+                               "own_p99_ms": own.p99_ms,
+                               "fast_burn_evals": evals,
+                               "decision": decision})
+finally:
+    fleet.stop()
+    client.close()
+    primary.stop()
+    standby.stop()
+EOF
+
 echo "== determinism smoke (scripted 2→1→2 resize vs unresized control)"
 # Accuracy-consistent elasticity tripwire: the SAME seeded job run with
 # a scripted 2→1→2 resize must match the unresized control's loss

@@ -6,7 +6,6 @@ telemetry plane (observability/metrics.py + tracing span IDs).
 """
 
 import io
-import re
 
 from edl_tpu.api.types import (
     RESOURCE_CPU, RESOURCE_MEMORY, RESOURCE_TPU,
@@ -19,91 +18,88 @@ from edl_tpu.observability.tracing import Tracer
 
 # -- strict Prometheus text-format (0.0.4) parser ---------------------------
 #
-# The conformance oracle every process's /metrics is held to: metric-name
-# and label grammar, HELP/TYPE placement, histogram le-monotonicity and
-# the _sum/_count contract.  Deliberately strict — a scraper is.
+# The conformance oracle every process's /metrics is held to — promoted
+# to library code (edl_tpu/observability/metrics.py::parse_exposition,
+# the same parser the scrape plane trusts in production); the alias
+# keeps every existing import site (tests, ci.sh heredocs) working, and
+# TestExpositionParser below remains its strictness unit suite.
 
-_METRIC_RE = re.compile(
-    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
-    r"(?:\{(?P<labels>[^}]*)\})? "
-    r"(?P<value>[0-9eE+.\-]+|\+Inf|-Inf|NaN)$")
-_LABEL_RE = re.compile(
-    r'^(?P<k>[a-zA-Z_][a-zA-Z0-9_]*)="(?P<v>(?:[^"\\]|\\.)*)"$')
+from edl_tpu.observability.metrics import (  # noqa: E402
+    ExpositionError, iter_samples, parse_exposition,
+)
 
-
-def parse_prometheus(text: str) -> dict:
-    """Parse exposition text into {series_key: float}; raises
-    AssertionError on any grammar violation."""
-    series: dict[str, float] = {}
-    typed: dict[str, str] = {}
-    for line in text.splitlines():
-        if not line.strip():
-            continue
-        if line.startswith("# HELP "):
-            assert len(line.split(" ", 3)) >= 3, f"bad HELP: {line!r}"
-            continue
-        if line.startswith("# TYPE "):
-            parts = line.split(" ")
-            assert len(parts) >= 4, f"bad TYPE: {line!r}"
-            assert parts[3] in ("counter", "gauge", "histogram",
-                                "summary", "untyped"), line
-            assert parts[2] not in typed, f"duplicate TYPE for {parts[2]}"
-            typed[parts[2]] = parts[3]
-            continue
-        assert not line.startswith("#"), f"unknown comment: {line!r}"
-        m = _METRIC_RE.match(line)
-        assert m, f"malformed sample line: {line!r}"
-        labels = m.group("labels")
-        if labels:
-            for pair in _split_label_pairs(labels):
-                assert _LABEL_RE.match(pair), f"bad label {pair!r} in {line!r}"
-        key = m.group("name") + ("{" + labels + "}" if labels else "")
-        assert key not in series, f"duplicate series: {key}"
-        v = m.group("value")
-        series[key] = (float("inf") if v == "+Inf"
-                       else float("-inf") if v == "-Inf" else float(v))
-    # histogram contracts: buckets monotone in le AND in count; sum/count
-    for name, kind in typed.items():
-        if kind != "histogram":
-            continue
-        by_labels: dict[str, list[tuple[float, float]]] = {}
-        for key, v in series.items():
-            if not key.startswith(name + "_bucket"):
-                continue
-            lm = re.search(r'le="([^"]+)"', key)
-            assert lm, key
-            le = float("inf") if lm.group(1) == "+Inf" else float(lm.group(1))
-            rest = re.sub(r'le="[^"]+",?', "", key).rstrip(",{}")
-            by_labels.setdefault(rest, []).append((le, v))
-        for rest, buckets in by_labels.items():
-            buckets.sort()
-            assert buckets[-1][0] == float("inf"), f"{name}: no +Inf bucket"
-            counts = [c for _, c in buckets]
-            assert counts == sorted(counts), f"{name}: non-monotone buckets"
-    return series
+parse_prometheus = parse_exposition
 
 
-def _split_label_pairs(labels: str) -> list[str]:
-    """Split a label body on commas outside quoted values."""
-    out, cur, in_q, esc = [], "", False, False
-    for ch in labels:
-        if esc:
-            cur += ch
-            esc = False
-        elif ch == "\\":
-            cur += ch
-            esc = True
-        elif ch == '"':
-            cur += ch
-            in_q = not in_q
-        elif ch == "," and not in_q:
-            out.append(cur)
-            cur = ""
-        else:
-            cur += ch
-    if cur:
-        out.append(cur)
-    return out
+class TestExpositionParser:
+    """The promoted parser's unit suite: every grammar/contract rule the
+    in-test implementation enforced, pinned against the library one."""
+
+    def test_values_labels_and_specials(self):
+        s = parse_exposition(
+            "# HELP edl_x_total help\n# TYPE edl_x_total counter\n"
+            'edl_x_total{job="a b",k="v"} 3\n'
+            "edl_x_total 2\n"
+            "edl_g +Inf\nedl_h -Inf\nedl_n NaN\n")
+        assert s['edl_x_total{job="a b",k="v"}'] == 3
+        assert s["edl_x_total"] == 2
+        assert s["edl_g"] == float("inf")
+        assert s["edl_h"] == float("-inf")
+        assert s["edl_n"] != s["edl_n"]  # NaN
+
+    def test_iter_samples_unescapes_label_values(self):
+        samples = iter_samples('m{v="a\\"b\\\\c\\nd"} 1\n')
+        assert samples == [("m", {"v": 'a"b\\c\nd'}, 1.0)]
+
+    def test_unescape_backslash_abutting_n_is_not_a_newline(self):
+        # spec form of the raw value `dir\name` is v="dir\\name": the
+        # unescape must scan left-to-right — sequential replace would
+        # see the second backslash + n as \n and corrupt the value
+        samples = iter_samples('m{v="dir\\\\name"} 1\n')
+        assert samples == [("m", {"v": "dir\\name"}, 1.0)]
+        # and the dict view round-trips it back to the escaped form
+        assert parse_exposition('m{v="dir\\\\name"} 1\n') == {
+            'm{v="dir\\\\name"}': 1.0}
+
+    def test_rejects_malformed_sample_line(self):
+        import pytest
+
+        for bad in ("1metric 3", "m{unquoted=x} 1", "m{} x",
+                    "m 1 2 3", "# WAT comment"):
+            with pytest.raises(ExpositionError):
+                parse_exposition(bad + "\n")
+
+    def test_rejects_bad_help_type_and_duplicates(self):
+        import pytest
+
+        with pytest.raises(ExpositionError, match="TYPE"):
+            parse_exposition("# TYPE only\n")
+        with pytest.raises(ExpositionError, match="unknown type"):
+            parse_exposition("# TYPE m exotic\n")
+        with pytest.raises(ExpositionError, match="duplicate TYPE"):
+            parse_exposition("# TYPE m gauge\n# TYPE m gauge\nm 1\n")
+        with pytest.raises(ExpositionError, match="duplicate series"):
+            parse_exposition('m{a="1"} 1\nm{a="1"} 2\n')
+
+    def test_histogram_contracts_enforced(self):
+        import pytest
+
+        ok = ("# TYPE h histogram\n"
+              'h_bucket{le="0.1"} 1\nh_bucket{le="+Inf"} 2\n'
+              "h_sum 0.3\nh_count 2\n")
+        assert parse_exposition(ok)['h_bucket{le="+Inf"}'] == 2
+        with pytest.raises(ExpositionError, match="no \\+Inf"):
+            parse_exposition("# TYPE h histogram\n"
+                             'h_bucket{le="0.1"} 1\n')
+        with pytest.raises(ExpositionError, match="non-monotone"):
+            parse_exposition("# TYPE h histogram\n"
+                             'h_bucket{le="0.1"} 3\n'
+                             'h_bucket{le="+Inf"} 2\n')
+
+    def test_exposition_error_is_assertion_shaped(self):
+        # pre-promotion callers wrapped the parser in try/except
+        # AssertionError; the promoted exception must still satisfy them
+        assert issubclass(ExpositionError, AssertionError)
 
 
 def _job(name, chips=1, lo=2, hi=4):

@@ -1,0 +1,985 @@
+"""Fleet scrape plane tests (edl_tpu/observability/scrape.py).
+
+Covers the ring store + windowed queries, sweep behavior (jittered
+intervals are exercised via the loop; backoff/staleness via a fake
+clock), dynamic target discovery (coordinator KV, address files,
+jobparser manifest annotations), the end-to-end scrape against BOTH
+coordinator backends plus a black-holed target, the FleetView rollup
+feeding ServingScaler the same decisions the hook-fed policy tests pin,
+the AlertEngine rules, and the shared flight-record dump lock / cooldown
+dedupe regression.
+"""
+
+import json
+import os
+import socket
+import threading
+import time
+
+import pytest
+
+from edl_tpu.observability.metrics import MetricsRegistry
+from edl_tpu.observability.scrape import (
+    SERVING_METRICS_ADDR_PREFIX, AddrPublisher, Alert, AlertEngine,
+    AlertRule, BurnRateRule, ConservationRule, FleetView,
+    GoodputCollapseRule, MetricsScraper, ScrapeTarget, TargetDownRule,
+    file_targets, format_addr_value, kv_targets, manifest_targets,
+    parse_addr_value, publish_serving_metrics_addr,
+    render_fleet_dashboard, static_targets,
+)
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def make_scraper(fetch_map, clock=None, **kw):
+    """Scraper over injected fetchers: fetch_map maps target name →
+    callable returning exposition text (or raising)."""
+    clock = clock or FakeClock()
+    kw.setdefault("interval_s", 1.0)
+    kw.setdefault("registry", MetricsRegistry())
+
+    def fetch(target):
+        return fetch_map[target.name]()
+
+    s = MetricsScraper(fetch=fetch, clock=clock, **kw)
+    for name in fetch_map:
+        s.add_target(ScrapeTarget(name=name, addr=f"{name}:0"))
+    return s, clock
+
+
+# ----------------------------------------------------- ring store + queries
+
+
+class TestQueries:
+    def test_latest_delta_rate_and_counter_reset(self):
+        reg = MetricsRegistry()
+        c = reg.counter("reqs")
+        c.inc(10, job="a")
+        s, clock = make_scraper({"t1": reg.render})
+        s.sweep()
+        clock.advance(1.0)
+        c.inc(20, job="a")
+        s.sweep()
+        assert s.latest("edl_reqs_total", {"job": "a"}) == 30
+        assert s.delta("edl_reqs_total", 10.0, {"job": "a"}) == 20
+        assert abs(s.rate("edl_reqs_total", 10.0) - 20.0) < 1e-6
+        # counter reset (process restart): the post-reset value counts
+        # from zero instead of producing a negative increase
+        clock.advance(1.0)
+        c.clear()
+        c.inc(5, job="a")
+        s.sweep()
+        assert s.delta("edl_reqs_total", 10.0) == 25  # 20 + 5
+        # label filter that matches nothing
+        assert s.latest("edl_reqs_total", {"job": "zzz"}) is None
+
+    def test_sum_by_and_label_values(self):
+        reg = MetricsRegistry()
+        g = reg.gauge("replicas")
+        g.set(2, job="a")
+        g.set(3, job="b")
+        s, clock = make_scraper({"t1": reg.render})
+        s.sweep()
+        assert s.sum_by("edl_replicas", "job") == {"a": 2.0, "b": 3.0}
+        assert s.label_values("edl_replicas", "job") == ["a", "b"]
+
+    def test_latest_aggregations_across_targets(self):
+        r1, r2 = MetricsRegistry(), MetricsRegistry()
+        r1.gauge("frac").set(0.9, job="a")
+        r2.gauge("frac").set(0.5, job="a")
+        s, _ = make_scraper({"t1": r1.render, "t2": r2.render})
+        s.sweep()
+        assert s.latest("edl_frac", agg="min") == 0.5
+        assert s.latest("edl_frac", agg="max") == 0.9
+        assert abs(s.latest("edl_frac", agg="avg") - 0.7) < 1e-9
+        assert abs(s.latest("edl_frac") - 1.4) < 1e-9  # sum default
+
+    def test_histogram_quantile_windowed_interpolation(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("lat_seconds", buckets=(0.01, 0.05, 0.1))
+        s, clock = make_scraper({"t1": reg.render})
+        s.sweep()
+        clock.advance(1.0)
+        for _ in range(90):
+            h.observe(0.005)   # le 0.01
+        for _ in range(10):
+            h.observe(0.08)    # le 0.1
+        s.sweep()
+        p50 = s.histogram_quantile("edl_lat_seconds", 0.50, 10.0)
+        p99 = s.histogram_quantile("edl_lat_seconds", 0.99, 10.0)
+        assert p50 is not None and p50 <= 0.01
+        assert 0.05 < p99 <= 0.1  # interpolated inside the last bucket
+        # a window with no observations: None, not zero
+        clock.advance(100.0)
+        s.sweep()
+        assert s.histogram_quantile("edl_lat_seconds", 0.99, 1.0) is None
+
+    def test_ring_bounded_retention(self):
+        reg = MetricsRegistry()
+        g = reg.gauge("v")
+        s, clock = make_scraper({"t1": reg.render}, retention=8)
+        for i in range(50):
+            g.set(i)
+            s.sweep()
+            clock.advance(1.0)
+        assert s.series_count() >= 1
+        fam = s._series["edl_v"]
+        ring = next(iter(fam.values()))
+        assert len(ring.samples) == 8  # bounded, oldest evicted
+
+
+# ------------------------------------------------ sweep / backoff / staleness
+
+
+class TestSweepBehavior:
+    def test_failure_backoff_grows_and_is_bounded(self):
+        calls = {"n": 0}
+
+        def boom():
+            calls["n"] += 1
+            raise OSError("connection refused")
+
+        s, clock = make_scraper({"dead": boom}, interval_s=1.0,
+                                backoff_base_s=1.0, backoff_max_s=4.0)
+        s.sweep()
+        assert calls["n"] == 1
+        st = s._state[("dead:0", "/metrics")]
+        assert st.consecutive_failures == 1
+        # not due until the backoff lapses
+        s.sweep()
+        assert calls["n"] == 1
+        clock.advance(1.1)
+        s.sweep()
+        assert calls["n"] == 2 and st.consecutive_failures == 2
+        # exponential: 2s now; then clamped at backoff_max_s forever
+        clock.advance(1.1)
+        s.sweep()
+        assert calls["n"] == 2
+        for _ in range(5):
+            clock.advance(4.1)
+            s.sweep()
+        assert st.consecutive_failures >= 5
+        assert st.next_due_t - clock() <= 4.0 + 1e-9  # bounded
+
+    def test_staleness_marked_and_healthy_targets_unaffected(self):
+        reg = MetricsRegistry()
+        reg.gauge("ok").set(1)
+        flaky = {"fail": False}
+
+        def maybe():
+            if flaky["fail"]:
+                raise OSError("down")
+            return reg.render()
+
+        s, clock = make_scraper({"good": reg.render, "flaky": maybe},
+                                interval_s=1.0, stale_after_s=3.0)
+        s.sweep()
+        states = {t["name"]: t for t in s.target_states()}
+        assert states["good"]["state"] == "up"
+        assert states["flaky"]["state"] == "up"
+        flaky["fail"] = True
+        for _ in range(6):
+            clock.advance(1.0)
+            s.sweep()
+        states = {t["name"]: t for t in s.target_states()}
+        # the dead target is marked, the healthy one kept its cadence
+        assert states["flaky"]["state"] == "down"
+        assert states["flaky"]["consecutive_failures"] >= 1
+        assert states["flaky"]["staleness_s"] > 3.0
+        assert states["good"]["state"] == "up"
+        assert states["good"]["staleness_s"] <= 1.0
+
+    def test_removed_target_rings_pruned_and_stale_gauges_excluded(self):
+        """A dead/removed target's final gauge samples must not be
+        summed into latest() rollups forever: a drained pod's frozen
+        queue-depth would otherwise block shrink decisions for good."""
+        r1, r2 = MetricsRegistry(), MetricsRegistry()
+        r1.gauge("serving_fleet_queue_depth").set(9, job="j")
+        r2.gauge("serving_fleet_queue_depth").set(0, job="j")
+        s, clock = make_scraper({"dead": r1.render, "live": r2.render},
+                                stale_after_s=3.0)
+        s.sweep()
+        assert s.latest("edl_serving_fleet_queue_depth",
+                        {"job": "j"}) == 9
+        # the dead pod stops answering: its last sample ages past the
+        # staleness horizon and drops out of latest() (the live target
+        # keeps being re-scraped)
+        del s._fetch  # not used below; guard against accidental scrape
+        s._fetch = lambda t: (_ for _ in ()).throw(OSError("down")) \
+            if t.name == "dead" else r2.render()
+        for _ in range(5):
+            clock.advance(1.0)
+            s.sweep()
+        assert s.latest("edl_serving_fleet_queue_depth",
+                        {"job": "j"}) == 0
+        # explicit last-known-value opt-out still sees it
+        assert s.latest("edl_serving_fleet_queue_depth", {"job": "j"},
+                        max_age_s=float("inf")) == 9
+        # removing the target prunes its rings entirely (no unbounded
+        # ring growth under target churn)
+        before = s.series_count()
+        s.remove_target(ScrapeTarget(name="dead", addr="dead:0"))
+        assert s.series_count() < before
+        assert s.latest("edl_serving_fleet_queue_depth", {"job": "j"},
+                        max_age_s=float("inf")) == 0
+
+    def test_raising_discovery_source_freezes_not_forgets_targets(self):
+        """A transient coordinator outage (discovery source raising)
+        must FREEZE the discovered target set, not age it out — the
+        fleet going undiscoverable is exactly when its down-alerts must
+        keep standing."""
+        reg = MetricsRegistry()
+        reg.gauge("x").set(1)
+        broken = {"on": False}
+
+        def discover():
+            if broken["on"]:
+                raise OSError("coordinator unreachable")
+            return [ScrapeTarget(name="d1", addr="d1:0")]
+
+        s = MetricsScraper(discover=[discover],
+                           fetch=lambda t: reg.render(),
+                           clock=FakeClock(), registry=MetricsRegistry(),
+                           forget_after_sweeps=2)
+        s.sweep()
+        assert [t.name for t in s.targets()] == ["d1"]
+        broken["on"] = True
+        for _ in range(5):  # well past forget_after_sweeps
+            s.sweep()
+        assert [t.name for t in s.targets()] == ["d1"]  # frozen, kept
+        broken["on"] = False
+        s.sweep()
+        assert [t.name for t in s.targets()] == ["d1"]
+
+    def test_discovered_target_dropped_after_source_forgets_it(self):
+        reg = MetricsRegistry()
+        present = {"on": True}
+
+        def discover():
+            if present["on"]:
+                return [ScrapeTarget(name="d1", addr="d1:0")]
+            return []
+
+        s = MetricsScraper(discover=[discover], fetch=lambda t: reg.render(),
+                           clock=FakeClock(), registry=MetricsRegistry(),
+                           forget_after_sweeps=2)
+        s.sweep()
+        assert [t.name for t in s.targets()] == ["d1"]
+        present["on"] = False
+        s.sweep()
+        assert s.targets()  # one miss: kept
+        s.sweep()
+        assert s.targets() == []  # forgotten
+
+    def test_self_metrics_rendered_strict(self):
+        from edl_tpu.observability.metrics import parse_exposition
+
+        reg = MetricsRegistry()
+        src = MetricsRegistry()
+        src.gauge("x").set(1)
+        s = MetricsScraper(fetch=lambda t: src.render(), registry=reg,
+                           clock=FakeClock())
+        s.add_target(ScrapeTarget(name="t", addr="t:0"))
+        s.sweep()
+        series = parse_exposition(reg.render())
+        assert series['edl_scrape_targets{state="up"}'] == 1
+        assert series["edl_scrape_sweep_seconds_count"] >= 1
+        assert series["edl_scrape_series"] >= 1
+
+    def test_jittered_loop_runs_and_stops(self):
+        reg = MetricsRegistry()
+        reg.gauge("x").set(1)
+        s = MetricsScraper(fetch=lambda t: reg.render(),
+                           registry=MetricsRegistry(),
+                           interval_s=0.02, jitter_frac=0.5)
+        s.add_target(ScrapeTarget(name="t", addr="t:0"))
+        s.start()
+        deadline = time.monotonic() + 5.0
+        while s.sweeps < 3 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        s.stop()
+        assert s.sweeps >= 3
+        assert not s.is_alive()
+
+
+# ------------------------------------------------------------- discovery
+
+
+class TestDiscovery:
+    def test_kv_targets_supervisor_and_serving_with_ttl(self):
+        from edl_tpu.coord import PyCoordService
+
+        kv = PyCoordService()
+        kv.kv_set("metrics-addr-w0", b"127.0.0.1:9100")
+        publish_serving_metrics_addr(kv, "ns/svc", "r0",
+                                     "127.0.0.1:9200", ttl_s=60.0)
+        # an EXPIRED serving key is skipped — the TTL semantics plain KV
+        # lacks, honored scraper-side
+        kv.kv_set(SERVING_METRICS_ADDR_PREFIX + "ns/svc/r1",
+                  format_addr_value("127.0.0.1:9300", -5.0))
+        found = {t.name: t for t in kv_targets(kv)()}
+        assert found["supervisor/w0"].addr == "127.0.0.1:9100"
+        assert found["supervisor/w0"].labels["role"] == "supervisor"
+        svc = found["serving/ns/svc/r0"]
+        assert svc.addr == "127.0.0.1:9200"
+        assert svc.labels == {"role": "serving", "job": "ns/svc",
+                              "replica": "r0"}
+        assert "serving/ns/svc/r1" not in found
+
+    def test_addr_value_roundtrip(self):
+        addr, expired = parse_addr_value(
+            format_addr_value("h:1", ttl_s=30.0))
+        assert addr == "h:1" and not expired
+        addr, expired = parse_addr_value(format_addr_value("h:1", None))
+        assert addr == "h:1" and not expired
+        assert parse_addr_value(b"garbage")[0] is None
+
+    def test_addr_publisher_refreshes_and_deletes_on_stop(self):
+        from edl_tpu.coord import PyCoordService
+
+        kv = PyCoordService()
+        pub = AddrPublisher(kv, "serving-metrics-addr/j/r", "127.0.0.1:1",
+                            ttl_s=3.0)
+        pub.start()
+        deadline = time.monotonic() + 5.0
+        first = None
+        while time.monotonic() < deadline:
+            v = kv.kv_get("serving-metrics-addr/j/r")
+            if v is not None:
+                first = v
+                break
+            time.sleep(0.01)
+        assert first is not None
+        # refresh: the expiry stamp moves forward
+        _, exp0 = first.decode().split()
+        while time.monotonic() < deadline:
+            v = kv.kv_get("serving-metrics-addr/j/r")
+            if v is not None and v.decode().split()[1] != exp0:
+                break
+            time.sleep(0.05)
+        assert v.decode().split()[1] != exp0, "expiry never refreshed"
+        pub.stop()
+        assert kv.kv_get("serving-metrics-addr/j/r") is None
+
+    def test_serving_metrics_addr_swept_on_job_deletion(self):
+        """The satellite contract: serving-metrics-addr/ rides
+        JOB_KV_PREFIXES, so a deleted job's published addresses leave
+        KV with its curve/cursors/generation."""
+        from edl_tpu.coord import PyCoordService
+        from edl_tpu.coord.gc import JOB_KV_PREFIXES, gc_job_kv
+
+        assert "serving-metrics-addr/" in JOB_KV_PREFIXES
+        kv = PyCoordService()
+        publish_serving_metrics_addr(kv, "ns/doomed", "r0", "h:1")
+        publish_serving_metrics_addr(kv, "ns/doomed2", "r0", "h:2")
+        removed = gc_job_kv(kv, "ns/doomed")
+        assert removed == 1
+        assert kv.kv_get("serving-metrics-addr/ns/doomed/r0") is None
+        # the name-prefix sibling survives (exact-uid scoping)
+        assert kv.kv_get("serving-metrics-addr/ns/doomed2/r0") is not None
+
+    def test_file_targets(self, tmp_path):
+        (tmp_path / "metrics-addr-w3").write_text("127.0.0.1:9999")
+        (tmp_path / "unrelated").write_text("x")
+        found = file_targets(str(tmp_path))()
+        assert len(found) == 1
+        assert found[0].name == "supervisor/w3"
+        assert found[0].addr == "127.0.0.1:9999"
+
+    def test_manifest_targets_from_jobparser_annotations(self):
+        """The controller/collector/coordinator manifests the jobparser
+        emits carry prometheus.io annotations — the scrape plane reads
+        the SAME manifests for its target list."""
+        from edl_tpu.api.types import (
+            ResourceRequirements, TrainerSpec, TrainingJob,
+            TrainingJobSpec,
+        )
+        from edl_tpu.controller.jobparser import parse_to_coordinator
+
+        job = TrainingJob(
+            name="j1", namespace="ns",
+            spec=TrainingJobSpec(
+                fault_tolerant=True,
+                trainer=TrainerSpec(min_instance=1, max_instance=2,
+                                    resources=ResourceRequirements())))
+        m = parse_to_coordinator(job)
+        # a callable like its sibling sources (usable as discover=[...])
+        targets = manifest_targets([m, {"kind": "Service"}],
+                                   host="10.0.0.7")()
+        assert len(targets) == 1
+        t = targets[0]
+        assert t.name == "ns/j1-coordinator"
+        assert t.addr.startswith("10.0.0.7:")
+        assert t.path == "/metrics"
+
+    def test_static_targets(self):
+        ts = static_targets(["a:1", "b:2"], role="x")
+        assert [(t.name, t.addr) for t in ts] == [("a:1", "a:1"),
+                                                  ("b:2", "b:2")]
+        assert ts[0].labels == {"role": "x"}
+
+
+# ---------------------------------------- end-to-end: both backends + wedged
+
+
+def _blackhole_server():
+    """A socket that accepts connections and never answers — the
+    wedged/black-holed target."""
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(8)
+    stop = threading.Event()
+    conns = []
+
+    def run():
+        srv.settimeout(0.2)
+        while not stop.is_set():
+            try:
+                c, _ = srv.accept()
+                conns.append(c)  # hold open, say nothing
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+
+    def close():
+        stop.set()
+        t.join(timeout=2)
+        for c in conns:
+            try:
+                c.close()
+            except OSError:
+                pass
+        srv.close()
+
+    return srv.getsockname()[1], close
+
+
+class TestEndToEndBackends:
+    def test_scrape_both_coordinator_backends_and_blackholed_target(self):
+        """Satellite: one sweep over a NATIVE coordinator's /metrics, a
+        PyCoordService-backed /metrics route, and a black-holed target —
+        coord series land for both backends, the wedge is marked
+        failing/stale with bounded backoff, and the healthy targets'
+        scrape cadence is unaffected in the same sweep."""
+        from edl_tpu.coord import PyCoordService, native_available
+        from edl_tpu.coord.server import spawn_server
+        from edl_tpu.observability.health import serve_health
+
+        if not native_available():
+            pytest.skip("native coord core unavailable")
+        h = spawn_server(health_port=0)
+        py_reg = MetricsRegistry()
+        svc = PyCoordService()
+        svc.join("a")
+        svc.register_metrics(py_reg)
+        py_srv = serve_health(0, {"ok": lambda: True}, host="127.0.0.1",
+                              registry=py_reg)
+        bh_port, bh_close = _blackhole_server()
+        scraper = MetricsScraper(
+            interval_s=0.2, timeout_s=0.5, backoff_base_s=0.2,
+            backoff_max_s=1.0, registry=MetricsRegistry())
+        scraper.add_target(ScrapeTarget(
+            name="coord/native", addr=f"127.0.0.1:{h.health_port}",
+            labels={"role": "coordinator"}))
+        scraper.add_target(ScrapeTarget(
+            name="coord/python",
+            addr=f"127.0.0.1:{py_srv.server_address[1]}",
+            labels={"role": "coordinator"}))
+        scraper.add_target(ScrapeTarget(
+            name="wedged", addr=f"127.0.0.1:{bh_port}"))
+        try:
+            c = h.client()
+            c.join("w0", "a")
+            t0 = time.monotonic()
+            report = scraper.sweep()
+            sweep_s = time.monotonic() - t0
+            # the black hole cost ONE timeout, not one per healthy target
+            assert report["failed"] == 1, report
+            assert report["scraped"] == 2, report
+            assert sweep_s < 3.0, sweep_s
+            # both backends' coord series landed, name-for-name
+            assert scraper.latest("edl_coord_members",
+                                  agg="max") is not None
+            by_target = {t["name"]: t for t in scraper.target_states()}
+            assert by_target["coord/native"]["state"] == "up"
+            assert by_target["coord/python"]["state"] == "up"
+            wedged = by_target["wedged"]
+            assert wedged["consecutive_failures"] == 1
+            assert wedged["state"] in ("stale", "down")
+            # bounded backoff across repeated failures
+            for _ in range(4):
+                time.sleep(0.25)
+                scraper.sweep()
+            st = scraper._state[(f"127.0.0.1:{bh_port}", "/metrics")]
+            assert st.next_due_t - time.monotonic() <= 1.0 + 0.5
+            # healthy targets kept being scraped while the wedge backed off
+            assert by_target["coord/native"]["scrapes"] >= 1
+            fresh = {t["name"]: t for t in scraper.target_states()}
+            assert fresh["coord/native"]["scrapes"] > 1
+            c.close()
+        finally:
+            bh_close()
+            py_srv.shutdown()
+            h.stop()
+
+
+# ---------------------------------------------- FleetView + scrape-fed scaler
+
+
+def _serving_registry(job="ns/svc"):
+    """A registry shaped like a serving replica's /metrics."""
+    reg = MetricsRegistry()
+    from edl_tpu.observability.metrics import SERVING_LATENCY_BUCKETS
+
+    reqs = reg.counter("serving_requests")
+    viol = reg.counter("serving_slo_violations")
+    hist = reg.histogram("serving_request_seconds",
+                         buckets=SERVING_LATENCY_BUCKETS)
+    reg.gauge("serving_fleet_queue_depth").set(0, job=job)
+    reg.gauge("serving_replicas_active").set(1, job=job)
+    reg.gauge("serving_replicas_ready").set(1, job=job)
+    return reg, reqs, viol, hist
+
+
+class TestFleetView:
+    JOB = "ns/svc"
+
+    def _view(self, reg, clock):
+        s = MetricsScraper(fetch=lambda t: reg.render(), clock=clock,
+                           registry=MetricsRegistry())
+        s.add_target(ScrapeTarget(name="r0", addr="r0:0",
+                                  labels={"job": self.JOB}))
+        return s, FleetView(s, window_s=10.0)
+
+    def test_serving_stats_rollup(self):
+        reg, reqs, viol, hist = _serving_registry(self.JOB)
+        clock = FakeClock()
+        s, view = self._view(reg, clock)
+        s.sweep()
+        clock.advance(2.0)
+        for _ in range(100):
+            reqs.inc(job=self.JOB)
+            hist.observe(0.004, job=self.JOB)
+        reg.gauge("serving_fleet_queue_depth").set(7, job=self.JOB)
+        s.sweep()
+        st = view.stats_for(self.JOB)
+        assert st.requests_windowed == 100
+        assert abs(st.qps - 50.0) < 1.0  # 100 over the 2 s span
+        assert 2.5 <= st.p99_ms <= 5.0   # bucket-resolution estimate
+        assert st.queue_depth == 7
+        assert st.replicas_active == 1 and st.replicas_ready == 1
+        assert view.jobs() == [self.JOB]
+
+    def test_scrape_fed_scaler_matches_hook_fed_policy(self):
+        """The acceptance parity: the SAME decisions the hook-fed policy
+        tests pin (tests/test_serving.py::test_policy_*), produced from
+        scraped metrics through FleetView.stats_for."""
+        from edl_tpu.api.types import ServingJob, ServingSpec
+        from edl_tpu.scheduler.autoscaler import ServingScaler
+
+        # p99 breach at 2 active replicas → grow to 3 (the pinned case:
+        # decide(_stats(p99=80, active=2), 2) == 3 with slo=50)
+        reg, reqs, viol, hist = _serving_registry(self.JOB)
+        reg.gauge("serving_replicas_active").set(2, job=self.JOB)
+        reg.gauge("serving_replicas_ready").set(2, job=self.JOB)
+        clock = FakeClock()
+        s, view = self._view(reg, clock)
+        s.sweep()
+        clock.advance(1.0)
+        for _ in range(50):
+            reqs.inc(job=self.JOB)
+            hist.observe(0.08, job=self.JOB)  # ~80 ms — over the SLO
+        s.sweep()
+        sc = ServingScaler().feed_from(view)
+        job = ServingJob(name="svc", namespace="ns", spec=ServingSpec(
+            min_replicas=1, max_replicas=8, slo_p99_ms=50.0))
+        stats = sc.stats_for(self.JOB)
+        assert stats.p99_ms > 50.0
+        assert sc.decide(job, stats, 2) == 3
+
+        # qps above the per-replica target → ceil(qps/target) (pinned:
+        # decide(_stats(qps=100, active=2), 2) == 4 with target 30)
+        reg2, reqs2, _, hist2 = _serving_registry(self.JOB)
+        reg2.gauge("serving_replicas_active").set(2, job=self.JOB)
+        clock2 = FakeClock()
+        s2, view2 = self._view(reg2, clock2)
+        s2.sweep()
+        clock2.advance(2.0)
+        for _ in range(200):  # 200 req over 2 s → 100 qps
+            reqs2.inc(job=self.JOB)
+            hist2.observe(0.001, job=self.JOB)
+        s2.sweep()
+        job_qps = ServingJob(name="svc", namespace="ns", spec=ServingSpec(
+            min_replicas=1, max_replicas=8, slo_p99_ms=0.0,
+            target_qps_per_replica=30.0))
+        sc2 = ServingScaler().feed_from(view2)
+        st2 = sc2.stats_for(self.JOB)
+        assert abs(st2.qps - 100.0) < 5.0
+        assert sc2.decide(job_qps, st2, 2) == 4
+
+        # inside the SLO with a queue: hold (pinned: decide(None))
+        assert sc.decide(job, type(stats)(
+            p50_ms=10, p99_ms=30, qps=10, queue_depth=1,
+            replicas_ready=2, replicas_active=2,
+            requests_windowed=20), 2) is None
+
+    def test_live_fleet_scrape_parity_with_fleetstats(self):
+        """End-to-end over a REAL in-process fleet: serve /metrics, run
+        traffic, scrape it, and hold FleetView's qps/p99 against the
+        fleet's own FleetStats within tolerance (p99 is bucket-resolution
+        — assert the same order, not equality)."""
+        jax = pytest.importorskip("jax")
+        import numpy as np
+
+        from edl_tpu.models import mlp
+        from edl_tpu.runtime.serving import PoissonTraffic, ServingFleet
+
+        job = "t/scrape-parity"
+        params = mlp.init(jax.random.key(0), [8, 16, 4])
+        fleet = ServingFleet(
+            lambda p, b: mlp.apply(p, b[0]), params,
+            example_row=(np.zeros((8,), np.float32),), job=job,
+            max_batch_size=4, max_queue_ms=1.0, slo_p99_ms=500.0)
+        srv = None
+        try:
+            fleet.scale_to(1)
+            srv = fleet.serve_metrics(0, host="127.0.0.1", publish=False)
+            port = srv.server_address[1]
+            scraper = MetricsScraper(interval_s=0.1, timeout_s=2.0,
+                                     registry=MetricsRegistry())
+            scraper.add_target(ScrapeTarget(
+                name="replica", addr=f"127.0.0.1:{port}",
+                labels={"job": job}))
+            view = FleetView(scraper, window_s=2.5)
+            traffic = PoissonTraffic(
+                fleet, lambda i: (np.full((8,), i % 5, np.float32),),
+                qps=120, seed=7)
+            # sweep continuously WHILE traffic flows, then measure both
+            # sides over the same window at the same instant — the
+            # apples-to-apples moment
+            halt = threading.Event()
+
+            def sweeper():
+                while not halt.wait(0.25):
+                    scraper.sweep()
+
+            sw = threading.Thread(target=sweeper, daemon=True)
+            scraper.sweep()
+            sw.start()
+            traffic.run(3.0)
+            scraper.sweep()
+            st = view.stats_for(job)
+            own = fleet.stats(window_s=2.5)
+            halt.set()
+            sw.join(timeout=5)
+            tally = traffic.await_all(timeout_s=30.0)
+            assert tally["dropped"] == 0 and tally["errors"] == 0
+            assert st.requests_windowed > 0
+            assert st.replicas_active == 1
+            # qps parity: both sides within 40% of each other (open-loop
+            # jitter + window edges), and both in the offered-load range
+            assert own.qps > 0
+            assert 0.6 * own.qps <= st.qps <= 1.4 * own.qps, (st, own)
+            assert 60.0 <= st.qps <= 200.0, st
+            # p99 parity within bucket resolution: same order of magnitude
+            assert st.p99_ms > 0
+            assert st.p99_ms <= max(own.p99_ms * 4.0, 5.0), (st, own)
+            assert own.p99_ms <= max(st.p99_ms * 4.0, 5.0), (st, own)
+        finally:
+            fleet.stop()
+
+    def test_dashboard_renders(self):
+        reg, reqs, viol, hist = _serving_registry(self.JOB)
+        reg.gauge("goodput_fraction").set(0.87, job="t/train")
+        clock = FakeClock()
+        s, view = self._view(reg, clock)
+        s.sweep()
+        clock.advance(1.0)
+        reqs.inc(10, job=self.JOB)
+        s.sweep()
+        engine = AlertEngine(view, rules=[TargetDownRule()])
+        engine.evaluate()
+        out = render_fleet_dashboard(view, engine)
+        assert "FLEET" in out and self.JOB in out
+        assert "TARGETS" in out and "r0" in out
+        assert "ALERTS" in out
+        assert "t/train" in out  # non-serving goodput section
+
+
+# ------------------------------------------------------------------ alerting
+
+
+class TestAlertEngine:
+    JOB = "ns/svc"
+
+    def _armed(self, reg, clock, rules, **engine_kw):
+        s = MetricsScraper(fetch=lambda t: reg.render(), clock=clock,
+                           registry=MetricsRegistry())
+        s.add_target(ScrapeTarget(name="r0", addr="r0:0"))
+        view = FleetView(s, window_s=10.0)
+        engine = AlertEngine(view, rules=rules,
+                             registry=MetricsRegistry(), **engine_kw)
+        return s, view, engine
+
+    def test_fast_burn_fires_within_two_windows_and_resolves(self):
+        reg, reqs, viol, hist = _serving_registry(self.JOB)
+        clock = FakeClock()
+        rule = BurnRateRule(budget_fraction=0.001, fast_window_s=5.0,
+                            slow_window_s=60.0, fast_factor=10.0,
+                            min_requests=10)
+        s, view, engine = self._armed(reg, clock, [rule])
+        s.sweep()
+        assert engine.evaluate() == []  # no data, nothing fires
+        # the breach: half the requests violate (burn = 500x budget)
+        clock.advance(1.0)
+        reqs.inc(100, job=self.JOB)
+        viol.inc(50, job=self.JOB)
+        s.sweep()
+        firing = engine.evaluate()  # within 2 evaluation windows
+        rules = {a.rule for a in firing}
+        assert "slo_fast_burn" in rules, firing
+        fast = next(a for a in firing if a.rule == "slo_fast_burn")
+        assert fast.labels == {"job": self.JOB}
+        assert fast.value > 10.0
+        assert engine._gauge.value(rule="slo_fast_burn") == 1
+        from edl_tpu.observability.collector import get_counters
+
+        assert get_counters().get("alerts_fired",
+                                  rule="slo_fast_burn") >= 1
+        # recovery: violations stop, the window ages the breach out
+        clock.advance(20.0)
+        reqs.inc(100, job=self.JOB)
+        s.sweep()
+        assert "slo_fast_burn" not in {a.rule for a in engine.evaluate()}
+        assert engine._gauge.value(rule="slo_fast_burn") == 0
+
+    def test_goodput_collapse_and_conservation_rules(self):
+        reg = MetricsRegistry()
+        reg.gauge("goodput_fraction").set(0.2, job="t/j")
+        reg.gauge("goodput_conservation_error_pct").set(4.2, job="t/j")
+        clock = FakeClock()
+        s, view, engine = self._armed(
+            reg, clock, [GoodputCollapseRule(min_fraction=0.5),
+                         ConservationRule(max_error_pct=1.0)])
+        s.sweep()
+        rules = {a.rule: a for a in engine.evaluate()}
+        assert rules["goodput_collapse"].labels == {"job": "t/j"}
+        assert rules["conservation_violation"].value == 4.2
+        # recovery resolves both
+        reg.gauge("goodput_fraction").set(0.9, job="t/j")
+        reg.gauge("goodput_conservation_error_pct").set(0.1, job="t/j")
+        clock.advance(1.0)
+        s.sweep()
+        assert engine.evaluate() == []
+
+    def test_target_down_rule(self):
+        reg = MetricsRegistry()
+
+        def boom():
+            raise OSError("refused")
+
+        clock = FakeClock()
+        s = MetricsScraper(fetch=lambda t: boom(), clock=clock,
+                           registry=MetricsRegistry(),
+                           backoff_base_s=0.1, backoff_max_s=0.1)
+        s.add_target(ScrapeTarget(name="dead", addr="dead:0"))
+        view = FleetView(s)
+        engine = AlertEngine(view, rules=[TargetDownRule(
+            down_after_failures=2)], registry=MetricsRegistry())
+        s.sweep()
+        assert engine.evaluate() == []  # one failure: not yet
+        for _ in range(3):
+            clock.advance(0.2)
+            s.sweep()
+        firing = engine.evaluate()
+        assert [a.rule for a in firing] == ["scrape_target_down"]
+        assert firing[0].labels == {"target": "dead"}
+
+    def test_alert_fires_flight_record_through_shared_lock(self, tmp_path):
+        reg = MetricsRegistry()
+        reg.gauge("goodput_fraction").set(0.1, job="t/j")
+        clock = FakeClock()
+        s, view, engine = self._armed(
+            reg, clock, [GoodputCollapseRule(min_fraction=0.5)],
+            flight_dir=str(tmp_path), dump_cooldown_s=60.0)
+        s.sweep()
+        engine.evaluate()
+        recs = [f for f in os.listdir(tmp_path)
+                if f.startswith("flightrec-")]
+        assert len(recs) == 1
+        doc = json.loads((tmp_path / recs[0]).read_text())
+        assert doc["reason"] == "alert-goodput_collapse"
+        assert doc["extra"]["labels"] == {"job": "t/j"}
+
+    def test_rule_exception_does_not_stop_other_rules(self):
+        class Broken(AlertRule):
+            def evaluate(self, view):
+                raise RuntimeError("boom")
+
+        class Always(AlertRule):
+            def evaluate(self, view):
+                return [Alert(rule="always", labels={}, firing=True)]
+
+        reg = MetricsRegistry()
+        clock = FakeClock()
+        s, view, engine = self._armed(reg, clock, [Broken(), Always()])
+        assert [a.rule for a in engine.evaluate()] == ["always"]
+
+
+# ----------------------------------- flight-record dump lock + cooldown dedupe
+
+
+class TestFlightDumpSerialization:
+    def test_same_reason_deduped_within_cooldown(self, tmp_path):
+        from edl_tpu.observability.collector import get_counters
+        from edl_tpu.observability.metrics import dump_flight_record
+
+        p1 = dump_flight_record(str(tmp_path), "stall-x", cooldown_s=60.0)
+        before = get_counters().get("flight_dumps_deduped",
+                                    reason="stall-x")
+        p2 = dump_flight_record(str(tmp_path), "stall-x", cooldown_s=60.0)
+        assert p2 == p1  # the deduped call returns the existing record
+        assert get_counters().get("flight_dumps_deduped",
+                                  reason="stall-x") == before + 1
+        recs = [f for f in os.listdir(tmp_path)
+                if f.startswith("flightrec-")]
+        assert len(recs) == 1
+        # a DIFFERENT reason inside the window still dumps: a stall and
+        # an alert for the same incident are both evidence
+        p3 = dump_flight_record(str(tmp_path), "alert-y", cooldown_s=60.0)
+        assert p3 != p1
+        assert len([f for f in os.listdir(tmp_path)
+                    if f.startswith("flightrec-")]) == 2
+
+    def test_cooldown_zero_keeps_legacy_always_dump(self, tmp_path):
+        from edl_tpu.observability.metrics import dump_flight_record
+
+        a = dump_flight_record(str(tmp_path), "r")
+        b = dump_flight_record(str(tmp_path), "r")
+        assert a != b
+
+    def test_concurrent_watchdog_and_alert_dumps_serialized(self, tmp_path):
+        """The regression the satellite names: a watchdog breach and an
+        alert firing dump concurrently in one process — every record
+        must be complete valid JSON (no interleaved prune/rename
+        damage), and same-reason storms inside the cooldown collapse."""
+        from edl_tpu.observability.metrics import dump_flight_record
+
+        errors = []
+        barrier = threading.Barrier(8)
+
+        def dump(reason):
+            try:
+                barrier.wait(timeout=10)
+                for _ in range(5):
+                    dump_flight_record(str(tmp_path), reason,
+                                       cooldown_s=60.0)
+            except Exception as exc:  # pragma: no cover
+                errors.append(exc)
+
+        threads = ([threading.Thread(target=dump, args=("stall-wd",))
+                    for _ in range(4)]
+                   + [threading.Thread(target=dump, args=("alert-burn",))
+                      for _ in range(4)])
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert not errors
+        recs = sorted(f for f in os.listdir(tmp_path)
+                      if f.startswith("flightrec-"))
+        # 40 calls, 2 distinct reasons, one cooldown window → exactly 2
+        assert len(recs) == 2, recs
+        for f in recs:
+            doc = json.loads((tmp_path / f).read_text())  # not torn
+            assert doc["reason"] in ("stall-wd", "alert-burn")
+        assert not [f for f in os.listdir(tmp_path)
+                    if f.startswith(".flightrec-")]  # no leaked temps
+
+
+# ------------------------------------------------- request spans (serving)
+
+
+class TestRequestSpans:
+    def test_traced_request_emits_span_tree_and_span_histograms(self):
+        jax = pytest.importorskip("jax")
+        import numpy as np
+
+        from edl_tpu.models import mlp
+        from edl_tpu.observability.metrics import get_registry
+        from edl_tpu.observability.tracing import get_tracer
+        from edl_tpu.runtime.serving import ServingFleet
+
+        params = mlp.init(jax.random.key(0), [8, 16, 4])
+        fleet = ServingFleet(
+            lambda p, b: mlp.apply(p, b[0]), params,
+            example_row=(np.zeros((8,), np.float32),), job="t/spans",
+            max_batch_size=4, max_queue_ms=0.5, slo_p99_ms=1000.0)
+        try:
+            fleet.scale_to(1)
+            get_tracer().clear()
+            req = fleet.submit((np.ones((8,), np.float32),),
+                               trace_id="feedbeef00000001")
+            req.wait(10.0)
+        finally:
+            fleet.stop()
+        evs = [e for e in get_tracer().events()
+               if e.trace_id == "feedbeef00000001"]
+        by_name = {e.name: e for e in evs}
+        root = by_name["serving_request"]
+        assert root.args["latency_ms"] > 0
+        phases = {"admit", "queue", "batch", "forward", "respond"}
+        for ph in phases:
+            child = by_name[f"serving_request.{ph}"]
+            assert child.parent_id == root.span_id
+            assert child.trace_id == root.trace_id
+        # phase ordering is physical: queue ends where batch begins
+        q = by_name["serving_request.queue"]
+        f = by_name["serving_request.forward"]
+        assert q.start_s <= f.start_s
+        # span histograms carry every phase
+        from edl_tpu.observability.metrics import parse_exposition
+
+        series = parse_exposition(get_registry().render())
+        for ph in phases:
+            key = f'edl_serving_span_seconds_count{{phase="{ph}"}}'
+            assert series[key] >= 1, key
+        # exemplar ring recorded the traced request with its phase split
+        ex = [e for e in fleet.exemplars
+              if e["trace_id"] == "feedbeef00000001"]
+        assert ex and ex[0]["forward_ms"] >= 0
+
+    def test_untraced_fast_request_emits_no_spans(self):
+        jax = pytest.importorskip("jax")
+        import numpy as np
+
+        from edl_tpu.models import mlp
+        from edl_tpu.observability.tracing import get_tracer
+        from edl_tpu.runtime.serving import ServingFleet
+
+        params = mlp.init(jax.random.key(0), [8, 16, 4])
+        fleet = ServingFleet(
+            lambda p, b: mlp.apply(p, b[0]), params,
+            example_row=(np.zeros((8,), np.float32),), job="t/quiet",
+            max_batch_size=4, max_queue_ms=0.5, slo_p99_ms=60000.0)
+        try:
+            fleet.scale_to(1)
+            get_tracer().clear()
+            fleet.submit((np.ones((8,), np.float32),)).wait(10.0)
+        finally:
+            fleet.stop()
+        assert not [e for e in get_tracer().events()
+                    if e.name.startswith("serving_request")]
