@@ -1260,6 +1260,322 @@ def coord_ha_leg(cycles: int = 5) -> dict:
     }
 
 
+def coord_scale_leg(sizes=(1000, 5000)) -> dict:
+    """Control-plane scale (ROADMAP #2; doc/coordinator_scale.md): drive
+    1k/5k simulated members — lightweight client threads, no jax —
+    through FORMATION (concurrent joins over one multiplexed connection
+    per simulated supervisor host), STEADY STATE (coalesced KEEPALIVE
+    heartbeat batches), a KV MUTATION window (replication bytes must be
+    O(delta), not O(store) — diffed against the server-reported snapshot
+    size, which is exactly what the pre-PR full-snapshot stream shipped
+    per mutation), a version-gated FOLLOWER READ, and a CRASH REFORM
+    (primary SIGKILL → mux failover + promotion → every member slot
+    re-confirmed).  A BASELINE scenario replays the pre-PR shape — one
+    socket per member slot, one HB line per slot per beat, per-member
+    probe/promote/rejoin on reform — at the smallest size, so
+    requests-per-reform and requests-per-beat reductions are measured,
+    not asserted.  Headline: formation p50/p99, reform latency, primary
+    CPU-seconds, requests-per-reform ratio, repl bytes per mutation vs
+    the snapshot baseline.  EDL_BENCH_COORD_10K=1 adds a 10k row."""
+    import resource
+    import signal
+    import socket as _socket
+    import statistics
+    import tempfile
+    import threading
+
+    from edl_tpu.coord.client import CoordClient, CoordMux
+    from edl_tpu.coord.server import spawn_server
+    from edl_tpu.runtime.discovery import BatchKeepalive
+
+    # one fd per baseline member + overhead: raise the soft limit
+    try:
+        soft, hard = resource.getrlimit(resource.RLIMIT_NOFILE)
+        resource.setrlimit(resource.RLIMIT_NOFILE,
+                           (min(hard, 65536), hard))
+    except (ValueError, OSError):
+        pass
+    if os.environ.get("EDL_BENCH_COORD_10K") == "1":
+        sizes = tuple(sizes) + (10_000,)
+    # state files on tmpfs when available: the leg measures control-plane
+    # speed, and a rotational-disk fsync per mutation would measure the
+    # disk instead (durability mechanics are coord_ha's job)
+    state_root = "/dev/shm" if os.path.isdir("/dev/shm") else None
+    SLOTS_PER_HOST = 200
+    CLK = os.sysconf("SC_CLK_TCK")
+
+    def cpu_s(pid: int) -> float:
+        with open(f"/proc/{pid}/stat") as f:
+            parts = f.read().rsplit(")", 1)[1].split()
+        return (int(parts[11]) + int(parts[12])) / CLK
+
+    def metrics(port: int) -> dict:
+        with _socket.create_connection(("127.0.0.1", port), timeout=5) as s:
+            s.settimeout(5)
+            s.sendall(b"METRICS\n")
+            r = s.makefile("rb").readline().decode().strip().split(" ")
+        keys = ("requests", "parked", "fired", "repl_bytes",
+                "repl_deltas", "repl_ckpts", "snapshot_bytes",
+                "follower_reads")
+        return {k: int(r[i + 1]) for i, k in enumerate(keys)
+                if len(r) > i + 1}
+
+    def spawn_pair(tag: str):
+        tmp = tempfile.mkdtemp(prefix=f"edl-coordscale-{tag}-",
+                               dir=state_root)
+        sb = spawn_server(standby=True,
+                          state_file=os.path.join(tmp, "b.state"))
+        pr = spawn_server(state_file=os.path.join(tmp, "a.state"),
+                          replicate_to=f"127.0.0.1:{sb.port}",
+                          repl_lease_ms=1000)
+        return pr, sb
+
+    def mux_scenario(n: int) -> dict:
+        pr, sb = spawn_pair(f"mux{n}")
+        hosts = max(1, (n + SLOTS_PER_HOST - 1) // SLOTS_PER_HOST)
+        muxes, keepalives, join_ms = [], [], []
+        jm_lock = threading.Lock()
+        try:
+            for _ in range(hosts):
+                muxes.append(CoordMux(
+                    "127.0.0.1", pr.port, timeout=5.0,
+                    reconnect_window_s=30.0, promote_grace_s=0.3,
+                    endpoints=[("127.0.0.1", sb.port)]))
+            cpu0 = cpu_s(pr.process.pid)
+
+            # -- formation: all hosts join their slots concurrently ----
+            def form(h: int) -> None:
+                c = muxes[h].client()
+                ka = BatchKeepalive(c, interval_s=1.0)
+                local = []
+                for i in range(h * SLOTS_PER_HOST,
+                               min((h + 1) * SLOTS_PER_HOST, n)):
+                    t0 = time.perf_counter()
+                    c.join(f"m{i}", f"10.0.{i >> 8}.{i & 255}")
+                    local.append((time.perf_counter() - t0) * 1000)
+                    ka.add(f"m{i}", f"10.0.{i >> 8}.{i & 255}")
+                keepalives.append(ka)
+                with jm_lock:
+                    join_ms.extend(local)
+
+            t_form = time.monotonic()
+            threads = [threading.Thread(target=form, args=(h,))
+                       for h in range(hosts)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            formation_s = time.monotonic() - t_form
+            assert muxes[0].client().epoch() == n
+
+            # -- steady state: coalesced heartbeat sweeps --------------
+            m0 = metrics(pr.port)
+            for ka in keepalives:
+                assert ka.beat_once() == len(ka._names)
+            m1 = metrics(pr.port)
+            hb_requests_per_beat = m1["requests"] - m0["requests"] - 1
+
+            # -- KV mutation window: bytes must be O(delta) ------------
+            c0 = muxes[0].client()
+            M = 50
+            for i in range(M):
+                c0.kv_set(f"bench/key-{i % 8}", b"x" * 64)
+            m2 = metrics(pr.port)
+            bytes_per_mut = (m2["repl_bytes"] - m1["repl_bytes"]) / M
+            snapshot_bytes = m2["snapshot_bytes"]
+
+            # -- version-gated follower read ---------------------------
+            cf = CoordClient("127.0.0.1", pr.port, timeout=5.0,
+                             endpoints=[("127.0.0.1", sb.port)],
+                             follower_reads=True)
+            assert cf.kv_get("bench/key-0") == b"x" * 64
+            follower_reads = metrics(sb.port).get("follower_reads", 0)
+            cf.close()
+            cpu_formation = cpu_s(pr.process.pid) - cpu0
+
+            # -- crash reform ------------------------------------------
+            r0 = metrics(sb.port)["requests"]
+            pr.process.send_signal(signal.SIGKILL)
+            pr.process.wait(timeout=10)
+            t_kill = time.monotonic()
+
+            def recover(h: int) -> None:
+                c = muxes[h].client()
+                # first op drives the mux failover (+ promotion race)
+                c.kv_get("bench/key-0")
+                deadline = time.monotonic() + 60
+                while time.monotonic() < deadline:
+                    if keepalives[h].beat_once() == \
+                            len(keepalives[h]._names):
+                        return
+                    time.sleep(0.05)
+                raise TimeoutError(f"host {h} never recovered")
+
+            threads = [threading.Thread(target=recover, args=(h,))
+                       for h in range(hosts)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            reform_s = time.monotonic() - t_kill
+            requests_per_reform = metrics(sb.port)["requests"] - r0 - 1
+            assert muxes[0].client().epoch() == n  # nobody rejoined
+            return {
+                "members": n, "hosts": hosts,
+                "formation_s": round(formation_s, 2),
+                "formation_ms_p50": round(
+                    statistics.median(join_ms), 3),
+                "formation_ms_p99": round(
+                    statistics.quantiles(join_ms, n=100)[98], 3),
+                "hb_requests_per_beat": hb_requests_per_beat,
+                "reform_s": round(reform_s, 2),
+                "requests_per_reform": requests_per_reform,
+                "repl_bytes_per_mutation": round(bytes_per_mut, 1),
+                "snapshot_bytes": snapshot_bytes,
+                "repl_bytes_reduction_x": round(
+                    snapshot_bytes / max(bytes_per_mut, 1.0), 1),
+                "follower_reads_served": follower_reads,
+                "primary_cpu_s_formation": round(cpu_formation, 2),
+            }
+        finally:
+            for ka in keepalives:
+                ka._stop.set()
+            for m in muxes:
+                m.close()
+            pr.stop()
+            sb.stop()
+
+    def baseline_scenario(n: int) -> dict:
+        """The pre-PR shape: one persistent socket per member slot, one
+        HB line per slot per beat, per-member probe/promote/rejoin on a
+        reform — what every supervisor did before multiplexing."""
+        pr, sb = spawn_pair(f"base{n}")
+        socks: list = [None] * n
+        join_ms = [0.0] * n
+
+        def raw(sock, line: str) -> str:
+            sock[0].sendall((line + "\n").encode())
+            return sock[1].readline().decode().strip()
+
+        def dial(port: int):
+            s = _socket.create_connection(("127.0.0.1", port), timeout=5)
+            s.settimeout(5)
+            return [s, s.makefile("rb")]
+
+        try:
+            def form(lo: int, hi: int) -> None:
+                for i in range(lo, hi):
+                    socks[i] = dial(pr.port)
+                    t0 = time.perf_counter()
+                    raw(socks[i], f"JOIN m{i} 10.0.{i >> 8}.{i & 255}")
+                    join_ms[i] = (time.perf_counter() - t0) * 1000
+
+            t_form = time.monotonic()
+            workers = 32
+            chunk = (n + workers - 1) // workers
+            threads = [threading.Thread(
+                target=form, args=(lo, min(lo + chunk, n)))
+                for lo in range(0, n, chunk)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            formation_s = time.monotonic() - t_form
+
+            # one heartbeat sweep = one request per member
+            m0 = metrics(pr.port)["requests"]
+            for i in range(n):
+                raw(socks[i], f"HB m{i}")
+            hb_requests_per_beat = metrics(pr.port)["requests"] - m0 - 1
+
+            # crash reform: every member independently probes both
+            # endpoints, promotes (server-side ratchet dedupes), redials
+            # and re-heartbeats — the pre-PR client herd
+            r0 = metrics(sb.port)["requests"]
+            pr.process.send_signal(signal.SIGKILL)
+            pr.process.wait(timeout=10)
+            t_kill = time.monotonic()
+
+            def recover(lo: int, hi: int) -> None:
+                for i in range(lo, hi):
+                    try:
+                        socks[i][0].close()
+                    except OSError:
+                        pass
+                    probe = dial(sb.port)
+                    role = raw(probe, "ROLE")
+                    if " primary " not in role:
+                        raw(probe, "PROMOTE 1")
+                    probe[0].close()
+                    socks[i] = dial(sb.port)
+                    if raw(socks[i], f"HB m{i}").startswith("ERR"):
+                        raw(socks[i],
+                            f"JOIN m{i} 10.0.{i >> 8}.{i & 255}")
+
+            threads = [threading.Thread(
+                target=recover, args=(lo, min(lo + chunk, n)))
+                for lo in range(0, n, chunk)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            reform_s = time.monotonic() - t_kill
+            requests_per_reform = metrics(sb.port)["requests"] - r0 - 1
+            return {
+                "members": n,
+                "formation_s": round(formation_s, 2),
+                "formation_ms_p50": round(
+                    statistics.median(join_ms), 3),
+                "formation_ms_p99": round(
+                    statistics.quantiles(join_ms, n=100)[98], 3),
+                "hb_requests_per_beat": hb_requests_per_beat,
+                "reform_s": round(reform_s, 2),
+                "requests_per_reform": requests_per_reform,
+            }
+        finally:
+            for sk in socks:
+                if sk is not None:
+                    try:
+                        sk[0].close()
+                    except OSError:
+                        pass
+            pr.stop()
+            sb.stop()
+
+    rows = {n: mux_scenario(n) for n in sizes}
+    base = baseline_scenario(min(sizes))
+    head = rows[min(sizes)]
+    out = {
+        "sizes": list(sizes),
+        "scale": rows,
+        "baseline_1socket_per_member": base,
+        # the acceptance ratios, measured at the shared size
+        "requests_per_reform_reduction_x": round(
+            base["requests_per_reform"]
+            / max(head["requests_per_reform"], 1), 1),
+        "hb_requests_per_beat_reduction_x": round(
+            base["hb_requests_per_beat"]
+            / max(head["hb_requests_per_beat"], 1), 1),
+        "repl_bytes_reduction_x": head["repl_bytes_reduction_x"],
+        "repl_bytes_per_mutation": head["repl_bytes_per_mutation"],
+    }
+    big = rows[max(sizes)]
+    out.update({
+        "members_max": big["members"],
+        "formation_ms_p50": big["formation_ms_p50"],
+        "formation_ms_p99": big["formation_ms_p99"],
+        "formation_s_at_max": big["formation_s"],
+        "reform_s_at_max": big["reform_s"],
+        "primary_cpu_s_formation_at_max":
+            big["primary_cpu_s_formation"],
+        "requests_per_reform_at_max": big["requests_per_reform"],
+    })
+    # in-leg acceptance: the reductions the tentpole exists for
+    assert out["requests_per_reform_reduction_x"] >= 5.0, out
+    assert out["repl_bytes_reduction_x"] >= 10.0, out
+    return out
+
+
 def serving_leg() -> dict:
     """Elastic inference serving under SLO, SCRAPE-FED (ROADMAP #4;
     doc/serving.md + doc/observability.md §scrape-plane): a
@@ -2162,6 +2478,14 @@ def main() -> None:
                         extra_env={"JAX_PLATFORMS": "cpu",
                                    "PALLAS_AXON_POOL_IPS": ""})
 
+    # coordinator scale-out: 1k/5k simulated members through formation,
+    # coalesced heartbeats, delta-replicated mutations and a crash
+    # reform, vs the pre-PR one-socket-per-member baseline (control
+    # plane only, no accelerator)
+    coord_scale = _run_leg("coord_scale", timeout_s=420,
+                           extra_env={"JAX_PLATFORMS": "cpu",
+                                      "PALLAS_AXON_POOL_IPS": ""})
+
     # goodput ledger + scaling curve through a resize+fault schedule
     # (CPU mesh — it is an attribution/accounting number, not throughput)
     goodput_r = _run_leg(
@@ -2222,7 +2546,8 @@ def main() -> None:
                    "large": large, "long_context": long_ctx,
                    "model_zoo": zoo, "elastic": elastic,
                    "reparallel": reparallel, "reform": reform,
-                   "coord_ha": coord_ha, "goodput": goodput_r,
+                   "coord_ha": coord_ha, "coord_scale": coord_scale,
+                   "goodput": goodput_r,
                    "determinism": determinism, "serving": serving,
                    "tpu_world_cycle": tpu_cycle},
     }
@@ -2262,6 +2587,29 @@ def main() -> None:
         "coord_ha_failover_ms_p50": coord_ha.get("failover_ms_p50"),
         "coord_ha_failover_ms_max": coord_ha.get("failover_ms_max"),
         "coord_ha_fence_after": coord_ha.get("fence_after"),
+        # coordinator scale-out (ROADMAP #2): the 10k-worker control
+        # plane — formation/reform latency at the largest simulated
+        # member count, primary CPU, and the two tentpole reductions
+        # (requests-per-reform via mux+KEEPALIVE, replication
+        # bytes-per-mutation via log-structured deltas) measured against
+        # the pre-PR one-socket-per-member / full-snapshot baseline
+        "coord_scale_members": coord_scale.get("members_max"),
+        "coord_scale_formation_ms_p50":
+            coord_scale.get("formation_ms_p50"),
+        "coord_scale_formation_ms_p99":
+            coord_scale.get("formation_ms_p99"),
+        "coord_scale_formation_s": coord_scale.get("formation_s_at_max"),
+        "coord_scale_reform_s": coord_scale.get("reform_s_at_max"),
+        "coord_scale_primary_cpu_s":
+            coord_scale.get("primary_cpu_s_formation_at_max"),
+        "coord_scale_requests_per_reform_reduction_x":
+            coord_scale.get("requests_per_reform_reduction_x"),
+        "coord_scale_hb_requests_reduction_x":
+            coord_scale.get("hb_requests_per_beat_reduction_x"),
+        "coord_scale_repl_bytes_per_mutation":
+            coord_scale.get("repl_bytes_per_mutation"),
+        "coord_scale_repl_bytes_reduction_x":
+            coord_scale.get("repl_bytes_reduction_x"),
         # goodput: the chip-second attribution a scheduler can allocate
         # by — fraction + where the lost time went, conservation-checked
         "goodput_fraction": goodput_r.get("goodput_fraction"),
@@ -2372,6 +2720,8 @@ if __name__ == "__main__":
             out = elastic_leg()
         elif leg == "coord_ha":
             out = coord_ha_leg()
+        elif leg == "coord_scale":
+            out = coord_scale_leg()
         elif leg == "goodput":
             out = goodput_leg()
         elif leg == "serving":

@@ -17,13 +17,19 @@ the canonical signatures).
 from edl_tpu.coord.service import (
     DEFAULT_MEMBER_TTL_MS,
     DEFAULT_TASK_TIMEOUT_MS,
+    CoordBehind,
     CoordFenced,
     LeaseStatus,
     PyCoordService,
     QueueStats,
 )
 from edl_tpu.coord.bindings import NativeCoordService, native_available
-from edl_tpu.coord.client import CoordClient, CoordUnavailable
+from edl_tpu.coord.client import (
+    CoordClient,
+    CoordMux,
+    CoordUnavailable,
+    MuxCoordClient,
+)
 from edl_tpu.coord.server import spawn_ha_pair, spawn_server
 
 
@@ -38,9 +44,12 @@ def local_service(task_timeout_ms: int = DEFAULT_TASK_TIMEOUT_MS,
 
 
 __all__ = [
+    "CoordBehind",
     "CoordClient",
     "CoordFenced",
+    "CoordMux",
     "CoordUnavailable",
+    "MuxCoordClient",
     "DEFAULT_MEMBER_TTL_MS",
     "DEFAULT_TASK_TIMEOUT_MS",
     "LeaseStatus",

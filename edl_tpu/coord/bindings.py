@@ -93,6 +93,7 @@ def _load() -> Optional[ctypes.CDLL]:
         "edl_svc_snapshot_repl": ([vp, i64, cp, i64], i64),
         "edl_svc_restore": ([vp, cp, i64], i32),
         "edl_svc_restore_repl": ([vp, cp, i64, i64], i32),
+        "edl_svc_apply_delta": ([vp, cp, i64, i64], i64),
         "edl_svc_fence": ([vp], i64),
         "edl_svc_stream_version": ([vp], i64),
     }
@@ -324,6 +325,23 @@ class NativeCoordService:
         data = blob.encode()
         return bool(self._lib.edl_svc_restore_repl(self._h, data, len(data),
                                                    self._clock()))
+
+    def apply_delta(self, blob: str) -> int:
+        """Apply a framed EDLDELTA1 op-log blob (the log-structured
+        replication stream — doc/coordinator_scale.md).  Returns the new
+        stream position; raises ValueError on a torn/unreplayable blob
+        (position NOT ratcheted for a torn one) and a position-mismatch
+        ValueError("behind") when the blob's ``from`` is not this
+        mirror's position (the caller falls back to a checkpoint)."""
+        data = blob.encode()
+        rc = self._lib.edl_svc_apply_delta(self._h, data, len(data),
+                                           self._clock())
+        if rc == -2:
+            raise ValueError("behind: delta does not start at this "
+                             "mirror's position")
+        if rc < 0:
+            raise ValueError("torn or unreplayable delta blob rejected")
+        return rc
 
     def fence(self) -> int:
         return self._lib.edl_svc_fence(self._h)

@@ -205,6 +205,18 @@ int edl_svc_restore_repl(void* h, const char* blob, int64_t len,
              : 0;
 }
 
+// Delta-log apply (log-structured replication): validates framing +
+// position contiguity, applies the records, re-anchors the exported
+// stream position at the blob's `to`.  Returns the new stream version,
+// -1 on a torn/unparseable/unreplayable blob (the caller must not
+// ratchet anything), or -2 when the blob's `from` does not match this
+// mirror's position (the caller requests a compaction checkpoint).
+int64_t edl_svc_apply_delta(void* h, const char* blob, int64_t len,
+                            int64_t now_ms) {
+  return static_cast<Service*>(h)->ApplyDeltaChecked(
+      std::string(blob, len), now_ms);
+}
+
 int64_t edl_svc_fence(void* h) {
   return static_cast<Service*>(h)->fence.load();
 }

@@ -198,6 +198,53 @@ void TaskQueue::MaybeAdvancePass() {
   }
 }
 
+bool TaskQueue::ReplayAdd(int64_t id, const std::string& payload) {
+  std::lock_guard<std::mutex> lock(mu_);
+  Task t;
+  t.id = id;
+  t.payload = payload;
+  todo_.push_back(std::move(t));
+  if (id + 1 > next_id_) next_id_ = id + 1;
+  version_.fetch_add(1);
+  return true;
+}
+
+bool TaskQueue::ReplayComplete(int64_t id) {
+  std::lock_guard<std::mutex> lock(mu_);
+  // the mirror holds the task in todo (leases never replicate); find by
+  // id, move to done — same end state the primary's Complete reached
+  for (auto it = todo_.begin(); it != todo_.end(); ++it) {
+    if (it->id == id) {
+      done_.push_back(std::move(*it));
+      todo_.erase(it);
+      version_.fetch_add(1);
+      return true;
+    }
+  }
+  return false;  // diverged mirror: caller falls back to a checkpoint
+}
+
+bool TaskQueue::ReplayFail(int64_t id) {
+  std::lock_guard<std::mutex> lock(mu_);
+  for (auto it = todo_.begin(); it != todo_.end(); ++it) {
+    if (it->id == id) {
+      it->failures += 1;
+      if (it->failures >= max_failures_) {
+        dropped_ += 1;
+        todo_.erase(it);
+      }
+      version_.fetch_add(1);
+      return true;
+    }
+  }
+  return false;
+}
+
+void TaskQueue::ForceAdvance() {
+  std::lock_guard<std::mutex> lock(mu_);
+  MaybeAdvancePass();
+}
+
 void TaskQueue::Clear() {
   std::lock_guard<std::mutex> lock(mu_);
   todo_.clear();
@@ -364,6 +411,11 @@ void Membership::RestoreMember(const std::string& name,
   m.name = name;
   m.address = address;
   m.deadline_ms = now_ms + ttl_ms_;
+}
+
+void Membership::RemoveMirror(const std::string& name) {
+  std::lock_guard<std::mutex> lock(mu_);
+  members_.erase(name);
 }
 
 void Membership::RefreshAll(int64_t now_ms) {
@@ -564,6 +616,122 @@ bool Service::RestoreRepl(const std::string& blob, int64_t now_ms) {
   kv.Clear();
   membership.ResetMembers();
   return RestoreImpl(this, blob, now_ms);
+}
+
+bool Service::ParseDeltaHeader(const std::string& blob, int64_t* from,
+                               int64_t* to) {
+  if (blob.rfind("EDLDELTA1 ", 0) != 0) return false;
+  // terminator check BEFORE anything else: a torn trailing record must
+  // reject the whole blob, never apply a prefix (same rule as snapshots)
+  if (blob.size() < 13 || blob.compare(blob.size() - 3, 3, "\n.\n") != 0)
+    return false;
+  std::istringstream ss(blob.substr(0, blob.find('\n')));
+  std::string magic;
+  ss >> magic >> *from >> *to;
+  return !ss.fail() && *from >= 0 && *to > *from;
+}
+
+bool Service::ApplyDelta(const std::string& blob, int64_t now_ms) {
+  int64_t from = 0, to = 0;
+  if (!ParseDeltaHeader(blob, &from, &to)) return false;
+  std::istringstream ss(blob);
+  std::string line;
+  std::getline(ss, line);  // header, parsed above
+  while (std::getline(ss, line)) {
+    if (line.empty() || line == ".") continue;
+    std::istringstream ls(line.substr(1));
+    switch (line[0]) {
+      case 'K': {
+        std::string hk, hv, k, v;
+        ls >> hk >> hv;
+        if (hv == "-") hv.clear();
+        if (!HexDecode(hk, &k) || !HexDecode(hv, &v)) return false;
+        kv.Set(k, v);
+        break;
+      }
+      case 'k': {
+        std::string hk, key;
+        ls >> hk;
+        if (!HexDecode(hk, &key)) return false;
+        kv.Del(key);  // idempotent: a re-streamed delete is harmless
+        break;
+      }
+      case 'J': {
+        std::string hn, ha, name, addr;
+        ls >> hn >> ha;
+        if (ha == "-") ha.clear();
+        if (!HexDecode(hn, &name) || !HexDecode(ha, &addr)) return false;
+        membership.Join(name, addr, now_ms);
+        break;
+      }
+      case 'L': {
+        std::string hn, name;
+        ls >> hn;
+        if (!HexDecode(hn, &name)) return false;
+        membership.Leave(name);
+        break;
+      }
+      case 'X': {  // expiry batch: N removals under ONE epoch bump
+        std::string csv;
+        ls >> csv;
+        size_t start = 0;
+        while (start < csv.size()) {
+          size_t comma = csv.find(',', start);
+          if (comma == std::string::npos) comma = csv.size();
+          std::string name;
+          if (!HexDecode(csv.substr(start, comma - start), &name))
+            return false;
+          membership.RemoveMirror(name);
+          start = comma + 1;
+        }
+        membership.ForceEpoch(membership.Epoch() + 1);
+        break;
+      }
+      case 'A': {
+        int64_t id;
+        std::string hp, payload;
+        ls >> id >> hp;
+        if (ls.fail()) return false;
+        if (hp != "-" && !HexDecode(hp, &payload)) return false;
+        queue.ReplayAdd(id, payload);
+        break;
+      }
+      case 'C': {
+        int64_t id;
+        ls >> id;
+        if (ls.fail() || !queue.ReplayComplete(id)) return false;
+        break;
+      }
+      case 'F': {
+        int64_t id;
+        ls >> id;
+        if (ls.fail() || !queue.ReplayFail(id)) return false;
+        break;
+      }
+      case 'R':
+        queue.ForceAdvance();
+        break;
+      default:
+        break;  // forward compatibility: unknown record tags skip
+    }
+  }
+  return true;
+}
+
+int64_t Service::ApplyDeltaChecked(const std::string& blob,
+                                   int64_t now_ms) {
+  int64_t from = 0, to = 0;
+  if (!ParseDeltaHeader(blob, &from, &to)) return -1;  // torn: untouched
+  if (StreamVersion() != from) return -2;
+  if (!ApplyDelta(blob, now_ms)) {
+    // an unreplayable record may have applied a prefix: this mirror is
+    // dirty — stop claiming the old position (a promotion in the window
+    // before the checkpoint lands must prefer its peers)
+    version_base.store(-DurableVersion());
+    return -1;
+  }
+  version_base.store(to - DurableVersion());
+  return StreamVersion();
 }
 
 bool Service::SaveTo(const std::string& path) const {

@@ -109,6 +109,21 @@ class TaskQueue {
   // full-snapshot apply can never leave deleted entries behind.
   void Clear();
 
+  // Delta-log replay surface (standby mirror applying framed op records,
+  // doc/coordinator_scale.md).  The mirror never tracks leases — the
+  // snapshot discipline serializes leased-as-todo — so task transitions
+  // replay as direct todo/done moves keyed by task id.  Each returns
+  // false when the referenced task is not where the record claims (the
+  // mirror has diverged; the caller rejects the whole delta and the
+  // primary falls back to a compaction checkpoint).
+  bool ReplayAdd(int64_t id, const std::string& payload);
+  bool ReplayComplete(int64_t id);
+  bool ReplayFail(int64_t id);
+  // Replay of a pass rollover ('R' record): runs the same deterministic
+  // MaybeAdvancePass rule the primary ran — mirrored state in, mirrored
+  // state out (requires both nodes configured with the same `passes`).
+  void ForceAdvance();
+
  private:
   struct Leased {
     Task task;
@@ -170,6 +185,12 @@ class Membership {
   void ResetMembers();
   void RestoreMember(const std::string& name, const std::string& address,
                      int64_t now_ms);
+  // Quiet single-member removal for delta replay of an expiry batch
+  // ('X' record): the primary swept N members under ONE epoch bump, so
+  // the mirror removes each quietly and the record's ForceEpoch carries
+  // the bump — N mirrored Leave()s would inflate the epoch by N-1 and a
+  // failover would reform every world over a phantom membership change.
+  void RemoveMirror(const std::string& name);
   void RefreshAll(int64_t now_ms);
   // Sorted by name — this order IS the rank assignment for an epoch
   // (replacing the reference's IP-sort ranks, docker/k8s_tools.py:113-121,
@@ -251,6 +272,42 @@ struct Service {
   // fresh TTLs at `now_ms` (deadlines never cross processes).
   std::string SnapshotRepl(int64_t now_ms);
   bool RestoreRepl(const std::string& blob, int64_t now_ms);
+  // Log-structured delta replication (doc/coordinator_scale.md).  A
+  // delta blob frames the op records that move a mirror from stream
+  // position `from` to `to`:
+  //
+  //   EDLDELTA1 <from> <to>
+  //   K <hexkey> <hexval|->      kv put (KVSET / winning KVCAS)
+  //   k <hexkey>                 kv delete
+  //   J <hexname> <hexaddr|->    member join / address change
+  //   L <hexname>                member leave (graceful)
+  //   X <hexname,hexname,...>    TTL-expiry batch (one epoch bump)
+  //   A <id> <hexpayload|->      task added
+  //   C <id>                     task completed (pending -> done)
+  //   F <id>                     task failed (failures+1; drops at limit)
+  //   R                          pass rollover (deterministic replay)
+  //   .
+  //
+  // Empty binary fields frame as "-" exactly like the snapshot format.
+  // ParseDeltaHeader validates magic + terminator (a torn blob must be
+  // rejected WITHOUT ratcheting fence/position — the same rule snapshots
+  // pin) and reports the position range; ApplyDelta applies the records
+  // in order, returning false on the first one the mirror cannot replay
+  // (caller then requests a compaction checkpoint instead).  The caller
+  // re-anchors version_base at `to` after a successful apply.
+  static bool ParseDeltaHeader(const std::string& blob, int64_t* from,
+                               int64_t* to);
+  bool ApplyDelta(const std::string& blob, int64_t now_ms);
+  // The one checked entry point both the wire server (SYNC) and the C
+  // ABI use — the dirty-mirror zeroing rule is safety-critical (a
+  // mirror claiming a stale position can win a promotion) and must not
+  // exist in two copies.  Returns the new stream version (>= 0), -1 for
+  // a torn/unreplayable blob (torn: nothing touched; unreplayable: a
+  // prefix may have applied, so this mirror's claimed position is
+  // ZEROED until a checkpoint restores it), or -2 when the blob's
+  // `from` is not this mirror's position (caller requests a
+  // compaction checkpoint).
+  int64_t ApplyDeltaChecked(const std::string& blob, int64_t now_ms);
   // Atomic, host-crash-durable file write-through (temp + fsync + rename +
   // directory fsync) / startup load.
   bool SaveTo(const std::string& path) const;

@@ -28,7 +28,40 @@
 //   KVWAIT <k> <timeout_ms> <epoch|-> -> OK <hex> | EPOCH <n> | NONE
 //                                     (parks until the key exists, the
 //                                     epoch moves off <epoch>, or timeout)
+//   KVWAITNE <k> <hexold|-> <timeout_ms> -> OK <hex> | GONE | NONE
+//                                     (parks until the key's value
+//                                     differs from <hexold>; "-" = absent,
+//                                     so "appeared" fires too — the
+//                                     change-wait the serving weight
+//                                     watcher long-polls on)
+//   KEEPALIVE <n1,n2,...>          -> OK <acked> <expired-csv|->
+//                                     (coalesced heartbeat batch: one
+//                                     request renews every member slot a
+//                                     supervisor host owns; expired names
+//                                     must re-JOIN individually)
 //   METRICS                        -> OK <requests> <parked> <fired>
+//                                     <repl_bytes> <repl_deltas>
+//                                     <repl_ckpts> <snapshot_bytes>
+//                                     <follower_reads>
+//
+// Scale-out additions (doc/coordinator_scale.md): mutating acks carry a
+// trailing "v<stream_version>" token (the read-your-writes floor a
+// client presents to follower reads; older clients ignore the extra
+// token), requests may be TAGGED — "#<id> <verb...>" answers
+// "#<id> <reply...>" and park verbs run off-thread, so one multiplexed
+// connection carries interleaved requests for many member slots without
+// a parked wait head-of-line-blocking the rest — and standbys serve
+// version-gated reads:
+//   READ <fence> <minver> <verb...> -> the inner read verb's reply, from
+//                                     ANY role, once this node's applied
+//                                     stream position >= <minver> (parks
+//                                     briefly, then "ERR behind <pos>");
+//                                     "ERR stale <fence>" when this node
+//                                     has not seen the client's fencing
+//                                     regime.  Inner verbs: KVGET, KEYS,
+//                                     MEMBERS, STATS, WAITEPOCH, KVWAIT,
+//                                     KVWAITNE, METRICS, CONFIG, PING.
+//                                     Followers never TTL-sweep.
 //
 // HA control-plane verbs (doc/coordinator_ha.md).  A node that is not the
 // fenced-in primary answers every OTHER verb — reads and long-polls
@@ -36,8 +69,19 @@
 // stale epoch/KV state from a standby or a deposed primary:
 //   ROLE                           -> OK <primary|standby|fenced> <fence> <ver>
 //   SYNC <fence> <ver> <hexblob>   -> OK <ver> | ERR fenced <fence>
-//                                     (primary→standby full-state stream;
-//                                     the standby persists BEFORE acking)
+//                                     | ERR behind | ERR badblob
+//                                     (primary→standby stream; the blob's
+//                                     magic selects the kind: EDLCOORD1 =
+//                                     compaction checkpoint (full state,
+//                                     clear-then-restore), EDLDELTA1 =
+//                                     framed op-log records covering
+//                                     (from, ver] — "ERR behind" when the
+//                                     standby's position is not the
+//                                     delta's `from` (the primary falls
+//                                     back to a checkpoint), "ERR
+//                                     badblob" on a torn blob (position
+//                                     never ratchets).  The standby
+//                                     persists BEFORE acking either way)
 //   REPLHB <fence>                 -> OK <fence> | ERR fenced <fence>
 //                                     (replication lease heartbeat)
 //   PROMOTE <fence>                -> OK <fence> <ver> | ERR stale <fence>
@@ -72,11 +116,14 @@
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
+#include <deque>
 #include <limits>
+#include <memory>
 #include <mutex>
 #include <sstream>
 #include <string>
 #include <thread>
+#include <utility>
 #include <vector>
 
 #include "coord.hpp"
@@ -179,6 +226,129 @@ std::atomic<int64_t> g_repl_syncs{0};    // streams acked (primary) /
                                          // applied (standby)
 std::atomic<int64_t> g_repl_errors{0};
 std::atomic<int64_t> g_promotions{0};
+
+// ---------------------------------------------------------------------------
+// Log-structured delta replication (doc/coordinator_scale.md).
+//
+// Mutating commands append framed op records to a bounded in-memory log
+// keyed by stream position; StreamToReplicas ships a replica the records
+// covering (its acked position, head] as one EDLDELTA1 blob — O(delta)
+// wire bytes per mutation instead of the full O(store) snapshot — and
+// falls back to a compaction CHECKPOINT (the PR 7 full snapshot) whenever
+// the log cannot prove contiguity: a mutation the capture missed (TTL
+// expiry sweeps, pass rollovers landing outside a captured verb), a
+// replica behind the log's trimmed tail, a fresh REPLICATE re-attach, or
+// a replica that rejected a delta.  Correctness therefore never depends
+// on the log: deltas are a pure wire-bytes optimization and every
+// fallback path is the already-proven checkpoint stream.
+// ---------------------------------------------------------------------------
+
+constexpr size_t kOpLogCap = 8192;  // records retained; older = checkpoint
+std::mutex g_log_mu;
+std::deque<std::pair<int64_t, std::string>> g_oplog;  // (position, record)
+int64_t g_log_to = 0;  // position of the last record (= head when contiguous)
+// mutating verbs serialize here across HandleImpl + log append, so record
+// positions can never interleave; reads, parks and heartbeats stay off it
+std::mutex g_mut_mu;
+// records captured by the current command's HandleImpl (same thread)
+thread_local std::vector<std::string> g_records;
+
+std::atomic<int64_t> g_repl_bytes{0};        // wire bytes streamed
+std::atomic<int64_t> g_repl_delta_syncs{0};  // exchanges shipped as deltas
+std::atomic<int64_t> g_repl_ckpt_syncs{0};   // exchanges shipped as ckpts
+std::atomic<int64_t> g_follower_reads{0};    // READ verbs served
+
+void OpLogReset(int64_t head) {
+  // caller holds g_log_mu: the log can no longer prove contiguity up to
+  // `head` — drop it; replicas behind `head` get a checkpoint
+  g_oplog.clear();
+  g_log_to = head;
+}
+
+// Append this command's captured records.  v0/v1 bracket the command's
+// StreamVersion; an exact match between version movement and record count
+// is the contiguity proof — anything else (an uncaptured concurrent bump,
+// e.g. a TTL sweep inside a parked wait) resets the log.
+void OpLogAppend(int64_t v0, int64_t v1,
+                 const std::vector<std::string>& records) {
+  std::lock_guard<std::mutex> lk(g_log_mu);
+  if (v1 == v0) return;
+  if (g_log_to == v0 &&
+      records.size() == static_cast<size_t>(v1 - v0)) {
+    for (size_t i = 0; i < records.size(); ++i)
+      g_oplog.emplace_back(v0 + 1 + static_cast<int64_t>(i), records[i]);
+    g_log_to = v1;
+    while (g_oplog.size() > kOpLogCap) g_oplog.pop_front();
+  } else {
+    OpLogReset(v1);
+  }
+}
+
+// Build the EDLDELTA1 blob covering (from, to], or "" when the log
+// cannot (trimmed past `from`, or head != `to`).  Caller holds g_log_mu.
+std::string OpLogDelta(int64_t from, int64_t to) {
+  if (g_log_to != to || from >= to) return "";
+  if (g_oplog.empty() || g_oplog.front().first > from + 1) return "";
+  std::string out = "EDLDELTA1 " + std::to_string(from) + " " +
+                    std::to_string(to) + "\n";
+  for (const auto& rec : g_oplog)
+    if (rec.first > from) out += rec.second + "\n";
+  out += ".\n";
+  return out;
+}
+
+// ---------------------------------------------------------------------------
+// Per-verb latency histograms (edl_coord_verb_seconds{verb=...}): the
+// bench's attribution signal for where control-plane time goes.  Fixed
+// buckets, lock-free observation; rendered on /metrics only for verbs
+// actually seen so an idle server's exposition stays lean.
+// ---------------------------------------------------------------------------
+
+constexpr double kVerbBucketsS[] = {0.0005, 0.001, 0.0025, 0.005, 0.01,
+                                    0.025,  0.05,  0.1,    0.25,  0.5,
+                                    1.0,    2.5};
+constexpr size_t kNVerbBuckets = sizeof(kVerbBucketsS) / sizeof(double);
+struct VerbHist {
+  const char* name;
+  std::atomic<int64_t> buckets[kNVerbBuckets];  // cumulative at render
+  std::atomic<int64_t> count{0};
+  std::atomic<int64_t> sum_us{0};
+};
+VerbHist g_verb_hists[] = {
+    {"LEASE", {}, {}, {}},    {"ADD", {}, {}, {}},
+    {"COMPLETE", {}, {}, {}}, {"FAIL", {}, {}, {}},
+    {"RENEW", {}, {}, {}},    {"RELEASE", {}, {}, {}},
+    {"STATS", {}, {}, {}},    {"JOIN", {}, {}, {}},
+    {"HB", {}, {}, {}},       {"KEEPALIVE", {}, {}, {}},
+    {"LEAVE", {}, {}, {}},    {"MEMBERS", {}, {}, {}},
+    {"KVSET", {}, {}, {}},    {"KVGET", {}, {}, {}},
+    {"KVDEL", {}, {}, {}},    {"KVCAS", {}, {}, {}},
+    {"KEYS", {}, {}, {}},     {"WAITEPOCH", {}, {}, {}},
+    {"KVWAIT", {}, {}, {}},   {"KVWAITNE", {}, {}, {}},
+    {"METRICS", {}, {}, {}},  {"READ", {}, {}, {}},
+    {"SYNC", {}, {}, {}},     {"REPLHB", {}, {}, {}},
+    {"PROMOTE", {}, {}, {}},  {"REPLICATE", {}, {}, {}},
+    {"ROLE", {}, {}, {}},     {"PING", {}, {}, {}},
+    {"CONFIG", {}, {}, {}},   {"other", {}, {}, {}},
+};
+constexpr size_t kNVerbs = sizeof(g_verb_hists) / sizeof(VerbHist);
+
+VerbHist& FindVerbHist(const std::string& cmd) {
+  for (size_t i = 0; i + 1 < kNVerbs; ++i)
+    if (cmd == g_verb_hists[i].name) return g_verb_hists[i];
+  return g_verb_hists[kNVerbs - 1];  // "other"
+}
+
+void ObserveVerb(const std::string& cmd, double seconds) {
+  VerbHist& h = FindVerbHist(cmd);
+  for (size_t b = 0; b < kNVerbBuckets; ++b)
+    if (seconds <= kVerbBucketsS[b]) {
+      h.buckets[b].fetch_add(1);
+      break;  // non-cumulative per-bucket; summed cumulative at render
+    }
+  h.count.fetch_add(1);
+  h.sum_us.fetch_add(static_cast<int64_t>(seconds * 1e6));
+}
 
 void MaybePersist(bool force = false) {
   if (g_state_file.empty()) return;
@@ -286,7 +456,10 @@ void SelfFence(int64_t newer_fence) {
 // One request/response exchange with a replica over its persistent
 // connection (redialing under backoff).  Returns 1 on an OK ack, 0 when
 // the replica is unreachable, -1 when it rejected us with a newer fence
-// (the caller must self-fence).  Caller holds g_repl_mu.
+// (the caller must self-fence), 2 on a non-fence protocol refusal (ERR
+// behind / ERR badblob — the replica is reachable but cannot apply what
+// we sent; the caller falls back to a compaction checkpoint).  Caller
+// holds g_repl_mu.
 int ReplicaExchange(Replica& r, const std::string& line, bool is_sync) {
   int64_t now = NowMs();
   if (r.fd < 0) {
@@ -391,20 +564,33 @@ int ReplicaExchange(Replica& r, const std::string& line, bool is_sync) {
     g_repl_errors.fetch_add(1);
     return 0;
   }
-  // protocol-level refusal that is not a fence (e.g. a replica that is
-  // itself a primary mid-reconfiguration): count and keep serving
+  // protocol-level refusal that is not a fence (ERR behind / ERR badblob
+  // from a delta the replica cannot apply, or a replica that is itself a
+  // primary mid-reconfiguration): reachable but unapplied — the caller
+  // decides (delta path falls back to a checkpoint)
   g_repl_errors.fetch_add(1);
-  return 0;
+  return 2;
 }
 
-// Stream the current snapshot to every attached standby.  Returns false
-// iff this node got fenced (the caller replaces its client reply).
+// Stream the current state to every attached standby — as the op-log
+// DELTA covering (replica position, head] when the log proves
+// contiguity, else as a full compaction CHECKPOINT (the PR 7 snapshot
+// stream; also the path for re-attaches, trimmed tails and rejected
+// deltas).  Returns false iff this node got fenced (the caller replaces
+// its client reply).
 bool StreamToReplicas() {
   if (g_role.load() != kPrimary) return false;
   std::lock_guard<std::mutex> lk(g_repl_mu);
   if (g_replicas.empty()) return true;
   int64_t sv = g_service->StreamVersion();
   int64_t now = NowMs();
+  {
+    // a mutation the capture missed (TTL sweep, rollover outside a
+    // captured verb) leaves the log head behind the live position: the
+    // log can no longer prove contiguity — reset, checkpoint everyone
+    std::lock_guard<std::mutex> lg(g_log_mu);
+    if (g_log_to != sv) OpLogReset(sv);
+  }
   bool all_current = true;
   bool any_behind_ready = false;
   for (auto& r : g_replicas) {
@@ -417,21 +603,53 @@ bool StreamToReplicas() {
     // way; down means STRICT mode must refuse to ack what no mirror
     // holds (AVAILABLE mode serves on — the documented tradeoff)
     return all_current || !g_repl_lease_strict;
-  std::string blob = g_service->SnapshotRepl(now);
-  std::string line = "SYNC " + std::to_string(g_service->fence.load()) +
-                     " " + std::to_string(sv) + " " +
-                     edlcoord::HexEncode(blob) + "\n";
+  const std::string fence_s = std::to_string(g_service->fence.load());
+  std::string ckpt_line;  // built lazily: most rounds ship only deltas
   bool any_ok = false;
   for (auto& r : g_replicas) {
     if (r.acked_version >= sv) {
       any_ok = true;  // this mirror already holds the position
       continue;
     }
+    std::string line;
+    bool is_delta = false;
+    if (r.acked_version >= 0) {
+      std::lock_guard<std::mutex> lg(g_log_mu);
+      std::string delta = OpLogDelta(r.acked_version, sv);
+      if (!delta.empty()) {
+        line = "SYNC " + fence_s + " " + std::to_string(sv) + " " +
+               edlcoord::HexEncode(delta) + "\n";
+        is_delta = true;
+      }
+    }
+    if (!is_delta) {
+      if (ckpt_line.empty())
+        ckpt_line = "SYNC " + fence_s + " " + std::to_string(sv) + " " +
+                    edlcoord::HexEncode(g_service->SnapshotRepl(now)) +
+                    "\n";
+      line = ckpt_line;
+    }
     int rc = ReplicaExchange(r, line, /*is_sync=*/true);
     if (rc == -1) return false;  // fenced (SelfFence already ran)
+    if (rc == 2 && is_delta) {
+      // reachable but couldn't apply the delta (ERR behind/badblob):
+      // fall back to a checkpoint NOW — leaving it behind until the
+      // next mutation would be a silent redundancy hole
+      r.acked_version = -1;
+      if (ckpt_line.empty())
+        ckpt_line = "SYNC " + fence_s + " " + std::to_string(sv) + " " +
+                    edlcoord::HexEncode(g_service->SnapshotRepl(NowMs())) +
+                    "\n";
+      rc = ReplicaExchange(r, ckpt_line, /*is_sync=*/true);
+      if (rc == -1) return false;
+      is_delta = false;
+      line = ckpt_line;
+    }
     if (rc == 1) {
       r.acked_version = sv;
       any_ok = true;
+      g_repl_bytes.fetch_add(static_cast<int64_t>(line.size()));
+      (is_delta ? g_repl_delta_syncs : g_repl_ckpt_syncs).fetch_add(1);
     } else if (rc == 0) {
       g_repl_errors.fetch_add(1);
     }
@@ -494,20 +712,29 @@ std::vector<std::string> Split(const std::string& line) {
   return out;
 }
 
-std::string HandleImpl(const std::string& line);
+std::string HandleImpl(const std::string& line, bool follower = false);
+int64_t ProbeSweepNow();
 
 // Control-plane verbs that every role answers; everything else is gated
-// on being the fenced-in primary.
+// on being the fenced-in primary.  READ carries its own fence+version
+// gate (that is its whole point: a version-gated read is servable from
+// ANY role — doc/coordinator_scale.md §follower reads).
 bool IsControlVerb(const std::string& cmd) {
   return cmd == "PING" || cmd == "CONFIG" || cmd == "METRICS" ||
          cmd == "ROLE" || cmd == "SYNC" || cmd == "REPLHB" ||
-         cmd == "PROMOTE" || cmd == "REPLICATE";
+         cmd == "PROMOTE" || cmd == "REPLICATE" || cmd == "READ";
+}
+
+// Verbs whose success can move the durable version: serialized under
+// g_mut_mu so captured op-log records can never interleave positions.
+bool IsMutatingVerb(const std::string& cmd) {
+  return cmd == "LEASE" || cmd == "ADD" || cmd == "COMPLETE" ||
+         cmd == "FAIL" || cmd == "JOIN" || cmd == "LEAVE" ||
+         cmd == "KVSET" || cmd == "KVDEL" || cmd == "KVCAS";
 }
 
 // One bad line must never take down the coordinator for the whole job.
-std::string Handle(const std::string& line) {
-  g_requests.fetch_add(1);
-  std::string cmd = line.substr(0, line.find(' '));
+std::string HandleGated(const std::string& cmd, const std::string& line) {
   const bool control = IsControlVerb(cmd);
   if (!control) {
     // Fencing gate: reads, writes and long-polls alike — a standby or a
@@ -516,10 +743,31 @@ std::string Handle(const std::string& line) {
     if (!EnsureLease()) return FencedReply();
   }
   std::string resp;
-  try {
-    resp = HandleImpl(line);
-  } catch (const std::exception& e) {
-    return std::string("ERR bad-arg ") + e.what();
+  const bool mut = IsMutatingVerb(cmd);
+  if (mut) {
+    std::unique_lock<std::mutex> ml(g_mut_mu);
+    g_records.clear();
+    const int64_t v0 = g_service->StreamVersion();
+    try {
+      resp = HandleImpl(line);
+    } catch (const std::exception& e) {
+      return std::string("ERR bad-arg ") + e.what();
+    }
+    const int64_t v1 = g_service->StreamVersion();
+    OpLogAppend(v0, v1, g_records);
+    ml.unlock();
+    // mutating acks carry the post-op stream position: the client's
+    // read-your-writes floor for version-gated follower reads (older
+    // clients ignore the trailing token).  LEASE stays token-free — its
+    // reply ends in a variable hex payload and leases need no RYW floor.
+    if (v1 != v0 && cmd != "LEASE" && resp.rfind("OK", 0) == 0)
+      resp += " v" + std::to_string(v1);
+  } else {
+    try {
+      resp = HandleImpl(line);
+    } catch (const std::exception& e) {
+      return std::string("ERR bad-arg ") + e.what();
+    }
   }
   // Persist BEFORE acking: once a worker sees OK for a COMPLETE or KVSET
   // — or an OK LEASE whose side effect rolled the pass over — a
@@ -537,7 +785,30 @@ std::string Handle(const std::string& line) {
   return resp;
 }
 
-std::string HandleImpl(const std::string& line) {
+std::string Handle(const std::string& line) {
+  const auto t0 = std::chrono::steady_clock::now();
+  g_requests.fetch_add(1);
+  std::string cmd = line.substr(0, line.find(' '));
+  std::string resp = HandleGated(cmd, line);
+  ObserveVerb(cmd, std::chrono::duration<double>(
+                       std::chrono::steady_clock::now() - t0)
+                       .count());
+  return resp;
+}
+
+// Read-only verbs a follower may serve under the READ gate.
+bool IsReadVerb(const std::string& cmd) {
+  return cmd == "KVGET" || cmd == "KEYS" || cmd == "MEMBERS" ||
+         cmd == "STATS" || cmd == "WAITEPOCH" || cmd == "KVWAIT" ||
+         cmd == "KVWAITNE" || cmd == "METRICS" || cmd == "CONFIG" ||
+         cmd == "PING";
+}
+
+//: how long a stale follower parks a version-gated read waiting for its
+//: applied position to catch up before redirecting the client
+constexpr int64_t kFollowerParkCapMs = 2000;
+
+std::string HandleImpl(const std::string& line, bool follower) {
   std::vector<std::string> args = Split(line);
   if (args.empty()) return "ERR empty";
   const std::string& cmd = args[0];
@@ -583,7 +854,18 @@ std::string HandleImpl(const std::string& line) {
     if (f < g_service->fence.load()) return FencedReply();
     std::string blob;
     if (!HexDecode(args[3], &blob)) return "ERR hex";
-    if (!g_service->RestoreRepl(blob, NowMs())) return "ERR badblob";
+    if (blob.rfind("EDLDELTA1 ", 0) == 0) {
+      // log-structured delta: apply only when contiguous with the
+      // position this mirror durably holds — "ERR behind" makes the
+      // primary fall back to a compaction checkpoint; torn or
+      // unreplayable blobs reject (the dirty-mirror zeroing rule lives
+      // in ApplyDeltaChecked, shared with the C ABI)
+      const int64_t rc = g_service->ApplyDeltaChecked(blob, NowMs());
+      if (rc == -2) return "ERR behind";
+      if (rc < 0) return "ERR badblob";
+    } else {
+      if (!g_service->RestoreRepl(blob, NowMs())) return "ERR badblob";
+    }
     if (f > g_service->fence.load()) g_service->fence.store(f);
     // a self-fenced ex-primary accepting a stream is provably a mirror
     // again: demote to standby so the pair regains real redundancy (and
@@ -689,8 +971,14 @@ std::string HandleImpl(const std::string& line) {
   }
 
   if (cmd == "LEASE" && args.size() == 2) {
+    // a LEASE can roll the pass over — the only mutation it makes that
+    // is snapshot-visible, captured as an 'R' record for the delta log
+    const int p0 = s.queue.CurrentPass();
     edlcoord::Lease lease;
-    switch (s.queue.LeaseTask(args[1], NowMs(), &lease)) {
+    const edlcoord::LeaseResult lr = s.queue.LeaseTask(args[1], NowMs(),
+                                                       &lease);
+    if (s.queue.CurrentPass() != p0) g_records.push_back("R");
+    switch (lr) {
       case edlcoord::LeaseResult::kOk:
         return "OK " + std::to_string(lease.task_id) + " " +
                HexEncode(lease.payload);
@@ -703,17 +991,27 @@ std::string HandleImpl(const std::string& line) {
   if (cmd == "ADD" && args.size() == 2) {
     std::string payload;
     if (args[1] != "-" && !HexDecode(args[1], &payload)) return "ERR hex";
-    return "OK " + std::to_string(s.queue.AddTask(payload));
+    const int64_t id = s.queue.AddTask(payload);
+    g_records.push_back("A " + std::to_string(id) + " " + args[1]);
+    return "OK " + std::to_string(id);
   }
-  if (cmd == "COMPLETE" && (args.size() == 2 || args.size() == 3))
-    return s.queue.Complete(std::stoll(args[1]),
-                            args.size() == 3 ? args[2] : "")
-               ? "OK"
-               : "ERR";
-  if (cmd == "FAIL" && (args.size() == 2 || args.size() == 3))
-    return s.queue.Fail(std::stoll(args[1]), args.size() == 3 ? args[2] : "")
-               ? "OK"
-               : "ERR";
+  if (cmd == "COMPLETE" && (args.size() == 2 || args.size() == 3)) {
+    const int p0 = s.queue.CurrentPass();
+    const int64_t id = std::stoll(args[1]);
+    if (!s.queue.Complete(id, args.size() == 3 ? args[2] : ""))
+      return "ERR";
+    g_records.push_back("C " + std::to_string(id));
+    if (s.queue.CurrentPass() != p0) g_records.push_back("R");
+    return "OK";
+  }
+  if (cmd == "FAIL" && (args.size() == 2 || args.size() == 3)) {
+    const int p0 = s.queue.CurrentPass();
+    const int64_t id = std::stoll(args[1]);
+    if (!s.queue.Fail(id, args.size() == 3 ? args[2] : "")) return "ERR";
+    g_records.push_back("F " + std::to_string(id));
+    if (s.queue.CurrentPass() != p0) g_records.push_back("R");
+    return "OK";
+  }
   if (cmd == "RENEW" && (args.size() == 2 || args.size() == 3))
     return s.queue.Renew(std::stoll(args[1]),
                          args.size() == 3 ? args[2] : "", NowMs())
@@ -729,16 +1027,55 @@ std::string HandleImpl(const std::string& line) {
            std::to_string(s.queue.CurrentPass());
   }
 
-  if (cmd == "JOIN" && args.size() == 3)
-    return "OK " + std::to_string(s.membership.Join(
-               args[1], args[2] == "-" ? "" : args[2], NowMs()));
+  if (cmd == "JOIN" && args.size() == 3) {
+    const int64_t e0 = s.membership.Epoch();
+    const std::string addr = args[2] == "-" ? "" : args[2];
+    const int64_t e1 = s.membership.Join(args[1], addr, NowMs());
+    if (e1 != e0)  // a refresh-join moves nothing: no record
+      g_records.push_back("J " + HexEncode(args[1]) + " " +
+                          (addr.empty() ? "-" : HexEncode(addr)));
+    return "OK " + std::to_string(e1);
+  }
   if (cmd == "HB" && args.size() == 2)
     return s.membership.Heartbeat(args[1], NowMs()) ? "OK" : "ERR rejoin";
-  if (cmd == "LEAVE" && args.size() == 2)
-    return s.membership.Leave(args[1]) ? "OK" : "ERR";
+  if (cmd == "KEEPALIVE" && args.size() == 2) {
+    // coalesced heartbeat batch: one request renews every member slot a
+    // supervisor host owns; expired names are reported back so the
+    // owner re-JOINs exactly those (ERR rejoin semantics, batched)
+    const int64_t now = NowMs();
+    int64_t acked = 0;
+    std::string expired;
+    size_t start = 0;
+    while (start < args[1].size()) {
+      size_t comma = args[1].find(',', start);
+      if (comma == std::string::npos) comma = args[1].size();
+      const std::string name = args[1].substr(start, comma - start);
+      if (!name.empty()) {
+        if (s.membership.Heartbeat(name, now)) {
+          ++acked;
+        } else {
+          if (!expired.empty()) expired += ',';
+          expired += name;
+        }
+      }
+      start = comma + 1;
+    }
+    return "OK " + std::to_string(acked) + " " +
+           (expired.empty() ? "-" : expired);
+  }
+  if (cmd == "LEAVE" && args.size() == 2) {
+    if (!s.membership.Leave(args[1])) return "ERR";
+    g_records.push_back("L " + HexEncode(args[1]));
+    return "OK";
+  }
   if (cmd == "MEMBERS") {
     std::string list;
-    for (const auto& m : s.membership.Members(NowMs())) {
+    // a follower never TTL-sweeps: its mirror sees no heartbeats, and
+    // expiring from it would fabricate epoch bumps (same rule as the
+    // standby's /healthz probe — ProbeSweepNow)
+    const int64_t sweep =
+        follower ? std::numeric_limits<int64_t>::min() : NowMs();
+    for (const auto& m : s.membership.Members(sweep)) {
       if (!list.empty()) list += ',';
       list += m.name + "=" + m.address;
     }
@@ -749,6 +1086,7 @@ std::string HandleImpl(const std::string& line) {
     std::string v;
     if (args[2] != "-" && !HexDecode(args[2], &v)) return "ERR hex";
     s.kv.Set(args[1], v);
+    g_records.push_back("K " + HexEncode(args[1]) + " " + args[2]);
     return "OK";
   }
   if (cmd == "KVGET" && args.size() == 2) {
@@ -756,13 +1094,20 @@ std::string HandleImpl(const std::string& line) {
     if (!s.kv.Get(args[1], &v)) return "NONE";
     return "OK " + HexEncode(v);
   }
-  if (cmd == "KVDEL" && args.size() == 2)
-    return s.kv.Del(args[1]) ? "OK" : "NONE";
+  if (cmd == "KVDEL" && args.size() == 2) {
+    if (!s.kv.Del(args[1])) return "NONE";
+    g_records.push_back("k " + HexEncode(args[1]));
+    return "OK";
+  }
   if (cmd == "KVCAS" && args.size() == 4) {
     std::string expect, v;
     if (args[2] != "-" && !HexDecode(args[2], &expect)) return "ERR hex";
     if (args[3] != "-" && !HexDecode(args[3], &v)) return "ERR hex";
-    return s.kv.Cas(args[1], expect, v) ? "OK" : "FAIL";
+    if (!s.kv.Cas(args[1], expect, v)) return "FAIL";
+    // a winning CAS replicates as a plain put: the mirror needs the
+    // outcome, not the race
+    g_records.push_back("K " + HexEncode(args[1]) + " " + args[3]);
+    return "OK";
   }
   if (cmd == "KEYS") {
     std::string prefix = args.size() > 1 ? args[1] : "";
@@ -793,10 +1138,16 @@ std::string HandleImpl(const std::string& line) {
       // deposed primary resuming INSIDE this loop would otherwise run
       // the expiry sweep below, fabricate an epoch bump from its frozen
       // member table, and fire the waiter with phantom membership before
-      // the keeper thread gets around to fencing it.
-      if (g_role.load() != kPrimary || !EnsureLease()) return FencedReply();
+      // the keeper thread gets around to fencing it.  A follower read
+      // skips both gates — its epoch moves only when a stream applies,
+      // and it never sweeps.
+      if (!follower) {
+        if (g_role.load() != kPrimary || !EnsureLease())
+          return FencedReply();
+      }
       const int64_t gen = CurrentWaitGen();
-      s.membership.Members(NowMs());  // expiry sweep (may bump the epoch)
+      if (!follower)
+        s.membership.Members(NowMs());  // expiry sweep (may bump epoch)
       const int64_t epoch = s.membership.Epoch();
       if (epoch != known) {
         if (parked) g_longpolls_fired.fetch_add(1);
@@ -824,7 +1175,10 @@ std::string HandleImpl(const std::string& line) {
     bool parked = false;
     for (;;) {
       // same role + lease re-verification as WAITEPOCH
-      if (g_role.load() != kPrimary || !EnsureLease()) return FencedReply();
+      if (!follower) {
+        if (g_role.load() != kPrimary || !EnsureLease())
+          return FencedReply();
+      }
       const int64_t gen = CurrentWaitGen();
       std::string v;
       if (s.kv.Get(key, &v)) {
@@ -832,7 +1186,7 @@ std::string HandleImpl(const std::string& line) {
         return "OK " + HexEncode(v);
       }
       if (watch_epoch) {
-        s.membership.Members(NowMs());
+        if (!follower) s.membership.Members(NowMs());
         const int64_t epoch = s.membership.Epoch();
         if (epoch != known) {
           if (parked) g_longpolls_fired.fetch_add(1);
@@ -850,10 +1204,104 @@ std::string HandleImpl(const std::string& line) {
       WaitChunk(gen, std::min(left + 1, kWaitRecheckMs));
     }
   }
-  if (cmd == "METRICS")
+  if (cmd == "KVWAITNE" && args.size() == 4) {
+    // change-wait: park while the key's value equals <hexold> ("-" =
+    // absent, so appearance fires too; "=" = the EMPTY value — a
+    // wire token cannot be zero bytes, and conflating empty with
+    // absent would fire instantly forever on an empty-valued key).
+    // The serving weight watcher's long-poll — replaces its
+    // fixed-interval lineage polling.
+    const std::string& key = args[1];
+    const bool old_absent = args[2] == "-";
+    std::string old_val;
+    if (!old_absent && args[2] != "=" &&
+        !HexDecode(args[2], &old_val))
+      return "ERR hex";
+    const int64_t timeout_ms =
+        std::min(std::max<int64_t>(std::stoll(args[3]), 0), kWaitTimeoutCapMs);
+    const auto deadline = std::chrono::steady_clock::now() +
+                          std::chrono::milliseconds(timeout_ms);
+    bool parked = false;
+    for (;;) {
+      if (!follower) {
+        if (g_role.load() != kPrimary || !EnsureLease())
+          return FencedReply();
+      }
+      const int64_t gen = CurrentWaitGen();
+      std::string v;
+      const bool exists = s.kv.Get(key, &v);
+      if (exists && (old_absent || v != old_val)) {
+        if (parked) g_longpolls_fired.fetch_add(1);
+        return "OK " + HexEncode(v);
+      }
+      if (!exists && !old_absent) {
+        if (parked) g_longpolls_fired.fetch_add(1);
+        return "GONE";
+      }
+      const auto now = std::chrono::steady_clock::now();
+      if (now >= deadline) return "NONE";
+      if (!parked) {
+        parked = true;
+        g_longpolls_parked.fetch_add(1);
+      }
+      const int64_t left = std::chrono::duration_cast<
+          std::chrono::milliseconds>(deadline - now).count();
+      WaitChunk(gen, std::min(left + 1, kWaitRecheckMs));
+    }
+  }
+  if (cmd == "READ" && args.size() >= 4) {
+    // version-gated follower read (doc/coordinator_scale.md): servable
+    // from ANY role once this node has seen the client's fencing regime
+    // and applied at least the client's read floor.  A stale follower
+    // parks briefly for its stream to catch up (SYNC applies notify the
+    // wait cv), then redirects the client to the primary.
+    const int64_t f = std::stoll(args[1]);
+    const int64_t minver = std::stoll(args[2]);
+    if (f > g_service->fence.load())
+      return "ERR stale " + std::to_string(g_service->fence.load());
+    if (!IsReadVerb(args[3])) return "ERR readonly";
+    const auto deadline = std::chrono::steady_clock::now() +
+                          std::chrono::milliseconds(kFollowerParkCapMs);
+    while (g_service->StreamVersion() < minver) {
+      const int64_t gen = CurrentWaitGen();
+      if (g_service->StreamVersion() >= minver) break;
+      if (std::chrono::steady_clock::now() >= deadline)
+        return "ERR behind " + std::to_string(g_service->StreamVersion());
+      WaitChunk(gen, kWaitRecheckMs);
+    }
+    std::string inner = args[3];
+    for (size_t i = 4; i < args.size(); ++i) inner += " " + args[i];
+    g_follower_reads.fetch_add(1);
+    // the inner verb runs sweep-free unless this node IS the primary
+    // (then sweeping remains its job and the read is trivially current)
+    return HandleImpl(inner, /*follower=*/g_role.load() != kPrimary);
+  }
+  if (cmd == "METRICS") {
+    // extended tail: replication wire accounting + the O(store)
+    // replication-snapshot size — member lines included, because THAT is
+    // the blob the pre-PR stream shipped per mutation (the baseline the
+    // bench diffs delta bytes against; sweep-free like every probe path
+    // so a standby's METRICS cannot corrupt its mirror) + follower
+    // reads.  The size is an O(store) serialization, so it recomputes
+    // at most once per 5 s — a scraper polling METRICS must not turn
+    // every sweep into a full-store walk under the service locks.
+    static std::atomic<int64_t> snap_bytes{-1};
+    static std::atomic<int64_t> snap_at_ms{-1};
+    const int64_t now = NowMs();
+    if (snap_bytes.load() < 0 || now - snap_at_ms.load() > 5000) {
+      snap_bytes.store(static_cast<int64_t>(
+          g_service->SnapshotRepl(ProbeSweepNow()).size()));
+      snap_at_ms.store(now);
+    }
     return "OK " + std::to_string(g_requests.load()) + " " +
            std::to_string(g_longpolls_parked.load()) + " " +
-           std::to_string(g_longpolls_fired.load());
+           std::to_string(g_longpolls_fired.load()) + " " +
+           std::to_string(g_repl_bytes.load()) + " " +
+           std::to_string(g_repl_delta_syncs.load()) + " " +
+           std::to_string(g_repl_ckpt_syncs.load()) + " " +
+           std::to_string(snap_bytes.load()) + " " +
+           std::to_string(g_follower_reads.load());
+  }
   return "ERR unknown";
 }
 
@@ -893,7 +1341,11 @@ std::string HealthBody() {
      << ",\"persisted_version\":" << g_persisted_version.load()
      << ",\"role\":\"" << RoleName(g_role.load()) << "\""
      << ",\"fence\":" << g_service->fence.load()
-     << ",\"stream_version\":" << g_service->StreamVersion() << "}";
+     << ",\"stream_version\":" << g_service->StreamVersion()
+     << ",\"repl_bytes\":" << g_repl_bytes.load()
+     << ",\"repl_deltas\":" << g_repl_delta_syncs.load()
+     << ",\"repl_checkpoints\":" << g_repl_ckpt_syncs.load()
+     << ",\"follower_reads\":" << g_follower_reads.load() << "}";
   return js.str();
 }
 
@@ -959,6 +1411,44 @@ std::string MetricsBody() {
           g_repl_errors.load());
   counter("edl_coord_promotions_total",
           "standby-to-primary promotions served", g_promotions.load());
+  // log-structured replication accounting (doc/coordinator_scale.md):
+  // wire bytes must grow O(delta) per mutation, not O(store) — the bench
+  // and the CI control-plane smoke assert on these
+  counter("edl_coord_repl_bytes_total",
+          "replication wire bytes streamed (deltas + checkpoints)",
+          g_repl_bytes.load());
+  counter("edl_coord_repl_deltas_total",
+          "replication exchanges shipped as op-log deltas",
+          g_repl_delta_syncs.load());
+  counter("edl_coord_repl_checkpoints_total",
+          "replication exchanges shipped as compaction checkpoints",
+          g_repl_ckpt_syncs.load());
+  counter("edl_coord_follower_reads_total",
+          "version-gated READ verbs served", g_follower_reads.load());
+  // per-verb latency histogram: the bench's control-plane attribution
+  // signal.  Only verbs actually observed render, so an idle server's
+  // exposition stays lean.
+  out << "# HELP edl_coord_verb_seconds request latency by verb\n"
+      << "# TYPE edl_coord_verb_seconds histogram\n";
+  for (size_t i = 0; i < kNVerbs; ++i) {
+    VerbHist& h = g_verb_hists[i];
+    const int64_t count = h.count.load();
+    if (count == 0) continue;
+    int64_t cum = 0;
+    for (size_t b = 0; b < kNVerbBuckets; ++b) {
+      cum += h.buckets[b].load();
+      std::ostringstream le;
+      le << kVerbBucketsS[b];
+      out << "edl_coord_verb_seconds_bucket{verb=\"" << h.name
+          << "\",le=\"" << le.str() << "\"} " << cum << "\n";
+    }
+    out << "edl_coord_verb_seconds_bucket{verb=\"" << h.name
+        << "\",le=\"+Inf\"} " << count << "\n";
+    out << "edl_coord_verb_seconds_sum{verb=\"" << h.name << "\"} "
+        << (static_cast<double>(h.sum_us.load()) / 1e6) << "\n";
+    out << "edl_coord_verb_seconds_count{verb=\"" << h.name << "\"} "
+        << count << "\n";
+  }
   return out.str();
 }
 
@@ -1020,7 +1510,50 @@ void ServeHealth(int fd) {
   close(fd);
 }
 
+// Connection state shared between the reader thread and any off-thread
+// tagged park verbs: responses serialize on write_mu, the fd closes only
+// when the last holder drops (a detached park thread must never write to
+// a recycled descriptor).
+struct ConnState {
+  explicit ConnState(int fd_in) : fd(fd_in) {}
+  ~ConnState() { close(fd); }
+  int fd;
+  std::mutex write_mu;
+  std::atomic<bool> closed{false};
+  std::atomic<int> inflight{0};
+
+  bool WriteLine(const std::string& resp) {
+    std::lock_guard<std::mutex> lk(write_mu);
+    if (closed.load()) return false;
+    size_t off = 0;
+    while (off < resp.size()) {
+      ssize_t w = write(fd, resp.data() + off, resp.size() - off);
+      if (w <= 0) {
+        closed.store(true);
+        return false;
+      }
+      off += static_cast<size_t>(w);
+    }
+    return true;
+  }
+};
+
+//: off-thread tagged parks per connection; beyond this the request is
+//: handled inline (backpressure), which a well-behaved mux client never
+//: hits (its chunked parks are ~1 per member slot)
+constexpr int kMaxConnParks = 1024;
+
+// Park verbs block their handling thread; a TAGGED one runs off-thread
+// so a multiplexed connection carrying interleaved requests for many
+// member slots is never head-of-line-blocked behind a parked wait.
+// READ counts too: its version gate can park, and so can its inner verb.
+bool IsParkVerb(const std::string& cmd) {
+  return cmd == "WAITEPOCH" || cmd == "KVWAIT" || cmd == "KVWAITNE" ||
+         cmd == "READ";
+}
+
 void Serve(int fd) {
+  auto st = std::make_shared<ConnState>(fd);
   std::string buf;
   char chunk[4096];
   for (;;) {
@@ -1032,19 +1565,37 @@ void Serve(int fd) {
       std::string line = buf.substr(0, pos);
       buf.erase(0, pos + 1);
       if (!line.empty() && line.back() == '\r') line.pop_back();
-      std::string resp = Handle(line) + "\n";
-      size_t off = 0;
-      while (off < resp.size()) {
-        ssize_t w = write(fd, resp.data() + off, resp.size() - off);
-        if (w <= 0) {
-          close(fd);
-          return;
+      // multiplex framing: "#<id> <verb...>" answers "#<id> <reply...>";
+      // tagged responses may interleave (that is the contract a mux
+      // client opts into), plain pipelined lines stay strictly in-order
+      std::string tag, cmdline = line;
+      if (!line.empty() && line[0] == '#') {
+        const size_t sp = line.find(' ');
+        if (sp != std::string::npos && sp > 1) {
+          tag = line.substr(0, sp);
+          cmdline = line.substr(sp + 1);
         }
-        off += static_cast<size_t>(w);
+      }
+      const std::string cmd = cmdline.substr(0, cmdline.find(' '));
+      if (!tag.empty() && IsParkVerb(cmd) &&
+          st->inflight.load() < kMaxConnParks) {
+        st->inflight.fetch_add(1);
+        std::thread([st, tag, cmdline]() {
+          st->WriteLine(tag + " " + Handle(cmdline) + "\n");
+          st->inflight.fetch_sub(1);
+        }).detach();
+        continue;
+      }
+      const std::string resp =
+          (tag.empty() ? "" : tag + " ") + Handle(cmdline) + "\n";
+      if (!st->WriteLine(resp)) {
+        shutdown(fd, SHUT_RDWR);
+        return;  // ~ConnState closes the fd once park threads finish
       }
     }
   }
-  close(fd);
+  st->closed.store(true);
+  shutdown(fd, SHUT_RDWR);
 }
 
 }  // namespace
@@ -1094,6 +1645,10 @@ int main(int argc, char** argv) {
   // command like PING must never replace an unloadable file the operator
   // may still want to inspect with an empty snapshot.
   g_persisted_version.store(g_service->DurableVersion());
+  // op-log head starts at the restored position: the first stream to any
+  // replica is necessarily a checkpoint (nothing retained), deltas flow
+  // from the first captured mutation after that
+  g_log_to = g_service->StreamVersion();
   if (!state_file.empty() && !restored &&
       access(state_file.c_str(), F_OK) == 0) {
     // a present-but-unloadable file is a serious event — start fresh (a
@@ -1249,8 +1804,17 @@ int main(int argc, char** argv) {
       usleep(static_cast<useconds_t>(
           std::max<int64_t>(g_repl_lease_ms / 3, 100) * 1000));
       if (g_role.load() != kPrimary) continue;
+      // TTL-expiry sweep: liveness truth must not depend on client
+      // traffic reaching the primary — with follower reads spreading
+      // MEMBERS/WAITEPOCH onto the standbys (which never sweep), a
+      // fully-offloaded read path would otherwise keep a dead member
+      // alive forever and no parked wait would ever reform around it.
+      const int64_t e0 = g_service->membership.Epoch();
+      g_service->membership.Members(NowMs());
+      MaybePersist();  // a swept bump is durable+mirrored like any other
       StreamToReplicas();
       EnsureLease();
+      if (g_service->membership.Epoch() != e0) NotifyWaiters();
     }
   }).detach();
 

@@ -10,6 +10,7 @@ reference docker/paddle_k8s:30) so a dead trainer's work flows to the living.
 from __future__ import annotations
 
 import enum
+import functools
 import threading
 import time
 from collections import deque
@@ -22,6 +23,26 @@ DEFAULT_MEMBER_TTL_MS = 15_000
 #: how stale the replication lease may go before a primary re-verifies
 #: its claim against the standbys (doc/coordinator_ha.md)
 DEFAULT_REPL_LEASE_S = 3.0
+#: op-log records retained for delta replication; a replica further
+#: behind than this gets a compaction checkpoint (native kOpLogCap twin)
+OPLOG_CAP = 8192
+#: per-verb latency buckets, matched to the native server's
+#: kVerbBucketsS so edl_coord_verb_seconds merges across backends
+VERB_SECONDS_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
+                        0.05, 0.1, 0.25, 0.5, 1.0, 2.5)
+
+
+def _hx(b: bytes) -> str:
+    """Binary field framing shared by snapshots and delta records: empty
+    frames as "-" (a bare trailing space would be dropped by the stream
+    parser)."""
+    return b.hex() if b else "-"
+
+
+class CoordBehind(RuntimeError):
+    """A version-gated follower read could not be served: this mirror's
+    applied stream position is still below the client's read floor after
+    the park budget.  The caller redirects to the primary."""
 
 
 class CoordFenced(RuntimeError):
@@ -144,6 +165,23 @@ class PyCoordService:
         self.repl_syncs = 0
         self.repl_errors = 0
         self.promotions = 0
+        # log-structured delta replication (doc/coordinator_scale.md):
+        # bounded op log of (stream position, framed record); _replicate
+        # ships a mirror the records covering (its position, head] and
+        # falls back to a compaction checkpoint whenever the log cannot
+        # prove contiguity — deltas are a wire-bytes optimization, never
+        # a correctness dependency
+        self._oplog: deque[tuple[int, str]] = deque()
+        self.repl_bytes = 0
+        self.repl_deltas = 0
+        self.repl_checkpoints = 0
+        self.follower_reads = 0
+        #: thread-local follower-read admission (see follower_read):
+        #: while set, _check_serving admits read verbs on a non-primary
+        #: and the TTL-sweep sites stay quiet (a mirror sees no
+        #: heartbeats; sweeping would fabricate epoch bumps)
+        self._follower_tls = threading.local()
+        self._verb_hist = None  # set by register_metrics
 
     def member_ttl_ms(self) -> int:
         return self._ttl_ms
@@ -156,10 +194,40 @@ class PyCoordService:
         with self._lock:
             return self._version_base + self._version
 
-    def _bump(self) -> None:
+    def _bump(self, record: Optional[str] = None) -> None:
         """A snapshot-visible field changed (native DurableVersion twin);
-        caller holds the lock."""
+        caller holds the lock.  ``record`` is the framed op-log record
+        replaying this exact mutation on a mirror — a bump WITHOUT one
+        (restore paths) breaks log contiguity, so the log drops and the
+        next stream to every behind replica is a compaction checkpoint."""
         self._version += 1
+        if record is None:
+            self._oplog.clear()
+        else:
+            self._oplog.append((self._version_base + self._version, record))
+            while len(self._oplog) > OPLOG_CAP:
+                self._oplog.popleft()
+
+    def _in_follower_read(self) -> bool:
+        return getattr(self._follower_tls, "active", False)
+
+    @staticmethod
+    def _timed(verb: str):
+        """Per-verb latency observation (edl_coord_verb_seconds twin);
+        near-zero cost until register_metrics arms the histogram."""
+        def deco(fn):
+            @functools.wraps(fn)
+            def wrapped(self, *args, **kwargs):
+                hist = self._verb_hist
+                if hist is None:
+                    return fn(self, *args, **kwargs)
+                t0 = time.perf_counter()
+                try:
+                    return fn(self, *args, **kwargs)
+                finally:
+                    hist.observe(time.perf_counter() - t0, verb=verb)
+            return wrapped
+        return deco
 
     def _check_serving(self) -> None:
         """Fencing gate, called (lock held) before serving any verb: a
@@ -167,6 +235,12 @@ class PyCoordService:
         went stale re-verifies its claim first — so a GC-paused-then-
         resumed primary discovers its deposition BEFORE handing a client
         stale epoch/KV state."""
+        if self._in_follower_read():
+            # version-gated read: admissible from ANY role under the
+            # fence+min-version token the caller presented (native READ
+            # twin — the lease gate is skipped too; staleness is bounded
+            # by the version gate, not the lease)
+            return
         if self.role != "primary":
             self.fencing_rejects += 1
             raise CoordFenced(
@@ -219,14 +293,31 @@ class PyCoordService:
         self._self_fence()
         return True
 
+    def _delta_blob(self, from_v: int, to_v: int) -> Optional[str]:
+        """The EDLDELTA1 blob covering ``(from_v, to_v]``, or None when
+        the op log cannot prove contiguity (trimmed past ``from_v``, or
+        a record-less bump dropped it) — the caller then ships a
+        compaction checkpoint.  Caller holds the lock."""
+        if from_v < 0 or from_v >= to_v or not self._oplog:
+            return None
+        if self._oplog[0][0] > from_v + 1 or self._oplog[-1][0] != to_v:
+            return None
+        lines = [f"EDLDELTA1 {from_v} {to_v}"]
+        lines += [rec for pos, rec in self._oplog if pos > from_v]
+        return "\n".join(lines) + "\n.\n"
+
     def _replicate(self) -> None:
-        """Stream the current snapshot to every replica (lock held; runs
+        """Stream the current state to every replica (lock held; runs
         after the mutation, before the caller's return — the in-process
         equivalent of the native server's persist-then-replicate-then-ack
-        pipeline).  An unreachable replica degrades, a replica holding a
-        newer fence deposes us: the mutation stays applied locally but
-        the caller sees :class:`CoordFenced` instead of an ack, exactly
-        the at-least-once contract a retried client op expects."""
+        pipeline) — as the op-log DELTA covering (replica position, head]
+        when the log proves contiguity, else as a full compaction
+        checkpoint (the PR 7 snapshot stream; also the fallback when a
+        mirror rejects a delta as behind/torn).  An unreachable replica
+        degrades, a replica holding a newer fence deposes us: the
+        mutation stays applied locally but the caller sees
+        :class:`CoordFenced` instead of an ack, exactly the
+        at-least-once contract a retried client op expects."""
         if not self._replicas or self.role != "primary":
             return
         sv = self._version_base + self._version
@@ -234,15 +325,38 @@ class PyCoordService:
                   if self._repl_acked.get(id(r), -1) < sv]
         if not behind:
             return
-        blob = self.snapshot(include_members=True)
+        ckpt: Optional[str] = None  # built lazily: most rounds ship deltas
         any_ok = False
         for replica in behind:
+            blob = self._delta_blob(self._repl_acked.get(id(replica), -1),
+                                    sv)
+            is_delta = blob is not None
+            if blob is None:
+                if ckpt is None:
+                    ckpt = self.snapshot(include_members=True)
+                blob = ckpt
             try:
-                replica.sync_from(self.fence, sv, blob)
+                try:
+                    replica.sync_from(self.fence, sv, blob)
+                except ValueError:
+                    if not is_delta:
+                        raise
+                    # reachable but couldn't apply the delta (behind /
+                    # torn): fall back to a checkpoint NOW — leaving the
+                    # mirror behind would be a silent redundancy hole
+                    if ckpt is None:
+                        ckpt = self.snapshot(include_members=True)
+                    blob, is_delta = ckpt, False
+                    replica.sync_from(self.fence, sv, blob)
                 # per-replica position: one mirror missing a stream
                 # (while another acked) still gets its catch-up later
                 self._repl_acked[id(replica)] = sv
                 any_ok = True
+                self.repl_bytes += len(blob)
+                if is_delta:
+                    self.repl_deltas += 1
+                else:
+                    self.repl_checkpoints += 1
             except CoordFenced as exc:
                 if not self._deposed_by(exc):
                     continue  # stale rejector, not a deposition
@@ -275,9 +389,14 @@ class PyCoordService:
                 self._replicate()
 
     def sync_from(self, fence: int, version: int, blob: str) -> int:
-        """Standby side of the stream: apply the primary's snapshot.
-        Rejects (with the newer token) a stream whose fence is stale —
-        the split-brain door a deposed primary knocks on."""
+        """Standby side of the stream: apply the primary's snapshot
+        (EDLCOORD1 compaction checkpoint, clear-then-restore) or op-log
+        delta (EDLDELTA1, applied only when contiguous with the position
+        this mirror holds).  Rejects (with the newer token) a stream
+        whose fence is stale — the split-brain door a deposed primary
+        knocks on — and raises ValueError for a torn blob (position
+        never ratchets) or a non-contiguous delta (the primary falls
+        back to a checkpoint)."""
         with self._lock:
             if self.role == "primary":
                 if fence == self.fence:
@@ -295,21 +414,126 @@ class PyCoordService:
                 raise CoordFenced(
                     f"stale stream fence {fence} (ours {self.fence})",
                     fence=self.fence)
-            if not self._restore(blob, clear=True, with_members=True):
+            if blob.startswith("EDLDELTA1 "):
+                self._apply_delta(blob)  # raises ValueError: torn/behind
+            elif not self._restore(blob, clear=True, with_members=True):
                 # a torn blob must not ratchet the fence or advertise a
                 # position this node does not hold (the native twin
                 # answers ERR badblob); the primary counts a repl error
                 self.repl_errors += 1
                 raise ValueError("torn replication blob rejected")
+            else:
+                self._version_base = version - self._version
             self.fence = max(self.fence, fence)
             if self.role == "fenced":
                 # a self-fenced ex-primary accepting a stream is provably
                 # a mirror again: regain standby status (and real
                 # redundancy for the pair)
                 self.role = "standby"
-            self._version_base = version - self._version
+            # the mirror's own op log is meaningless until promoted (its
+            # positions were never streamed from); keep it empty so a
+            # fresh primary starts from a checkpoint
+            self._oplog.clear()
             self.repl_syncs += 1
+            self._cond.notify_all()  # wake version-gated follower reads
             return self._version_base + self._version
+
+    def _apply_delta(self, blob: str) -> None:
+        """Apply an EDLDELTA1 op-log blob (lock held).  Contiguity and
+        framing are validated BEFORE any record applies; an unreplayable
+        record mid-blob (diverged mirror) zeroes this node's claimed
+        position — promotion must prefer its peers until the checkpoint
+        fallback lands."""
+        if not blob.endswith("\n.\n"):
+            self.repl_errors += 1
+            raise ValueError("torn delta blob rejected")
+        header, _, body = blob.partition("\n")
+        parts = header.split(" ")
+        try:
+            from_v, to_v = int(parts[1]), int(parts[2])
+        except (IndexError, ValueError):
+            self.repl_errors += 1
+            raise ValueError("torn delta blob rejected") from None
+        if from_v >= to_v:
+            self.repl_errors += 1
+            raise ValueError("torn delta blob rejected")
+        if self._version_base + self._version != from_v:
+            raise ValueError(
+                f"behind: delta starts at {from_v}, mirror holds "
+                f"{self._version_base + self._version}")
+
+        def unhex(tok: str) -> bytes:
+            return b"" if tok in ("-", "") else bytes.fromhex(tok)
+
+        now = self._clock()
+        try:
+            for line in body.splitlines():
+                if not line or line == ".":
+                    continue
+                tag, _, rest = line.partition(" ")
+                args = rest.split(" ") if rest else []
+                if tag == "K":
+                    self._kv[bytes.fromhex(args[0]).decode()] = \
+                        unhex(args[1]) if len(args) > 1 else b""
+                elif tag == "k":
+                    self._kv.pop(bytes.fromhex(args[0]).decode(), None)
+                elif tag == "J":
+                    name = bytes.fromhex(args[0]).decode()
+                    addr = (unhex(args[1]).decode()
+                            if len(args) > 1 else "")
+                    prev = self._members.get(name)
+                    if prev is None or prev[0] != addr:
+                        self._epoch += 1
+                    self._members[name] = (addr, now + self._ttl_ms)
+                elif tag == "L":
+                    if self._members.pop(
+                            bytes.fromhex(args[0]).decode(),
+                            None) is not None:
+                        self._epoch += 1
+                elif tag == "X":
+                    # expiry batch: N removals under ONE epoch bump
+                    for hexname in args[0].split(","):
+                        self._members.pop(bytes.fromhex(hexname).decode(),
+                                          None)
+                    self._epoch += 1
+                elif tag == "A":
+                    t = _Task(int(args[0]),
+                              unhex(args[1]) if len(args) > 1 else b"")
+                    self._todo.append(t)
+                    self._next_id = max(self._next_id, t.id + 1)
+                elif tag == "C":
+                    self._replay_move(int(args[0]), done=True)
+                elif tag == "F":
+                    self._replay_move(int(args[0]), done=False)
+                elif tag == "R":
+                    self._maybe_advance_pass()
+                # unknown tags: forward compatibility, skip
+        except (IndexError, ValueError, KeyError):
+            # a prefix may have applied: this mirror is dirty — stop
+            # claiming the old position (native twin: ERR badblob after
+            # zeroing) until the checkpoint fallback restores it
+            self._version_base = -self._version
+            self.repl_errors += 1
+            raise ValueError("unreplayable delta record rejected") \
+                from None
+        self._version_base = to_v - self._version
+
+    def _replay_move(self, task_id: int, done: bool) -> None:
+        """Replay a task transition on the mirror: the mirror never
+        tracks leases (snapshots serialize leased-as-todo), so C/F
+        records move/mutate the task by id in todo."""
+        for i, t in enumerate(self._todo):
+            if t.id == task_id:
+                if done:
+                    del self._todo[i]
+                    self._done.append(t)
+                else:
+                    t.failures += 1
+                    if t.failures >= self._max_failures:
+                        del self._todo[i]
+                        self._dropped += 1
+                return
+        raise KeyError(f"task {task_id} not in mirror todo")
 
     def repl_heartbeat(self, fence: int) -> int:
         """Replication lease probe (primary → standby)."""
@@ -453,16 +677,18 @@ class PyCoordService:
 
     # -- task queue --------------------------------------------------------
 
+    @_timed("ADD")
     def add_task(self, payload: bytes) -> int:
         with self._lock:
             self._check_serving()
             t = _Task(self._next_id, bytes(payload))
             self._next_id += 1
             self._todo.append(t)
-            self._bump()
+            self._bump(f"A {t.id} {_hx(t.payload)}")
             self._replicate()
             return t.id
 
+    @_timed("LEASE")
     def lease(self, worker: str) -> tuple[LeaseStatus, int, bytes]:
         now = self._clock()
         with self._lock:
@@ -479,6 +705,7 @@ class PyCoordService:
             self._replicate()
             return (LeaseStatus.OK, t.id, t.payload)
 
+    @_timed("COMPLETE")
     def complete(self, task_id: int, worker: Optional[str] = None) -> bool:
         """Mark a leased task done.  If ``worker`` is given, the completion
         is rejected unless that worker still holds the lease — so a timed-out
@@ -492,11 +719,13 @@ class PyCoordService:
                 return False  # lease moved to another worker
             del self._leased[task_id]
             self._done.append(leased.task)
-            self._bump()  # pending→done is a snapshot-visible move
+            # pending→done is a snapshot-visible move
+            self._bump(f"C {task_id}")
             self._maybe_advance_pass()
             self._replicate()
             return True
 
+    @_timed("FAIL")
     def fail(self, task_id: int, worker: Optional[str] = None) -> bool:
         with self._lock:
             self._check_serving()
@@ -512,7 +741,8 @@ class PyCoordService:
                 self._dropped += 1  # poison pill: drop, don't wedge the pass
             else:
                 self._todo.append(t)
-            self._bump()  # failure count / dropped counter changed
+            # failure count / dropped counter changed
+            self._bump(f"F {task_id}")
             self._maybe_advance_pass()
             self._replicate()
             return True
@@ -553,6 +783,7 @@ class PyCoordService:
             self._check_serving()
             return self._pass
 
+    @_timed("STATS")
     def stats(self) -> QueueStats:
         with self._lock:
             self._check_serving()
@@ -583,10 +814,11 @@ class PyCoordService:
                 self._pass = self._total_passes - 1
             # reached from lease() too: a rollover must stream/persist
             # even though LEASE itself is not a mutating command
-            self._bump()
+            self._bump("R")
 
     # -- membership --------------------------------------------------------
 
+    @_timed("JOIN")
     def join(self, name: str, address: str = "") -> int:
         now = self._clock()
         with self._lock:
@@ -596,11 +828,13 @@ class PyCoordService:
             self._members[name] = (address, now + self._ttl_ms)
             if change:
                 self._epoch += 1
-                self._bump()
+                self._bump(f"J {name.encode().hex()} "
+                           f"{_hx(address.encode())}")
                 self._cond.notify_all()
             self._replicate()
             return self._epoch
 
+    @_timed("HB")
     def heartbeat(self, name: str) -> bool:
         now = self._clock()
         with self._lock:
@@ -611,13 +845,14 @@ class PyCoordService:
             self._members[name] = (addr, now + self._ttl_ms)
             return True
 
+    @_timed("LEAVE")
     def leave(self, name: str) -> bool:
         with self._lock:
             self._check_serving()
             if self._members.pop(name, None) is None:
                 return False
             self._epoch += 1
-            self._bump()
+            self._bump(f"L {name.encode().hex()}")
             self._cond.notify_all()
             self._replicate()
             return True
@@ -631,7 +866,9 @@ class PyCoordService:
                 del self._members[n]
             if dead:
                 self._epoch += 1
-                self._bump()
+                # one batch record, one epoch bump on the mirror too
+                self._bump("X " + ",".join(n.encode().hex()
+                                           for n in dead))
                 self._cond.notify_all()
             self._replicate()
             return len(dead)
@@ -656,6 +893,7 @@ class PyCoordService:
     #: detection latency only; actual mutations wake waiters instantly
     WAIT_RECHECK_S = 0.05
 
+    @_timed("WAITEPOCH")
     def wait_epoch(self, known_epoch: int, timeout_s: float) -> int:
         """Block until the membership epoch differs from ``known_epoch``
         or ``timeout_s`` elapses; returns the current epoch either way."""
@@ -666,7 +904,10 @@ class PyCoordService:
                 # a wait that outlives this node's primacy must not hand
                 # the waiter a stale epoch (_self_fence notifies the cond)
                 self._check_serving()
-                self.expire_members()  # TTL truth, like MEMBERS' sweep
+                if not self._in_follower_read():
+                    # TTL truth, like MEMBERS' sweep; a follower read
+                    # never sweeps (its mirror sees no heartbeats)
+                    self.expire_members()
                 if self._epoch != known_epoch:
                     if parked:
                         self.longpolls_fired += 1
@@ -679,6 +920,7 @@ class PyCoordService:
                     self.longpolls_parked += 1
                 self._cond.wait(min(remaining, self.WAIT_RECHECK_S))
 
+    @_timed("KVWAIT")
     def kv_wait(self, key: str, timeout_s: float,
                 known_epoch: Optional[int] = None
                 ) -> tuple[Optional[bytes], Optional[int]]:
@@ -690,7 +932,8 @@ class PyCoordService:
         with self._cond:
             while True:
                 self._check_serving()  # see wait_epoch
-                self.expire_members()
+                if not self._in_follower_read():
+                    self.expire_members()
                 v = self._kv.get(key)
                 if v is not None:
                     if parked:
@@ -708,12 +951,127 @@ class PyCoordService:
                     self.longpolls_parked += 1
                 self._cond.wait(min(remaining, self.WAIT_RECHECK_S))
 
-    def server_metrics(self) -> dict:
-        """Op counters, shape-matched to CoordClient.server_metrics()."""
+    @_timed("KVWAITNE")
+    def kv_wait_changed(self, key: str, old: Optional[bytes],
+                        timeout_s: float
+                        ) -> tuple[bool, Optional[bytes]]:
+        """Block until ``key``'s value differs from ``old`` (``None`` =
+        currently absent, so appearance fires) or the timeout lapses.
+        Returns ``(True, new_value)`` on a change, ``(True, None)`` when
+        the key was deleted, ``(False, None)`` on timeout — the KVWAITNE
+        twin the serving weight watcher long-polls on."""
+        deadline = time.monotonic() + max(timeout_s, 0.0)
+        parked = False
+        with self._cond:
+            while True:
+                self._check_serving()
+                if not self._in_follower_read():
+                    self.expire_members()
+                v = self._kv.get(key)
+                if v is not None and (old is None or bytes(v) != old):
+                    if parked:
+                        self.longpolls_fired += 1
+                    return True, bytes(v)
+                if v is None and old is not None:
+                    if parked:
+                        self.longpolls_fired += 1
+                    return True, None  # deleted counts as a change
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False, None
+                if not parked:
+                    parked = True
+                    self.longpolls_parked += 1
+                self._cond.wait(min(remaining, self.WAIT_RECHECK_S))
+
+    # -- follower reads ------------------------------------------------------
+
+    def follower_read(self, fence: int, min_version: int,
+                      timeout_s: float = 2.0):
+        """Version-gated read admission on a mirror (the native READ
+        verb's in-process twin — doc/coordinator_scale.md): a context
+        manager under which read verbs are served from ANY role, once
+        this node has seen the caller's fencing regime (``fence``) and
+        applied at least the caller's read floor (``min_version``, the
+        stream position its last write acked at).  A stale mirror parks
+        until its replication stream catches up (``sync_from`` notifies)
+        and raises :class:`CoordBehind` past ``timeout_s`` — the caller
+        then redirects to the primary.  Read-your-writes holds by
+        construction; TTL sweeps stay off (a mirror sees no heartbeats).
+
+        ::
+
+            with standby.follower_read(fence, floor):
+                value = standby.kv_get("goodput-curve/job")
+        """
+        svc = self
+
+        class _Admission:
+            def __enter__(self):
+                with svc._cond:
+                    if fence > svc.fence:
+                        svc.fencing_rejects += 1
+                        raise CoordFenced(
+                            f"stale: mirror fence {svc.fence} has not "
+                            f"seen regime {fence}", fence=svc.fence)
+                    deadline = time.monotonic() + max(timeout_s, 0.0)
+                    while (svc._version_base + svc._version
+                           < min_version):
+                        remaining = deadline - time.monotonic()
+                        if remaining <= 0:
+                            raise CoordBehind(
+                                f"mirror at "
+                                f"{svc._version_base + svc._version} < "
+                                f"read floor {min_version}")
+                        svc._cond.wait(min(remaining,
+                                           svc.WAIT_RECHECK_S))
+                    svc.follower_reads += 1
+                svc._follower_tls.active = True
+                return svc
+
+            def __exit__(self, *exc) -> None:
+                svc._follower_tls.active = False
+
+        return _Admission()
+
+    @_timed("KEEPALIVE")
+    def heartbeat_many(self, names) -> dict:
+        """Coalesced heartbeat batch (the KEEPALIVE verb's twin): renew
+        every named member in ONE request; returns name -> renewed.  A
+        False entry means the member expired and must re-join — exactly
+        the per-name ERR-rejoin contract, batched."""
+        now = self._clock()
         with self._lock:
+            self._check_serving()
+            out = {}
+            for name in names:
+                entry = self._members.get(name)
+                if entry is None:
+                    out[name] = False
+                else:
+                    self._members[name] = (entry[0], now + self._ttl_ms)
+                    out[name] = True
+            return out
+
+    def server_metrics(self) -> dict:
+        """Op counters, shape-matched to CoordClient.server_metrics().
+        ``snapshot_bytes`` is an O(store) serialization, recomputed at
+        most once per 5 s (native METRICS twin) — a metrics poller must
+        not hold the verb lock for a full-store walk every call."""
+        with self._lock:
+            now = time.monotonic()
+            cached = getattr(self, "_snap_bytes_cache", None)
+            if cached is None or now - cached[0] > 5.0:
+                cached = (now, len(self.snapshot(include_members=True)))
+                self._snap_bytes_cache = cached
             return {"requests_served": self.requests_served,
                     "longpolls_parked": self.longpolls_parked,
-                    "longpolls_fired": self.longpolls_fired}
+                    "longpolls_fired": self.longpolls_fired,
+                    "repl_bytes": self.repl_bytes,
+                    "repl_deltas": self.repl_deltas,
+                    "repl_checkpoints": self.repl_checkpoints,
+                    "snapshot_bytes": cached[1],
+                    "follower_reads": self.follower_reads}
 
     def register_metrics(self, registry=None) -> None:
         """Expose this service's live state on a
@@ -778,40 +1136,67 @@ class PyCoordService:
                             help="replication exchanges that failed")
         registry.counter_fn("coord_promotions", lambda: self.promotions,
                             help="standby-to-primary promotions")
+        # log-structured replication accounting + follower reads,
+        # name-matched to the native /metrics exposition
+        registry.counter_fn("coord_repl_bytes", lambda: self.repl_bytes,
+                            help="replication wire bytes streamed "
+                                 "(deltas + checkpoints)")
+        registry.counter_fn("coord_repl_deltas",
+                            lambda: self.repl_deltas,
+                            help="replication exchanges shipped as "
+                                 "op-log deltas")
+        registry.counter_fn("coord_repl_checkpoints",
+                            lambda: self.repl_checkpoints,
+                            help="replication exchanges shipped as "
+                                 "compaction checkpoints")
+        registry.counter_fn("coord_follower_reads",
+                            lambda: self.follower_reads,
+                            help="version-gated follower reads served")
+        # per-verb latency histogram (native edl_coord_verb_seconds
+        # twin); observation stays a no-op until this arms it
+        self._verb_hist = registry.histogram(
+            "coord_verb_seconds", help="request latency by verb",
+            buckets=VERB_SECONDS_BUCKETS)
 
+    @_timed("MEMBERS")
     def members(self) -> tuple[int, list[tuple[str, str]]]:
         """(epoch, [(name, address)]) name-sorted — this order IS the rank
         assignment (replacing IP-sort ranks, reference k8s_tools.py:113-121)."""
-        self.expire_members()
+        if not self._in_follower_read():
+            self.expire_members()
         with self._lock:
             out = sorted((n, a) for n, (a, _) in self._members.items())
             return self._epoch, out
 
     # -- kv ----------------------------------------------------------------
 
+    @_timed("KVSET")
     def kv_set(self, key: str, value: bytes) -> None:
         with self._lock:
             self._check_serving()
             self._kv[key] = bytes(value)
-            self._bump()
+            self._bump(f"K {key.encode().hex()} {_hx(value)}")
             self._cond.notify_all()
             self._replicate()
 
+    @_timed("KVGET")
     def kv_get(self, key: str) -> Optional[bytes]:
         with self._lock:
             self._check_serving()
             return self._kv.get(key)
 
+    @_timed("KVDEL")
     def kv_del(self, key: str) -> bool:
         with self._lock:
             self._check_serving()
             removed = self._kv.pop(key, None) is not None
             if removed:
-                self._bump()
+                self._bump(f"k {key.encode().hex()}")
                 self._cond.notify_all()
                 self._replicate()
             return removed
 
+    @_timed("KVCAS")
     def kv_cas(self, key: str, expect: bytes, value: bytes) -> bool:
         """Set iff current == expect (empty expect: must not exist) — the
         slot-claim primitive (role of etcd pserver slots)."""
@@ -824,11 +1209,14 @@ class PyCoordService:
             elif cur != expect:
                 return False
             self._kv[key] = bytes(value)
-            self._bump()
+            # a winning CAS replicates as a plain put: the mirror needs
+            # the outcome, not the race
+            self._bump(f"K {key.encode().hex()} {_hx(bytes(value))}")
             self._cond.notify_all()
             self._replicate()
             return True
 
+    @_timed("KEYS")
     def kv_keys(self, prefix: str = "") -> list[str]:
         with self._lock:
             self._check_serving()

@@ -201,6 +201,102 @@ class CoordDiscovery:
             wait_epoch_change(self._client, epoch, remaining, poll_s=poll_s)
 
 
+class BatchKeepalive:
+    """Coalesced heartbeats for EVERY member slot a supervisor host owns
+    (doc/coordinator_scale.md §multiplexing): one background thread, one
+    KEEPALIVE request per beat for N names — instead of N keepalive
+    threads each holding a socket and sending its own HB line.  This is
+    the request-count collapse the coordinator scale bench measures.
+
+    An expired name (reported back per-batch) is re-joined with its
+    registered address, unless an eviction marker names it — the same
+    rejoin/eviction contract as :meth:`CoordDiscovery.keepalive`, batched.
+    Against a pre-scale-out server the client degrades to individual HBs
+    transparently (same thread, same cadence)."""
+
+    def __init__(self, client, interval_s: float | None = None) -> None:
+        self._client = client
+        self._names: dict[str, str] = {}  # name -> address (for rejoin)
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        if interval_s is None:
+            from edl_tpu.coord.client import CoordError
+
+            try:
+                interval_s = max(client.member_ttl_ms() / 3000.0, 0.01)
+            except (AttributeError, OSError, CoordError):
+                interval_s = 5.0
+        self.interval_s = interval_s
+        self.beats = 0
+
+    def add(self, name: str, address: str = "") -> None:
+        with self._lock:
+            self._names[name] = address
+
+    def remove(self, name: str) -> None:
+        with self._lock:
+            self._names.pop(name, None)
+
+    def _evicted(self, name: str) -> bool:
+        kv_get = getattr(self._client, "kv_get", None)
+        if kv_get is None:
+            return False
+        try:
+            return kv_get(f"evict/{name}") is not None
+        except Exception:
+            return False  # coordinator unreachable ≠ evicted
+
+    def beat_once(self) -> int:
+        """One coalesced beat; returns how many names were renewed."""
+        from edl_tpu.coord.client import CoordError
+
+        with self._lock:
+            names = dict(self._names)
+        if not names:
+            return 0
+        try:
+            results = self._client.heartbeat_many(list(names))
+        except (OSError, CoordError):
+            return 0  # coordinator briefly unreachable; next beat rules
+        renewed = 0
+        for name, ok in results.items():
+            if ok:
+                renewed += 1
+                continue
+            # expired: rejoin under the eviction-marker rule
+            if self._evicted(name):
+                self.remove(name)
+                continue
+            try:
+                self._client.join(name, names.get(name, ""))
+            except (OSError, CoordError):
+                pass
+        self.beats += 1
+        return renewed
+
+    def start(self) -> "BatchKeepalive":
+        def loop() -> None:
+            while not self._stop.wait(self.interval_s):
+                self.beat_once()
+
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="batch-keepalive")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=self.interval_s + 1.0)
+
+    def __enter__(self) -> "BatchKeepalive":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+
 class PodDiscovery:
     """Reference-verb equivalents over a pod-listing backend."""
 

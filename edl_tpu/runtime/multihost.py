@@ -1391,6 +1391,16 @@ def run_elastic_worker(
     small hosts (measured: the join leg got 10 s WORSE with immediate
     respawn on a 1-core box).  A crash inside the delay window falls
     back to a cold spawn — the pre-warm-spawn behavior."""
+    # Connection multiplexing (doc/coordinator_scale.md): a harness
+    # hosting several member slots in one process passes the shared
+    # CoordMux and each supervisor takes a lightweight slot handle —
+    # one persistent connection per host instead of one per slot.  The
+    # handle pickles to the world children as a plain standalone client
+    # (sockets cannot cross processes).
+    from edl_tpu.coord.client import CoordMux
+
+    if isinstance(coord, CoordMux):
+        coord = coord.client()
     ew = ElasticWorld(coord, name, address=address, settle_s=settle_s)
     # Goodput ledger for this member slot: one chip-second per second,
     # attributed queued → productive/reform_dark/stall across the run

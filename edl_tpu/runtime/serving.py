@@ -1040,11 +1040,25 @@ class ServingFleet:
         self.rolling_reload(restored["params"], step)
         return step
 
-    def watch_lineage(self, checkpointer, poll_s: float = 5.0
-                      ) -> "_WeightWatcher":
-        """Background thread polling the lineage for new generations —
-        the deployed path's reload driver (``reload_poll_s``)."""
-        self._watcher = _WeightWatcher(self, checkpointer, poll_s)
+    def watch_lineage(self, checkpointer, poll_s: float = 5.0,
+                      scan_backstop: int = 1) -> "_WeightWatcher":
+        """Background thread watching for new weight generations — the
+        deployed path's reload driver (``reload_poll_s``).
+
+        With a coordinator wired (``kv=``), each cycle LONG-POLLS the
+        ``serving-gen/<job>`` key (KVWAITNE change-wait) instead of
+        sleeping: a published generation wakes the reload within
+        milliseconds instead of an average poll_s/2.  The checkpoint
+        lineage itself is still scanned every ``scan_backstop`` cycles
+        (default 1 = the pre-scale-out every-``poll_s`` cadence, so a
+        trainer that writes checkpoints WITHOUT publishing the KV key
+        reloads exactly as before); deployments whose trainers publish
+        the key can raise it and the skipped filesystem scans are
+        counted ``serving_lineage_polls_saved``.  Falls back to plain
+        sleep-polling against pre-scale-out servers or without a
+        coordinator."""
+        self._watcher = _WeightWatcher(self, checkpointer, poll_s,
+                                       scan_backstop=scan_backstop)
         self._watcher.start()
         return self._watcher
 
@@ -1066,24 +1080,87 @@ class ServingFleet:
             r.stop(drain=drain, timeout_s=self.drain_timeout_s)
 
 
+_UNSET = object()
+
+
 class _WeightWatcher(threading.Thread):
     def __init__(self, fleet: ServingFleet, checkpointer,
-                 poll_s: float) -> None:
+                 poll_s: float, scan_backstop: int = 1) -> None:
         super().__init__(name=f"serving-reload-{fleet.job}", daemon=True)
         self.fleet = fleet
         self.checkpointer = checkpointer
         self.poll_s = max(float(poll_s), 0.1)
+        self.scan_backstop = max(int(scan_backstop), 1)
         # NOT named _stop: threading.Thread owns a private _stop()
         # method, and shadowing it with an Event breaks Thread.join()
         self._halt = threading.Event()
+        self._no_longpoll = False
+        self._gen_key = SERVING_GEN_KEY.format(job=fleet.job)
+        # "never observed" must be distinct from "key absent" (None):
+        # re-reading the key each cycle would absorb a change BEFORE the
+        # wait could fire on it — the baseline only ever updates from
+        # the change-wait's own results
+        self._known: object = _UNSET
+
+    def _park(self) -> tuple[bool, bool]:
+        """One cycle's wait: long-poll the generation key when a
+        coordinator with the change-wait verb is wired, else sleep.
+        Returns ``(fired, longpolled)`` — ``fired`` when the key CHANGED
+        (reload signal), ``longpolled`` when a real change-wait watched
+        it (only then may the scan backstop skip lineage scans; a plain
+        sleep has no wake signal to compensate a skipped scan)."""
+        kv = self.fleet._kv
+        wait_changed = (getattr(kv, "kv_wait_changed", None)
+                        if kv is not None else None)
+        if wait_changed is None or self._no_longpoll:
+            self._halt.wait(self.poll_s)
+            return False, False
+        try:
+            if self._known is _UNSET:
+                self._known = kv.kv_get(self._gen_key)
+            fired, newv = wait_changed(self._gen_key, self._known,
+                                       self.poll_s)
+            if getattr(kv, "_no_waitne", False):
+                # pre-scale-out server: the client was sleep-polling the
+                # KV on our behalf, which is pure added load over plain
+                # lineage polling — drop to the legacy path for good
+                self._no_longpoll = True
+                return False, False
+            get_counters().inc("serving_lineage_longpolls",
+                               result="fired" if fired else "timeout")
+            if fired:
+                self._known = newv
+            return fired, True
+        except Exception as exc:
+            log.warn("lineage long-poll failed; sleeping this cycle",
+                     job=self.fleet.job, error=str(exc)[:120])
+            self._halt.wait(self.poll_s)
+            return False, False
 
     def run(self) -> None:
-        while not self._halt.wait(self.poll_s):
-            try:
-                self.fleet.reload_from_lineage(self.checkpointer)
-            except Exception as exc:  # keep watching; a bad gen is skipped
-                log.warn("lineage reload failed", job=self.fleet.job,
-                         error=str(exc)[:200])
+        cycles_since_scan = 0
+        while True:
+            fired, longpolled = self._park()
+            if self._halt.is_set():
+                return
+            cycles_since_scan += 1
+            # the backstop only gates scans a LIVE change-wait covers:
+            # without one (no coordinator, old server, a failed cycle)
+            # nothing would wake us for a new generation, so every
+            # cycle scans — the pre-scale-out cadence
+            backstop = self.scan_backstop if longpolled else 1
+            if fired or cycles_since_scan >= backstop:
+                cycles_since_scan = 0
+                try:
+                    self.fleet.reload_from_lineage(self.checkpointer)
+                except Exception as exc:  # keep watching; bad gen skipped
+                    log.warn("lineage reload failed", job=self.fleet.job,
+                             error=str(exc)[:200])
+            else:
+                # the KV signal said "nothing new": the filesystem scan a
+                # sleep-poller would have burned is skipped — the saved
+                # round-trip the long-poll switch exists for
+                get_counters().inc("serving_lineage_polls_saved")
 
     def stop(self) -> None:
         self._halt.set()
@@ -1230,7 +1307,13 @@ def serve_main(env=None) -> int:
     fleet.scale_to(1)
     poll_s = float(env.get("EDL_SERVING_RELOAD_POLL_S", "5"))
     if poll_s > 0:
-        fleet.watch_lineage(ckpt, poll_s)
+        # EDL_SERVING_SCAN_BACKSTOP > 1 trusts the serving-gen KV key as
+        # the reload signal and scans the lineage only every N cycles
+        # (for deployments whose trainers publish it); default 1 keeps
+        # the every-poll_s filesystem scan
+        fleet.watch_lineage(
+            ckpt, poll_s,
+            scan_backstop=int(env.get("EDL_SERVING_SCAN_BACKSTOP", "1")))
 
     health_port = int(env.get("EDL_HEALTH_PORT", "8080"))
     health = None

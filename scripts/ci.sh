@@ -303,6 +303,121 @@ EOF
 JAX_PLATFORMS=cpu python "$HA_TMP/ha_smoke.py"
 rm -rf "$HA_TMP"
 
+echo "== control-plane scale smoke (200 members, follower read, delta bytes)"
+# The coordinator scale-out tentpole (doc/coordinator_scale.md), small:
+# 200 simulated member slots form over ONE multiplexed connection per
+# simulated host with coalesced KEEPALIVE heartbeats; a follower serves
+# a version-gated read while the primary is SIGSTOPped; a crash reform
+# (SIGKILL) completes under a fixed budget with every slot re-confirmed
+# on the promoted standby; and replication bytes per KV put are asserted
+# O(delta) — an order of magnitude under the full-snapshot size the
+# pre-PR stream shipped per mutation — via the new METRICS counters.
+JAX_PLATFORMS=cpu python - <<'EOF'
+import signal, socket, tempfile, threading, time
+
+from edl_tpu.coord import CoordClient, CoordMux
+from edl_tpu.coord.server import spawn_server
+from edl_tpu.runtime.discovery import BatchKeepalive
+
+N, HOSTS = 200, 2
+
+def metrics(port):
+    with socket.create_connection(("127.0.0.1", port), timeout=5) as s:
+        s.settimeout(5)
+        s.sendall(b"METRICS\n")
+        r = s.makefile("rb").readline().decode().strip().split(" ")
+    keys = ("requests", "parked", "fired", "repl_bytes", "repl_deltas",
+            "repl_ckpts", "snapshot_bytes", "follower_reads")
+    return {k: int(r[i + 1]) for i, k in enumerate(keys) if len(r) > i + 1}
+
+tmp = tempfile.mkdtemp(prefix="edl-ci-coordscale-")
+sb = spawn_server(standby=True, state_file=tmp + "/b.state")
+pr = spawn_server(state_file=tmp + "/a.state",
+                  replicate_to=f"127.0.0.1:{sb.port}", repl_lease_ms=1000)
+muxes = [CoordMux("127.0.0.1", pr.port, timeout=5.0,
+                  reconnect_window_s=20.0, promote_grace_s=0.3,
+                  endpoints=[("127.0.0.1", sb.port)])
+         for _ in range(HOSTS)]
+kas = []
+try:
+    # formation: one mux per host, coalesced keepalives
+    per = N // HOSTS
+    for h, mux in enumerate(muxes):
+        c = mux.client()
+        ka = BatchKeepalive(c, interval_s=1.0)
+        for i in range(h * per, (h + 1) * per):
+            c.join(f"m{i}", f"a{i}")
+            ka.add(f"m{i}", f"a{i}")
+        kas.append(ka)
+    c0 = muxes[0].client()
+    assert c0.epoch() == N
+    m0 = metrics(pr.port)
+    for ka in kas:
+        assert ka.beat_once() == per
+    m1 = metrics(pr.port)
+    hb_reqs = m1["requests"] - m0["requests"] - 1
+    assert hb_reqs <= HOSTS + 1, hb_reqs  # N heartbeats in HOSTS lines
+
+    # O(delta) replication bytes per KV put vs the snapshot the pre-PR
+    # stream would have shipped for EACH of these mutations
+    for i in range(20):
+        c0.kv_set(f"ci/k{i}", b"x" * 32)
+    m2 = metrics(pr.port)
+    per_put = (m2["repl_bytes"] - m1["repl_bytes"]) / 20
+    assert m2["repl_deltas"] >= 20, m2
+    assert per_put * 10 < m2["snapshot_bytes"], (per_put, m2)
+
+    # follower read while the primary is FROZEN: the version-gated READ
+    # is served from the standby's applied stream position
+    cf = CoordClient("127.0.0.1", pr.port, timeout=2.0,
+                     reconnect_window_s=10.0,
+                     endpoints=[("127.0.0.1", sb.port)],
+                     follower_reads=True)
+    assert cf.kv_get("ci/k0") == b"x" * 32  # learn the follower path
+    pr.process.send_signal(signal.SIGSTOP)
+    time.sleep(0.1)
+    t0 = time.monotonic()
+    assert cf.kv_get("ci/k1") == b"x" * 32
+    frozen_read_s = time.monotonic() - t0
+    assert frozen_read_s < 1.0, frozen_read_s
+    pr.process.send_signal(signal.SIGCONT)
+    fr = metrics(sb.port)["follower_reads"]
+    assert fr >= 2, fr
+    cf.close()
+
+    # crash reform under budget: kill the primary; every host's mux
+    # fails over (promoting the standby) and re-confirms all its slots
+    pr.process.send_signal(signal.SIGKILL)
+    pr.process.wait(timeout=10)
+    t0 = time.monotonic()
+    def recover(h):
+        muxes[h].client().kv_get("ci/k0")
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if kas[h].beat_once() == per:
+                return
+            time.sleep(0.05)
+        raise TimeoutError(f"host {h} never recovered")
+    ts = [threading.Thread(target=recover, args=(h,))
+          for h in range(HOSTS)]
+    for t in ts: t.start()
+    for t in ts: t.join()
+    reform_s = time.monotonic() - t0
+    assert reform_s < 10.0, reform_s
+    assert muxes[0].client().epoch() == N  # zero rejoin churn
+    print(f"control-plane scale smoke OK: members={N} "
+          f"hb_requests_per_beat={hb_reqs} repl_bytes_per_put={per_put:.0f} "
+          f"snapshot_bytes={m2['snapshot_bytes']} "
+          f"follower_reads={fr} reform_s={reform_s:.2f}")
+finally:
+    for ka in kas:
+        ka.stop()
+    for mux in muxes:
+        mux.close()
+    pr.stop()
+    sb.stop()
+EOF
+
 echo "== goodput smoke (chip-second ledger conservation + curve in coord KV)"
 # Part A: an in-process trainer eats one injected resize with the process
 # ledger installed — compile/reshard chip-seconds attributed, curve
