@@ -1805,6 +1805,433 @@ def serving_leg() -> dict:
     return out
 
 
+def frontdoor_leg() -> dict:
+    """The production serving data plane at 10⁵+ qps (ROADMAP #4's
+    data-path half; doc/serving.md §data-plane): an OPEN-LOOP Poisson
+    driver pushes ≥100k qps of pipelined keep-alive HTTP through the
+    load-balancer tier into a multi-replica front-door fleet — and the
+    p99 stays under the SLO THROUGH a live scale-up (warm-standby
+    activation), a rolling weight reload (ready-gate invisible), an
+    injected straggler (hedge-rescued), and a SIGKILLed replica
+    (connection-loss rescue, zero surfaced errors).  Headline:
+    sustained qps, p99 vs SLO per drill window, requests-per-connection
+    and hedge rates vs the thread-per-connection ThreadingHTTPServer
+    baseline."""
+    import collections as _collections
+    import re as _re
+    import signal as _signal  # noqa: F401 (SIGKILL via Popen.kill)
+    import tempfile as _tempfile
+    import threading
+    import urllib.request
+
+    import numpy as np
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from edl_tpu.models import mlp
+    from edl_tpu.coord.server import spawn_server
+    from edl_tpu.observability.metrics import iter_samples, parse_exposition
+    from edl_tpu.runtime.checkpoint import ElasticCheckpointer
+    from edl_tpu.runtime.frontdoor import build_predict_request
+
+    SLO_P99_MS = 100.0
+    TARGET_QPS = float(os.environ.get("EDL_BENCH_FD_QPS", "110000"))
+    DUR_S = 8.0
+    JOB = "bench/frontdoor"
+    DIM, SIZES = 16, [16, 32, 4]
+    NCONN = 6
+
+    tmp = _tempfile.mkdtemp(prefix="edl-bench-frontdoor-")
+    params = mlp.init(jax.random.key(0), SIZES)
+    lineage_dir = os.path.join(tmp, "lineage")
+    lineage = ElasticCheckpointer(lineage_dir, max_to_keep=3)
+    lineage.save(1, {"params": params})
+    lineage.save(2, {"params": jax.tree.map(lambda a: a * 1.01, params)})
+    lineage.close()
+
+    procs: dict = {}
+    srv = spawn_server(member_ttl_ms=15000)
+
+    def spawn_replica(name: str, standby: bool = False):
+        env = dict(os.environ)
+        env.update(JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="",
+                   XLA_FLAGS="",
+                   EDL_FD_JOB=JOB, EDL_FD_REPLICA=name, EDL_FD_PORT="0",
+                   EDL_FD_HOST="127.0.0.1",
+                   EDL_FD_MODEL="mlp:16,32,4",
+                   EDL_FD_MODEL_DIR=lineage_dir,
+                   EDL_FD_MAX_BATCH="512", EDL_FD_MAX_QUEUE_MS="2",
+                   EDL_COORD_ENDPOINT=f"127.0.0.1:{srv.port}",
+                   EDL_FD_METRICS_PORT="0", EDL_FD_TTL_S="10",
+                   EDL_FD_STANDBY="1" if standby else "0")
+        logp = os.path.join(tmp, f"{name}.log")
+        p = subprocess.Popen(
+            [sys.executable, "-m", "edl_tpu.runtime.frontdoor"],
+            stdout=open(logp, "w"), stderr=subprocess.STDOUT, env=env,
+            cwd=_REPO)
+        procs[name] = p
+        return logp
+
+    def ready_ports(logp):
+        _, text = _wait_log(
+            logp, lambda t: "frontdoor ready port=" in t
+            or "lb ready port=" in t, 180)
+        m = _re.search(r"(?:frontdoor|lb) ready port=(\d+) .*?"
+                       r"metrics_port=(\d+)", text)
+        return int(m.group(1)), int(m.group(2))
+
+    def admin(port: int, verb: str, body: bytes = b"") -> None:
+        urllib.request.urlopen(urllib.request.Request(
+            f"http://127.0.0.1:{port}/admin/{verb}", data=body or b"0",
+            method="POST"), timeout=10).read()
+
+    def scrape(port: int) -> dict:
+        text = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=10
+        ).read().decode()
+        parse_exposition(text)  # strict-grammar gate
+        out = {}
+        for name, labels, value in iter_samples(text):
+            out.setdefault(name, []).append((labels, value))
+        return out
+
+    def msum(metrics: dict, name: str, **match) -> float:
+        total = 0.0
+        for labels, value in metrics.get(name, []):
+            if all(labels.get(k) == v for k, v in match.items()):
+                total += value
+        return total
+
+    out: dict = {"slo_p99_ms": SLO_P99_MS, "target_qps": TARGET_QPS}
+    try:
+        # ---- baseline: the PR 10 ThreadingHTTPServer front door,
+        # driven the way HTTP/1.0-close forced clients to drive it
+        # (one connection per request) ------------------------------------
+        base_env = dict(os.environ)
+        base_env.update(JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="",
+                        XLA_FLAGS="",
+                        EDL_SERVING_FRONTDOOR="legacy",
+                        EDL_SERVING_MODEL="mlp:16,32,4",
+                        EDL_SERVING_MODEL_DIR=lineage_dir,
+                        EDL_SERVING_PORT="0", EDL_HEALTH_PORT="-1",
+                        EDL_SERVING_RELOAD_POLL_S="0")
+        base_log = os.path.join(tmp, "baseline.log")
+        procs["baseline"] = subprocess.Popen(
+            [sys.executable, "-c",
+             "from edl_tpu.runtime.serving import serve_main; serve_main()"],
+            stdout=open(base_log, "w"), stderr=subprocess.STDOUT,
+            env=base_env, cwd=_REPO)
+        _, text = _wait_log(base_log,
+                            lambda t: "model server ready port=" in t, 180)
+        base_port = int(_re.search(r"ready port=(\d+)", text).group(1))
+        jbody = json.dumps({"inputs": list(range(DIM))}).encode()
+        base_counts = [0, 0]
+
+        def base_worker(i):
+            import socket as _s
+            t_end = time.perf_counter() + 1.5
+            while time.perf_counter() < t_end:
+                c = _s.create_connection(("127.0.0.1", base_port),
+                                         timeout=10)
+                c.sendall(b"POST /predict HTTP/1.1\r\nHost: b\r\n"
+                          b"Content-Type: application/json\r\n"
+                          b"Connection: close\r\n"
+                          b"Content-Length: %d\r\n\r\n" % len(jbody)
+                          + jbody)
+                buf = b""
+                while b"\r\n\r\n" not in buf or b"outputs" not in buf:
+                    d = c.recv(65536)
+                    if not d:
+                        break
+                    buf += d
+                c.close()
+                base_counts[i] += 1
+
+        t0 = time.perf_counter()
+        bws = [threading.Thread(target=base_worker, args=(i,))
+               for i in range(2)]
+        for w in bws:
+            w.start()
+        for w in bws:
+            w.join()
+        base_wall = time.perf_counter() - t0
+        base_proc = procs.pop("baseline")
+        base_proc.terminate()
+        try:
+            # reap BEFORE the fleet phase: a still-draining baseline
+            # (plus its JAX runtime) would compete for the very CPU the
+            # 10⁵-qps measurement below is about to saturate
+            base_proc.wait(timeout=20)
+        except subprocess.TimeoutExpired:
+            base_proc.kill()
+            base_proc.wait(timeout=10)
+        out["baseline_qps"] = round(sum(base_counts) / base_wall, 1)
+        out["baseline_requests_per_connection"] = 1.0
+
+        # ---- the fleet: 2 live replicas + 1 warm standby + LB ----------
+        logs = {n: spawn_replica(n, standby=(n == "r2"))
+                for n in ("r0", "r1", "r2")}
+        ports = {n: ready_ports(lp) for n, lp in logs.items()}
+        lb_env = dict(os.environ)
+        lb_env.update(JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="",
+                      XLA_FLAGS="",
+                      EDL_LB_JOB=JOB, EDL_LB_PORT="0",
+                      EDL_LB_HOST="127.0.0.1",
+                      EDL_COORD_ENDPOINT=f"127.0.0.1:{srv.port}",
+                      EDL_LB_POOL="2", EDL_LB_DISCOVERY_S="0.25",
+                      EDL_LB_HEDGE_FLOOR_MS="15",
+                      EDL_LB_HEDGE_CAP_MS="1000", EDL_LB_HEDGE_K="3",
+                      EDL_LB_METRICS_PORT="0", EDL_LB_SWEEP_MS="5")
+        lb_log = os.path.join(tmp, "lb.log")
+        procs["lb"] = subprocess.Popen(
+            [sys.executable, "-m", "edl_tpu.runtime.lb"],
+            stdout=open(lb_log, "w"), stderr=subprocess.STDOUT,
+            env=lb_env, cwd=_REPO)
+        lb_port, lb_metrics = ready_ports(lb_log)
+        time.sleep(1.0)  # one discovery sweep + pools dialed
+
+        # ---- the open-loop driver --------------------------------------
+        import asyncio
+
+        req_bytes = bytes(build_predict_request(
+            np.arange(DIM, dtype=np.float32)))
+        L = len(req_bytes)
+        TEMPLATE_N = 4096
+        template = req_bytes * TEMPLATE_N
+        rng = np.random.default_rng(13)
+        n_sched = int(TARGET_QPS * DUR_S)
+        arrivals = np.cumsum(rng.exponential(1.0 / TARGET_QPS,
+                                             size=n_sched))
+        lat_v: list = []    # per completion-group latency
+        lat_c: list = []    # ... and its request count
+        lat_t: list = []    # ... and its completion time (phase cuts)
+        flags = {"http_error": 0}
+
+        class Drv(asyncio.Protocol):
+            def __init__(self):
+                self.tr = None
+                self.carry = 0
+                self.stride = None
+                self.head = b""
+                self.pending: _collections.deque = _collections.deque()
+                self.completed = 0
+
+            def connection_made(self, tr):
+                import socket as _s
+
+                self.tr = tr
+                tr.get_extra_info("socket").setsockopt(
+                    _s.IPPROTO_TCP, _s.TCP_NODELAY, 1)
+
+            def data_received(self, data):
+                now = time.perf_counter()
+                # any non-200 anywhere is an instant tripwire (429/503
+                # would also desync the stride count)
+                if data.find(b"HTTP/1.1 4") >= 0 \
+                        or data.find(b"HTTP/1.1 5") >= 0:
+                    flags["http_error"] += 1
+                if self.stride is None:
+                    self.head += data
+                    i = self.head.find(b"\r\n\r\n")
+                    if i < 0:
+                        return
+                    m = _re.search(rb"Content-Length: (\d+)",
+                                   self.head[:i])
+                    self.stride = i + 4 + int(m.group(1))
+                    data, self.head = self.head, b""
+                total = self.carry + len(data)
+                n = total // self.stride
+                self.carry = total - n * self.stride
+                while n > 0 and self.pending:
+                    t_sent, k = self.pending[0]
+                    take = min(k, n)
+                    lat_v.append(now - t_sent)
+                    lat_c.append(take)
+                    lat_t.append(now)
+                    if take == k:
+                        self.pending.popleft()
+                    else:
+                        self.pending[0] = (t_sent, k - take)
+                    n -= take
+                    self.completed += take
+
+            def connection_lost(self, exc):
+                pass
+
+        marks: dict = {}
+        drill_errors: list = []
+
+        def in_thread(fn, *a):
+            threading.Thread(target=lambda: _drill(fn, *a),
+                             daemon=True).start()
+
+        def _drill(fn, *a):
+            try:
+                fn(*a)
+            except Exception as exc:  # surfaced in the artifact
+                drill_errors.append(f"{fn.__name__}: {exc}")
+
+        def do_scaleup():
+            admin(ports["r2"][0], "activate")
+
+        def do_reload():
+            for n in ("r0", "r1", "r2"):
+                admin(ports[n][0], "reload")
+                time.sleep(0.5)  # rolling: one replica at a time
+
+        def do_straggler():
+            admin(ports["r0"][0], "stall", b"300")
+
+        def do_kill():
+            procs["r2"].kill()
+
+        async def drive():
+            loop = asyncio.get_running_loop()
+            conns = []
+            for _ in range(NCONN):
+                _t, pr = await loop.create_connection(
+                    Drv, "127.0.0.1", lb_port)
+                conns.append(pr)
+            drills = _collections.deque([
+                (2.0, "scaleup", do_scaleup),
+                (3.5, "reload", do_reload),
+                (5.5, "straggler", do_straggler),
+                (6.5, "kill", do_kill),
+            ])
+            t_start = time.perf_counter()
+            marks["t_start"] = t_start
+            sent = 0
+            rr = 0
+            max_lag = 0.0
+            while True:
+                now = time.perf_counter() - t_start
+                if now >= DUR_S or sent >= n_sched:
+                    break
+                due = int(np.searchsorted(arrivals, now)) - sent
+                if due > 0:
+                    max_lag = max(max_lag,
+                                  now - arrivals[sent])
+                while due > 0:
+                    k = min(due, TEMPLATE_N)
+                    pr = conns[rr % NCONN]
+                    rr += 1
+                    pr.pending.append((time.perf_counter(), k))
+                    pr.tr.write(memoryview(template)[:k * L])
+                    sent += k
+                    due -= k
+                while drills and now >= drills[0][0]:
+                    _, name, fn = drills.popleft()
+                    marks[name] = time.perf_counter()
+                    in_thread(fn)
+                await asyncio.sleep(0.0015)
+            marks["t_send_end"] = time.perf_counter()
+            # drain: every sent request must come back
+            deadline = time.perf_counter() + 30
+            while time.perf_counter() < deadline:
+                done = sum(c.completed for c in conns)
+                if done >= sent:
+                    break
+                await asyncio.sleep(0.02)
+            marks["t_done"] = time.perf_counter()
+            for c in conns:
+                c.tr.close()
+            return sent, sum(c.completed for c in conns), max_lag
+
+        sent, completed, max_lag = asyncio.run(drive())
+
+        # ---- tallies ----------------------------------------------------
+        v = np.repeat(np.asarray(lat_v), np.asarray(lat_c))
+        t = np.repeat(np.asarray(lat_t), np.asarray(lat_c))
+        t0 = marks["t_start"]
+        wall = marks["t_done"] - t0
+        send_wall = marks["t_send_end"] - t0
+
+        def pct(mask, q):
+            vv = v[mask]
+            return (round(float(np.quantile(vv, q)) * 1000.0, 3)
+                    if vv.size else None)
+
+        windows = {
+            "steady": (t0, marks["scaleup"]),
+            "scaleup": (marks["scaleup"], marks["reload"]),
+            "reload": (marks["reload"], marks["straggler"]),
+            "straggler": (marks["straggler"], marks["kill"]),
+            "kill": (marks["kill"], marks["t_done"]),
+        }
+        phase_p99 = {name: pct((t >= lo) & (t < hi), 0.99)
+                     for name, (lo, hi) in windows.items()}
+
+        lbm = scrape(lb_metrics)
+        r0m = scrape(ports["r0"][1])
+        hedge_wins = msum(lbm, "edl_lb_hedges_total", result="win")
+        hedge_fired = msum(lbm, "edl_lb_hedges_fired_total")
+        rescues = msum(lbm, "edl_lb_rescues_total")
+        sheds = msum(lbm, "edl_lb_overload_sheds_total")
+        timeouts = msum(lbm, "edl_lb_timeouts_total")
+        fd_sheds = msum(r0m, "edl_frontdoor_overload_sheds_total")
+
+        # post-blast: the rolling reload really landed (gen 2 serves)
+        gen_body = json.dumps({"inputs": list(range(DIM))}).encode()
+        gen_req = urllib.request.Request(
+            f"http://127.0.0.1:{lb_port}/predict", data=gen_body,
+            headers={"Content-Type": "application/json"}, method="POST")
+        generation = json.loads(urllib.request.urlopen(
+            gen_req, timeout=10).read().decode()).get("generation")
+
+        qps = completed / send_wall if send_wall > 0 else 0.0
+        out.update({
+            "frontdoor_qps": round(qps, 1),
+            "requests_sent": int(sent),
+            "requests_completed": int(completed),
+            "driver_connections": NCONN,
+            "requests_per_connection": round(sent / NCONN, 1),
+            "driver_max_lag_ms": round(max_lag * 1000.0, 1),
+            "p50_ms": pct(np.ones_like(v, bool), 0.50),
+            "p99_ms": pct(np.ones_like(v, bool), 0.99),
+            "max_ms": round(float(v.max()) * 1000.0, 3) if v.size else None,
+            "phase_p99_ms": phase_p99,
+            "hedges_fired": int(hedge_fired),
+            "hedge_wins": int(hedge_wins),
+            "hedge_rescues_after_kill": int(rescues),
+            "hedge_rate_pct": round(100.0 * hedge_fired / max(sent, 1), 4),
+            "lb_overload_sheds": int(sheds),
+            "lb_timeouts": int(timeouts),
+            "frontdoor_overload_sheds": int(fd_sheds),
+            "driver_http_errors": int(flags["http_error"]),
+            "drill_errors": drill_errors,
+            "rolling_reload_generation": generation,
+            "wall_s": round(wall, 2),
+            "vs_baseline_qps_x": round(qps / max(out["baseline_qps"], 0.1),
+                                       1),
+        })
+        # in-leg acceptance: a regression fails the bench loudly
+        assert not drill_errors, out
+        assert out["frontdoor_qps"] >= 100_000, out
+        assert completed == sent, out
+        assert out["driver_http_errors"] == 0, out
+        assert out["lb_overload_sheds"] == 0, out
+        assert out["lb_timeouts"] == 0, out
+        assert out["p99_ms"] <= SLO_P99_MS, out
+        for name, p in phase_p99.items():
+            assert p is not None and p <= SLO_P99_MS, (name, out)
+        assert out["hedge_wins"] > 0, out
+        assert out["hedge_rescues_after_kill"] > 0, out
+        assert out["requests_per_connection"] >= 100, out
+        assert out["rolling_reload_generation"] == 2, out
+        return out
+    finally:
+        for p in procs.values():
+            if p.poll() is None:
+                p.kill()
+        for p in procs.values():  # reap: no zombies riding later legs
+            try:
+                p.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                pass
+        srv.process.kill()
+
+
 def goodput_leg() -> dict:
     """Goodput ledger through a resize+fault schedule (doc/observability.md
     §goodput): a live trainer walks 2→4→2 with steady-state throughput
@@ -2512,6 +2939,14 @@ def main() -> None:
                    "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
                    "PALLAS_AXON_POOL_IPS": ""})
 
+    # the production serving data plane: 10⁵+ qps open-loop through the
+    # LB tier into a multi-replica front-door fleet, p99-under-SLO
+    # through a scale-up, a rolling reload, a straggler and a kill
+    frontdoor = _run_leg(
+        "frontdoor", timeout_s=420,
+        extra_env={"JAX_PLATFORMS": "cpu", "XLA_FLAGS": "",
+                   "PALLAS_AXON_POOL_IPS": ""})
+
     # Headline discipline (VERDICT r5 weak #4): LEAD with metrics that
     # can still move — contended admission latency, the MFU suite,
     # reform/resize latencies.  The saturated packing ratio (100 % vs the
@@ -2549,6 +2984,7 @@ def main() -> None:
                    "coord_ha": coord_ha, "coord_scale": coord_scale,
                    "goodput": goodput_r,
                    "determinism": determinism, "serving": serving,
+                   "frontdoor": frontdoor,
                    "tpu_world_cycle": tpu_cycle},
     }
     print(json.dumps(result))
@@ -2649,6 +3085,25 @@ def main() -> None:
         "alerts_fired": serving.get("alerts_fired"),
         "fast_burn_evals_to_fire":
             serving.get("fast_burn_evals_to_fire"),
+        # the production serving data plane (ROADMAP #4 data-path half):
+        # open-loop qps sustained through the LB tier with p99 under the
+        # SLO across all four drill windows, requests-per-connection vs
+        # the one-per-connection ThreadingHTTPServer baseline, and the
+        # hedge counters that absorbed the straggler + the kill
+        "frontdoor_qps": frontdoor.get("frontdoor_qps"),
+        "frontdoor_p99_ms": frontdoor.get("p99_ms"),
+        "frontdoor_slo_p99_ms": frontdoor.get("slo_p99_ms"),
+        "frontdoor_phase_p99_ms": frontdoor.get("phase_p99_ms"),
+        "frontdoor_requests_per_connection":
+            frontdoor.get("requests_per_connection"),
+        "frontdoor_baseline_qps": frontdoor.get("baseline_qps"),
+        "frontdoor_vs_baseline_qps_x":
+            frontdoor.get("vs_baseline_qps_x"),
+        "frontdoor_hedge_rate_pct": frontdoor.get("hedge_rate_pct"),
+        "frontdoor_hedge_wins": frontdoor.get("hedge_wins"),
+        "frontdoor_rescues_after_kill":
+            frontdoor.get("hedge_rescues_after_kill"),
+        "frontdoor_errors": frontdoor.get("driver_http_errors"),
         # accuracy-consistent elasticity: a resize must be invisible to
         # the loss curve — the measured divergence of the 4→2→8 walk
         # (with an injected kill) vs the unresized control, and the
@@ -2726,6 +3181,8 @@ if __name__ == "__main__":
             out = goodput_leg()
         elif leg == "serving":
             out = serving_leg()
+        elif leg == "frontdoor":
+            out = frontdoor_leg()
         elif leg == "reparallel":
             out = reparallel_leg()
         elif leg == "determinism":

@@ -1391,3 +1391,23 @@ class MuxCoordClient(CoordClient):
                               self.reconnect_window_s,
                               list(self.endpoints),
                               self.promote_grace_s))
+
+
+def client_from_env(env, var: str = "EDL_COORD_ENDPOINT",
+                    disabled: str = "coordinator features disabled"):
+    """Optional :class:`CoordClient` from a ``host:port`` env var — the
+    shared bootstrap for process entrypoints (serve_main, replica_main,
+    lb_main) whose coordinator wiring is best-effort: returns ``None``
+    quietly when the var is unset/blank, and warns + returns ``None``
+    when it is set but the endpoint is unreachable (``disabled`` names
+    what the caller degrades to)."""
+    ep = env.get(var, "")
+    if not ep or ":" not in ep:
+        return None
+    host, _, port = ep.rpartition(":")
+    try:
+        return CoordClient(host, int(port))
+    except Exception as exc:
+        print(f"warning: coordinator {ep} unreachable "
+              f"({str(exc)[:80]}); {disabled}", flush=True)
+        return None

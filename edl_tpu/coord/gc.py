@@ -35,6 +35,9 @@ JOB_KV_PREFIXES = (
     # observability/scrape.py stamps an expiry the scraper honors — but
     # the keys themselves only leave KV here or via AddrPublisher.stop)
     "serving-metrics-addr/",
+    # the DATA-plane address + ready-gate keys the LB tier discovers
+    # replicas through (runtime/frontdoor.py _StatePublisher)
+    "serving-addr/",
 )
 
 

@@ -241,6 +241,38 @@ class Histogram(_Family):
             counts[-1] += 1  # +Inf
             self._sums[key] += v
 
+    def observe_many(self, values, **labels) -> None:
+        """Vectorized :meth:`observe` for block-oriented callers (the
+        serving data plane records latencies per admitted BLOCK, not per
+        request — at 10⁵ qps a per-request observe with its per-call
+        lock acquisition would itself be the hot path).  One lock, one
+        ``np.searchsorted`` over the whole block."""
+        import numpy as np
+
+        arr = np.asarray(values, dtype=np.float64).ravel()
+        if arr.size == 0:
+            return
+        barr = getattr(self, "_bucket_arr", None)
+        if barr is None:
+            barr = self._bucket_arr = np.asarray(self.buckets)
+        # bucket i counts v <= buckets[i]: cumulative, like observe()
+        idx = np.searchsorted(barr, arr, side="left")
+        per_bucket = np.bincount(idx, minlength=len(self.buckets) + 1)
+        cum_from = np.cumsum(per_bucket)  # observations in buckets <= i
+        total = int(arr.size)
+        s = float(arr.sum())
+        with self._lock:
+            key = _label_key(labels)
+            counts = self._counts.get(key)
+            if counts is None:
+                counts = [0] * (len(self.buckets) + 1)
+                self._counts[key] = counts
+                self._sums[key] = 0.0
+            for i in range(len(self.buckets)):
+                counts[i] += int(cum_from[i])
+            counts[-1] += total
+            self._sums[key] += s
+
     def count(self, **labels) -> int:
         with self._lock:
             counts = self._counts.get(_label_key(labels))

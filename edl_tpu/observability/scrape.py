@@ -806,27 +806,46 @@ class AddrPublisher(threading.Thread):
     tombstone to wait out)."""
 
     def __init__(self, kv, key: str, addr: str,
-                 ttl_s: float = DEFAULT_ADDR_TTL_S) -> None:
+                 ttl_s: float = DEFAULT_ADDR_TTL_S,
+                 value_fn: Optional[Callable[[], bytes]] = None) -> None:
         super().__init__(name=f"addr-publish-{key}", daemon=True)
         self.kv = kv
         self.key = key
         self.addr = addr
         self.ttl_s = max(float(ttl_s), 1.0)
+        #: value factory — default is the plain TTL'd address; the
+        #: serving data plane publishes addr+expiry+ready-gate state
+        #: through the same refresher (runtime/frontdoor.py)
+        self.value_fn = value_fn
         self._halt = threading.Event()
+        self._kick = threading.Event()
+
+    def publish_now(self) -> None:
+        """Republish out of band (e.g. on a ready-gate transition) —
+        the run loop wakes immediately instead of at the next ttl/3."""
+        self._kick.set()
+
+    def _put(self) -> None:
+        try:
+            value = (self.value_fn() if self.value_fn is not None
+                     else format_addr_value(self.addr, self.ttl_s))
+            self.kv.kv_set(self.key, value)
+        except Exception as exc:  # coordinator blip: keep refreshing
+            log.warn("addr publish failed", key=self.key,
+                     error=str(exc)[:120])
 
     def run(self) -> None:
+        self._put()
         while True:
-            try:
-                self.kv.kv_set(self.key,
-                               format_addr_value(self.addr, self.ttl_s))
-            except Exception as exc:  # coordinator blip: keep refreshing
-                log.warn("metrics addr publish failed", key=self.key,
-                         error=str(exc)[:120])
-            if self._halt.wait(self.ttl_s / 3.0):
+            self._kick.wait(self.ttl_s / 3.0)
+            self._kick.clear()
+            if self._halt.is_set():
                 return
+            self._put()
 
     def stop(self) -> None:
         self._halt.set()
+        self._kick.set()
         self.join(timeout=5)
         try:
             self.kv.kv_del(self.key)

@@ -41,6 +41,12 @@ _EXPORTS = {
     "ServeRequest": ("edl_tpu.runtime.serving", "ServeRequest"),
     "PoissonTraffic": ("edl_tpu.runtime.serving", "PoissonTraffic"),
     "RequestDropped": ("edl_tpu.runtime.serving", "RequestDropped"),
+    # the production serving data plane (doc/serving.md §data-plane)
+    "FrontDoor": ("edl_tpu.runtime.frontdoor", "FrontDoor"),
+    "BatchApp": ("edl_tpu.runtime.frontdoor", "BatchApp"),
+    "FleetApp": ("edl_tpu.runtime.frontdoor", "FleetApp"),
+    "ServingLB": ("edl_tpu.runtime.lb", "ServingLB"),
+    "LBApp": ("edl_tpu.runtime.lb", "LBApp"),
 }
 
 __all__ = list(_EXPORTS)

@@ -1,0 +1,799 @@
+"""The serving load-balancer tier: ready-gate-aware routing, pooled
+pipelined upstream connections, and p99-derived request hedging
+(ROADMAP #4's data-path half; doc/serving.md §data-plane).
+
+Between clients and the :mod:`~edl_tpu.runtime.frontdoor` replicas sits
+one (or more — the tier is stateless) ``ServingLB`` process:
+
+* **discovery** — replicas are found through the TTL'd
+  ``serving-addr/<job>/<replica>`` coordinator-KV keys each replica's
+  front door publishes (value ``host:port <expiry> <state>``); the
+  *state* field is the ready gate: ``building``/``reloading``/
+  ``draining`` replicas take no new traffic while their in-flight work
+  completes — a rolling reload is invisible to clients by construction.
+* **connection pooling** — ``pool`` persistent HTTP/1.1 connections per
+  upstream, requests pipelined; client request bytes are forwarded
+  VERBATIM (they are already valid HTTP/1.1 — zero re-encode, zero
+  re-parse beyond the front door's block scan) and upstream response
+  bytes are forwarded verbatim back.
+* **least-outstanding routing** — each block of pipelined requests goes
+  to the ready upstream with the fewest outstanding rows.
+* **hedging** — a sweep task watches every upstream's oldest
+  outstanding block; past the hedge delay (``max(floor,
+  k × observed-p99)``, recomputed continuously from the LB's own
+  response latencies) the block is re-sent to a different replica.
+  First response wins; the loser's response is consumed off its
+  connection and discarded (with pipelining there is no un-send — the
+  cancellation is at the response, exactly like production hedging).
+  The admit→queue→batch→forward→respond span taxonomy on the replica
+  (PR 11) attributes WHY the straggler was slow; the LB's hedge
+  counters say how often it had to care.
+* **failure rescue** — a dead upstream connection (killed replica)
+  fails fast: every outstanding block is re-sent to a surviving
+  replica, so a SIGKILL costs latency, not errors.
+* **priority shedding** — the same ``X-EDL-Priority`` classes as the
+  front door, applied against the LB-wide outstanding-row count: low
+  sheds at the soft watermark, normal at the hard cap, high rides the
+  reserve band.
+
+Scrape names: ``edl_lb_requests_total`` / ``edl_lb_responses_total`` /
+``edl_lb_hedges_total{result=win|lose}`` / ``edl_lb_rescues_total`` /
+``edl_lb_overload_sheds_total{priority=}`` / ``edl_lb_timeouts_total``
+/ ``edl_lb_discovery_sweeps_total`` (counters),
+``edl_lb_request_seconds`` (histogram), ``edl_lb_upstreams_ready`` /
+``edl_lb_outstanding_rows`` / ``edl_lb_hedge_delay_ms`` (gauges) — all
+labeled ``job=``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import collections
+import threading
+import time
+from typing import Optional
+
+import numpy as np
+
+from edl_tpu.observability.collector import get_counters
+from edl_tpu.observability.logging import get_logger
+from edl_tpu.observability.metrics import SERVING_LATENCY_BUCKETS, get_registry
+from edl_tpu.runtime.frontdoor import (
+    FD_READY,
+    PRI_HIGH,
+    PRI_LOW,
+    PRIORITY_NAMES,
+    RESP_404,
+    RESP_429,
+    RESP_503,
+    SERVING_ADDR_PREFIX,
+    FrontDoor,
+    HeadMeta,
+    HttpConn,
+    parse_serving_addr,
+)
+
+log = get_logger("runtime.lb")
+
+
+def _strip_hop_headers(raw: bytes, meta: HeadMeta, n: int) -> bytes:
+    """Drop the client's hop-by-hop ``Connection:`` line before
+    forwarding (RFC 7230 §6.1): a ``close`` applies to the CLIENT hop
+    only — forwarded verbatim it would make the replica tear down a
+    pooled pipelined upstream connection (rescue-resending every other
+    in-flight block on it) once per close-marked request."""
+    head = raw[:meta.head_len]
+    lower = head.lower()
+    i = lower.find(b"\r\nconnection:")
+    if i < 0:
+        return raw
+    j = lower.index(b"\r\n", i + 2)
+    new_head = head[:i] + head[j:]
+    if n == 1:
+        return new_head + raw[meta.head_len:]
+    stride = meta.total_len  # uniform block: identical heads at stride
+    out = bytearray()
+    for k in range(n):
+        off = k * stride
+        out += new_head
+        out += raw[off + meta.head_len:off + stride]
+    return bytes(out)
+
+
+class _Cell:
+    """Shared first-wins flag between a primary dispatch and its
+    hedge/rescue twins: whoever completes first takes it; later
+    completions are consumed and discarded."""
+
+    __slots__ = ("done",)
+
+    def __init__(self) -> None:
+        self.done = False
+
+
+class _OutBlock:
+    """One dispatched run of pipelined requests awaiting ``n`` responses
+    on one upstream connection."""
+
+    __slots__ = ("conn", "slot", "n", "remaining", "req_bytes", "t_sent",
+                 "t_admit", "cell", "kind", "acc", "hedged")
+
+    def __init__(self, conn, slot, n: int, req_bytes: bytes,
+                 cell: _Cell, kind: str = "primary",
+                 t_admit: Optional[float] = None) -> None:
+        self.conn = conn              # client HttpConn (may be closed)
+        self.slot = slot              # client RespSlot
+        self.n = n
+        self.remaining = n
+        self.req_bytes = req_bytes    # retained for hedge/rescue resend
+        self.t_sent = time.perf_counter()
+        # original LB admission time, carried across hedge/rescue
+        # resends: every timeout bound anchors here, so a rescued block
+        # waits ONE request_timeout total, not a fresh one per resend
+        self.t_admit = self.t_sent if t_admit is None else t_admit
+        self.cell = cell
+        self.kind = kind              # primary | hedge | rescue
+        self.acc: list[bytes] = []    # response bytes, in order
+        self.hedged = False
+
+
+class _UpstreamConn(asyncio.Protocol):
+    """One pooled connection to one replica: pipelined writes, block
+    response parsing with the same fixed-stride fast path as the front
+    door (upstream responses to a fixed model are byte-identical heads),
+    FIFO completion against the expected-block queue."""
+
+    def __init__(self, upstream: "_Upstream", lb: "LBApp") -> None:
+        self.up = upstream
+        self.lb = lb
+        self.transport = None
+        self.connected = False
+        self.expected: "collections.deque[_OutBlock]" = collections.deque()
+        self._buf = bytearray()
+        #: (head bytes, total response stride) — armed by the first
+        #: parsed response
+        self._fixed: Optional[tuple[bytes, int]] = None
+        self.outstanding_rows = 0
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def connection_made(self, transport) -> None:
+        self.transport = transport
+        try:
+            import socket
+
+            transport.get_extra_info("socket").setsockopt(
+                socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        except Exception:
+            pass
+        self.connected = True
+
+    def connection_lost(self, exc) -> None:
+        self.connected = False
+        try:
+            self.up.conns.remove(self)
+        except ValueError:
+            pass
+        self.lb.on_upstream_conn_lost(self)
+
+    # -- dispatch ------------------------------------------------------------
+
+    def send_block(self, blk: _OutBlock) -> None:
+        self.expected.append(blk)
+        self.outstanding_rows += blk.n
+        self.transport.write(blk.req_bytes)
+
+    # -- response parsing ----------------------------------------------------
+
+    def data_received(self, data: bytes) -> None:
+        buf = self._buf
+        buf += data
+        while buf:
+            if self._fixed is not None:
+                head, stride = self._fixed
+                n = len(buf) // stride
+                if n > 0 and buf.startswith(head):
+                    run = 1
+                    while run < n and buf.startswith(head, run * stride):
+                        run += 1
+                    chunk = bytes(memoryview(buf)[:run * stride])
+                    del buf[:run * stride]
+                    self._feed_uniform(chunk, run, stride)
+                    continue
+            if not self._parse_one():
+                break
+
+    def _parse_one(self) -> bool:
+        buf = self._buf
+        idx = buf.find(b"\r\n\r\n")
+        if idx < 0:
+            return False
+        head = bytes(memoryview(buf)[:idx + 4])
+        lower = head.lower()
+        body_len = 0
+        # \r\n-anchored like HeadMeta's lookups (an unanchored match
+        # could hit inside another header's name and desync framing)
+        ci = lower.find(b"\r\ncontent-length:")
+        if ci >= 0:
+            end = lower.index(b"\r\n", ci + 2)
+            try:
+                body_len = int(lower[ci + 17:end].strip())
+            except ValueError:
+                pass
+        total = len(head) + body_len
+        if len(buf) < total:
+            return False
+        raw = bytes(memoryview(buf)[:total])
+        del buf[:total]
+        if lower.startswith(b"http/1.1 200") and body_len:
+            self._fixed = (head, total)
+        self._feed(raw, 1)
+        return True
+
+    def _feed_uniform(self, chunk: bytes, count: int, stride: int) -> None:
+        """``count`` uniform responses of ``stride`` bytes: fill the
+        expected-block queue head-first, slicing per block."""
+        off = 0
+        while count > 0 and self.expected:
+            blk = self.expected[0]
+            take = min(count, blk.remaining)
+            blk.acc.append(chunk[off:off + take * stride]
+                           if (off or take * stride != len(chunk))
+                           else chunk)
+            blk.remaining -= take
+            self.outstanding_rows -= take
+            off += take * stride
+            count -= take
+            if blk.remaining == 0:
+                self.expected.popleft()
+                self.lb.block_done(blk)
+        if count > 0:
+            log.warn("upstream sent unexpected responses",
+                     upstream=self.up.name, extra=count)
+
+    def _feed(self, raw: bytes, count: int) -> None:
+        for _ in range(count):
+            if not self.expected:
+                log.warn("upstream sent unexpected response",
+                         upstream=self.up.name)
+                return
+            blk = self.expected[0]
+            blk.acc.append(raw)
+            blk.remaining -= 1
+            self.outstanding_rows -= 1
+            if blk.remaining == 0:
+                self.expected.popleft()
+                self.lb.block_done(blk)
+
+
+class _Upstream:
+    """One replica as the LB sees it: address, gate state, conn pool."""
+
+    __slots__ = ("name", "addr", "state", "conns", "dialing", "last_seen",
+                 "requests")
+
+    def __init__(self, name: str, addr: str) -> None:
+        self.name = name
+        self.addr = addr
+        self.state = FD_READY
+        self.conns: list[_UpstreamConn] = []
+        self.dialing = 0
+        self.last_seen = time.monotonic()
+        self.requests = 0
+
+    def routable(self) -> bool:
+        return self.state == FD_READY and bool(self.conns)
+
+    def outstanding(self) -> int:
+        return sum(c.outstanding_rows for c in self.conns)
+
+    def least_loaded_conn(self) -> Optional[_UpstreamConn]:
+        live = [c for c in self.conns if c.connected]
+        if not live:
+            return None
+        return min(live, key=lambda c: c.outstanding_rows)
+
+
+class LBApp:
+    """The LB's front-door app + upstream manager.  Runs entirely on the
+    door's event loop (discovery feeds it via ``call_soon_threadsafe``),
+    so no routing state needs locks."""
+
+    wants_raw = True
+
+    def __init__(self, *, job: str = "job", kv=None,
+                 static_upstreams: Optional[dict[str, str]] = None,
+                 pool: int = 2, discovery_s: float = 0.5,
+                 hedge_floor_ms: float = 10.0, hedge_cap_ms: float = 1000.0,
+                 hedge_k: float = 3.0, request_timeout_s: float = 30.0,
+                 hard_cap_rows: int = 65536, soft_cap_rows: int = 0,
+                 sweep_ms: float = 5.0, addr_grace_s: float = 5.0) -> None:
+        self.job = job
+        self.kv = kv
+        self.static_upstreams = dict(static_upstreams or {})
+        self.pool = max(int(pool), 1)
+        self.discovery_s = float(discovery_s)
+        self.hedge_floor_ms = float(hedge_floor_ms)
+        self.hedge_cap_ms = float(hedge_cap_ms)
+        self.hedge_k = float(hedge_k)
+        self.request_timeout_s = float(request_timeout_s)
+        self.hard_cap = max(int(hard_cap_rows), 1)
+        self.soft_cap = (int(soft_cap_rows) if soft_cap_rows
+                         else self.hard_cap // 2)
+        self.high_cap = self.hard_cap + self.hard_cap // 4
+        self.sweep_ms = float(sweep_ms)
+        self.addr_grace_s = float(addr_grace_s)
+        self.door: Optional[FrontDoor] = None
+        self.upstreams: dict[str, _Upstream] = {}
+        self.outstanding_rows = 0
+        self.hedge_delay_s = self.hedge_floor_ms / 1e3
+        #: blocks with no routable upstream yet: (deadline, blk)
+        self._parked: "collections.deque[tuple[float, _OutBlock]]" = (
+            collections.deque())
+        self._paused_conns: set = set()
+        self._lat_ring = np.zeros(4096, np.float64)
+        self._lat_n = 0
+        self._lat_i = 0
+        self._discovery: Optional[threading.Thread] = None
+        self._halt = threading.Event()
+        self._sweep_handle = None
+        self._sweep_n = 0
+        reg = get_registry()
+        self._c = get_counters()
+        self._hist = reg.histogram(
+            "lb_request_seconds",
+            help="LB-observed latency, dispatch to upstream response",
+            buckets=SERVING_LATENCY_BUCKETS)
+        reg.gauge_fn("lb_upstreams_ready",
+                     lambda: sum(1 for u in self.upstreams.values()
+                                 if u.routable()),
+                     help="replicas currently routable", job=job)
+        reg.gauge_fn("lb_outstanding_rows", lambda: self.outstanding_rows,
+                     help="requests in flight to upstreams", job=job)
+        self._hedge_gauge = reg.gauge(
+            "lb_hedge_delay_ms",
+            help="current p99-derived hedge delay")
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def attach(self, door: FrontDoor) -> None:
+        self.door = door
+        self._hedge_gauge.set(round(self.hedge_delay_s * 1e3, 3),
+                              job=self.job)
+        for name, addr in self.static_upstreams.items():
+            self._apply_target(name, addr, FD_READY)
+        self._schedule_sweep()
+        if self.kv is not None:
+            self._discovery = threading.Thread(
+                target=self._discover_loop, daemon=True,
+                name=f"lb-discovery-{self.job}")
+            self._discovery.start()
+
+    def detach(self) -> None:
+        self._halt.set()
+        if self._discovery is not None:
+            self._discovery.join(timeout=5)
+
+    # -- discovery (own thread → loop) ---------------------------------------
+
+    def _discover_loop(self) -> None:
+        prefix = f"{SERVING_ADDR_PREFIX}{self.job}/"
+        while not self._halt.wait(self.discovery_s):
+            try:
+                targets: dict[str, tuple[str, str]] = {}
+                for key in self.kv.kv_keys(prefix):
+                    value = self.kv.kv_get(key)
+                    if value is None:
+                        continue
+                    addr, state, expired = parse_serving_addr(value)
+                    if addr is None or expired:
+                        continue
+                    targets[key[len(prefix):]] = (addr, state)
+                self._c.inc("lb_discovery_sweeps", job=self.job)
+                self.door.call_soon(self._apply_targets, targets)
+            except Exception as exc:
+                log.warn("discovery sweep failed", error=str(exc)[:120])
+
+    def _apply_targets(self, targets: dict) -> None:
+        now = time.monotonic()
+        for name, (addr, state) in targets.items():
+            self._apply_target(name, addr, state, now)
+        # a replica that vanished from KV (TTL expiry after a kill, or a
+        # clean unpublish) is dropped after a short grace; its dead
+        # connections already rescued their blocks on connection_lost
+        for name in list(self.upstreams):
+            if name in targets or name in self.static_upstreams:
+                continue
+            up = self.upstreams[name]
+            if now - up.last_seen > self.addr_grace_s:
+                for conn in list(up.conns):
+                    try:
+                        conn.transport.close()
+                    except Exception:
+                        pass
+                del self.upstreams[name]
+                log.info("upstream dropped", upstream=name)
+
+    def _apply_target(self, name: str, addr: str, state: str,
+                      now: Optional[float] = None) -> None:
+        up = self.upstreams.get(name)
+        if up is None:
+            up = _Upstream(name, addr)
+            up.state = state
+            self.upstreams[name] = up
+            log.info("upstream discovered", upstream=name, addr=addr,
+                     state=state)
+        else:
+            if state != up.state:
+                log.info("upstream state", upstream=name, state=state)
+            up.state = state
+            up.addr = addr
+        up.last_seen = now if now is not None else time.monotonic()
+        self._fill_pool(up)
+
+    def _fill_pool(self, up: _Upstream) -> None:
+        want = self.pool if up.state == FD_READY else min(self.pool, 1)
+        while len(up.conns) + up.dialing < want:
+            up.dialing += 1
+            asyncio.ensure_future(self._dial(up))
+
+    async def _dial(self, up: _Upstream) -> None:
+        host, _, port = up.addr.rpartition(":")
+        try:
+            _, proto = await asyncio.wait_for(
+                asyncio.get_running_loop().create_connection(
+                    lambda: _UpstreamConn(up, self), host, int(port)),
+                timeout=5.0)
+            up.conns.append(proto)
+        except Exception as exc:
+            log.warn("upstream dial failed", upstream=up.name,
+                     addr=up.addr, error=str(exc)[:120])
+        finally:
+            up.dialing -= 1
+
+    # -- client-side dispatch (loop thread) ----------------------------------
+
+    def handle_raw_block(self, conn: HttpConn, raw: bytes, n: int,
+                         meta: HeadMeta) -> None:
+        pri = meta.priority
+        qd = self.outstanding_rows
+        if pri == PRI_LOW and qd + n > self.soft_cap:
+            self._shed(conn, n, pri)
+            return
+        cap = self.high_cap if pri == PRI_HIGH else self.hard_cap
+        if qd + n > cap:
+            self._shed(conn, n, pri)
+            conn.pause()
+            self._paused_conns.add(conn)
+            return
+        self._c.inc("lb_requests", n, job=self.job)
+        if not meta.keep_alive:  # rare: off the byte-identical hot path
+            raw = _strip_hop_headers(raw, meta, n)
+        slot = conn.push_slot(n)
+        blk = _OutBlock(conn, slot, n, raw, _Cell())
+        self.outstanding_rows += n
+        self._dispatch(blk)
+
+    def handle_request(self, conn: HttpConn, meta: HeadMeta, body: bytes,
+                       raw: bytes) -> None:
+        if meta.method == "GET":
+            if meta.path == "/healthz":
+                from edl_tpu.runtime.frontdoor import RESP_200_EMPTY
+
+                ok = any(u.routable() for u in self.upstreams.values())
+                conn.complete(conn.push_slot(1),
+                              RESP_200_EMPTY if ok else RESP_503)
+            else:
+                conn.complete(conn.push_slot(1), RESP_404)
+            return
+        if meta.method != "POST" or meta.path != "/predict":
+            # NOT a transparent proxy for the replica admin surface:
+            # /admin/* (stall/drain/activate/reload) on the public LB
+            # endpoint would hand any client the drill controls
+            conn.complete(conn.push_slot(1), RESP_404)
+            return
+        # /predict (JSON included) forwards verbatim
+        self.handle_raw_block(conn, raw, 1, meta)
+
+    def on_conn_lost(self, conn: HttpConn) -> None:
+        # in-flight blocks complete into a closed conn harmlessly
+        self._paused_conns.discard(conn)
+
+    def _shed(self, conn: HttpConn, n: int, pri: int) -> None:
+        conn.complete(conn.push_slot(n), RESP_429 * n)
+        self._c.inc("lb_overload_sheds", n, job=self.job,
+                    priority=PRIORITY_NAMES[pri])
+
+    def _pick(self, exclude=None) -> Optional[_Upstream]:
+        best = None
+        best_load = None
+        for up in self.upstreams.values():
+            if up is exclude or not up.routable():
+                continue
+            load = up.outstanding()
+            if best is None or load < best_load:
+                best, best_load = up, load
+        return best
+
+    def _dispatch(self, blk: _OutBlock, exclude=None) -> None:
+        up = self._pick(exclude)
+        if up is None and exclude is not None:
+            up = self._pick(None)  # better a busy twin than nothing
+        if up is None:
+            self._parked.append(
+                (blk.t_admit + self.request_timeout_s, blk))
+            return
+        conn = up.least_loaded_conn()
+        if conn is None:
+            self._parked.append(
+                (blk.t_admit + self.request_timeout_s, blk))
+            return
+        up.requests += blk.n
+        blk.t_sent = time.perf_counter()
+        conn.send_block(blk)
+
+    # -- completion ----------------------------------------------------------
+
+    def block_done(self, blk: _OutBlock) -> None:
+        if blk.cell.done:
+            # consumed but discarded: ONLY a hedge-duel participant
+            # (the hedge twin, or a primary/rescue that was hedged)
+            # counts toward the win/lose series the dashboards read as
+            # duel outcomes — an unhedged rescue's duplicate or a
+            # post-timeout response is a late response, not a lost duel
+            if blk.hedged or blk.kind == "hedge":
+                self._c.inc("lb_hedges", blk.n, job=self.job,
+                            result="lose")
+            else:
+                self._c.inc("lb_late_responses", blk.n, job=self.job)
+            return
+        blk.cell.done = True
+        lat = time.perf_counter() - blk.t_sent
+        self._record_lat(lat)
+        self._hist.observe(lat, job=self.job)
+        self._c.inc("lb_responses", blk.n, job=self.job)
+        if blk.kind == "hedge":
+            self._c.inc("lb_hedges", blk.n, job=self.job, result="win")
+        elif blk.kind == "rescue":
+            self._c.inc("lb_rescues", blk.n, job=self.job)
+        self.outstanding_rows -= blk.n
+        if not blk.conn.closed:
+            blk.conn.complete(
+                blk.slot,
+                blk.acc[0] if len(blk.acc) == 1 else b"".join(blk.acc))
+        self._maybe_resume()
+
+    def _maybe_resume(self) -> None:
+        if self._paused_conns and self.outstanding_rows < self.soft_cap // 2:
+            for c in list(self._paused_conns):
+                c.resume()
+            self._paused_conns.clear()
+
+    def _record_lat(self, lat: float) -> None:
+        self._lat_ring[self._lat_i] = lat
+        self._lat_i = (self._lat_i + 1) % len(self._lat_ring)
+        self._lat_n = min(self._lat_n + 1, len(self._lat_ring))
+
+    # -- upstream failure ----------------------------------------------------
+
+    def on_upstream_conn_lost(self, conn: _UpstreamConn) -> None:
+        """A replica connection died (kill, crash, close): re-send every
+        outstanding block to a surviving replica — the client sees
+        latency, never an error."""
+        blocks = list(conn.expected)
+        conn.expected.clear()
+        for blk in blocks:
+            conn.outstanding_rows -= blk.remaining
+            if blk.cell.done:
+                continue
+            resend = _OutBlock(blk.conn, blk.slot, blk.n, blk.req_bytes,
+                               blk.cell, kind="rescue",
+                               t_admit=blk.t_admit)
+            self._dispatch(resend, exclude=conn.up)
+        if blocks:
+            log.info("upstream connection lost; blocks rescued",
+                     upstream=conn.up.name, blocks=len(blocks))
+        # keep the pool full while the replica is still advertised
+        up = conn.up
+        if up.name in self.upstreams and not self._halt.is_set():
+            self._apply_target(up.name, up.addr, up.state)
+
+    # -- the sweep (hedge + timeouts + parked + hedge-delay refresh) ---------
+
+    def _schedule_sweep(self) -> None:
+        if self._halt.is_set():
+            return
+        self._sweep_handle = self.door.loop.call_later(
+            self.sweep_ms / 1e3, self._sweep)
+
+    def _sweep(self) -> None:
+        try:
+            now = time.perf_counter()
+            # refresh the p99-derived hedge delay — every ~20th sweep:
+            # a full-ring np.quantile per 5 ms sweep would be 200
+            # sorts/s on the routing thread, for a threshold that only
+            # needs ~100 ms freshness
+            self._sweep_n += 1
+            if self._lat_n >= 32 and self._sweep_n % 20 == 1:
+                p99 = float(np.quantile(self._lat_ring[:self._lat_n], 0.99))
+                self.hedge_delay_s = min(
+                    max(self.hedge_k * p99, self.hedge_floor_ms / 1e3),
+                    self.hedge_cap_ms / 1e3)
+                self._hedge_gauge.set(round(self.hedge_delay_s * 1e3, 3),
+                                      job=self.job)
+            # pool top-up, ~every 0.5 s at the default 5 ms sweep: in
+            # KV mode the discovery sweep re-dials, but a STATIC
+            # upstream whose initial dial failed (LB started before the
+            # replica listened) has no other redial trigger — without
+            # this it would be unroutable forever.  last_seen is NOT
+            # refreshed here (that would defeat addr_grace_s aging).
+            if self._sweep_n % 100 == 1:
+                for up in self.upstreams.values():
+                    self._fill_pool(up)
+            # hedge stragglers
+            for up in list(self.upstreams.values()):
+                for conn in up.conns:
+                    for blk in conn.expected:
+                        if now - blk.t_sent <= self.hedge_delay_s:
+                            break  # FIFO: the rest are younger
+                        if blk.hedged or blk.cell.done:
+                            continue
+                        target = self._pick(exclude=up)
+                        if target is None:
+                            break
+                        tconn = target.least_loaded_conn()
+                        if tconn is None:
+                            # no live conn this sweep: leave the block
+                            # unmarked so the next sweep retries — a
+                            # hedge marked-but-never-sent would wait
+                            # out the full request timeout
+                            continue
+                        blk.hedged = True
+                        hedge = _OutBlock(blk.conn, blk.slot, blk.n,
+                                          blk.req_bytes, blk.cell,
+                                          kind="hedge",
+                                          t_admit=blk.t_admit)
+                        hedge.hedged = True
+                        self._c.inc("lb_hedges_fired", blk.n, job=self.job)
+                        target.requests += blk.n
+                        tconn.send_block(hedge)
+            # re-dispatch parked blocks / expire them
+            parked, self._parked = self._parked, collections.deque()
+            for deadline, blk in parked:
+                if blk.cell.done:
+                    continue
+                if now > deadline:
+                    blk.cell.done = True
+                    self.outstanding_rows -= blk.n
+                    self._c.inc("lb_timeouts", blk.n, job=self.job)
+                    if not blk.conn.closed:
+                        blk.conn.complete(blk.slot, RESP_503 * blk.n)
+                    continue
+                if self._pick() is not None:
+                    self._dispatch(blk)
+                else:
+                    self._parked.append((deadline, blk))
+            # expire blocks stuck on a live-but-wedged upstream past the
+            # request timeout (hedging should beat this by orders of
+            # magnitude; this is the last-resort bound)
+            for up in list(self.upstreams.values()):
+                for conn in list(up.conns):
+                    expired = False
+                    while conn.expected and (
+                            now - conn.expected[0].t_admit
+                            > self.request_timeout_s):
+                        blk = conn.expected.popleft()
+                        conn.outstanding_rows -= blk.remaining
+                        expired = True
+                        if blk.cell.done:
+                            continue
+                        blk.cell.done = True
+                        self.outstanding_rows -= blk.n
+                        self._c.inc("lb_timeouts", blk.n, job=self.job)
+                        if not blk.conn.closed:
+                            blk.conn.complete(blk.slot, RESP_503 * blk.n)
+                    if expired:
+                        # the wedged replica may still answer the popped
+                        # blocks; on a pipelined FIFO those bytes would
+                        # be credited to the NEXT block — kill the
+                        # connection so the stream can never desync
+                        # (connection_lost rescues the younger blocks
+                        # onto a healthy replica and repools)
+                        try:
+                            conn.transport.abort()
+                        except Exception:
+                            try:
+                                conn.transport.close()
+                            except Exception:
+                                pass
+            self._maybe_resume()
+        finally:
+            self._schedule_sweep()
+
+
+class ServingLB:
+    """One LB process/listener: a :class:`FrontDoor` over an
+    :class:`LBApp` (convenience wrapper for tests and ``lb_main``)."""
+
+    def __init__(self, *, job: str = "job", host: str = "0.0.0.0",
+                 port: int = 0, **lb_kwargs) -> None:
+        self.app = LBApp(job=job, **lb_kwargs)
+        self.door = FrontDoor(self.app, host=host, port=port, job=job)
+
+    def start(self) -> "ServingLB":
+        self.door.start()
+        return self
+
+    @property
+    def port(self) -> int:
+        return self.door.port
+
+    def stop(self) -> None:
+        self.door.stop()
+
+
+def lb_main(env=None) -> int:
+    """The LB process entrypoint (``python -m edl_tpu.runtime.lb``):
+    discovery from EDL_COORD_ENDPOINT, listener on EDL_LB_PORT,
+    ``/metrics`` on EDL_LB_METRICS_PORT."""
+    import os
+    import signal
+
+    env = os.environ if env is None else env
+    from edl_tpu.coord.client import client_from_env
+
+    job = env.get("EDL_LB_JOB", "default/serving")
+    kv = client_from_env(env, disabled="discovery disabled")
+    static = {}
+    for i, addr in enumerate(
+            a for a in env.get("EDL_LB_UPSTREAMS", "").split(",") if a):
+        static[f"static-{i}"] = addr
+    lb = ServingLB(
+        job=job, host=env.get("EDL_LB_HOST", "0.0.0.0"),
+        port=int(env.get("EDL_LB_PORT", "0")), kv=kv,
+        static_upstreams=static,
+        pool=int(env.get("EDL_LB_POOL", "2")),
+        discovery_s=float(env.get("EDL_LB_DISCOVERY_S", "0.5")),
+        hedge_floor_ms=float(env.get("EDL_LB_HEDGE_FLOOR_MS", "10")),
+        hedge_cap_ms=float(env.get("EDL_LB_HEDGE_CAP_MS", "1000")),
+        hedge_k=float(env.get("EDL_LB_HEDGE_K", "3")),
+        hard_cap_rows=int(env.get("EDL_LB_CAP_ROWS", "65536")),
+        request_timeout_s=float(env.get("EDL_LB_REQUEST_TIMEOUT_S", "30")),
+        sweep_ms=float(env.get("EDL_LB_SWEEP_MS", "5")))
+    lb.start()
+    metrics_srv = None
+    if int(env.get("EDL_LB_METRICS_PORT", "0")) >= 0:
+        from edl_tpu.observability.health import serve_health
+
+        metrics_srv = serve_health(
+            int(env.get("EDL_LB_METRICS_PORT", "0")),
+            {"upstreams": lambda: any(
+                u.routable() for u in lb.app.upstreams.values())})
+    print(f"lb ready port={lb.port} metrics_port="
+          f"{metrics_srv.server_address[1] if metrics_srv else -1}",
+          flush=True)
+    stop = threading.Event()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            signal.signal(sig, lambda *_: stop.set())
+        except ValueError:
+            pass
+    try:
+        while not stop.wait(0.5):
+            pass
+    finally:
+        lb.stop()
+        if metrics_srv is not None:
+            metrics_srv.shutdown()
+        if kv is not None:
+            try:
+                kv.close()
+            except Exception:
+                pass
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover - process entrypoint
+    import sys
+
+    sys.exit(lb_main())

@@ -502,6 +502,25 @@ def orbax_load_state(path: str, task=MlpTask()):
         ckpt.close()
 
 
+def make_worker_coord(host: str, port: int):
+    """The supervisor's coordinator client: a :class:`CoordMux` slot
+    handle by default (doc/coordinator_scale.md — one multiplexed
+    connection per pod process; parked long-polls never starve the
+    keepalive, and the batched KEEPALIVE verb rides it), so the
+    ProcessKubelet/exec-kubelet harnesses run the same control-plane
+    path the coord_scale bench measures instead of a bespoke one.
+    ``EDL_COORD_MUX=0`` opts back into a plain per-process client."""
+    from edl_tpu.coord.client import CoordClient, CoordMux
+
+    if os.environ.get("EDL_COORD_MUX", "1") != "0":
+        try:
+            return CoordMux(host, port).client()
+        except Exception as exc:
+            print(f"warning: mux connect failed ({str(exc)[:80]}); "
+                  f"using plain client", file=sys.stderr, flush=True)
+    return CoordClient(host, port)
+
+
 def main(argv=None) -> int:
     import signal
     import threading
@@ -550,10 +569,8 @@ def main(argv=None) -> int:
     leave = threading.Event()
     signal.signal(signal.SIGTERM, lambda *_: leave.set())
 
-    from edl_tpu.coord.client import CoordClient
-
     host, _, port = args.coord.rpartition(":")
-    coord = CoordClient(host, int(port))
+    coord = make_worker_coord(host, int(port))
 
     # Data publication: EDL_MH_DATA_DIR switches from in-memory shards
     # (every worker re-derives the same split) to REAL shard files on

@@ -135,16 +135,47 @@ class ServeRequest:
         self.result: Any = None
         self.error: Optional[BaseException] = None
         self.slo_violation = False
+        self._callbacks: list[Callable[["ServeRequest"], None]] = []
+
+    def add_done_callback(self, fn: Callable[["ServeRequest"], None]
+                          ) -> None:
+        """Run ``fn(self)`` when the request completes or fails (on the
+        completing thread) — immediately if it already has.  The async
+        front door's fleet adapter and ``PoissonTraffic.await_all``'s
+        shared-condition wait both ride this instead of parking a thread
+        per request."""
+        if self._done.is_set():
+            fn(self)
+            return
+        self._callbacks.append(fn)
+        # completion may have raced the append: never lose the callback
+        # (remove is atomic; a concurrent _fire_callbacks pop wins the
+        # ValueError race and has already called fn)
+        if self._done.is_set():
+            try:
+                self._callbacks.remove(fn)
+            except ValueError:
+                return
+            fn(self)
+
+    def _fire_callbacks(self) -> None:
+        while self._callbacks:
+            try:
+                self._callbacks.pop(0)(self)
+            except Exception:  # a callback must never kill the serve loop
+                log.warn("request done-callback failed", request=self.id)
 
     def complete(self, result: Any) -> None:
         self.t_done = time.perf_counter()
         self.result = result
         self._done.set()
+        self._fire_callbacks()
 
     def fail(self, exc: BaseException) -> None:
         self.t_done = time.perf_counter()
         self.error = exc
         self._done.set()
+        self._fire_callbacks()
 
     def wait(self, timeout: Optional[float] = None):
         """Block for the reply; raises the replica-side error if the
@@ -1208,23 +1239,44 @@ class PoissonTraffic:
 
     def await_all(self, timeout_s: float = 30.0) -> dict:
         """Wait for every sent request; returns the closed-loop tally
-        the bench/CI assert on (served / dropped / errors / latencies)."""
+        the bench/CI assert on (served / dropped / errors / latencies).
+
+        One SHARED condition wait: every request signals a common
+        counter via its done-callback and this thread parks until all
+        have fired or the deadline passes — a wedged tail costs one
+        deadline wait total, not a poll per wedged request (at 10⁵-qps
+        open-loop scale a per-request O(ms) poll would perturb the very
+        latencies the driver measures)."""
+        pending = [r for r in self.sent if not r._done.is_set()]
+        remaining = [len(pending)]
+        cond = threading.Condition()
+
+        def on_done(_req) -> None:
+            with cond:
+                remaining[0] -= 1
+                if remaining[0] <= 0:
+                    cond.notify_all()
+
+        for req in pending:
+            req.add_done_callback(on_done)
+        deadline = time.perf_counter() + timeout_s
+        with cond:
+            while remaining[0] > 0:
+                left = deadline - time.perf_counter()
+                if left <= 0:
+                    break
+                cond.wait(left)
         served = dropped = errors = timeouts = 0
         lats: list[float] = []
-        deadline = time.perf_counter() + timeout_s
         for req in self.sent:
-            try:
-                # past the shared deadline, poll instead of waiting: a
-                # wedged tail must cost O(ms) per request, not 100 ms
-                # each across thousands
-                req.wait(max(deadline - time.perf_counter(), 0.001))
+            if not req._done.is_set():
+                timeouts += 1
+            elif req.error is None:
                 served += 1
                 lats.append(req.latency_s)
-            except RequestDropped:
+            elif isinstance(req.error, RequestDropped):
                 dropped += 1
-            except TimeoutError:
-                timeouts += 1
-            except Exception:
+            else:
                 errors += 1
         lat = np.sort(np.asarray(lats)) if lats else np.asarray([0.0])
         return {
@@ -1284,17 +1336,9 @@ def serve_main(env=None) -> int:
     # /metrics address so the scrape plane discovers it — set
     # EDL_COORD_ENDPOINT (host:port) on the pod/harness to enable;
     # without it the replica still serves /metrics, just undiscovered
-    kv = None
-    coord_ep = env.get("EDL_COORD_ENDPOINT", "")
-    if coord_ep and ":" in coord_ep:
-        from edl_tpu.coord.client import CoordClient
+    from edl_tpu.coord.client import client_from_env
 
-        chost, _, cport = coord_ep.rpartition(":")
-        try:
-            kv = CoordClient(chost, int(cport))
-        except Exception as exc:
-            print(f"warning: coordinator {coord_ep} unreachable "
-                  f"({str(exc)[:80]}); metrics address not published")
+    kv = client_from_env(env, disabled="metrics address not published")
     fleet = ServingFleet(
         lambda p, b: mlp.apply(p, b[0]), params,
         example_row=(np.zeros((sizes[0],), np.float32),),
@@ -1329,6 +1373,25 @@ def serve_main(env=None) -> int:
             ttl_s=float(env.get("EDL_SERVING_METRICS_TTL_S", "30")))
 
     class Handler(BaseHTTPRequestHandler):
+        # HTTP/1.1 with Content-Length on every reply = keep-alive by
+        # default: even this legacy thread-per-connection path (kept as
+        # the bench baseline; EDL_SERVING_FRONTDOOR=legacy) stops paying
+        # a TCP handshake per request.  The read timeout bounds how
+        # long an idle keep-alive client may pin its thread (close-per-
+        # request used to bound thread lifetime; keep-alive must not
+        # hand that bound to the client).
+        protocol_version = "HTTP/1.1"
+        timeout = 60
+
+        def do_GET(self):  # noqa: N802 (http.server casing)
+            if self.path != "/healthz":
+                self.send_error(404)
+                return
+            ready = fleet.replicas_ready() >= 1
+            self.send_response(200 if ready else 503)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+
         def do_POST(self):  # noqa: N802 (http.server casing)
             if self.path != "/predict":
                 self.send_error(404)
@@ -1364,23 +1427,45 @@ def serve_main(env=None) -> int:
         def log_message(self, *a):  # quiet; metrics carry the signal
             pass
 
-    srv = ThreadingHTTPServer(
-        ("0.0.0.0", int(env.get("EDL_SERVING_PORT", "8500"))), Handler)
+    # the front door: async event loop by default (persistent keep-alive
+    # connections, pipelining, the f32 fast path — doc/serving.md
+    # §data-plane); EDL_SERVING_FRONTDOOR=legacy keeps the PR 10
+    # thread-per-connection server (the bench baseline), now at least
+    # HTTP/1.1 keep-alive
+    frontdoor_kind = env.get("EDL_SERVING_FRONTDOOR", "async")
+    port = int(env.get("EDL_SERVING_PORT", "8500"))
+    srv = door = None
+    if frontdoor_kind == "legacy":
+        srv = ThreadingHTTPServer(("0.0.0.0", port), Handler)
+        bound = srv.server_address[1]
+        t = threading.Thread(target=srv.serve_forever, daemon=True)
+        t.start()
+    else:
+        from edl_tpu.runtime.frontdoor import FleetApp, FrontDoor
+
+        door = FrontDoor(FleetApp(fleet, sizes[0]), port=port, job=job)
+        door.start()
+        bound = door.port
     log.info("model server ready", job=job, generation=fleet.generation,
-             port=srv.server_address[1])
+             port=bound, frontdoor=frontdoor_kind)
+    # machine-parseable ready marker (harnesses/bench wait on it to
+    # learn an ephemeral port; logging may not have a handler here)
+    print(f"model server ready port={bound} frontdoor={frontdoor_kind} "
+          f"generation={fleet.generation}", flush=True)
     stop = threading.Event()
     for sig in (signal.SIGTERM, signal.SIGINT):
         try:
             signal.signal(sig, lambda *_: stop.set())
         except ValueError:
             pass  # not the main thread (tests)
-    t = threading.Thread(target=srv.serve_forever, daemon=True)
-    t.start()
     try:
         while not stop.wait(0.5):
             pass
     finally:
-        srv.shutdown()
+        if srv is not None:
+            srv.shutdown()
+        if door is not None:
+            door.stop()
         fleet.stop(drain=True)  # graceful: finish the queue, drop
         # nothing; also unpublishes the metrics address + stops /metrics
         if health is not None:

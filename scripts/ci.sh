@@ -821,4 +821,157 @@ print("determinism smoke OK:", div, "vw_remaps",
       get_counters().get("vw_remaps") - c0)
 EOF
 
+echo "== front-door smoke (LB → 2 replicas: keep-alive, hedge rescue, strict metrics)"
+# The serving data plane tripwire (doc/serving.md §data-plane): a short
+# pipelined burst through the load-balancer tier into two async
+# front-door replicas must (a) ride persistent connections — requests ≫
+# connections, (b) stay under the smoke SLO at p99, (c) drop nothing,
+# (d) rescue an injected straggler iteration via a hedge whose late
+# primary response is consumed and DISCARDED, and (e) leave the new
+# edl_lb_* / edl_frontdoor_* series green under the strict exposition
+# parser, fetched over real HTTP like a production scraper would.
+JAX_PLATFORMS=cpu python - <<'EOF'
+import threading, time, socket, re, urllib.request
+import numpy as np, jax
+
+from edl_tpu.models import mlp
+from edl_tpu.observability.collector import get_counters
+from edl_tpu.observability.health import serve_health
+from edl_tpu.observability.metrics import iter_samples, parse_exposition
+from edl_tpu.runtime.serving import ElasticServer
+from edl_tpu.runtime.frontdoor import (BatchApp, FrontDoor, FD_READY,
+                                       FD_RELOADING,
+                                       build_predict_request)
+from edl_tpu.runtime.lb import ServingLB
+
+SLO_MS = 150.0
+JOB = "ci/frontdoor"
+SIZES = [8, 16, 4]
+params = mlp.init(jax.random.key(0), SIZES)
+
+class KV:  # in-process stand-in for the coordinator KV verbs used here
+    def __init__(self): self.d, self.l = {}, threading.Lock()
+    def kv_set(self, k, v):
+        with self.l: self.d[k] = bytes(v)
+    def kv_get(self, k):
+        with self.l: return self.d.get(k)
+    def kv_del(self, k):
+        with self.l: return self.d.pop(k, None) is not None
+    def kv_keys(self, p=""):
+        with self.l: return [k for k in self.d if k.startswith(p)]
+
+kv = KV()
+def build():
+    return ElasticServer(lambda p, b: mlp.apply(p, b[0]), params)
+apps, doors = {}, {}
+for name in ("ra", "rb"):
+    apps[name] = BatchApp(build, SIZES[0], job=JOB, replica=name, kv=kv,
+                          max_batch=32, max_queue_ms=1.0, addr_ttl_s=10.0)
+    doors[name] = FrontDoor(apps[name], host="127.0.0.1",
+                            job=f"{JOB}/{name}").start()
+for app in apps.values():
+    assert app.wait_ready(120)
+lb = ServingLB(job=JOB, host="127.0.0.1", kv=kv, pool=2, discovery_s=0.1,
+               sweep_ms=3.0, hedge_floor_ms=20.0).start()
+deadline = time.monotonic() + 30
+while time.monotonic() < deadline and sum(
+        1 for u in lb.app.upstreams.values() if u.routable()) < 2:
+    time.sleep(0.05)
+assert sum(1 for u in lb.app.upstreams.values() if u.routable()) == 2
+
+row = np.ones((SIZES[0],), np.float32)
+req = build_predict_request(row)
+
+def read_n(s, n, timeout=30.0):
+    s.settimeout(timeout); buf = b""; out = []
+    while len(out) < n:
+        i = buf.find(b"\r\n\r\n")
+        if i < 0:
+            buf += s.recv(1 << 20); continue
+        head = buf[:i + 4]
+        st = int(head.split(b" ", 2)[1])
+        cl = int(re.search(rb"[Cc]ontent-[Ll]ength: (\d+)", head).group(1))
+        while len(buf) < i + 4 + cl:
+            buf += s.recv(1 << 20)
+        out.append(st); buf = buf[i + 4 + cl:]
+    return out
+
+try:
+    # (a)+(b)+(c): 1000 requests over TWO keep-alive connections, in
+    # pipelined blocks of 50, per-block closed-loop latency recorded
+    conns_before = doors["ra"].connections + doors["rb"].connections
+    lats = []
+    socks = []
+    for _ in range(2):
+        s = socket.create_connection(("127.0.0.1", lb.port), timeout=30)
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        socks.append(s)
+    statuses = []
+    for k in range(20):
+        s = socks[k % 2]
+        t0 = time.perf_counter()
+        s.sendall(req * 50)
+        statuses += read_n(s, 50)
+        lats.append(time.perf_counter() - t0)
+    assert statuses.count(200) == 1000, statuses[:20]
+    p99_ms = sorted(lats)[int(0.99 * (len(lats) - 1))] * 1000.0
+    assert p99_ms <= SLO_MS, p99_ms
+    # keep-alive held: the replica doors saw ONLY the LB's pooled dials
+    assert doors["ra"].connections + doors["rb"].connections \
+        == conns_before, "new upstream connections appeared mid-burst"
+    served = sum(a.requests_served for a in apps.values())
+    assert served >= 1000
+
+    # (d) the straggler drill: wedge ra off the LB path, steer the next
+    # block onto it, regate rb so the hedge sweep has a target
+    c = get_counters()
+    apps["ra"]._stall_once_ms = 2000
+    d = socket.create_connection(("127.0.0.1", doors["ra"].port))
+    d.sendall(req); time.sleep(0.05)
+    apps["rb"]._set_state(FD_RELOADING)
+    while lb.app.upstreams["rb"].state != FD_RELOADING: time.sleep(0.02)
+    s = socks[0]
+    s.sendall(req * 4); time.sleep(0.05)
+    apps["rb"]._set_state(FD_READY)
+    while lb.app.upstreams["rb"].state != FD_READY: time.sleep(0.02)
+    sts = read_n(s, 4)
+    assert sts == [200] * 4, sts
+    read_n(d, 1); d.close()
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and (
+            c.get("lb_hedges", job=JOB, result="win") == 0
+            or c.get("lb_hedges", job=JOB, result="lose") == 0):
+        time.sleep(0.05)
+    wins = c.get("lb_hedges", job=JOB, result="win")
+    loses = c.get("lb_hedges", job=JOB, result="lose")
+    assert wins > 0, "hedge never fired"
+    assert loses > 0, "straggler's late response never discarded"
+    assert c.get("lb_overload_sheds", job=JOB) == 0
+    assert c.get("lb_timeouts", job=JOB) == 0
+    for s in socks:
+        s.close()
+
+    # (e) the new series, over real HTTP, through the strict parser
+    msrv = serve_health(0, {"ok": lambda: True}, host="127.0.0.1")
+    text = urllib.request.urlopen(
+        f"http://127.0.0.1:{msrv.server_address[1]}/metrics",
+        timeout=10).read().decode()
+    parse_exposition(text)  # strict grammar or die
+    got = {n for n, _l, v in iter_samples(text) if v > 0}
+    for need in ("edl_lb_requests_total", "edl_lb_responses_total",
+                 "edl_lb_hedges_total", "edl_lb_hedges_fired_total",
+                 "edl_frontdoor_requests_served_total",
+                 "edl_frontdoor_connections_total"):
+        assert need in got, (need, sorted(got))
+    msrv.shutdown()
+    print("front-door smoke OK:", {
+        "requests": 1004, "lb_connections": 2,
+        "p99_ms": round(p99_ms, 2), "hedge_wins": int(wins),
+        "hedge_discards": int(loses)})
+finally:
+    lb.stop()
+    for door in doors.values():
+        door.stop()
+EOF
+
 echo "CI OK"

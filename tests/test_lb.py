@@ -1,0 +1,561 @@
+"""The serving load-balancer tier (runtime/lb.py): KV discovery with
+ready-gate routing, hedging with first-wins cancellation, connection
+pooling, priority shedding, and the killed-replica rescue drill — the
+ISSUE-13 satellite checklist, in-process."""
+
+import json
+import os
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+
+from edl_tpu.models import mlp  # noqa: E402
+from edl_tpu.observability.collector import get_counters  # noqa: E402
+from edl_tpu.runtime.frontdoor import (  # noqa: E402
+    FD_READY,
+    FD_RELOADING,
+    SERVING_ADDR_PREFIX,
+    BatchApp,
+    FrontDoor,
+    build_predict_request,
+)
+from edl_tpu.runtime.lb import ServingLB  # noqa: E402
+
+from tests.test_frontdoor import connect, read_responses  # noqa: E402
+
+SIZES = [8, 16, 4]
+PARAMS = mlp.init(jax.random.key(0), SIZES)
+
+
+class FakeKV:
+    """Thread-safe dict with the coordinator KV verbs discovery and the
+    state publisher use."""
+
+    def __init__(self):
+        self._d = {}
+        self._lock = threading.Lock()
+
+    def kv_set(self, key, value):
+        with self._lock:
+            self._d[key] = bytes(value)
+
+    def kv_get(self, key):
+        with self._lock:
+            return self._d.get(key)
+
+    def kv_del(self, key):
+        with self._lock:
+            return self._d.pop(key, None) is not None
+
+    def kv_keys(self, prefix=""):
+        with self._lock:
+            return [k for k in self._d if k.startswith(prefix)]
+
+
+def spin_replica(kv, job, replica, **kw):
+    from edl_tpu.runtime.serving import ElasticServer
+
+    def build():
+        return ElasticServer(lambda p, b: mlp.apply(p, b[0]), PARAMS)
+
+    app = BatchApp(build, SIZES[0], job=job, replica=replica, kv=kv,
+                   max_batch=kw.pop("max_batch", 16),
+                   max_queue_ms=kw.pop("max_queue_ms", 0.5),
+                   addr_ttl_s=kw.pop("addr_ttl_s", 5.0), **kw)
+    door = FrontDoor(app, host="127.0.0.1", job=f"{job}-{replica}").start()
+    assert app.wait_ready(120)
+    return app, door
+
+
+class TestLBTier:
+    """Two live replicas + one LB, discovered through the FakeKV the
+    replicas publish their ready-gate keys to."""
+
+    JOB = "lbtest/fleet"
+
+    @classmethod
+    def setup_class(cls):
+        cls.kv = FakeKV()
+        cls.app_a, cls.door_a = spin_replica(cls.kv, cls.JOB, "ra")
+        cls.app_b, cls.door_b = spin_replica(cls.kv, cls.JOB, "rb")
+        cls.lb = ServingLB(
+            job=cls.JOB, host="127.0.0.1", kv=cls.kv, pool=2,
+            discovery_s=0.1, sweep_ms=3.0, hedge_floor_ms=30.0,
+            request_timeout_s=20.0).start()
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if sum(1 for u in cls.lb.app.upstreams.values()
+                   if u.routable()) == 2:
+                break
+            time.sleep(0.05)
+        assert sum(1 for u in cls.lb.app.upstreams.values()
+                   if u.routable()) == 2, cls.lb.app.upstreams
+
+    @classmethod
+    def teardown_class(cls):
+        cls.lb.stop()
+        cls.door_a.stop()
+        cls.door_b.stop()
+
+    def _upstream(self, name):
+        return self.lb.app.upstreams[name]
+
+    def _send(self, n, sock=None, priority=None):
+        row = np.ones((SIZES[0],), np.float32)
+        s = sock or connect(self.lb.port)
+        s.sendall(b"".join(build_predict_request(row, priority=priority)
+                           for _ in range(n)))
+        return s
+
+    def test_discovery_published_keys(self):
+        keys = self.kv.kv_keys(f"{SERVING_ADDR_PREFIX}{self.JOB}/")
+        assert len(keys) == 2
+
+    def test_routes_and_answers(self):
+        s = self._send(20)
+        resps = read_responses(s, 20)
+        s.close()
+        assert [st for st, _ in resps] == [200] * 20
+        ref = np.asarray(mlp.apply(
+            PARAMS, np.ones((1, SIZES[0]), np.float32)))[0]
+        np.testing.assert_allclose(np.frombuffer(resps[0][1], "<f4"), ref,
+                                   atol=1e-5)
+
+    def test_connection_pool_reuse(self):
+        """Hundreds of requests ride the SAME pooled upstream
+        connections: the replica doors' accepted-connection count must
+        not move while requests pour through."""
+        # park the hedger: on a loaded host a burst aging past the
+        # 30 ms floor would hedge and double-count requests_served
+        saved = (self.lb.app.hedge_floor_ms, self.lb.app.hedge_cap_ms,
+                 self.lb.app.hedge_delay_s)
+        self.lb.app.hedge_floor_ms = self.lb.app.hedge_cap_ms = 60_000.0
+        self.lb.app.hedge_delay_s = 60.0
+        try:
+            conns_before = (self.door_a.connections
+                            + self.door_b.connections)
+            served_a = self.app_a.requests_served
+            served_b = self.app_b.requests_served
+            for _ in range(3):
+                s = self._send(100)
+                resps = read_responses(s, 100)
+                assert [st for st, _ in resps] == [200] * 100
+                s.close()
+            assert self.door_a.connections + self.door_b.connections \
+                == conns_before
+            served = (self.app_a.requests_served - served_a
+                      + self.app_b.requests_served - served_b)
+            assert served == 300  # every request crossed an upstream
+        finally:
+            (self.lb.app.hedge_floor_ms, self.lb.app.hedge_cap_ms,
+             self.lb.app.hedge_delay_s) = saved
+
+    def test_ready_gate_routes_around_reloading(self):
+        """A RELOADING replica takes no new traffic; regated, it takes
+        traffic again — the rolling-reload invariant."""
+        self.app_b._set_state(FD_RELOADING)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline \
+                and self._upstream("rb").state == FD_READY:
+            time.sleep(0.02)
+        assert self._upstream("rb").state == FD_RELOADING
+        served_b = self.app_b.requests_served
+        reqs_b = self._upstream("rb").requests
+        s = self._send(60)
+        resps = read_responses(s, 60)
+        s.close()
+        assert [st for st, _ in resps] == [200] * 60
+        assert self._upstream("rb").requests == reqs_b
+        assert self.app_b.requests_served == served_b
+        # regate: traffic returns
+        self.app_b._set_state(FD_READY)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline \
+                and self._upstream("rb").state != FD_READY:
+            time.sleep(0.02)
+        got = False
+        for _ in range(10):  # routing is least-outstanding; nudge it
+            s = self._send(40)
+            read_responses(s, 40)
+            s.close()
+            if self._upstream("rb").requests > reqs_b:
+                got = True
+                break
+        assert got, "regated replica never took traffic again"
+
+    def _gate_rb(self, state):
+        self.app_b._set_state(state)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline \
+                and self._upstream("rb").state != state:
+            time.sleep(0.02)
+        assert self._upstream("rb").state == state
+
+    def test_hedge_fires_and_first_wins(self):
+        """An injected straggler iteration on one replica: the LB hedges
+        the aged block to the peer (win counted), and the straggler's
+        late response is consumed and DISCARDED (lose counted) — first
+        wins, nothing errors, nothing duplicates client-side.
+
+        Deterministic steering: ra is wedged via a DIRECT request, rb is
+        gated while the LB block is sent (so it lands on ra), then rb is
+        regated so the hedge sweep has a target."""
+        c = get_counters()
+        wins0 = c.get("lb_hedges", job=self.JOB, result="win")
+        loses0 = c.get("lb_hedges", job=self.JOB, result="lose")
+        row = np.ones((SIZES[0],), np.float32)
+        # 1. wedge ra's next iteration, triggered off the LB's path
+        self.app_a._stall_once_ms = 1200
+        direct = connect(self.door_a.port)
+        direct.sendall(build_predict_request(row))
+        time.sleep(0.05)  # the wedged iteration is now in progress
+        # 2. gate rb so the LB block must land on ra's queue
+        self._gate_rb(FD_RELOADING)
+        s = self._send(4)
+        time.sleep(0.05)
+        # 3. regate rb: the hedge sweep now has a fast target
+        self._gate_rb(FD_READY)
+        resps = read_responses(s, 4, timeout=30)
+        s.close()
+        assert [st for st, _ in resps] == [200] * 4
+        read_responses(direct, 1, timeout=30)
+        direct.close()
+        # the hedge won (rb answered while ra slept) and ra's late
+        # response was consumed + discarded
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and (
+                c.get("lb_hedges", job=self.JOB, result="win") == wins0
+                or c.get("lb_hedges", job=self.JOB,
+                         result="lose") == loses0):
+            time.sleep(0.05)
+        assert c.get("lb_hedges", job=self.JOB, result="win") > wins0
+        assert c.get("lb_hedges", job=self.JOB, result="lose") > loses0
+
+    def test_killed_replica_rescued_zero_errors(self):
+        """Abruptly sever a replica mid-burst (its queued work dies with
+        its connections): every outstanding block is re-sent to the
+        survivor — the client sees 200s only, and rescues are counted."""
+        c = get_counters()
+        rescues0 = c.get("lb_rescues", job=self.JOB)
+        row = np.ones((SIZES[0],), np.float32)
+        # park the hedger (floor/cap AND the live delay >> the drill)
+        # so the RESCUE path — not a racing hedge — saves the burst
+        self.lb.app.hedge_floor_ms = self.lb.app.hedge_cap_ms = 60_000.0
+        self.lb.app.hedge_delay_s = 60.0
+        # wedge ra off the LB path, gate rb so the burst lands on ra
+        # (long enough that the gate waits + discovery sweeps before the
+        # sever stay comfortably inside the wedge)
+        self.app_a._stall_once_ms = 3000
+        direct = connect(self.door_a.port)
+        direct.sendall(build_predict_request(row))
+        time.sleep(0.05)
+        self._gate_rb(FD_RELOADING)
+        s = self._send(40)
+        time.sleep(0.1)  # the burst is now queued on ra
+        self._gate_rb(FD_READY)
+        # sever ra's sockets (RST-style: transports abort via the loop)
+        door = self.door_a
+
+        def sever():
+            for conn in list(door.conns):
+                conn.transport.abort()
+
+        door.call_soon(sever)
+        resps = read_responses(s, 40, timeout=30)
+        s.close()
+        assert [st for st, _ in resps] == [200] * 40
+        assert c.get("lb_rescues", job=self.JOB) > rescues0
+        direct.close()  # severed with the rest of ra's connections
+
+    def test_connection_close_does_not_kill_upstream_pool(self):
+        """A client's hop-by-hop ``Connection: close`` is stripped
+        before forwarding: the client hop closes, but the pooled
+        pipelined upstream connections survive (no rescue storm, no
+        redial per close-marked request)."""
+        conns_before = self.door_a.connections + self.door_b.connections
+        row = np.ones((SIZES[0],), np.float32)
+        body = np.ascontiguousarray(row, dtype="<f4").tobytes()
+        req = (b"POST /predict HTTP/1.1\r\nHost: t\r\n"
+               b"Content-Type: application/x-edl-f32\r\n"
+               b"Connection: close\r\n"
+               b"Content-Length: %d\r\n\r\n" % len(body)) + body
+        for _ in range(3):
+            s = connect(self.lb.port)
+            s.sendall(req)
+            (st, b), = read_responses(s, 1)
+            assert st == 200
+            assert s.recv(1 << 16) == b""  # client hop DID close
+            s.close()
+        # follow-up traffic still rides the same pooled connections
+        s = self._send(20)
+        resps = read_responses(s, 20)
+        s.close()
+        assert [st for st, _ in resps] == [200] * 20
+        assert self.door_a.connections + self.door_b.connections \
+            == conns_before
+
+    def test_json_forwarded_verbatim(self):
+        row = np.arange(SIZES[0], dtype=np.float32)
+        body = json.dumps({"inputs": row.tolist()}).encode()
+        jreq = (b"POST /predict HTTP/1.1\r\nHost: t\r\n"
+                b"Content-Type: application/json\r\n"
+                b"Content-Length: %d\r\n\r\n" % len(body)) + body
+        s = connect(self.lb.port)
+        s.sendall(jreq)
+        (st, b), = read_responses(s, 1)
+        s.close()
+        assert st == 200
+        ref = np.asarray(mlp.apply(PARAMS, row[None, :]))[0]
+        np.testing.assert_allclose(
+            np.asarray(json.loads(b.decode())["outputs"]), ref, atol=1e-5)
+
+    def test_healthz(self):
+        s = connect(self.lb.port)
+        s.sendall(b"GET /healthz HTTP/1.1\r\nHost: t\r\n\r\n")
+        (st, _), = read_responses(s, 1)
+        s.close()
+        assert st == 200
+
+    def test_admin_verbs_not_forwarded(self):
+        """The LB is not a transparent proxy for the replica admin
+        surface: /admin/* from a client gets a 404 at the LB, never a
+        forwarded drill verb."""
+        s = connect(self.lb.port)
+        s.sendall(b"POST /admin/stall HTTP/1.1\r\nHost: t\r\n"
+                  b"Content-Length: 6\r\n\r\n300000")
+        (st, _), = read_responses(s, 1)
+        s.close()
+        assert st == 404
+        assert self.app_a._stall_once_ms == 0.0
+        assert self.app_b._stall_once_ms == 0.0
+
+
+class TestLBShedding:
+    """Priority shedding against the LB-wide outstanding count (tiny
+    caps, one deliberately wedged replica)."""
+
+    JOB = "lbtest/shed"
+
+    @classmethod
+    def setup_class(cls):
+        cls.kv = FakeKV()
+        cls.app, cls.door = spin_replica(cls.kv, cls.JOB, "r0",
+                                         max_batch=8)
+        cls.lb = ServingLB(
+            job=cls.JOB, host="127.0.0.1", kv=cls.kv, pool=1,
+            discovery_s=0.1, sweep_ms=5.0, hedge_floor_ms=10_000.0,
+            hard_cap_rows=32, soft_cap_rows=16).start()
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and not any(
+                u.routable() for u in cls.lb.app.upstreams.values()):
+            time.sleep(0.05)
+        assert any(u.routable() for u in cls.lb.app.upstreams.values())
+
+    @classmethod
+    def teardown_class(cls):
+        cls.lb.stop()
+        cls.door.stop()
+
+    def test_priority_shed_order_under_overload(self):
+        c = get_counters()
+        row = np.ones((SIZES[0],), np.float32)
+        self.app._stall_once_ms = 400
+        s = connect(self.lb.port)
+        s.sendall(build_predict_request(row) * 16)  # fill to soft cap
+        time.sleep(0.1)
+        low0 = c.get("lb_overload_sheds", job=self.JOB, priority="low")
+        s.sendall(build_predict_request(row, priority="low"))
+        s.sendall(build_predict_request(row, priority="normal"))
+        s.sendall(build_predict_request(row, priority="high"))
+        resps = read_responses(s, 19, timeout=30)
+        s.close()
+        statuses = [st for st, _ in resps]
+        assert statuses[:16] == [200] * 16
+        assert statuses[16] == 429  # low shed first
+        assert statuses[17] == 200  # normal still admitted
+        assert statuses[18] == 200  # high rides the reserve band
+        assert c.get("lb_overload_sheds", job=self.JOB,
+                     priority="low") == low0 + 1
+        # overload degraded in priority order and nothing was dropped:
+        # every request got a fast, definitive answer
+        assert len(resps) == 19
+
+
+def test_request_timeout_kills_desynced_upstream_conn():
+    """A block expired by the request-timeout last resort must take its
+    pipelined upstream connection with it: the wedged replica's eventual
+    late responses would otherwise be credited to the NEXT block on the
+    FIFO — silently wrong outputs forever.  The client gets a 503, the
+    stale connection dies (the fake upstream sees EOF), and the repooled
+    fresh connection serves the next request correctly."""
+    c = get_counters()
+    accepted = []
+    srv = socket.socket()
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(4)
+    halt = threading.Event()
+
+    def acceptor():
+        while not halt.is_set():
+            try:
+                conn, _ = srv.accept()
+            except OSError:
+                return
+            accepted.append(conn)
+
+    t = threading.Thread(target=acceptor, daemon=True)
+    t.start()
+    lb = ServingLB(
+        job="lbtest/timeout", host="127.0.0.1",
+        static_upstreams={"r0": f"127.0.0.1:{srv.getsockname()[1]}"},
+        pool=1, sweep_ms=5.0, hedge_floor_ms=60_000.0,
+        hedge_cap_ms=60_000.0, request_timeout_s=0.3).start()
+    try:
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and not accepted:
+            time.sleep(0.02)
+        assert accepted, "LB never dialed the upstream"
+        first = accepted[0]
+        timeouts0 = c.get("lb_timeouts", job="lbtest/timeout")
+        row = np.ones((SIZES[0],), np.float32)
+        s = connect(lb.port)
+        s.sendall(build_predict_request(row))  # upstream never answers
+        (st, _), = read_responses(s, 1, timeout=10)
+        assert st == 503  # timed out, not hung
+        assert c.get("lb_timeouts", job="lbtest/timeout") == timeouts0 + 1
+        # the stale connection is DEAD: the fake upstream reads EOF
+        first.settimeout(10)
+        first.recv(1 << 16)  # drain the forwarded request bytes
+        assert first.recv(1 << 16) == b""  # EOF: the LB killed the conn
+        # the pool re-dials; the fresh connection serves correctly
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and len(accepted) < 2:
+            time.sleep(0.02)
+        assert len(accepted) >= 2, "LB never repooled after the kill"
+        fresh = accepted[-1]
+        s.sendall(build_predict_request(row))
+        fresh.settimeout(10)
+        fresh.recv(1 << 16)
+        fresh.sendall(b"HTTP/1.1 200 OK\r\nContent-Length: 2\r\n\r\nhi")
+        (st2, body2), = read_responses(s, 1, timeout=10)
+        assert (st2, body2) == (200, b"hi")  # right response, right block
+        s.close()
+    finally:
+        halt.set()
+        srv.close()
+        lb.stop()
+        for conn in accepted:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+
+def test_lb_static_upstreams_no_kv():
+    """The LB also runs without a coordinator (static upstream list) —
+    the zero-dependency deployment shape."""
+    from edl_tpu.runtime.serving import ElasticServer
+
+    def build():
+        return ElasticServer(lambda p, b: mlp.apply(p, b[0]), PARAMS)
+
+    app = BatchApp(build, SIZES[0], job="lbtest/static", replica="r0")
+    door = FrontDoor(app, host="127.0.0.1", job="lbtest/static").start()
+    assert app.wait_ready(120)
+    lb = ServingLB(job="lbtest/static", host="127.0.0.1",
+                   static_upstreams={"r0": f"127.0.0.1:{door.port}"},
+                   pool=1).start()
+    try:
+        time.sleep(0.3)
+        row = np.ones((SIZES[0],), np.float32)
+        s = connect(lb.port)
+        s.sendall(build_predict_request(row) * 10)
+        resps = read_responses(s, 10)
+        s.close()
+        assert [st for st, _ in resps] == [200] * 10
+    finally:
+        lb.stop()
+        door.stop()
+
+
+def test_lb_static_upstream_redialed_after_late_start():
+    """A static upstream that was NOT listening when the LB started
+    (replica restart window, LB-first boot order) is re-dialed by the
+    sweep's pool top-up and becomes routable once it comes up — without
+    KV discovery there is no other redial trigger."""
+    from edl_tpu.runtime.serving import ElasticServer
+
+    def build():
+        return ElasticServer(lambda p, b: mlp.apply(p, b[0]), PARAMS)
+
+    probe = socket.socket()
+    probe.bind(("127.0.0.1", 0))
+    port = probe.getsockname()[1]
+    probe.close()  # nothing listens here yet
+    lb = ServingLB(job="lbtest/latestart", host="127.0.0.1",
+                   static_upstreams={"r0": f"127.0.0.1:{port}"},
+                   pool=1, sweep_ms=3.0).start()
+    door = None
+    try:
+        time.sleep(0.7)  # the initial dial has failed by now
+        assert not any(u.routable()
+                       for u in lb.app.upstreams.values())
+        app = BatchApp(build, SIZES[0], job="lbtest/latestart",
+                       replica="r0")
+        door = FrontDoor(app, host="127.0.0.1", port=port,
+                         job="lbtest/latestart").start()
+        assert app.wait_ready(120)
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            if any(u.routable() for u in lb.app.upstreams.values()):
+                break
+            time.sleep(0.05)
+        assert any(u.routable() for u in lb.app.upstreams.values())
+        row = np.ones((SIZES[0],), np.float32)
+        s = connect(lb.port)
+        s.sendall(build_predict_request(row) * 5)
+        resps = read_responses(s, 5)
+        s.close()
+        assert [st for st, _ in resps] == [200] * 5
+    finally:
+        lb.stop()
+        if door is not None:
+            door.stop()
+
+
+def test_unhedged_rescue_duplicate_not_a_hedge_lose():
+    """A rescue resend whose ORIGINAL also answered (sever raced the
+    response) is a late duplicate, not a hedge-duel loss — only duel
+    participants (hedge twins, hedged primaries/rescues) may move the
+    win/lose series dashboards read as duel outcomes."""
+    from edl_tpu.runtime.lb import LBApp, _Cell, _OutBlock
+
+    app = LBApp(job="lbtest/dup")
+    c = get_counters()
+    lose0 = c.get("lb_hedges", job="lbtest/dup", result="lose")
+    late0 = c.get("lb_late_responses", job="lbtest/dup")
+
+    class _ClosedConn:
+        closed = True
+
+    cell = _Cell()
+    cell.done = True  # the original already answered the client
+    rescue = _OutBlock(_ClosedConn(), None, 3, b"", cell, kind="rescue")
+    app.block_done(rescue)
+    assert c.get("lb_hedges", job="lbtest/dup", result="lose") == lose0
+    assert c.get("lb_late_responses", job="lbtest/dup") == late0 + 3
+    # a hedge twin losing the duel IS a duel outcome
+    hedge = _OutBlock(_ClosedConn(), None, 2, b"", cell, kind="hedge")
+    hedge.hedged = True
+    app.block_done(hedge)
+    assert c.get("lb_hedges", job="lbtest/dup",
+                 result="lose") == lose0 + 2
+    assert c.get("lb_late_responses", job="lbtest/dup") == late0 + 3
