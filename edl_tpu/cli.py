@@ -19,6 +19,9 @@ surface:
   validate      parse+default+validate a manifest, print the result
   fleet         one-screen fleet dashboard from scraped /metrics
                 (doc/observability.md §scrape-plane)
+  trace         render one request's stitched cross-process span tree
+                from per-process trace dumps (doc/serving.md §request
+                tracing)
 """
 
 from __future__ import annotations
@@ -379,6 +382,37 @@ def cmd_fleet(args) -> int:
     return 3 if firing and args.check else 0
 
 
+def cmd_trace(args) -> int:
+    """Stitch one trace id's spans across every tier that recorded them
+    (LB origin → front door → batcher; serving fleet phases) and render
+    the tree.  Sources: ``trace-*.json`` dumps each data-plane process
+    writes under EDL_TRACE_DIR (``Tracer.dump`` format) plus
+    ``flightrec-*.json`` flight records — pass ``--files`` to read
+    specific dumps instead.  Exit 1 when the id appears in no source
+    (sampled out, ring rotated, or the dir is wrong)."""
+    from edl_tpu.observability.tracing import (
+        discover_trace_files, load_trace_events, render_trace_tree,
+    )
+
+    paths = list(args.files or [])
+    if not paths:
+        paths = discover_trace_files(args.trace_dir)
+    if not paths:
+        print(f"error: no trace-*.json / flightrec-*.json under "
+              f"{args.trace_dir!r} — point --trace-dir at the dir the "
+              f"data-plane processes dump to (EDL_TRACE_DIR), or pass "
+              f"--files", file=sys.stderr)
+        return 2
+    events = load_trace_events(paths, args.trace_id)
+    if not events:
+        print(f"trace {args.trace_id} not found in {len(paths)} "
+              f"source file(s) — it may have been sampled out or the "
+              f"ring rotated past it", file=sys.stderr)
+        return 1
+    print(render_trace_tree(events, args.trace_id))
+    return 0
+
+
 def cmd_validate(args) -> int:
     import yaml
 
@@ -518,6 +552,19 @@ def build_parser() -> argparse.ArgumentParser:
     c.add_argument("--check", action="store_true",
                    help="exit 3 if any alert is firing (CI/cron probes)")
     c.set_defaults(fn=cmd_fleet)
+
+    c = sub.add_parser("trace", help="render one request's stitched "
+                                     "cross-process span tree by trace "
+                                     "id")
+    c.add_argument("trace_id")
+    c.add_argument("--trace-dir",
+                   default=os.environ.get("EDL_TRACE_DIR", "."),
+                   help="directory holding per-process trace-*.json "
+                        "dumps and flightrec-*.json records (default: "
+                        "EDL_TRACE_DIR, else .)")
+    c.add_argument("--files", nargs="*", default=None,
+                   help="explicit dump files (overrides --trace-dir)")
+    c.set_defaults(fn=cmd_trace)
 
     c = sub.add_parser("validate", help="validate a manifest")
     c.add_argument("manifest")

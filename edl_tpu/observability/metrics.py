@@ -98,6 +98,18 @@ def _label_key(labels: dict) -> tuple[tuple[str, str], ...]:
     return tuple(sorted((k, str(v)) for k, v in labels.items()))
 
 
+def _render_exemplar(ex: Optional[tuple[str, float, float]]) -> str:
+    """OpenMetrics-style exemplar suffix for a bucket sample line —
+    `` # {trace_id="…"} <value> <ts>`` — or empty.  The strict parser
+    (:func:`iter_samples`) accepts and returns these; series without
+    exemplars render byte-identically to before."""
+    if ex is None:
+        return ""
+    trace_id, value, ts = ex
+    return (f' # {{trace_id="{escape_label_value(trace_id)}"}} '
+            f"{format_value(value)} {ts:.3f}")
+
+
 def _render_labels(key: tuple[tuple[str, str], ...],
                    extra: tuple[tuple[str, str], ...] = ()) -> str:
     pairs = list(key) + list(extra)
@@ -225,6 +237,16 @@ class Histogram(_Family):
         # per label-set: [bucket counts..., +Inf count], sum
         self._counts: dict[tuple, list[int]] = {}
         self._sums: dict[tuple, float] = {}
+        #: per label-set: bucket index → (trace_id, value, wall_ts) —
+        #: the newest exemplar whose observation fell in that bucket
+        #: (OpenMetrics-style; rendered as a `# {trace_id="…"} v ts`
+        #: suffix on the bucket line, ingested by the scrape plane)
+        self._exemplars: dict[tuple, dict[int, tuple[str, float, float]]] = {}
+        #: exemplars older than this stop rendering: a once-ever
+        #: startup outlier must not be re-exposed (and so re-freshened
+        #: by every scraper) for days after its trace dumps rotated —
+        #: the handle would be dead by the time anyone follows it
+        self.exemplar_ttl_s: float = 600.0
 
     def observe(self, v: float, **labels) -> None:
         v = float(v)
@@ -273,6 +295,28 @@ class Histogram(_Family):
             counts[-1] += total
             self._sums[key] += s
 
+    def put_exemplar(self, v: float, trace_id: str, **labels) -> None:
+        """Attach a trace-id exemplar for an observation of ``v`` (the
+        caller pairs this with its observe/observe_many — the serving
+        data plane observes latencies in vectorized blocks and attaches
+        exemplars only for the sampled requests).  Kept per bucket, last
+        writer wins — the join from a scraped latency breach to the
+        trace id that explains it."""
+        v = float(v)
+        idx = len(self.buckets)  # +Inf
+        for i, b in enumerate(self.buckets):
+            if v <= b:
+                idx = i
+                break
+        with self._lock:
+            self._exemplars.setdefault(_label_key(labels), {})[idx] = (
+                str(trace_id), v, time.time())
+
+    def exemplars(self, **labels) -> list[tuple[str, float, float]]:
+        """This label set's current exemplars: (trace_id, value, ts)."""
+        with self._lock:
+            return list(self._exemplars.get(_label_key(labels), {}).values())
+
     def count(self, **labels) -> int:
         with self._lock:
             counts = self._counts.get(_label_key(labels))
@@ -299,19 +343,31 @@ class Histogram(_Family):
         name = PREFIX + sanitize_name(self.name)
         lines.append(f"# HELP {name} {self.help or self.name}")
         lines.append(f"# TYPE {name} histogram")
+        cutoff = (time.time() - self.exemplar_ttl_s
+                  if self.exemplar_ttl_s > 0 else None)
         with self._lock:
             keys = sorted(self._counts)
             snap = {k: (list(self._counts[k]), self._sums[k]) for k in keys}
+            exem = {}
+            for k in keys:
+                ex = self._exemplars.get(k)
+                if not ex:
+                    continue
+                if cutoff is not None:
+                    for i in [i for i, e in ex.items() if e[2] < cutoff]:
+                        del ex[i]  # expired: stop re-exposing it
+                exem[k] = dict(ex)
         for key in keys:
             counts, total = snap[key]
+            ex = exem.get(key) or {}
             for i, b in enumerate(self.buckets):
                 lines.append(
                     f"{name}_bucket"
                     f"{_render_labels(key, (('le', format_value(b)),))}"
-                    f" {counts[i]}")
+                    f" {counts[i]}{_render_exemplar(ex.get(i))}")
             lines.append(
                 f"{name}_bucket{_render_labels(key, (('le', '+Inf'),))}"
-                f" {counts[-1]}")
+                f" {counts[-1]}{_render_exemplar(ex.get(len(self.buckets)))}")
             lines.append(f"{name}_sum{_render_labels(key)} "
                          f"{format_value(total)}")
             lines.append(f"{name}_count{_render_labels(key)} {counts[-1]}")
@@ -499,11 +555,46 @@ def _unescape_label_value(value: str) -> str:
     return "".join(out)
 
 
-def iter_samples(text: str) -> list[tuple[str, dict, float]]:
+_PARSE_EXEMPLAR_RE = re.compile(
+    r"^\{(?P<labels>[^}]*)\} "
+    r"(?P<value>[0-9eE+.\-]+|\+Inf|-Inf|NaN)"
+    r"(?: (?P<ts>[0-9eE+.\-]+))?$")
+
+
+def _split_exemplar(line: str) -> tuple[str, str]:
+    """Split a sample line from its exemplar suffix at the first
+    `` # `` OUTSIDE quoted label values — a label value legitimately
+    containing ``" # "`` (valid, and produced verbatim by this module's
+    own renderer) must not be mistaken for an exemplar separator."""
+    in_q = False
+    esc = False
+    for i, ch in enumerate(line):
+        if esc:
+            esc = False
+        elif ch == "\\":
+            esc = True
+        elif ch == '"':
+            in_q = not in_q
+        elif (ch == "#" and not in_q and i >= 1
+              and line[i - 1] == " " and line[i + 1:i + 2] == " "):
+            return line[:i - 1], line[i + 2:]
+    return line, ""
+
+
+def iter_samples(text: str,
+                 exemplars: Optional[list] = None
+                 ) -> list[tuple[str, dict, float]]:
     """Parse exposition text into structured ``(name, labels, value)``
     samples, enforcing the full strict grammar (see
     :func:`parse_exposition`).  This is the form the scrape plane
-    ingests — label values are unescaped back to their raw form."""
+    ingests — label values are unescaped back to their raw form.
+
+    OpenMetrics-style exemplar suffixes on sample lines
+    (`` # {trace_id="…"} <value> [<ts>]``) are accepted; pass a list as
+    ``exemplars`` to collect them as
+    ``(name, labels, exemplar_labels, exemplar_value, ts_or_None)``
+    tuples — a malformed exemplar is a grammar violation like any
+    other."""
     samples: list[tuple[str, dict, float]] = []
     seen: set[str] = set()
     typed: dict[str, str] = {}
@@ -527,6 +618,26 @@ def iter_samples(text: str) -> list[tuple[str, dict, float]]:
             continue
         if line.startswith("#"):
             raise ExpositionError(f"unknown comment: {line!r}")
+        line, ex_body = _split_exemplar(line)
+        ex_parsed: Optional[tuple[dict, float, Optional[float]]] = None
+        if ex_body:
+            em = _PARSE_EXEMPLAR_RE.match(ex_body)
+            if not em:
+                raise ExpositionError(f"malformed exemplar: {ex_body!r}")
+            ex_labels: dict[str, str] = {}
+            if em.group("labels"):
+                for pair in _split_label_pairs(em.group("labels")):
+                    lm = _PARSE_LABEL_RE.match(pair)
+                    if not lm:
+                        raise ExpositionError(
+                            f"bad exemplar label {pair!r}")
+                    ex_labels[lm.group("k")] = _unescape_label_value(
+                        lm.group("v"))
+            ev = em.group("value")
+            ex_value = (math.inf if ev == "+Inf"
+                        else -math.inf if ev == "-Inf" else float(ev))
+            ex_ts = float(em.group("ts")) if em.group("ts") else None
+            ex_parsed = (ex_labels, ex_value, ex_ts)
         m = _PARSE_METRIC_RE.match(line)
         if not m:
             raise ExpositionError(f"malformed sample line: {line!r}")
@@ -548,6 +659,8 @@ def iter_samples(text: str) -> list[tuple[str, dict, float]]:
         value = (math.inf if v == "+Inf"
                  else -math.inf if v == "-Inf" else float(v))
         samples.append((m.group("name"), labels, value))
+        if ex_parsed is not None and exemplars is not None:
+            exemplars.append((m.group("name"), labels) + ex_parsed)
     _check_histogram_contracts(samples, typed)
     return samples
 
@@ -693,6 +806,9 @@ def _dump_flight_record_locked(dir_path, reason, slug, stamp, extra,
         "counters": get_counters().snapshot(),
         "metrics_text": registry.render(),
         "trace_events": [asdict(e) for e in tracer.events()],
+        # the wall anchor lets `edl-tpu trace` align these events with
+        # other processes' dumps (tracing.load_trace_events)
+        "trace_wall_anchor_s": getattr(tracer, "_wall_anchor", None),
     }
     # the goodput ledger snapshot rides along: the post-mortem for a
     # hang includes what the hang cost (best-effort — processes without

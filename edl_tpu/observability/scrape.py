@@ -16,7 +16,11 @@ fed by an in-process harness hook.  This module is the consumer:
   with the same strict :func:`~edl_tpu.observability.metrics.
   parse_exposition` grammar the tests enforce, and stores bounded
   per-series time-series rings supporting windowed rate / delta /
-  sum-by-label / histogram-quantile queries.
+  sum-by-label / histogram-quantile queries — plus the trace-id
+  **exemplars** the serving data plane attaches to its latency
+  buckets (kept per target so a dead pod's exemplars age out with its
+  series; ``exemplars()`` returns them slowest-first, each one an
+  ``edl-tpu trace``-able handle).
 * :class:`FleetView` — per-job and fleet-wide rollups of the scraped
   ``edl_serving_*`` / ``edl_goodput_*`` / ``edl_coord_*`` series.  Its
   :meth:`FleetView.stats_for` is the signal
@@ -207,6 +211,11 @@ class MetricsScraper:
         self._state: dict[tuple, _TargetState] = {}
         #: metric name → {(label items, target key) → ring}
         self._series: dict[str, dict[tuple, _Ring]] = {}
+        #: histogram exemplars (trace ids riding bucket samples):
+        #: family name → {(labels-sans-le, target key) → deque of
+        #: (ingest_t, exemplar labels, value)} — keyed per target so a
+        #: dead pod's exemplars age out WITH its series
+        self._exemplars: dict[str, dict[tuple, "deque"]] = {}
         self.sweeps = 0
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -247,6 +256,12 @@ class MetricsScraper:
                 del fam[lkey]
             if not fam:
                 del self._series[name]
+        for name in list(self._exemplars):
+            fam = self._exemplars[name]
+            for lkey in [k for k in fam if k[1] == key]:
+                del fam[lkey]
+            if not fam:
+                del self._exemplars[name]
 
     def targets(self) -> list[ScrapeTarget]:
         with self._lock:
@@ -335,9 +350,10 @@ class MetricsScraper:
         """Fetch + parse + ingest one target; returns an error string on
         failure, None on success."""
         now = self._clock()
+        exem: list = []
         try:
             text = self._fetch(target)
-            samples = iter_samples(text)
+            samples = iter_samples(text, exemplars=exem)
         except Exception as exc:
             return f"{type(exc).__name__}: {str(exc)[:120]}"
         t_ingest = self._clock()
@@ -364,6 +380,24 @@ class MetricsScraper:
                         # second sample to start moving
                         ring.samples.append((prev_success, 0.0))
                 ring.samples.append((t_ingest, value))
+            for name, labels, ex_labels, ex_value, _ex_ts in exem:
+                # exemplars ride _bucket sample lines; store under the
+                # base family, without the bucket's le label
+                if name.endswith("_bucket"):
+                    name = name[:-len("_bucket")]
+                lkey = (tuple(sorted((k, v) for k, v in labels.items()
+                                     if k != "le")), target.key())
+                ring = self._exemplars.setdefault(name, {}).get(lkey)
+                if ring is None:
+                    ring = self._exemplars[name][lkey] = deque(maxlen=8)
+                # an exemplar still exposed on re-scrape stays FRESH
+                # (timestamp refreshed in place); it only ages once the
+                # target stops exposing — or stops answering — it
+                for e in list(ring):
+                    if e[1] == ex_labels and e[2] == ex_value:
+                        ring.remove(e)
+                        break
+                ring.append((t_ingest, ex_labels, ex_value))
             st.last_success_t = t_ingest
             st.consecutive_failures = 0
             st.next_due_t = t_ingest + self.interval_s
@@ -623,6 +657,35 @@ class MetricsScraper:
                 return prev_le + (le - prev_le) * max(min(frac, 1.0), 0.0)
             prev_le, prev_cum = le, cum
         return les[-2] if len(les) > 1 else None
+
+    def exemplars(self, name: str, labels: Optional[dict] = None,
+                  max_age_s: Optional[float] = None) -> list[dict]:
+        """Scraped histogram exemplars for one family (trace ids the
+        data plane attached to its latency buckets), newest-kept per
+        series, sorted SLOWEST first — the join from a fleet-level
+        latency breach to the trace that explains it.  A sample older
+        than ``max_age_s`` (default: the staleness horizon; a removed
+        target's exemplars are gone entirely) is excluded."""
+        horizon = (self.stale_after_s if max_age_s is None
+                   else float(max_age_s))
+        cutoff = self._clock() - horizon
+        out: list[dict] = []
+        with self._lock:
+            fam = self._exemplars.get(name) or {}
+            for (slabels, _tkey), ring in fam.items():
+                if not self._match(slabels, labels):
+                    continue
+                for t, ex_labels, value in ring:
+                    if t < cutoff:
+                        continue
+                    out.append({
+                        "labels": dict(slabels),
+                        "trace_id": ex_labels.get("trace_id", ""),
+                        "value": value,
+                        "age_s": round(self._clock() - t, 3),
+                    })
+        out.sort(key=lambda e: -e["value"])
+        return out
 
     def series_count(self) -> int:
         with self._lock:
@@ -913,6 +976,24 @@ class FleetView:
         feeds the policy from scraped replica /metrics."""
         return self.serving_stats(job=uid)
 
+    #: latency families whose bucket exemplars carry trace ids
+    EXEMPLAR_FAMILIES = ("edl_serving_request_seconds",
+                        "edl_frontdoor_request_seconds",
+                        "edl_lb_request_seconds")
+
+    def slowest_exemplars(self, job: Optional[str] = None,
+                          k: int = 3) -> list[dict]:
+        """The slowest scraped trace-id exemplars across the serving
+        latency families — the dashboard's "why was THIS slow" handles,
+        each feedable straight into ``edl-tpu trace``."""
+        labels = {"job": job} if job else None
+        out: list[dict] = []
+        for fam in self.EXEMPLAR_FAMILIES:
+            for ex in self.scraper.exemplars(fam, labels):
+                out.append({**ex, "family": fam})
+        out.sort(key=lambda e: -e["value"])
+        return out[:max(int(k), 1)]
+
     # -- goodput / coordinator ----------------------------------------------
 
     def goodput_fraction(self, job: Optional[str] = None
@@ -971,6 +1052,12 @@ class FleetView:
             gp = goodput.get(job)
             if gp:
                 per_job[job]["goodput"] = gp.get("fraction")
+            slow = self.slowest_exemplars(job, k=1)
+            if slow:
+                per_job[job]["slowest_trace"] = {
+                    "trace_id": slow[0]["trace_id"],
+                    "latency_ms": round(slow[0]["value"] * 1e3, 3),
+                }
         fleet = self.serving_stats(None)
         return {
             "window_s": self.window_s,
@@ -1256,13 +1343,16 @@ def render_fleet_dashboard(view: FleetView,
     if snap["jobs"]:
         lines.append("")
         rows = [("JOB", "QPS", "P50ms", "P99ms", "QUEUE", "REPLICAS",
-                 "GOODPUT")]
+                 "GOODPUT", "SLOWEST-TRACE")]
         for job, j in sorted(snap["jobs"].items()):
             gp = j.get("goodput")
+            slow = j.get("slowest_trace")
             rows.append((job, f"{j['qps']:g}", f"{j['p50_ms']:g}",
                          f"{j['p99_ms']:g}", str(j["queue"]),
                          j["replicas"],
-                         f"{gp:.2%}" if gp is not None else "-"))
+                         f"{gp:.2%}" if gp is not None else "-",
+                         (f"{slow['latency_ms']:g}ms@{slow['trace_id']}"
+                          if slow else "-")))
         widths = [max(len(r[i]) for r in rows) for i in range(len(rows[0]))]
         lines += ["  ".join(c.ljust(w) for c, w in zip(r, widths)).rstrip()
                   for r in rows]

@@ -61,15 +61,27 @@ Scrape names: ``edl_frontdoor_requests_served_total`` /
 ``edl_frontdoor_connections_total`` /
 ``edl_frontdoor_overload_sheds_total{priority=}`` /
 ``edl_frontdoor_request_errors_total`` (counters),
-``edl_frontdoor_request_seconds`` / ``edl_frontdoor_batch_rows``
+``edl_frontdoor_request_seconds`` (histogram, trace-id exemplars on
+its buckets for sampled requests) / ``edl_frontdoor_batch_rows``
 (histograms), ``edl_frontdoor_queue_rows`` / ``edl_frontdoor_state``
-(gauges) — all labeled ``job=``.
+(gauges) — all labeled ``job=`` — plus
+``edl_loop_lag_seconds{loop=frontdoor}`` /
+``edl_loop_lag_breaches_total`` from the :class:`LoopLagProbe`.
+
+Request tracing (doc/serving.md §request tracing): a sampled block —
+one carrying ``X-EDL-Trace-Id``, injected by the LB origin or sent by
+the client — gets a ``frontdoor_request`` span tree with the phase
+cuts parse → admit → queue → batch → forward → respond, parented to
+the LB's admission span via ``X-EDL-Parent-Span``, the id echoed on
+the response (f32 and JSON alike), and a record in the bounded
+exemplar ring flight records embed.
 """
 
 from __future__ import annotations
 
 import asyncio
 import collections
+import os
 import threading
 import time
 from typing import Any, Callable, Optional
@@ -78,10 +90,19 @@ import numpy as np
 
 from edl_tpu.observability.collector import get_counters
 from edl_tpu.observability.logging import get_logger
-from edl_tpu.observability.metrics import SERVING_LATENCY_BUCKETS, get_registry
+from edl_tpu.observability.metrics import (
+    SERVING_LATENCY_BUCKETS, dump_flight_record, get_registry,
+)
 from edl_tpu.observability.scrape import AddrPublisher
+from edl_tpu.observability.tracing import get_tracer
 
 log = get_logger("runtime.frontdoor")
+
+#: event-loop lag histogram boundaries (seconds): sub-ms scheduling
+#: noise up to multi-second wedges — the range a "GC pause / blocking
+#: call on the loop thread" failure lives in
+LOOP_LAG_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                    0.1, 0.25, 0.5, 1.0, 2.5, 5.0)
 
 #: coordinator-KV prefix for the serving DATA-plane address + ready gate
 #: (``serving-addr/<job>/<replica>`` → ``host:port <expiry> <state>``);
@@ -151,14 +172,18 @@ def parse_serving_addr(value: bytes) -> tuple[Optional[str], str, bool]:
 
 
 def build_predict_request(row: np.ndarray, priority: Optional[str] = None,
-                          host: str = "fd") -> bytes:
+                          host: str = "fd",
+                          trace_id: Optional[str] = None) -> bytes:
     """One raw-f32 ``/predict`` request (clients, bench driver, tests).
     Constant head bytes for a constant row width — which is exactly what
-    arms the server's fixed-stride block parser."""
+    arms the server's fixed-stride block parser.  ``trace_id`` adds the
+    ``X-EDL-Trace-Id`` header (the request is then traced end-to-end and
+    the id echoed on the reply — doc/serving.md §request tracing)."""
     body = np.ascontiguousarray(row, dtype="<f4").tobytes()
     pri = f"X-EDL-Priority: {priority}\r\n" if priority else ""
+    tid = f"X-EDL-Trace-Id: {trace_id}\r\n" if trace_id else ""
     head = (f"POST /predict HTTP/1.1\r\nHost: {host}\r\n"
-            f"Content-Type: {F32_CONTENT_TYPE}\r\n{pri}"
+            f"Content-Type: {F32_CONTENT_TYPE}\r\n{pri}{tid}"
             f"Content-Length: {len(body)}\r\n\r\n")
     return head.encode() + body
 
@@ -169,8 +194,8 @@ class HeadMeta:
     parsing into one dict hit)."""
 
     __slots__ = ("method", "path", "body_len", "f32", "priority",
-                 "trace_id", "keep_alive", "head_len", "total_len", "bad",
-                 "chunked")
+                 "trace_id", "parent_span", "keep_alive", "head_len",
+                 "total_len", "bad", "chunked")
 
     def __init__(self, head: bytes) -> None:
         self.bad = False
@@ -179,6 +204,7 @@ class HeadMeta:
         self.f32 = False
         self.priority = PRI_NORMAL
         self.trace_id: Optional[str] = None
+        self.parent_span: Optional[str] = None
         self.keep_alive = True
         self.head_len = len(head)
         try:
@@ -230,6 +256,12 @@ class HeadMeta:
         if idx >= 0:
             end = lower.index(b"\r\n", idx + 2)
             self.trace_id = head[idx + 17:end].strip().decode("latin1")
+        # the LB (trace origin) injects this so downstream span roots
+        # nest under its admission span in the stitched tree
+        idx = lower.find(b"\r\nx-edl-parent-span:")
+        if idx >= 0:
+            end = lower.index(b"\r\n", idx + 2)
+            self.parent_span = head[idx + 20:end].strip().decode("latin1")
         if b"\r\nconnection: close" in lower:
             self.keep_alive = False
         self.total_len = self.head_len + self.body_len
@@ -376,9 +408,14 @@ class HttpConn(asyncio.Protocol):
         meta = self.door.head_cache.get(head)
         if meta is None:
             meta = HeadMeta(head)
-            if len(self.door.head_cache) > 512:
-                self.door.head_cache.clear()
-            self.door.head_cache[head] = meta
+            # traced heads are unique per request (they embed the trace
+            # id): caching them would churn the bounded cache (each
+            # clear() dumps genuinely hot heads) for entries that can
+            # never hit again
+            if meta.trace_id is None:
+                if len(self.door.head_cache) > 512:
+                    self.door.head_cache.clear()
+                self.door.head_cache[head] = meta
         if meta.bad:
             self._poison(RESP_400)
             return False
@@ -402,8 +439,12 @@ class HttpConn(asyncio.Protocol):
             self._close_after_flush = True
         if (meta.method == "POST" and meta.path == "/predict" and meta.f32
                 and meta.body_len >= 4 and meta.body_len % 4 == 0):
-            # arm the fixed-stride block parser for the repeats
-            self._fixed = (head, meta)
+            # arm the fixed-stride block parser for the repeats — but
+            # never on a traced head: it is unique to its request, so
+            # arming would just push the NEXT (plain) request onto the
+            # slow path (the LB's response parser has the same guard)
+            if meta.trace_id is None:
+                self._fixed = (head, meta)
             if self.app.wants_raw:
                 self.app.handle_raw_block(self, raw, 1, meta)
             else:
@@ -559,18 +600,23 @@ class FrontDoor:
 
 class _Block:
     """One admitted run of requests from one connection (the batcher's
-    unit of work): rows, the response slot, and the admission stamp."""
+    unit of work): rows, the response slot, and the admission stamp.
+    ``t_recv``/``parent`` are set only for traced blocks (the sampled
+    minority) — the span-phase cuts and the cross-tier stitch point."""
 
-    __slots__ = ("conn", "slot", "rows", "t", "json", "trace_id")
+    __slots__ = ("conn", "slot", "rows", "t", "json", "trace_id",
+                 "t_recv", "parent")
 
     def __init__(self, conn, slot, rows, t, json_resp=False,
-                 trace_id=None) -> None:
+                 trace_id=None, t_recv=0.0, parent=None) -> None:
         self.conn = conn
         self.slot = slot
         self.rows = rows
         self.t = t
         self.json = json_resp
         self.trace_id = trace_id
+        self.t_recv = t_recv
+        self.parent = parent
 
 
 class _StatePublisher(AddrPublisher):
@@ -647,6 +693,11 @@ class BatchApp:
         self._out_head_arr = None
         self.iterations = 0
         self.requests_served = 0
+        #: completed trace records (the sampled minority): what flight
+        #: records embed and `edl-tpu trace` complements — bounded so a
+        #: week of serving cannot grow it
+        self.exemplars: "collections.deque[dict]" = collections.deque(
+            maxlen=256)
         reg = get_registry()
         self._hist = reg.histogram(
             "frontdoor_request_seconds",
@@ -734,6 +785,10 @@ class BatchApp:
     def handle_rows(self, conn: HttpConn, rows: np.ndarray,
                     meta: HeadMeta) -> None:
         k = len(rows)
+        # traced requests (the sampled minority) stamp arrival so the
+        # parse→admit phase cut is real; the untraced steady state pays
+        # nothing here
+        t_recv = time.perf_counter() if meta.trace_id else 0.0
         if self.failed:
             # the build died: nothing will ever drain the queue — fast
             # 503s, not a hang until client timeout
@@ -746,13 +801,13 @@ class BatchApp:
         admit, pause = self._admission(k, meta.priority)
         if admit < k:
             if admit:
-                self._admit(conn, rows[:admit], meta)
+                self._admit(conn, rows[:admit], meta, t_recv=t_recv)
             self._shed(conn, k - admit, meta.priority)
             if pause:
                 conn.pause()
                 self._paused_conns.add(conn)
             return
-        self._admit(conn, rows, meta)
+        self._admit(conn, rows, meta, t_recv=t_recv)
 
     def _shed(self, conn: HttpConn, k: int, pri: int) -> None:
         if k <= 0:
@@ -762,10 +817,13 @@ class BatchApp:
                     priority=PRIORITY_NAMES[pri])
 
     def _admit(self, conn: HttpConn, rows: np.ndarray,
-               meta: HeadMeta, json_resp: bool = False) -> None:
+               meta: HeadMeta, json_resp: bool = False,
+               t_recv: float = 0.0) -> None:
         slot = conn.push_slot(len(rows))
-        blk = _Block(conn, slot, rows, time.perf_counter(),
-                     json_resp=json_resp, trace_id=meta.trace_id)
+        now = time.perf_counter()
+        blk = _Block(conn, slot, rows, now,
+                     json_resp=json_resp, trace_id=meta.trace_id,
+                     t_recv=t_recv or now, parent=meta.parent_span)
         with self._cond:
             self._queue.append(blk)
             self._queued_rows += len(rows)
@@ -785,6 +843,7 @@ class BatchApp:
                 conn.complete(conn.push_slot(1), RESP_404)
             return
         if meta.method == "POST" and path == "/predict":
+            t_recv = time.perf_counter() if meta.trace_id else 0.0
             if self.failed:
                 conn.complete(conn.push_slot(1), RESP_503)
                 return
@@ -809,7 +868,7 @@ class BatchApp:
                     conn.pause()
                     self._paused_conns.add(conn)
                 return
-            self._admit(conn, row, meta, json_resp=True)
+            self._admit(conn, row, meta, json_resp=True, t_recv=t_recv)
             return
         if meta.method == "POST" and path.startswith("/admin/"):
             self._handle_admin(conn, path, body)
@@ -949,6 +1008,7 @@ class BatchApp:
             self._maybe_swap()
             if not blocks:
                 continue
+            t_take = time.perf_counter()
             if self._stall_once_ms > 0:
                 # the injected straggler: this iteration wedges AFTER
                 # admission, so its requests age past the LB hedge delay
@@ -995,10 +1055,26 @@ class BatchApp:
                                if b.trace_id else b"")
                             + f"Content-Length: {len(payload)}"
                               f"\r\n\r\n".encode() + payload)
+                elif b.trace_id:
+                    # traced f32 rows echo the id too: the header
+                    # contract holds on the fast path, not just the
+                    # JSON slow path (f32↔JSON parity)
+                    echo = (
+                        b"HTTP/1.1 200 OK\r\nContent-Type: "
+                        + F32_CONTENT_TYPE.encode()
+                        + b"\r\nX-EDL-Trace-Id: "
+                        + b.trace_id.encode("latin1")
+                        + b"\r\nContent-Length: "
+                        + str(self.out_dim * 4).encode() + b"\r\n\r\n")
+                    bodies = mat[off:off + k, len(self._out_head):]
+                    data = b"".join(echo + bodies[i].tobytes()
+                                    for i in range(k))
                 else:
                     data = mat[off:off + k].tobytes()
                 done.append((b.conn, b.slot, data))
                 lats.append((now - b.t, k))
+                if b.trace_id:
+                    self._emit_block_spans(b, t_take, t_fwd, now)
                 off += k
             self.door.call_soon(self._deliver, done)
             self._bhist.observe(n, job=self.job)
@@ -1014,6 +1090,44 @@ class BatchApp:
                                 job=self.job)
             self._drained(n)
             del mat
+
+    def _emit_block_spans(self, b: _Block, t_take: float, t_fwd0: float,
+                          t_fwd1: float) -> None:
+        """One traced block's span tree: a ``frontdoor_request`` root
+        (parented to the LB's admission span via the injected
+        ``X-EDL-Parent-Span``, so the cross-tier tree stitches) with the
+        phase cuts parse → admit → queue → batch → forward → respond as
+        children — the door's third of the LB-origin taxonomy
+        (doc/serving.md §request tracing).  Emitted only for the sampled
+        minority; the steady state pays nothing."""
+        tracer = get_tracer()
+        t_done = time.perf_counter()
+        lat = t_done - b.t_recv
+        root = tracer.record_span(
+            "frontdoor_request", "frontdoor", b.t_recv, t_done,
+            trace_id=b.trace_id, parent_id=b.parent,
+            replica=self.replica, job=self.job, rows=len(b.rows),
+            generation=self.generation, path="json" if b.json else "f32",
+            latency_ms=round(lat * 1e3, 3))
+        for phase, t0, t1 in (
+                # parse is ~0 by construction (head cache / block scan);
+                # the zero-length span records that honestly
+                ("parse", b.t_recv, b.t_recv),
+                ("admit", b.t_recv, b.t),
+                ("queue", b.t, t_take),
+                ("batch", t_take, t_fwd0),
+                ("forward", t_fwd0, t_fwd1),
+                ("respond", t_fwd1, t_done)):
+            tracer.record_span(f"frontdoor.{phase}", "frontdoor", t0,
+                               max(t1, t0), trace_id=b.trace_id,
+                               parent_id=root)
+        self._hist.put_exemplar(lat, b.trace_id, job=self.job)
+        self.exemplars.append({
+            "trace_id": b.trace_id, "replica": self.replica,
+            "latency_ms": round(lat * 1e3, 3), "rows": len(b.rows),
+            "queue_ms": round(max(t_take - b.t, 0.0) * 1e3, 3),
+            "forward_ms": round((t_fwd1 - t_fwd0) * 1e3, 3),
+        })
 
     def _forward(self, rows: np.ndarray) -> np.ndarray:
         """Serve ``rows`` through the fixed compiled batch shape,
@@ -1135,13 +1249,15 @@ class FleetApp:
         pass
 
     def _submit(self, conn, row: np.ndarray, trace_id, json_resp: bool,
-                slot: RespSlot, pri: int = PRI_NORMAL) -> None:
+                slot: RespSlot, pri: int = PRI_NORMAL,
+                parent_span=None) -> None:
         from edl_tpu.runtime.serving import RequestDropped
 
         door = self.door
 
         try:
-            req = self.fleet.submit((row,), trace_id=trace_id)
+            req = self.fleet.submit((row,), trace_id=trace_id,
+                                    parent_span=parent_span)
         except RequestDropped:
             # a fleet admission shed is OVERLOAD, not failure: the same
             # 429 + shed counter the BatchApp path gives it, so clients
@@ -1171,9 +1287,12 @@ class FleetApp:
             else:
                 body = np.ascontiguousarray(
                     r.result, dtype="<f4").tobytes()
+                # the echo contract holds for f32 exactly like JSON
                 data = (f"HTTP/1.1 200 OK\r\n"
                         f"Content-Type: {F32_CONTENT_TYPE}\r\n"
-                        f"Content-Length: {len(body)}\r\n\r\n"
+                        + (f"X-EDL-Trace-Id: {trace_id}\r\n"
+                           if trace_id else "")
+                        + f"Content-Length: {len(body)}\r\n\r\n"
                         ).encode() + body
             door.call_soon(self._fill, conn, slot, data, timer)
 
@@ -1201,7 +1320,8 @@ class FleetApp:
             return
         for row in rows:
             self._submit(conn, row, meta.trace_id, False,
-                         conn.push_slot(1), meta.priority)
+                         conn.push_slot(1), meta.priority,
+                         parent_span=meta.parent_span)
 
     def handle_request(self, conn, meta: HeadMeta, body: bytes,
                        raw: bytes) -> None:
@@ -1223,9 +1343,151 @@ class FleetApp:
                 conn.complete(conn.push_slot(1), RESP_400)
                 return
             self._submit(conn, row, meta.trace_id, True, conn.push_slot(1),
-                         meta.priority)
+                         meta.priority, parent_span=meta.parent_span)
             return
         conn.complete(conn.push_slot(1), RESP_404)
+
+
+# -- event-loop lag watchdog -------------------------------------------------
+
+
+class LoopLagProbe:
+    """Self-timing probe on a :class:`FrontDoor`'s event loop — the
+    whole data plane is ONE loop per process, so a GC pause or an
+    accidental blocking call on the loop thread stalls every connection
+    at once while every existing counter keeps looking healthy.  The
+    probe reschedules itself every ``interval_s`` and measures how late
+    the loop actually ran it:
+
+    * every tick's lag lands in ``edl_loop_lag_seconds{loop=}``
+      (:data:`LOOP_LAG_BUCKETS`) — the scrape plane sees scheduling
+      jitter grow BEFORE it becomes an outage;
+    * a lag past ``breach_s`` counts ``loop_lag_breaches_total{loop=}``;
+      ``sustain`` consecutive breaches escalate: one flight record
+      (reason ``loop-lag-<name>``, the exemplar ring embedded, deduped
+      by the shared cooldown) so the post-mortem shows what the loop
+      was doing while it lagged;
+    * a fully WEDGED loop (no ticks at all) is caught by a threaded
+      :class:`~edl_tpu.runtime.watchdog.StallWatchdog` fed one beat per
+      tick — escalation dumps a ``loop-stall-<name>`` record and counts
+      ``stalls_detected{scope=loop-<name>}``, turning the silent-hang
+      failure class into evidence."""
+
+    def __init__(self, door: FrontDoor, loop_name: str, *,
+                 interval_s: float = 0.05, breach_s: float = 0.25,
+                 sustain: int = 3, flight_dir: str = "",
+                 exemplars_fn: Optional[Callable[[], list]] = None,
+                 dump_cooldown_s: float = 30.0) -> None:
+        from edl_tpu.runtime.watchdog import StallWatchdog
+
+        self.door = door
+        self.loop_name = loop_name
+        self.interval_s = max(float(interval_s), 0.005)
+        self.breach_s = float(breach_s)
+        self.sustain = max(int(sustain), 1)
+        self.flight_dir = flight_dir
+        self.exemplars_fn = exemplars_fn
+        self.dump_cooldown_s = float(dump_cooldown_s)
+        self.ticks = 0
+        self.breaches = 0
+        self.escalations = 0
+        self.last_lag_s = 0.0
+        self._streak = 0
+        self._expected = 0.0
+        self._handle = None
+        self._stopped = False
+        self._hist = get_registry().histogram(
+            "loop_lag_seconds",
+            help="event-loop scheduling lag of the self-timing probe",
+            buckets=LOOP_LAG_BUCKETS)
+        self._c = get_counters()
+        # the floor bounds detection of a FULLY wedged loop; beats come
+        # every interval_s, so the EWMA term stays tiny and the floor is
+        # the whole deadline
+        self._watchdog = StallWatchdog(
+            floor_s=max(4.0 * self.breach_s, 20.0 * self.interval_s, 1.0),
+            scope=f"loop-{loop_name}", flight_dir="",
+            on_stall=self._on_stall)
+
+    def start(self) -> "LoopLagProbe":
+        # seed the deadline clock BEFORE handing anything to the loop:
+        # a loop that wedges before the first _tick ever runs would
+        # otherwise never arm the watchdog (no beat → check() is a
+        # no-op) — the exact silent-hang class this probe exists for
+        self._watchdog.beat()
+        self.door.call_soon(self._arm)
+        self._watchdog.start(poll_s=max(self.interval_s, 0.05))
+        return self
+
+    def _arm(self) -> None:
+        self._expected = time.perf_counter() + self.interval_s
+        self._handle = self.door.loop.call_later(self.interval_s,
+                                                 self._tick)
+
+    def _tick(self) -> None:
+        if self._stopped:
+            return
+        now = time.perf_counter()
+        lag = max(now - self._expected, 0.0)
+        self.ticks += 1
+        self.last_lag_s = lag
+        self._hist.observe(lag, loop=self.loop_name)
+        self._watchdog.beat()
+        if lag > self.breach_s:
+            self.breaches += 1
+            self._c.inc("loop_lag_breaches", loop=self.loop_name)
+            self._streak += 1
+            # "sustained" = N consecutive breached ticks, OR one pause
+            # so long it covers N breach windows by itself (a single
+            # multi-second GC pause schedules only ONE late tick — it
+            # must not need N repeats to count)
+            if self._streak >= self.sustain \
+                    or lag >= self.sustain * self.breach_s:
+                self._escalate("loop-lag", lag)
+                self._streak = 0
+        else:
+            self._streak = 0
+        self._expected = now + self.interval_s
+        self._handle = self.door.loop.call_later(self.interval_s,
+                                                 self._tick)
+
+    def _on_stall(self, stall) -> None:
+        # no beats at all: the loop is WEDGED, not merely laggy
+        self._escalate("loop-stall", getattr(stall, "silent_s", 0.0))
+
+    def _escalate(self, kind: str, lag_s: float) -> None:
+        self.escalations += 1
+        log.error("event loop lagging", loop=self.loop_name, kind=kind,
+                  lag_ms=round(lag_s * 1e3, 1))
+        get_tracer().instant(f"{kind}_escalated", category="loop",
+                             loop=self.loop_name,
+                             lag_ms=round(lag_s * 1e3, 1))
+        if not self.flight_dir:
+            return
+        try:
+            extra = {"loop": self.loop_name, "lag_s": lag_s}
+            if self.exemplars_fn is not None:
+                extra["exemplars"] = list(self.exemplars_fn())
+            dump_flight_record(self.flight_dir, f"{kind}-{self.loop_name}",
+                               extra=extra,
+                               cooldown_s=self.dump_cooldown_s)
+        except Exception as exc:
+            log.warn("loop-lag flight record dump failed",
+                     error=str(exc)[:120])
+
+    def stop(self) -> None:
+        self._stopped = True
+        self._watchdog.stop()
+        handle = self._handle
+
+        def cancel() -> None:
+            if handle is not None:
+                handle.cancel()
+
+        try:
+            self.door.call_soon(cancel)
+        except Exception:
+            pass  # loop already gone
 
 
 # -- process entrypoint ------------------------------------------------------
@@ -1236,11 +1498,31 @@ def replica_main(env=None) -> int:
     edl_tpu.runtime.frontdoor``): an :class:`ElasticServer` behind a
     :class:`BatchApp` front door, the ready-gate address published to
     coordinator KV, ``/metrics`` on its own port.  The EDL_FD_* env
-    contract mirrors EDL_SERVING_* (doc/serving.md §data-plane)."""
-    import os
-    import signal
+    contract mirrors EDL_SERVING_* (doc/serving.md §data-plane).
 
+    Observability wiring: ``EDL_TRACE_DIR`` dumps the trace ring as a
+    pid-suffixed ``trace-*.json`` every second (what ``edl-tpu trace``
+    stitches); ``EDL_FLIGHTREC_DIR`` arms flight records on abnormal
+    exit, build failure, and sustained event-loop lag (the exemplar
+    ring embedded); ``EDL_FD_LAG_PROBE_MS`` (default 50, 0 disables)
+    drives the :class:`LoopLagProbe`."""
     env = os.environ if env is None else env
+    try:
+        return _replica_main(env)
+    except Exception:
+        # abnormal exit: leave the post-mortem on disk like the
+        # supervisor does (pid-suffixed by dump_flight_record)
+        fdir = env.get("EDL_FLIGHTREC_DIR", "")
+        if fdir:
+            try:
+                dump_flight_record(fdir, "frontdoor-abnormal-exit")
+            except Exception:
+                pass
+        raise
+
+
+def _replica_main(env) -> int:
+    import signal
     import jax
 
     from edl_tpu.models import mlp
@@ -1304,6 +1586,22 @@ def replica_main(env=None) -> int:
     door = FrontDoor(app, host=env.get("EDL_FD_HOST", "0.0.0.0"),
                      port=int(env.get("EDL_FD_PORT", "0")), job=job)
     door.start()
+    flight_dir = env.get("EDL_FLIGHTREC_DIR", "")
+    trace_dir = env.get("EDL_TRACE_DIR", "")
+    sink = probe = None
+    if trace_dir:
+        from edl_tpu.observability.tracing import TraceFileSink
+
+        sink = TraceFileSink(
+            trace_dir, f"fd-{replica.replace('/', '-')}-{os.getpid()}")
+        sink.start()
+    probe_ms = float(env.get("EDL_FD_LAG_PROBE_MS", "50"))
+    if probe_ms > 0:
+        probe = LoopLagProbe(
+            door, "frontdoor", interval_s=probe_ms / 1e3,
+            breach_s=float(env.get("EDL_FD_LAG_BREACH_MS", "250")) / 1e3,
+            flight_dir=flight_dir,
+            exemplars_fn=lambda: list(app.exemplars)).start()
     metrics_port = int(env.get("EDL_FD_METRICS_PORT", "0"))
     metrics_srv = None
     if metrics_port >= 0:
@@ -1317,6 +1615,18 @@ def replica_main(env=None) -> int:
         # that 503s everything) — fail the process loudly instead
         print(f"frontdoor FAILED replica={replica} "
               f"(build failed or timed out; see log above)", flush=True)
+        if flight_dir:
+            try:
+                dump_flight_record(
+                    flight_dir, "frontdoor-build-failed",
+                    extra={"replica": replica,
+                           "exemplars": list(app.exemplars)})
+            except Exception:
+                pass
+        if probe is not None:
+            probe.stop()
+        if sink is not None:
+            sink.stop()
         door.stop()
         if metrics_srv is not None:
             metrics_srv.shutdown()
@@ -1347,7 +1657,11 @@ def replica_main(env=None) -> int:
         deadline = time.monotonic() + 10
         while app._queued_rows > 0 and time.monotonic() < deadline:
             time.sleep(0.01)
+        if probe is not None:
+            probe.stop()
         door.stop()
+        if sink is not None:
+            sink.stop()  # final dump: the ring as of shutdown
         if metrics_srv is not None:
             metrics_srv.shutdown()
         if kv is not None:

@@ -25,9 +25,6 @@ one (or more — the tier is stateless) ``ServingLB`` process:
   First response wins; the loser's response is consumed off its
   connection and discarded (with pipelining there is no un-send — the
   cancellation is at the response, exactly like production hedging).
-  The admit→queue→batch→forward→respond span taxonomy on the replica
-  (PR 11) attributes WHY the straggler was slow; the LB's hedge
-  counters say how often it had to care.
 * **failure rescue** — a dead upstream connection (killed replica)
   fails fast: every outstanding block is re-sent to a surviving
   replica, so a SIGKILL costs latency, not errors.
@@ -35,14 +32,31 @@ one (or more — the tier is stateless) ``ServingLB`` process:
   front door, applied against the LB-wide outstanding-row count: low
   sheds at the soft watermark, normal at the hard cap, high rides the
   reserve band.
+* **trace origin** — the LB opens every sampled request's CROSS-TIER
+  span tree (doc/serving.md §request tracing): an ``lb_request`` root
+  (admission → completion) with ``lb.route`` and one ``lb.upstream``
+  span per dispatch — hedge twins as siblings marked
+  ``win``/``discarded``, rescue resends parented to the ORIGINAL
+  admission and the severed primary marked ``severed``.  Downstream,
+  the replica's front door records parse→admit→queue→batch→forward→
+  respond under the same trace id (``X-EDL-Trace-Id``) and nests via
+  the injected ``X-EDL-Parent-Span``.  Sampling is tail-based
+  (impossible to trace everything at 10⁵ qps): hedged / rescued /
+  shed / timed-out / p-slowest blocks are always kept; a ~1 %
+  deterministic head rate (``trace_sample``) covers the steady state,
+  and only head-sampled blocks carry the header — the unsampled
+  steady state stays byte-identical on the block parse.  Stitch a
+  trace back together with ``edl-tpu trace <id>``.
 
 Scrape names: ``edl_lb_requests_total`` / ``edl_lb_responses_total`` /
 ``edl_lb_hedges_total{result=win|lose}`` / ``edl_lb_rescues_total`` /
 ``edl_lb_overload_sheds_total{priority=}`` / ``edl_lb_timeouts_total``
-/ ``edl_lb_discovery_sweeps_total`` (counters),
-``edl_lb_request_seconds`` (histogram), ``edl_lb_upstreams_ready`` /
-``edl_lb_outstanding_rows`` / ``edl_lb_hedge_delay_ms`` (gauges) — all
-labeled ``job=``.
+/ ``edl_lb_discovery_sweeps_total`` /
+``edl_traces_sampled_total{origin=}`` (counters),
+``edl_lb_request_seconds`` (histogram, trace-id exemplars on its
+buckets) / ``edl_loop_lag_seconds{loop=lb}`` (histogram),
+``edl_lb_upstreams_ready`` / ``edl_lb_outstanding_rows`` /
+``edl_lb_hedge_delay_ms`` (gauges) — all labeled ``job=``.
 """
 
 from __future__ import annotations
@@ -57,7 +71,10 @@ import numpy as np
 
 from edl_tpu.observability.collector import get_counters
 from edl_tpu.observability.logging import get_logger
-from edl_tpu.observability.metrics import SERVING_LATENCY_BUCKETS, get_registry
+from edl_tpu.observability.metrics import (
+    SERVING_LATENCY_BUCKETS, dump_flight_record, get_registry,
+)
+from edl_tpu.observability.tracing import get_tracer, new_span_id, new_trace_id
 from edl_tpu.runtime.frontdoor import (
     FD_READY,
     PRI_HIGH,
@@ -70,10 +87,57 @@ from edl_tpu.runtime.frontdoor import (
     FrontDoor,
     HeadMeta,
     HttpConn,
+    LoopLagProbe,
     parse_serving_addr,
 )
 
 log = get_logger("runtime.lb")
+
+
+def _inject_trace_headers(raw: bytes, trace_id: str,
+                          parent_span: str) -> bytes:
+    """Rebuild a forwarded block with ``X-EDL-Trace-Id`` +
+    ``X-EDL-Parent-Span`` inserted into the FIRST request's head only:
+    the traced member request takes the replica's slow parse once while
+    the rest of the block stays byte-identical on the fixed-stride fast
+    path — which is what keeps sampling off the steady state's cost
+    model.  Headers already present (a client-supplied id, a hedge
+    resend of already-injected bytes) are not duplicated."""
+    i = raw.find(b"\r\n\r\n")
+    if i < 0:
+        return raw
+    lower = raw[:i].lower()
+    ins = b""
+    if b"x-edl-trace-id:" not in lower:
+        ins += b"X-EDL-Trace-Id: " + trace_id.encode("latin1") + b"\r\n"
+    if b"x-edl-parent-span:" not in lower:
+        ins += (b"X-EDL-Parent-Span: " + parent_span.encode("latin1")
+                + b"\r\n")
+    if not ins:
+        return raw
+    return raw[:i + 2] + ins + raw[i + 2:]
+
+
+class _TraceCtx:
+    """One sampled block's trace: the id the tiers stitch on, the LB
+    root span every dispatch/door span parents to, and the dispatch
+    records the hedge-duel outcomes land in.  Shared across hedge and
+    rescue twins via the block's :class:`_Cell`."""
+
+    __slots__ = ("tid", "root_sid", "t_admit", "n", "origin", "records",
+                 "emitted")
+
+    def __init__(self, tid: str, n: int, origin: str,
+                 t_admit: Optional[float] = None) -> None:
+        self.tid = tid
+        self.root_sid = new_span_id()
+        self.t_admit = (t_admit if t_admit is not None
+                        else time.perf_counter())
+        self.n = n
+        self.origin = origin  # client | head | hedge | rescue | slow | …
+        #: dispatch records: {kind, replica, sid, t0, t1, outcome}
+        self.records: list[dict] = []
+        self.emitted = False
 
 
 def _strip_hop_headers(raw: bytes, meta: HeadMeta, n: int) -> bytes:
@@ -103,12 +167,15 @@ def _strip_hop_headers(raw: bytes, meta: HeadMeta, n: int) -> bytes:
 class _Cell:
     """Shared first-wins flag between a primary dispatch and its
     hedge/rescue twins: whoever completes first takes it; later
-    completions are consumed and discarded."""
+    completions are consumed and discarded.  ``trace`` carries the
+    block's :class:`_TraceCtx` (None on the unsampled steady state) so
+    a loser's late arrival still finds its duel's spans."""
 
-    __slots__ = ("done",)
+    __slots__ = ("done", "trace")
 
     def __init__(self) -> None:
         self.done = False
+        self.trace: Optional[_TraceCtx] = None
 
 
 class _OutBlock:
@@ -116,7 +183,7 @@ class _OutBlock:
     on one upstream connection."""
 
     __slots__ = ("conn", "slot", "n", "remaining", "req_bytes", "t_sent",
-                 "t_admit", "cell", "kind", "acc", "hedged")
+                 "t_admit", "cell", "kind", "acc", "hedged", "trace_rec")
 
     def __init__(self, conn, slot, n: int, req_bytes: bytes,
                  cell: _Cell, kind: str = "primary",
@@ -135,6 +202,7 @@ class _OutBlock:
         self.kind = kind              # primary | hedge | rescue
         self.acc: list[bytes] = []    # response bytes, in order
         self.hedged = False
+        self.trace_rec: Optional[dict] = None  # this dispatch's record
 
 
 class _UpstreamConn(asyncio.Protocol):
@@ -225,7 +293,12 @@ class _UpstreamConn(asyncio.Protocol):
             return False
         raw = bytes(memoryview(buf)[:total])
         del buf[:total]
-        if lower.startswith(b"http/1.1 200") and body_len:
+        # arm the fast path only on the STEADY-STATE head: a traced
+        # response's echoed X-EDL-Trace-Id head is unique to its
+        # request — arming on it would push every following (plain)
+        # response onto the slow parse until the next re-arm
+        if lower.startswith(b"http/1.1 200") and body_len \
+                and b"\r\nx-edl-trace-id:" not in lower:
             self._fixed = (head, total)
         self._feed(raw, 1)
         return True
@@ -246,7 +319,7 @@ class _UpstreamConn(asyncio.Protocol):
             count -= take
             if blk.remaining == 0:
                 self.expected.popleft()
-                self.lb.block_done(blk)
+                self.lb.block_done(blk, self.up.name)
         if count > 0:
             log.warn("upstream sent unexpected responses",
                      upstream=self.up.name, extra=count)
@@ -263,7 +336,7 @@ class _UpstreamConn(asyncio.Protocol):
             self.outstanding_rows -= 1
             if blk.remaining == 0:
                 self.expected.popleft()
-                self.lb.block_done(blk)
+                self.lb.block_done(blk, self.up.name)
 
 
 class _Upstream:
@@ -307,7 +380,10 @@ class LBApp:
                  hedge_floor_ms: float = 10.0, hedge_cap_ms: float = 1000.0,
                  hedge_k: float = 3.0, request_timeout_s: float = 30.0,
                  hard_cap_rows: int = 65536, soft_cap_rows: int = 0,
-                 sweep_ms: float = 5.0, addr_grace_s: float = 5.0) -> None:
+                 sweep_ms: float = 5.0, addr_grace_s: float = 5.0,
+                 trace: bool = True, trace_sample: float = 0.01,
+                 tail_slow_quantile: float = 0.99,
+                 slo_ms: float = 0.0) -> None:
         self.job = job
         self.kv = kv
         self.static_upstreams = dict(static_upstreams or {})
@@ -338,6 +414,25 @@ class LBApp:
         self._halt = threading.Event()
         self._sweep_handle = None
         self._sweep_n = 0
+        # -- tail-sampled request tracing (the LB is the trace ORIGIN:
+        # doc/serving.md §request tracing).  Head sampling is
+        # deterministic — every `1/trace_sample`-th admitted block gets
+        # a trace id injected into its first request; hedged / rescued
+        # / shed / timed-out and p-slowest blocks are promoted at the
+        # tail regardless, so the interesting 0.1% is always kept.
+        self.trace_enabled = bool(trace)
+        self.trace_sample = max(float(trace_sample), 0.0)
+        self._head_every = (int(round(1.0 / self.trace_sample))
+                            if self.trace_sample > 0 else 0)
+        self._blocks_seen = 0
+        self.tail_slow_quantile = min(max(float(tail_slow_quantile),
+                                          0.0), 1.0)
+        self.slo_ms = float(slo_ms)
+        self._slow_keep_s = float("inf")
+        self._last_shed_trace = 0.0
+        #: completed trace records — what flight records embed
+        self.exemplars: "collections.deque[dict]" = collections.deque(
+            maxlen=256)
         reg = get_registry()
         self._c = get_counters()
         self._hist = reg.histogram(
@@ -469,10 +564,116 @@ class LBApp:
         self._c.inc("lb_requests", n, job=self.job)
         if not meta.keep_alive:  # rare: off the byte-identical hot path
             raw = _strip_hop_headers(raw, meta, n)
+        ctx: Optional[_TraceCtx] = None
+        if self.trace_enabled:
+            if meta.trace_id:
+                # client-supplied id: always traced; inject only the
+                # parent-span header so the door tree nests under ours
+                ctx = _TraceCtx(meta.trace_id, n, "client")
+                raw = _inject_trace_headers(raw, ctx.tid, ctx.root_sid)
+            elif self._head_every:
+                self._blocks_seen += 1
+                if self._blocks_seen >= self._head_every:
+                    self._blocks_seen = 0
+                    ctx = _TraceCtx(new_trace_id(), n, "head")
+                    raw = _inject_trace_headers(raw, ctx.tid,
+                                                ctx.root_sid)
         slot = conn.push_slot(n)
         blk = _OutBlock(conn, slot, n, raw, _Cell())
+        blk.cell.trace = ctx
         self.outstanding_rows += n
         self._dispatch(blk)
+
+    # -- trace emission (sampled blocks only) --------------------------------
+
+    def _trace_dispatch(self, ctx: _TraceCtx, blk: _OutBlock,
+                        up_name: str) -> None:
+        """Open one dispatch record (a primary send, a hedge twin, a
+        rescue resend) — the spans the duel outcomes land in."""
+        rec = {"kind": blk.kind, "replica": up_name,
+               "sid": new_span_id(), "t0": time.perf_counter(),
+               "t1": None, "outcome": None}
+        ctx.records.append(rec)
+        blk.trace_rec = rec
+
+    def _trace_rec_end(self, ctx: _TraceCtx, rec: Optional[dict],
+                       outcome: str) -> None:
+        """Close one dispatch record and emit its ``lb.upstream`` span
+        (hedge twins are SIBLINGS under the admission root, each marked
+        ``win`` / ``discarded`` / ``severed`` / ``timeout``)."""
+        if rec is None or rec["t1"] is not None:
+            return
+        rec["t1"] = time.perf_counter()
+        rec["outcome"] = outcome
+        get_tracer().record_span(
+            "lb.upstream", "lb", rec["t0"], rec["t1"],
+            trace_id=ctx.tid, span_id=rec["sid"],
+            parent_id=ctx.root_sid, replica=rec["replica"],
+            kind=rec["kind"], outcome=outcome)
+
+    def _trace_complete(self, ctx: _TraceCtx, outcome: str,
+                        lat_s: float) -> None:
+        """Emit the trace's root (``lb_request``: admission → done) and
+        route span, land the completed record in the exemplar ring +
+        the latency histogram's exemplar slot, and count it sampled.
+        Idempotent — the first completion (winner or timeout) wins."""
+        if ctx.emitted:
+            return
+        ctx.emitted = True
+        now = time.perf_counter()
+        tracer = get_tracer()
+        kinds = {r["kind"] for r in ctx.records}
+        tracer.record_span(
+            "lb_request", "lb", ctx.t_admit, now,
+            trace_id=ctx.tid, span_id=ctx.root_sid, job=self.job,
+            n=ctx.n, origin=ctx.origin, outcome=outcome,
+            latency_ms=round(lat_s * 1e3, 3),
+            hedged="hedge" in kinds, rescued="rescue" in kinds)
+        if ctx.records:
+            tracer.record_span("lb.route", "lb", ctx.t_admit,
+                               ctx.records[0]["t0"], trace_id=ctx.tid,
+                               parent_id=ctx.root_sid)
+        self._hist.put_exemplar(lat_s, ctx.tid, job=self.job)
+        self.exemplars.append({
+            "trace_id": ctx.tid, "origin": ctx.origin,
+            "outcome": outcome, "n": ctx.n,
+            "latency_ms": round(lat_s * 1e3, 3),
+            "hedged": "hedge" in kinds, "rescued": "rescue" in kinds,
+        })
+        self._c.inc("traces_sampled", job=self.job, origin=ctx.origin)
+
+    def _trace_timeout(self, blk: _OutBlock, now: float,
+                       up_name: Optional[str] = None) -> None:
+        """An expired block (parked or wedged-upstream) is an errored
+        request — always kept by the tail sampler."""
+        if not self.trace_enabled:
+            return
+        ctx = blk.cell.trace
+        if ctx is None:
+            if up_name is not None and blk.t_sent:
+                ctx = self._trace_promote(blk, "timeout", up_name)
+            else:  # never dispatched: no upstream record to close
+                ctx = _TraceCtx(new_trace_id(), blk.n, "timeout",
+                                t_admit=blk.t_admit)
+                blk.cell.trace = ctx
+        self._trace_rec_end(ctx, blk.trace_rec, "timeout")
+        self._trace_complete(ctx, "timeout", now - blk.t_admit)
+
+    def _trace_promote(self, blk: _OutBlock, origin: str,
+                       up_name: str) -> _TraceCtx:
+        """Tail promotion of an UNSAMPLED in-flight block (it just got
+        hedged, rescued, or timed out — the always-keep set): open its
+        ctx retroactively, with a record for the dispatch already in
+        flight so the duel reads complete."""
+        ctx = _TraceCtx(new_trace_id(), blk.n, origin,
+                        t_admit=blk.t_admit)
+        blk.cell.trace = ctx
+        rec = {"kind": blk.kind, "replica": up_name,
+               "sid": new_span_id(), "t0": blk.t_sent,
+               "t1": None, "outcome": None}
+        ctx.records.append(rec)
+        blk.trace_rec = rec
+        return ctx
 
     def handle_request(self, conn: HttpConn, meta: HeadMeta, body: bytes,
                        raw: bytes) -> None:
@@ -503,6 +704,27 @@ class LBApp:
         conn.complete(conn.push_slot(n), RESP_429 * n)
         self._c.inc("lb_overload_sheds", n, job=self.job,
                     priority=PRIORITY_NAMES[pri])
+        # sheds are in the tail sampler's always-keep set, but overload
+        # sheds come in floods — keep at most ~10/s so the trace ring
+        # records that shedding HAPPENED (and at what depth) without
+        # the flood becoming its own overload
+        if self.trace_enabled:
+            now = time.perf_counter()
+            if now - self._last_shed_trace >= 0.1:
+                self._last_shed_trace = now
+                tid = new_trace_id()
+                get_tracer().record_span(
+                    "lb_request", "lb", now, now, trace_id=tid,
+                    job=self.job, n=n, origin="shed", outcome="shed",
+                    priority=PRIORITY_NAMES[pri],
+                    outstanding_rows=self.outstanding_rows)
+                self.exemplars.append({
+                    "trace_id": tid, "origin": "shed",
+                    "outcome": "shed", "n": n, "latency_ms": 0.0,
+                    "hedged": False, "rescued": False,
+                })
+                self._c.inc("traces_sampled", job=self.job,
+                            origin="shed")
 
     def _pick(self, exclude=None) -> Optional[_Upstream]:
         best = None
@@ -530,25 +752,36 @@ class LBApp:
             return
         up.requests += blk.n
         blk.t_sent = time.perf_counter()
+        if blk.cell.trace is not None:
+            self._trace_dispatch(blk.cell.trace, blk, up.name)
         conn.send_block(blk)
 
     # -- completion ----------------------------------------------------------
 
-    def block_done(self, blk: _OutBlock) -> None:
+    def block_done(self, blk: _OutBlock,
+                   up_name: Optional[str] = None) -> None:
+        ctx = blk.cell.trace
         if blk.cell.done:
             # consumed but discarded: ONLY a hedge-duel participant
             # (the hedge twin, or a primary/rescue that was hedged)
             # counts toward the win/lose series the dashboards read as
             # duel outcomes — an unhedged rescue's duplicate or a
             # post-timeout response is a late response, not a lost duel
-            if blk.hedged or blk.kind == "hedge":
+            duel = blk.hedged or blk.kind == "hedge"
+            if duel:
                 self._c.inc("lb_hedges", blk.n, job=self.job,
                             result="lose")
             else:
                 self._c.inc("lb_late_responses", blk.n, job=self.job)
+            if ctx is not None:
+                # the loser's span, marked for what it was — emitted on
+                # arrival, stitched by id (the root already emitted)
+                self._trace_rec_end(ctx, blk.trace_rec,
+                                    "discarded" if duel else "late")
             return
         blk.cell.done = True
-        lat = time.perf_counter() - blk.t_sent
+        now = time.perf_counter()
+        lat = now - blk.t_sent
         self._record_lat(lat)
         self._hist.observe(lat, job=self.job)
         self._c.inc("lb_responses", blk.n, job=self.job)
@@ -561,6 +794,21 @@ class LBApp:
             blk.conn.complete(
                 blk.slot,
                 blk.acc[0] if len(blk.acc) == 1 else b"".join(blk.acc))
+        lat_admit = now - blk.t_admit
+        if ctx is None and self.trace_enabled and (
+                lat_admit > self._slow_keep_s
+                or (self.slo_ms and lat_admit * 1e3 > self.slo_ms)):
+            # tail keep: the p-slowest / SLO-violating completions are
+            # sampled even though no header ever left — LB-side spans
+            # only (there is no retroactive downstream propagation),
+            # which still answers WHERE the time went at this tier
+            ctx = self._trace_promote(
+                blk, "slo" if self.slo_ms
+                and lat_admit * 1e3 > self.slo_ms else "slow",
+                up_name or "?")
+        if ctx is not None:
+            self._trace_rec_end(ctx, blk.trace_rec, "win")
+            self._trace_complete(ctx, "served", lat_admit)
         self._maybe_resume()
 
     def _maybe_resume(self) -> None:
@@ -586,7 +834,21 @@ class LBApp:
             conn.outstanding_rows -= blk.remaining
             if blk.cell.done:
                 continue
-            resend = _OutBlock(blk.conn, blk.slot, blk.n, blk.req_bytes,
+            resend_bytes = blk.req_bytes
+            if self.trace_enabled:
+                # a rescue is always kept (tail sampling's always-keep
+                # set): promote the block if it wasn't sampled, mark
+                # the severed dispatch, and inject the trace header
+                # into the resend so the surviving replica's spans
+                # stitch under this admission
+                ctx = blk.cell.trace
+                if ctx is None:
+                    ctx = self._trace_promote(blk, "rescue",
+                                              conn.up.name)
+                self._trace_rec_end(ctx, blk.trace_rec, "severed")
+                resend_bytes = _inject_trace_headers(
+                    blk.req_bytes, ctx.tid, ctx.root_sid)
+            resend = _OutBlock(blk.conn, blk.slot, blk.n, resend_bytes,
                                blk.cell, kind="rescue",
                                t_admit=blk.t_admit)
             self._dispatch(resend, exclude=conn.up)
@@ -615,12 +877,18 @@ class LBApp:
             # needs ~100 ms freshness
             self._sweep_n += 1
             if self._lat_n >= 32 and self._sweep_n % 20 == 1:
-                p99 = float(np.quantile(self._lat_ring[:self._lat_n], 0.99))
+                window = self._lat_ring[:self._lat_n]
+                p99 = float(np.quantile(window, 0.99))
                 self.hedge_delay_s = min(
                     max(self.hedge_k * p99, self.hedge_floor_ms / 1e3),
                     self.hedge_cap_ms / 1e3)
                 self._hedge_gauge.set(round(self.hedge_delay_s * 1e3, 3),
                                       job=self.job)
+                if self.trace_enabled and self.tail_slow_quantile < 1.0:
+                    # the tail sampler's p-slowest keep threshold rides
+                    # the same windowed quantile refresh
+                    self._slow_keep_s = float(np.quantile(
+                        window, self.tail_slow_quantile))
             # pool top-up, ~every 0.5 s at the default 5 ms sweep: in
             # KV mode the discovery sweep re-dials, but a STATIC
             # upstream whose initial dial failed (LB started before the
@@ -649,13 +917,29 @@ class LBApp:
                             # out the full request timeout
                             continue
                         blk.hedged = True
+                        hedge_bytes = blk.req_bytes
+                        if self.trace_enabled:
+                            # a hedge is always kept: promote if
+                            # unsampled, and the RESEND carries the
+                            # trace header — the duel's winner records
+                            # its door/batch spans under this admission
+                            # even though the primary left untraced
+                            ctx = blk.cell.trace
+                            if ctx is None:
+                                ctx = self._trace_promote(
+                                    blk, "hedge", up.name)
+                            hedge_bytes = _inject_trace_headers(
+                                blk.req_bytes, ctx.tid, ctx.root_sid)
                         hedge = _OutBlock(blk.conn, blk.slot, blk.n,
-                                          blk.req_bytes, blk.cell,
+                                          hedge_bytes, blk.cell,
                                           kind="hedge",
                                           t_admit=blk.t_admit)
                         hedge.hedged = True
                         self._c.inc("lb_hedges_fired", blk.n, job=self.job)
                         target.requests += blk.n
+                        if hedge.cell.trace is not None:
+                            self._trace_dispatch(hedge.cell.trace,
+                                                 hedge, target.name)
                         tconn.send_block(hedge)
             # re-dispatch parked blocks / expire them
             parked, self._parked = self._parked, collections.deque()
@@ -668,6 +952,7 @@ class LBApp:
                     self._c.inc("lb_timeouts", blk.n, job=self.job)
                     if not blk.conn.closed:
                         blk.conn.complete(blk.slot, RESP_503 * blk.n)
+                    self._trace_timeout(blk, now)
                     continue
                 if self._pick() is not None:
                     self._dispatch(blk)
@@ -692,6 +977,7 @@ class LBApp:
                         self._c.inc("lb_timeouts", blk.n, job=self.job)
                         if not blk.conn.closed:
                             blk.conn.complete(blk.slot, RESP_503 * blk.n)
+                        self._trace_timeout(blk, now, up.name)
                     if expired:
                         # the wedged replica may still answer the popped
                         # blocks; on a pipelined FIFO those bytes would
@@ -735,11 +1021,33 @@ class ServingLB:
 def lb_main(env=None) -> int:
     """The LB process entrypoint (``python -m edl_tpu.runtime.lb``):
     discovery from EDL_COORD_ENDPOINT, listener on EDL_LB_PORT,
-    ``/metrics`` on EDL_LB_METRICS_PORT."""
+    ``/metrics`` on EDL_LB_METRICS_PORT.
+
+    Observability wiring: ``EDL_LB_TRACE_SAMPLE`` sets the head
+    sampling rate (default 0.01 ≈ 1 %; negative disables tracing
+    entirely), ``EDL_TRACE_DIR`` dumps the trace ring for ``edl-tpu
+    trace``, ``EDL_FLIGHTREC_DIR`` arms flight records on abnormal exit
+    / sustained event-loop lag, and ``EDL_LB_LAG_PROBE_MS`` (default
+    50, 0 disables) drives the :class:`LoopLagProbe`."""
+    import os
+
+    env = os.environ if env is None else env
+    try:
+        return _lb_main(env)
+    except Exception:
+        fdir = env.get("EDL_FLIGHTREC_DIR", "")
+        if fdir:
+            try:
+                dump_flight_record(fdir, "lb-abnormal-exit")
+            except Exception:
+                pass
+        raise
+
+
+def _lb_main(env) -> int:
     import os
     import signal
 
-    env = os.environ if env is None else env
     from edl_tpu.coord.client import client_from_env
 
     job = env.get("EDL_LB_JOB", "default/serving")
@@ -748,6 +1056,7 @@ def lb_main(env=None) -> int:
     for i, addr in enumerate(
             a for a in env.get("EDL_LB_UPSTREAMS", "").split(",") if a):
         static[f"static-{i}"] = addr
+    trace_sample = float(env.get("EDL_LB_TRACE_SAMPLE", "0.01"))
     lb = ServingLB(
         job=job, host=env.get("EDL_LB_HOST", "0.0.0.0"),
         port=int(env.get("EDL_LB_PORT", "0")), kv=kv,
@@ -759,8 +1068,26 @@ def lb_main(env=None) -> int:
         hedge_k=float(env.get("EDL_LB_HEDGE_K", "3")),
         hard_cap_rows=int(env.get("EDL_LB_CAP_ROWS", "65536")),
         request_timeout_s=float(env.get("EDL_LB_REQUEST_TIMEOUT_S", "30")),
-        sweep_ms=float(env.get("EDL_LB_SWEEP_MS", "5")))
+        sweep_ms=float(env.get("EDL_LB_SWEEP_MS", "5")),
+        trace=trace_sample >= 0,
+        trace_sample=max(trace_sample, 0.0),
+        slo_ms=float(env.get("EDL_LB_SLO_MS", "0")))
     lb.start()
+    flight_dir = env.get("EDL_FLIGHTREC_DIR", "")
+    trace_dir = env.get("EDL_TRACE_DIR", "")
+    sink = probe = None
+    if trace_dir:
+        from edl_tpu.observability.tracing import TraceFileSink
+
+        sink = TraceFileSink(trace_dir, f"lb-{os.getpid()}")
+        sink.start()
+    probe_ms = float(env.get("EDL_LB_LAG_PROBE_MS", "50"))
+    if probe_ms > 0:
+        probe = LoopLagProbe(
+            lb.door, "lb", interval_s=probe_ms / 1e3,
+            breach_s=float(env.get("EDL_LB_LAG_BREACH_MS", "250")) / 1e3,
+            flight_dir=flight_dir,
+            exemplars_fn=lambda: list(lb.app.exemplars)).start()
     metrics_srv = None
     if int(env.get("EDL_LB_METRICS_PORT", "0")) >= 0:
         from edl_tpu.observability.health import serve_health
@@ -782,7 +1109,11 @@ def lb_main(env=None) -> int:
         while not stop.wait(0.5):
             pass
     finally:
+        if probe is not None:
+            probe.stop()
         lb.stop()
+        if sink is not None:
+            sink.stop()  # final dump: the ring as of shutdown
         if metrics_srv is not None:
             metrics_srv.shutdown()
         if kv is not None:

@@ -118,7 +118,9 @@ class ServeRequest:
     header, or any caller) makes the request's phases first-class
     ``TraceEvent`` spans; without one, spans are emitted only for SLO
     violations so a p99 breach is attributable to a phase without
-    flooding the trace ring at full qps."""
+    flooding the trace ring at full qps.  ``parent_span`` (the LB's
+    injected ``X-EDL-Parent-Span``) roots the span tree under the
+    origin tier's admission span so the cross-process tree stitches."""
 
     payload: tuple
     id: int = 0
@@ -129,6 +131,7 @@ class ServeRequest:
     t_forward1: float = 0.0
     t_done: float = 0.0
     trace_id: Optional[str] = None
+    parent_span: Optional[str] = None
 
     def __post_init__(self) -> None:
         self._done = threading.Event()
@@ -617,7 +620,8 @@ class ServingReplica:
         # histogram breach joins to, carrying the phase split inline
         root = tracer.record_span(
             "serving_request", "serving", req.t_enqueue, req.t_done,
-            trace_id=tid, replica=self.name, job=self.job,
+            trace_id=tid, parent_id=req.parent_span,
+            replica=self.name, job=self.job,
             request_id=req.id, latency_ms=lat_ms,
             slo_violation=req.slo_violation,
             queue_ms=round(max(req.t_admit - req.t_queued, 0.0) * 1e3, 3),
@@ -631,6 +635,10 @@ class ServingReplica:
             tracer.record_span(f"serving_request.{phase}", "serving",
                                t0, max(t1, t0), trace_id=tid,
                                parent_id=root)
+            # histogram exemplars: the scrape plane joins a phase
+            # breach in edl_serving_span_seconds straight to this trace
+            self._shist.put_exemplar(max(t1 - t0, 0.0), tid, phase=phase)
+        self._hist.put_exemplar(req.latency_s, tid, job=self.job)
 
 
 @dataclass
@@ -859,18 +867,21 @@ class ServingFleet:
     # -- routing ------------------------------------------------------------
 
     def submit(self, payload: tuple,
-               trace_id: Optional[str] = None) -> ServeRequest:
+               trace_id: Optional[str] = None,
+               parent_span: Optional[str] = None) -> ServeRequest:
         """Admit one request: routed to the READY replica with the
         shortest queue (a building/reloading replica receives no new
         traffic; with none ready — transient, e.g. a single replica
         mid-build — the request queues on the least-loaded live replica
         and waits rather than failing).  ``trace_id`` (the ``/predict``
         ``X-EDL-Trace-Id`` header, or any caller's id) makes the
-        request's phase spans first-class trace events."""
+        request's phase spans first-class trace events; ``parent_span``
+        (the LB origin's injected ``X-EDL-Parent-Span``) stitches them
+        under the cross-tier root."""
         req = ServeRequest(payload=tuple(np.asarray(a) for a in payload),
                            id=next(self._ids),
                            t_enqueue=time.perf_counter(),
-                           trace_id=trace_id)
+                           trace_id=trace_id, parent_span=parent_span)
         while True:
             with self._lock:
                 live = [r for r in self._replicas if r.state != STOPPED]

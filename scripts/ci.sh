@@ -821,15 +821,18 @@ print("determinism smoke OK:", div, "vw_remaps",
       get_counters().get("vw_remaps") - c0)
 EOF
 
-echo "== front-door smoke (LB → 2 replicas: keep-alive, hedge rescue, strict metrics)"
+echo "== front-door smoke (LB → 2 replicas: keep-alive, hedge rescue, strict metrics, stitched trace)"
 # The serving data plane tripwire (doc/serving.md §data-plane): a short
 # pipelined burst through the load-balancer tier into two async
 # front-door replicas must (a) ride persistent connections — requests ≫
 # connections, (b) stay under the smoke SLO at p99, (c) drop nothing,
 # (d) rescue an injected straggler iteration via a hedge whose late
-# primary response is consumed and DISCARDED, and (e) leave the new
+# primary response is consumed and DISCARDED, (e) leave the new
 # edl_lb_* / edl_frontdoor_* series green under the strict exposition
-# parser, fetched over real HTTP like a production scraper would.
+# parser, fetched over real HTTP like a production scraper would, and
+# (f) yield a stitched LB→door→batch span tree for the hedged request —
+# rendered by `edl-tpu trace`, with the hedge-loser span marked
+# discarded (doc/serving.md §request tracing).
 JAX_PLATFORMS=cpu python - <<'EOF'
 import threading, time, socket, re, urllib.request
 import numpy as np, jax
@@ -948,6 +951,47 @@ try:
     assert loses > 0, "straggler's late response never discarded"
     assert c.get("lb_overload_sheds", job=JOB) == 0
     assert c.get("lb_timeouts", job=JOB) == 0
+
+    # (f) the stitched cross-tier trace: repeat the straggler drill
+    # with a CLIENT-traced request, then recover the whole tree by id
+    # through the `edl-tpu trace` verb (the operator's path)
+    import io, os, tempfile
+    from contextlib import redirect_stdout
+    from edl_tpu import cli as edl_cli
+    from edl_tpu.observability.tracing import get_tracer, new_trace_id
+    tid = new_trace_id()
+    treq = build_predict_request(row, trace_id=tid)
+    apps["ra"]._stall_once_ms = 2000
+    d = socket.create_connection(("127.0.0.1", doors["ra"].port))
+    d.sendall(req); time.sleep(0.05)
+    apps["rb"]._set_state(FD_RELOADING)
+    while lb.app.upstreams["rb"].state != FD_RELOADING: time.sleep(0.02)
+    s = socks[0]
+    s.sendall(treq); time.sleep(0.05)
+    apps["rb"]._set_state(FD_READY)
+    while lb.app.upstreams["rb"].state != FD_READY: time.sleep(0.02)
+    assert read_n(s, 1) == [200]
+    read_n(d, 1); d.close()
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        outs = {e.args.get("outcome") for e in get_tracer().events()
+                if e.trace_id == tid and e.name == "lb.upstream"}
+        if {"win", "discarded"} <= outs:
+            break
+        time.sleep(0.05)
+    tdir = tempfile.mkdtemp(prefix="edl-ci-traces-")
+    get_tracer().dump(os.path.join(tdir, "trace-ci-smoke.json"),
+                      "ci-smoke")
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = edl_cli.main(["trace", tid, "--trace-dir", tdir])
+    tree = buf.getvalue()
+    assert rc == 0, (rc, tree)
+    for need in ("lb_request", "lb.upstream", "frontdoor_request",
+                 "frontdoor.queue", "frontdoor.forward",
+                 "kind=hedge", "outcome=win", "outcome=discarded"):
+        assert need in tree, (need, tree)
+    assert c.get("traces_sampled", job=JOB, origin="client") >= 1
     for s in socks:
         s.close()
 
@@ -961,13 +1005,16 @@ try:
     for need in ("edl_lb_requests_total", "edl_lb_responses_total",
                  "edl_lb_hedges_total", "edl_lb_hedges_fired_total",
                  "edl_frontdoor_requests_served_total",
-                 "edl_frontdoor_connections_total"):
+                 "edl_frontdoor_connections_total",
+                 "edl_traces_sampled_total"):
         assert need in got, (need, sorted(got))
     msrv.shutdown()
     print("front-door smoke OK:", {
-        "requests": 1004, "lb_connections": 2,
+        "requests": 1005, "lb_connections": 2,
         "p99_ms": round(p99_ms, 2), "hedge_wins": int(wins),
-        "hedge_discards": int(loses)})
+        "hedge_discards": int(loses),
+        "stitched_trace": tid,
+        "trace_spans": tree.count("\n") + 1})
 finally:
     lb.stop()
     for door in doors.values():

@@ -489,7 +489,7 @@ def test_fleet_app_request_timeout_500():
         def replicas_ready(self):
             return 1
 
-        def submit(self, batch, trace_id=None):
+        def submit(self, batch, trace_id=None, parent_span=None):
             return ServeRequest(payload=batch)  # never completed
 
     app = FleetApp(WedgedFleet(), SIZES[0], timeout_s=0.5)
@@ -732,3 +732,189 @@ def test_gc_sweeps_serving_addr_prefix():
     from edl_tpu.coord.gc import JOB_KV_PREFIXES
 
     assert "serving-addr/" in JOB_KV_PREFIXES
+
+
+# -- request tracing (ISSUE-14): f32↔JSON span parity, the loop-lag probe ----
+
+
+def _read_raw_responses(sock, n, timeout=30.0):
+    """Like read_responses but keeps the raw head bytes (header-contract
+    assertions need them)."""
+    sock.settimeout(timeout)
+    buf = b""
+    out = []
+    while len(out) < n:
+        idx = buf.find(b"\r\n\r\n")
+        if idx < 0:
+            buf += sock.recv(1 << 20)
+            continue
+        head = buf[:idx + 4]
+        status = int(head.split(b" ", 2)[1])
+        m = re.search(rb"\r\n[Cc]ontent-[Ll]ength: (\d+)", head)
+        clen = int(m.group(1)) if m else 0
+        while len(buf) < idx + 4 + clen:
+            buf += sock.recv(1 << 20)
+        out.append((status, head, buf[idx + 4:idx + 4 + clen]))
+        buf = buf[idx + 4 + clen:]
+    return out
+
+
+def _span_names(trace_id):
+    from edl_tpu.observability.tracing import get_tracer
+
+    return sorted({e.name for e in get_tracer().events()
+                   if e.trace_id == trace_id})
+
+
+def test_f32_json_span_parity_and_echo():
+    """A traced request gets the SAME front-door phase taxonomy and the
+    same header echo whether it arrives on the f32 fast path or the
+    JSON slow path — the fast path is not a tracing blind spot
+    (ISSUE-14 satellite: today only JSON got the full treatment)."""
+    from edl_tpu.observability.tracing import new_trace_id
+
+    app, door = make_replica("fdtest/parity")
+    assert app.wait_ready(120)
+    try:
+        tid_f32, tid_json = new_trace_id(), new_trace_id()
+        row = np.ones((SIZES[0],), np.float32)
+        s = connect(door.port)
+        # f32 fast path, traced
+        s.sendall(build_predict_request(row, trace_id=tid_f32))
+        status, head, body = _read_raw_responses(s, 1)[0]
+        assert status == 200
+        assert f"X-EDL-Trace-Id: {tid_f32}".encode() in head, head
+        assert len(body) == SIZES[-1] * 4  # still a raw f32 body
+        # JSON slow path, traced
+        payload = json.dumps({"inputs": row.tolist()}).encode()
+        s.sendall(b"POST /predict HTTP/1.1\r\nHost: t\r\n"
+                  b"Content-Type: application/json\r\n"
+                  b"X-EDL-Trace-Id: " + tid_json.encode() + b"\r\n"
+                  b"Content-Length: %d\r\n\r\n" % len(payload) + payload)
+        status, head, body = _read_raw_responses(s, 1)[0]
+        assert status == 200
+        assert f"X-EDL-Trace-Id: {tid_json}".encode() in head
+        s.close()
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and (
+                not _span_names(tid_f32) or not _span_names(tid_json)):
+            time.sleep(0.05)
+        # span PARITY: identical phase taxonomy on both paths
+        assert _span_names(tid_f32) == _span_names(tid_json)
+        assert _span_names(tid_f32) == [
+            "frontdoor.admit", "frontdoor.batch", "frontdoor.forward",
+            "frontdoor.parse", "frontdoor.queue", "frontdoor.respond",
+            "frontdoor_request"]
+        # both landed in the exemplar ring + the histogram's exemplars
+        ring_ids = {e["trace_id"] for e in app.exemplars}
+        assert {tid_f32, tid_json} <= ring_ids
+        hist_ids = {t for t, _v, _ts in
+                    app._hist.exemplars(job="fdtest/parity")}
+        assert tid_f32 in hist_ids or tid_json in hist_ids
+    finally:
+        door.stop()
+
+
+def test_traced_head_neither_cached_nor_armed():
+    """Traced heads are unique per request (they embed the id): they
+    must not churn the bounded head cache, and must not re-arm the
+    fixed-stride parser away from the steady-state head."""
+    from edl_tpu.observability.tracing import new_trace_id
+
+    app, door = make_replica("fdtest/headcache")
+    assert app.wait_ready(120)
+    try:
+        row = np.ones((SIZES[0],), np.float32)
+        s = connect(door.port)
+        # plain → traced → plain, pipelined on one connection
+        tid = new_trace_id()
+        s.sendall(build_predict_request(row)
+                  + build_predict_request(row, trace_id=tid)
+                  + build_predict_request(row))
+        resps = read_responses(s, 3)
+        assert [st for st, _ in resps] == [200] * 3
+        cached = list(door.head_cache)
+        assert not any(tid.encode() in h for h in cached), cached
+        # the armed fast-path head is still the PLAIN steady-state one
+        conn = next(iter(door.conns))
+        assert conn._fixed is not None
+        assert tid.encode() not in conn._fixed[0]
+        s.close()
+    finally:
+        door.stop()
+
+
+def test_untraced_f32_requests_emit_no_spans():
+    """The unsampled steady state pays nothing: plain f32 requests
+    leave no frontdoor_request spans behind."""
+    from edl_tpu.observability.tracing import get_tracer
+
+    app, door = make_replica("fdtest/quiet")
+    assert app.wait_ready(120)
+    try:
+        before = sum(1 for e in get_tracer().events()
+                     if e.name == "frontdoor_request")
+        row = np.ones((SIZES[0],), np.float32)
+        s = connect(door.port)
+        s.sendall(build_predict_request(row) * 8)
+        assert [st for st, _ in read_responses(s, 8)] == [200] * 8
+        s.close()
+        after = sum(1 for e in get_tracer().events()
+                    if e.name == "frontdoor_request")
+        assert after == before
+        assert not any(e.get("replica") == "r0" and False
+                       for e in app.exemplars)  # ring untouched by these
+    finally:
+        door.stop()
+
+
+def test_loop_lag_probe_histogram_breach_and_flightrec(tmp_path):
+    """The loop-lag watchdog: a blocking call on the event-loop thread
+    shows up in edl_loop_lag_seconds, counts breaches, and a sustained
+    lag dumps a flight record embedding the exemplar ring."""
+    from edl_tpu.runtime.frontdoor import LoopLagProbe
+
+    app, door = make_replica("fdtest/lag")
+    assert app.wait_ready(120)
+    probe = None
+    try:
+        probe = LoopLagProbe(
+            door, "fdtest-lag", interval_s=0.02, breach_s=0.05,
+            sustain=2, flight_dir=str(tmp_path),
+            exemplars_fn=lambda: list(app.exemplars),
+            dump_cooldown_s=0.0).start()
+        # wedge DETECTION is armed before the first tick (seeded beat):
+        # a loop that wedges immediately is still caught
+        assert probe._watchdog._last_beat is not None
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and probe.ticks < 3:
+            time.sleep(0.02)
+        assert probe.ticks >= 3, "probe never ran on the loop"
+        # wedge the loop twice: two consecutive breached ticks
+        for _ in range(2):
+            door.call_soon(time.sleep, 0.12)
+            time.sleep(0.15)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and probe.escalations == 0:
+            time.sleep(0.05)
+        assert probe.breaches >= 2
+        assert probe.escalations >= 1
+        assert get_counters().get("loop_lag_breaches",
+                                  loop="fdtest-lag") >= 2
+        recs = [f for f in os.listdir(tmp_path)
+                if f.startswith("flightrec-") and "loop-lag" in f]
+        assert recs, os.listdir(tmp_path)
+        with open(tmp_path / recs[0]) as f:
+            doc = json.load(f)
+        assert doc["extra"]["loop"] == "fdtest-lag"
+        assert "exemplars" in doc["extra"]
+        # the lag histogram saw the wedge
+        from edl_tpu.observability.metrics import get_registry
+
+        hist = get_registry().histogram("loop_lag_seconds")
+        assert hist.count(loop="fdtest-lag") >= 3
+        assert hist.sum(loop="fdtest-lag") >= 0.1  # two ~120 ms wedges
+    finally:
+        if probe is not None:
+            probe.stop()
+        door.stop()

@@ -559,3 +559,189 @@ def test_unhedged_rescue_duplicate_not_a_hedge_lose():
     assert c.get("lb_hedges", job="lbtest/dup",
                  result="lose") == lose0 + 2
     assert c.get("lb_late_responses", job="lbtest/dup") == late0 + 3
+
+
+# -- request tracing at the origin (ISSUE-14) --------------------------------
+
+
+def _trace_events(tid):
+    from edl_tpu.observability.tracing import get_tracer
+
+    return [e for e in get_tracer().events() if e.trace_id == tid]
+
+
+class TestTraceOrigin:
+    """The LB as trace origin: head sampling injects the header, a
+    hedge duel yields winner/loser spans stitched cross-tier, and the
+    exemplar ring + traces_sampled counters record the keeps."""
+
+    JOB = "lbtrace/fleet"
+
+    @classmethod
+    def setup_class(cls):
+        cls.kv = FakeKV()
+        cls.app_a, cls.door_a = spin_replica(cls.kv, cls.JOB, "ra")
+        cls.app_b, cls.door_b = spin_replica(cls.kv, cls.JOB, "rb")
+        # trace_sample=1.0: EVERY admitted block head-samples — the
+        # deterministic setting tests (and only tests) use
+        cls.lb = ServingLB(
+            job=cls.JOB, host="127.0.0.1", kv=cls.kv, pool=2,
+            discovery_s=0.1, sweep_ms=3.0, hedge_floor_ms=30.0,
+            request_timeout_s=20.0, trace_sample=1.0).start()
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and sum(
+                1 for u in cls.lb.app.upstreams.values()
+                if u.routable()) < 2:
+            time.sleep(0.05)
+        assert sum(1 for u in cls.lb.app.upstreams.values()
+                   if u.routable()) == 2
+
+    @classmethod
+    def teardown_class(cls):
+        cls.lb.stop()
+        cls.door_a.stop()
+        cls.door_b.stop()
+
+    def _gate_rb(self, state):
+        self.app_b._set_state(state)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline \
+                and self.lb.app.upstreams["rb"].state != state:
+            time.sleep(0.02)
+        assert self.lb.app.upstreams["rb"].state == state
+
+    def test_head_sampling_injects_and_stitches(self):
+        """An UNTRACED client request is head-sampled at the LB: a
+        trace id is minted, the header injected into the forwarded
+        bytes, the replica's door records its phases under the same id
+        parented to the LB root, and the echo rides back to the
+        client."""
+        c = get_counters()
+        head0 = c.get("traces_sampled", job=self.JOB, origin="head")
+        row = np.ones((SIZES[0],), np.float32)
+        s = connect(self.lb.port)
+        s.sendall(build_predict_request(row))  # NO client trace header
+        resps = read_responses(s, 1)
+        s.close()
+        assert resps[0][0] == 200
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and c.get(
+                "traces_sampled", job=self.JOB, origin="head") == head0:
+            time.sleep(0.05)
+        assert c.get("traces_sampled", job=self.JOB,
+                     origin="head") > head0
+        ex = [e for e in self.lb.app.exemplars if e["origin"] == "head"]
+        assert ex, list(self.lb.app.exemplars)
+        tid = ex[-1]["trace_id"]
+        deadline = time.monotonic() + 10
+        names = set()
+        while time.monotonic() < deadline:
+            names = {e.name for e in _trace_events(tid)}
+            if "frontdoor_request" in names and "lb_request" in names:
+                break
+            time.sleep(0.05)
+        # the stitched set: LB origin spans AND the door's phases,
+        # one trace id across both tiers
+        assert {"lb_request", "lb.route", "lb.upstream",
+                "frontdoor_request", "frontdoor.forward"} <= names
+        # the door root is PARENTED to the LB root (injected
+        # X-EDL-Parent-Span), not an orphan stitched only by id
+        root = next(e for e in _trace_events(tid)
+                    if e.name == "lb_request")
+        door_root = next(e for e in _trace_events(tid)
+                         if e.name == "frontdoor_request")
+        assert door_root.parent_id == root.span_id
+
+    def test_hedged_request_tree_marks_loser_discarded(self):
+        """The acceptance shape: a hedged request's stitched tree shows
+        the duel — hedge twins as sibling lb.upstream spans, winner
+        marked win, the straggler's late response marked discarded —
+        rendered by the same code path `edl-tpu trace` uses."""
+        from edl_tpu.observability.tracing import (
+            get_tracer, new_trace_id, render_trace_tree,
+        )
+
+        c = get_counters()
+        tid = new_trace_id()
+        row = np.ones((SIZES[0],), np.float32)
+        # wedge ra via a direct request, steer the traced request onto
+        # it, then regate rb as the hedge target (the test_lb steering
+        # recipe)
+        self.app_a._stall_once_ms = 1200
+        direct = connect(self.door_a.port)
+        direct.sendall(build_predict_request(row))
+        time.sleep(0.05)
+        self._gate_rb(FD_RELOADING)
+        s = connect(self.lb.port)
+        s.sendall(build_predict_request(row, trace_id=tid))
+        time.sleep(0.05)
+        self._gate_rb(FD_READY)
+        resps = read_responses(s, 1, timeout=30)
+        s.close()
+        assert resps[0][0] == 200
+        read_responses(direct, 1, timeout=30)
+        direct.close()
+        # wait until the duel fully resolved: winner AND discarded loser
+        deadline = time.monotonic() + 15
+        outcomes = set()
+        while time.monotonic() < deadline:
+            outcomes = {e.args.get("outcome")
+                        for e in _trace_events(tid)
+                        if e.name == "lb.upstream"}
+            if {"win", "discarded"} <= outcomes:
+                break
+            time.sleep(0.05)
+        assert {"win", "discarded"} <= outcomes, outcomes
+        evs = [{"name": e.name, "category": e.category,
+                "ts_s": e.start_s, "dur_s": e.duration_s,
+                "proc": "inproc", "trace_id": e.trace_id,
+                "span_id": e.span_id, "parent_id": e.parent_id,
+                "args": dict(e.args)} for e in _trace_events(tid)]
+        txt = render_trace_tree(evs, tid)
+        assert "lb_request" in txt
+        assert "outcome=discarded" in txt
+        assert "outcome=win" in txt
+        assert "kind=hedge" in txt
+        assert "frontdoor_request" in txt
+        # the exemplar ring marks it hedged, and the always-keep
+        # counter moved even though this was a client-traced request
+        ex = [e for e in self.lb.app.exemplars
+              if e["trace_id"] == tid]
+        assert ex and ex[0]["hedged"] is True
+        # histogram exemplar attached for the scrape plane
+        ids = {t for t, _v, _ts in
+               self.lb.app._hist.exemplars(job=self.JOB)}
+        assert tid in ids
+        assert get_tracer()  # keep the import referenced
+
+    def test_trace_disabled_lb_injects_nothing(self):
+        """trace=False: no ctx, no injection, no spans — the pre-ISSUE
+        behavior, selectable per process (EDL_LB_TRACE_SAMPLE=-1)."""
+        from edl_tpu.observability.tracing import get_tracer
+
+        kv = FakeKV()
+        app, door = spin_replica(kv, "lbtrace/off", "rq")
+        lb = ServingLB(job="lbtrace/off", host="127.0.0.1", kv=kv,
+                       pool=1, discovery_s=0.1, sweep_ms=3.0,
+                       trace=False, trace_sample=1.0).start()
+        try:
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline and not any(
+                    u.routable() for u in lb.app.upstreams.values()):
+                time.sleep(0.05)
+            before = len(get_tracer().events())
+            row = np.ones((SIZES[0],), np.float32)
+            s = connect(lb.port)
+            s.sendall(build_predict_request(row) * 4)
+            assert [st for st, _ in read_responses(s, 4)] == [200] * 4
+            s.close()
+            assert get_counters().get("traces_sampled",
+                                      job="lbtrace/off",
+                                      origin="head") == 0
+            new = [e for e in list(get_tracer().events())[before:]
+                   if e.name in ("lb_request", "frontdoor_request")
+                   and e.args.get("job") == "lbtrace/off"]
+            assert new == []
+        finally:
+            lb.stop()
+            door.stop()

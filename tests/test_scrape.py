@@ -983,3 +983,158 @@ class TestRequestSpans:
             fleet.stop()
         assert not [e for e in get_tracer().events()
                     if e.name.startswith("serving_request")]
+
+
+# ------------------------------------------- histogram exemplar ingestion
+
+
+class TestExemplars:
+    """ISSUE-14 satellite: the scrape plane ingests trace-id exemplars
+    off histogram bucket lines — the join from a fleet-level latency
+    breach to an `edl-tpu trace`-able id."""
+
+    def _exposed_registry(self, tid="feedbeef0001", v=0.05):
+        reg = MetricsRegistry()
+        h = reg.histogram("serving_request_seconds",
+                          buckets=(0.001, 0.01, 0.1, 1.0))
+        h.observe(v, job="j1")
+        h.put_exemplar(v, tid, job="j1")
+        reg.counter("serving_requests").inc(3, job="j1")
+        return reg
+
+    def test_parse_exposition_roundtrips_exemplars(self):
+        from edl_tpu.observability.metrics import (
+            iter_samples, parse_exposition,
+        )
+
+        reg = self._exposed_registry()
+        text = reg.render()
+        assert ' # {trace_id="feedbeef0001"} 0.05' in text
+        # the strict parser accepts the annotated exposition whole…
+        series = parse_exposition(text)
+        assert series['edl_serving_request_seconds_count{job="j1"}'] == 1
+        # …and hands the exemplars back on request
+        ex = []
+        iter_samples(text, exemplars=ex)
+        assert len(ex) == 1
+        name, labels, ex_labels, ex_value, ts = ex[0]
+        assert name == "edl_serving_request_seconds_bucket"
+        assert labels["le"] == "0.1" and labels["job"] == "j1"
+        assert ex_labels == {"trace_id": "feedbeef0001"}
+        assert ex_value == 0.05 and ts is not None
+
+    def test_malformed_exemplar_is_a_grammar_violation(self):
+        from edl_tpu.observability.metrics import (
+            ExpositionError, iter_samples,
+        )
+
+        bad = ('# HELP edl_x_seconds x\n# TYPE edl_x_seconds histogram\n'
+               'edl_x_seconds_bucket{le="+Inf"} 1 # {trace_id=oops} 1\n'
+               'edl_x_seconds_sum 1\nedl_x_seconds_count 1\n')
+        with pytest.raises(ExpositionError):
+            iter_samples(bad)
+
+    def test_scraper_ingests_and_fleetview_surfaces_slowest(self):
+        reg = self._exposed_registry(tid="slowtrace001", v=0.25)
+        # a second, faster exemplar on another job: slowest wins
+        h = reg.histogram("serving_request_seconds")
+        h.observe(0.002, job="j2")
+        h.put_exemplar(0.002, "fasttrace002", job="j2")
+        s, clock = make_scraper({"t1": reg.render})
+        s.sweep()
+        ex = s.exemplars("edl_serving_request_seconds")
+        assert [e["trace_id"] for e in ex[:2]] == ["slowtrace001",
+                                                   "fasttrace002"]
+        view = FleetView(s)
+        slow = view.slowest_exemplars(k=1)
+        assert slow[0]["trace_id"] == "slowtrace001"
+        assert slow[0]["family"] == "edl_serving_request_seconds"
+        snap = view.snapshot()
+        assert snap["jobs"]["j1"]["slowest_trace"]["trace_id"] == \
+            "slowtrace001"
+        assert snap["jobs"]["j1"]["slowest_trace"]["latency_ms"] == 250.0
+        # the dashboard renders the handle an operator feeds to
+        # `edl-tpu trace`
+        assert "slowtrace001" in render_fleet_dashboard(view)
+
+    def test_exemplar_stays_fresh_while_exposed(self):
+        """Re-scraping the same still-exposed exemplar refreshes its
+        age — it must not fade from rollups while the target is alive
+        and still advertising it."""
+        reg = self._exposed_registry()
+        s, clock = make_scraper({"t1": reg.render})
+        s.sweep()
+        for _ in range(6):
+            clock.advance(1.5)
+            s.sweep()
+        ex = s.exemplars("edl_serving_request_seconds", {"job": "j1"})
+        assert len(ex) == 1 and ex[0]["age_s"] < s.stale_after_s
+
+    def test_dead_target_exemplars_age_out_with_its_series(self):
+        """A discovered target that vanishes (dead pod) takes its
+        exemplars with its series — no immortal trace ids in the
+        slowest-rollup."""
+        reg = self._exposed_registry()
+        alive = [True]
+
+        def discover():
+            return ([ScrapeTarget(name="d1", addr="d1:9", source="x")]
+                    if alive[0] else [])
+
+        clock = FakeClock()
+        s = MetricsScraper(
+            fetch=lambda t: reg.render(), clock=clock,
+            discover=[discover], interval_s=1.0,
+            forget_after_sweeps=3, registry=MetricsRegistry())
+        s.sweep()
+        assert s.exemplars("edl_serving_request_seconds")
+        alive[0] = False
+        for _ in range(4):
+            clock.advance(1.5)
+            s.sweep()
+        assert s.targets() == []
+        assert s.exemplars("edl_serving_request_seconds",
+                           max_age_s=float("inf")) == []
+
+    def test_hash_inside_label_value_is_not_an_exemplar(self):
+        """A label value containing " # " (valid, and rendered verbatim
+        by the module's own renderer) must not be mistaken for an
+        exemplar separator — the whole target scrape would error."""
+        from edl_tpu.observability.metrics import (
+            iter_samples, parse_exposition,
+        )
+
+        reg = MetricsRegistry()
+        reg.counter("jobs").inc(1, job="a # b")
+        text = reg.render()
+        series = parse_exposition(text)
+        assert series['edl_jobs_total{job="a # b"}'] == 1
+        ex = []
+        iter_samples(text, exemplars=ex)
+        assert ex == []
+        # and both at once: hashy label + a real exemplar on one line
+        h = reg.histogram("lat_seconds", buckets=(1.0,))
+        h.observe(0.5, job="a # b")
+        h.put_exemplar(0.5, "tid # x", job="a # b")
+        ex = []
+        iter_samples(reg.render(), exemplars=ex)
+        assert len(ex) == 1
+        assert ex[0][1]["job"] == "a # b"
+        assert ex[0][2] == {"trace_id": "tid # x"}
+
+    def test_expired_exemplar_stops_rendering(self):
+        """A once-ever outlier exemplar must not be re-exposed (and so
+        re-freshened by every scraper) past the histogram's TTL — by
+        then its trace dumps have rotated and the handle is dead."""
+        reg = MetricsRegistry()
+        h = reg.histogram("lat_seconds", buckets=(1.0,))
+        h.observe(0.5, job="j")
+        h.put_exemplar(0.5, "oldtrace", job="j")
+        assert "oldtrace" in reg.render()
+        # age the stored exemplar past the TTL
+        for ex in h._exemplars.values():
+            for i, (tid, v, ts) in list(ex.items()):
+                ex[i] = (tid, v, ts - h.exemplar_ttl_s - 1)
+        assert "oldtrace" not in reg.render()
+        # …and it stays gone (the expiry prunes, not just filters)
+        assert all(not ex for ex in h._exemplars.values())

@@ -1,0 +1,200 @@
+"""Cross-process trace stitching + the `edl-tpu trace` verb
+(ISSUE-14 tentpole): load_trace_events over chrome dumps AND flight
+records, span-forest nesting, tree rendering, the TraceFileSink a live
+data-plane process dumps through, and the CLI surface end-to-end."""
+
+import io
+import json
+import os
+import time
+from contextlib import redirect_stdout
+
+from edl_tpu.observability.tracing import (
+    TraceFileSink,
+    Tracer,
+    build_span_forest,
+    discover_trace_files,
+    load_trace_events,
+    new_trace_id,
+    render_trace_tree,
+)
+
+
+def _two_process_trace(tmp_path, tid):
+    """Simulate the LB + one replica recording one hedged request, each
+    into its own tracer, dumped as separate processes' files."""
+    lb, fd = Tracer(), Tracer()
+    root = lb.record_span("lb_request", "lb", 0.000, 0.050,
+                          trace_id=tid, n=4, origin="head",
+                          outcome="served", hedged=True)
+    lb.record_span("lb.route", "lb", 0.000, 0.001, trace_id=tid,
+                   parent_id=root)
+    lb.record_span("lb.upstream", "lb", 0.001, 0.048, trace_id=tid,
+                   parent_id=root, replica="r0", kind="primary",
+                   outcome="discarded")
+    lb.record_span("lb.upstream", "lb", 0.020, 0.024, trace_id=tid,
+                   parent_id=root, replica="r1", kind="hedge",
+                   outcome="win")
+    door = fd.record_span("frontdoor_request", "frontdoor", 0.021,
+                          0.024, trace_id=tid, parent_id=root,
+                          replica="r1", rows=4)
+    fd.record_span("frontdoor.forward", "frontdoor", 0.022, 0.0235,
+                   trace_id=tid, parent_id=door)
+    lb.dump(str(tmp_path / "trace-lb-1.json"), "lb-1")
+    fd.dump(str(tmp_path / "trace-fd-r1.json"), "fd-r1")
+    return lb, fd
+
+
+def test_load_and_render_stitched_cross_process_tree(tmp_path):
+    tid = new_trace_id()
+    _two_process_trace(tmp_path, tid)
+    # noise in the same files: another trace id must not leak in
+    files = discover_trace_files(str(tmp_path))
+    assert len(files) == 2
+    events = load_trace_events(files, tid)
+    assert len(events) == 6
+    assert {e["proc"] for e in events} == {"lb-1", "fd-r1"}
+    roots = build_span_forest(events)
+    assert len(roots) == 1 and roots[0]["name"] == "lb_request"
+    # door root nests under the LB root even though it came from
+    # another process's dump (parent_id stitching)
+    kids = [c["name"] for c in roots[0]["children"]]
+    assert kids == ["lb.route", "lb.upstream", "lb.upstream",
+                    "frontdoor_request"]
+    txt = render_trace_tree(events, tid)
+    assert "2 processes" in txt
+    assert "outcome=discarded" in txt and "outcome=win" in txt
+    assert "frontdoor.forward" in txt
+    assert "[fd-r1]" in txt and "[lb-1]" in txt
+
+
+def test_orphan_parent_surfaces_as_root(tmp_path):
+    """A span whose parent dump is missing (ring rotated, file lost)
+    must surface as a root, not vanish from the tree."""
+    tid = new_trace_id()
+    t = Tracer()
+    t.record_span("frontdoor_request", "frontdoor", 0.0, 0.01,
+                  trace_id=tid, parent_id="missing-span-id")
+    t.dump(str(tmp_path / "trace-orphan.json"), "fd")
+    events = load_trace_events([str(tmp_path / "trace-orphan.json")],
+                               tid)
+    roots = build_span_forest(events)
+    assert [r["name"] for r in roots] == ["frontdoor_request"]
+    assert "frontdoor_request" in render_trace_tree(events, tid)
+
+
+def test_flight_record_is_a_trace_source(tmp_path):
+    """flightrec-*.json embeds the trace ring with a wall anchor — a
+    crash's flight record is enough to recover its sampled traces."""
+    from edl_tpu.observability.metrics import dump_flight_record
+
+    tid = new_trace_id()
+    t = Tracer()
+    t.record_span("lb_request", "lb", 0.0, 0.02, trace_id=tid,
+                  origin="rescue", outcome="served")
+    path = dump_flight_record(str(tmp_path), "lb-abnormal-exit",
+                              tracer=t)
+    assert os.path.basename(path).startswith("flightrec-")
+    events = load_trace_events([path], tid)
+    assert len(events) == 1 and events[0]["name"] == "lb_request"
+    assert events[0]["args"]["origin"] == "rescue"
+    # discovery picks flight records up next to trace dumps
+    assert path in discover_trace_files(str(tmp_path))
+
+
+def test_cli_trace_verb_renders_and_errors(tmp_path, capsys):
+    from edl_tpu import cli
+
+    tid = new_trace_id()
+    _two_process_trace(tmp_path, tid)
+    rc = cli.main(["trace", tid, "--trace-dir", str(tmp_path)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert f"trace {tid}" in out
+    assert "lb_request" in out and "frontdoor_request" in out
+    assert "outcome=discarded" in out
+    # unknown id: exit 1 with a pointer, not a stack trace
+    rc = cli.main(["trace", "no-such-trace", "--trace-dir",
+                   str(tmp_path)])
+    assert rc == 1
+    # no sources at all: exit 2
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    rc = cli.main(["trace", tid, "--trace-dir", str(empty)])
+    assert rc == 2
+
+
+def test_cli_trace_explicit_files(tmp_path):
+    from edl_tpu import cli
+
+    tid = new_trace_id()
+    _two_process_trace(tmp_path, tid)
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = cli.main(["trace", tid, "--files",
+                       str(tmp_path / "trace-lb-1.json")])
+    assert rc == 0
+    txt = buf.getvalue()
+    # only the LB's half: door spans live in the other (unpassed) file
+    assert "lb_request" in txt and "frontdoor_request" not in txt
+
+
+def test_trace_file_sink_periodic_and_final_dump(tmp_path):
+    t = Tracer()
+    tid = new_trace_id()
+    t.record_span("lb_request", "lb", 0.0, 0.01, trace_id=tid)
+    sink = TraceFileSink(str(tmp_path), "lb-test", interval_s=0.05,
+                         tracer=t)
+    sink.start()
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and sink.dumps < 2:
+        time.sleep(0.02)
+    assert sink.dumps >= 2
+    # a late event makes it into the FINAL dump on stop()
+    t.record_span("lb.upstream", "lb", 0.01, 0.02, trace_id=tid)
+    sink.stop()
+    with open(tmp_path / "trace-lb-test.json") as f:
+        doc = json.load(f)
+    names = {e["name"] for e in doc["traceEvents"]
+             if e.get("ph") != "M"}
+    assert {"lb_request", "lb.upstream"} <= names
+    assert doc["edl"]["process"] == "lb-test"
+    # no torn temp files left behind
+    assert not [f for f in os.listdir(tmp_path) if ".tmp-" in f]
+
+
+def test_anchorless_file_merges_degraded_not_fatal(tmp_path):
+    """A foreign chrome trace without the edl wall anchor merges at raw
+    timestamps instead of being dropped or shifting everything."""
+    tid = new_trace_id()
+    foreign = {"traceEvents": [{
+        "name": "ext_span", "cat": "x", "ph": "X", "ts": 1000.0,
+        "dur": 500.0, "pid": 0, "tid": 0,
+        "args": {"trace_id": tid, "span_id": "e1"}}]}
+    p = tmp_path / "trace-foreign.json"
+    p.write_text(json.dumps(foreign))
+    events = load_trace_events([str(p)], tid)
+    assert len(events) == 1
+    assert events[0]["ts_s"] == 0.001 and events[0]["dur_s"] == 0.0005
+
+
+def test_duplicate_sources_dedupe_by_span_id(tmp_path):
+    """The same ring dumped twice — a trace-*.json AND a flight record
+    (EDL_TRACE_DIR == EDL_FLIGHTREC_DIR is a legitimate setup) — must
+    not duplicate subtrees in the rendered tree."""
+    from edl_tpu.observability.metrics import dump_flight_record
+
+    tid = new_trace_id()
+    t = Tracer()
+    root = t.record_span("lb_request", "lb", 0.0, 0.05, trace_id=tid)
+    t.record_span("lb.upstream", "lb", 0.001, 0.049, trace_id=tid,
+                  parent_id=root, kind="primary", outcome="win")
+    t.instant("lb_shed_marker", category="lb")
+    t.dump(str(tmp_path / "trace-lb.json"), "lb-1")
+    dump_flight_record(str(tmp_path), "loop-lag-lb", tracer=t)
+    events = load_trace_events(discover_trace_files(str(tmp_path)), tid)
+    assert len(events) == 2  # not 4
+    roots = build_span_forest(events)
+    assert len(roots) == 1
+    assert [c["name"] for c in roots[0]["children"]] == ["lb.upstream"]
+    assert "2 spans" in render_trace_tree(events, tid)
