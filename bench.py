@@ -285,6 +285,52 @@ def scheduler_multidomain_bench() -> dict:
     }
 
 
+def sched_sim_leg() -> dict:
+    """Goodput-driven multi-tenant scheduling at fleet scale
+    (doc/scheduling.md): 2000 synthetic jobs — scaling curves sampled
+    from the recorded template classes, ~15% serving fleets, mixed
+    priorities — driven through the REAL planner on a 512-chip
+    8-domain fleet, under the marginal-goodput objective AND the
+    count-based baseline on a bit-identical workload.  Headlines:
+    aggregate-goodput uplift, admission p50/p99 (censored at the
+    horizon), preemptions, and the hard invariants (zero gang
+    strandings, no world below min_instance)."""
+    from edl_tpu.scheduler.sim import SimConfig, compare_objectives
+
+    cfg = SimConfig(n_jobs=2000, hosts=64, chips_per_host=8, domains=8,
+                    horizon_s=4000.0, arrival_spread_s=3300.0, seed=17)
+    out = compare_objectives(cfg, register=True)
+    g, c = out["goodput"], out["count"]
+    # in-leg acceptance: the objective must BEAT count packing on
+    # goodput without regressing admission, and the gang/min
+    # invariants are absolute
+    assert out["sched_goodput_uplift_pct"] > 0, out
+    assert out["sched_gang_strandings"] == 0, out
+    assert out["sched_min_violations"] == 0, out
+    assert (out["sched_admission_p99_s"]
+            <= out["sched_admission_p99_s_count"] + 1e-9), out
+    return {
+        "sim_jobs": out["sim_jobs"],
+        "chips": cfg.hosts * cfg.chips_per_host,
+        "domains": cfg.domains,
+        "sched_goodput_uplift_pct": out["sched_goodput_uplift_pct"],
+        "sched_admission_p50_s": g["admission_p50_s"],
+        "sched_admission_p99_s": out["sched_admission_p99_s"],
+        "sched_admission_p99_s_count": out["sched_admission_p99_s_count"],
+        "sched_preemptions": out["sched_preemptions"],
+        "sched_gang_strandings": out["sched_gang_strandings"],
+        "sched_min_violations": out["sched_min_violations"],
+        "sched_resizes": g["resizes"],
+        "jobs_admitted": g["jobs_admitted"],
+        "jobs_completed": g["jobs_completed"],
+        "jobs_completed_count_baseline": c["jobs_completed"],
+        "chip_util_mean_pct": g["chip_util_mean_pct"],
+        "chip_util_mean_pct_count_baseline": c["chip_util_mean_pct"],
+        "goodput_run": g,
+        "count_run": c,
+    }
+
+
 # ---------------------------------------------------------------------------
 # Leg 2: accelerator throughput + MFU (runs in a subprocess)
 # ---------------------------------------------------------------------------
@@ -3136,6 +3182,13 @@ def main() -> None:
         extra_env={"JAX_PLATFORMS": "cpu", "XLA_FLAGS": "",
                    "PALLAS_AXON_POOL_IPS": ""})
 
+    # goodput-driven multi-tenant scheduling at fleet scale: 2000
+    # synthetic jobs through the REAL planner under both objectives
+    # (pure control plane, no accelerator, no jax)
+    sched_sim = _run_leg(
+        "sched_sim", timeout_s=560,
+        extra_env={"JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": ""})
+
     # Headline discipline (VERDICT r5 weak #4): LEAD with metrics that
     # can still move — contended admission latency, the MFU suite,
     # reform/resize latencies.  The saturated packing ratio (100 % vs the
@@ -3171,7 +3224,7 @@ def main() -> None:
                    "model_zoo": zoo, "elastic": elastic,
                    "reparallel": reparallel, "reform": reform,
                    "coord_ha": coord_ha, "coord_scale": coord_scale,
-                   "goodput": goodput_r,
+                   "goodput": goodput_r, "sched_sim": sched_sim,
                    "determinism": determinism, "serving": serving,
                    "frontdoor": frontdoor,
                    "tpu_world_cycle": tpu_cycle},
@@ -3246,6 +3299,19 @@ def main() -> None:
             goodput_r.get("marginal_tok_s_per_chip_at_4"),
         "goodput_curve_survived_failover":
             goodput_r.get("curve_survived_failover"),
+        # goodput-driven multi-tenant scheduling (ROADMAP #1): the
+        # fleet-scale sim's comparison of the marginal objective vs the
+        # count-based baseline through the REAL planner — uplift must
+        # be positive, strandings zero, admission un-regressed
+        "sched_goodput_uplift_pct":
+            sched_sim.get("sched_goodput_uplift_pct"),
+        "sched_admission_p99_s": sched_sim.get("sched_admission_p99_s"),
+        "sched_admission_p99_s_count":
+            sched_sim.get("sched_admission_p99_s_count"),
+        "sched_preemptions": sched_sim.get("sched_preemptions"),
+        "sched_gang_strandings":
+            sched_sim.get("sched_gang_strandings"),
+        "sched_sim_jobs": sched_sim.get("sim_jobs"),
         # elastic inference serving: the first user-facing latency
         # number — request p50/p99 vs the SLO through a LIVE scale-up
         # (prewarm hit: the compile was off the traffic path) and a
@@ -3371,6 +3437,8 @@ if __name__ == "__main__":
             out = coord_scale_leg()
         elif leg == "goodput":
             out = goodput_leg()
+        elif leg == "sched_sim":
+            out = sched_sim_leg()
         elif leg == "serving":
             out = serving_leg()
         elif leg == "frontdoor":

@@ -27,6 +27,7 @@ from edl_tpu.api.types import (
     MasterSpec,
     PserverSpec,
     ResourceRequirements,
+    SchedPriority,
     ServingJob,
     ServingSpec,
     TpuTopology,
@@ -168,6 +169,9 @@ def job_from_dict(doc: dict[str, Any]) -> TrainingJob:
         topology=(TpuTopology.parse(str(t["topology"]))
                   if t.get("topology") else None),
         allow_multi_domain=bool(t.get("allow_multi_domain", False)),
+        # int or a tier name ("high"); declared int-or-string in the CRD
+        priority=SchedPriority.parse(
+            t.get("priority", SchedPriority.NORMAL)),
         env={k: str(v) for k, v in (t.get("env") or {}).items()},
         volumes=[dict(v) for v in (t.get("volumes") or [])],
         volume_mounts=[dict(v) for v in (t.get("volume_mounts") or [])],
@@ -233,6 +237,7 @@ def job_to_dict(job: TrainingJob) -> dict[str, Any]:
                 "min_instance": t.min_instance,
                 "max_instance": t.max_instance,
                 "allow_multi_domain": t.allow_multi_domain,
+                "priority": int(t.priority),
                 "env": {k: str(v) for k, v in sorted(t.env.items())},
                 "volumes": [dict(v) for v in t.volumes],
                 "volume_mounts": [dict(v) for v in t.volume_mounts],
@@ -287,6 +292,8 @@ def serving_job_from_dict(doc: dict[str, Any]) -> ServingJob:
         drain_timeout_s=float(s.get("drain_timeout_s", 30.0)),
         reload_poll_s=float(s.get("reload_poll_s", 5.0)),
         env={k: str(v) for k, v in (s.get("env") or {}).items()},
+        priority=SchedPriority.parse(
+            s.get("priority", SchedPriority.NORMAL)),
     )
     return ServingJob(
         name=meta.get("name", ""),
@@ -313,6 +320,7 @@ def serving_job_to_dict(job: ServingJob) -> dict[str, Any]:
         "max_queue_ms": s.max_queue_ms,
         "drain_timeout_s": s.drain_timeout_s,
         "reload_poll_s": s.reload_poll_s,
+        "priority": int(s.priority),
         "env": {k: str(v) for k, v in sorted(s.env.items())},
         "resources": {
             "requests": {k: str(v) for k, v in s.resources.requests.items()},

@@ -57,6 +57,32 @@ MULTI_DOMAIN_LABEL = "edl-tpu-multi-domain"
 DEFAULT_SERVING_PORT = 8500
 
 
+class SchedPriority(enum.IntEnum):
+    """Scheduling priority of a job's chip claim (doc/scheduling.md).
+
+    Consumed by the goodput planner: allocation considers higher
+    priorities first, and a pending HIGH gang may preempt — shrink, via
+    a planned resize, never a kill — lower-priority elastic jobs down
+    to their ``min_instance`` to land.  The value is an int so deployments
+    may define finer tiers; these names are the documented rungs."""
+
+    LOW = 0
+    NORMAL = 1
+    HIGH = 2
+
+    @classmethod
+    def parse(cls, v: "int | str") -> int:
+        """Accept an int or a (case-insensitive) tier name."""
+        if isinstance(v, str) and not v.lstrip("-").isdigit():
+            try:
+                return int(cls[v.strip().upper()])
+            except KeyError:
+                raise ValueError(f"unknown priority {v!r} "
+                                 f"(want an int or one of "
+                                 f"{[m.name.lower() for m in cls]})")
+        return int(v)
+
+
 def _as_qmap(m: "dict[str, Quantity | str | int] | None") -> dict[str, Quantity]:
     return {k: Quantity(v) for k, v in (m or {}).items()}
 
@@ -135,6 +161,11 @@ class TrainerSpec:
     #: capacity, because an unwitting DCN hop inside a TP/FSDP mesh is a
     #: silent order-of-magnitude bandwidth cliff.
     allow_multi_domain: bool = False
+    #: Scheduling priority (:class:`SchedPriority` rung or any int): the
+    #: goodput planner allocates chips to higher priorities first, and a
+    #: pending higher-priority gang may shrink lower-priority elastic
+    #: jobs (down to their min_instance) to be admitted.
+    priority: int = SchedPriority.NORMAL
     #: User environment for trainer pods, merged AFTER the EDL_* contract
     #: so user values win — the supported way to tune runtime knobs like
     #: EDL_MH_CKPT_EVERY per job (k8s env-list semantics: last wins).
@@ -224,6 +255,11 @@ class ServingSpec:
     #: user environment for server pods (same merge contract as
     #: ``TrainerSpec.env``: user values win)
     env: dict = field(default_factory=dict)
+    #: scheduling priority of the fleet's chip claim (same scale as
+    #: ``TrainerSpec.priority``); serving fleets defending a user-facing
+    #: SLO typically run HIGH so a saturated fleet can preempt batch
+    #: training for capacity
+    priority: int = SchedPriority.NORMAL
 
 
 @dataclass
@@ -334,6 +370,10 @@ class TrainingJob:
         elastic path); False = zero failure budget (static barrier)."""
         return self.spec.fault_tolerant
 
+    def sched_priority(self) -> int:
+        """Scheduling priority of the chip claim (doc/scheduling.md)."""
+        return int(self.spec.trainer.priority)
+
     @property
     def full_name(self) -> str:
         return f"{self.namespace}/{self.name}"
@@ -387,6 +427,10 @@ class ServingJob:
         """ReplicaSet semantics: a crashed server is always replaced —
         the fleet degrades, it never statically fails."""
         return True
+
+    def sched_priority(self) -> int:
+        """Scheduling priority of the chip claim (doc/scheduling.md)."""
+        return int(self.spec.priority)
 
     @property
     def full_name(self) -> str:

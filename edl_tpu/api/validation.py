@@ -52,6 +52,11 @@ def set_defaults_and_validate(job: T.TrainingJob) -> T.TrainingJob:
             "elastic jobs (min_instance < max_instance) require fault_tolerant"
         )
 
+    if t.priority < 0:
+        raise ValidationError(
+            f"trainer.priority must be >= 0 (got {t.priority}); "
+            "0=low 1=normal 2=high, higher ints allowed")
+
     # TPU additions: a declared topology must describe at least one chip and
     # agree with an explicit chip limit if both are present.
     if t.topology is not None:
@@ -102,6 +107,10 @@ def set_defaults_and_validate_serving(job: T.ServingJob) -> T.ServingJob:
     if s.reload_poll_s < 0:
         raise ValidationError("server.reload_poll_s must be >= 0 "
                               "(0 disables the lineage watch)")
+    if s.priority < 0:
+        raise ValidationError(
+            f"server.priority must be >= 0 (got {s.priority}); "
+            "0=low 1=normal 2=high, higher ints allowed")
     if s.topology is not None:
         if s.topology.chips < 1:
             raise ValidationError(f"invalid TPU topology {s.topology}")

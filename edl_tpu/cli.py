@@ -93,11 +93,30 @@ def cmd_controller(args) -> int:
     from edl_tpu.scheduler.topology import POW2_POLICY, UNIT_POLICY
 
     cluster = _build_cluster(args)
+    # a coordinator endpoint wires the goodput planner's curve source
+    # (doc/scheduling.md), the serving capacity recorder, and job-KV GC
+    goodput_curves = coord_for = None
+    coord_ep = getattr(args, "coord", "")
+    if coord_ep:
+        from edl_tpu.coord.client import CoordClient
+        from edl_tpu.observability.goodput import load_curve
+
+        host, _, port = coord_ep.rpartition(":")
+        if not host or not port.isdigit():
+            print(f"error: --coord wants host:port, got {coord_ep!r}",
+                  file=sys.stderr)
+            raise SystemExit(2)
+        coord = CoordClient(host, int(port))
+        goodput_curves = lambda uid: load_curve(coord, uid)  # noqa: E731
+        coord_for = lambda job: coord  # noqa: E731
     controller = Controller(
         cluster,
         max_load_desired=args.max_load_desired,
         shape_policy=POW2_POLICY if args.pow2_shapes else UNIT_POLICY,
         autoscaler_loop_seconds=args.loop_seconds,
+        goodput_curves=goodput_curves,
+        goodput_objective=getattr(args, "goodput_objective", True),
+        coord_for=coord_for,
         # scrape plane: with a source configured, the serving scaler is
         # fed from scraped replica /metrics instead of any in-process
         # hook (doc/observability.md §scrape-plane)
@@ -473,6 +492,18 @@ def build_parser() -> argparse.ArgumentParser:
                    help="stream TrainingJob watch events between periodic "
                         "full LISTs (the reference informer model); "
                         "--no-watch = pure poll-list every tick")
+    c.add_argument("--goodput-objective",
+                   action=argparse.BooleanOptionalAction, default=True,
+                   help="price chips by marginal goodput from each job's "
+                        "measured ScalingCurve (priorities, preemption, "
+                        "gang placement — doc/scheduling.md); needs a "
+                        "curve source (--coord); --no-goodput-objective "
+                        "pins the reference count-based packing")
+    c.add_argument("--coord", default="",
+                   help="coordinator host:port: enables the goodput "
+                        "curve source (goodput-curve/<job> KV), the "
+                        "serving capacity-curve recorder, and job-KV GC "
+                        "on deletion")
     _add_scrape_flags(c)
     c.set_defaults(fn=cmd_controller)
 

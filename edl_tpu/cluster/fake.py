@@ -364,7 +364,13 @@ class FakeCluster(Cluster):
         if spec is None:
             return False
         trainer = getattr(spec.spec, "trainer", None)
-        return trainer is not None and trainer.allow_multi_domain
+        if trainer is None:
+            # a replica group without a trainer section (ServingJob):
+            # replicas are independent meshes — no inter-replica ICI
+            # collective to protect, so the fleet may spread across
+            # domains and is never pinned (matches PlannedJob.multi_domain)
+            return True
+        return trainer.allow_multi_domain
 
     def _find_node_for(self, pod: FakePod) -> Optional[str]:
         idle = {

@@ -48,6 +48,7 @@ class Controller:
         min_resize_delta: int = 1,
         mesh_shape_for=None,
         goodput_curves=None,
+        goodput_objective: bool = True,
         serving_stats_for=None,
         serving_loop_seconds: float = 2.0,
         coord_for=None,
@@ -55,6 +56,12 @@ class Controller:
         scrape_window_s: float = 10.0,
     ) -> None:
         self.cluster = cluster
+        #: the packing objective (doc/scheduling.md): default ON, chips
+        #: are granted by marginal goodput whenever ``goodput_curves``
+        #: resolves a measured ScalingCurve — priorities, preemption and
+        #: gang placement included; with no curve source (or flag off)
+        #: the reference count-based packing rules unchanged
+        self.goodput_objective = goodput_objective
         self.autoscaler = Autoscaler(
             cluster,
             max_load_desired=max_load_desired,
@@ -64,6 +71,7 @@ class Controller:
             min_resize_delta=min_resize_delta,
             mesh_shape_for=mesh_shape_for,
             goodput_curves=goodput_curves,
+            goodput_objective=goodput_objective,
         )
         #: the scrape plane (observability/scrape.py): when a
         #: MetricsScraper is handed in (the ``edl-tpu controller
@@ -87,17 +95,20 @@ class Controller:
         #: above, read off the in-process fleet in the harness),
         #: actuating the same cluster replica-group dial the trainer
         #: autoscaler uses
-        self.serving_scaler = ServingScaler(
-            cluster=cluster,
-            stats_for=serving_stats_for,
-            loop_seconds=serving_loop_seconds,
-        )
         #: optional ``coord_for(job) -> kv-client | None`` hook: on job
         #: deletion the controller sweeps the job's coordinator KV
         #: (goodput curve, vw map/cursors, serving generation —
         #: edl_tpu.coord.gc.JOB_KV_PREFIXES); without it those keys
-        #: outlive the job on any shared coordinator
+        #: outlive the job on any shared coordinator.  The serving
+        #: scaler also records each fleet's measured QPS-capacity curve
+        #: through it (goodput-curve/<job>), feeding chip arbitration.
         self.coord_for = coord_for
+        self.serving_scaler = ServingScaler(
+            cluster=cluster,
+            stats_for=serving_stats_for,
+            loop_seconds=serving_loop_seconds,
+            coord_for=coord_for,
+        )
         self._updater_convert_seconds = updater_convert_seconds
         self._updater_confirm_seconds = updater_confirm_seconds
         self._updaters: dict[str, TrainingJobUpdater] = {}
@@ -143,11 +154,29 @@ class Controller:
             self._updaters[job.full_name] = updater
         if isinstance(job, ServingJob):
             self.serving_scaler.on_add(job)
+            if self._arbitrated(job):
+                # train+serve chip arbitration (doc/scheduling.md): an
+                # elastic chip-holding fleet's replica dial is owned by
+                # the goodput planner — its measured QPS-capacity curve
+                # (recorded by the serving scaler from FleetView data)
+                # is priced in the same marginal loop as every trainer's
+                # scaling curve, so a saturated fleet outbids a
+                # flat-curve trainer for the next chip.  The SLO policy
+                # keeps observing and prewarm-hinting, but stops dialing.
+                self.autoscaler.on_add(job)
+                self.serving_scaler.observe_only.add(job.full_name)
         else:
             self.autoscaler.on_add(job)
         log.info("job submitted", job=job.full_name,
                  kind=type(job).__name__)
         return updater
+
+    def _arbitrated(self, job: ServingJob) -> bool:
+        """True when this serving fleet's chips are arbitrated by the
+        goodput planner rather than dialed by the SLO policy alone."""
+        return (self.goodput_objective
+                and self.autoscaler.goodput_curves is not None
+                and job.need_tpu() and job.elastic())
 
     def modify(self, job: "TrainingJob | ServingJob") -> None:
         validate_any(job)  # same gate as submit
@@ -158,6 +187,19 @@ class Controller:
         if isinstance(job, ServingJob):
             updater.modify(job)
             self.serving_scaler.on_update(job)
+            # reconcile arbitration ownership: a spec change can flip
+            # eligibility (e.g. min==max made elastic, or the reverse) —
+            # exactly one loop may own the replica dial afterwards
+            was = job.full_name in self.serving_scaler.observe_only
+            now = self._arbitrated(job)
+            if now and not was:
+                self.autoscaler.on_add(job)
+                self.serving_scaler.observe_only.add(job.full_name)
+            elif was and not now:
+                self.autoscaler.on_del(job)
+                self.serving_scaler.observe_only.discard(job.full_name)
+            elif now:
+                self.autoscaler.on_update(job)
             return
         old = updater.job.spec
         if old.trainer.allow_multi_domain != job.spec.trainer.allow_multi_domain:
@@ -179,6 +221,10 @@ class Controller:
             updater.notify_delete()
             updater.join(timeout=10)
         if isinstance(job, ServingJob):
+            # membership truth, not a spec recomputation: deletion must
+            # unregister wherever submit/modify actually registered
+            if job.full_name in self.serving_scaler.observe_only:
+                self.autoscaler.on_del(job)
             self.serving_scaler.on_del(job)
         else:
             self.autoscaler.on_del(job)

@@ -451,8 +451,16 @@ class ScalingCurve:
         self._lock = threading.Lock()
 
     def observe(self, world_size: int, tokens_per_second: float,
-                shape: str = "", mfu_pct: Optional[float] = None) -> None:
-        """Fold one steady-state window sample into the curve."""
+                shape: str = "", mfu_pct: Optional[float] = None,
+                max_samples: Optional[int] = None) -> None:
+        """Fold one steady-state window sample into the curve.
+
+        ``max_samples`` bounds the cell's effective sample count: past
+        it the running mean becomes an EWMA with weight 1/max_samples,
+        so a curve fed continuously (the serving capacity recorder — one
+        point per scaler tick for the fleet's lifetime) tracks CURRENT
+        behavior within ~max_samples ticks instead of freezing into a
+        lifetime average a traffic step can never move."""
         key = (int(world_size), shape)
         with self._lock:
             cell = self._cells.get(key)
@@ -460,6 +468,11 @@ class ScalingCurve:
                 cell = {"tok_s": 0.0, "mfu_pct": None, "n": 0, "mfu_n": 0}
                 self._cells[key] = cell
             n = cell["n"]
+            if max_samples is not None and n >= max_samples > 0:
+                cell["tok_s"] += (tokens_per_second
+                                  - cell["tok_s"]) / max_samples
+                cell["n"] = n + 1
+                return
             cell["tok_s"] = (cell["tok_s"] * n + tokens_per_second) / (n + 1)
             if mfu_pct is not None:
                 # weighted by the number of samples that actually
@@ -576,10 +589,11 @@ class CurveStore:
         return CURVE_KEY.format(job=self.job)
 
     def record(self, world_size: int, tokens_per_second: float,
-               shape: str = "", mfu_pct: Optional[float] = None) -> None:
+               shape: str = "", mfu_pct: Optional[float] = None,
+               max_samples: Optional[int] = None) -> None:
         """Fold a steady-state sample in, persist, refresh the gauges."""
         self.curve.observe(world_size, tokens_per_second, shape=shape,
-                           mfu_pct=mfu_pct)
+                           mfu_pct=mfu_pct, max_samples=max_samples)
         self._coord.kv_set(self.key, self.curve.to_json().encode())
         self._sync_metrics()
 

@@ -2,8 +2,11 @@
 (role of reference pkg/autoscaler.go)."""
 
 from edl_tpu.scheduler.planner import (
+    GoodputPlan,
     PlannedJob,
+    plan_cluster,
     scale_all_jobs_dry_run,
+    scale_all_jobs_goodput,
     scale_dry_run,
     sorted_jobs,
 )
@@ -11,8 +14,11 @@ from edl_tpu.scheduler.topology import SliceShapePolicy, POW2_POLICY
 from edl_tpu.scheduler.autoscaler import Autoscaler
 
 __all__ = [
+    "GoodputPlan",
     "PlannedJob",
+    "plan_cluster",
     "scale_all_jobs_dry_run",
+    "scale_all_jobs_goodput",
     "scale_dry_run",
     "sorted_jobs",
     "SliceShapePolicy",

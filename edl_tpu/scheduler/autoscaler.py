@@ -17,13 +17,18 @@ import enum
 import queue
 import threading
 import time
+from collections import deque
 from dataclasses import dataclass
 from typing import Callable, Optional
 
 from edl_tpu.api.types import TrainingJob
 from edl_tpu.cluster.base import Cluster
 from edl_tpu.observability.logging import get_logger
-from edl_tpu.scheduler.planner import PlannedJob, scale_all_jobs_dry_run
+from edl_tpu.scheduler.planner import (
+    PlannedJob,
+    plan_cluster,
+    scale_all_jobs_dry_run,
+)
 from edl_tpu.scheduler.topology import SliceShapePolicy, UNIT_POLICY
 
 DEFAULT_LOOP_SECONDS = 5.0  # reference autoscaler.go:31
@@ -61,6 +66,7 @@ class Autoscaler:
         min_resize_delta: int = DEFAULT_MIN_RESIZE_DELTA,
         mesh_shape_for: Optional[Callable[[str, int], object]] = None,
         goodput_curves: Optional[Callable[[str], object]] = None,
+        goodput_objective: bool = True,
         clock=time.monotonic,
     ) -> None:
         self.cluster = cluster
@@ -77,6 +83,9 @@ class Autoscaler:
         self.min_resize_delta = max(int(min_resize_delta), 1)
         self._clock = clock
         self._last_resize: dict[str, float] = {}  # uid -> actuation time
+        #: uid -> consecutive ticks observed with pending pods (feeds
+        #: PlannedJob.pending_age — the preemption age gate)
+        self._pending_age: dict[str, int] = {}
         self.jobs: dict[str, PlannedJob] = {}  # keyed by uid (namespace/name)
         self._events: "queue.Queue[Event]" = queue.Queue()
         self._stop = threading.Event()
@@ -105,19 +114,29 @@ class Autoscaler:
         #: Planning/actuation still walk instance counts; the shape is
         #: carried alongside, never instead.
         self.mesh_shape_for = mesh_shape_for
-        #: goodput advisory hook: maps a job uid to its measured
+        #: goodput curve hook: maps a job uid to its measured
         #: :class:`~edl_tpu.observability.goodput.ScalingCurve` (e.g.
-        #: ``lambda uid: goodput.load_curve(coord, uid)``).  When set,
-        #: every actuated plan logs the job's marginal
-        #: tokens-per-second-per-chip at the target size and exports it
-        #: as ``edl_autoscaler_marginal_tokens_per_chip{job=}`` —
-        #: ADVISORY this PR: the packing decision is unchanged (the
-        #: goodput-driven planner is ROADMAP #3); this is the measured
-        #: substrate it will consume, surfaced where the decision is made.
+        #: ``lambda uid: goodput.load_curve(coord, uid)``).  With
+        #: ``goodput_objective`` on (the default) the curves DRIVE the
+        #: packing: plans come from the marginal-goodput allocator
+        #: (planner.scale_all_jobs_goodput — priorities, preemption,
+        #: gang placement), degrading bit-for-bit to count packing when
+        #: no curve resolves.  Every actuated plan still logs the
+        #: marginal advisory + the
+        #: ``edl_autoscaler_marginal_tokens_per_chip{job=}`` gauge.
         self.goodput_curves = goodput_curves
+        #: objective switch (doc/scheduling.md): True (default) prices
+        #: chips by marginal goodput whenever a curve source is wired;
+        #: False pins the reference's count-based packing regardless.
+        self.goodput_objective = goodput_objective
         #: log of (uid, target, measured_at, marginal) advisories, for
-        #: tests/observability
-        self.advisory_history: list[dict] = []
+        #: tests/observability — BOUNDED: this is appended on every
+        #: actuated plan for the life of the controller process
+        self.advisory_history: "deque[dict]" = deque(maxlen=256)
+        #: the last plan's objective mode ("count" | "goodput" |
+        #: "degraded") — what edl_autoscaler_objective{mode=} reports
+        self.objective_mode: str = ("goodput" if goodput_objective
+                                    else "count")
 
     # -- event intake (reference autoscaler.go:159-171) --------------------
 
@@ -141,11 +160,19 @@ class Autoscaler:
             except queue.Empty:
                 return
             if evt.type in (EventType.ADD, EventType.UPDATE):
-                j = PlannedJob(config=evt.job, shape_policy=self.shape_policy)
+                # serving fleets are replica groups, not meshes: their
+                # replicas are independent, so the trainer slice-shape
+                # quantization (e.g. --pow2-shapes) must not bind their
+                # dial — a 5-replica fleet is a perfectly good fleet
+                policy = (UNIT_POLICY
+                          if getattr(evt.job, "replica_role", "trainer")
+                          == "server" else self.shape_policy)
+                j = PlannedJob(config=evt.job, shape_policy=policy)
                 self.jobs[j.uid] = j
                 self._sync_parallelism(j)
             elif evt.type == EventType.DEL:
                 self.jobs.pop(evt.job.full_name, None)
+                self._pending_age.pop(evt.job.full_name, None)
                 # drop the cooldown stamp too: a re-submitted job under
                 # the same uid starts with a clean hysteresis slate (and
                 # a long-lived controller must not leak one float per
@@ -171,7 +198,24 @@ class Autoscaler:
             return {}
 
         candidates = self._reschedulable_jobs()
-        diff = scale_all_jobs_dry_run(candidates, r, self.max_load_desired)
+        plan = None
+        curves = self._tick_curve_source()
+        if self.goodput_objective and curves is not None:
+            try:
+                plan = plan_cluster(candidates, r, self.max_load_desired,
+                                    curves=curves, objective="goodput")
+                diff = plan.diff
+            except Exception as exc:
+                # the loop thread must survive ANY planner failure: log,
+                # fall back to the reference packer for this tick
+                log.error("goodput plan failed; count packing this tick",
+                          error=str(exc)[:300])
+                diff = scale_all_jobs_dry_run(candidates, r,
+                                              self.max_load_desired)
+        else:
+            diff = scale_all_jobs_dry_run(candidates, r,
+                                          self.max_load_desired)
+        self._note_objective(plan)
 
         # Zero deltas are dropped: no no-op actuation writes, no plan spam
         # (the reference re-writes unchanged Parallelism every tick — a
@@ -195,6 +239,29 @@ class Autoscaler:
                 suppressed[uid] = "cooldown"
                 continue
             target[uid] = self.jobs[uid].parallelism + delta
+        if plan is not None:
+            # preemption overrides hysteresis: a higher-priority gang's
+            # admission must not wait out its victim's resize cooldown
+            for rec in plan.preemptions:
+                v = rec["victim"]
+                if v in suppressed and v in self.jobs and diff.get(v):
+                    del suppressed[v]
+                    target[v] = self.jobs[v].parallelism + diff[v]
+            # a rebalance is one decision with two legs (victim shrink +
+            # winner grant): hysteresis must drop them ATOMICALLY — a
+            # suppressed shrink with an actuated grant strands the
+            # winner's pods, an actuated shrink with a suppressed grant
+            # idles the freed chips for a whole cooldown
+            for rec in plan.reclaims:
+                if rec.get("reason") != "rebalance":
+                    continue
+                v, w = rec["victim"], rec["for_job"]
+                if v in suppressed and w in target:
+                    suppressed[w] = "paired_reclaim"
+                    del target[w]
+                elif w in suppressed and v in target:
+                    suppressed[v] = "paired_reclaim"
+                    del target[v]
         if suppressed:
             from edl_tpu.observability.collector import get_counters
 
@@ -232,24 +299,85 @@ class Autoscaler:
                     except Exception as exc:
                         log.warn("prewarm hint sink failed", job=uid,
                                  error=str(exc))
-            self._advise_goodput(target)
+            self._advise_goodput(target, plan, curves)
         self._scale_all_jobs(target)
         return target
 
-    def _advise_goodput(self, target: dict[str, int]) -> None:
+    def _tick_curve_source(self):
+        """One curve fetch per job per tick: wrap ``goodput_curves`` in
+        a tick-scoped memo so the planner's resolve pass and the
+        advisory path share one KV round-trip per job — with the CLI's
+        ``load_curve`` wiring every call is a synchronous coordinator
+        fetch, and the advisory used to re-pay what the plan already
+        fetched.  A raising source memoizes None (the planner and the
+        advisory both degrade)."""
+        src = self.goodput_curves
+        if src is None:
+            return None
+        memo: dict[str, object] = {}
+
+        def cached(uid: str):
+            if uid not in memo:
+                try:
+                    memo[uid] = src(uid)
+                except Exception as exc:
+                    log.warn("goodput curve lookup failed", job=uid,
+                             error=str(exc)[:200])
+                    memo[uid] = None
+            return memo[uid]
+
+        return cached
+
+    def _note_objective(self, plan) -> None:
+        """Record which objective ruled this tick (the
+        ``edl_autoscaler_objective{mode=}`` gauge — 1 on the active
+        mode, 0 on the others, so a scrape always sees all three
+        series) plus the preemption/rollback evidence counters."""
+        from edl_tpu.observability.collector import get_counters
+        from edl_tpu.observability.metrics import get_registry
+
+        mode = plan.mode if plan is not None else "count"
+        self.objective_mode = mode
+        gauge = get_registry().gauge(
+            "autoscaler_objective",
+            help="active packing objective (1 = this mode ruled the "
+                 "last plan): goodput | count | degraded")
+        for m in ("goodput", "count", "degraded"):
+            gauge.set(1.0 if m == mode else 0.0, mode=m)
+        if plan is None:
+            return
+        if plan.preemptions:
+            get_counters().inc("sched_preemptions",
+                               n=len(plan.preemptions))
+            for p in plan.preemptions:
+                log.info("preemption planned", **p)
+        if plan.reclaims:
+            get_counters().inc("sched_reclaims", n=len(plan.reclaims))
+        if plan.rollbacks:
+            get_counters().inc("sched_gang_rollbacks",
+                               n=len(plan.rollbacks))
+            for rb in plan.rollbacks:
+                log.info("gang admission rolled back", **rb)
+
+    def _advise_goodput(self, target: dict[str, int], plan=None,
+                        curves=None) -> None:
         """Log each actuated job's measured marginal throughput per chip
-        at its new target (advisory — the allocation itself is unchanged
-        this PR; consuming the curve in the packing decision is ROADMAP
-        #3).  A missing/raising curve source degrades to silence — the
+        at its new target — the price the goodput objective paid for the
+        plan, surfaced next to the decision (and still just a log line
+        in count mode).  Reads the tick-scoped curve memo (no second KV
+        fetch) and carries the plan's own step price when it granted
+        one.  A missing/raising curve source degrades to silence — the
         advisory is never a dependency."""
-        if self.goodput_curves is None:
+        if curves is None:
+            curves = self._tick_curve_source()
+        if curves is None:
             return
         from edl_tpu.observability.collector import get_counters
         from edl_tpu.observability.metrics import get_registry
 
         for uid, n in target.items():
             try:
-                curve = self.goodput_curves(uid)
+                curve = curves(uid)
                 if curve is None:
                     continue
                 at = curve.nearest_world_size(n)
@@ -263,6 +391,11 @@ class Autoscaler:
                 continue
             advisory = {"job": uid, "target": n, "measured_at": at,
                         "marginal_tok_s_per_chip": round(marginal, 2)}
+            if plan is not None and uid in plan.marginals:
+                # the exact per-chip price the allocator paid for this
+                # job's last granted step (GoodputPlan.marginals)
+                advisory["priced_at_grant"] = round(
+                    plan.marginals[uid], 2)
             log.info("goodput advisory", **advisory)
             self.advisory_history.append(advisory)
             get_counters().inc("autoscaler_goodput_advisories")
@@ -337,6 +470,13 @@ class Autoscaler:
             except Exception as exc:
                 log.error("job_pods failed", job=j.name, error=str(exc))
                 continue
+            j.pending = counts.pending  # the goodput objective's gang signal
+            if counts.pending > 0:
+                j.pending_age = self._pending_age.get(j.uid, 0)
+                self._pending_age[j.uid] = j.pending_age + 1
+            else:
+                j.pending_age = 0
+                self._pending_age.pop(j.uid, None)
             surveyed.append((j, counts))
             if counts.total == counts.pending:
                 have_pending = True
@@ -404,11 +544,25 @@ class ServingScaler:
         scale_down_cooldown_s: float = 30.0,
         scale_up_cooldown_s: float = 2.0,
         shrink_headroom: float = 0.3,
+        coord_for: Optional[Callable[[object], object]] = None,
         clock=time.monotonic,
     ) -> None:
         self.cluster = cluster
         self.stats_for = stats_for
         self.actuate = actuate
+        #: optional ``coord_for(job) -> kv-client | None``: when set,
+        #: every observed tick RECORDS the fleet's (replica_count → qps)
+        #: point into the job's goodput :class:`CurveStore`
+        #: (``goodput-curve/<job>`` in coordinator KV) — so serving jobs
+        #: arrive at the goodput planner with a real measured
+        #: QPS-capacity curve, not just the optimistic prior
+        self.coord_for = coord_for
+        self._curve_stores: dict[str, object] = {}
+        #: uids whose replica dial the GOODPUT PLANNER owns (train+serve
+        #: chip arbitration): this policy still observes, records the
+        #: capacity curve, and fires prewarm hints, but never actuates —
+        #: two loops dialing one group would fight
+        self.observe_only: set[str] = set()
         self.loop_seconds = loop_seconds
         #: a shrink must wait this long after ANY scaling action — p99
         #: recovers slowly after a resize and a premature shrink would
@@ -456,6 +610,8 @@ class ServingScaler:
         self.jobs.pop(job.full_name, None)
         self._last_change.pop(job.full_name, None)
         self._targets.pop(job.full_name, None)
+        self._curve_stores.pop(job.full_name, None)
+        self.observe_only.discard(job.full_name)
         from edl_tpu.observability.metrics import get_registry
 
         get_registry().gauge("serving_target_replicas").remove(
@@ -517,8 +673,20 @@ class ServingScaler:
                              error=str(exc)[:200])
                     continue
             current = self._current(uid, job, stats)
+            self._record_capacity(uid, job, stats, current)
             target = self.decide(job, stats, current)
             if target is None:
+                continue
+            if uid in self.observe_only:
+                # chip arbitration: the goodput planner owns the dial;
+                # this policy's decision survives as the prewarm hint
+                # (scale-ups compile ahead regardless of who actuates)
+                if self.hint_sink is not None and target > current:
+                    try:
+                        self.hint_sink(uid, target)
+                    except Exception as exc:
+                        log.warn("serving prewarm hint sink failed",
+                                 job=uid, error=str(exc)[:200])
                 continue
             last = self._last_change.get(uid, -1e18)
             cooldown = (self.scale_up_cooldown_s if target > current
@@ -532,6 +700,47 @@ class ServingScaler:
             self._plan(uid, job, stats, current, target, now)
             actuated[uid] = target
         return actuated
+
+    def _record_capacity(self, uid: str, job, stats, current: int) -> None:
+        """Fold the live FleetView observation into the job's goodput
+        curve: one (replica_count → fleet qps) sample per observed tick,
+        persisted under ``goodput-curve/<job>`` so the goodput planner
+        prices this fleet's chips from MEASURED capacity.  A saturated
+        fleet's curve rises ~linearly with replicas (steep marginal —
+        it outbids a flat-curve trainer); a fleet past its demand goes
+        flat (its marginal collapses and the chips flow elsewhere).
+        Best-effort: a missing coordinator or a raising store never
+        perturbs the scaling decision."""
+        if (self.coord_for is None or stats is None or current < 1
+                or stats.requests_windowed == 0
+                or getattr(stats, "qps", 0) <= 0):
+            return
+        try:
+            store = self._curve_stores.get(uid)
+            if store is None:
+                coord = self.coord_for(job)
+                if coord is None:
+                    return
+                from edl_tpu.observability.goodput import CurveStore
+
+                store = CurveStore(coord, uid)
+                # seed from the persisted curve: CurveStore's local
+                # curve is the authoritative copy it republishes WHOLE
+                # on every record — a fresh store after a controller
+                # restart must not clobber the fleet's accumulated
+                # multi-point curve with a single new cell
+                persisted = store.load()
+                if persisted is not None:
+                    store.curve = persisted
+                self._curve_stores[uid] = store
+            # recency-bounded fold (~1 min of ticks): the capacity curve
+            # must track a traffic step, not freeze into a lifetime
+            # demand average the planner can never re-price from
+            store.record(current, stats.qps, shape="serving",
+                         max_samples=30)
+        except Exception as exc:
+            log.warn("serving capacity curve record failed", job=uid,
+                     error=str(exc)[:200])
 
     def _current(self, uid: str, job, stats) -> int:
         if stats is not None and getattr(stats, "replicas_active", 0):

@@ -1021,4 +1021,65 @@ finally:
         door.stop()
 EOF
 
+echo "== sched smoke (goodput objective vs count packing through the real planner)"
+python - <<'EOF'
+# Fast tripwire for the goodput-driven multi-tenant scheduler
+# (doc/scheduling.md): a 120-job fleet sim through the REAL planner
+# under both objectives, then the edl_sched_* / edl_autoscaler_objective
+# series through the strict exposition parser.
+from edl_tpu.observability.metrics import get_registry, parse_exposition
+from edl_tpu.scheduler.sim import SimConfig, FleetSim, compare_objectives
+
+# a hot fleet must actually preempt (aged HIGH gangs admitted by
+# planned shrinks of cheaper victims, floored at min) — and still
+# strand nothing
+hot = SimConfig(n_jobs=120, hosts=16, chips_per_host=8, domains=4,
+                horizon_s=900.0, arrival_spread_s=500.0, seed=17)
+hcmp = compare_objectives(hot, register=True)
+hout = hcmp["goodput"]
+assert hout["preemptions"] > 0, hout
+assert hcmp["sched_gang_strandings"] == 0, hcmp
+assert hcmp["sched_min_violations"] == 0, hcmp
+
+# the moderate-contention reference fleet LAST (its numbers are what
+# the headline gauges report): the marginal objective must beat count
+# packing on goodput without regressing admission
+cfg = SimConfig(n_jobs=120, hosts=16, chips_per_host=8, domains=4,
+                horizon_s=900.0, arrival_spread_s=700.0, seed=17)
+out = compare_objectives(cfg, register=True)
+assert out["sched_goodput_uplift_pct"] > 0, out
+assert out["sched_gang_strandings"] == 0, out
+assert out["sched_min_violations"] == 0, out  # never below min_instance
+assert (out["sched_admission_p99_s"]
+        <= out["sched_admission_p99_s_count"] + 1e-9), out
+
+# the autoscaler's objective gauge: goodput mode with a curve source,
+# bit-for-bit count mode without one
+from edl_tpu.observability.goodput import ScalingCurve
+from edl_tpu.scheduler.autoscaler import Autoscaler
+from tests.test_autoscaler import cluster_with, mk_job, submit
+
+curve = ScalingCurve("default/example")
+curve.observe(2, 1000.0); curve.observe(8, 3000.0)
+c = cluster_with(cpu_milli=10_000)
+a = Autoscaler(c, goodput_curves=lambda uid: curve)
+submit(c, a, mk_job("example", lo=2, hi=10))
+a.tick()
+
+series = parse_exposition(get_registry().render())  # strict grammar or die
+assert series["edl_sched_goodput_uplift_pct"] > 0, series
+assert series["edl_sched_gang_strandings"] == 0
+assert series['edl_sched_admission_p99_s{objective="goodput"}'] >= 0
+assert series["edl_sched_preemptions_total"] >= hout["preemptions"]
+assert series['edl_autoscaler_objective{mode="goodput"}'] == 1.0
+assert series['edl_autoscaler_objective{mode="count"}'] == 0.0
+
+print("sched smoke OK:", {
+    "uplift_pct": out["sched_goodput_uplift_pct"],
+    "admission_p99_s": out["sched_admission_p99_s"],
+    "admission_p99_s_count": out["sched_admission_p99_s_count"],
+    "preemptions_hot": hout["preemptions"],
+    "gang_strandings": 0})
+EOF
+
 echo "CI OK"

@@ -1,0 +1,866 @@
+"""The marginal-goodput scheduling objective (doc/scheduling.md):
+pricing, priorities, preemption, gang discipline, degraded-mode parity,
+and the control-plane wiring (priority field api→serde→CRD, the bounded
+advisory log, the serving capacity-curve recorder)."""
+
+import math
+from collections import deque
+from types import SimpleNamespace
+
+import pytest
+
+from edl_tpu.api.types import (
+    RESOURCE_TPU,
+    ResourceRequirements,
+    SchedPriority,
+    ServingJob,
+    ServingSpec,
+)
+from edl_tpu.cluster.resource import ClusterResource, NodeResources
+from edl_tpu.observability.goodput import ScalingCurve, load_curve
+from edl_tpu.scheduler.planner import (
+    OPTIMISTIC_PRIOR,
+    PlannedJob,
+    _step_marginal,
+    plan_cluster,
+    scale_all_jobs_dry_run,
+    scale_all_jobs_goodput,
+)
+from edl_tpu.scheduler.topology import POW2_POLICY
+from tests.test_planner import (
+    big_cluster,
+    make_job,
+    make_multi_domain_job,
+    two_domain_cluster,
+)
+
+
+def curve(points, job=""):
+    c = ScalingCurve(job=job)
+    for ws, tok in sorted(points.items()):
+        c.observe(ws, tok)
+    return c
+
+
+def curves_for(mapping):
+    """uid → ScalingCurve source, as the autoscaler wires it."""
+    return lambda uid: mapping.get(uid)
+
+
+def priced_job(name, chips, lo, hi, p, priority=SchedPriority.NORMAL,
+               policy=None):
+    j = make_job(name, "1", "1", "1Mi", "1Mi", str(chips), lo, hi, p,
+                 **({"policy": policy} if policy else {}))
+    j.config.spec.trainer.priority = int(priority)
+    return j
+
+
+def one_domain_cluster(nodes=2, chips_per_node=4):
+    n = NodeResources(
+        nodes_cpu_idle_milli={f"n{i}": 8000 for i in range(nodes)},
+        nodes_memory_free_mega={f"n{i}": 16000 for i in range(nodes)},
+        nodes_tpu_free={f"n{i}": chips_per_node for i in range(nodes)},
+        nodes_ici_domain={f"n{i}": "D" for i in range(nodes)},
+    )
+    return ClusterResource(cpu_total_milli=8000 * nodes,
+                           memory_total_mega=16000 * nodes,
+                           tpu_total=chips_per_node * nodes, nodes=n)
+
+
+# ---------------------------------------------------------------------------
+# degraded mode: bit-for-bit count-packing parity
+# ---------------------------------------------------------------------------
+
+
+def test_degraded_mode_matches_count_packing_bit_for_bit():
+    """No curve resolves → the plan IS the count packer's plan, same
+    dict, on representative fixtures (the acceptance parity pin)."""
+    fixtures = []
+    j = make_job("j", "1", "1", "1Mi", "1Mi", "0", 1, 8, 1,
+                 policy=POW2_POLICY)
+    fixtures.append(([j], big_cluster()))
+    a = make_job("a", "1", "1", "1Mi", "1Mi", "2", 0, 4, 0)
+    b = make_job("b", "1", "1", "1Mi", "1Mi", "2", 0, 2, 0)
+    fixtures.append(([a, b], two_domain_cluster()))
+    for cv in (None, lambda uid: None, curves_for({})):
+        for jobs, r in fixtures:
+            expect = scale_all_jobs_dry_run(jobs, r.copy(), 1.0)
+            plan = plan_cluster(jobs, r.copy(), 1.0, curves=cv)
+            assert plan.mode == "degraded"
+            assert plan.diff == expect
+            assert not plan.preemptions and not plan.rollbacks
+
+
+def test_raising_curve_source_degrades_not_raises():
+    def broken(uid):
+        raise RuntimeError("curve store unreachable")
+
+    j = make_job("j", "1", "1", "1Mi", "1Mi", "0", 1, 4, 1)
+    r = big_cluster()
+    plan = plan_cluster([j], r, 1.0, curves=broken)
+    assert plan.mode == "degraded"
+    assert plan.diff == scale_all_jobs_dry_run([j], r, 1.0)
+
+
+def test_count_objective_is_the_reference_packer_wrapped():
+    j = make_job("j", "1", "1", "1Mi", "1Mi", "0", 1, 4, 1)
+    r = big_cluster()
+    plan = plan_cluster([j], r, 1.0, curves=curves_for(
+        {"default/j": curve({1: 100.0})}), objective="count")
+    assert plan.mode == "count"
+    assert plan.diff == scale_all_jobs_dry_run([j], r, 1.0)
+
+
+# ---------------------------------------------------------------------------
+# the marginal objective
+# ---------------------------------------------------------------------------
+
+
+def test_marginal_packing_prefers_steep_curve():
+    """Two identical jobs, one steep curve, one flat: the contended
+    chips all flow to the steep one — the uniform-fulfillment leveling
+    the count packer would do is exactly what this objective replaces."""
+    r = one_domain_cluster(nodes=1, chips_per_node=4)
+    steep = priced_job("steep", 1, 0, 4, 0)
+    flat = priced_job("flat", 1, 0, 4, 0)
+    cv = curves_for({
+        "default/steep": curve({1: 100.0, 2: 200.0, 4: 400.0}),
+        "default/flat": curve({1: 50.0, 2: 52.0, 4: 53.0}),
+    })
+    plan = plan_cluster([steep, flat], r, 1.0, curves=cv)
+    assert plan.mode == "goodput"
+    assert plan.diff["default/steep"] == 4
+    assert plan.diff["default/flat"] == 0
+    # the evidence trail carries the price the last granted step paid
+    assert plan.marginals["default/steep"] == pytest.approx(100.0)
+    # the count packer would have leveled them 2/2
+    leveled = scale_all_jobs_dry_run([steep, flat], r, 1.0)
+    assert leveled["default/steep"] == leveled["default/flat"] == 2
+
+
+def test_optimistic_prior_explores_unmeasured_jobs():
+    """An unmeasured job outbids a measured one (prior = +inf): it gets
+    capacity, runs, and becomes measured — exploration never starves."""
+    r = one_domain_cluster(nodes=1, chips_per_node=4)
+    measured = priced_job("measured", 1, 0, 4, 0)
+    fresh = priced_job("fresh", 1, 0, 4, 0)
+    cv = curves_for({"default/measured": curve({1: 100.0, 2: 190.0})})
+    plan = plan_cluster([measured, fresh], r, 1.0, curves=cv)
+    assert plan.diff["default/fresh"] == 4
+    assert plan.diff["default/measured"] == 0
+
+
+def test_zero_marginal_jobs_still_pack_leftover_capacity():
+    """A measured-flat job is deprioritized, not starved: idle chips
+    are pure waste, so leftovers still pack after every better bidder
+    is satisfied."""
+    r = one_domain_cluster(nodes=2, chips_per_node=4)  # 8 chips
+    steep = priced_job("steep", 1, 0, 4, 0)
+    flat = priced_job("flat", 1, 0, 4, 0)
+    cv = curves_for({
+        "default/steep": curve({1: 100.0, 2: 200.0}),
+        "default/flat": curve({1: 100.0, 2: 100.0, 4: 100.0}),
+    })
+    plan = plan_cluster([steep, flat], r, 1.0, curves=cv)
+    assert plan.diff["default/steep"] == 4
+    assert plan.diff["default/flat"] == 4  # leftovers, not starvation
+
+
+def test_fresh_pending_gang_does_not_preempt_yet():
+    """The age gate: a gang pending for ZERO plans reserves free
+    capacity but shrinks no one — the kubelet may well place it before
+    the next tick, and an arrival burst at light load must not churn
+    running jobs."""
+    r = one_domain_cluster(nodes=2, chips_per_node=4)
+    victim = priced_job("victim", 2, 1, 3, 2)
+    r.nodes.nodes_tpu_free["n0"] = 0
+    r.nodes.nodes_tpu_free["n1"] = 2
+    r.tpu_limit = 4 + 4
+    r.jobs_ici_domain = {"default/victim": "D"}
+    gang = priced_job("gang", 2, 2, 2, 2, priority=SchedPriority.HIGH)
+    gang.pending = 2                       # fresh: pending_age == 0
+    cv = curves_for({"default/victim": curve({1: 100.0, 2: 101.0})})
+    plan = plan_cluster([victim, gang], r, 1.0, curves=cv)
+    assert not plan.preemptions
+    assert plan.diff["default/victim"] == 0
+
+
+def test_pending_high_gang_preempts_cheapest_victim_to_min():
+    """An AGED HIGH pending gang shrinks strictly-lower-priority elastic
+    victims — cheapest marginal FIRST, never below min_instance — until
+    its whole gang fits the domain."""
+    r = one_domain_cluster(nodes=2, chips_per_node=4)  # 8 chips in D
+    # V_flat runs 2x2 chips (cheap marginal), V_steep runs 1x2 (pricey)
+    v_flat = priced_job("vflat", 2, 1, 3, 2)
+    v_steep = priced_job("vsteep", 2, 1, 2, 1)
+    r.nodes.nodes_tpu_free["n0"] = 0       # v_flat's 4 chips
+    r.nodes.nodes_tpu_free["n1"] = 2       # v_steep's 2 chips, 2 free
+    r.tpu_limit = 6 + 4                    # placed + the gang's pending
+    r.cpu_request_milli = 3 * 1_000_000 + 2 * 1_000_000
+    r.jobs_ici_domain = {"default/vflat": "D", "default/vsteep": "D"}
+    gang = priced_job("gang", 2, 2, 2, 2, priority=SchedPriority.HIGH)
+    gang.pending = 2                       # whole min gang unplaced
+    gang.pending_age = 1                   # aged past the kubelet grace
+    cv = curves_for({
+        "default/vflat": curve({1: 100.0, 2: 101.0}),
+        "default/vsteep": curve({1: 400.0}),
+    })
+    plan = plan_cluster([v_flat, v_steep, gang], r, 1.0, curves=cv)
+    assert plan.mode == "goodput"
+    assert plan.preemptions, "no preemption planned"
+    assert {p["victim"] for p in plan.preemptions} == {"default/vflat"}
+    assert plan.diff["default/vflat"] == -1          # one step, to free 2
+    assert plan.diff["default/vsteep"] == 0          # pricier: untouched
+    assert v_flat.parallelism + plan.diff["default/vflat"] >= 1  # >= min
+    assert not plan.rollbacks
+
+
+def test_gang_rolled_back_whole_when_no_domain_feasible():
+    """A gang no single domain can hold — even with every eligible
+    victim at floor — is rolled back whole: nothing is shrunk for it."""
+    r = two_domain_cluster()  # 2 domains x 4 chips
+    # each domain: 2 chips held by a low-prio victim at min (nothing
+    # shrinkable), 2 free — a 6-chip single-domain gang can never land
+    va = priced_job("va", 2, 1, 1, 1, priority=SchedPriority.LOW)
+    vb = priced_job("vb", 2, 1, 1, 1, priority=SchedPriority.LOW)
+    r.nodes.nodes_tpu_free["a0"] = 0
+    r.nodes.nodes_tpu_free["b0"] = 0
+    r.tpu_limit = 4 + 6
+    r.jobs_ici_domain = {"default/va": "A", "default/vb": "B"}
+    gang = priced_job("gang", 2, 3, 3, 3, priority=SchedPriority.HIGH)
+    gang.pending = 3
+    gang.pending_age = 1
+    cv = curves_for({"default/va": curve({1: 100.0})})
+    plan = plan_cluster([va, vb, gang], r, 1.0, curves=cv)
+    assert plan.rollbacks and plan.rollbacks[0]["job"] == "default/gang"
+    assert not plan.preemptions
+    assert all(d >= 0 for d in plan.diff.values()), plan.diff
+
+
+def test_equal_priority_pending_rides_overcommit_drain():
+    """A NORMAL gang among NORMAL incumbents cannot preempt — but its
+    pending claim over-commits the cluster and the drain shrinks the
+    cheapest-marginal victim (the count packer's admission-by-shrinking
+    re-ranked by marginal value)."""
+    r = one_domain_cluster(nodes=2, chips_per_node=4)
+    v_flat = priced_job("vflat", 2, 1, 3, 2)   # 4 chips, flat curve
+    v_steep = priced_job("vsteep", 2, 1, 2, 2)  # 4 chips, steep curve
+    r.nodes.nodes_tpu_free["n0"] = 0
+    r.nodes.nodes_tpu_free["n1"] = 0
+    r.tpu_limit = 8 + 2                        # full + a 2-chip pending gang
+    r.jobs_ici_domain = {"default/vflat": "D", "default/vsteep": "D"}
+    gang = priced_job("gang", 2, 1, 1, 1)
+    gang.pending = 1
+    cv = curves_for({
+        "default/vflat": curve({1: 100.0, 2: 102.0}),
+        "default/vsteep": curve({1: 100.0, 2: 300.0}),
+    })
+    plan = plan_cluster([v_flat, v_steep, gang], r, 1.0, curves=cv)
+    assert not plan.preemptions                # no priority edge
+    assert any(rec["reason"] == "overcommit" for rec in plan.reclaims)
+    assert plan.diff["default/vflat"] == -1    # cheapest marginal drained
+    assert plan.diff["default/vsteep"] == 0
+
+
+def test_rebalance_saturated_serving_outbids_flat_trainer():
+    """Train+serve arbitration: a saturated serving fleet (steep
+    measured QPS curve) reclaims a chip from a flat-curve trainer in
+    the same marginal loop — the shrink and the paired grant land in
+    ONE plan, actuated as planned resizes."""
+    r = one_domain_cluster(nodes=1, chips_per_node=4)
+    res = ResourceRequirements(requests={"cpu": "1", "memory": "1Mi"},
+                               limits={RESOURCE_TPU: "1"})
+    fleet = ServingJob(name="fleet", spec=ServingSpec(
+        min_replicas=1, max_replicas=4, resources=res,
+        priority=SchedPriority.NORMAL))
+    serving = PlannedJob(config=fleet, parallelism=1)
+    trainer = priced_job("batch", 1, 1, 4, 3)
+    r.nodes.nodes_tpu_free["n0"] = 0           # 1 + 3 chips: cluster full
+    r.tpu_limit = 4
+    r.jobs_ici_domain = {"default/batch": "D"}
+    cv = curves_for({
+        "default/fleet": curve({1: 500.0, 2: 1000.0}),  # saturated: linear
+        "default/batch": curve({1: 100.0, 3: 110.0}),   # flat
+    })
+    plan = plan_cluster([serving, trainer], r, 1.0, curves=cv)
+    assert plan.diff["default/batch"] == -1
+    assert plan.diff["default/fleet"] == 1
+    assert any(rec["reason"] == "rebalance" and
+               rec["victim"] == "default/batch" for rec in plan.reclaims)
+
+
+def test_unmeasured_holdings_are_never_reclaimed():
+    """Rebalance needs a measured victim: optimistically-priced
+    (unmeasured) holdings are protected — exploration is not preempted
+    by exploitation."""
+    r = one_domain_cluster(nodes=1, chips_per_node=4)
+    grower = priced_job("grower", 1, 1, 4, 1)
+    fresh = priced_job("fresh", 1, 1, 4, 3)
+    r.nodes.nodes_tpu_free["n0"] = 0
+    r.tpu_limit = 4
+    r.jobs_ici_domain = {"default/grower": "D", "default/fresh": "D"}
+    cv = curves_for({"default/grower": curve({1: 500.0, 2: 1000.0})})
+    plan = plan_cluster([grower, fresh], r, 1.0, curves=cv)
+    assert plan.diff["default/fresh"] == 0
+    assert not plan.reclaims and not plan.preemptions
+
+
+def test_priority_tiers_rule_before_marginals():
+    """A HIGH flat-curve job still outbids a NORMAL steep-curve job for
+    the next chip: priority is the outer sort key, marginal the inner."""
+    r = one_domain_cluster(nodes=1, chips_per_node=2)
+    high_flat = priced_job("hflat", 1, 0, 2, 0, priority=SchedPriority.HIGH)
+    norm_steep = priced_job("nsteep", 1, 0, 2, 0)
+    cv = curves_for({
+        "default/hflat": curve({1: 10.0, 2: 11.0}),
+        "default/nsteep": curve({1: 100.0, 2: 200.0}),
+    })
+    plan = plan_cluster([high_flat, norm_steep], r, 1.0, curves=cv)
+    assert plan.diff["default/hflat"] == 2
+    assert plan.diff["default/nsteep"] == 0
+
+
+# ---------------------------------------------------------------------------
+# multi-domain contention stress under the new objective (VERDICT r5 #8)
+# ---------------------------------------------------------------------------
+
+
+def test_spanning_and_pinned_contention_under_goodput_objective():
+    """The VERDICT r5 #8 contention case re-run under the marginal
+    objective with measured curves on both jobs: the pinned job never
+    leaves its fabric, the spanning job takes the remainder, every chip
+    packs — the same world the count packer reaches."""
+    nodes = NodeResources(
+        nodes_cpu_idle_milli={n: 8000 for n in ("a0", "a1", "b0", "b1")},
+        nodes_memory_free_mega={n: 16000 for n in ("a0", "a1", "b0", "b1")},
+        nodes_tpu_free={"a0": 0, "a1": 2, "b0": 0, "b1": 2},
+        nodes_ici_domain={"a0": "A", "a1": "A", "b0": "B", "b1": "B"},
+    )
+    r = ClusterResource(cpu_total_milli=32_000, memory_total_mega=64_000,
+                        tpu_total=8, tpu_limit=4, nodes=nodes)
+    r.jobs_ici_domain["default/p"] = "A"
+    pinned = make_job("p", "1", "1", "1Mi", "1Mi", "2", 1, 2, 1)
+    spanning = make_multi_domain_job("s", 1, 3, 1, chips="2")
+    cv = curves_for({
+        "default/p": curve({1: 100.0, 2: 220.0}),   # 60 tok/s per chip
+        "default/s": curve({1: 100.0, 2: 190.0}),   # 45 tok/s per chip
+    })
+    plan = plan_cluster([pinned, spanning], r.copy(), 1.0, curves=cv)
+    assert plan.mode == "goodput"
+    # the pinned job's step lands in ITS fabric (A) and the spanning
+    # job takes the remainder: every chip packed, nothing strandable
+    assert pinned.parallelism + plan.diff["default/p"] == 2
+    assert spanning.parallelism + plan.diff["default/s"] == 2
+    # with these curves the marginal objective reaches the same world
+    # the count packer reaches on the same snapshot
+    count = scale_all_jobs_dry_run([pinned, spanning], r.copy(), 1.0)
+    assert plan.diff == count
+
+
+def test_unequal_domains_spanning_world_under_goodput_objective():
+    """The 3+1 unequal-fabric case: a measured spanning job still packs
+    both fabrics whole under the marginal objective, and actuating the
+    plan on the fake kubelet strands nothing."""
+    from edl_tpu.cluster.fake import FakeCluster
+
+    cluster = FakeCluster()
+    for name, dom, chips in (("a0", "A", 2), ("a1", "A", 1), ("b0", "B", 1)):
+        cluster.add_node(name, cpu_milli=8000, memory_mega=16000,
+                         tpu_chips=chips, ici_domain=dom)
+    j = make_multi_domain_job("j", 1, 4, 1, chips="1")
+    cluster.create_resources(j.config)
+    cluster.reconcile()
+    r = cluster.inquiry_resource()
+    j.parallelism = cluster.get_trainer_parallelism(j.config)
+    cv = curves_for({"default/j": curve({1: 100.0, 2: 198.0})})
+    plan = plan_cluster([j], r, 1.0, curves=cv)
+    target = j.parallelism + plan.diff["default/j"]
+    assert target == 4
+    cluster.update_trainer_parallelism(j.config, target)
+    cluster.reconcile()
+    counts = cluster.job_pods(j.config)
+    assert counts.pending == 0 and counts.running == 4
+
+
+# ---------------------------------------------------------------------------
+# ScalingCurve pricing edge cases (the allocator leans on these)
+# ---------------------------------------------------------------------------
+
+
+def test_empty_curve_prices_at_prior():
+    c = ScalingCurve()
+    assert c.world_sizes() == []
+    assert c.tokens_per_second(4) is None
+    assert c.nearest_world_size(4) is None
+    assert c.marginal_tokens_per_second_per_chip(4) is None
+    assert _step_marginal(c, 4, 1, OPTIMISTIC_PRIOR) == OPTIMISTIC_PRIOR
+    assert _step_marginal(None, 4, 1, 123.0) == 123.0
+
+
+def test_single_measured_size_marginal_is_average_per_chip():
+    c = curve({4: 400.0})
+    assert c.marginal_tokens_per_second_per_chip(4) == pytest.approx(100.0)
+    # a step ending anywhere reads the lone point's average
+    assert _step_marginal(c, 8, 1, 0.0) == pytest.approx(100.0)
+    assert _step_marginal(c, 2, 1, 0.0) == pytest.approx(100.0)
+    # chips-per-instance normalizes the per-world-size slope
+    assert _step_marginal(c, 8, 4, 0.0) == pytest.approx(25.0)
+
+
+def test_queries_beyond_measured_range_use_the_curve_edge():
+    c = curve({2: 100.0, 4: 180.0})
+    # above the range: largest measured point answers, so the marginal
+    # is the LAST measured slope (linear extrapolation)
+    assert c.nearest_world_size(100) == 4
+    assert _step_marginal(c, 100, 1, 0.0) == pytest.approx(40.0)
+    # below the range: the smallest measured point answers
+    assert c.nearest_world_size(1) == 2
+    assert _step_marginal(c, 1, 1, 0.0) == pytest.approx(50.0)
+
+
+def test_nearest_world_size_tie_breaking():
+    c = curve({2: 100.0, 4: 180.0, 8: 260.0})
+    assert c.nearest_world_size(2) == 2     # exact hit
+    assert c.nearest_world_size(3) == 2     # largest measured <= query
+    assert c.nearest_world_size(7) == 4
+    assert c.nearest_world_size(8) == 8
+    assert c.nearest_world_size(1) == 2     # nothing below: smallest rules
+
+
+def test_degraded_parity_pin_when_no_curves_resolve():
+    """The explicit acceptance pin: same jobs, same snapshot, curves
+    present-but-empty → the goodput entry point returns the count
+    packer's exact diff."""
+    jobs = [priced_job("a", 1, 1, 6, 2), priced_job("b", 1, 1, 6, 2)]
+    r = big_cluster()
+    empty = curves_for({"default/a": ScalingCurve(),
+                        "default/b": ScalingCurve()})
+    plan = scale_all_jobs_goodput(jobs, r.copy(), 1.0, curves=empty)
+    assert plan.mode == "degraded"
+    assert plan.diff == scale_all_jobs_dry_run(jobs, r.copy(), 1.0)
+
+
+# ---------------------------------------------------------------------------
+# satellites: bounded advisory log, serving capacity recorder, priority
+# threading, objective gauge
+# ---------------------------------------------------------------------------
+
+
+def test_empty_node_snapshot_never_crashes():
+    """A drained cluster (every node gone NotReady) with an aged
+    starved gang must plan to a rollback, not an IndexError — the
+    autoscaler loop thread rides on it."""
+    r = ClusterResource()  # no nodes at all
+    gang = priced_job("gang", 2, 2, 2, 2, priority=SchedPriority.HIGH)
+    gang.pending = 2
+    gang.pending_age = 10  # well past the starvation threshold
+    other = priced_job("other", 1, 1, 2, 1)
+    cv = curves_for({"default/other": curve({1: 100.0})})
+    plan = plan_cluster([gang, other], r, 1.0, curves=cv)
+    assert plan.rollbacks and not plan.preemptions
+
+
+def test_autoscaler_loop_survives_a_raising_planner():
+    """Belt and braces: ANY goodput-planner exception degrades the tick
+    to count packing instead of killing the loop thread."""
+    from tests.test_autoscaler import cluster_with, mk_job, submit
+    import edl_tpu.scheduler.autoscaler as auto_mod
+    from edl_tpu.scheduler.autoscaler import Autoscaler
+
+    c = cluster_with(cpu_milli=10_000)
+    a = Autoscaler(c, goodput_curves=lambda uid: curve({1: 100.0}))
+    submit(c, a, mk_job("example", lo=2, hi=10))
+    orig = auto_mod.plan_cluster
+
+    def boom(*args, **kw):
+        raise RuntimeError("planner bug")
+
+    auto_mod.plan_cluster = boom
+    try:
+        target = a.tick()   # must not raise; count packing rules
+    finally:
+        auto_mod.plan_cluster = orig
+    assert target and c.get_trainer_parallelism(
+        a.jobs["default/example"].config) == 10
+
+
+def test_curve_source_fetched_once_per_tick():
+    """One KV round-trip per job per tick: the planner's resolve pass
+    and the advisory share the tick-scoped memo (the CLI wires a
+    blocking coordinator fetch per call)."""
+    from tests.test_autoscaler import cluster_with, mk_job, submit
+    from edl_tpu.scheduler.autoscaler import Autoscaler
+
+    calls = []
+    cv = curve({2: 1000.0, 8: 3000.0})
+
+    def source(uid):
+        calls.append(uid)
+        return cv
+
+    c = cluster_with(cpu_milli=10_000)
+    a = Autoscaler(c, goodput_curves=source)
+    submit(c, a, mk_job("example", lo=2, hi=10))
+    target = a.tick()
+    assert target  # plan actuated AND advisory logged...
+    assert a.advisory_history
+    assert calls == ["default/example"]  # ...off ONE fetch
+
+
+def test_advisory_history_is_bounded():
+    """scheduler/autoscaler.py kept an unbounded list appended on every
+    actuated plan — now a deque(maxlen=256)."""
+    from tests.test_autoscaler import cluster_with
+    from edl_tpu.scheduler.autoscaler import Autoscaler
+
+    cv = curve({2: 1000.0, 8: 3000.0}, job="default/x")
+    a = Autoscaler(cluster_with(), goodput_curves=lambda uid: cv)
+    assert isinstance(a.advisory_history, deque)
+    assert a.advisory_history.maxlen == 256
+    for _ in range(300):
+        a._advise_goodput({"default/x": 4})
+    assert len(a.advisory_history) == 256
+
+
+def test_serving_scaler_records_capacity_curve():
+    """Each observed decide() folds (replica_count → fleet qps) into
+    the job's CurveStore under goodput-curve/<job>, so the goodput
+    planner prices serving fleets from MEASURED capacity."""
+    from edl_tpu.scheduler.autoscaler import ServingScaler
+
+    class KV:
+        def __init__(self):
+            self.d = {}
+
+        def kv_set(self, k, v):
+            self.d[k] = v
+
+        def kv_get(self, k):
+            return self.d.get(k)
+
+    kv = KV()
+    job = ServingJob(name="fleet", spec=ServingSpec(
+        min_replicas=1, max_replicas=8, slo_p99_ms=100.0))
+    stats_by_tick = []
+
+    def stats_for(uid):
+        return stats_by_tick[-1]
+
+    actuations = []
+    s = ServingScaler(stats_for=stats_for,
+                      actuate=lambda uid, n: actuations.append((uid, n)),
+                      coord_for=lambda j: kv, clock=lambda: 1000.0)
+    s.on_add(job)
+    stats_by_tick.append(SimpleNamespace(
+        requests_windowed=500, qps=120.0, p99_ms=40.0, queue_depth=0,
+        replicas_active=2))
+    s.tick()
+    stats_by_tick.append(SimpleNamespace(
+        requests_windowed=900, qps=260.0, p99_ms=150.0, queue_depth=12,
+        replicas_active=4))
+    s._clock = lambda: 2000.0
+    s.tick()
+    c = load_curve(kv, "default/fleet")
+    assert c is not None
+    assert c.world_sizes() == [2, 4]
+    assert c.tokens_per_second(2) == pytest.approx(120.0)
+    assert c.tokens_per_second(4) == pytest.approx(260.0)
+    assert c.marginal_tokens_per_second_per_chip(4) == pytest.approx(70.0)
+
+    # a RESTARTED controller (fresh scaler, same coordinator) must seed
+    # from the persisted curve — its first record folds IN, it does not
+    # clobber the accumulated multi-point curve with one new cell
+    s2 = ServingScaler(stats_for=stats_for, actuate=lambda uid, n: None,
+                       coord_for=lambda j: kv, clock=lambda: 3000.0)
+    s2.on_add(job)
+    stats_by_tick.append(SimpleNamespace(
+        requests_windowed=400, qps=330.0, p99_ms=60.0, queue_depth=0,
+        replicas_active=6))
+    s2.tick()
+    c = load_curve(kv, "default/fleet")
+    assert c.world_sizes() == [2, 4, 6]
+
+
+def test_capacity_curve_tracks_a_traffic_step():
+    """The recorder's recency bound: after a traffic step, the cell's
+    mean converges to the NEW qps within ~max_samples folds — a
+    lifetime average would freeze and the planner could never re-price
+    the fleet's growth."""
+    c = ScalingCurve("default/fleet")
+    for _ in range(500):
+        c.observe(4, 100.0, shape="serving", max_samples=30)
+    for _ in range(120):                       # the step: 100 → 400 qps
+        c.observe(4, 400.0, shape="serving", max_samples=30)
+    got = c.tokens_per_second(4)
+    assert got > 350.0, got                    # tracked, not frozen
+    # an unbounded fold over the same stream stays pinned near the
+    # lifetime mean — the failure mode the bound exists to prevent
+    frozen = ScalingCurve()
+    for _ in range(500):
+        frozen.observe(4, 100.0)
+    for _ in range(120):
+        frozen.observe(4, 400.0)
+    assert frozen.tokens_per_second(4) < 180.0
+
+
+def test_arbitrated_serving_fleet_is_not_shape_quantized():
+    """A serving fleet's replicas are independent — the trainer slice
+    policy (--pow2-shapes) must not quantize its dial to 1/2/4."""
+    from edl_tpu.cluster.fake import FakeCluster
+    from edl_tpu.scheduler.autoscaler import Autoscaler
+
+    cluster = FakeCluster()
+    cluster.add_node("n0", cpu_milli=64_000, memory_mega=64_000,
+                     tpu_chips=8)
+    res = ResourceRequirements(requests={"cpu": "1", "memory": "1Mi"},
+                               limits={RESOURCE_TPU: "1"})
+    fleet = ServingJob(name="fleet", spec=ServingSpec(
+        min_replicas=1, max_replicas=6, slo_p99_ms=50.0, resources=res))
+    a = Autoscaler(cluster, shape_policy=POW2_POLICY,
+                   goodput_curves=lambda uid: curve({1: 100.0, 2: 200.0}))
+    cluster.create_resources(fleet)
+    a.on_add(fleet)
+    for _ in range(8):
+        a.tick()
+    # pow2 would cap at 4; the fleet must reach its real max of 6
+    assert cluster.get_trainer_parallelism(fleet) == 6
+
+
+def test_paired_rebalance_legs_suppress_atomically():
+    """Hysteresis must drop a rebalance's shrink+grant TOGETHER: a
+    cooldown on the victim must not let the winner's grant actuate
+    into capacity that was never freed."""
+    from edl_tpu.scheduler.autoscaler import Autoscaler
+    from edl_tpu.scheduler.planner import GoodputPlan
+
+    class OneJobCluster:
+        """Minimal Cluster seam: two running 1-chip jobs, full node."""
+
+        def __init__(self):
+            from edl_tpu.cluster.fake import FakeCluster
+
+            self.fake = FakeCluster()
+            self.fake.add_node("n0", cpu_milli=64_000,
+                               memory_mega=64_000, tpu_chips=4)
+
+        def __getattr__(self, name):
+            return getattr(self.fake, name)
+
+    c = OneJobCluster()
+    clock_t = [1000.0]
+    a = Autoscaler(c, goodput_curves=lambda uid: curve({1: 100.0}),
+                   resize_cooldown_s=30.0, clock=lambda: clock_t[0])
+    winner = priced_job("winner", 1, 1, 4, 1).config
+    victim = priced_job("victim", 1, 1, 4, 3).config
+    c.create_resources(winner)
+    c.create_resources(victim)
+    a.on_add(winner)
+    a.on_add(victim)
+    a.drain_events()
+    # the victim resized moments ago: inside its cooldown
+    a._last_resize["default/victim"] = clock_t[0] - 1.0
+
+    import edl_tpu.scheduler.autoscaler as auto_mod
+
+    orig = auto_mod.plan_cluster
+
+    def fake_plan(jobs, r, mld=1.0, **kw):
+        return GoodputPlan(
+            diff={"default/victim": -1, "default/winner": 1},
+            mode="goodput",
+            reclaims=[{"victim": "default/victim",
+                       "for_job": "default/winner",
+                       "from": 3, "to": 2, "reason": "rebalance"}])
+
+    auto_mod.plan_cluster = fake_plan
+    try:
+        actuated = a.tick()
+    finally:
+        auto_mod.plan_cluster = orig
+    # neither leg actuated: the victim was cooling down, so the
+    # winner's paired grant was dropped with it
+    assert actuated == {}, actuated
+    assert a.suppressed_history[-1] == {
+        "default/victim": "cooldown", "default/winner": "paired_reclaim"}
+
+
+def test_preemption_overrides_victim_cooldown():
+    """A higher-priority gang's admission must not wait out its
+    victim's resize cooldown."""
+    from edl_tpu.scheduler.autoscaler import Autoscaler
+    from edl_tpu.scheduler.planner import GoodputPlan
+    from tests.test_autoscaler import cluster_with
+
+    c = cluster_with()
+    clock_t = [1000.0]
+    a = Autoscaler(c, goodput_curves=lambda uid: curve({1: 100.0}),
+                   resize_cooldown_s=30.0, clock=lambda: clock_t[0])
+    victim = priced_job("victim", 0, 1, 8, 4).config
+    c.create_resources(victim)
+    c.update_trainer_parallelism(victim, 4)   # running at 4
+    a.on_add(victim)
+    a.drain_events()
+    a._last_resize["default/victim"] = clock_t[0] - 1.0  # cooling down
+
+    import edl_tpu.scheduler.autoscaler as auto_mod
+
+    orig = auto_mod.plan_cluster
+    auto_mod.plan_cluster = lambda jobs, r, mld=1.0, **kw: GoodputPlan(
+        diff={"default/victim": -2}, mode="goodput",
+        preemptions=[{"victim": "default/victim",
+                      "for_job": "default/gang", "from": 4, "to": 2,
+                      "domain": None, "reason": "preempt"}])
+    try:
+        actuated = a.tick()
+    finally:
+        auto_mod.plan_cluster = orig
+    assert actuated == {"default/victim": 2}, actuated
+
+
+def test_observe_only_serving_job_hints_but_never_actuates():
+    """Under chip arbitration the SLO policy keeps observing, recording
+    and prewarm-hinting — but the goodput planner owns the dial."""
+    from edl_tpu.scheduler.autoscaler import ServingScaler
+
+    job = ServingJob(name="fleet", spec=ServingSpec(
+        min_replicas=1, max_replicas=8, slo_p99_ms=100.0))
+    breach = SimpleNamespace(requests_windowed=900, qps=260.0,
+                             p99_ms=400.0, queue_depth=40,
+                             replicas_active=2)
+    actuations, hints = [], []
+    s = ServingScaler(stats_for=lambda uid: breach,
+                      actuate=lambda uid, n: actuations.append((uid, n)),
+                      clock=lambda: 1000.0)
+    s.hint_sink = lambda uid, n: hints.append((uid, n))
+    s.on_add(job)
+    s.observe_only.add(job.full_name)
+    s.tick()
+    assert actuations == []
+    assert hints and hints[0][1] > 2  # the breach still prewarms ahead
+
+
+def test_priority_threads_api_serde_crd():
+    """SchedPriority round-trips through the manifest layer for both
+    kinds, accepts tier names, survives apiserver structural pruning,
+    and rejects negatives at validation."""
+    import edl_tpu.api.serde as serde
+    from edl_tpu.api.validation import ValidationError, validate_any
+    from tests.k8s_stub import load_crd_schemas, prune_per_schema
+
+    doc = serde.job_to_dict(
+        priced_job("p", 1, 1, 2, 1, priority=SchedPriority.HIGH).config)
+    assert doc["spec"]["trainer"]["priority"] == 2
+    back = serde.job_from_dict(doc)
+    assert back.sched_priority() == 2
+    # tier names parse (case-insensitive)
+    doc["spec"]["trainer"]["priority"] = "high"
+    assert serde.job_from_dict(doc).sched_priority() == 2
+    with pytest.raises(ValueError):
+        serde.job_from_dict(
+            {**doc, "spec": {**doc["spec"],
+                             "trainer": {**doc["spec"]["trainer"],
+                                         "priority": "urgent"}}})
+    # CRD lockstep: a conformant apiserver must not prune the field
+    schema = load_crd_schemas()[("edl.tpu", "trainingjobs")]
+    pruned = prune_per_schema(doc, schema)
+    assert pruned["spec"]["trainer"]["priority"] == "high"
+    sj = ServingJob(name="f", spec=ServingSpec(
+        min_replicas=1, max_replicas=2, priority=SchedPriority.HIGH))
+    sdoc = serde.serving_job_to_dict(sj)
+    assert sdoc["spec"]["server"]["priority"] == 2
+    assert serde.serving_job_from_dict(sdoc).sched_priority() == 2
+    sschema = load_crd_schemas()[("edl.tpu", "servingjobs")]
+    assert prune_per_schema(sdoc, sschema)["spec"]["server"]["priority"] == 2
+    # validation bounds
+    bad = priced_job("bad", 1, 1, 1, 1).config
+    bad.spec.trainer.priority = -1
+    with pytest.raises(ValidationError):
+        validate_any(bad)
+
+
+def test_autoscaler_objective_gauge_reports_active_mode():
+    from tests.test_autoscaler import cluster_with, mk_job, submit
+    from edl_tpu.observability.metrics import get_registry, parse_exposition
+    from edl_tpu.scheduler.autoscaler import Autoscaler
+
+    cv = curve({2: 1000.0, 8: 3000.0})
+    c = cluster_with()
+    a = Autoscaler(c, goodput_curves=lambda uid: cv)
+    submit(c, a, mk_job("example", lo=2, hi=10))
+    a.tick()
+    series = parse_exposition(get_registry().render())
+    assert series['edl_autoscaler_objective{mode="goodput"}'] == 1.0
+    assert series['edl_autoscaler_objective{mode="count"}'] == 0.0
+    # flag off → count mode, bit-for-bit reference behavior
+    c2 = cluster_with()
+    b = Autoscaler(c2, goodput_curves=lambda uid: cv,
+                   goodput_objective=False)
+    submit(c2, b, mk_job("example", lo=2, hi=10))
+    b.tick()
+    series = parse_exposition(get_registry().render())
+    assert series['edl_autoscaler_objective{mode="count"}'] == 1.0
+
+
+def test_controller_arbitrates_elastic_chip_serving_fleets():
+    """An elastic chip-holding ServingJob submitted under the goodput
+    objective registers with BOTH loops: the SLO policy observes and
+    records, the goodput planner owns the dial."""
+    from edl_tpu.cluster.fake import FakeCluster
+    from edl_tpu.controller.controller import Controller
+
+    cluster = FakeCluster()
+    cluster.add_node("n0", cpu_milli=64_000, memory_mega=64_000,
+                     tpu_chips=8)
+    ctl = Controller(cluster, goodput_curves=lambda uid: None)
+    res = ResourceRequirements(requests={"cpu": "1", "memory": "1Mi"},
+                               limits={RESOURCE_TPU: "1"})
+    job = ServingJob(name="fleet", spec=ServingSpec(
+        min_replicas=1, max_replicas=4, slo_p99_ms=50.0, resources=res))
+    try:
+        ctl.submit(job)
+        ctl.autoscaler.drain_events()
+        assert job.full_name in ctl.autoscaler.jobs
+        assert job.full_name in ctl.serving_scaler.observe_only
+        ctl.delete(job)
+        ctl.autoscaler.drain_events()
+        assert job.full_name not in ctl.autoscaler.jobs
+        assert job.full_name not in ctl.serving_scaler.observe_only
+    finally:
+        ctl.stop()
+
+
+def test_controller_modify_reconciles_arbitration_both_ways():
+    """A spec change can flip arbitration eligibility: exactly ONE loop
+    owns the replica dial afterwards, in either direction."""
+    from edl_tpu.cluster.fake import FakeCluster
+    from edl_tpu.controller.controller import Controller
+
+    cluster = FakeCluster()
+    cluster.add_node("n0", cpu_milli=64_000, memory_mega=64_000,
+                     tpu_chips=8)
+    ctl = Controller(cluster, goodput_curves=lambda uid: None)
+    res = ResourceRequirements(requests={"cpu": "1", "memory": "1Mi"},
+                               limits={RESOURCE_TPU: "1"})
+    # submitted NON-elastic: no arbitration — the SLO policy owns it
+    job = ServingJob(name="fleet", spec=ServingSpec(
+        min_replicas=2, max_replicas=2, slo_p99_ms=50.0, resources=res))
+    try:
+        ctl.submit(job)
+        ctl.autoscaler.drain_events()
+        assert job.full_name not in ctl.serving_scaler.observe_only
+        assert job.full_name not in ctl.autoscaler.jobs
+        # modified elastic → the goodput planner takes the dial
+        job.spec.max_replicas = 4
+        ctl.modify(job)
+        ctl.autoscaler.drain_events()
+        assert job.full_name in ctl.serving_scaler.observe_only
+        assert job.full_name in ctl.autoscaler.jobs
+        # modified back to fixed-size → ownership returns whole
+        job.spec.max_replicas = 2
+        ctl.modify(job)
+        ctl.autoscaler.drain_events()
+        assert job.full_name not in ctl.serving_scaler.observe_only
+        assert job.full_name not in ctl.autoscaler.jobs
+    finally:
+        ctl.delete(job)
+        ctl.stop()
