@@ -82,6 +82,8 @@ from __future__ import annotations
 import asyncio
 import collections
 import os
+import random
+import socket
 import threading
 import time
 from typing import Any, Callable, Optional
@@ -194,8 +196,8 @@ class HeadMeta:
     parsing into one dict hit)."""
 
     __slots__ = ("method", "path", "body_len", "f32", "priority",
-                 "trace_id", "parent_span", "keep_alive", "head_len",
-                 "total_len", "bad", "chunked")
+                 "trace_id", "parent_span", "nonce", "keep_alive",
+                 "head_len", "total_len", "bad", "chunked")
 
     def __init__(self, head: bytes) -> None:
         self.bad = False
@@ -205,6 +207,7 @@ class HeadMeta:
         self.priority = PRI_NORMAL
         self.trace_id: Optional[str] = None
         self.parent_span: Optional[str] = None
+        self.nonce: Optional[str] = None
         self.keep_alive = True
         self.head_len = len(head)
         try:
@@ -262,6 +265,14 @@ class HeadMeta:
         if idx >= 0:
             end = lower.index(b"\r\n", idx + 2)
             self.parent_span = head[idx + 20:end].strip().decode("latin1")
+        # end-to-end integrity nonce (doc/serving.md §response
+        # integrity): the LB stamps it on a block's first request and
+        # requires the echo on that block's first response — a
+        # misrouted/desynced/corrupted answer cannot echo it
+        idx = lower.find(b"\r\nx-edl-block-nonce:")
+        if idx >= 0:
+            end = lower.index(b"\r\n", idx + 2)
+            self.nonce = head[idx + 20:end].strip().decode("latin1")
         if b"\r\nconnection: close" in lower:
             self.keep_alive = False
         self.total_len = self.head_len + self.body_len
@@ -408,11 +419,11 @@ class HttpConn(asyncio.Protocol):
         meta = self.door.head_cache.get(head)
         if meta is None:
             meta = HeadMeta(head)
-            # traced heads are unique per request (they embed the trace
-            # id): caching them would churn the bounded cache (each
-            # clear() dumps genuinely hot heads) for entries that can
-            # never hit again
-            if meta.trace_id is None:
+            # traced/nonce'd heads are unique per request (they embed
+            # the trace id / block nonce): caching them would churn the
+            # bounded cache (each clear() dumps genuinely hot heads)
+            # for entries that can never hit again
+            if meta.trace_id is None and meta.nonce is None:
                 if len(self.door.head_cache) > 512:
                     self.door.head_cache.clear()
                 self.door.head_cache[head] = meta
@@ -440,10 +451,11 @@ class HttpConn(asyncio.Protocol):
         if (meta.method == "POST" and meta.path == "/predict" and meta.f32
                 and meta.body_len >= 4 and meta.body_len % 4 == 0):
             # arm the fixed-stride block parser for the repeats — but
-            # never on a traced head: it is unique to its request, so
-            # arming would just push the NEXT (plain) request onto the
-            # slow path (the LB's response parser has the same guard)
-            if meta.trace_id is None:
+            # never on a traced or nonce'd head: it is unique to its
+            # request, so arming would just push the NEXT (plain)
+            # request onto the slow path (the LB's response parser has
+            # the same guard)
+            if meta.trace_id is None and meta.nonce is None:
                 self._fixed = (head, meta)
             if self.app.wants_raw:
                 self.app.handle_raw_block(self, raw, 1, meta)
@@ -605,10 +617,11 @@ class _Block:
     minority) — the span-phase cuts and the cross-tier stitch point."""
 
     __slots__ = ("conn", "slot", "rows", "t", "json", "trace_id",
-                 "t_recv", "parent")
+                 "t_recv", "parent", "nonce")
 
     def __init__(self, conn, slot, rows, t, json_resp=False,
-                 trace_id=None, t_recv=0.0, parent=None) -> None:
+                 trace_id=None, t_recv=0.0, parent=None,
+                 nonce=None) -> None:
         self.conn = conn
         self.slot = slot
         self.rows = rows
@@ -617,6 +630,7 @@ class _Block:
         self.trace_id = trace_id
         self.t_recv = t_recv
         self.parent = parent
+        self.nonce = nonce
 
 
 class _StatePublisher(AddrPublisher):
@@ -655,7 +669,10 @@ class BatchApp:
                  hard_cap_rows: int = 65536, soft_cap_rows: int = 0,
                  slo_p99_ms: float = 0.0, kv=None,
                  advertise_host: str = "127.0.0.1",
-                 addr_ttl_s: float = 15.0, standby: bool = False) -> None:
+                 addr_ttl_s: float = 15.0, standby: bool = False,
+                 brownout_enter_ms: float = 0.0,
+                 brownout_sustain: int = 3,
+                 brownout_min_s: float = 0.5) -> None:
         self.build_server = build_server
         self.row_dim = int(row_dim)
         self.job = job
@@ -698,7 +715,44 @@ class BatchApp:
         #: week of serving cannot grow it
         self.exemplars: "collections.deque[dict]" = collections.deque(
             maxlen=256)
+        # -- brownout: the degraded mode between healthy and 429-
+        # everything (doc/serving.md §brownout).  Entered after
+        # ``brownout_sustain`` consecutive batcher iterations whose
+        # oldest queued block aged past ``brownout_enter_ms`` (0
+        # disables the queue-age trigger), or immediately on a
+        # sustained loop-lag escalation relayed via note_lag_breach().
+        # While active: admission caps halve, the co-batching admission
+        # window collapses to 0 (serve NOW, don't wait for batchmates)
+        # and span/exemplar work is shed first — response correctness
+        # (bodies, echo headers) is never degraded.  Exit needs
+        # ``brownout_min_s`` elapsed AND ``brownout_sustain`` clean
+        # iterations (hysteresis: no flapping at the threshold).
+        self.brownout_enter_ms = float(brownout_enter_ms)
+        self.brownout_sustain = max(int(brownout_sustain), 1)
+        self.brownout_min_s = float(brownout_min_s)
+        self.brownouts = 0
+        self._brownout = False
+        self._brn_streak = 0
+        self._brn_clear = 0
+        self._brn_since = 0.0
+        self._brn_last = 0.0
+        self._lag_breach = False
+        # -- gray-failure seam (GrayReplica drills): for a window, a
+        # fraction of blocks get gray answers — 500s ("error") or a
+        # wrong-nonce echo + garbage body ("corrupt")
+        self._gray_rate = 0.0
+        self._gray_mode = "error"
+        self._gray_until = 0.0
+        self._gray_rng = random.Random(0xED1)
         reg = get_registry()
+        self._brn_seconds = reg.counter(
+            "frontdoor_brownout_seconds",
+            help="seconds spent in brownout (degraded admission)")
+        self._brn_seconds.inc(0, job=job, replica=replica)
+        reg.gauge_fn("frontdoor_brownout",
+                     lambda: 1 if self._brownout else 0,
+                     help="1 while the replica serves in brownout",
+                     job=job, replica=replica)
         self._hist = reg.histogram(
             "frontdoor_request_seconds",
             help="front-door latency, admission to response write",
@@ -775,9 +829,15 @@ class BatchApp:
         here): ``(rows to admit of k, pause the connection?)`` against
         the live queue depth."""
         qd = self._queued_rows
-        if pri == PRI_LOW and qd + k > self.soft_cap:
+        soft, hard, high = self.soft_cap, self.hard_cap, self.high_cap
+        if self._brownout:
+            # degraded admission: half the window at every tier — the
+            # queue must SHRINK while browned out, or the lag/age
+            # breach that triggered it can never clear
+            soft, hard, high = soft // 2, hard // 2, high // 2
+        if pri == PRI_LOW and qd + k > soft:
             return 0, False
-        cap = self.high_cap if pri == PRI_HIGH else self.hard_cap
+        cap = high if pri == PRI_HIGH else hard
         if qd + k > cap:
             return max(cap - qd, 0), True
         return k, False
@@ -823,7 +883,8 @@ class BatchApp:
         now = time.perf_counter()
         blk = _Block(conn, slot, rows, now,
                      json_resp=json_resp, trace_id=meta.trace_id,
-                     t_recv=t_recv or now, parent=meta.parent_span)
+                     t_recv=t_recv or now, parent=meta.parent_span,
+                     nonce=meta.nonce)
         with self._cond:
             self._queue.append(blk)
             self._queued_rows += len(rows)
@@ -896,6 +957,15 @@ class BatchApp:
                 conn.complete(conn.push_slot(1), RESP_409)
         elif verb == "drain":
             self._set_state(FD_DRAINING)
+            conn.complete(conn.push_slot(1), RESP_200_EMPTY)
+        elif verb == "gray":
+            # chaos drill injection: body is "<rate> <mode> <duration_s>"
+            try:
+                rate, mode, dur = body.decode().split()
+                self.set_gray(float(rate), mode, float(dur))
+            except (ValueError, UnicodeDecodeError):
+                conn.complete(conn.push_slot(1), RESP_400)
+                return
             conn.complete(conn.push_slot(1), RESP_200_EMPTY)
         elif verb == "reload":
             hook = getattr(self, "reload_hook", None)
@@ -1009,6 +1079,7 @@ class BatchApp:
             if not blocks:
                 continue
             t_take = time.perf_counter()
+            self._brownout_tick(t_take, blocks)
             if self._stall_once_ms > 0:
                 # the injected straggler: this iteration wedges AFTER
                 # admission, so its requests age past the LB hedge delay
@@ -1041,8 +1112,17 @@ class BatchApp:
             done = []
             lats = []
             off = 0
+            gray = (self._gray_rate > 0.0
+                    and time.perf_counter() < self._gray_until)
             for b in blocks:
                 k = len(b.rows)
+                if gray:
+                    gdata = self._gray_response(b, k)
+                    if gdata is not None:
+                        done.append((b.conn, b.slot, gdata))
+                        lats.append((now - b.t, k))
+                        off += k
+                        continue
                 if b.json:
                     import json
 
@@ -1053,17 +1133,25 @@ class BatchApp:
                             b"Content-Type: application/json\r\n"
                             + (f"X-EDL-Trace-Id: {b.trace_id}\r\n".encode()
                                if b.trace_id else b"")
+                            + (f"X-EDL-Block-Nonce: {b.nonce}\r\n".encode()
+                               if b.nonce else b"")
                             + f"Content-Length: {len(payload)}"
                               f"\r\n\r\n".encode() + payload)
-                elif b.trace_id:
-                    # traced f32 rows echo the id too: the header
+                elif b.trace_id or b.nonce:
+                    # traced/nonce'd f32 rows echo the headers too: the
                     # contract holds on the fast path, not just the
-                    # JSON slow path (f32↔JSON parity)
+                    # JSON slow path (f32↔JSON parity) — and the echo
+                    # is NEVER shed, even in brownout (it is what lets
+                    # the LB trust the payload)
                     echo = (
                         b"HTTP/1.1 200 OK\r\nContent-Type: "
                         + F32_CONTENT_TYPE.encode()
-                        + b"\r\nX-EDL-Trace-Id: "
-                        + b.trace_id.encode("latin1")
+                        + (b"\r\nX-EDL-Trace-Id: "
+                           + b.trace_id.encode("latin1")
+                           if b.trace_id else b"")
+                        + (b"\r\nX-EDL-Block-Nonce: "
+                           + b.nonce.encode("latin1")
+                           if b.nonce else b"")
                         + b"\r\nContent-Length: "
                         + str(self.out_dim * 4).encode() + b"\r\n\r\n")
                     bodies = mat[off:off + k, len(self._out_head):]
@@ -1073,7 +1161,9 @@ class BatchApp:
                     data = mat[off:off + k].tobytes()
                 done.append((b.conn, b.slot, data))
                 lats.append((now - b.t, k))
-                if b.trace_id:
+                if b.trace_id and not self._brownout:
+                    # brownout sheds span/exemplar work first: tracing
+                    # is the cheapest thing to stop doing under duress
                     self._emit_block_spans(b, t_take, t_fwd, now)
                 off += k
             self.door.call_soon(self._deliver, done)
@@ -1129,6 +1219,92 @@ class BatchApp:
             "forward_ms": round((t_fwd1 - t_fwd0) * 1e3, 3),
         })
 
+    # -- gray-failure seam + brownout (chaos drills / degraded mode) ---------
+
+    def set_gray(self, rate: float, mode: str = "error",
+                 duration_s: float = 1.0) -> None:
+        """Chaos seam for the :class:`~edl_tpu.runtime.faults.GrayReplica`
+        drill: for ``duration_s`` a ``rate`` fraction of blocks get gray
+        answers.  ``"error"`` sends 500s; ``"corrupt"`` sends a
+        wrong-nonce echo + garbage body on nonce'd blocks only — the
+        misroute/desync shape the LB's integrity check exists to catch
+        (corrupting an un-nonce'd block would be a silently-wrong
+        payload no tier could detect, which the drill invariant
+        forbids)."""
+        if mode not in ("error", "corrupt"):
+            raise ValueError(f"unknown gray mode {mode!r}")
+        self._gray_rate = max(float(rate), 0.0)
+        self._gray_mode = mode
+        self._gray_until = time.perf_counter() + float(duration_s)
+
+    def _gray_response(self, b: _Block, k: int) -> Optional[bytes]:
+        if self._gray_rng.random() >= self._gray_rate:
+            return None
+        if self._gray_mode == "error":
+            self._c.inc("frontdoor_gray_responses", k, job=self.job,
+                        mode="error")
+            return RESP_500 * k
+        if b.nonce is None:
+            return None
+        self._c.inc("frontdoor_gray_responses", k, job=self.job,
+                    mode="corrupt")
+        body = b"\xde\xad" * (self.out_dim * 2)
+        head = (f"HTTP/1.1 200 OK\r\nContent-Type: {F32_CONTENT_TYPE}\r\n"
+                f"X-EDL-Block-Nonce: bad-{b.nonce}\r\n"
+                f"Content-Length: {len(body)}\r\n\r\n").encode()
+        return (head + body) * k
+
+    def note_lag_breach(self) -> None:
+        """Relay from the :class:`LoopLagProbe`'s sustained-lag
+        escalation (any thread): the next batcher iteration enters
+        brownout immediately — the probe already proved sustain."""
+        self._lag_breach = True
+
+    def _brownout_tick(self, now: float, blocks: list) -> None:
+        lag = self._lag_breach
+        if lag:
+            self._lag_breach = False
+        age_breach = False
+        if self.brownout_enter_ms > 0 and blocks:
+            age_breach = ((now - blocks[0].t) * 1e3
+                          > self.brownout_enter_ms)
+        if not self._brownout:
+            if lag:
+                self._brn_streak = self.brownout_sustain
+            elif age_breach:
+                self._brn_streak += 1
+            else:
+                self._brn_streak = 0
+            if self._brn_streak >= self.brownout_sustain:
+                self._brownout = True
+                self.brownouts += 1
+                self._brn_since = self._brn_last = now
+                self._brn_clear = 0
+                self._brn_streak = 0
+                log.warn("entering brownout", replica=self.replica,
+                         queued_rows=self._queued_rows)
+                get_tracer().instant("brownout_entered",
+                                     category="serving",
+                                     replica=self.replica)
+            return
+        # in brownout: bank the degraded seconds incrementally (the
+        # scrape plane sees the episode GROW, not just its post-mortem
+        # total), then exit only with hysteresis
+        self._brn_seconds.inc(max(now - self._brn_last, 0.0),
+                              job=self.job, replica=self.replica)
+        self._brn_last = now
+        if lag or age_breach:
+            self._brn_clear = 0
+            return
+        self._brn_clear += 1
+        if (now - self._brn_since >= self.brownout_min_s
+                and self._brn_clear >= self.brownout_sustain):
+            self._brownout = False
+            log.info("exiting brownout", replica=self.replica,
+                     brownout_s=round(now - self._brn_since, 3))
+            get_tracer().instant("brownout_exited", category="serving",
+                                 replica=self.replica)
+
     def _forward(self, rows: np.ndarray) -> np.ndarray:
         """Serve ``rows`` through the fixed compiled batch shape,
         chunking when a burst outruns one batch."""
@@ -1154,9 +1330,11 @@ class BatchApp:
                 self._cond.wait(0.05)
             if self._halt and not self._queue:
                 return None
-            if self._queue and self.max_queue_ms > 0:
+            if self._queue and self.max_queue_ms > 0 \
+                    and not self._brownout:
                 # admission window: wait for co-batchees once the first
-                # block is in hand, bounded by max_queue_ms
+                # block is in hand, bounded by max_queue_ms (collapsed
+                # to 0 in brownout: tightest queue deadline first)
                 deadline = time.perf_counter() + self.max_queue_ms / 1e3
                 while self._queued_rows < self.max_batch:
                     remaining = deadline - time.perf_counter()
@@ -1250,7 +1428,7 @@ class FleetApp:
 
     def _submit(self, conn, row: np.ndarray, trace_id, json_resp: bool,
                 slot: RespSlot, pri: int = PRI_NORMAL,
-                parent_span=None) -> None:
+                parent_span=None, nonce=None) -> None:
         from edl_tpu.runtime.serving import RequestDropped
 
         door = self.door
@@ -1282,6 +1460,8 @@ class FleetApp:
                         b"Content-Type: application/json\r\n"
                         + (f"X-EDL-Trace-Id: {trace_id}\r\n".encode()
                            if trace_id else b"")
+                        + (f"X-EDL-Block-Nonce: {nonce}\r\n".encode()
+                           if nonce else b"")
                         + f"Content-Length: {len(payload)}\r\n\r\n".encode()
                         + payload)
             else:
@@ -1292,6 +1472,8 @@ class FleetApp:
                         f"Content-Type: {F32_CONTENT_TYPE}\r\n"
                         + (f"X-EDL-Trace-Id: {trace_id}\r\n"
                            if trace_id else "")
+                        + (f"X-EDL-Block-Nonce: {nonce}\r\n"
+                           if nonce else "")
                         + f"Content-Length: {len(body)}\r\n\r\n"
                         ).encode() + body
             door.call_soon(self._fill, conn, slot, data, timer)
@@ -1321,7 +1503,7 @@ class FleetApp:
         for row in rows:
             self._submit(conn, row, meta.trace_id, False,
                          conn.push_slot(1), meta.priority,
-                         parent_span=meta.parent_span)
+                         parent_span=meta.parent_span, nonce=meta.nonce)
 
     def handle_request(self, conn, meta: HeadMeta, body: bytes,
                        raw: bytes) -> None:
@@ -1343,7 +1525,8 @@ class FleetApp:
                 conn.complete(conn.push_slot(1), RESP_400)
                 return
             self._submit(conn, row, meta.trace_id, True, conn.push_slot(1),
-                         meta.priority, parent_span=meta.parent_span)
+                         meta.priority, parent_span=meta.parent_span,
+                         nonce=meta.nonce)
             return
         conn.complete(conn.push_slot(1), RESP_404)
 
@@ -1377,7 +1560,9 @@ class LoopLagProbe:
                  interval_s: float = 0.05, breach_s: float = 0.25,
                  sustain: int = 3, flight_dir: str = "",
                  exemplars_fn: Optional[Callable[[], list]] = None,
-                 dump_cooldown_s: float = 30.0) -> None:
+                 dump_cooldown_s: float = 30.0,
+                 on_sustained: Optional[Callable[[str, float],
+                                                 None]] = None) -> None:
         from edl_tpu.runtime.watchdog import StallWatchdog
 
         self.door = door
@@ -1388,6 +1573,9 @@ class LoopLagProbe:
         self.flight_dir = flight_dir
         self.exemplars_fn = exemplars_fn
         self.dump_cooldown_s = float(dump_cooldown_s)
+        #: escalation relay (``(kind, lag_s)``, loop thread): what wires
+        #: sustained lag into the replica's brownout entry
+        self.on_sustained = on_sustained
         self.ticks = 0
         self.breaches = 0
         self.escalations = 0
@@ -1462,6 +1650,12 @@ class LoopLagProbe:
         get_tracer().instant(f"{kind}_escalated", category="loop",
                              loop=self.loop_name,
                              lag_ms=round(lag_s * 1e3, 1))
+        if self.on_sustained is not None:
+            try:
+                self.on_sustained(kind, lag_s)
+            except Exception as exc:
+                log.warn("loop-lag escalation relay failed",
+                         error=str(exc)[:120])
         if not self.flight_dir:
             return
         try:
@@ -1491,6 +1685,69 @@ class LoopLagProbe:
 
 
 # -- process entrypoint ------------------------------------------------------
+
+
+class CoordBootstrapError(RuntimeError):
+    """The coordinator endpoint was configured but never answered within
+    the bootstrap deadline — the pod must fail loudly (exit 3), not hang
+    past its readiness budget or silently run discovery-less."""
+
+
+def bootstrap_kv(env, *, disabled: str,
+                 var: str = "EDL_COORD_ENDPOINT") -> Optional[Any]:
+    """Coordinator bootstrap for serving-plane pods (replica + LB mains).
+
+    An UNSET/blank endpoint stays the quiet degraded path (returns None,
+    like :func:`~edl_tpu.coord.client.client_from_env`).  A CONFIGURED
+    endpoint is a hard dependency: probe it with short-timeout PING
+    sockets under jittered exponential backoff until it answers PONG —
+    the probe catches black-holed endpoints where the TCP connect
+    succeeds but requests hang, which a bare ``CoordClient(...)``
+    construct-and-hope never would — and raise
+    :class:`CoordBootstrapError` once ``EDL_COORD_BOOTSTRAP_DEADLINE_S``
+    (default 10) lapses, so a down coordinator at pod start fails
+    loudly inside the readiness budget instead of hanging past it."""
+    endpoint = env.get(var, "")
+    if ":" not in endpoint:
+        log.info(f"{var} not set; {disabled}")
+        return None
+    host, _, port_s = endpoint.rpartition(":")
+    try:
+        port = int(port_s)
+    except ValueError:
+        raise CoordBootstrapError(f"unparseable {var}={endpoint!r}")
+    deadline_s = float(env.get("EDL_COORD_BOOTSTRAP_DEADLINE_S", "10"))
+    t0 = time.monotonic()
+    rng = random.Random()
+    attempt = 0
+    while True:
+        remaining = t0 + deadline_s - time.monotonic()
+        if remaining <= 0:
+            raise CoordBootstrapError(
+                f"coordinator at {endpoint} unreachable for "
+                f"{deadline_s:.1f}s ({attempt} attempts)")
+        probe_timeout = min(1.0, max(remaining, 0.05))
+        try:
+            with socket.create_connection((host, port),
+                                          timeout=probe_timeout) as s:
+                s.settimeout(probe_timeout)
+                s.sendall(b"PING\n")
+                if s.makefile("rb").readline().startswith(b"PONG"):
+                    from edl_tpu.coord.client import CoordClient
+
+                    return CoordClient(host, port)
+        except OSError:
+            pass
+        attempt += 1
+        # jittered exponential backoff, capped at 1 s: fast retries for
+        # a restarting coordinator, no thundering herd across a fleet
+        delay = min(1.0, 0.05 * (2 ** attempt)) * rng.uniform(0.5, 1.0)
+        remaining = t0 + deadline_s - time.monotonic()
+        if remaining <= 0:
+            raise CoordBootstrapError(
+                f"coordinator at {endpoint} unreachable for "
+                f"{deadline_s:.1f}s ({attempt} attempts)")
+        time.sleep(min(delay, remaining))
 
 
 def replica_main(env=None) -> int:
@@ -1549,9 +1806,23 @@ def _replica_main(env) -> int:
             params = ckpt.restore({"params": params}, step=step)["params"]
             generation = step
 
-    from edl_tpu.coord.client import client_from_env
-
-    kv = client_from_env(env, disabled="address not published")
+    try:
+        kv = bootstrap_kv(env, disabled="address not published")
+    except CoordBootstrapError as exc:
+        # the PR 13 exit-3 marker: harnesses gate on FAILED/ready lines,
+        # and a down coordinator at pod start must fail INSIDE the
+        # readiness budget, not hang past it
+        print(f"frontdoor FAILED replica={replica} "
+              f"(coordinator bootstrap: {exc})", flush=True)
+        fdir = env.get("EDL_FLIGHTREC_DIR", "")
+        if fdir:
+            try:
+                dump_flight_record(fdir, "frontdoor-coord-bootstrap",
+                                   extra={"replica": replica,
+                                          "error": str(exc)})
+            except Exception:
+                pass
+        return 3
 
     from edl_tpu.runtime.serving import ElasticServer
 
@@ -1565,7 +1836,10 @@ def _replica_main(env) -> int:
         hard_cap_rows=int(env.get("EDL_FD_CAP_ROWS", "65536")),
         slo_p99_ms=float(env.get("EDL_FD_SLO_P99_MS", "0")),
         kv=kv, addr_ttl_s=float(env.get("EDL_FD_TTL_S", "15")),
-        standby=env.get("EDL_FD_STANDBY", "0") == "1")
+        standby=env.get("EDL_FD_STANDBY", "0") == "1",
+        brownout_enter_ms=float(env.get("EDL_FD_BROWNOUT_MS", "0")),
+        brownout_sustain=int(env.get("EDL_FD_BROWNOUT_SUSTAIN", "3")),
+        brownout_min_s=float(env.get("EDL_FD_BROWNOUT_MIN_S", "0.5")))
     app.generation = generation
 
     def reload_hook():
@@ -1601,7 +1875,8 @@ def _replica_main(env) -> int:
             door, "frontdoor", interval_s=probe_ms / 1e3,
             breach_s=float(env.get("EDL_FD_LAG_BREACH_MS", "250")) / 1e3,
             flight_dir=flight_dir,
-            exemplars_fn=lambda: list(app.exemplars)).start()
+            exemplars_fn=lambda: list(app.exemplars),
+            on_sustained=lambda kind, lag: app.note_lag_breach()).start()
     metrics_port = int(env.get("EDL_FD_METRICS_PORT", "0"))
     metrics_srv = None
     if metrics_port >= 0:

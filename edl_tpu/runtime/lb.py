@@ -32,6 +32,24 @@ one (or more — the tier is stateless) ``ServingLB`` process:
   front door, applied against the LB-wide outstanding-row count: low
   sheds at the soft watermark, normal at the hard cap, high rides the
   reserve band.
+* **circuit breaking** — each upstream carries a :class:`_Breaker`
+  (doc/serving.md §gray-failure defenses): consecutive-error or
+  windowed-error-rate trip ejects the replica from routing, a cooldown
+  later a SINGLE half-open probe block must complete clean
+  ``breaker_probes`` times before traffic returns.  Errors are 5xx
+  responses, integrity failures, severed connections, and request
+  timeouts — the gray-failure signals a crash-only health check never
+  sees.
+* **retry budget** — hedge twins and rescue resends draw from one
+  token bucket (``retry_budget_cap`` burst, refilled ``retry_ratio``
+  per admitted block), so a fleet-wide outage degrades to single-send
+  instead of amplifying into a resend storm.
+* **response integrity** — every forwarded block's first request
+  carries an ``X-EDL-Block-Nonce`` that the replica must echo on the
+  block's first response; a missing/mismatched echo (misroute, FIFO
+  desync, corrupted payload) is never credited or forwarded — the
+  connection is aborted (poisoned), the blocks rescue, and the breaker
+  hears about it.
 * **trace origin** — the LB opens every sampled request's CROSS-TIER
   span tree (doc/serving.md §request tracing): an ``lb_request`` root
   (admission → completion) with ``lb.route`` and one ``lb.upstream``
@@ -52,11 +70,18 @@ Scrape names: ``edl_lb_requests_total`` / ``edl_lb_responses_total`` /
 ``edl_lb_hedges_total{result=win|lose}`` / ``edl_lb_rescues_total`` /
 ``edl_lb_overload_sheds_total{priority=}`` / ``edl_lb_timeouts_total``
 / ``edl_lb_discovery_sweeps_total`` /
+``edl_lb_discovery_freezes_total`` /
+``edl_lb_breaker_transitions_total{to=open|half_open|closed}`` /
+``edl_lb_integrity_failures_total`` /
+``edl_lb_retry_budget_exhausted_total`` /
 ``edl_traces_sampled_total{origin=}`` (counters),
 ``edl_lb_request_seconds`` (histogram, trace-id exemplars on its
 buckets) / ``edl_loop_lag_seconds{loop=lb}`` (histogram),
 ``edl_lb_upstreams_ready`` / ``edl_lb_outstanding_rows`` /
-``edl_lb_hedge_delay_ms`` (gauges) — all labeled ``job=``.
+``edl_lb_hedge_delay_ms`` /
+``edl_lb_breaker_state{upstream=}`` (gauges; the breaker gauge's
+upstream label is the bounded replica NAME, never addr:port churn) —
+all labeled ``job=``.
 """
 
 from __future__ import annotations
@@ -84,10 +109,12 @@ from edl_tpu.runtime.frontdoor import (
     RESP_429,
     RESP_503,
     SERVING_ADDR_PREFIX,
+    CoordBootstrapError,
     FrontDoor,
     HeadMeta,
     HttpConn,
     LoopLagProbe,
+    bootstrap_kv,
     parse_serving_addr,
 )
 
@@ -169,13 +196,17 @@ class _Cell:
     hedge/rescue twins: whoever completes first takes it; later
     completions are consumed and discarded.  ``trace`` carries the
     block's :class:`_TraceCtx` (None on the unsampled steady state) so
-    a loser's late arrival still finds its duel's spans."""
+    a loser's late arrival still finds its duel's spans.  ``nonce``
+    carries the block's integrity token (injected into the first
+    request's head, echoed on the first response) — shared so hedge and
+    rescue twins, which resend the same bytes, expect the same echo."""
 
-    __slots__ = ("done", "trace")
+    __slots__ = ("done", "trace", "nonce")
 
     def __init__(self) -> None:
         self.done = False
         self.trace: Optional[_TraceCtx] = None
+        self.nonce: Optional[bytes] = None
 
 
 class _OutBlock:
@@ -183,7 +214,8 @@ class _OutBlock:
     on one upstream connection."""
 
     __slots__ = ("conn", "slot", "n", "remaining", "req_bytes", "t_sent",
-                 "t_admit", "cell", "kind", "acc", "hedged", "trace_rec")
+                 "t_admit", "cell", "kind", "acc", "hedged", "trace_rec",
+                 "probe_up", "errors")
 
     def __init__(self, conn, slot, n: int, req_bytes: bytes,
                  cell: _Cell, kind: str = "primary",
@@ -203,6 +235,9 @@ class _OutBlock:
         self.acc: list[bytes] = []    # response bytes, in order
         self.hedged = False
         self.trace_rec: Optional[dict] = None  # this dispatch's record
+        #: upstream name whose half-open breaker this dispatch probes
+        self.probe_up: Optional[str] = None
+        self.errors = 0               # 5xx / integrity hits credited here
 
 
 class _UpstreamConn(asyncio.Protocol):
@@ -293,12 +328,37 @@ class _UpstreamConn(asyncio.Protocol):
             return False
         raw = bytes(memoryview(buf)[:total])
         del buf[:total]
+        status_2xx = lower.startswith(b"http/1.1 2")
+        nonce = None
+        ni = lower.find(b"\r\nx-edl-block-nonce:")
+        if ni >= 0:
+            ne = lower.index(b"\r\n", ni + 2)
+            nonce = bytes(lower[ni + 20:ne].strip())
+        blk = self.expected[0] if self.expected else None
+        if blk is not None:
+            # the block's FIRST response must echo its nonce; later
+            # responses (and other blocks' responses) must not carry
+            # one.  A mismatch is a misroute / FIFO desync / corrupted
+            # payload — poison, never credited or forwarded.
+            want = blk.cell.nonce if blk.remaining == blk.n else None
+            if status_2xx and nonce != want:
+                self.lb.integrity_failure(
+                    self, blk,
+                    "missing echo" if nonce is None else "bad echo")
+                return False
+            if status_2xx:
+                self.up.breaker.record_ok()
+            elif lower.startswith(b"http/1.1 5"):
+                blk.errors += 1
+                self.up.breaker.record_error(why="5xx")
         # arm the fast path only on the STEADY-STATE head: a traced
-        # response's echoed X-EDL-Trace-Id head is unique to its
-        # request — arming on it would push every following (plain)
-        # response onto the slow parse until the next re-arm
+        # response's echoed X-EDL-Trace-Id head (or a nonce echo) is
+        # unique to its request — arming on it would push every
+        # following (plain) response onto the slow parse until the
+        # next re-arm
         if lower.startswith(b"http/1.1 200") and body_len \
-                and b"\r\nx-edl-trace-id:" not in lower:
+                and b"\r\nx-edl-trace-id:" not in lower \
+                and b"\r\nx-edl-block-nonce:" not in lower:
             self._fixed = (head, total)
         self._feed(raw, 1)
         return True
@@ -306,9 +366,16 @@ class _UpstreamConn(asyncio.Protocol):
     def _feed_uniform(self, chunk: bytes, count: int, stride: int) -> None:
         """``count`` uniform responses of ``stride`` bytes: fill the
         expected-block queue head-first, slicing per block."""
+        self.up.breaker.record_ok(count)  # armed head is a steady 200
         off = 0
         while count > 0 and self.expected:
             blk = self.expected[0]
+            if blk.cell.nonce is not None and blk.remaining == blk.n:
+                # the block's first response must carry the nonce echo,
+                # which can never match the armed steady head — a
+                # fast-path hit here means the stream desynced
+                self.lb.integrity_failure(self, blk, "missing echo")
+                return
             take = min(count, blk.remaining)
             blk.acc.append(chunk[off:off + take * stride]
                            if (off or take * stride != len(chunk))
@@ -339,13 +406,116 @@ class _UpstreamConn(asyncio.Protocol):
                 self.lb.block_done(blk, self.up.name)
 
 
+#: circuit breaker states — the gauge values of
+#: ``edl_lb_breaker_state{upstream=}`` (and what
+#: :mod:`~edl_tpu.runtime.faults` reads for its recovery predicates)
+BRK_CLOSED, BRK_OPEN, BRK_HALF = 0, 1, 2
+_BRK_NAMES = ("closed", "open", "half_open")
+
+
+class _Breaker:
+    """Per-upstream circuit breaker (doc/serving.md §gray-failure
+    defenses).  CLOSED → OPEN on ``breaker_errors`` consecutive errors
+    or a windowed error rate ≥ ``breaker_ratio`` over ≥ ``breaker_min``
+    responses; OPEN → HALF_OPEN when ``breaker_cooldown_s`` elapses
+    (ticked by the sweep); HALF_OPEN admits ONE probe block at a time
+    and re-CLOSEs after ``breaker_probes`` clean probes — any probe
+    failure re-OPENs.  Errors are 5xx responses, integrity failures,
+    severed connections, and request timeouts.  All mutation happens on
+    the door's loop thread; :meth:`routable` is pure attribute reads
+    (the scrape thread's gauge_fn path calls it)."""
+
+    __slots__ = ("lb", "name", "state", "consec", "win_n", "win_err",
+                 "win_t0", "open_until", "opened_at", "probe_inflight",
+                 "probe_ok")
+
+    def __init__(self, lb: "LBApp", name: str) -> None:
+        self.lb = lb
+        self.name = name
+        self.state = BRK_CLOSED
+        self.consec = 0
+        self.win_n = 0
+        self.win_err = 0
+        self.win_t0 = time.perf_counter()
+        self.open_until = 0.0
+        self.opened_at = 0.0
+        self.probe_inflight = 0
+        self.probe_ok = 0
+
+    def routable(self) -> bool:
+        if self.state == BRK_CLOSED:
+            return True
+        if self.state == BRK_HALF:
+            return self.probe_inflight == 0
+        return False
+
+    def record_ok(self, n: int = 1) -> None:
+        self.consec = 0
+        self.win_n += n
+
+    def record_error(self, n: int = 1, why: str = "") -> None:
+        now = time.perf_counter()
+        if now - self.win_t0 > self.lb.breaker_window_s:
+            self.win_t0 = now
+            self.win_n = 0
+            self.win_err = 0
+        self.consec += n
+        self.win_n += n
+        self.win_err += n
+        if self.state != BRK_CLOSED:
+            return
+        if self.consec >= self.lb.breaker_errors or (
+                self.win_n >= self.lb.breaker_min
+                and self.win_err / self.win_n >= self.lb.breaker_ratio):
+            self._trip(now, why)
+
+    def _trip(self, now: float, why: str) -> None:
+        self.open_until = now + self.lb.breaker_cooldown_s
+        self.opened_at = now
+        self._set(BRK_OPEN)
+        log.warn("breaker opened", upstream=self.name,
+                 why=why or "errors", consec=self.consec,
+                 window_err=self.win_err)
+        self.lb._on_breaker_open(self.name, why or "errors")
+
+    def tick(self, now: float) -> None:
+        if self.state == BRK_OPEN and now >= self.open_until:
+            self.probe_ok = 0
+            self.probe_inflight = 0
+            self._set(BRK_HALF)
+            log.info("breaker half-open", upstream=self.name)
+
+    def probe_result(self, ok: bool) -> None:
+        self.probe_inflight = max(self.probe_inflight - 1, 0)
+        if self.state != BRK_HALF:
+            return
+        if not ok:
+            self._trip(time.perf_counter(), "probe failed")
+            return
+        self.probe_ok += 1
+        if self.probe_ok >= self.lb.breaker_probes:
+            self.consec = 0
+            self.win_n = 0
+            self.win_err = 0
+            self._set(BRK_CLOSED)
+            log.info("breaker closed", upstream=self.name)
+
+    def _set(self, state: int) -> None:
+        self.state = state
+        self.lb._breaker_gauge.set(state, job=self.lb.job,
+                                   upstream=self.name)
+        self.lb._c.inc("lb_breaker_transitions", job=self.lb.job,
+                       to=_BRK_NAMES[state])
+
+
 class _Upstream:
-    """One replica as the LB sees it: address, gate state, conn pool."""
+    """One replica as the LB sees it: address, gate state, conn pool,
+    circuit breaker."""
 
     __slots__ = ("name", "addr", "state", "conns", "dialing", "last_seen",
-                 "requests")
+                 "requests", "breaker")
 
-    def __init__(self, name: str, addr: str) -> None:
+    def __init__(self, name: str, addr: str, lb: "LBApp") -> None:
         self.name = name
         self.addr = addr
         self.state = FD_READY
@@ -353,9 +523,11 @@ class _Upstream:
         self.dialing = 0
         self.last_seen = time.monotonic()
         self.requests = 0
+        self.breaker = _Breaker(lb, name)
 
     def routable(self) -> bool:
-        return self.state == FD_READY and bool(self.conns)
+        return (self.state == FD_READY and bool(self.conns)
+                and self.breaker.routable())
 
     def outstanding(self) -> int:
         return sum(c.outstanding_rows for c in self.conns)
@@ -383,7 +555,13 @@ class LBApp:
                  sweep_ms: float = 5.0, addr_grace_s: float = 5.0,
                  trace: bool = True, trace_sample: float = 0.01,
                  tail_slow_quantile: float = 0.99,
-                 slo_ms: float = 0.0) -> None:
+                 slo_ms: float = 0.0,
+                 breaker_errors: int = 5, breaker_ratio: float = 0.5,
+                 breaker_min: int = 20, breaker_window_s: float = 1.0,
+                 breaker_cooldown_s: float = 1.0, breaker_probes: int = 2,
+                 retry_budget_cap: float = 256.0,
+                 retry_ratio: float = 0.2, integrity: bool = True,
+                 flight_dir: str = "") -> None:
         self.job = job
         self.kv = kv
         self.static_upstreams = dict(static_upstreams or {})
@@ -399,6 +577,22 @@ class LBApp:
         self.high_cap = self.hard_cap + self.hard_cap // 4
         self.sweep_ms = float(sweep_ms)
         self.addr_grace_s = float(addr_grace_s)
+        # -- gray-failure defenses (doc/serving.md §gray-failure
+        # defenses): per-upstream circuit breakers, a fleet-wide resend
+        # token bucket, and per-block response-integrity nonces
+        self.breaker_errors = max(int(breaker_errors), 1)
+        self.breaker_ratio = float(breaker_ratio)
+        self.breaker_min = max(int(breaker_min), 1)
+        self.breaker_window_s = float(breaker_window_s)
+        self.breaker_cooldown_s = float(breaker_cooldown_s)
+        self.breaker_probes = max(int(breaker_probes), 1)
+        self.retry_budget_cap = float(retry_budget_cap)
+        self.retry_ratio = float(retry_ratio)
+        self._retry_tokens = self.retry_budget_cap
+        self.integrity = bool(integrity)
+        self._nonce_prefix = new_span_id()
+        self._nonce_seq = 0
+        self.flight_dir = str(flight_dir or "")
         self.door: Optional[FrontDoor] = None
         self.upstreams: dict[str, _Upstream] = {}
         self.outstanding_rows = 0
@@ -411,6 +605,7 @@ class LBApp:
         self._lat_n = 0
         self._lat_i = 0
         self._discovery: Optional[threading.Thread] = None
+        self._disc_frozen = False
         self._halt = threading.Event()
         self._sweep_handle = None
         self._sweep_n = 0
@@ -448,6 +643,17 @@ class LBApp:
         self._hedge_gauge = reg.gauge(
             "lb_hedge_delay_ms",
             help="current p99-derived hedge delay")
+        self._breaker_gauge = reg.gauge(
+            "lb_breaker_state",
+            help="per-upstream circuit breaker: 0 closed / 1 open / "
+                 "2 half-open")
+        # zero-sample pre-registration: the strict exposition parser
+        # (and the dashboards) see every defense series from scrape #1
+        self._c.inc("lb_integrity_failures", 0, job=job)
+        self._c.inc("lb_retry_budget_exhausted", 0, job=job)
+        self._c.inc("lb_discovery_freezes", 0, job=job)
+        for to in _BRK_NAMES:
+            self._c.inc("lb_breaker_transitions", 0, job=job, to=to)
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -487,12 +693,34 @@ class LBApp:
                 self._c.inc("lb_discovery_sweeps", job=self.job)
                 self.door.call_soon(self._apply_targets, targets)
             except Exception as exc:
-                log.warn("discovery sweep failed", error=str(exc)[:120])
+                # a coordinator partition: _apply_targets never runs,
+                # so addr_grace_s aging is implicitly frozen — serving
+                # continues on last-known addresses
+                self._c.inc("lb_discovery_freezes", job=self.job)
+                log.warn("discovery sweep failed; aging frozen",
+                         error=str(exc)[:120])
 
     def _apply_targets(self, targets: dict) -> None:
         now = time.monotonic()
         for name, (addr, state) in targets.items():
             self._apply_target(name, addr, state, now)
+        if not targets and any(n not in self.static_upstreams
+                               for n in self.upstreams):
+            # EVERY dynamic target vanished in one sweep — that is a
+            # coordinator partition or KV wipe (server-side TTL expiry
+            # after a partition heals), not a fleet-wide replica death:
+            # freeze aging and keep serving on last-known addresses.
+            # The next non-empty sweep refreshes last_seen and re-arms
+            # addr_grace_s aging.
+            self._c.inc("lb_discovery_freezes", job=self.job)
+            if not self._disc_frozen:
+                self._disc_frozen = True
+                log.warn("discovery returned no targets; aging frozen",
+                         upstreams=len(self.upstreams))
+            return
+        if targets and self._disc_frozen:
+            self._disc_frozen = False
+            log.info("discovery recovered; aging re-armed")
         # a replica that vanished from KV (TTL expiry after a kill, or a
         # clean unpublish) is dropped after a short grace; its dead
         # connections already rescued their blocks on connection_lost
@@ -507,15 +735,25 @@ class LBApp:
                     except Exception:
                         pass
                 del self.upstreams[name]
+                try:
+                    self._breaker_gauge.remove(job=self.job,
+                                               upstream=name)
+                except Exception:
+                    pass
                 log.info("upstream dropped", upstream=name)
 
     def _apply_target(self, name: str, addr: str, state: str,
                       now: Optional[float] = None) -> None:
         up = self.upstreams.get(name)
         if up is None:
-            up = _Upstream(name, addr)
+            up = _Upstream(name, addr, self)
             up.state = state
             self.upstreams[name] = up
+            # pin the breaker series at discovery: bounded label set
+            # (replica name), visible to the strict parser before the
+            # first transition
+            self._breaker_gauge.set(BRK_CLOSED, job=self.job,
+                                    upstream=name)
             log.info("upstream discovered", upstream=name, addr=addr,
                      state=state)
         else:
@@ -562,6 +800,11 @@ class LBApp:
             self._paused_conns.add(conn)
             return
         self._c.inc("lb_requests", n, job=self.job)
+        # every admitted block refills the resend token bucket a little
+        # — the budget scales with real traffic, not wall time
+        if self._retry_tokens < self.retry_budget_cap:
+            self._retry_tokens = min(self.retry_budget_cap,
+                                     self._retry_tokens + self.retry_ratio)
         if not meta.keep_alive:  # rare: off the byte-identical hot path
             raw = _strip_hop_headers(raw, meta, n)
         ctx: Optional[_TraceCtx] = None
@@ -578,9 +821,23 @@ class LBApp:
                     ctx = _TraceCtx(new_trace_id(), n, "head")
                     raw = _inject_trace_headers(raw, ctx.tid,
                                                 ctx.root_sid)
+        nonce = None
+        if self.integrity:
+            # per-block integrity nonce: rides the FIRST request's head
+            # (one slow parse at the replica, like a trace header), must
+            # echo on the block's first response.  Resends reuse
+            # req_bytes, so hedge/rescue twins expect the same echo.
+            self._nonce_seq += 1
+            i = raw.find(b"\r\n\r\n")
+            if i >= 0:
+                nonce = (f"{self._nonce_prefix}-{self._nonce_seq:x}"
+                         .encode("latin1"))
+                raw = (raw[:i + 2] + b"X-EDL-Block-Nonce: " + nonce
+                       + b"\r\n" + raw[i + 2:])
         slot = conn.push_slot(n)
         blk = _OutBlock(conn, slot, n, raw, _Cell())
         blk.cell.trace = ctx
+        blk.cell.nonce = nonce
         self.outstanding_rows += n
         self._dispatch(blk)
 
@@ -751,6 +1008,11 @@ class LBApp:
                 (blk.t_admit + self.request_timeout_s, blk))
             return
         up.requests += blk.n
+        if up.breaker.state == BRK_HALF:
+            # this dispatch IS the half-open probe: one at a time —
+            # routable() holds further traffic until it settles
+            up.breaker.probe_inflight += 1
+            blk.probe_up = up.name
         blk.t_sent = time.perf_counter()
         if blk.cell.trace is not None:
             self._trace_dispatch(blk.cell.trace, blk, up.name)
@@ -760,6 +1022,9 @@ class LBApp:
 
     def block_done(self, blk: _OutBlock,
                    up_name: Optional[str] = None) -> None:
+        # a fully-credited block settles its half-open probe (winner or
+        # discarded loser alike: the upstream answered)
+        self._probe_settle(blk, True)
         ctx = blk.cell.trace
         if blk.cell.done:
             # consumed but discarded: ONLY a hedge-duel participant
@@ -822,6 +1087,73 @@ class LBApp:
         self._lat_i = (self._lat_i + 1) % len(self._lat_ring)
         self._lat_n = min(self._lat_n + 1, len(self._lat_ring))
 
+    # -- gray-failure defenses -----------------------------------------------
+
+    def _probe_settle(self, blk: _OutBlock, ok: bool) -> None:
+        """Settle a half-open probe dispatch exactly once: clean
+        completion re-admits (after ``breaker_probes`` of them), any
+        error / sever / timeout re-opens."""
+        name = blk.probe_up
+        if name is None:
+            return
+        blk.probe_up = None
+        up = self.upstreams.get(name)
+        if up is not None:
+            up.breaker.probe_result(ok and blk.errors == 0)
+
+    def _retry_spend(self, blk: _OutBlock, kind: str) -> bool:
+        """Take one resend token (a hedge twin or rescue resend).
+        Exhaustion degrades to single-send — counted, flight-recorded,
+        never amplified into a resend storm."""
+        if self._retry_tokens >= 1.0:
+            self._retry_tokens -= 1.0
+            return True
+        self._c.inc("lb_retry_budget_exhausted", job=self.job)
+        if self.flight_dir:
+            try:
+                dump_flight_record(
+                    self.flight_dir, "lb-retry-budget",
+                    extra={"kind": kind, "n": blk.n,
+                           "outstanding_rows": self.outstanding_rows},
+                    cooldown_s=30.0)
+            except Exception:
+                pass
+        return False
+
+    def integrity_failure(self, conn: _UpstreamConn, blk: _OutBlock,
+                          why: str) -> None:
+        """A response that fails the nonce-echo check is connection
+        poisoning: never credited, never forwarded.  Abort the
+        connection so every in-flight block on it (this one included)
+        rescues onto a healthy replica — the client still gets a
+        correct payload, the breaker hears an error."""
+        self._c.inc("lb_integrity_failures", job=self.job)
+        blk.errors += 1
+        conn.up.breaker.record_error(why="integrity")
+        log.warn("response integrity failure", upstream=conn.up.name,
+                 why=why)
+        conn._buf.clear()
+        conn._fixed = None
+        try:
+            conn.transport.abort()
+        except Exception:
+            try:
+                conn.transport.close()
+            except Exception:
+                pass
+
+    def _on_breaker_open(self, name: str, why: str) -> None:
+        if not self.flight_dir:
+            return
+        try:
+            dump_flight_record(
+                self.flight_dir, "lb-breaker-open",
+                extra={"upstream": name, "why": why,
+                       "exemplars": list(self.exemplars)[-20:]},
+                cooldown_s=30.0)
+        except Exception:
+            pass
+
     # -- upstream failure ----------------------------------------------------
 
     def on_upstream_conn_lost(self, conn: _UpstreamConn) -> None:
@@ -830,9 +1162,25 @@ class LBApp:
         latency, never an error."""
         blocks = list(conn.expected)
         conn.expected.clear()
+        if blocks and not self._halt.is_set():
+            # a sever with work in flight is a breaker error; an idle
+            # close (drain, pool recycle) is not
+            conn.up.breaker.record_error(why="conn lost")
         for blk in blocks:
             conn.outstanding_rows -= blk.remaining
+            self._probe_settle(blk, False)
             if blk.cell.done:
+                continue
+            if not self._retry_spend(blk, "rescue"):
+                # budget exhausted: fail fast (degrade to the single
+                # send that just died) rather than join a resend storm
+                blk.cell.done = True
+                self.outstanding_rows -= blk.n
+                self._c.inc("lb_timeouts", blk.n, job=self.job)
+                if not blk.conn.closed:
+                    blk.conn.complete(blk.slot, RESP_503 * blk.n)
+                self._trace_timeout(blk, time.perf_counter(),
+                                    conn.up.name)
                 continue
             resend_bytes = blk.req_bytes
             if self.trace_enabled:
@@ -871,6 +1219,9 @@ class LBApp:
     def _sweep(self) -> None:
         try:
             now = time.perf_counter()
+            # breaker cooldowns: OPEN → HALF_OPEN on the loop thread
+            for up in self.upstreams.values():
+                up.breaker.tick(now)
             # refresh the p99-derived hedge delay — every ~20th sweep:
             # a full-ring np.quantile per 5 ms sweep would be 200
             # sorts/s on the routing thread, for a threshold that only
@@ -916,6 +1267,13 @@ class LBApp:
                             # hedge marked-but-never-sent would wait
                             # out the full request timeout
                             continue
+                        if not self._retry_spend(blk, "hedge"):
+                            # budget exhausted: this block degrades to
+                            # single-send for good — marking it hedged
+                            # stops every later sweep re-burning the
+                            # exhaustion counter on the same straggler
+                            blk.hedged = True
+                            continue
                         blk.hedged = True
                         hedge_bytes = blk.req_bytes
                         if self.trace_enabled:
@@ -937,6 +1295,9 @@ class LBApp:
                         hedge.hedged = True
                         self._c.inc("lb_hedges_fired", blk.n, job=self.job)
                         target.requests += blk.n
+                        if target.breaker.state == BRK_HALF:
+                            target.breaker.probe_inflight += 1
+                            hedge.probe_up = target.name
                         if hedge.cell.trace is not None:
                             self._trace_dispatch(hedge.cell.trace,
                                                  hedge, target.name)
@@ -970,6 +1331,8 @@ class LBApp:
                         blk = conn.expected.popleft()
                         conn.outstanding_rows -= blk.remaining
                         expired = True
+                        self._probe_settle(blk, False)
+                        up.breaker.record_error(why="timeout")
                         if blk.cell.done:
                             continue
                         blk.cell.done = True
@@ -1048,10 +1411,23 @@ def _lb_main(env) -> int:
     import os
     import signal
 
-    from edl_tpu.coord.client import client_from_env
-
     job = env.get("EDL_LB_JOB", "default/serving")
-    kv = client_from_env(env, disabled="discovery disabled")
+    flight_dir = env.get("EDL_FLIGHTREC_DIR", "")
+    try:
+        # jittered-backoff probe under EDL_COORD_BOOTSTRAP_DEADLINE_S:
+        # a down coordinator at pod start fails loudly (exit 3, the
+        # supervisor restart marker) instead of hanging past the
+        # readiness budget
+        kv = bootstrap_kv(env, disabled="discovery disabled")
+    except CoordBootstrapError as exc:
+        print(f"lb FAILED (coordinator bootstrap: {exc})", flush=True)
+        if flight_dir:
+            try:
+                dump_flight_record(flight_dir, "lb-coord-bootstrap",
+                                   extra={"error": str(exc)})
+            except Exception:
+                pass
+        return 3
     static = {}
     for i, addr in enumerate(
             a for a in env.get("EDL_LB_UPSTREAMS", "").split(",") if a):
@@ -1071,9 +1447,19 @@ def _lb_main(env) -> int:
         sweep_ms=float(env.get("EDL_LB_SWEEP_MS", "5")),
         trace=trace_sample >= 0,
         trace_sample=max(trace_sample, 0.0),
-        slo_ms=float(env.get("EDL_LB_SLO_MS", "0")))
+        slo_ms=float(env.get("EDL_LB_SLO_MS", "0")),
+        breaker_errors=int(env.get("EDL_LB_BREAKER_ERRORS", "5")),
+        breaker_ratio=float(env.get("EDL_LB_BREAKER_RATIO", "0.5")),
+        breaker_min=int(env.get("EDL_LB_BREAKER_MIN", "20")),
+        breaker_window_s=float(env.get("EDL_LB_BREAKER_WINDOW_S", "1")),
+        breaker_cooldown_s=float(
+            env.get("EDL_LB_BREAKER_COOLDOWN_S", "1")),
+        breaker_probes=int(env.get("EDL_LB_BREAKER_PROBES", "2")),
+        retry_budget_cap=float(env.get("EDL_LB_RETRY_BUDGET", "256")),
+        retry_ratio=float(env.get("EDL_LB_RETRY_RATIO", "0.2")),
+        integrity=env.get("EDL_LB_INTEGRITY", "1") != "0",
+        flight_dir=flight_dir)
     lb.start()
-    flight_dir = env.get("EDL_FLIGHTREC_DIR", "")
     trace_dir = env.get("EDL_TRACE_DIR", "")
     sink = probe = None
     if trace_dir:

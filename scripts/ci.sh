@@ -1021,6 +1021,194 @@ finally:
         door.stop()
 EOF
 
+echo "== chaos smoke (gray replica: breaker eject → half-open re-admit, nonce integrity, zero wrong payloads)"
+# The serving-plane gray-failure tripwire (doc/fault_drills.md §serving,
+# doc/serving.md §gray-failure defenses): a replica turned gray in
+# error mode must be EJECTED by the LB circuit breaker with the client
+# seeing only correct 200s (rescue resends mask the blast), then
+# re-admitted through a half-open probe once the drill lapses; a
+# corrupt-mode gray must be caught by the per-block response nonce —
+# never forwarded.  Every defense series must render for the strict
+# exposition parser from scrape #1 (zero-sample pre-registration).
+JAX_PLATFORMS=cpu python - <<'EOF'
+import tempfile, threading, time, socket, re
+import numpy as np, jax
+
+from edl_tpu.models import mlp
+from edl_tpu.observability.collector import get_counters
+from edl_tpu.observability.metrics import get_registry, parse_exposition
+from edl_tpu.runtime.serving import ElasticServer
+from edl_tpu.runtime.frontdoor import (BatchApp, FrontDoor,
+                                       build_predict_request)
+from edl_tpu.runtime.lb import BRK_CLOSED, BRK_OPEN, ServingLB
+
+JOB = "ci/chaos"
+SIZES = [8, 16, 4]
+params = mlp.init(jax.random.key(0), SIZES)
+row = np.ones((SIZES[0],), np.float32)
+expect = np.asarray(mlp.apply(params, row[None]))[0]
+req = build_predict_request(row)
+
+class KV:  # in-process stand-in for the coordinator KV verbs used here
+    def __init__(self): self.d, self.l = {}, threading.Lock()
+    def kv_set(self, k, v):
+        with self.l: self.d[k] = bytes(v)
+    def kv_get(self, k):
+        with self.l: return self.d.get(k)
+    def kv_del(self, k):
+        with self.l: return self.d.pop(k, None) is not None
+    def kv_keys(self, p=""):
+        with self.l: return [k for k in self.d if k.startswith(p)]
+
+kv = KV()
+def build():
+    return ElasticServer(lambda p, b: mlp.apply(p, b[0]), params)
+apps, doors = {}, {}
+for name in ("ra", "rb"):
+    apps[name] = BatchApp(build, SIZES[0], job=JOB, replica=name, kv=kv,
+                          max_batch=32, max_queue_ms=1.0, addr_ttl_s=10.0)
+    doors[name] = FrontDoor(apps[name], host="127.0.0.1",
+                            job=f"{JOB}/{name}").start()
+for app in apps.values():
+    assert app.wait_ready(120)
+# hedging parked far out of reach: every resend below is the breaker /
+# rescue machinery acting, not the tail-latency hedger
+lb = ServingLB(job=JOB, host="127.0.0.1", kv=kv, pool=2, discovery_s=0.1,
+               sweep_ms=3.0, hedge_floor_ms=60000.0, hedge_cap_ms=60000.0,
+               breaker_errors=3, breaker_min=1000, breaker_window_s=0.5,
+               breaker_cooldown_s=0.3, breaker_probes=1,
+               flight_dir=tempfile.mkdtemp(prefix="edl-ci-chaos-")).start()
+deadline = time.monotonic() + 30
+while time.monotonic() < deadline and sum(
+        1 for u in lb.app.upstreams.values() if u.routable()) < 2:
+    time.sleep(0.05)
+assert sum(1 for u in lb.app.upstreams.values() if u.routable()) == 2
+
+def read_bodies(s, n, timeout=30.0):
+    s.settimeout(timeout); buf = b""; out = []
+    while len(out) < n:
+        i = buf.find(b"\r\n\r\n")
+        if i < 0:
+            buf += s.recv(1 << 20); continue
+        head = buf[:i + 4]
+        st = int(head.split(b" ", 2)[1])
+        cl = int(re.search(rb"[Cc]ontent-[Ll]ength: (\d+)", head).group(1))
+        while len(buf) < i + 4 + cl:
+            buf += s.recv(1 << 20)
+        out.append((st, buf[i + 4:i + 4 + cl])); buf = buf[i + 4 + cl:]
+    return out
+
+wrong = [0]
+def burst(k=8, allow_500=False):
+    # two CONCURRENT pipelined bursts so the least-outstanding picker
+    # spreads load over both upstreams (and a half-open probe can route)
+    def one(res, slot):
+        s = socket.create_connection(("127.0.0.1", lb.port), timeout=30)
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        try:
+            s.sendall(req * k)
+            res[slot] = read_bodies(s, k)
+        finally:
+            s.close()
+    res = [None, None]
+    ts = [threading.Thread(target=one, args=(res, j)) for j in (0, 1)]
+    for t in ts: t.start()
+    for t in ts: t.join()
+    n500 = 0
+    for got in res:
+        assert got is not None, "burst reader died"
+        for st, body in got:
+            if st == 500 and allow_500:
+                n500 += 1; continue  # honest 5xx: breaker food, not lies
+            assert st == 200, st
+            out = np.frombuffer(body, "<f4")
+            if out.shape != expect.shape or not np.allclose(
+                    out, expect, atol=1e-4):
+                wrong[0] += 1
+    return n500
+
+c = get_counters()
+try:
+    burst()  # clean warmup: both breakers CLOSED, payloads verified
+
+    # (a) error-mode gray on ra → consecutive honest 500s trip the
+    # breaker; once OPEN the gray replica is off the routable set, so
+    # the 5xx blast is BOUNDED by the trip threshold, never masked into
+    # a wrong 200 — every 200 in the drill still carries the right bytes
+    GRAY_S = 2.0
+    apps["ra"].set_gray(1.0, "error", GRAY_S)
+    t0 = time.monotonic()
+    blast = 0
+    while (lb.app.upstreams["ra"].breaker.state != BRK_OPEN
+           and time.monotonic() - t0 < 10):
+        blast += burst(allow_500=True)
+    assert lb.app.upstreams["ra"].breaker.state == BRK_OPEN, \
+        "breaker never ejected the gray replica"
+    eject_ms = (time.monotonic() - t0) * 1000.0
+    assert blast > 0, "error drill never surfaced a 5xx"
+    # ejected: while the drill still burns, traffic routes around ra
+    assert burst(allow_500=True) == 0, "5xx after ejection"
+
+    # (b) drill lapses → half-open probe → re-admit (CLOSED again)
+    time.sleep(max(0.0, GRAY_S - (time.monotonic() - t0)) + 0.35)
+    t1 = time.monotonic()
+    while (lb.app.upstreams["ra"].breaker.state != BRK_CLOSED
+           and time.monotonic() - t1 < 15):
+        burst(); time.sleep(0.05)
+    assert lb.app.upstreams["ra"].breaker.state == BRK_CLOSED, \
+        "half-open probe never re-admitted the recovered replica"
+    for to in ("open", "half_open", "closed"):
+        assert c.get("lb_breaker_transitions", job=JOB, to=to) > 0, to
+
+    # (c) corrupt-mode gray on rb → the per-block nonce catches the
+    # forged echo; the poisoned connection is abandoned and the block
+    # rescued — the corruption NEVER reaches a client
+    i0 = c.get("lb_integrity_failures", job=JOB)
+    apps["rb"].set_gray(1.0, "corrupt", 0.8)
+    t2 = time.monotonic()
+    while (c.get("lb_integrity_failures", job=JOB) == i0
+           and time.monotonic() - t2 < 10):
+        burst()
+    assert c.get("lb_integrity_failures", job=JOB) > i0, \
+        "corrupt gray never tripped the nonce check"
+    time.sleep(0.9)
+    burst()  # post-drill: fleet serves clean again
+
+    assert wrong[0] == 0, f"{wrong[0]} wrong payloads reached a client"
+
+    # (d) every defense series renders under the strict parser from a
+    # single scrape — breaker state per upstream with a BOUNDED label
+    # set, transitions, integrity, retry budget, brownout
+    series = parse_exposition(get_registry().render())
+    ups = {m.group(1) for k in series
+           for m in [re.match(
+               r'edl_lb_breaker_state\{.*upstream="([^"]+)"', k)] if m}
+    assert ups == {"ra", "rb"}, ups
+    for need in ("edl_lb_breaker_transitions_total",
+                 "edl_lb_integrity_failures_total",
+                 "edl_lb_retry_budget_exhausted_total",
+                 "edl_lb_discovery_freezes_total",
+                 "edl_frontdoor_brownout_seconds_total",
+                 "edl_frontdoor_gray_responses_total"):
+        assert any(k == need or k.startswith(need + "{")
+                   for k in series), (need, sorted(series)[:40])
+
+    print("chaos smoke OK:", {
+        "wrong_payloads": 0,
+        "drill_500s": blast,
+        "breaker_eject_ms": round(eject_ms, 1),
+        "breaker_transitions": {
+            to: int(c.get("lb_breaker_transitions", job=JOB, to=to))
+            for to in ("open", "half_open", "closed")},
+        "integrity_failures":
+            int(c.get("lb_integrity_failures", job=JOB)),
+        "rescues": int(c.get("lb_rescues", job=JOB))})
+finally:
+    lb.stop()
+    for door in doors.values():
+        door.stop()
+EOF
+
 echo "== sched smoke (goodput objective vs count packing through the real planner)"
 python - <<'EOF'
 # Fast tripwire for the goodput-driven multi-tenant scheduler

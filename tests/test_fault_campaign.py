@@ -74,8 +74,12 @@ def test_fault_plan_same_seed_same_campaign():
 
 
 def test_fault_plan_covers_all_kinds_with_spacing():
+    # kinds= spans training AND serving: the default is the training
+    # eight (seeded training campaigns stay bit-identical as the kind
+    # registry grows)
     plan = FaultPlan.random(7, n_faults=len(ACTION_TYPES), first_step=10,
-                            last_step=100, min_gap=8)
+                            last_step=100, min_gap=8,
+                            kinds=tuple(ACTION_TYPES))
     kinds = [d["kind"] for d in plan.describe()]
     assert sorted(kinds) == sorted(ACTION_TYPES)
     steps = [d["at_step"] for d in plan.describe()]

@@ -417,7 +417,10 @@ def test_request_timeout_kills_desynced_upstream_conn():
         job="lbtest/timeout", host="127.0.0.1",
         static_upstreams={"r0": f"127.0.0.1:{srv.getsockname()[1]}"},
         pool=1, sweep_ms=5.0, hedge_floor_ms=60_000.0,
-        hedge_cap_ms=60_000.0, request_timeout_s=0.3).start()
+        hedge_cap_ms=60_000.0, request_timeout_s=0.3,
+        # the hand-rolled socket upstream can't echo nonces; this test
+        # pins the timeout/desync kill, not response integrity
+        integrity=False).start()
     try:
         deadline = time.monotonic() + 10
         while time.monotonic() < deadline and not accepted:

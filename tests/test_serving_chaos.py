@@ -1,0 +1,723 @@
+"""Serving-plane chaos drills (the ISSUE-16 robustness PR): circuit
+breakers ejecting gray replicas and re-admitting them through half-open
+probes, the retry budget degrading hedges instead of amplifying load,
+end-to-end response-integrity nonces catching corrupted payloads,
+front-door brownout with hysteresis, discovery freezing (not aging out
+the fleet) under a coordinator partition, the exit-3 bootstrap marker —
+and the seeded multi-fault soak that runs all five serving fault kinds
+concurrently under live traffic and proves ZERO wrong payloads."""
+
+import glob
+import os
+import random
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402,F401
+
+from edl_tpu.models import mlp  # noqa: E402
+from edl_tpu.observability.collector import get_counters  # noqa: E402
+from edl_tpu.observability.metrics import (  # noqa: E402
+    get_registry,
+    parse_exposition,
+)
+from edl_tpu.runtime.faults import (  # noqa: E402
+    SERVING_KINDS,
+    ChaosProxy,
+    FaultContext,
+    FaultPlan,
+    FaultPlanEngine,
+    GrayReplica,
+)
+from edl_tpu.runtime.frontdoor import (  # noqa: E402
+    SERVING_ADDR_PREFIX,
+    BatchApp,
+    CoordBootstrapError,
+    FrontDoor,
+    bootstrap_kv,
+    build_predict_request,
+    format_serving_addr,
+    replica_main,
+)
+from edl_tpu.runtime.lb import (  # noqa: E402
+    BRK_CLOSED,
+    BRK_OPEN,
+    ServingLB,
+    lb_main,
+)
+
+from tests.test_frontdoor import connect, read_responses  # noqa: E402
+from tests.test_lb import PARAMS, SIZES, FakeKV, spin_replica  # noqa: E402
+
+_REF: dict[float, np.ndarray] = {}
+
+
+def ref_out(v: float) -> np.ndarray:
+    """The ground-truth model output for a constant-``v`` row — what a
+    response body must decode to, or it counts as a WRONG payload."""
+    if v not in _REF:
+        _REF[v] = np.asarray(
+            mlp.apply(PARAMS, np.full((1, SIZES[0]), v, np.float32)))[0]
+    return _REF[v]
+
+
+def payload_ok(body: bytes, v: float) -> bool:
+    out = np.frombuffer(body, "<f4")
+    exp = ref_out(v)
+    return out.shape == exp.shape and bool(np.allclose(out, exp, atol=1e-4))
+
+
+class PartitionableKV(FakeKV):
+    """FakeKV whose discovery reads can be severed for a window — the
+    raising mode models the coordinator RPC timing out mid-partition,
+    the empty mode models a server-side KV wipe (TTL expiry after the
+    partition heals before the replicas republish)."""
+
+    def __init__(self):
+        super().__init__()
+        self._until = 0.0
+        self._mode = "raise"
+
+    def partition(self, duration_s, mode="raise"):
+        self._mode = mode
+        self._until = time.monotonic() + duration_s
+
+    def partitioned(self):
+        return time.monotonic() < self._until
+
+    def kv_keys(self, prefix=""):
+        if self.partitioned():
+            if self._mode == "raise":
+                raise OSError("coordinator unreachable (injected)")
+            return []
+        return super().kv_keys(prefix)
+
+
+def wait_routable(lb, n, deadline_s=30.0):
+    end = time.monotonic() + deadline_s
+    while time.monotonic() < end:
+        if sum(1 for u in lb.app.upstreams.values() if u.routable()) >= n:
+            return True
+        time.sleep(0.05)
+    return False
+
+
+# ---------------------------------------------------------------------------
+# Breaker lifecycle + response integrity (one two-replica fleet)
+# ---------------------------------------------------------------------------
+
+
+class TestBreakerAndIntegrity:
+    """Gray replica ra behind a breaker-armed LB: error-mode grays trip
+    the breaker (eject → half-open probe → re-admit), corrupt-mode grays
+    are caught by the per-block nonce and masked by rescue resends —
+    the client NEVER sees a wrong payload."""
+
+    JOB = "chaos/fleet"
+
+    @classmethod
+    def setup_class(cls):
+        import tempfile
+
+        cls.kv = FakeKV()
+        cls.app_a, cls.door_a = spin_replica(cls.kv, cls.JOB, "ra")
+        cls.app_b, cls.door_b = spin_replica(cls.kv, cls.JOB, "rb")
+        cls.flight = tempfile.mkdtemp(prefix="edl-chaos-flight-")
+        # hedging off (floor=cap=60 s): these drills pin the breaker and
+        # the nonce check, not hedge masking
+        cls.lb = ServingLB(
+            job=cls.JOB, host="127.0.0.1", kv=cls.kv, pool=2,
+            discovery_s=0.1, sweep_ms=3.0,
+            hedge_floor_ms=60000.0, hedge_cap_ms=60000.0,
+            request_timeout_s=20.0,
+            breaker_errors=3, breaker_ratio=0.5, breaker_min=10,
+            breaker_window_s=0.5, breaker_cooldown_s=0.25,
+            breaker_probes=1, flight_dir=cls.flight).start()
+        assert wait_routable(cls.lb, 2), cls.lb.app.upstreams
+
+    @classmethod
+    def teardown_class(cls):
+        cls.lb.stop()
+        cls.door_a.stop()
+        cls.door_b.stop()
+
+    # two concurrent bursts so BOTH upstreams take load each round (the
+    # least-outstanding picker would otherwise tie-break to one) — this
+    # is also what routes the half-open probe to the recovering replica
+    def _round(self, v=1.0, k=8):
+        out = []
+        s1, s2 = connect(self.lb.port), connect(self.lb.port)
+        try:
+            req = build_predict_request(
+                np.full((SIZES[0],), v, np.float32))
+            s1.sendall(req * k)
+            s2.sendall(req * k)
+            out.extend(read_responses(s1, k, timeout=30))
+            out.extend(read_responses(s2, k, timeout=30))
+        finally:
+            s1.close()
+            s2.close()
+        return out
+
+    def _breaker(self, name):
+        up = self.lb.app.upstreams.get(name)
+        return None if up is None else up.breaker.state
+
+    def _drive_until(self, predicate, deadline_s=15.0, v=1.0):
+        wrong = errs = total = 0
+        end = time.monotonic() + deadline_s
+        while time.monotonic() < end:
+            for st, body in self._round(v=v):
+                total += 1
+                if st == 200:
+                    if not payload_ok(body, v):
+                        wrong += 1
+                else:
+                    errs += 1
+            if predicate():
+                return wrong, errs, total
+            time.sleep(0.01)
+        raise AssertionError(
+            f"predicate never held (breaker={self._breaker('ra')}, "
+            f"total={total}, errs={errs})")
+
+    def test_error_gray_trips_breaker_then_half_open_readmit(self):
+        c = get_counters()
+        trans0 = {t: c.get("lb_breaker_transitions", job=self.JOB, to=t)
+                  for t in ("open", "half_open", "closed")}
+        self.app_a.set_gray(1.0, "error", duration_s=2.0)
+        wrong, _, _ = self._drive_until(
+            lambda: self._breaker("ra") == BRK_OPEN)
+        assert wrong == 0
+        assert c.get("lb_breaker_transitions", job=self.JOB,
+                     to="open") > trans0["open"]
+        # the ejection left a post-mortem on disk (PR 11 flight path)
+        assert glob.glob(os.path.join(self.flight, "*lb-breaker-open*"))
+        # while OPEN, traffic lands on rb only: all 200s, all correct
+        for st, body in self._round():
+            assert st == 200 and payload_ok(body, 1.0)
+        # gray window lapses → cooldown → HALF (sweep flips it with no
+        # traffic needed) → the next round's probe closes it
+        time.sleep(2.0)
+        wrong, errs, _ = self._drive_until(
+            lambda: self._breaker("ra") == BRK_CLOSED)
+        assert wrong == 0
+        assert c.get("lb_breaker_transitions", job=self.JOB,
+                     to="half_open") > trans0["half_open"]
+        assert c.get("lb_breaker_transitions", job=self.JOB,
+                     to="closed") > trans0["closed"]
+        # re-admitted: both upstreams routable again
+        assert wait_routable(self.lb, 2)
+
+    def test_metrics_render_strict_with_bounded_labels(self):
+        """The new series render through the strict 0.0.4 parser, and
+        the breaker gauge's upstream label set is exactly the replica
+        names — no per-request/per-nonce cardinality leak."""
+        text = get_registry().render()
+        series = parse_exposition(text)  # raises on grammar violations
+        ups = set()
+        for key in series:
+            # scope to THIS fleet's job: the registry is process-wide
+            # and other suites' LBs legitimately own their own series
+            if (key.startswith("edl_lb_breaker_state{")
+                    and f'job="{self.JOB}"' in key):
+                for part in key[key.index("{") + 1:-1].split(","):
+                    k, _, val = part.partition("=")
+                    if k == "upstream":
+                        ups.add(val.strip('"'))
+        assert ups and ups <= {"ra", "rb"}, ups
+        assert any(k.startswith("edl_lb_breaker_transitions_total")
+                   for k in series)
+        assert any(k.startswith("edl_lb_integrity_failures_total")
+                   for k in series)
+        assert any(k.startswith("edl_lb_retry_budget_exhausted_total")
+                   for k in series)
+        assert any(k.startswith("edl_frontdoor_brownout_seconds_total")
+                   for k in series)
+
+    def test_corrupt_gray_caught_by_nonce_zero_wrong_payloads(self):
+        """mode="corrupt" answers 200s with garbage bodies and a wrong
+        nonce echo — undetectable by status code.  The LB's integrity
+        check must poison the connection and rescue the block to the
+        healthy replica: every client response correct, zero wrong."""
+        c = get_counters()
+        integ0 = c.get("lb_integrity_failures", job=self.JOB)
+        self.app_a.set_gray(1.0, "corrupt", duration_s=1.0)
+        deadline = time.monotonic() + 1.2
+        wrong = total = 0
+        while time.monotonic() < deadline:
+            for st, body in self._round(v=2.0):
+                total += 1
+                # corruption is MASKED, not surfaced: rescue resends mean
+                # the client sees a correct 200, never the garbage
+                assert st == 200, st
+                if not payload_ok(body, 2.0):
+                    wrong += 1
+        assert wrong == 0 and total >= 32
+        assert c.get("lb_integrity_failures", job=self.JOB) > integ0
+        # let the breaker re-admit ra before the next test reuses it
+        self._drive_until(lambda: self._breaker("ra") == BRK_CLOSED,
+                          v=2.0)
+
+
+# ---------------------------------------------------------------------------
+# Retry budget
+# ---------------------------------------------------------------------------
+
+
+def test_retry_budget_exhaustion_degrades_hedges(tmp_path):
+    """With a zero retry budget and a near-zero hedge delay, every
+    would-be hedge must degrade to single-send: answers stay correct,
+    the exhaustion counter moves, and a flight record lands on disk —
+    no retry-storm amplification."""
+    kv = FakeKV()
+    app_a, door_a = spin_replica(kv, "chaos/budget", "r0")
+    app_b, door_b = spin_replica(kv, "chaos/budget", "r1")
+    lb = ServingLB(
+        job="chaos/budget", host="127.0.0.1", kv=kv, pool=2,
+        discovery_s=0.1, sweep_ms=2.0,
+        hedge_floor_ms=0.1, hedge_cap_ms=0.1,
+        request_timeout_s=20.0,
+        retry_budget_cap=0.0, retry_ratio=0.0,
+        flight_dir=str(tmp_path)).start()
+    try:
+        assert wait_routable(lb, 2)
+        c = get_counters()
+        ex0 = c.get("lb_retry_budget_exhausted", job="chaos/budget")
+        k = 64
+        socks = [connect(lb.port) for _ in range(4)]
+        try:
+            req = build_predict_request(
+                np.full((SIZES[0],), 3.0, np.float32))
+            for s in socks:
+                s.sendall(req * k)
+            for s in socks:
+                for st, body in read_responses(s, k, timeout=30):
+                    assert st == 200 and payload_ok(body, 3.0)
+        finally:
+            for s in socks:
+                s.close()
+        assert c.get("lb_retry_budget_exhausted", job="chaos/budget") > ex0
+        assert glob.glob(str(tmp_path / "*lb-retry-budget*"))
+    finally:
+        lb.stop()
+        door_a.stop()
+        door_b.stop()
+
+
+# ---------------------------------------------------------------------------
+# CoordPartition: discovery freezes, serving continues, aging re-arms
+# ---------------------------------------------------------------------------
+
+
+def test_coord_partition_freezes_discovery_serving_continues():
+    kv = PartitionableKV()
+    job = "chaos/freeze"
+    app_a, door_a = spin_replica(kv, job, "ra")
+    app_b, door_b = spin_replica(kv, job, "rb")
+    lb = ServingLB(
+        job=job, host="127.0.0.1", kv=kv, pool=2,
+        discovery_s=0.05, sweep_ms=3.0, addr_grace_s=0.3,
+        hedge_floor_ms=30.0, request_timeout_s=20.0).start()
+    c = get_counters()
+
+    def burst(v):
+        s = connect(lb.port)
+        try:
+            s.sendall(build_predict_request(
+                np.full((SIZES[0],), v, np.float32)) * 4)
+            return read_responses(s, 4, timeout=30)
+        finally:
+            s.close()
+
+    try:
+        assert wait_routable(lb, 2)
+        # -- phase 1: the coordinator RPC raises (partition).  The LB
+        # must keep BOTH last-known targets well past addr_grace_s and
+        # keep serving on them.
+        f0 = c.get("lb_discovery_freezes", job=job)
+        kv.partition(0.7, mode="raise")
+        time.sleep(0.45)  # > addr_grace_s, still inside the partition
+        assert set(lb.app.upstreams) == {"ra", "rb"}
+        for st, body in burst(4.0):
+            assert st == 200 and payload_ok(body, 4.0)
+        assert c.get("lb_discovery_freezes", job=job) > f0
+        while kv.partitioned():
+            time.sleep(0.05)
+        # -- phase 2: the sweep "succeeds" with ZERO targets (KV wipe).
+        # Mass disappearance must freeze aging, not age out the fleet.
+        time.sleep(0.2)
+        f1 = c.get("lb_discovery_freezes", job=job)
+        kv.partition(0.6, mode="empty")
+        time.sleep(0.4)
+        assert set(lb.app.upstreams) == {"ra", "rb"}
+        assert lb.app._disc_frozen
+        for st, body in burst(5.0):
+            assert st == 200 and payload_ok(body, 5.0)
+        assert c.get("lb_discovery_freezes", job=job) > f1
+        while kv.partitioned():
+            time.sleep(0.05)
+        # -- phase 3: recovery re-arms aging.  A replica that then
+        # cleanly unpublishes is dropped within addr_grace_s — the
+        # freeze was an episode, not a permanent aging-off switch.
+        deadline = time.monotonic() + 5
+        while lb.app._disc_frozen and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert not lb.app._disc_frozen
+        door_a.stop()
+        kv.kv_del(f"{SERVING_ADDR_PREFIX}{job}/ra")
+        deadline = time.monotonic() + 5
+        while "ra" in lb.app.upstreams and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert "ra" not in lb.app.upstreams
+        assert "rb" in lb.app.upstreams
+        for st, body in burst(6.0):
+            assert st == 200 and payload_ok(body, 6.0)
+    finally:
+        lb.stop()
+        door_b.stop()
+
+
+# ---------------------------------------------------------------------------
+# Coordinator bootstrap: jittered backoff under a hard deadline, exit 3
+# ---------------------------------------------------------------------------
+
+
+def _silent_listener():
+    """A black-holed coordinator: accepts TCP, never answers PONG — the
+    failure mode a bare connect-and-hope bootstrap would hang on."""
+    srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(8)
+    return srv, srv.getsockname()[1]
+
+
+def test_bootstrap_kv_contract():
+    assert bootstrap_kv({}, disabled="discovery disabled") is None
+    with pytest.raises(CoordBootstrapError):
+        bootstrap_kv({"EDL_COORD_ENDPOINT": "host:notaport"},
+                     disabled="discovery disabled")
+
+
+def test_lb_main_exit3_on_black_holed_coordinator(capsys, tmp_path):
+    srv, port = _silent_listener()
+    try:
+        rc = lb_main({
+            "EDL_COORD_ENDPOINT": f"127.0.0.1:{port}",
+            "EDL_COORD_BOOTSTRAP_DEADLINE_S": "0.6",
+            "EDL_LB_JOB": "chaos/boot",
+            "EDL_FLIGHTREC_DIR": str(tmp_path),
+        })
+    finally:
+        srv.close()
+    assert rc == 3
+    out = capsys.readouterr().out
+    assert "lb FAILED (coordinator bootstrap:" in out
+    assert "unreachable for" in out
+    assert glob.glob(str(tmp_path / "*lb-coord-bootstrap*"))
+
+
+def test_replica_main_exit3_on_black_holed_coordinator(capsys, tmp_path):
+    srv, port = _silent_listener()
+    try:
+        rc = replica_main({
+            "EDL_COORD_ENDPOINT": f"127.0.0.1:{port}",
+            "EDL_COORD_BOOTSTRAP_DEADLINE_S": "0.6",
+            "EDL_FD_MODEL": "mlp:8,16,4",
+            "EDL_FD_REPLICA": "rboot",
+            "EDL_FLIGHTREC_DIR": str(tmp_path),
+        })
+    finally:
+        srv.close()
+    assert rc == 3
+    out = capsys.readouterr().out
+    assert "frontdoor FAILED replica=rboot" in out
+    assert "coordinator bootstrap" in out
+    assert glob.glob(str(tmp_path / "*frontdoor-coord-bootstrap*"))
+
+
+# ---------------------------------------------------------------------------
+# Front-door brownout + the /admin/gray drill verb
+# ---------------------------------------------------------------------------
+
+
+def test_brownout_enters_on_lag_breach_and_exits_with_hysteresis():
+    from edl_tpu.runtime.serving import ElasticServer
+
+    job, replica = "chaos/brown", "r0"
+
+    def build():
+        return ElasticServer(lambda p, b: mlp.apply(p, b[0]), PARAMS)
+
+    app = BatchApp(build, SIZES[0], job=job, replica=replica,
+                   max_batch=16, max_queue_ms=0.5,
+                   brownout_sustain=2, brownout_min_s=0.3)
+    door = FrontDoor(app, host="127.0.0.1", job=job).start()
+    try:
+        assert app.wait_ready(120)
+        s = connect(door.port)
+        req = build_predict_request(np.full((SIZES[0],), 7.0, np.float32))
+        s.sendall(req * 4)
+        read_responses(s, 4)
+        assert not app._brownout and app.brownouts == 0
+        seconds = get_registry().counter("frontdoor_brownout_seconds")
+        b0 = seconds.value(job=job, replica=replica)
+        # the loop-lag probe's sustained-breach relay: the NEXT batcher
+        # iteration enters brownout (the probe already proved sustain)
+        app.note_lag_breach()
+        deadline = time.monotonic() + 10
+        while not app._brownout and time.monotonic() < deadline:
+            s.sendall(req)
+            read_responses(s, 1)
+        assert app._brownout and app.brownouts == 1
+        # degraded ≠ wrong: admitted requests still answer correctly
+        s.sendall(req * 4)
+        for st, body in read_responses(s, 4):
+            assert st == 200 and payload_ok(body, 7.0)
+        # hysteresis exit: brownout_min_s elapsed AND sustain clean ticks
+        deadline = time.monotonic() + 10
+        while app._brownout and time.monotonic() < deadline:
+            s.sendall(req)
+            read_responses(s, 1)
+            time.sleep(0.02)
+        assert not app._brownout
+        assert seconds.value(job=job, replica=replica) > b0
+        s.close()
+    finally:
+        door.stop()
+
+
+def test_admin_gray_drill_verb():
+    """/admin/gray is the out-of-process injection seam the bench leg
+    drives: body "<rate> <mode> <duration_s>", malformed → 400."""
+    from tests.test_frontdoor import make_replica
+
+    app, door = make_replica("chaos/admingray")
+    try:
+        assert app.wait_ready(120)
+        s = connect(door.port)
+        body = b"1.0 error 0.4"
+        s.sendall(b"POST /admin/gray HTTP/1.1\r\nHost: t\r\n"
+                  b"Content-Length: %d\r\n\r\n" % len(body) + body)
+        (st, _), = read_responses(s, 1)
+        assert st == 200
+        req = build_predict_request(np.full((SIZES[0],), 8.0, np.float32))
+        s.sendall(req)
+        (st, _), = read_responses(s, 1)
+        assert st == 500
+        assert get_counters().get("frontdoor_gray_responses",
+                                  job="chaos/admingray", mode="error") >= 1
+        time.sleep(0.45)  # the drill window lapses on its own
+        s.sendall(req)
+        (st, resp), = read_responses(s, 1)
+        assert st == 200 and payload_ok(resp, 8.0)
+        bad = b"1.0 bogus 0.4"
+        s.sendall(b"POST /admin/gray HTTP/1.1\r\nHost: t\r\n"
+                  b"Content-Length: %d\r\n\r\n" % len(bad) + bad)
+        (st, _), = read_responses(s, 1)
+        assert st == 400
+        s.close()
+    finally:
+        door.stop()
+
+
+# ---------------------------------------------------------------------------
+# The seeded multi-fault soak
+# ---------------------------------------------------------------------------
+
+SOAK_SEED = 1601
+N_REPLICAS = 3
+
+
+def _soak_plan(seed):
+    plan = FaultPlan.random(seed, kinds=SERVING_KINDS, n_faults=5,
+                            first_step=3, last_step=40, min_gap=5,
+                            flake_duration_s=0.8)
+    # the soak asserts the breaker eject→re-admit arc, so the gray's
+    # rate must be high enough to trip it; the bump is deterministic
+    # (same seed → same plan) so reproducibility still holds
+    for a in plan.actions:
+        if isinstance(a, GrayReplica):
+            a.rate = max(a.rate, 0.85)
+    return plan
+
+
+def test_soak_plan_seeded_reproducibility():
+    p1, p2 = _soak_plan(SOAK_SEED), _soak_plan(SOAK_SEED)
+    assert p1.describe() == p2.describe()
+    kinds = [d["kind"] for d in p1.describe()]
+    assert sorted(kinds) == sorted(SERVING_KINDS)
+    assert _soak_plan(SOAK_SEED + 1).describe() != p1.describe()
+
+
+@pytest.mark.slow
+def test_serving_chaos_soak_zero_wrong_payloads():
+    """All five serving fault kinds fire concurrently (seeded schedule,
+    steps = deciseconds) against a 3-replica fleet behind chaos proxies
+    while Poisson-ish traffic flows.  Invariants: ZERO wrong payloads,
+    bounded error rate, the breaker arc observed, every fault injected
+    and recovered exactly once, and the campaign is seed-reproducible."""
+    kv = PartitionableKV()
+    job = "chaos/soak"
+    apps, doors, proxies, pubs = {}, {}, {}, []
+    pub_stop = threading.Event()
+    for i in range(N_REPLICAS):
+        name = f"r{i}"
+        # kv=None: the replica must NOT advertise its real door — the
+        # chaos proxy in front of it is the advertised address
+        apps[name], doors[name] = spin_replica(None, job, name)
+        proxies[name] = ChaosProxy(("127.0.0.1", doors[name].port))
+
+    def publish(name):
+        key = f"{SERVING_ADDR_PREFIX}{job}/{name}"
+        addr = f"{proxies[name].host}:{proxies[name].port}"
+        while not pub_stop.is_set():
+            kv.kv_set(key, format_serving_addr(addr, 2.0))
+            pub_stop.wait(0.3)
+
+    for name in apps:
+        t = threading.Thread(target=publish, args=(name,), daemon=True)
+        t.start()
+        pubs.append(t)
+
+    lb = ServingLB(
+        job=job, host="127.0.0.1", kv=kv, pool=2,
+        discovery_s=0.1, sweep_ms=3.0, addr_grace_s=1.0,
+        hedge_floor_ms=25.0, hedge_cap_ms=250.0,
+        request_timeout_s=2.0,
+        breaker_errors=4, breaker_ratio=0.5, breaker_min=10,
+        breaker_window_s=0.5, breaker_cooldown_s=0.3,
+        breaker_probes=1).start()
+
+    def partition_coord(duration_s):
+        kv.partition(duration_s, mode="raise")
+        until = time.monotonic() + duration_s
+
+        def recovered():
+            return (time.monotonic() >= until + 0.3
+                    and len(lb.app.upstreams) == N_REPLICAS)
+
+        return recovered
+
+    c = get_counters()
+    stop = threading.Event()
+    stats = {}
+
+    def traffic(tid):
+        rng = random.Random(SOAK_SEED * 100 + tid)
+        v = float(tid + 1)
+        req = build_predict_request(np.full((SIZES[0],), v, np.float32))
+        exp = ref_out(v)
+        ok = err = wrong = 0
+        s = None
+        while not stop.is_set():
+            if s is None:
+                try:
+                    s = connect(lb.port)
+                except OSError:
+                    err += 1
+                    time.sleep(0.05)
+                    continue
+            k = rng.randrange(1, 5)
+            try:
+                s.sendall(req * k)
+                resps = read_responses(s, k, timeout=6.0)
+            except OSError:
+                try:
+                    s.close()
+                except OSError:
+                    pass
+                s = None
+                err += k
+                continue
+            for st, body in resps:
+                if st == 200:
+                    out = np.frombuffer(body, "<f4")
+                    if out.shape == exp.shape and np.allclose(
+                            out, exp, atol=1e-4):
+                        ok += 1
+                    else:
+                        wrong += 1
+                else:
+                    err += 1
+            time.sleep(min(rng.expovariate(125.0), 0.05))
+        if s is not None:
+            try:
+                s.close()
+            except OSError:
+                pass
+        stats[tid] = (ok, err, wrong)
+
+    try:
+        assert wait_routable(lb, N_REPLICAS)
+        plan = _soak_plan(SOAK_SEED)
+        ctx = FaultContext(
+            replica_proxies=proxies,
+            gray={n: apps[n].set_gray for n in apps},
+            serving_lb=lb.app,
+            partition_coord=partition_coord,
+            rng=random.Random(SOAK_SEED))
+        inj0 = {k: c.get("faults_injected", type=k)
+                for k in SERVING_KINDS}
+        rec0 = {k: c.get("recoveries_completed", type=k)
+                for k in SERVING_KINDS}
+        trans0 = {t: c.get("lb_breaker_transitions", job=job, to=t)
+                  for t in ("open", "half_open", "closed")}
+        engine = FaultPlanEngine(plan, ctx)
+        threads = [threading.Thread(target=traffic, args=(tid,),
+                                    daemon=True) for tid in range(3)]
+        for t in threads:
+            t.start()
+        t0 = time.monotonic()
+        hard = t0 + 60.0
+        while time.monotonic() < hard:
+            engine(int((time.monotonic() - t0) * 10))
+            if engine.quiescent():
+                break
+            time.sleep(0.02)
+        quiesced = engine.quiescent()
+        time.sleep(0.5)  # a little post-recovery traffic on the clean fleet
+        stop.set()
+        for t in threads:
+            t.join(timeout=20)
+        assert quiesced, (engine.unfired(), engine.fired, engine.recovered)
+        # exactly-once accounting: every serving kind fired once and
+        # recovered once, in the engine's audit trail AND the counters
+        assert sorted(k for _, k in engine.fired) == sorted(SERVING_KINDS)
+        assert sorted(engine.recovered) == sorted(SERVING_KINDS)
+        for k in SERVING_KINDS:
+            assert c.get("faults_injected", type=k) == inj0[k] + 1, k
+            assert c.get("recoveries_completed", type=k) == rec0[k] + 1, k
+        # the breaker arc was observed: eject → half-open → re-admit
+        assert c.get("lb_breaker_transitions", job=job,
+                     to="open") > trans0["open"]
+        assert c.get("lb_breaker_transitions", job=job,
+                     to="half_open") > trans0["half_open"]
+        assert c.get("lb_breaker_transitions", job=job,
+                     to="closed") > trans0["closed"]
+        for up in lb.app.upstreams.values():
+            assert up.breaker.state == BRK_CLOSED
+        ok = sum(v[0] for v in stats.values())
+        err = sum(v[1] for v in stats.values())
+        wrong = sum(v[2] for v in stats.values())
+        total = ok + err + wrong
+        assert wrong == 0, f"{wrong} wrong payloads out of {total}"
+        assert total >= 300, total
+        assert err / total <= 0.15, f"error rate {err}/{total}"
+        # same seed → the same campaign, bit for bit
+        assert _soak_plan(SOAK_SEED).describe() == plan.describe()
+    finally:
+        stop.set()
+        pub_stop.set()
+        lb.stop()
+        for p in proxies.values():
+            p.close()
+        for d in doors.values():
+            d.stop()
