@@ -38,6 +38,10 @@ JOB_KV_PREFIXES = (
     # the DATA-plane address + ready-gate keys the LB tier discovers
     # replicas through (runtime/frontdoor.py _StatePublisher)
     "serving-addr/",
+    # per-(step, worker) update fingerprints the SDC defense plane
+    # cross-checks (runtime/sdc.py); quarantine markers are per-WORKER
+    # like evict/ and deliberately not swept with the job
+    "sdc-fp/",
 )
 
 

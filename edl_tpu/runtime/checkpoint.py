@@ -58,9 +58,14 @@ _MANIFEST_DIRNAME = ".integrity"
 #: integrity-manifest schema version.  v1 (pre-versioned) manifests had
 #: only {step, files}; v2 adds {"version": 2, "meta": [size, crc] |
 #: None} fingerprinting the training-meta sidecar (data cursors + RNG
-#: lineage).  verify()/restore() accept both — an old store keeps
-#: restoring unchanged.
-_MANIFEST_VERSION = 2
+#: lineage); v3 is the VERIFIED LINEAGE (doc/sdc_defense.md): a
+#: ``verified`` bit plus the param-tree fingerprint recomputed from the
+#: live tree at save time — ``tree_hash`` (whole-tree) and ``leaves``
+#: (per-leaf xor-folds keyed by jax keystr path, so a PARTIAL restore
+#: like serving's params-only tree can verify the subset of paths it
+#: shares).  verify()/restore() accept all three — an old store keeps
+#: restoring unchanged, it just cannot claim the verified bit.
+_MANIFEST_VERSION = 3
 
 
 def _fingerprint_tree(root: Path) -> dict[str, list]:
@@ -109,6 +114,16 @@ class ElasticCheckpointer:
         #: training-meta sidecars owed by async saves (written with the
         #: manifest at finalize, same reason: never fingerprint mid-write)
         self._pending_meta: dict[int, dict] = {}
+        #: per-leaf tree folds owed by wait=False saves (computed from
+        #: the in-memory tree at submit time — the files may still be
+        #: mid-write at finalize, the tree is ground truth)
+        self._pending_folds: dict[int, dict] = {}
+        #: the last successful restore's step and whether its param
+        #: tree-hash matched the manifest (None = no hash evidence:
+        #: pre-v3 manifest or hashing unavailable) — what the
+        #: CorruptCheckpoint drill's recovery predicate asserts on
+        self.last_restored_step: Optional[int] = None
+        self.last_restore_hash_ok: Optional[bool] = None
         #: the async pipeline: at most ONE persist thread in flight
         self._inflight: Optional[threading.Thread] = None
         self._async_error: Optional[BaseException] = None
@@ -202,12 +217,38 @@ class ElasticCheckpointer:
             return None
         return meta
 
-    def _write_manifest(self, step: int) -> None:
+    @staticmethod
+    def _tree_folds(tree: Any) -> Optional[dict]:
+        """Per-leaf xor-folds of the live tree (keystr path → fold), or
+        None when hashing is unavailable — a save must never fail
+        because the verification layer could not hash."""
+        try:
+            from edl_tpu.runtime.sdc import tree_leaf_folds
+
+            return tree_leaf_folds(tree)
+        except Exception as exc:
+            log.warn("param tree hashing failed; saving unverified",
+                     error=str(exc)[:120])
+            return None
+
+    def _write_manifest(self, step: int,
+                        folds: Optional[dict] = None) -> None:
         root = self._step_dir(step)
         if not root.is_dir():  # layout drift — never fail the save for it
             return
         manifest = {"version": _MANIFEST_VERSION, "step": step,
                     "files": _fingerprint_tree(root)}
+        if folds is not None:
+            # the verified-lineage bit: the manifest carries the hash of
+            # the TREE the trainer actually held, not just the bytes the
+            # filesystem returned — restore spot-checks what it parsed
+            # against this, and serving refuses generations without it
+            from edl_tpu.runtime.sdc import fold_fingerprint
+
+            manifest["verified"] = True
+            manifest["tree_hash"] = fold_fingerprint(folds)
+            manifest["leaves"] = {path: f"{fold:016x}"
+                                  for path, fold in sorted(folds.items())}
         mpath = self._meta_path(step)
         if mpath.exists():
             try:
@@ -270,6 +311,51 @@ class ElasticCheckpointer:
         except OSError:
             return False  # files listed in the manifest are unreadable
         return found == manifest["files"]
+
+    def manifest(self, step: int) -> Optional[dict]:
+        """The step's integrity manifest, or None (absent/unreadable)."""
+        try:
+            with open(self._manifest_path(step)) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    def manifest_verified(self, step: int) -> Optional[bool]:
+        """The step's verified-lineage claim: True when the manifest
+        carries the v3 verified bit + tree hash, False when it exists
+        and explicitly does NOT claim it (a forged/downgraded manifest),
+        None when there is no manifest at all — the legacy store, where
+        absence of a manifest is no evidence against the data."""
+        manifest = self.manifest(step)
+        if manifest is None:
+            return None
+        return bool(manifest.get("verified")) and "tree_hash" in manifest
+
+    def verify_restored(self, step: int, tree: Any) -> Optional[bool]:
+        """Spot-check a RESTORED tree against the manifest's per-leaf
+        folds — the half of the verified lineage that catches bytes
+        which pass the file CRCs but parse to something the trainer
+        never held (or a manifest forged around the files).  Compares
+        only the leaf paths present in both, so a partial restore
+        (serving's params-only tree) verifies its shared subset.
+        Returns None when no hash evidence exists (pre-v3 manifest, no
+        shared paths, hashing unavailable)."""
+        manifest = self.manifest(step)
+        leaves = (manifest or {}).get("leaves")
+        if not leaves:
+            return None
+        folds = self._tree_folds(tree)
+        if folds is None:
+            return None
+        shared = [p for p in folds if p in leaves]
+        if not shared:
+            return None
+        for path in shared:
+            if f"{folds[path]:016x}" != leaves[path]:
+                log.warn("restored tree fails manifest param hash",
+                         step=step, leaf=path)
+                return False
+        return True
 
     # -- save/restore -------------------------------------------------------
 
@@ -344,13 +430,19 @@ class ElasticCheckpointer:
                 self._write_meta(step, meta)
             else:
                 self._drop_stale_meta(step)
-            self._write_manifest(step)
+            self._write_manifest(step, folds=self._tree_folds(tree))
             self._unfinalized.discard(step)
             self._pending_meta.pop(step, None)
+            self._pending_folds.pop(step, None)
         else:
             self._unfinalized.add(step)
             if meta is not None:
                 self._pending_meta[step] = meta
+            # hash the tree NOW (it is in memory and consistent); the
+            # files may still be mid-write when finalize() runs
+            folds = self._tree_folds(tree)
+            if folds is not None:
+                self._pending_folds[step] = folds
         if self._save_failure_streak:
             log.info("checkpoint saves recovered", step=step,
                      after_failures=self._save_failure_streak)
@@ -475,9 +567,11 @@ class ElasticCheckpointer:
                 self._write_meta(step, meta)
             else:
                 self._drop_stale_meta(step)
-            self._write_manifest(step)
+            self._write_manifest(step,
+                                 folds=self._pending_folds.pop(step, None))
         self._unfinalized.clear()
         self._pending_meta.clear()
+        self._pending_folds.clear()
 
     def refresh(self) -> None:
         """Re-read the step store from disk.  Orbax's CheckpointManager
@@ -594,6 +688,22 @@ class ElasticCheckpointer:
                 last_exc = exc
                 exc_types.add(type(exc))
                 continue
+            # verified lineage: what Orbax handed back must hash to what
+            # the trainer saved — bytes that pass the file CRCs but
+            # parse to a different tree (or a manifest forged around the
+            # files) are corruption, fall back like a torn step
+            hash_ok = self.verify_restored(candidate, restored)
+            if hash_ok is False:
+                log.warn("restored checkpoint fails param tree-hash; "
+                         "falling back", step=candidate)
+                get_tracer().instant("checkpoint_corruption_detected",
+                                     category="chaos", step=candidate,
+                                     error="param tree-hash mismatch")
+                get_counters().inc("checkpoint_corruption_detected")
+                get_counters().inc("checkpoint_tree_hash_mismatch")
+                fell_back = True
+                manifest_failed = True
+                continue
             if fell_back:
                 flush_deferred()  # a later step restored — those WERE torn
                 log.warn("restored from fallback checkpoint after "
@@ -604,6 +714,8 @@ class ElasticCheckpointer:
                                    type="corrupt_checkpoint")
             log.info("restored checkpoint", step=candidate,
                      dir=str(self.directory))
+            self.last_restored_step = candidate
+            self.last_restore_hash_ok = hash_ok
             return restored
         if (all_manifested and not manifest_failed and last_exc is not None
                 and len(exc_types) == 1

@@ -76,15 +76,19 @@ class CoordDiscovery:
 
     def _eviction_marker(self) -> bool:
         """True when a peer wrote an eviction marker for this worker
-        (multihost straggler eviction — see ElasticWorld.evict).  The
-        keepalive consults this before an expiry-rejoin: without the
-        check, the evicted worker's beat thread would undo the eviction
-        forever (leave → heartbeat False → rejoin → leave → ...)."""
+        (multihost straggler eviction — see ElasticWorld.evict) OR an
+        SDC quarantine marker (confirmed silent corruption — see
+        edl_tpu.runtime.sdc.quarantine_worker; same protocol, different
+        verdict).  The keepalive consults this before an expiry-rejoin:
+        without the check, the marked worker's beat thread would undo
+        the eviction forever (leave → heartbeat False → rejoin →
+        leave → ...)."""
         kv_get = getattr(self._client, "kv_get", None)
         if kv_get is None:
             return False
         try:
-            return kv_get(f"evict/{self.name}") is not None
+            return (kv_get(f"evict/{self.name}") is not None
+                    or kv_get(f"sdc-quarantine/{self.name}") is not None)
         except Exception:
             return False  # coordinator unreachable ≠ evicted
 
@@ -254,7 +258,10 @@ class BatchKeepalive:
         if kv_get is None:
             return False
         try:
-            return kv_get(f"evict/{name}") is not None
+            # eviction (straggler vote) and SDC quarantine (confirmed
+            # corruption) share the decline-the-rejoin contract
+            return (kv_get(f"evict/{name}") is not None
+                    or kv_get(f"sdc-quarantine/{name}") is not None)
         except Exception:
             return False  # coordinator unreachable ≠ evicted
 

@@ -583,6 +583,8 @@ class ElasticTrainer:
                     f"injected kill after {done}/{V} micro-batches "
                     f"at step {self.state.step}")
 
+        poison = getattr(self, "_poison_losses_pending", 0)
+
         if use_dp:
             rounds = V // width
             for r in range(rounds):
@@ -607,11 +609,67 @@ class ElasticTrainer:
                 done += 1
                 maybe_abort()
             scale = 1.0 / V
+        if getattr(self, "_corrupt_updates_pending", 0) > 0:
+            # the CorruptGradient chaos seam (doc/sdc_defense.md): ONE
+            # bit of the accumulated gradient flips before the apply —
+            # the canonical silent corruption, loud nowhere
+            self._corrupt_updates_pending -= 1
+            from edl_tpu.runtime.sdc import flip_tree_bit
+
+            gsum = flip_tree_bit(gsum)
+            log.warn("injected gradient corruption before apply",
+                     step=self.state.step)
+            get_tracer().instant("sdc_gradient_corrupted",
+                                 category="chaos", step=self.state.step)
         self.state.params, self.state.opt_state = fns["apply"](
             self.state.params, self.state.opt_state, gsum,
             np.float32(scale))
         self.state.step += 1
+        if poison > 0:
+            # the PoisonLoss seam: the REPORT lies, the params are clean
+            # — what the shadow recompute must refute, not confirm
+            self._poison_losses_pending = poison - 1
+            log.warn("injected poisoned loss report",
+                     step=self.state.step)
+            get_tracer().instant("sdc_loss_poisoned", category="chaos",
+                                 step=self.state.step)
+            return float("nan")
         return lsum * scale
+
+    # -- SDC chaos seams ---------------------------------------------------
+
+    def inject_update_corruption(self, n: int = 1) -> None:
+        """Flip one bit in the accumulated gradient of each of the next
+        ``n`` :meth:`step_accumulate` calls, BEFORE the optimizer apply
+        — the ``CorruptGradient`` fault: the update is silently wrong
+        and every later step inherits the drift."""
+        self._corrupt_updates_pending = (
+            getattr(self, "_corrupt_updates_pending", 0) + int(n))
+
+    def inject_loss_poison(self, n: int = 1) -> None:
+        """Make the next ``n`` :meth:`step_accumulate` calls RETURN a
+        NaN loss while applying the honest update — the ``PoisonLoss``
+        fault: a corrupted metric path over clean parameters, which the
+        SDC shadow recompute must refute rather than roll back."""
+        self._poison_losses_pending = (
+            getattr(self, "_poison_losses_pending", 0) + int(n))
+
+    def flip_param_bits(self, leaf: int = 0, bit: int = 17) -> None:
+        """Flip one bit of one live parameter leaf IN PLACE — the
+        ``FlipParamBits`` fault (a latent chip writing back a wrong
+        word).  Device placement/shardings of the live tree are
+        preserved."""
+        from edl_tpu.runtime.sdc import flip_tree_bit
+
+        flipped = flip_tree_bit(self.state.params, leaf=leaf, bit=bit)
+        self.state.params = jax.tree.map(
+            lambda orig, new: (jax.device_put(new, orig.sharding)
+                               if hasattr(orig, "sharding") else new),
+            self.state.params, flipped)
+        log.warn("injected parameter bit flip", step=self.state.step,
+                 leaf=leaf, bit=bit)
+        get_tracer().instant("sdc_param_bits_flipped", category="chaos",
+                             step=self.state.step, leaf=leaf, bit=bit)
 
     # -- internals ---------------------------------------------------------
 

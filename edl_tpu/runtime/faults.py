@@ -252,6 +252,9 @@ class FaultContext:
     coord: Any = None            # CoordClient/service for recovery probes
     proxy: Optional[ChaosProxy] = None
     checkpointer: Any = None     # ElasticCheckpointer
+    #: ElasticTrainer under drill — the SDC faults (CorruptGradient /
+    #: FlipParamBits / PoisonLoss) strike through its chaos seams
+    trainer: Any = None
     #: non-kubelet drills: SIGKILL + respawn the coord server process
     #: (durable state file carries recovery) — provided by the harness
     restart_coordinator: Optional[Callable[[], None]] = None
@@ -592,9 +595,20 @@ class CorruptCheckpoint(FaultAction):
             b = bytearray(data) or bytearray(1)
             b[len(b) // 2] ^= 0xFF
             victim.write_bytes(bytes(b))
-        # recovery = the checkpointer's own fallback restore; counted by
-        # the checkpointer (recoveries_completed{type=corrupt_checkpoint})
-        return FIRED, None
+        # recovery = the checkpointer's own fallback restore (counted as
+        # recoveries_completed{type=corrupt_checkpoint}) AND the step it
+        # lands on re-verifying: its restored param tree must hash to
+        # its manifest (verified lineage) — falling back onto a second
+        # corrupt step used to pass this drill silently
+        before = get_counters().get("recoveries_completed",
+                                    type="corrupt_checkpoint")
+
+        def recovered() -> bool:
+            moved = get_counters().get("recoveries_completed",
+                                       type="corrupt_checkpoint") > before
+            return moved and ck.last_restore_hash_ok is not False
+
+        return FIRED, recovered
 
     def describe(self) -> dict:
         return {**super().describe(), "mode": self.mode}
@@ -676,6 +690,86 @@ class WedgeCollective(FaultAction):
             return RETRY, None  # nothing to freeze yet (mid-reform)
         log.warn("fault: wedged a collective participant")
         return FIRED, lambda: _stalls_detected_total() > before
+
+
+# ---------------------------------------------------------------------------
+# Silent-data-corruption fault actions (doc/sdc_defense.md)
+# ---------------------------------------------------------------------------
+#
+# The QUIETEST failures: nothing crashes, nothing stalls, the loss keeps
+# printing — the model is just WRONG.  Nothing in the crash or watchdog
+# paths can ever notice; only the SDC plane's fingerprint/anomaly/shadow
+# ladder does, so (like the stall pair) detection-and-repair IS the
+# drill's recovery condition.
+
+
+def _sdc_rollbacks_total() -> int:
+    return get_counters().total("sdc_rollbacks")
+
+
+def _sdc_refuted_total() -> int:
+    return get_counters().get("sdc_verdicts", outcome="refuted")
+
+
+@dataclass
+class CorruptGradient(FaultAction):
+    """Flip one bit in the accumulated gradient BEFORE the optimizer
+    apply (a miscompiled reduction, a bad ALU lane): the update is
+    silently wrong and every later step inherits the drift.  Recovery =
+    the SDC plane confirmed the corruption and rolled the trajectory
+    back (``sdc_rollbacks`` moved)."""
+
+    kind: str = "corrupt_gradient"
+
+    def fire(self, ctx: FaultContext):
+        if ctx.trainer is None:
+            raise RuntimeError("CorruptGradient needs a trainer in the ctx")
+        before = _sdc_rollbacks_total()
+        log.warn("fault: corrupting next accumulated gradient")
+        ctx.trainer.inject_update_corruption(1)
+        return FIRED, lambda: _sdc_rollbacks_total() > before
+
+
+@dataclass
+class FlipParamBits(FaultAction):
+    """Flip one bit of one LIVE parameter leaf (a latent chip writing
+    back a wrong word between steps).  Recovery like
+    :class:`CorruptGradient`: confirmed + rolled back."""
+
+    leaf: int = 0
+    bit: int = 17
+
+    kind: str = "flip_param_bits"
+
+    def fire(self, ctx: FaultContext):
+        if ctx.trainer is None:
+            raise RuntimeError("FlipParamBits needs a trainer in the ctx")
+        before = _sdc_rollbacks_total()
+        log.warn("fault: flipping live parameter bit", leaf=self.leaf,
+                 bit=self.bit)
+        ctx.trainer.flip_param_bits(leaf=self.leaf, bit=self.bit)
+        return FIRED, lambda: _sdc_rollbacks_total() > before
+
+    def describe(self) -> dict:
+        return {**super().describe(), "leaf": self.leaf, "bit": self.bit}
+
+
+@dataclass
+class PoisonLoss(FaultAction):
+    """The metric path lies (NaN loss report) over CLEAN parameters —
+    the false-alarm half of the drill matrix.  Recovery = the shadow
+    recompute REFUTED it (``sdc_verdicts{outcome=refuted}`` moved): the
+    defense must not roll back a healthy trainer."""
+
+    kind: str = "poison_loss"
+
+    def fire(self, ctx: FaultContext):
+        if ctx.trainer is None:
+            raise RuntimeError("PoisonLoss needs a trainer in the ctx")
+        before = _sdc_refuted_total()
+        log.warn("fault: poisoning next loss report")
+        ctx.trainer.inject_loss_poison(1)
+        return FIRED, lambda: _sdc_refuted_total() > before
 
 
 # ---------------------------------------------------------------------------
@@ -900,11 +994,18 @@ TRAINING_KINDS = ("kill_trainer", "kill_coordinator", "network_flake",
 SERVING_KINDS = ("slow_upstream", "gray_replica", "conn_flap",
                  "partial_partition", "coord_partition")
 
+#: the silent three (doc/sdc_defense.md): pass ``kinds=SDC_KINDS`` to
+#: :meth:`FaultPlan.random` for a corruption campaign.  FROZEN like the
+#: training eight — seeded campaigns of every family stay bit-identical
+#: as the registry grows.
+SDC_KINDS = ("corrupt_gradient", "flip_param_bits", "poison_loss")
+
 #: kind string → action class (plan (de)serialization + random campaigns)
 ACTION_TYPES = {
     cls.kind: cls  # type: ignore[attr-defined]
     for cls in (KillTrainer, KillCoordinator, NetworkFlake, PreemptDomain,
                 CorruptCheckpoint, DiskFull, StallStep, WedgeCollective,
+                CorruptGradient, FlipParamBits, PoisonLoss,
                 SlowUpstream, GrayReplica, ConnFlap, PartialPartition,
                 CoordPartition)
 }
@@ -1035,8 +1136,11 @@ class FaultPlanEngine:
 
     def quiescent(self) -> bool:
         """True when every action has fired and every engine-watched
-        recovery has completed (checkpoint faults recover inside the
-        checkpointer and are not awaited here)."""
+        recovery has completed.  DiskFull recovers inside the
+        checkpointer and is not awaited here; CorruptCheckpoint IS
+        awaited — its predicate turns true on the fallback restore
+        landing on a step whose param hash re-verifies (the drill must
+        exercise a restore after the strike)."""
         with self._lock:
             return not self._armed and not self._pending
 

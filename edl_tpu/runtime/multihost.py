@@ -422,8 +422,14 @@ class ElasticWorld:
         get_counters().inc("members_evicted")
 
     def evicted_names(self) -> set[str]:
-        return {key.split("/", 1)[1]
-                for key in self._coord.kv_keys("evict/")}
+        """Members barred from the world: evicted stragglers plus
+        SDC-quarantined workers (confirmed silent corruption — the
+        markers are written by ``edl_tpu.runtime.sdc`` but honored by
+        the same membership machinery)."""
+        return ({key.split("/", 1)[1]
+                 for key in self._coord.kv_keys("evict/")}
+                | {key.split("/", 1)[1]
+                   for key in self._coord.kv_keys("sdc-quarantine/")})
 
     def clear_eviction(self) -> bool:
         """Lift this worker's own eviction (fresh-start amnesty).
@@ -435,16 +441,24 @@ class ElasticWorld:
         amnesty the stable pod name would be locked out of the job
         forever (markers ride the coordinator's durable state).  If the
         new incarnation wedges too, it just gets evicted again."""
+        cleared = False
         key = _EVICT_KEY.format(name=self.name)
-        if self._coord.kv_get(key) is None:
-            return False
-        log.warn("clearing own eviction marker on fresh start",
-                 member=self.name)
-        self._coord.kv_del(key)
-        from edl_tpu.observability.collector import get_counters
+        if self._coord.kv_get(key) is not None:
+            log.warn("clearing own eviction marker on fresh start",
+                     member=self.name)
+            self._coord.kv_del(key)
+            from edl_tpu.observability.collector import get_counters
 
-        get_counters().inc("evictions_cleared")
-        return True
+            get_counters().inc("evictions_cleared")
+            cleared = True
+        # the SDC quarantine marker follows the same amnesty rule: a
+        # fresh incarnation (rescheduled pod, replaced silicon) is the
+        # repair the quarantine was waiting for
+        from edl_tpu.runtime.sdc import clear_quarantine
+
+        if clear_quarantine(self._coord, self.name):
+            cleared = True
+        return cleared
 
     def _claim_coordinator(self, epoch: int, rank: int, budget_s: float
                            ) -> Optional[str]:

@@ -1,0 +1,781 @@
+"""Silent-data-corruption (SDC) defense plane.
+
+Every fault the stack already survives is *loud*: a crash closes a
+socket, a stall stops the progress beats, a gray replica trips a
+breaker.  A flipped bit in a gradient, a miscompiled kernel on one
+chip, or a torn optimizer leaf corrupts the model **silently** — the
+loss keeps printing, checkpoints keep landing, and the serving fleet
+happily ships the poison.  This module is the detect→confirm→rollback→
+quarantine ladder for that failure class, built on two properties the
+stack already paid for:
+
+* **PR 9's determinism oracle** — in ``accum_mode="replicated"`` the
+  update at step ``s`` is a bitwise-pure function of
+  ``(dataset, V, s)``.  Any two honest executions of the same step
+  produce byte-identical parameters, so a *fingerprint* disagreement
+  is evidence of corruption, not of scheduling (``doc/
+  accuracy_elasticity.md``); the dp-packed perf mode regroups float
+  reductions with the world size, so there the comparison degrades to
+  the documented loss-tolerance envelope.
+* **Tenplex-style virtualized state** — VW cursors + verified
+  checkpoints make "roll back to step k and replay" cheap and
+  *exactly-once*, so the repaired trajectory is bitwise-identical to a
+  run that never saw the corruption.
+
+The ladder (``doc/sdc_defense.md``):
+
+1. **Fingerprint** (:class:`UpdateFingerprinter`) — a cadenced
+   tree-hash of the post-step update: per-leaf xor-fold of the raw
+   bytes, device→host snapshot on the caller (the only step-loop cost,
+   same contract as ``save_async``), fold + KV publish
+   (``sdc-fp/<job>/<step>/<worker>``) on a bounded background thread.
+   In multi-worker dp, replicas cross-check the same step's
+   fingerprint; the minority worker is the named suspect.
+2. **Anomaly** (:class:`AnomalyDetector`) — fingerprint mismatch, a
+   loss z-score trip against an EWMA baseline, or NaN/inf.
+3. **Shadow recompute** (:class:`ShadowRecompute`) — re-execute the
+   suspect steps from the last verified checkpoint's VW cursors on a
+   *different* trainer/bundle and compare bitwise (replicated) or
+   within the dp tolerance.  Verdicts are counted
+   ``sdc_verdicts{outcome=confirmed|refuted}``.
+4. **Escalate** (:class:`SdcPlane`) — a confirmed corruption rolls the
+   live trainer back to the last verified checkpoint (the caller
+   replays through VW cursors), quarantines the suspect worker via the
+   PR 2 eviction protocol (``sdc-quarantine/<name>`` marker, same
+   amnesty rules), and dumps a flight record embedding the full
+   verdict trail.
+
+Checkpoint *lineage* verification (the ``verified`` manifest bit +
+param tree-hash) lives in ``runtime/checkpoint.py`` and reuses this
+module's folds; serving reloads refuse unverified generations
+(``runtime/serving.py``).
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+from edl_tpu.observability.collector import get_counters
+from edl_tpu.observability.logging import get_logger
+from edl_tpu.observability.tracing import get_tracer
+
+log = get_logger("runtime.sdc")
+
+#: coordinator KV keys.  Fingerprints are per (job, step, worker) so dp
+#: replicas publish side by side and the cross-check lists one step's
+#: prefix; quarantine markers live beside PR 2's ``evict/<name>``
+#: markers and are honored by the same keepalive/rejoin machinery.
+SDC_FP_KEY = "sdc-fp/{job}/{step}/{worker}"
+SDC_FP_STEP_PREFIX = "sdc-fp/{job}/{step}/"
+SDC_QUARANTINE_KEY = "sdc-quarantine/{name}"
+
+_FNV_PRIME = 1099511628211
+_MASK64 = (1 << 64) - 1
+
+
+# -- fingerprint primitives --------------------------------------------------
+
+
+def leaf_fold(x: Any) -> int:
+    """xor-fold the raw bytes of one array leaf into 64 bits.
+
+    XOR over 4-byte lanes *within* a leaf — commutative, so ANY lane
+    decomposition of the raw little-endian bytes computes the same
+    value, which is what lets :class:`UpdateFingerprinter` fold
+    on-device (a bitcast + xor-reduce inside jit) and land on the
+    identical number — then mixed with the byte length and dtype so a
+    truncation or a dtype drift cannot alias to the same fold.  Device
+    arrays are snapshotted host-side first — callers on the step loop
+    should pass already-fetched host trees (the ``save_async``
+    contract): an ndarray input takes the zero-copy view path, anything
+    else pays a device_get."""
+    if isinstance(x, np.ndarray):
+        a = x
+    else:
+        import jax
+
+        a = np.asarray(jax.device_get(x))
+    if not a.flags.c_contiguous:
+        a = np.ascontiguousarray(a)
+    n = a.nbytes
+    if n % 4 == 0 and n:
+        lanes = a.reshape(-1).view(np.uint8).view(np.uint32)
+    else:
+        buf = a.tobytes() + b"\0" * ((-n) % 4)
+        lanes = np.frombuffer(buf, dtype=np.uint32)
+    acc = int(np.bitwise_xor.reduce(lanes)) if lanes.size else 0
+    return _mix_tail(acc, n, str(a.dtype))
+
+
+def _mix_tail(acc: int, nbytes: int, dtype_str: str) -> int:
+    """The order-sensitive tail mix shared by the host and on-device
+    fold paths: length + dtype name keep shape/type drift from folding
+    to an honest leaf's value."""
+    acc = ((acc * _FNV_PRIME) ^ nbytes) & _MASK64
+    for ch in dtype_str.encode():
+        acc = ((acc * _FNV_PRIME) ^ ch) & _MASK64
+    return acc
+
+
+def _lanes32_xor(x):
+    """Traced body: xor all 4-byte lanes of one leaf into ONE uint32 —
+    the device half of :func:`leaf_fold`.  16-bit dtypes pair adjacent
+    elements into little-endian words; sub-16-bit dtypes raise (the
+    caller falls back to the host fold)."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    itemsize = np.dtype(x.dtype).itemsize
+    if itemsize % 4 == 0:
+        words = lax.bitcast_convert_type(x, jnp.uint32).reshape(-1)
+    elif itemsize == 2:
+        half = lax.bitcast_convert_type(x, jnp.uint16).reshape(-1)
+        if half.size % 2:
+            half = jnp.concatenate([half, jnp.zeros(1, jnp.uint16)])
+        pairs = half.reshape(-1, 2).astype(jnp.uint32)
+        words = pairs[:, 0] | (pairs[:, 1] << 16)
+    else:
+        raise NotImplementedError(f"sub-16-bit dtype {x.dtype}")
+    if words.size == 0:
+        return jnp.uint32(0)
+    return lax.reduce(words, np.uint32(0), lax.bitwise_xor, (0,))
+
+
+_fold_tree_on_device = None
+
+
+def device_tree_folds(tree: Any) -> Any:
+    """Fold every leaf ON DEVICE (one jitted bitcast+xor-reduce over the
+    whole tree) and return a tree of uint32 scalars — the step loop
+    then moves a few bytes host-side instead of the whole update.
+    Raises for dtypes the device path can't lane (caller falls back)."""
+    global _fold_tree_on_device
+    import jax
+
+    if _fold_tree_on_device is None:
+        _fold_tree_on_device = jax.jit(
+            lambda t: jax.tree.map(_lanes32_xor, t))
+    return _fold_tree_on_device(tree)
+
+
+def tree_leaf_folds(tree: Any) -> dict[str, int]:
+    """Per-leaf folds keyed by jax keystr path — the unit of blame a
+    fingerprint mismatch localizes to, and what checkpoint manifests
+    store so a PARTIAL restore (serving restores only ``params``) can
+    verify the subset of paths it shares."""
+    import jax
+
+    leaves = jax.tree_util.tree_leaves_with_path(tree)
+    return {jax.tree_util.keystr(path): leaf_fold(leaf)
+            for path, leaf in leaves}
+
+
+def tree_fingerprint(tree: Any) -> str:
+    """16-hex-digit order-sensitive mix over the sorted per-leaf folds
+    — THE fingerprint replicas publish and manifests record."""
+    return fold_fingerprint(tree_leaf_folds(tree))
+
+
+def fold_fingerprint(folds: dict[str, int]) -> str:
+    """Fingerprint from precomputed per-leaf folds (lets the
+    checkpointer hash once and reuse for both manifest + comparison)."""
+    acc = 0xCBF29CE484222325  # FNV-1a offset basis
+    for path in sorted(folds):
+        for ch in path.encode():
+            acc = ((acc ^ ch) * _FNV_PRIME) & _MASK64
+        acc = ((acc ^ (int(folds[path]) & _MASK64)) * _FNV_PRIME) & _MASK64
+    return f"{acc:016x}"
+
+
+def flip_tree_bit(tree: Any, leaf: int = 0, bit: int = 17) -> Any:
+    """Return a copy of ``tree`` with ONE bit flipped in one leaf — the
+    minimal silent corruption the drills inject.  Host-side; callers
+    device_put the result back under the original shardings."""
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    idx = leaf % len(leaves)
+    a = np.array(jax.device_get(leaves[idx]))  # owned copy
+    raw = a.view(np.uint8).reshape(-1)
+    pos = (bit // 8) % raw.size
+    raw[pos] ^= np.uint8(1 << (bit % 8))
+    leaves = list(leaves)
+    leaves[idx] = a
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+# -- the cadenced fingerprinter ----------------------------------------------
+
+
+@dataclass
+class CrossCheck:
+    """One step's dp cross-check result."""
+
+    step: int
+    fingerprints: dict[str, str]
+    mismatch: bool = False
+    #: minority workers named by majority vote; empty on an even split
+    #: (the shadow recompute resolves which side was honest)
+    suspects: list[str] = field(default_factory=list)
+
+
+class UpdateFingerprinter:
+    """Cadenced post-step fingerprint publisher + dp cross-checker.
+
+    The step loop pays ONLY the device→host snapshot (recorded in
+    ``pauses_s`` so the bench can quote fingerprint overhead); folding
+    and the KV publish run on a bounded background thread — at most
+    one in flight, late ticks drop the oldest pending work rather than
+    queueing (a fingerprint is advisory, a stalled step loop is not)."""
+
+    def __init__(self, kv=None, job: str = "job", worker: str = "w0",
+                 cadence: int = 1) -> None:
+        self.kv = kv
+        self.job = job
+        self.worker = worker
+        self.cadence = max(int(cadence), 1)
+        #: step → fingerprint, locally observed (kept bounded)
+        self.local: dict[int, str] = {}
+        self.pauses_s: list[float] = []
+        self._inflight: Optional[threading.Thread] = None
+        # on-device fold path: per-structure cached paths/meta, plus a
+        # one-time host cross-check before trusting the device fold
+        self._struct = None
+        self._paths: list[str] = []
+        self._meta: list[tuple[int, str]] = []
+        self._device_ok: Optional[bool] = None
+        #: None → decide from the backend on first use (device fold on
+        #: accelerators, host fold on CPU); tests pin it explicitly
+        self._prefer_device: Optional[bool] = None
+
+    def due(self, step: int) -> bool:
+        return step % self.cadence == 0
+
+    def record(self, step: int, tree: Any) -> Optional[str]:
+        """Fingerprint ``tree`` at ``step`` if the cadence says so.
+        Synchronous fold (host trees are already cheap to fold and the
+        bench measures the full pause); the KV publish is fire-and-
+        forget on a background thread.  Returns the fingerprint or
+        None when off-cadence."""
+        if not self.due(step):
+            return None
+        import jax
+
+        # wait for the update's own async dispatch BEFORE starting the
+        # clock: the apply has to finish whether or not we fingerprint
+        # (an undefended loop pays this same wait at its next dispatch),
+        # so only the snapshot+fold is the defense's marginal cost
+        jax.block_until_ready(tree)
+        t0 = time.monotonic()
+        fp = self._fingerprint(tree)
+        self.local[step] = fp
+        if len(self.local) > 64:
+            self.local.pop(min(self.local))
+        get_counters().inc("sdc_fingerprints")
+        if self.kv is not None:
+            self._publish_bg(step, fp)
+        pause = time.monotonic() - t0
+        self.pauses_s.append(pause)
+        from edl_tpu.observability.metrics import get_registry
+
+        get_registry().histogram(
+            "sdc_fingerprint_seconds",
+            help="step-loop pause per update fingerprint").observe(pause)
+        return fp
+
+    def _fingerprint(self, tree: Any) -> str:
+        """Combined fingerprint of ``tree``.
+
+        On an accelerator backend the fold runs ON DEVICE (xor over
+        uint32 lanes commutes, so the jitted per-leaf fold equals the
+        host fold) and only a uint32 scalar per leaf crosses to the
+        host — the step loop never pays a full device→host copy of the
+        update.  The first device fold is cross-checked against the
+        host fold once; any disagreement (or an unsupported dtype)
+        falls back to the host path permanently.  On the CPU backend
+        there is no transfer to save, so the host fold — with cached
+        leaf paths — is used directly."""
+        import jax
+
+        struct = jax.tree_util.tree_structure(tree)
+        if self._struct is None or struct != self._struct:
+            with_path = jax.tree_util.tree_leaves_with_path(tree)
+            self._paths = [jax.tree_util.keystr(p) for p, _ in with_path]
+            self._meta = [
+                (int(leaf.size) * np.dtype(leaf.dtype).itemsize,
+                 str(np.dtype(leaf.dtype)))
+                for _, leaf in with_path]
+            self._struct = struct
+        if self._prefer_device is None:
+            self._prefer_device = jax.default_backend() != "cpu"
+        if self._prefer_device and self._device_ok is not False:
+            try:
+                scalars = jax.device_get(
+                    jax.tree_util.tree_leaves(device_tree_folds(tree)))
+                folds = {path: _mix_tail(int(v), nbytes, dtype_str)
+                         for path, (nbytes, dtype_str), v
+                         in zip(self._paths, self._meta, scalars)}
+                fp = fold_fingerprint(folds)
+                if self._device_ok is None:
+                    ref = tree_fingerprint(jax.device_get(tree))
+                    self._device_ok = fp == ref
+                    if not self._device_ok:
+                        log.warn("on-device fold disagrees with host "
+                                 "fold; fingerprinting on host")
+                        return ref
+                return fp
+            except Exception as exc:
+                self._device_ok = False
+                log.warn("on-device fold unavailable; fingerprinting "
+                         "on host", error=str(exc)[:120])
+        leaves = jax.tree_util.tree_leaves(tree)
+        return fold_fingerprint({
+            path: leaf_fold(np.asarray(leaf))
+            for path, leaf in zip(self._paths, leaves)})
+
+    def _publish_bg(self, step: int, fp: str) -> None:
+        prev = self._inflight
+        if prev is not None:
+            prev.join()  # bounded: one publish in flight
+
+        def publish() -> None:
+            try:
+                self.kv.kv_set(
+                    SDC_FP_KEY.format(job=self.job, step=step,
+                                      worker=self.worker), fp.encode())
+            except Exception as exc:  # advisory plane: never kill a step
+                log.warn("sdc fingerprint publish failed", step=step,
+                         error=str(exc)[:120])
+
+        t = threading.Thread(target=publish, daemon=True,
+                             name=f"sdc-fp-{step}")
+        self._inflight = t
+        t.start()
+
+    def drain(self) -> None:
+        t = self._inflight
+        if t is not None:
+            t.join()
+            self._inflight = None
+
+    def cross_check(self, step: int) -> Optional[CrossCheck]:
+        """Compare every worker's published fingerprint for ``step``.
+        Majority vote names the minority suspect(s); a 2-way even split
+        is still a mismatch, with no named suspect — the shadow
+        recompute decides who was honest.  None without a KV or when
+        fewer than 2 workers published."""
+        if self.kv is not None:
+            self.drain()  # our own publish must be visible to the scan
+        fps: dict[str, str] = {}
+        if self.kv is not None:
+            prefix = SDC_FP_STEP_PREFIX.format(job=self.job, step=step)
+            try:
+                for key in self.kv.kv_keys(prefix):
+                    raw = self.kv.kv_get(key)
+                    if raw is not None:
+                        fps[key[len(prefix):]] = raw.decode()
+            except Exception as exc:
+                log.warn("sdc cross-check scan failed", step=step,
+                         error=str(exc)[:120])
+                return None
+        if len(fps) < 2:
+            return None
+        votes: dict[str, int] = {}
+        for fp in fps.values():
+            votes[fp] = votes.get(fp, 0) + 1
+        if len(votes) == 1:
+            return CrossCheck(step=step, fingerprints=fps)
+        majority = max(votes.values())
+        winners = [fp for fp, n in votes.items() if n == majority]
+        suspects: list[str] = []
+        if len(winners) == 1:
+            suspects = sorted(w for w, fp in fps.items()
+                              if fp != winners[0])
+        log.warn("sdc fingerprint mismatch across workers", step=step,
+                 fingerprints=fps, suspects=suspects)
+        return CrossCheck(step=step, fingerprints=fps, mismatch=True,
+                          suspects=suspects)
+
+
+# -- anomaly detection -------------------------------------------------------
+
+
+class AnomalyDetector:
+    """Loss-stream anomaly gate: NaN/inf always trips; after a warmup,
+    a z-score against an EWMA mean/variance baseline trips on spikes.
+    Deliberately *cheap and jumpy* — the shadow recompute is the
+    arbiter, this only decides when to invoke it."""
+
+    def __init__(self, z: float = 6.0, warmup: int = 8,
+                 alpha: float = 0.25) -> None:
+        self.z = float(z)
+        self.warmup = int(warmup)
+        self.alpha = float(alpha)
+        self.mean: Optional[float] = None
+        self.var = 0.0
+        self.seen = 0
+
+    def observe(self, loss: float) -> Optional[str]:
+        """Feed one loss; returns the trigger name ("nan"|"loss_spike")
+        or None.  An anomalous sample is NOT folded into the baseline —
+        a confirmed corruption would otherwise teach the detector that
+        corruption is normal."""
+        if not math.isfinite(loss):
+            return "nan"
+        if self.mean is None:
+            self.mean, self.seen = float(loss), 1
+            return None
+        delta = float(loss) - self.mean
+        # absolute-explosion guard, live even during warmup: a loss
+        # thousands of times the baseline needs no variance estimate
+        if abs(delta) > 1e3 * (abs(self.mean) + 1.0):
+            return "loss_spike"
+        std = math.sqrt(self.var)
+        if self.seen >= self.warmup and std > 0.0:
+            if abs(delta) > self.z * std:
+                return "loss_spike"
+        self.mean += self.alpha * delta
+        self.var = (1.0 - self.alpha) * (self.var
+                                         + self.alpha * delta * delta)
+        self.seen += 1
+        return None
+
+
+# -- shadow recompute --------------------------------------------------------
+
+
+@dataclass
+class Verdict:
+    """The outcome of one full anomaly→shadow-recompute episode — the
+    flight-record payload satellite 6 pins."""
+
+    step: int
+    trigger: str                       # nan | loss_spike | fp_mismatch
+    outcome: str                       # confirmed | refuted | unresolved
+    anchor_step: int = 0               # shadow's replay start (verified)
+    replayed_steps: int = 0
+    live_fingerprint: str = ""
+    shadow_fingerprint: str = ""
+    shadow_loss: float = float("nan")
+    live_loss: float = float("nan")
+    suspects: list[str] = field(default_factory=list)
+    quarantined: Optional[str] = None
+    rollback_step: Optional[int] = None
+
+    def to_dict(self) -> dict:
+        return {"step": self.step, "trigger": self.trigger,
+                "outcome": self.outcome, "anchor_step": self.anchor_step,
+                "replayed_steps": self.replayed_steps,
+                "live_fingerprint": self.live_fingerprint,
+                "shadow_fingerprint": self.shadow_fingerprint,
+                "shadow_loss": self.shadow_loss,
+                "live_loss": self.live_loss,
+                "suspects": list(self.suspects),
+                "quarantined": self.quarantined,
+                "rollback_step": self.rollback_step}
+
+
+class ShadowRecompute:
+    """Re-execute suspect steps on an INDEPENDENT trainer and compare.
+
+    ``make_trainer()`` builds a fresh trainer (different bundle; in
+    replicated accumulation mode any world size computes the bitwise-
+    identical update, so the shadow may be world=1) at the job's
+    deterministic init params.  ``make_batches()`` builds a fresh
+    :class:`~edl_tpu.runtime.virtual.VirtualBatches` over the same
+    dataset.  The shadow restores the last VERIFIED checkpoint (or
+    starts from init when none), winds the batch stream to the anchor
+    through the pure ``cursors_for_step`` cursors, replays to the
+    suspect step, and compares fingerprints bitwise (replicated) or
+    losses within the documented dp tolerance."""
+
+    def __init__(self, make_trainer: Callable[[], Any],
+                 make_batches: Callable[[], Any],
+                 cfg, checkpointer=None,
+                 mode: str = "replicated") -> None:
+        from edl_tpu.runtime.virtual import (DEFAULT_LOSS_ATOL,
+                                             DEFAULT_LOSS_RTOL)
+
+        self.make_trainer = make_trainer
+        self.make_batches = make_batches
+        self.cfg = cfg
+        self.checkpointer = checkpointer
+        self.mode = mode
+        self.atol, self.rtol = DEFAULT_LOSS_ATOL, DEFAULT_LOSS_RTOL
+
+    def _anchor(self) -> int:
+        if self.checkpointer is None:
+            return 0
+        step = self.checkpointer.latest_verified_step()
+        return int(step) if step is not None else 0
+
+    def judge(self, verdict: Verdict) -> Verdict:
+        """Fill in the shadow half of ``verdict`` and rule.  Confirmed
+        = the live execution's fingerprint (or loss) disagrees with the
+        honest recomputation; refuted = they match (e.g. a poisoned
+        loss report over clean params, or a detector false alarm)."""
+        from edl_tpu.runtime.virtual import vw_keys
+
+        t0 = time.monotonic()
+        step = verdict.step
+        anchor = self._anchor()
+        if anchor >= step:
+            # the corruption landed before (or at) the newest verified
+            # checkpoint — re-anchor one verified step earlier if the
+            # lineage has one, else replay from init
+            anchor = 0
+            if self.checkpointer is not None:
+                for s in sorted(getattr(self.checkpointer, "_mgr").all_steps(),
+                                reverse=True):
+                    if s < step and self.checkpointer.verify(s):
+                        anchor = int(s)
+                        break
+        trainer = self.make_trainer()
+        batches = self.make_batches()
+        if anchor > 0 and self.checkpointer is not None:
+            tree = {"params": trainer.state.params,
+                    "opt": trainer.state.opt_state}
+            restored = self.checkpointer.restore(tree, step=anchor)
+            trainer.state.params = restored["params"]
+            trainer.state.opt_state = restored["opt"]
+            trainer.state.step = anchor
+        batches.restore(batches.cursors_for_step(anchor))
+        verdict.anchor_step = anchor
+        loss = float("nan")
+        replayed = 0
+        while batches.step < step:
+            micro = batches.next_step()
+            if micro is None:
+                break
+            keys = None
+            if trainer.rng_in_loss:
+                keys = vw_keys(self.cfg.job_seed, self.cfg.vw_count,
+                               batches.step - 1)
+            loss = trainer.step_accumulate(micro, rng_keys=keys)
+            replayed += 1
+        verdict.replayed_steps = replayed
+        verdict.shadow_loss = float(loss)
+        verdict.shadow_fingerprint = tree_fingerprint(trainer.state.params)
+        if self.mode == "replicated" and verdict.live_fingerprint:
+            confirmed = (verdict.shadow_fingerprint
+                         != verdict.live_fingerprint)
+        elif math.isfinite(verdict.live_loss):
+            confirmed = not (math.isfinite(verdict.shadow_loss)
+                             and abs(verdict.shadow_loss - verdict.live_loss)
+                             <= self.atol
+                             + self.rtol * abs(verdict.shadow_loss))
+        else:
+            # live loss was NaN: if the honest recompute is finite, the
+            # live execution was corrupt
+            confirmed = math.isfinite(verdict.shadow_loss)
+        verdict.outcome = "confirmed" if confirmed else "refuted"
+        get_tracer().instant(
+            "sdc_shadow_recompute", category="chaos", step=step,
+            anchor=anchor, outcome=verdict.outcome,
+            replayed=replayed,
+            elapsed_ms=round((time.monotonic() - t0) * 1000, 1))
+        return verdict
+
+
+# -- quarantine (PR 2 eviction protocol, SDC flavor) -------------------------
+
+
+def quarantine_worker(kv, name: str, reason: str = "sdc-confirmed",
+                      by: str = "sdc") -> bool:
+    """Write the durable quarantine marker for ``name``.  The keepalive
+    machinery (`runtime/discovery.py`) honors it exactly like an
+    eviction marker — the quarantined worker's expiry-rejoin is
+    declined — and `ElasticWorld.evicted_names` unions it, so the next
+    reform forms without the suspect.  Amnesty follows the eviction
+    rules: a FRESH incarnation clears its own marker
+    (`clear_quarantine`)."""
+    if kv is None:
+        return False
+    try:
+        kv.kv_set(SDC_QUARANTINE_KEY.format(name=name),
+                  f"{by}:{reason}".encode())
+    except Exception as exc:
+        log.warn("sdc quarantine marker write failed", member=name,
+                 error=str(exc)[:120])
+        return False
+    log.warn("worker quarantined for silent data corruption",
+             member=name, reason=reason)
+    get_tracer().instant("sdc_quarantined", category="chaos",
+                         member=name, reason=reason)
+    get_counters().inc("sdc_quarantines")
+    return True
+
+
+def quarantined_names(kv) -> set[str]:
+    try:
+        return {key.split("/", 1)[1]
+                for key in kv.kv_keys("sdc-quarantine/")}
+    except Exception:
+        return set()
+
+
+def clear_quarantine(kv, name: str) -> bool:
+    """Fresh-start amnesty, same contract as
+    ``ElasticWorld.clear_eviction``: a restarted incarnation of the
+    suspect (new process, presumably healthy silicon or a rescheduled
+    pod) lifts its own marker; if it corrupts again it is simply
+    re-quarantined."""
+    key = SDC_QUARANTINE_KEY.format(name=name)
+    try:
+        if kv.kv_get(key) is None:
+            return False
+        kv.kv_del(key)
+    except Exception:
+        return False
+    log.warn("clearing own sdc quarantine marker on fresh start",
+             member=name)
+    get_counters().inc("sdc_quarantines_cleared")
+    return True
+
+
+# -- the plane ---------------------------------------------------------------
+
+
+class SdcPlane:
+    """The assembled ladder, wired into a training loop after each
+    applied update (``VirtualWorkerLoop(sdc=...)`` drives it)::
+
+        verdict = plane.after_step(step, loss, trainer.state.params)
+        if verdict is not None and verdict.outcome == "confirmed":
+            # roll back + replay (the loop owns its own state)
+
+    Mirrors the :class:`~edl_tpu.runtime.watchdog.StallWatchdog` shape:
+    ``healthy()``, a ``flight_dir`` falling back to ``EDL_FLIGHTREC_DIR``,
+    an ``on_confirmed`` escalation callback, and evidence-first flight
+    records carrying the whole verdict trail."""
+
+    def __init__(self, fingerprinter: Optional[UpdateFingerprinter] = None,
+                 detector: Optional[AnomalyDetector] = None,
+                 shadow: Optional[ShadowRecompute] = None,
+                 checkpointer=None, kv=None,
+                 on_confirmed: Optional[Callable[[Verdict], None]] = None,
+                 flight_dir: Optional[str] = None) -> None:
+        import os
+
+        self.fingerprinter = fingerprinter or UpdateFingerprinter()
+        self.detector = detector or AnomalyDetector()
+        self.shadow = shadow
+        self.checkpointer = checkpointer
+        self.kv = kv if kv is not None else self.fingerprinter.kv
+        self.on_confirmed = on_confirmed
+        self.flight_dir = (flight_dir if flight_dir is not None
+                           else os.environ.get("EDL_FLIGHTREC_DIR", ""))
+        #: every completed episode, oldest first (bounded)
+        self.verdicts: list[Verdict] = []
+
+    def healthy(self) -> bool:
+        return not any(v.outcome == "confirmed" for v in self.verdicts)
+
+    # -- the per-step hook ----------------------------------------------
+
+    def after_step(self, step: int, loss: float,
+                   params: Any) -> Optional[Verdict]:
+        """Run the ladder for one applied update.  Returns a Verdict
+        when an anomaly was escalated to the shadow recompute (whatever
+        the outcome), else None.  Never raises into the step loop."""
+        trigger = self.detector.observe(float(loss))
+        fp = None
+        try:
+            fp = self.fingerprinter.record(step, params)
+        except Exception as exc:  # advisory: folding must not kill steps
+            log.warn("sdc fingerprint failed", step=step,
+                     error=str(exc)[:120])
+        suspects: list[str] = []
+        check = None
+        if trigger is None and fp is not None:
+            check = self.fingerprinter.cross_check(step)
+            if check is not None and check.mismatch:
+                trigger = "fp_mismatch"
+                suspects = check.suspects
+        if trigger is None:
+            return None
+        get_counters().inc("sdc_anomalies", trigger=trigger)
+        get_tracer().instant("sdc_anomaly", category="chaos", step=step,
+                             trigger=trigger, loss=float(loss))
+        verdict = Verdict(step=step, trigger=trigger, outcome="unresolved",
+                          live_fingerprint=fp or
+                          self.fingerprinter.local.get(step, ""),
+                          live_loss=float(loss), suspects=suspects)
+        if verdict.live_fingerprint == "":
+            # escalation needs the live fingerprint even off-cadence
+            try:
+                verdict.live_fingerprint = tree_fingerprint(params)
+            except Exception:
+                pass
+        if self.shadow is not None:
+            verdict = self.shadow.judge(verdict)
+            if (verdict.outcome == "confirmed" and not verdict.suspects
+                    and check is not None and verdict.shadow_fingerprint):
+                # an even dp split named no minority — the honest shadow
+                # recomputation breaks the tie: whoever published a
+                # fingerprint that disagrees with it is the suspect
+                verdict.suspects = sorted(
+                    w for w, f in check.fingerprints.items()
+                    if f != verdict.shadow_fingerprint)
+        get_counters().inc("sdc_verdicts", outcome=verdict.outcome)
+        if verdict.outcome == "confirmed":
+            self._escalate(verdict)
+        self.verdicts.append(verdict)
+        if len(self.verdicts) > 32:
+            self.verdicts.pop(0)
+        return verdict
+
+    # -- escalation ------------------------------------------------------
+
+    def _escalate(self, verdict: Verdict) -> None:
+        ck = self.checkpointer or (self.shadow.checkpointer
+                                   if self.shadow is not None else None)
+        if ck is not None:
+            # rollback target: the newest verified step BEFORE the
+            # corrupt one — the caller restores + replays through it
+            target = None
+            step = ck.latest_verified_step()
+            if step is not None and step < verdict.step:
+                target = int(step)
+            else:
+                try:
+                    for s in sorted(ck._mgr.all_steps(), reverse=True):
+                        if s < verdict.step and ck.verify(s):
+                            target = int(s)
+                            break
+                except Exception:
+                    target = None
+            verdict.rollback_step = target if target is not None else 0
+        suspect = verdict.suspects[0] if verdict.suspects else None
+        if suspect is not None and self.kv is not None:
+            if quarantine_worker(self.kv, suspect,
+                                 reason=f"sdc step {verdict.step}"):
+                verdict.quarantined = suspect
+        log.warn("sdc corruption CONFIRMED", step=verdict.step,
+                 trigger=verdict.trigger,
+                 rollback_step=verdict.rollback_step,
+                 quarantined=verdict.quarantined)
+        if self.flight_dir:
+            from edl_tpu.observability.metrics import dump_flight_record
+
+            trail = [v.to_dict() for v in self.verdicts[-8:]]
+            trail.append(verdict.to_dict())
+            try:
+                dump_flight_record(
+                    self.flight_dir, "sdc-corruption",
+                    extra={"sdc": verdict.to_dict(),
+                           "sdc_verdict_trail": trail})
+            except Exception as exc:
+                log.warn("sdc flight record failed", error=str(exc)[:120])
+        if self.on_confirmed is not None:
+            try:
+                self.on_confirmed(verdict)
+            except Exception as exc:
+                log.warn("sdc on_confirmed callback failed",
+                         error=str(exc)[:120])

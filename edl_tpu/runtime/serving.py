@@ -1072,6 +1072,18 @@ class ServingFleet:
         step = checkpointer.latest_verified_step()
         if step is None or step <= self.generation:
             return None
+        # verified lineage (doc/sdc_defense.md): a generation whose
+        # manifest does not carry the verified bit — or carries a
+        # FORGED one — must never ship to the fleet.  A corrupt trainer
+        # keeps training through its own rollback; serving just skips
+        # the generation and waits for a verified one.  Manifests from
+        # before the verified bit (None) keep serving unchanged.
+        verified_fn = getattr(checkpointer, "manifest_verified", None)
+        if verified_fn is not None and verified_fn(step) is False:
+            log.warn("serving reload SKIPPED unverified generation",
+                     job=self.job, generation=step)
+            get_counters().inc("serving_reload_skipped_unverified")
+            return None
         with self._lock:
             template = next((r.server for r in self._replicas
                              if r.server is not None), None)
@@ -1079,6 +1091,17 @@ class ServingFleet:
             return None
         restored = checkpointer.restore({"params": template.params_host()},
                                         step=step)
+        # the restore itself re-hashes what it parsed against the
+        # manifest and falls back past a failing step — if it LANDED
+        # anywhere but the requested generation, refuse to publish that
+        # older tree under the newer generation number
+        landed = getattr(checkpointer, "last_restored_step", step)
+        if landed is not None and landed != step:
+            log.warn("serving reload SKIPPED generation that failed "
+                     "verification at restore", job=self.job,
+                     generation=step, landed=landed)
+            get_counters().inc("serving_reload_skipped_unverified")
+            return None
         self.rolling_reload(restored["params"], step)
         return step
 

@@ -440,6 +440,8 @@ class VirtualRunReport:
     world_sizes: list[int] = field(default_factory=list)
     resizes: int = 0
     vw_moves: int = 0
+    #: confirmed-corruption rollbacks the loop performed (SDC plane)
+    rollbacks: int = 0
     #: exactly-once ledger: global row id → times an APPLIED update
     #: trained on it (rows consumed by an aborted accumulation are
     #: re-fetched on restore and must appear exactly once here)
@@ -469,7 +471,8 @@ class VirtualWorkerLoop:
                  kv=None, job: str = "job",
                  checkpointer=None, ckpt_every: int = 0,
                  augment: Optional[Callable[[tuple, Any], tuple]] = None,
-                 report: Optional[VirtualRunReport] = None) -> None:
+                 report: Optional[VirtualRunReport] = None,
+                 sdc=None) -> None:
         self.trainer = trainer
         self.cfg = cfg
         self.batches = batches
@@ -477,6 +480,16 @@ class VirtualWorkerLoop:
         self.job = job
         self.checkpointer = checkpointer
         self.ckpt_every = int(ckpt_every)
+        #: the SDC defense plane (:class:`edl_tpu.runtime.sdc.SdcPlane`)
+        #: consulted after every applied update; a confirmed verdict
+        #: rolls this loop back to the verdict's verified checkpoint and
+        #: replays through the VW cursors — the stitched trajectory is
+        #: bitwise-identical to an uninjected control (replicated mode)
+        self.sdc = sdc
+        #: per-step committed row ids, kept only under an SDC plane so a
+        #: rollback can rewind the exactly-once ledger it re-trains
+        self._rows_log: Optional[list[list[int]]] = ([] if sdc is not None
+                                                     else None)
         #: host-side deterministic augmentation: (micro_batch, key) →
         #: micro_batch.  Draws keyed by the VW lineage, so augmentation
         #: is identical at any world size.
@@ -590,13 +603,37 @@ class VirtualWorkerLoop:
                 micro = [self.augment(mb, k) for mb, k in zip(micro, keys)]
             loss = self.trainer.step_accumulate(
                 micro, rng_keys=keys if self.trainer.rng_in_loss else None)
+            if self.sdc is not None:
+                # the SDC ladder runs BEFORE the step's effects commit:
+                # a confirmed corruption must never reach the ledger,
+                # the trajectory, or (run the fingerprint at least as
+                # often as the checkpoint cadence) a verified save
+                verdict = self.sdc.after_step(self.batches.step,
+                                              float(loss),
+                                              self.trainer.state.params)
+                if verdict is not None:
+                    if verdict.outcome == "confirmed":
+                        if self._rollback(verdict):
+                            continue  # replay from the verified anchor
+                    elif (not np.isfinite(float(loss))
+                          and np.isfinite(verdict.shadow_loss)):
+                        # refuted NaN (PoisonLoss): the params are clean
+                        # and the shadow recomputed the honest loss —
+                        # repair the METRIC so the trajectory stays
+                        # bitwise-continuous with the control
+                        loss = verdict.shadow_loss
+                        get_counters().inc("sdc_losses_repaired")
             # the update APPLIED: commit this step's rows to the
             # exactly-once ledger and persist the cursors (KV write
             # rides HA replication)
+            step_gids: list[int] = []
             for ids in self.batches.last_step_rows:
                 for gid in ids.tolist():
                     self.report.rows_trained[gid] = (
                         self.report.rows_trained.get(gid, 0) + 1)
+                    step_gids.append(gid)
+            if self._rows_log is not None:
+                self._rows_log.append(step_gids)
             if self.cursors is not None:
                 self.cursors.save(self.batches.state())
             self.report.losses.append(float(loss))
@@ -611,7 +648,63 @@ class VirtualWorkerLoop:
             if on_step is not None:
                 on_step(self.batches.step, float(loss),
                         self.trainer.world_size)
+        if self.sdc is not None:
+            self.sdc.fingerprinter.drain()
         return self.report
+
+    def _rollback(self, verdict) -> bool:
+        """Roll the loop back to ``verdict.rollback_step`` (the newest
+        verified checkpoint before the corruption): restore trainer
+        state + VW cursors through the existing transactional restore
+        machinery, rewind the exactly-once ledger and the recorded
+        trajectory, and let :meth:`run` replay.  Returns False when no
+        verified anchor exists (the loop continues damaged — counted,
+        never wedged)."""
+        target = verdict.rollback_step or 0
+        if self.checkpointer is None or target <= 0:
+            log.warn("sdc rollback impossible: no verified checkpoint "
+                     "precedes the corruption", step=verdict.step)
+            get_counters().inc("sdc_rollbacks_skipped")
+            return False
+        tree = {"params": self.trainer.state.params,
+                "opt": self.trainer.state.opt_state}
+        restored = self.checkpointer.restore(tree, step=target)
+        self.trainer.state.params = restored["params"]
+        self.trainer.state.opt_state = restored["opt"]
+        self.trainer.state.step = target
+        meta = self.checkpointer.load_meta(target)
+        cursor = (meta or {}).get("cursor")
+        if cursor is None:
+            cursor = self.batches.cursors_for_step(target)
+        self.batches.restore(cursor)
+        # rewind every post-anchor commit: the replayed steps must land
+        # in the ledger exactly once, and the stitched trajectory must
+        # read as if the corrupt steps never happened.  The lists hold
+        # one entry per step completed THIS run (a resumed run starts
+        # mid-stream), so truncate by how many steps are being undone —
+        # the corrupt step itself (verdict.step) never committed.
+        undone = verdict.step - 1 - target
+        keep = max(len(self.report.losses) - undone, 0)
+        if self._rows_log is not None:
+            for gids in self._rows_log[keep:]:
+                for gid in gids:
+                    n = self.report.rows_trained.get(gid, 0) - 1
+                    if n > 0:
+                        self.report.rows_trained[gid] = n
+                    else:
+                        self.report.rows_trained.pop(gid, None)
+            del self._rows_log[keep:]
+        del self.report.losses[keep:]
+        del self.report.world_sizes[keep:]
+        if self.cursors is not None:
+            self.cursors.save(self.batches.state())
+        self.report.rollbacks += 1
+        log.warn("sdc rollback complete; replaying through VW cursors",
+                 from_step=verdict.step, to_step=target)
+        get_tracer().instant("sdc_rollback", category="chaos",
+                             from_step=verdict.step, to_step=target)
+        get_counters().inc("sdc_rollbacks")
+        return True
 
 
 # -- divergence accounting ---------------------------------------------------

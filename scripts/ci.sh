@@ -1270,4 +1270,119 @@ print("sched smoke OK:", {
     "gang_strandings": 0})
 EOF
 
+echo "== sdc smoke (2-worker CorruptGradient: detect → quarantine → rollback → bitwise)"
+# The SDC defense-plane tripwire (doc/sdc_defense.md): a corrupted
+# gradient on one of two lock-step dp workers must split the published
+# update fingerprints, be CONFIRMED by the shadow recomputation (which
+# also breaks the 2-way vote tie and names the corrupt worker), leave a
+# quarantine marker in coordinator KV, roll the corrupt worker back to
+# its last VERIFIED checkpoint, and replay to a final trajectory
+# BITWISE-IDENTICAL to the uninjected control — with every edl_sdc_*
+# series green under the strict exposition parser.
+JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+python - <<'EOF'
+import tempfile
+
+import jax, numpy as np, optax
+
+from edl_tpu.coord import local_service
+from edl_tpu.models import mlp
+from edl_tpu.observability.collector import get_counters
+from edl_tpu.observability.metrics import get_registry, parse_exposition
+from edl_tpu.parallel.mesh import MeshSpec
+from edl_tpu.runtime.checkpoint import ElasticCheckpointer
+from edl_tpu.runtime.data import ShardRegistry
+from edl_tpu.runtime.elastic import ElasticTrainer
+from edl_tpu.runtime.faults import (CorruptGradient, FaultContext,
+                                    FaultPlan, FaultPlanEngine)
+from edl_tpu.runtime.sdc import (AnomalyDetector, SdcPlane, ShadowRecompute,
+                                 UpdateFingerprinter, clear_quarantine,
+                                 quarantined_names)
+from edl_tpu.runtime.virtual import (VirtualBatches, VirtualConfig,
+                                     VirtualWorkerLoop)
+
+SEED, STEPS = 3, 14
+CFG = VirtualConfig(vw_count=8, global_batch=64, job_seed=SEED)
+rng = np.random.default_rng(1)
+y = rng.integers(0, 4, 2048).astype(np.int32)
+x = rng.normal(size=(2048, 16)).astype(np.float32)
+reg = ShardRegistry()
+ids = reg.register_arrays((x, y), num_shards=16)
+
+def trainer():
+    params = mlp.init(jax.random.key(0), [16, 32, 4])
+    return ElasticTrainer(mlp.loss_fn, params, optax.adam(1e-2),
+                          spec=MeshSpec(dp=-1), initial_world_size=1,
+                          accum_mode="replicated")
+
+def batches():
+    return VirtualBatches(CFG, ids, reg.get, passes=2)
+
+control = VirtualWorkerLoop(trainer(), CFG, batches()).run(max_steps=STEPS)
+
+kv = local_service()
+rigs = {}
+for worker in ("wA", "wB"):
+    ck = ElasticCheckpointer(tempfile.mkdtemp(prefix=f"edl-ci-sdc-{worker}-"))
+    tr = trainer()
+    plane = SdcPlane(
+        fingerprinter=UpdateFingerprinter(kv=kv, job="ci-sdc",
+                                          worker=worker),
+        detector=AnomalyDetector(),
+        shadow=ShadowRecompute(trainer, batches, CFG, checkpointer=ck),
+        checkpointer=ck, kv=kv)
+    loop = VirtualWorkerLoop(tr, CFG, batches(), checkpointer=ck,
+                             ckpt_every=5, sdc=plane)
+    rigs[worker] = (tr, loop, plane, ck)
+
+# the corruption strikes wB through the seeded fault engine; lock-step
+# interleave so each worker's published fingerprint is visible to the
+# peer's next cross-check
+plan = FaultPlan(actions=[CorruptGradient(at_step=7)], seed=SEED)
+ctx = FaultContext()
+ctx.trainer = rigs["wB"][0]
+engine = FaultPlanEngine(plan, ctx)
+for i in range(1, STEPS + 1):
+    engine(i)
+    rigs["wA"][1].run(max_steps=i)
+    rigs["wB"][1].run(max_steps=i)
+
+_, loopA, planeA, ckA = rigs["wA"]
+_, loopB, planeB, ckB = rigs["wB"]
+conf = [v for v in planeB.verdicts if v.outcome == "confirmed"]
+assert conf and conf[0].trigger == "fp_mismatch", planeB.verdicts
+assert conf[0].quarantined == "wB", conf[0].to_dict()
+assert "wB" in quarantined_names(kv), "quarantine marker missing from KV"
+assert loopB.report.rollbacks == 1, loopB.report
+assert loopA.report.rollbacks == 0, "the honest peer rolled back"
+assert loopB.report.losses == control.losses, "wB not bitwise vs control"
+assert loopA.report.losses == control.losses, "wA not bitwise vs control"
+assert engine.quiescent() and engine.recovered == ["corrupt_gradient"]
+
+# every edl_sdc_* series green under the strict parser
+series = parse_exposition(get_registry().render())
+for need in ("edl_sdc_fingerprints_total",
+             'edl_sdc_anomalies_total{trigger="fp_mismatch"}',
+             'edl_sdc_verdicts_total{outcome="confirmed"}',
+             "edl_sdc_rollbacks_total",
+             "edl_sdc_quarantines_total"):
+    assert any(k == need or k.startswith(need.rstrip("}") + ",")
+               for k in series), (need, sorted(series)[:40])
+assert series['edl_sdc_verdicts_total{outcome="confirmed"}'] >= 1
+assert series["edl_sdc_rollbacks_total"] >= 1
+assert series["edl_sdc_quarantines_total"] >= 1
+assert any(k.startswith("edl_sdc_fingerprint_seconds") for k in series)
+
+clear_quarantine(kv, "wB")
+ckA.close()
+ckB.close()
+c = get_counters()
+print("sdc smoke OK:", {
+    "trigger": conf[0].trigger, "quarantined": conf[0].quarantined,
+    "rollback_step": conf[0].rollback_step,
+    "rollbacks_B": loopB.report.rollbacks,
+    "bitwise": loopB.report.losses == control.losses,
+    "fingerprints": int(c.get("sdc_fingerprints"))})
+EOF
+
 echo "CI OK"

@@ -361,7 +361,7 @@ class TestCheckpointMeta:
         assert ck.load_meta(6) == self.META
         manifest = json.loads(
             (tmp_path / ".integrity" / "6.json").read_text())
-        assert manifest["version"] == 2
+        assert manifest["version"] == 3
         assert manifest["meta"] is not None
         assert ck.verify(6)
         ck.close()

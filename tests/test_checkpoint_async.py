@@ -228,3 +228,58 @@ def test_restore_drains_inflight_persist(tmp_path):
     restored = ck.restore(tree(0))  # must not read under the write
     assert int(restored["step"][0]) == 1
     ck.close()
+
+
+def test_later_sync_save_races_persist_thread_ordering_pinned(tmp_path):
+    """PR 17 satellite: save_async(N)'s persist thread vs a concurrent
+    SYNC save(N+1) from the step loop.  The sync save must queue behind
+    the in-flight persist (never interleave Orbax writes), step N's
+    manifest + meta sidecar must land BEFORE step N+1's, and both steps
+    end fully verified with N+1 as the newest verified step."""
+    import threading
+
+    entered = threading.Event()
+    release = threading.Event()
+
+    class SlowPersist(ElasticCheckpointer):
+        def _persist(self, step, tree_, wait, best_effort, meta=None):
+            if step == 5:
+                entered.set()
+                assert release.wait(10), "test deadlock"
+            return super()._persist(step, tree_, wait=wait,
+                                    best_effort=best_effort, meta=meta)
+
+    ck = SlowPersist(tmp_path)
+    ck.save_async(5, tree(5), meta={"cursor": "c5"})
+    assert entered.wait(10)
+
+    done = []
+    racer = threading.Thread(
+        target=lambda: done.append(
+            ck.save(6, tree(6), wait=True, meta={"cursor": "c6"})))
+    racer.start()
+    time.sleep(0.2)
+    # the sync save is parked in wait_pending: NOTHING of step 6 exists
+    # yet, and step 5's manifest is still owed by the stalled persist
+    assert done == []
+    assert not (Path(tmp_path) / ".integrity" / "6.json").exists()
+    assert not (Path(tmp_path) / ".integrity" / "5.json").exists()
+
+    release.set()
+    racer.join(30)
+    assert done == [True]
+    # ordering landed: 5 then 6, each with its own meta sidecar
+    for step, cursor in ((5, "c5"), (6, "c6")):
+        manifest = json.loads(
+            (Path(tmp_path) / ".integrity" / f"{step}.json").read_text())
+        assert manifest["verified"] is True and manifest["tree_hash"]
+        assert ck.load_meta(step)["cursor"] == cursor
+    assert ck.latest_verified_step() == 6
+    # finalize() after the fact owes nothing and clobbers nothing
+    ck.finalize()
+    assert ck.manifest_verified(5) is True
+    assert ck.manifest_verified(6) is True
+    restored = ck.restore(tree(0), step=6)
+    assert int(restored["step"][0]) == 6
+    assert ck.last_restore_hash_ok is True
+    ck.close()

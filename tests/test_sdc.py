@@ -1,0 +1,471 @@
+"""The SDC defense plane (edl_tpu.runtime.sdc): silent-data-corruption
+detection and repair.
+
+The acceptance property (ISSUE 17 / doc/sdc_defense.md): a training run
+struck by a silent corruption — a flipped gradient bit, a flipped live
+parameter bit — detects it (fingerprint cross-check or loss-anomaly
+gate), confirms it against an independent shadow recomputation, rolls
+back to the last VERIFIED checkpoint, quarantines the suspect worker,
+and replays through the virtual-worker cursors so the stitched
+trajectory is BITWISE-IDENTICAL to an uninjected control.  A poisoned
+metric over clean parameters must be REFUTED, not rolled back.
+
+Also home to: the fingerprint/fold primitives, the dp cross-check
+minority vote, the verified-lineage manifest bits (checkpoint v3), the
+quarantine marker's keepalive/amnesty contract, and the seeded SDC
+fault-plan determinism.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import optax  # noqa: E402
+
+from edl_tpu.coord import PyCoordService, local_service  # noqa: E402
+from edl_tpu.models import mlp  # noqa: E402
+from edl_tpu.observability.collector import get_counters  # noqa: E402
+from edl_tpu.parallel.mesh import MeshSpec  # noqa: E402
+from edl_tpu.runtime.checkpoint import ElasticCheckpointer  # noqa: E402
+from edl_tpu.runtime.data import ShardRegistry  # noqa: E402
+from edl_tpu.runtime.elastic import ElasticTrainer  # noqa: E402
+from edl_tpu.runtime.sdc import (  # noqa: E402
+    AnomalyDetector,
+    SdcPlane,
+    ShadowRecompute,
+    UpdateFingerprinter,
+    clear_quarantine,
+    flip_tree_bit,
+    fold_fingerprint,
+    quarantine_worker,
+    quarantined_names,
+    tree_fingerprint,
+    tree_leaf_folds,
+)
+from edl_tpu.runtime.virtual import (  # noqa: E402
+    VirtualBatches,
+    VirtualConfig,
+    VirtualWorkerLoop,
+)
+
+SEED = 3
+CFG = VirtualConfig(vw_count=8, global_batch=64, job_seed=SEED)
+STEPS = 14
+
+
+def _dataset(n=2048):
+    rng = np.random.default_rng(1)
+    y = rng.integers(0, 4, n).astype(np.int32)
+    x = rng.normal(size=(n, 16)).astype(np.float32)
+    return x, y
+
+
+def _batches():
+    reg = ShardRegistry()
+    ids = reg.register_arrays(_dataset(), num_shards=16)
+    return VirtualBatches(CFG, ids, reg.get, passes=2)
+
+
+def _trainer(world=1):
+    params = mlp.init(jax.random.key(0), [16, 32, 4])
+    return ElasticTrainer(mlp.loss_fn, params, optax.adam(1e-2),
+                          spec=MeshSpec(dp=-1), initial_world_size=world,
+                          accum_mode="replicated")
+
+
+@pytest.fixture(scope="module")
+def control():
+    """The uninjected reference trajectory every drill compares against."""
+    return VirtualWorkerLoop(_trainer(), CFG, _batches()).run(max_steps=STEPS)
+
+
+def _plane(ck=None, kv=None, job="job", worker="w0", flight_dir=None):
+    shadow = ShadowRecompute(_trainer, _batches, CFG, checkpointer=ck)
+    return SdcPlane(
+        fingerprinter=UpdateFingerprinter(kv=kv, job=job, worker=worker),
+        detector=AnomalyDetector(), shadow=shadow, checkpointer=ck,
+        flight_dir=flight_dir)
+
+
+# ---------------------------------------------------------------------------
+# fingerprint primitives
+# ---------------------------------------------------------------------------
+
+class TestFingerprintPrimitives:
+    def test_tree_fingerprint_deterministic_and_bit_sensitive(self):
+        t = {"w": np.arange(64, dtype=np.float32),
+             "b": {"c": np.ones((4, 4), np.float64)}}
+        fp = tree_fingerprint(t)
+        assert fp == tree_fingerprint(t)  # pure
+        assert len(fp) == 16 and int(fp, 16) >= 0
+        for leaf in range(2):
+            flipped = tree_fingerprint(flip_tree_bit(t, leaf=leaf, bit=0))
+            assert flipped != fp  # ONE flipped bit anywhere changes it
+
+    def test_flip_is_an_involution_and_copies(self):
+        t = {"w": np.arange(8, dtype=np.float32)}
+        before = t["w"].copy()
+        once = flip_tree_bit(t, leaf=0, bit=3)
+        assert np.array_equal(t["w"], before)  # original untouched
+        twice = flip_tree_bit(once, leaf=0, bit=3)
+        assert tree_fingerprint(twice) == tree_fingerprint(t)
+
+    def test_fold_is_dtype_and_shape_sensitive(self):
+        a = {"x": np.zeros(4, np.float32)}
+        b = {"x": np.zeros(4, np.float64)}
+        c = {"x": np.zeros(8, np.float32)}
+        fps = {tree_fingerprint(a), tree_fingerprint(b), tree_fingerprint(c)}
+        assert len(fps) == 3  # same bytes-ish content, all distinguished
+
+    def test_fold_fingerprint_is_path_keyed(self):
+        # the same leaf folds under different paths must not collide by
+        # commuting — the combiner is order-fixed over sorted paths
+        f1 = fold_fingerprint({"a": 1, "b": 2})
+        f2 = fold_fingerprint({"a": 2, "b": 1})
+        assert f1 != f2
+
+    def test_tree_leaf_folds_cover_every_leaf(self):
+        t = {"w": np.ones(4, np.float32), "b": {"c": np.ones(2, np.int32)}}
+        folds = tree_leaf_folds(t)
+        assert len(folds) == 2
+        assert all(isinstance(v, int) for v in folds.values())
+
+
+# ---------------------------------------------------------------------------
+# anomaly gate
+# ---------------------------------------------------------------------------
+
+class TestAnomalyDetector:
+    def test_clean_stream_never_trips(self):
+        det = AnomalyDetector()
+        rng = np.random.default_rng(0)
+        for _ in range(200):
+            assert det.observe(1.5 + 0.05 * rng.standard_normal()) is None
+
+    def test_nan_and_inf_always_trip(self):
+        det = AnomalyDetector()
+        assert det.observe(float("nan")) == "nan"
+        assert det.observe(float("inf")) == "nan"
+
+    def test_spike_trips_after_warmup(self):
+        det = AnomalyDetector(z=6.0, warmup=8)
+        for i in range(20):
+            det.observe(1.0 + 0.01 * math.sin(i))
+        assert det.observe(3.0) == "loss_spike"
+
+    def test_explosion_trips_even_during_warmup(self):
+        det = AnomalyDetector(warmup=8)
+        det.observe(1.8)
+        assert det.observe(8.5e36) == "loss_spike"
+
+    def test_anomaly_not_folded_into_baseline(self):
+        det = AnomalyDetector(z=6.0, warmup=4)
+        for i in range(10):
+            det.observe(1.0 + 0.01 * math.sin(i))
+        assert det.observe(50.0) == "loss_spike"
+        # the spike did NOT teach the detector that 50 is normal
+        assert det.observe(50.0) == "loss_spike"
+        assert det.observe(1.0) is None
+
+
+# ---------------------------------------------------------------------------
+# dp cross-check
+# ---------------------------------------------------------------------------
+
+class TestCrossCheck:
+    def _fp(self, kv, job, worker, cadence=1):
+        return UpdateFingerprinter(kv=kv, job=job, worker=worker,
+                                   cadence=cadence)
+
+    def test_majority_names_the_minority(self):
+        kv = PyCoordService()
+        t = {"w": np.ones(4, np.float32)}
+        bad = flip_tree_bit(t, bit=5)
+        for worker, tree in (("w0", t), ("w1", t), ("w2", bad)):
+            self._fp(kv, "j", worker).record(3, tree)
+        check = self._fp(kv, "j", "w0").cross_check(3)
+        assert check.mismatch and check.suspects == ["w2"]
+
+    def test_even_split_is_mismatch_without_suspects(self):
+        kv = PyCoordService()
+        t = {"w": np.ones(4, np.float32)}
+        self._fp(kv, "j", "w0").record(3, t)
+        self._fp(kv, "j", "w1").record(3, flip_tree_bit(t, bit=5))
+        check = self._fp(kv, "j", "w0").cross_check(3)
+        assert check.mismatch and check.suspects == []
+
+    def test_agreement_and_singleton(self):
+        kv = PyCoordService()
+        t = {"w": np.ones(4, np.float32)}
+        fp0 = self._fp(kv, "j", "w0")
+        fp0.record(3, t)
+        assert fp0.cross_check(3) is None  # alone: nothing to check
+        self._fp(kv, "j", "w1").record(3, t)
+        check = fp0.cross_check(3)
+        assert check is not None and not check.mismatch
+
+    def test_cadence_skips_off_steps(self):
+        fp = UpdateFingerprinter(cadence=5)
+        t = {"w": np.ones(4, np.float32)}
+        assert fp.record(3, t) is None
+        assert fp.record(5, t) is not None
+        assert get_counters().get("sdc_fingerprints") >= 1
+
+
+# ---------------------------------------------------------------------------
+# verified lineage (checkpoint manifest v3)
+# ---------------------------------------------------------------------------
+
+class TestVerifiedLineage:
+    def _tree(self, step):
+        return {"w": np.arange(64, dtype=np.float32) * (step + 1),
+                "b": np.ones((8,), np.float32) * step}
+
+    def test_sync_save_writes_verified_manifest(self, tmp_path):
+        ck = ElasticCheckpointer(tmp_path / "ck")
+        ck.save(1, self._tree(1))
+        m = ck.manifest(1)
+        assert m["version"] == 3 and m["verified"] is True
+        assert m["tree_hash"] == tree_fingerprint(self._tree(1))
+        assert set(m["leaves"]) == set(tree_leaf_folds(self._tree(1)))
+        assert ck.manifest_verified(1) is True
+        ck.close()
+
+    def test_async_save_verifies_at_finalize(self, tmp_path):
+        ck = ElasticCheckpointer(tmp_path / "ck")
+        ck.save_async(2, self._tree(2))
+        ck.finalize()
+        assert ck.manifest_verified(2) is True
+        assert ck.manifest(2)["tree_hash"] == tree_fingerprint(self._tree(2))
+        ck.close()
+
+    def test_forged_manifest_reads_unverified(self, tmp_path):
+        ck = ElasticCheckpointer(tmp_path / "ck")
+        ck.save(1, self._tree(1))
+        mpath = ck._manifest_path(1)
+        m = json.loads(mpath.read_text())
+        del m["verified"]
+        mpath.write_text(json.dumps(m))
+        assert ck.manifest_verified(1) is False
+        ck.close()
+
+    def test_verify_restored_spot_checks_shared_leaves(self, tmp_path):
+        ck = ElasticCheckpointer(tmp_path / "ck")
+        ck.save(1, self._tree(1))
+        good = ck.restore(self._tree(0), step=1)
+        assert ck.verify_restored(1, good) is True
+        assert ck.last_restore_hash_ok is True
+        bad = dict(good)
+        bad["w"] = np.asarray(flip_tree_bit({"w": good["w"]}, bit=9)["w"])
+        assert ck.verify_restored(1, bad) is False
+        # a PARTIAL tree verifies its shared subset only
+        assert ck.verify_restored(1, {"b": good["b"]}) is True
+        assert ck.verify_restored(1, {"zzz": good["b"]}) is None
+        ck.close()
+
+    def test_restore_falls_back_past_hash_forged_step(self, tmp_path):
+        """Files intact + CRCs matching + Orbax parsing — but the
+        manifest's leaf hashes disagree with what was parsed (a forged
+        manifest around substituted data).  restore() must fall back to
+        the previous verified step and count the detection."""
+        ck = ElasticCheckpointer(tmp_path / "ck", max_to_keep=4)
+        ck.save(1, self._tree(1))
+        ck.save(2, self._tree(2))
+        mpath = ck._manifest_path(2)
+        m = json.loads(mpath.read_text())
+        first = sorted(m["leaves"])[0]
+        m["leaves"][first] = f"{0:016x}"  # lie about one leaf
+        mpath.write_text(json.dumps(m))
+        before = get_counters().get("checkpoint_tree_hash_mismatch")
+        restored = ck.restore(self._tree(0))
+        assert np.array_equal(restored["w"], self._tree(1)["w"])  # fell back
+        assert ck.last_restored_step == 1
+        assert get_counters().get("checkpoint_tree_hash_mismatch") == before + 1
+        ck.close()
+
+
+# ---------------------------------------------------------------------------
+# quarantine protocol (PR 2 eviction contract, SDC flavor)
+# ---------------------------------------------------------------------------
+
+class TestQuarantine:
+    def test_marker_declines_rejoin_and_amnesty_lifts_it(self):
+        from edl_tpu.runtime.multihost import ElasticWorld
+
+        coord = PyCoordService()
+        healthy = ElasticWorld(coord, "w0")
+        healthy.join()
+        assert quarantine_worker(coord, "w1", reason="sdc step 9")
+        assert "w1" in quarantined_names(coord)
+        # membership machinery sees it exactly like an eviction
+        assert "w1" in healthy.evicted_names()
+        # the fresh incarnation's first act lifts its own marker
+        reborn = ElasticWorld(coord, "w1", settle_s=0.05, poll_s=0.01)
+        assert reborn.clear_eviction() is True
+        assert "w1" not in quarantined_names(coord)
+        reborn.join()
+        _, names = reborn.wait_stable(min_members=2, timeout_s=5.0)
+        assert "w1" in names
+
+    def test_clear_quarantine_idempotent(self):
+        kv = PyCoordService()
+        quarantine_worker(kv, "w9")
+        assert clear_quarantine(kv, "w9") is True
+        assert clear_quarantine(kv, "w9") is False
+
+    def test_fp_keys_are_job_swept_markers_are_not(self):
+        from edl_tpu.coord.gc import JOB_KV_PREFIXES, gc_job_kv
+
+        assert "sdc-fp/" in JOB_KV_PREFIXES
+        kv = PyCoordService()
+        kv.kv_set("sdc-fp/j/5/w0", b"x")
+        quarantine_worker(kv, "w0")
+        assert gc_job_kv(kv, "j") == 1
+        assert kv.kv_get("sdc-fp/j/5/w0") is None
+        assert "w0" in quarantined_names(kv)  # per-worker: survives the job
+
+
+# ---------------------------------------------------------------------------
+# seeded SDC fault plans
+# ---------------------------------------------------------------------------
+
+class TestSdcFaultPlans:
+    def test_kinds_registered_and_frozen(self):
+        from edl_tpu.runtime.faults import ACTION_TYPES, SDC_KINDS
+
+        assert SDC_KINDS == ("corrupt_gradient", "flip_param_bits",
+                             "poison_loss")
+        for kind in SDC_KINDS:
+            assert kind in ACTION_TYPES
+
+    def test_seeded_plan_is_deterministic(self):
+        from edl_tpu.runtime.faults import FaultPlan, SDC_KINDS
+
+        a = FaultPlan.random(11, n_faults=3, kinds=SDC_KINDS)
+        b = FaultPlan.random(11, n_faults=3, kinds=SDC_KINDS)
+        assert a.describe() == b.describe()
+        assert {d["kind"] for d in a.describe()} == set(SDC_KINDS)
+
+    def test_actions_require_a_trainer_in_ctx(self):
+        from edl_tpu.runtime.faults import CorruptGradient, FaultContext
+
+        with pytest.raises(RuntimeError, match="trainer"):
+            CorruptGradient().fire(FaultContext())
+
+
+# ---------------------------------------------------------------------------
+# the drills: detect → shadow → rollback → bitwise replay
+# ---------------------------------------------------------------------------
+
+class TestEndToEndDrills:
+    def test_flip_param_bits_confirmed_rolled_back_bitwise(
+            self, tmp_path, control):
+        """Drill 1 (single worker): a live parameter bit flip explodes
+        the next loss → anomaly gate → shadow recompute from the last
+        verified checkpoint CONFIRMS → rollback + cursor replay.  The
+        final trajectory is bitwise-identical to the uninjected
+        control, the ledger balances, and the flight record carries the
+        verdict trail."""
+        ck = ElasticCheckpointer(tmp_path / "ck")
+        tr = _trainer()
+        plane = _plane(ck=ck, flight_dir=str(tmp_path / "fr"))
+        loop = VirtualWorkerLoop(tr, CFG, _batches(), checkpointer=ck,
+                                 ckpt_every=5, sdc=plane)
+        fired = []
+
+        def strike(step, loss, world):
+            if step == 7 and not fired:
+                fired.append(step)
+                tr.flip_param_bits(leaf=0, bit=30)
+
+        rep = loop.run(max_steps=STEPS, on_step=strike)
+        assert rep.rollbacks == 1
+        conf = [v for v in plane.verdicts if v.outcome == "confirmed"]
+        assert conf and conf[0].rollback_step == 5
+        assert not plane.healthy()
+        assert rep.losses == control.losses  # BITWISE continuity
+        assert rep.rows_trained == control.rows_trained  # exactly-once held
+        recs = list((tmp_path / "fr").glob("*.json"))
+        assert recs
+        payload = json.loads(recs[0].read_text())["extra"]
+        assert payload["sdc"]["outcome"] == "confirmed"
+        assert payload["sdc"]["trigger"] in ("loss_spike", "nan")
+        trail = payload["sdc_verdict_trail"]
+        assert trail[-1]["rollback_step"] == 5
+        ck.close()
+
+    def test_corrupt_gradient_cross_checked_and_quarantined(
+            self, tmp_path, control):
+        """Drill 2 (two dp workers in lock-step): one worker's
+        accumulated gradient is corrupted pre-apply.  Its published
+        fingerprint splits from its peer's; the shadow recomputation
+        breaks the 2-way tie, names the corrupt worker, quarantines it,
+        and rolls it back — BOTH workers end bitwise-equal to the
+        control, and the fired CorruptGradient fault's recovery
+        predicate observes the rollback."""
+        from edl_tpu.runtime.faults import (CorruptGradient, FaultContext,
+                                            FaultPlan, FaultPlanEngine)
+
+        kv = local_service()
+        rigs = {}
+        for worker in ("wA", "wB"):
+            ck = ElasticCheckpointer(tmp_path / worker)
+            tr = _trainer()
+            plane = _plane(ck=ck, kv=kv, job="drill2", worker=worker)
+            loop = VirtualWorkerLoop(tr, CFG, _batches(), checkpointer=ck,
+                                     ckpt_every=5, sdc=plane)
+            rigs[worker] = (tr, loop, plane, ck)
+        # the corruption strikes wB through the seeded fault engine
+        plan = FaultPlan(actions=[CorruptGradient(at_step=7)], seed=SEED)
+        ctx = FaultContext()
+        ctx.trainer = rigs["wB"][0]
+        engine = FaultPlanEngine(plan, ctx)
+        for i in range(1, STEPS + 1):
+            engine(i)
+            rigs["wA"][1].run(max_steps=i)
+            rigs["wB"][1].run(max_steps=i)
+        _, loopA, planeA, ckA = rigs["wA"]
+        _, loopB, planeB, ckB = rigs["wB"]
+        conf = [v for v in planeB.verdicts if v.outcome == "confirmed"]
+        assert conf and conf[0].trigger == "fp_mismatch"
+        assert conf[0].quarantined == "wB"
+        assert "wB" in quarantined_names(kv)
+        assert loopB.report.rollbacks == 1
+        assert loopA.report.rollbacks == 0  # the honest peer never rolls
+        assert loopB.report.losses == control.losses
+        assert loopA.report.losses == control.losses
+        assert engine.quiescent() and engine.recovered == ["corrupt_gradient"]
+        clear_quarantine(kv, "wB")
+        ckA.close()
+        ckB.close()
+
+    def test_poison_loss_refuted_and_metric_repaired(self, control):
+        """Drill 3: a NaN loss REPORT over clean parameters.  The
+        shadow recompute refutes it (the honest recomputation matches
+        the live fingerprint), nothing rolls back, no one is
+        quarantined — and the recorded trajectory carries the repaired
+        honest loss, bitwise-equal to control."""
+        from edl_tpu.runtime.faults import (FaultContext, FaultPlanEngine,
+                                            PoisonLoss, FaultPlan)
+
+        tr = _trainer()
+        plane = _plane()
+        loop = VirtualWorkerLoop(tr, CFG, _batches(), sdc=plane)
+        plan = FaultPlan(actions=[PoisonLoss(at_step=6)], seed=SEED)
+        ctx = FaultContext()
+        ctx.trainer = tr
+        engine = FaultPlanEngine(plan, ctx)
+        before = get_counters().get("sdc_losses_repaired")
+        rep = loop.run(max_steps=STEPS, on_step=engine)
+        ref = [v for v in plane.verdicts if v.outcome == "refuted"]
+        assert ref and ref[0].trigger == "nan"
+        assert rep.rollbacks == 0
+        assert plane.healthy()  # a refuted episode is not ill health
+        assert rep.losses == control.losses
+        assert get_counters().get("sdc_losses_repaired") == before + 1
+        assert engine.quiescent() and engine.recovered == ["poison_loss"]

@@ -233,6 +233,62 @@ def test_reload_from_checkpoint_lineage(tmp_path):
         ckpt.close()
 
 
+def test_reload_skips_unverified_generation(tmp_path):
+    """PR 17 satellite: the fleet must NEVER load a generation whose
+    manifest does not carry (or forges) the verified bit.  A forged
+    manifest skips with a counter and the serving generation stands; a
+    later honestly-verified generation rolls on normally."""
+    import json
+
+    from edl_tpu.runtime.checkpoint import ElasticCheckpointer
+
+    ckpt = ElasticCheckpointer(tmp_path / "lineage", max_to_keep=4)
+    ckpt.save(1, {"params": PARAMS})
+    p2 = jax.tree.map(lambda a: a + 3.0, PARAMS)
+    ckpt.save(2, {"params": p2})
+    # forge generation 2: strip the verified bit, leave the files (and
+    # their CRCs) intact — latest_verified_step alone would take it
+    mpath = ckpt._manifest_path(2)
+    forged = json.loads(mpath.read_text())
+    del forged["verified"]
+    mpath.write_text(json.dumps(forged))
+
+    fleet = make_fleet(job="t/unverified", kv=None)
+    try:
+        fleet.scale_to(1)
+        fleet.generation = 1
+        before = get_counters().get("serving_reload_skipped_unverified")
+        assert fleet.reload_from_lineage(ckpt) is None
+        assert fleet.generation == 1  # the fleet never moved
+        assert get_counters().get(
+            "serving_reload_skipped_unverified") == before + 1
+        # generation 3 lies DEEPER: verified bit intact but the leaf
+        # hashes disagree with the stored bytes — restore() falls back
+        # past it, and publishing the fallback tree under generation 3
+        # is refused too
+        p3 = jax.tree.map(lambda a: a + 7.0, PARAMS)
+        ckpt.save(3, {"params": p3})
+        mpath = ckpt._manifest_path(3)
+        lied = json.loads(mpath.read_text())
+        leaf = sorted(lied["leaves"])[0]
+        lied["leaves"][leaf] = f"{0:016x}"
+        mpath.write_text(json.dumps(lied))
+        assert fleet.reload_from_lineage(ckpt) is None
+        assert fleet.generation == 1
+        assert get_counters().get(
+            "serving_reload_skipped_unverified") == before + 2
+        # an honest generation 4 ships
+        p4 = jax.tree.map(lambda a: a * 2.0, PARAMS)
+        ckpt.save(4, {"params": p4})
+        assert fleet.reload_from_lineage(ckpt) == 4
+        req = fleet.submit(row(1))
+        assert np.allclose(np.asarray(req.wait(5)),
+                           expected(row(1)[0], p4))
+    finally:
+        fleet.stop()
+        ckpt.close()
+
+
 def test_generation_published_to_coordinator_kv():
     from edl_tpu.coord import PyCoordService
 
