@@ -1851,6 +1851,155 @@ def serving_leg() -> dict:
     return out
 
 
+def decode_serving_leg() -> dict:
+    """Token-level continuous batching through a LIVE fleet resize
+    (ROADMAP #2; doc/serving.md §autoregressive serving): mixed-priority
+    autoregressive sessions stream through a 2-replica DecodeFleet —
+    sessions join/leave the running batch every iteration, prompts
+    prefill in chunks against the decode TPOT budget, per-request K/V
+    lives in the paged block pool — and MID-DECODE the fleet scales
+    2→1: every live session's K/V evacuates to the survivor through the
+    replan path.  Headline: sustained decode tok/s and TTFT p99 under
+    the SLO with ZERO dropped sessions, session count conserved
+    (completed + failed == submitted, failed == 0), and every
+    session's tokens BITWISE-equal to the full-context greedy
+    reference — migration reproduced the exact continuation."""
+    import time as _time
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")  # axon sitecustomize override
+    import numpy as np
+
+    from edl_tpu.models.transformer import TINY, apply, init
+    from edl_tpu.observability.metrics import get_registry, parse_exposition
+    from edl_tpu.runtime.serving import (
+        PRI_HIGH, PRI_LOW, PRI_NORMAL, DecodeFleet,
+    )
+
+    TTFT_SLO_MS = 5000.0   # CPU host: generous, but asserted in-leg
+    MAX_NEW = 32
+    JOB = "bench/decode"
+    params = init(jax.random.PRNGKey(0), TINY)
+
+    # the full-context greedy reference: what every paged / batched /
+    # migrated decode must reproduce token-for-token
+    def ref_decode(prompt, n):
+        toks = list(prompt)
+        out = []
+        for _ in range(n):
+            logits = apply(params, np.asarray([toks], np.int32), TINY)
+            t = int(np.asarray(logits[0, -1]).argmax())
+            out.append(t)
+            toks.append(t)
+        return out
+
+    rng = np.random.default_rng(11)
+    wave1 = [rng.integers(1, 255, size=int(rng.integers(3, 12))).tolist()
+             for _ in range(8)]
+    wave2 = [rng.integers(1, 255, size=int(rng.integers(3, 12))).tolist()
+             for _ in range(4)]
+    pri = [PRI_HIGH, PRI_NORMAL, PRI_NORMAL, PRI_LOW]
+
+    fleet = DecodeFleet(
+        params, TINY, job=JOB, roles={"decode": 2}, slots=4,
+        prefill_chunk=8, kv_blocks=96, kv_block_size=8,
+        max_blocks_per_session=8, ttft_slo_ms=TTFT_SLO_MS,
+        tpot_slo_ms=500.0)
+
+    phases: list[str] = []
+    sessions = []
+    ref = {}
+    dropped = migrations = 0
+    replicas_before = replicas_after = 0
+    toks_emitted = 0
+    decode_wall_s = 0.0
+    try:
+        t0 = _time.perf_counter()
+        phases.append("wave1: 8 sessions across 2 replicas")
+        for i, p in enumerate(wave1):
+            sessions.append(fleet.submit(p, max_new_tokens=MAX_NEW,
+                                         priority=pri[i % len(pri)]))
+        # wait until the batch is demonstrably DECODING (first tokens
+        # out) so the resize lands mid-generation, not between waves
+        for s in sessions[:4]:
+            s.wait_first_token(60)
+        replicas_before = fleet.replicas_active()
+        phases.append("LIVE resize 2->1: evacuate every session's KV "
+                      "to the survivor, zero drops")
+        fleet.scale_to(1)
+        replicas_after = fleet.replicas_active()
+        phases.append("wave2: 4 sessions onto the shrunken fleet")
+        for i, p in enumerate(wave2):
+            sessions.append(fleet.submit(p, max_new_tokens=MAX_NEW,
+                                         priority=pri[i % len(pri)]))
+        outs = [s.wait(240) for s in sessions]
+        decode_wall_s = _time.perf_counter() - t0
+        toks_emitted = sum(len(o) for o in outs)
+        migrations = fleet.migrations
+        dropped = fleet.sessions_failed
+        # the reference continuations, computed OUTSIDE the timed span
+        for p in wave1 + wave2:
+            ref[tuple(p)] = ref_decode(p, MAX_NEW)
+        bitwise_stable = all(
+            o == ref[tuple(s.prompt)] for s, o in zip(sessions, outs))
+        ttfts_ms = np.sort(np.asarray(
+            [s.ttft_s * 1e3 for s in sessions]))
+        ttft_p99_ms = float(ttfts_ms[int(0.99 * (len(ttfts_ms) - 1))])
+        stats = fleet.stats(window_s=decode_wall_s + 1.0)
+        kv_used_after, kv_total = fleet.kv_blocks()
+        # the scrape surface: strict-grammar parse, decode series live
+        series = parse_exposition(get_registry().render())
+        ttft_series = sum(1 for k in series
+                          if k.startswith("edl_serving_ttft_seconds")
+                          and JOB in k)
+        tpot_series = sum(1 for k in series
+                          if k.startswith("edl_serving_tpot_seconds")
+                          and JOB in k)
+        kv_series = sum(1 for k in series
+                        if k.startswith("edl_serving_kv_") and JOB in k)
+        out = {
+            "sessions_submitted": fleet.sessions_submitted,
+            "sessions_completed": fleet.sessions_completed,
+            "sessions_failed": dropped,
+            "decode_dropped_sessions": dropped,
+            "decode_migrations": migrations,
+            "decode_resized_live": (replicas_before, replicas_after),
+            "decode_tokens": toks_emitted,
+            "decode_tok_s": round(toks_emitted / max(decode_wall_s,
+                                                     1e-6), 2),
+            "decode_ttft_p99_ms": round(ttft_p99_ms, 3),
+            "decode_ttft_slo_ms": TTFT_SLO_MS,
+            "decode_tpot_p50_ms": stats.tpot_p50_ms,
+            "decode_bitwise_stable": bitwise_stable,
+            "decode_kv_blocks_used_after": kv_used_after,
+            "decode_kv_blocks_total": kv_total,
+            "decode_ttft_series": ttft_series,
+            "decode_tpot_series": tpot_series,
+            "decode_kv_series": kv_series,
+            "phases": phases,
+        }
+    finally:
+        # teardown BEFORE any assert: replica loops are non-daemon
+        # worker threads holding XLA buffers (XLA-teardown safety)
+        fleet.stop(drain=False)
+    # acceptance gates, in-leg: a regression fails the bench loudly
+    assert out["decode_dropped_sessions"] == 0, out
+    assert (out["sessions_completed"] + out["sessions_failed"]
+            == out["sessions_submitted"]), out
+    assert out["sessions_submitted"] == len(wave1) + len(wave2), out
+    assert out["decode_resized_live"] == (2, 1), out
+    assert out["decode_migrations"] >= 1, out
+    assert out["decode_bitwise_stable"], out
+    assert out["decode_tok_s"] > 0, out
+    assert out["decode_ttft_p99_ms"] <= TTFT_SLO_MS, out
+    assert out["decode_kv_blocks_used_after"] == 0, out
+    assert out["decode_ttft_series"] > 0, out
+    assert out["decode_tpot_series"] > 0, out
+    assert out["decode_kv_series"] > 0, out
+    return out
+
+
 def frontdoor_leg() -> dict:
     """The production serving data plane at 10⁵+ qps (ROADMAP #4's
     data-path half; doc/serving.md §data-plane): an OPEN-LOOP Poisson
@@ -3715,6 +3864,13 @@ def main() -> None:
                    "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
                    "PALLAS_AXON_POOL_IPS": ""})
 
+    # token-level continuous batching: autoregressive sessions through
+    # a live 2→1 resize with zero drops and bitwise-stable tokens
+    decode_serving = _run_leg(
+        "decode_serving", timeout_s=420,
+        extra_env={"JAX_PLATFORMS": "cpu", "XLA_FLAGS": "",
+                   "PALLAS_AXON_POOL_IPS": ""})
+
     # the production serving data plane: 10⁵+ qps open-loop through the
     # LB tier into a multi-replica front-door fleet, p99-under-SLO
     # through a scale-up, a rolling reload, a straggler and a kill
@@ -3776,6 +3932,7 @@ def main() -> None:
                    "goodput": goodput_r, "sched_sim": sched_sim,
                    "determinism": determinism, "sdc": sdc,
                    "serving": serving,
+                   "decode_serving": decode_serving,
                    "frontdoor": frontdoor, "chaos_serving": chaos,
                    "tpu_world_cycle": tpu_cycle},
     }
@@ -3890,6 +4047,16 @@ def main() -> None:
         "alerts_fired": serving.get("alerts_fired"),
         "fast_burn_evals_to_fire":
             serving.get("fast_burn_evals_to_fire"),
+        # token-level continuous batching (ROADMAP #2): sustained decode
+        # tok/s + TTFT p99 THROUGH a live 2→1 resize — zero dropped
+        # sessions, every continuation bitwise-equal to the reference
+        "decode_tok_s": decode_serving.get("decode_tok_s"),
+        "decode_ttft_p99_ms": decode_serving.get("decode_ttft_p99_ms"),
+        "decode_dropped_sessions":
+            decode_serving.get("decode_dropped_sessions"),
+        "decode_migrations": decode_serving.get("decode_migrations"),
+        "decode_bitwise_stable":
+            decode_serving.get("decode_bitwise_stable"),
         # the production serving data plane (ROADMAP #4 data-path half):
         # open-loop qps sustained through the LB tier with p99 under the
         # SLO across all four drill windows, requests-per-connection vs
@@ -4009,6 +4176,8 @@ if __name__ == "__main__":
             out = sched_sim_leg()
         elif leg == "serving":
             out = serving_leg()
+        elif leg == "decode_serving":
+            out = decode_serving_leg()
         elif leg == "frontdoor":
             out = frontdoor_leg()
         elif leg == "chaos_serving":

@@ -260,6 +260,40 @@ class ServingSpec:
     #: SLO typically run HIGH so a saturated fleet can preempt batch
     #: training for capacity
     priority: int = SchedPriority.NORMAL
+    # -- autoregressive decode serving (doc/serving.md §autoregressive) --
+    #: time-to-first-token objective (ms) for decode fleets — a second
+    #: SLO input to the autoscaling policy alongside ``slo_p99_ms``
+    #: (which defends per-request latency on stateless fleets and TTFT
+    #: keeps honest on decode fleets, where a "request" is a whole
+    #: session); 0 disables TTFT-driven scaling
+    slo_ttft_ms: float = 0.0
+    #: per-output-token time objective (ms) per decode iteration; the
+    #: batcher's prefill-interleave budget protects it, the violation
+    #: counter (``edl_serving_tpot_slo_violations_total``) audits it
+    slo_tpot_ms: float = 0.0
+    #: decode slots per replica — the fixed compiled decode batch shape
+    #: sessions continuously pack into (the decode twin of
+    #: ``max_batch_size``)
+    decode_slots: int = 8
+    #: paged KV pool shape per replica: ``kv_blocks`` blocks of
+    #: ``kv_block_size`` token positions; a session may hold at most
+    #: ``kv_max_blocks_per_session`` (bounds one prompt's footprint).
+    #: ``kv_blocks * kv_block_size`` is the replica's total resident
+    #: decode capacity in tokens — its bytes are accounted against the
+    #: resize memory filter like params.
+    kv_blocks: int = 256
+    kv_block_size: int = 16
+    kv_max_blocks_per_session: int = 32
+    #: prompt prefill chunk length (tokens per prefill iteration) —
+    #: interleaved against decode under the TPOT budget
+    prefill_chunk: int = 64
+    #: decode iterations the batcher runs between prefill chunks while
+    #: sessions are decoding (the TPOT-protection dial; higher favors
+    #: TPOT, lower favors TTFT)
+    decode_per_prefill: int = 2
+    #: prefill-tier replicas for disaggregated serving (0 = aggregated:
+    #: every replica both prefills and decodes)
+    prefill_replicas: int = 0
 
 
 @dataclass

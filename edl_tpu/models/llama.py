@@ -1,0 +1,265 @@
+"""Llama-family autoregressive serving surface: chunked prefill +
+cached single-token decode over a **paged** KV cache (ROADMAP #2;
+doc/serving.md §autoregressive serving).
+
+:mod:`edl_tpu.models.transformer` is the training-side decoder (full-
+sequence causal apply).  Serving needs the other two entry points the
+Orca/vLLM idiom is built from:
+
+* :func:`prefill` — run a fixed-size **chunk** of prompt tokens through
+  the stack, writing each token's K/V into the session's cache blocks
+  and attending to everything already cached.  Chunking keeps the
+  compiled shape fixed (no recompiles as prompt lengths move) and lets
+  the token scheduler interleave prompt work against running decodes
+  under a TPOT budget.
+* :func:`decode_step` — one token for every live slot in the fixed
+  decode batch: gather each slot's paged K/V context via its block
+  table, append the new token's K/V, return next-token logits.
+
+The cache itself is **block-paged** ([layers, num_blocks, block_size,
+kv_heads, head_dim] per K and V): a sequence owns a *list* of blocks,
+not a contiguous span, so a 5-token and a 5000-token session pack the
+same pool without fragmentation and a freed session's blocks are
+immediately reusable.  Block allocation/accounting lives in
+:mod:`edl_tpu.runtime.kvcache`; this module only ever sees block
+*tables* (``[slots, max_blocks]`` int32, logical order — flat gather
+index == absolute token position).
+
+Both entry points are shape-static (slots, chunk, max_blocks are
+compile-time constants) and donate the cache, so serving AOT-compiles
+them once per replica and the cache buffers update in place.  Dead
+slots/padded rows write with out-of-range block ids under
+``mode="drop"`` — garbage never lands in a real block.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from edl_tpu.models.transformer import (  # noqa: F401  (re-exports: the
+    FLAGSHIP,  # serving stack's one-stop model import)
+    LLAMA3_8B,
+    TINY,
+    TransformerConfig,
+    apply,
+    init,
+    rms_norm,
+    rope_freqs,
+)
+from edl_tpu.ops.embedding import embed_lookup
+
+
+# -- cache layout ------------------------------------------------------------
+
+
+def init_cache(cfg: TransformerConfig, num_blocks: int,
+               block_size: int) -> dict:
+    """The paged KV pool's device arrays: ``{"k", "v"}``, each
+    ``[n_layers, num_blocks, block_size, n_kv_heads, head_dim]`` in the
+    model's compute dtype.  Block 0 is a block like any other — the
+    *allocator* decides ownership; out-of-range ids are the drop
+    sentinel."""
+    shape = (cfg.n_layers, num_blocks, block_size,
+             cfg.n_kv_heads, cfg.head_dim)
+    return {"k": jnp.zeros(shape, cfg.dtype),
+            "v": jnp.zeros(shape, cfg.dtype)}
+
+
+def cache_bytes(cfg: TransformerConfig, num_blocks: int,
+                block_size: int) -> int:
+    """Resident bytes of :func:`init_cache`'s arrays — what the memory
+    filter and the goodput ledger account alongside params."""
+    itemsize = jnp.dtype(cfg.dtype).itemsize
+    return (2 * cfg.n_layers * num_blocks * block_size
+            * cfg.n_kv_heads * cfg.head_dim * itemsize)
+
+
+# -- shared attention over a paged context -----------------------------------
+
+
+def _rope_rows(cfg: TransformerConfig, x: jax.Array,
+               positions: jax.Array) -> jax.Array:
+    """RoPE for per-row positions: x ``[rows, heads, hd]``, positions
+    ``[rows]``."""
+    angles = rope_freqs(cfg, positions)  # [rows, hd/2]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    cos = jnp.cos(angles)[:, None, :]
+    sin = jnp.sin(angles)[:, None, :]
+    return jnp.concatenate(
+        [x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+
+
+def _paged_attention(q: jax.Array, ctx_k: jax.Array, ctx_v: jax.Array,
+                     q_pos: jax.Array, cfg: TransformerConfig) -> jax.Array:
+    """Attention of per-row queries against per-row paged contexts.
+
+    q ``[rows, h, hd]``; ctx_k/ctx_v ``[rows, T, kv, hd]`` where flat
+    context index == absolute token position; q_pos ``[rows]`` absolute
+    query positions.  Causal: row r attends to context positions
+    ``<= q_pos[r]``.  Returns ``[rows, h*hd]``."""
+    h, kv = cfg.n_heads, cfg.n_kv_heads
+    if kv != h:  # GQA: repeat kv heads for the reference einsum path
+        rep = h // kv
+        ctx_k = jnp.repeat(ctx_k, rep, axis=2)
+        ctx_v = jnp.repeat(ctx_v, rep, axis=2)
+    scores = jnp.einsum("rhd,rthd->rht", q.astype(jnp.float32),
+                        ctx_k.astype(jnp.float32))
+    scores = scores / (cfg.head_dim ** 0.5)
+    t_idx = jnp.arange(ctx_k.shape[1])
+    mask = t_idx[None, None, :] <= q_pos[:, None, None]
+    scores = jnp.where(mask, scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("rht,rthd->rhd", probs, ctx_v.astype(jnp.float32))
+    return out.reshape(out.shape[0], h * cfg.head_dim).astype(cfg.dtype)
+
+
+def _forward_rows(params: dict, cache: dict, tokens: jax.Array,
+                  positions: jax.Array, block_tables: jax.Array,
+                  write_blk: jax.Array, write_off: jax.Array,
+                  cfg: TransformerConfig) -> tuple[jax.Array, dict]:
+    """The shared layer stack for both entry points: per-row tokens at
+    per-row absolute positions, K/V written into ``(write_blk,
+    write_off)`` (out-of-range blk → dropped), attention over each
+    row's block-table context.  Returns (logits ``[rows, vocab]``, new
+    cache)."""
+    dt = cfg.dtype
+    num_blocks = cache["k"].shape[1]
+    block_size = cache["k"].shape[2]
+    x = embed_lookup(params["embed"], tokens[None, :],
+                     one_hot=cfg.one_hot_embed, dtype=dt)[0]  # [rows, d]
+    new_k, new_v = cache["k"], cache["v"]
+    for li, p in enumerate(params["layers"]):
+        h, kvh, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+        xn = rms_norm(x, p["attn_norm"], cfg.norm_eps)
+        q = (xn @ p["wq"].astype(dt)).reshape(-1, h, hd)
+        k = (xn @ p["wk"].astype(dt)).reshape(-1, kvh, hd)
+        v = (xn @ p["wv"].astype(dt)).reshape(-1, kvh, hd)
+        q = _rope_rows(cfg, q, positions).astype(dt)
+        k = _rope_rows(cfg, k, positions).astype(dt)
+        # write THIS row's k/v into its cache cell before the gather, so
+        # the query attends to itself through the cache — one code path
+        # for prefill and decode.  Dead/padded rows carry blk ==
+        # num_blocks and drop.
+        new_k = new_k.at[li, write_blk, write_off].set(k, mode="drop")
+        new_v = new_v.at[li, write_blk, write_off].set(v, mode="drop")
+        # gather each row's paged context: [rows, maxb, bs, kv, hd] →
+        # flat [rows, maxb*bs, kv, hd]; flat index == absolute position
+        ctx_k = new_k[li][block_tables]
+        ctx_v = new_v[li][block_tables]
+        rows = ctx_k.shape[0]
+        ctx_k = ctx_k.reshape(rows, -1, kvh, hd)
+        ctx_v = ctx_v.reshape(rows, -1, kvh, hd)
+        o = _paged_attention(q, ctx_k, ctx_v, positions, cfg)
+        x = x + (o @ p["wo"].astype(dt))
+        xn = rms_norm(x, p["mlp_norm"], cfg.norm_eps)
+        gate = jax.nn.silu(xn @ p["w1"].astype(dt))
+        up = xn @ p["w3"].astype(dt)
+        x = x + ((gate * up) @ p["w2"].astype(dt))
+    del num_blocks, block_size  # shapes only; documented above
+    x = rms_norm(x, params["norm"], cfg.norm_eps)
+    logits = (x @ params["lm_head"].astype(dt)).astype(jnp.float32)
+    return logits, {"k": new_k, "v": new_v}
+
+
+def _write_indices(positions: jax.Array, block_tables: jax.Array,
+                   live: jax.Array, num_blocks: int,
+                   block_size: int) -> tuple[jax.Array, jax.Array]:
+    """(blk, off) cache cells for per-row writes; dead rows get the
+    out-of-range drop sentinel."""
+    logical = positions // block_size
+    maxb = block_tables.shape[-1]
+    logical = jnp.clip(logical, 0, maxb - 1)
+    blk = jnp.take_along_axis(block_tables, logical[:, None], axis=1)[:, 0]
+    blk = jnp.where(live, blk, num_blocks)
+    return blk, positions % block_size
+
+
+# -- entry points ------------------------------------------------------------
+
+
+@partial(jax.jit, static_argnames=("cfg",), donate_argnames=("cache",))
+def decode_step(params: dict, cache: dict, tokens: jax.Array,
+                positions: jax.Array, block_tables: jax.Array,
+                live: jax.Array, cfg: TransformerConfig
+                ) -> tuple[jax.Array, dict]:
+    """One decode iteration for the fixed slot batch.
+
+    tokens ``[slots]`` int32 (each slot's last emitted/prompt token);
+    positions ``[slots]`` (absolute position of that token); block_tables
+    ``[slots, max_blocks]``; live ``[slots]`` bool (dead slots compute
+    garbage but never write).  Returns next-token logits ``[slots,
+    vocab]`` and the updated cache."""
+    nb, bs = cache["k"].shape[1], cache["k"].shape[2]
+    blk, off = _write_indices(positions, block_tables, live, nb, bs)
+    return _forward_rows(params, cache, tokens, positions, block_tables,
+                         blk, off, cfg)
+
+
+@partial(jax.jit, static_argnames=("cfg",), donate_argnames=("cache",))
+def prefill(params: dict, cache: dict, tokens: jax.Array,
+            block_table: jax.Array, start_pos: jax.Array,
+            length: jax.Array, cfg: TransformerConfig
+            ) -> tuple[jax.Array, dict]:
+    """One prefill **chunk** for one session: tokens ``[chunk]`` (valid
+    prefix ``length``, rest padding), written at absolute positions
+    ``start_pos + i`` through ``block_table [max_blocks]``.  Rows past
+    ``length`` neither write nor matter.  Returns per-row logits
+    ``[chunk, vocab]`` (row ``length-1`` of the final chunk seeds
+    decoding) and the updated cache."""
+    chunk = tokens.shape[0]
+    positions = start_pos + jnp.arange(chunk, dtype=jnp.int32)
+    valid = jnp.arange(chunk) < length
+    nb, bs = cache["k"].shape[1], cache["k"].shape[2]
+    tables = jnp.broadcast_to(block_table, (chunk,) + block_table.shape)
+    blk, off = _write_indices(positions, tables, valid, nb, bs)
+    return _forward_rows(params, cache, tokens, positions, tables,
+                         blk, off, cfg)
+
+
+# -- host-side helpers (migration / handoff) ---------------------------------
+
+
+def gather_session_kv(cache: dict, block_ids, length: int,
+                      block_size: int) -> dict[str, Any]:
+    """Host copy of one session's K/V, flattened to ``[L, length, kv,
+    hd]`` — the unit a live migration / prefill→decode handoff ships.
+    ``block_ids`` is the session's logical-order block list."""
+    import numpy as np
+
+    out = {}
+    for name in ("k", "v"):
+        arr = np.asarray(jax.device_get(cache[name][:, list(block_ids)]))
+        L, nb, bs = arr.shape[0], arr.shape[1], arr.shape[2]
+        flat = arr.reshape(L, nb * bs, arr.shape[3], arr.shape[4])
+        out[name] = flat[:, :length].copy()
+    return out
+
+
+def scatter_session_kv(cache: dict, block_ids, host_kv: dict,
+                       block_size: int) -> dict:
+    """Write a :func:`gather_session_kv` payload into freshly allocated
+    blocks of (another) cache — the receive half of migration/handoff.
+    Returns the updated cache arrays."""
+    import numpy as np
+
+    length = host_kv["k"].shape[1]
+    n_need = -(-length // block_size)
+    assert len(block_ids) >= n_need, (len(block_ids), length, block_size)
+    for name in ("k", "v"):
+        flat = np.asarray(host_kv[name])
+        L = flat.shape[0]
+        pad = n_need * block_size - length
+        if pad:
+            flat = np.concatenate(
+                [flat, np.zeros((L, pad) + flat.shape[2:], flat.dtype)],
+                axis=1)
+        blocked = flat.reshape(L, n_need, block_size,
+                               flat.shape[2], flat.shape[3])
+        ids = jnp.asarray(list(block_ids[:n_need]), jnp.int32)
+        cache[name] = cache[name].at[:, ids].set(
+            jnp.asarray(blocked, cache[name].dtype))
+    return cache

@@ -58,6 +58,22 @@ DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
 SERVING_LATENCY_BUCKETS = (0.0002, 0.0005, 0.001, 0.0025, 0.005, 0.01,
                            0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5)
 
+#: time-to-first-token boundaries (seconds) for autoregressive serving:
+#: TTFT is a prefill (prompt-length-proportional) latency — ms-scale at
+#: the fast end but legitimately stretching to seconds under chunked
+#: prefill interleave, so the single-shot SERVING_LATENCY_BUCKETS top
+#: out too low for it.  Fixed so TTFT series merge across replicas.
+SERVING_TTFT_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                        0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+
+#: per-output-token (TPOT) boundaries (seconds): one decode iteration —
+#: sub-ms on a warm chip up to 100 ms when prefill interleave or a
+#: resize steals iterations.  Dense at the bottom where the decode SLO
+#: lives; SERVING_LATENCY_BUCKETS would crush every healthy TPOT into
+#: its first two buckets.
+SERVING_TPOT_BUCKETS = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005,
+                        0.01, 0.025, 0.05, 0.1)
+
 #: rendered-name prefix: one namespace for every series the stack emits
 PREFIX = "edl_"
 
@@ -262,6 +278,17 @@ class Histogram(_Family):
                     counts[i] += 1
             counts[-1] += 1  # +Inf
             self._sums[key] += v
+
+    def touch(self, **labels) -> None:
+        """Pre-register a label set with zero observations so the full
+        bucket/sum/count block renders from the FIRST scrape — a strict
+        parser (and rate()-over-counters dashboards) must see a new
+        series exist before its first sample, not appear mid-flight."""
+        with self._lock:
+            key = _label_key(labels)
+            if key not in self._counts:
+                self._counts[key] = [0] * (len(self.buckets) + 1)
+                self._sums[key] = 0.0
 
     def observe_many(self, values, **labels) -> None:
         """Vectorized :meth:`observe` for block-oriented callers (the

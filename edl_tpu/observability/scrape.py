@@ -964,12 +964,27 @@ class FleetView:
         depth = s.latest("edl_serving_fleet_queue_depth", labels) or 0
         ready = s.latest("edl_serving_replicas_ready", labels) or 0
         active = s.latest("edl_serving_replicas_active", labels) or 0
+        # decode-serving extension: TTFT/TPOT from the decode-scale
+        # histograms, tok/s from the emission counter, sessions + KV
+        # occupancy from the replica gauges (all zero on stateless jobs)
+        ttft = s.histogram_quantile("edl_serving_ttft_seconds", 0.99,
+                                    w, labels)
+        tpot = s.histogram_quantile("edl_serving_tpot_seconds", 0.50,
+                                    w, labels)
+        tps = s.rate("edl_serving_decode_tokens_total", w, labels)
+        sessions = s.latest("edl_serving_sessions_active", labels) or 0
+        kv_used = s.latest("edl_serving_kv_blocks_used", labels) or 0
+        kv_total = s.latest("edl_serving_kv_blocks_total", labels) or 0
         return FleetStats(
             p50_ms=round((p50 or 0.0) * 1000.0, 3),
             p99_ms=round((p99 or 0.0) * 1000.0, 3),
             qps=round(qps, 2), queue_depth=int(depth),
             replicas_ready=int(ready), replicas_active=int(active),
-            requests_windowed=int(windowed))
+            requests_windowed=int(windowed),
+            ttft_p99_ms=round((ttft or 0.0) * 1000.0, 3),
+            tpot_p50_ms=round((tpot or 0.0) * 1000.0, 4),
+            decode_tps=round(tps, 2), sessions=int(sessions),
+            kv_blocks_used=int(kv_used), kv_blocks_total=int(kv_total))
 
     def stats_for(self, uid: str):
         """The :class:`ServingScaler` seam: ``stats_for=view.stats_for``
@@ -1048,6 +1063,10 @@ class FleetView:
                 "queue": st.queue_depth,
                 "replicas": f"{st.replicas_ready}/{st.replicas_active}",
                 "requests_windowed": st.requests_windowed,
+                "ttft_p99_ms": st.ttft_p99_ms,
+                "decode_tps": st.decode_tps,
+                "sessions": st.sessions,
+                "kv_blocks": f"{st.kv_blocks_used}/{st.kv_blocks_total}",
             }
             gp = goodput.get(job)
             if gp:
@@ -1342,14 +1361,22 @@ def render_fleet_dashboard(view: FleetView,
                  f"(window {snap['window_s']:g}s)")
     if snap["jobs"]:
         lines.append("")
-        rows = [("JOB", "QPS", "P50ms", "P99ms", "QUEUE", "REPLICAS",
-                 "GOODPUT", "SLOWEST-TRACE")]
+        rows = [("JOB", "QPS", "P50ms", "P99ms", "TTFTp99", "TOK/S",
+                 "SESSIONS", "KV", "QUEUE", "REPLICAS", "GOODPUT",
+                 "SLOWEST-TRACE")]
         for job, j in sorted(snap["jobs"].items()):
             gp = j.get("goodput")
             slow = j.get("slowest_trace")
+            kv = j.get("kv_blocks", "0/0")
             rows.append((job, f"{j['qps']:g}", f"{j['p50_ms']:g}",
-                         f"{j['p99_ms']:g}", str(j["queue"]),
-                         j["replicas"],
+                         f"{j['p99_ms']:g}",
+                         (f"{j.get('ttft_p99_ms', 0):g}ms"
+                          if j.get("ttft_p99_ms") else "-"),
+                         (f"{j.get('decode_tps', 0):g}"
+                          if j.get("decode_tps") else "-"),
+                         str(j.get("sessions", 0)),
+                         kv if kv != "0/0" else "-",
+                         str(j["queue"]), j["replicas"],
                          f"{gp:.2%}" if gp is not None else "-",
                          (f"{slow['latency_ms']:g}ms@{slow['trace_id']}"
                           if slow else "-")))

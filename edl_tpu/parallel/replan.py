@@ -258,6 +258,7 @@ def choose_shape(
     candidates: Optional[Sequence[MeshShape]] = None,
     max_bytes_per_device: Optional[int] = None,
     base: Optional[MeshShape] = None,
+    reserved_bytes_per_device: int = 0,
 ) -> tuple[MeshShape, ReshardPlan]:
     """Pick the minimal-transfer axis assignment for an unconstrained
     resize to ``n_devices``.
@@ -267,8 +268,13 @@ def choose_shape(
     Candidates whose post-reshard resident bytes would overflow
     ``max_bytes_per_device`` are dropped first — this is the dp→fsdp
     escape hatch for small worlds: when the replicated model no longer
-    fits one chip, the only surviving candidates shard it.  Ties prefer
-    the dp-dominant split (cheapest steady-state collectives: one grad
+    fits one chip, the only surviving candidates shard it.
+    ``reserved_bytes_per_device`` tightens that budget for resident
+    state the tree does not carry — a decode replica's paged KV pool
+    (:meth:`~edl_tpu.runtime.kvcache.KVBlockPool.total_bytes`) lives in
+    HBM exactly like params, and a plan that ignores it blesses layouts
+    that OOM on the first decode after the resize.  Ties prefer the
+    dp-dominant split (cheapest steady-state collectives: one grad
     all-reduce, no param all-gathers)."""
     cands = list(candidates) if candidates is not None else candidate_shapes(
         n_devices, base=base)
@@ -281,7 +287,8 @@ def choose_shape(
                             old_shape=None, new_shape=shape)
         rank = (plan.bytes_moved, -shape.dp, shape.key())
         if (max_bytes_per_device is not None
-                and plan.max_device_bytes > max_bytes_per_device):
+                and plan.max_device_bytes + reserved_bytes_per_device
+                > max_bytes_per_device):
             overflow.append((rank, shape, plan))
             continue
         scored.append((rank, shape, plan))
@@ -300,7 +307,8 @@ def choose_shape(
 
 def propose_shape(n_devices: int, state_bytes: int,
                   max_bytes_per_device: Optional[int] = None,
-                  base: Optional[MeshShape] = None) -> MeshShape:
+                  base: Optional[MeshShape] = None,
+                  reserved_bytes_per_device: int = 0) -> MeshShape:
     """Control-plane shape proposal, no meshes required: pure-dp unless
     replicating ``state_bytes`` per chip would overflow the budget, in
     which case the smallest sufficient factor moves into fsdp.
@@ -321,7 +329,8 @@ def propose_shape(n_devices: int, state_bytes: int,
         # would bless an over-budget layout right at the boundary, the
         # exact regime this OOM-escape hook exists for
         if (max_bytes_per_device is None
-                or -(-state_bytes // fsdp) <= max_bytes_per_device):
+                or -(-state_bytes // fsdp) + reserved_bytes_per_device
+                <= max_bytes_per_device):
             return MeshShape(dp=rem // fsdp, fsdp=fsdp, tp=tp, sp=sp, ep=ep)
     return MeshShape(dp=1, fsdp=rem, tp=tp, sp=sp, ep=ep)
 

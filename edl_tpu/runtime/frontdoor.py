@@ -197,7 +197,7 @@ class HeadMeta:
 
     __slots__ = ("method", "path", "body_len", "f32", "priority",
                  "trace_id", "parent_span", "nonce", "keep_alive",
-                 "head_len", "total_len", "bad", "chunked")
+                 "head_len", "total_len", "bad", "chunked", "session")
 
     def __init__(self, head: bytes) -> None:
         self.bad = False
@@ -208,6 +208,7 @@ class HeadMeta:
         self.trace_id: Optional[str] = None
         self.parent_span: Optional[str] = None
         self.nonce: Optional[str] = None
+        self.session: Optional[str] = None
         self.keep_alive = True
         self.head_len = len(head)
         try:
@@ -273,6 +274,13 @@ class HeadMeta:
         if idx >= 0:
             end = lower.index(b"\r\n", idx + 2)
             self.nonce = head[idx + 20:end].strip().decode("latin1")
+        # decode-session affinity (doc/serving.md §autoregressive
+        # serving): the LB pins every request carrying this id to the
+        # replica holding the session's KV cache
+        idx = lower.find(b"\r\nx-edl-session:")
+        if idx >= 0:
+            end = lower.index(b"\r\n", idx + 2)
+            self.session = head[idx + 16:end].strip().decode("latin1")
         if b"\r\nconnection: close" in lower:
             self.keep_alive = False
         self.total_len = self.head_len + self.body_len
@@ -1413,10 +1421,14 @@ class FleetApp:
 
     wants_raw = False
 
-    def __init__(self, fleet, row_dim: int, timeout_s: float = 30.0) -> None:
+    def __init__(self, fleet, row_dim: int, timeout_s: float = 30.0,
+                 decode_fleet=None) -> None:
         self.fleet = fleet
         self.row_dim = int(row_dim)
         self.timeout_s = timeout_s
+        #: optional :class:`~edl_tpu.runtime.serving.DecodeFleet` behind
+        #: POST /generate (doc/serving.md §autoregressive serving)
+        self.decode_fleet = decode_fleet
         self.door: Optional[FrontDoor] = None
         self._c = get_counters()
 
@@ -1528,7 +1540,72 @@ class FleetApp:
                          meta.priority, parent_span=meta.parent_span,
                          nonce=meta.nonce)
             return
+        if (meta.method == "POST" and meta.path == "/generate"
+                and self.decode_fleet is not None):
+            self._generate(conn, meta, body)
+            return
         conn.complete(conn.push_slot(1), RESP_404)
+
+    def _generate(self, conn, meta: HeadMeta, body: bytes) -> None:
+        """Autoregressive completion: ``{"prompt": [ids], "max_new_tokens":
+        N}`` → the session's full greedy generation (a 429 when the KV
+        pool's bounded admission sheds).  The response echoes the
+        session id so affinity-aware clients/LBs can pin follow-ups."""
+        import json
+
+        from edl_tpu.runtime.kvcache import KVPoolExhausted
+
+        door = self.door
+        try:
+            req = json.loads(body.decode())
+            prompt = [int(t) for t in req["prompt"]]
+            max_new = int(req.get("max_new_tokens", 16))
+        except Exception:
+            conn.complete(conn.push_slot(1), RESP_400)
+            return
+        slot = conn.push_slot(1)
+
+        def finish(sess) -> None:
+            if sess.error is not None:
+                data = RESP_503
+            else:
+                payload = json.dumps({
+                    "tokens": sess.generated,
+                    "session": sess.id,
+                    "ttft_ms": round(sess.ttft_s * 1e3, 3),
+                    "tpot_ms": round(sess.tpot_s * 1e3, 4),
+                    "generation": self.decode_fleet.generation,
+                }).encode()
+                data = (b"HTTP/1.1 200 OK\r\n"
+                        b"Content-Type: application/json\r\n"
+                        + f"X-EDL-Session: {sess.id}\r\n".encode()
+                        + (f"X-EDL-Trace-Id: {meta.trace_id}\r\n".encode()
+                           if meta.trace_id else b"")
+                        + (f"X-EDL-Block-Nonce: {meta.nonce}\r\n".encode()
+                           if meta.nonce else b"")
+                        + f"Content-Length: {len(payload)}\r\n\r\n".encode()
+                        + payload)
+            door.call_soon(self._fill_gen, conn, slot, data)
+
+        from edl_tpu.runtime.serving import SessionDropped
+
+        try:
+            self.decode_fleet.submit(prompt, max_new,
+                                     priority=meta.priority,
+                                     trace_id=meta.trace_id,
+                                     on_done=finish)
+        except KVPoolExhausted:
+            self._c.inc("frontdoor_overload_sheds", job=door.job,
+                        priority=PRIORITY_NAMES[meta.priority])
+            conn.complete(slot, RESP_429)
+        except SessionDropped:
+            conn.complete(slot, RESP_503)
+        except ValueError:
+            conn.complete(slot, RESP_400)
+
+    def _fill_gen(self, conn, slot: RespSlot, data: bytes) -> None:
+        if slot.data is None:
+            conn.complete(slot, data)
 
 
 # -- event-loop lag watchdog -------------------------------------------------

@@ -1,0 +1,244 @@
+"""Elastic paged KV-cache pool — per-request decode state as
+first-class elastic state (ROADMAP #2; doc/serving.md §autoregressive
+serving).
+
+The decode path's working set is not params: it is each live session's
+K/V history, growing a token at a time and dying with the session.  The
+vLLM insight, applied to the elastic substrate:
+
+* **Block allocation.**  The device cache
+  (:func:`edl_tpu.models.llama.init_cache`) is a pool of fixed-size
+  blocks; a session owns a *list* of blocks, not a contiguous span.
+  There is no external fragmentation by construction — any free block
+  serves any session — and a finished/abandoned session's blocks return
+  to the free list immediately.
+* **Bounded admission.**  Allocation failure is a typed
+  :class:`KVPoolExhausted` (the serving layer's 429), never an OOM: the
+  pool size is fixed at replica build, so load shows up as admission
+  backpressure, not a dead replica.
+* **Accounted like params.**  :meth:`total_bytes` is what
+  :func:`~edl_tpu.parallel.replan.choose_shape`'s memory filter must
+  reserve (its ``reserved_bytes_per_device``) and what the goodput
+  ledger's memory view sees — a resize plan that ignores KV residency
+  blesses layouts that OOM on first decode.
+* **Evacuation.**  :meth:`export_session` / :meth:`import_session` ship
+  a session's K/V through the host — the unit of live migration (a
+  scale-down's replan path drains *state*, not sessions), of
+  prefill→decode handoff between replica roles, and of the
+  replica-death rescue.
+
+Scrape names: ``edl_serving_kv_blocks_used`` /
+``edl_serving_kv_blocks_total`` (gauges, labeled ``job=``/``replica=``),
+``edl_serving_kv_admission_rejects_total`` (counter).
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+from typing import Optional
+
+from edl_tpu.observability.collector import get_counters
+from edl_tpu.observability.logging import get_logger
+
+log = get_logger("runtime.kvcache")
+
+
+class KVPoolExhausted(RuntimeError):
+    """Typed bounded-admission signal: the pool cannot hold the
+    requested tokens right now.  Maps to 429 at the front door — a full
+    pool sheds, it never OOMs."""
+
+
+class SessionUnknown(KeyError):
+    """The pool holds no blocks for this session id."""
+
+
+class KVBlockPool:
+    """Block allocator + accounting over one replica's paged device
+    cache.  Thread-safe: the serve loop allocates/frees while admission
+    checks :meth:`can_admit` from router threads.
+
+    The pool OWNS the cache arrays (``self.cache``) because functional
+    updates replace them: the serve loop passes ``pool.cache`` into the
+    jitted step and stores the donated result back via
+    :meth:`set_cache`."""
+
+    def __init__(self, cfg, num_blocks: int, block_size: int,
+                 max_blocks_per_session: int, *, job: str = "job",
+                 replica: str = "", registry=None) -> None:
+        from edl_tpu.models import llama
+        from edl_tpu.observability.metrics import get_registry
+
+        self.cfg = cfg
+        self.num_blocks = int(num_blocks)
+        self.block_size = int(block_size)
+        self.max_blocks_per_session = int(max_blocks_per_session)
+        self.job = job
+        self.replica = replica
+        self.cache = llama.init_cache(cfg, self.num_blocks, self.block_size)
+        self._free: "collections.deque[int]" = collections.deque(
+            range(self.num_blocks))
+        self._sessions: dict[int, list[int]] = {}
+        self._lock = threading.Lock()
+        self._c = get_counters()
+        reg = registry if registry is not None else get_registry()
+        labels = {"job": job}
+        if replica:
+            labels["replica"] = replica
+        reg.gauge_fn("serving_kv_blocks_used", self.blocks_used,
+                     help="KV pool blocks currently owned by sessions",
+                     **labels)
+        reg.gauge_fn("serving_kv_blocks_total", lambda: self.num_blocks,
+                     help="KV pool capacity in blocks", **labels)
+        # zero-pre-registration: the strict parser sees the reject
+        # counter from scrape #1, before the first full pool
+        self._c.inc("serving_kv_admission_rejects", 0, job=job)
+
+    # -- observation ---------------------------------------------------------
+
+    def blocks_used(self) -> int:
+        with self._lock:
+            return self.num_blocks - len(self._free)
+
+    def blocks_free(self) -> int:
+        with self._lock:
+            return len(self._free)
+
+    def sessions(self) -> list[int]:
+        with self._lock:
+            return list(self._sessions)
+
+    def session_blocks(self, sid: int) -> list[int]:
+        with self._lock:
+            if sid not in self._sessions:
+                raise SessionUnknown(sid)
+            return list(self._sessions[sid])
+
+    @property
+    def bytes_per_block(self) -> int:
+        from edl_tpu.models.llama import cache_bytes
+
+        return cache_bytes(self.cfg, 1, self.block_size)
+
+    def total_bytes(self) -> int:
+        """Resident bytes of the whole pool — the reservation the
+        resize memory filter and the goodput memory view account."""
+        from edl_tpu.models.llama import cache_bytes
+
+        return cache_bytes(self.cfg, self.num_blocks, self.block_size)
+
+    def used_bytes(self) -> int:
+        return self.blocks_used() * self.bytes_per_block
+
+    # -- admission / growth --------------------------------------------------
+
+    def _blocks_for(self, tokens: int) -> int:
+        return max(-(-int(tokens) // self.block_size), 1)
+
+    def can_admit(self, tokens: int) -> bool:
+        """Would :meth:`ensure_capacity` for a NEW session of ``tokens``
+        succeed right now?  The router's bounded-admission probe."""
+        need = self._blocks_for(tokens)
+        with self._lock:
+            return (need <= len(self._free)
+                    and need <= self.max_blocks_per_session)
+
+    def ensure_capacity(self, sid: int, tokens: int) -> list[int]:
+        """Grow session ``sid``'s block list to cover ``tokens`` total
+        tokens (allocating lazily, a block at a time as decode crosses
+        each block boundary).  Returns the logical-order block list.
+        Raises :class:`KVPoolExhausted` — with the session's existing
+        blocks UNTOUCHED — when the pool or the per-session cap cannot
+        cover it."""
+        need = self._blocks_for(tokens)
+        with self._lock:
+            have = self._sessions.setdefault(sid, [])
+            if need <= len(have):
+                return list(have)
+            if need > self.max_blocks_per_session:
+                if not have:  # a failed NEW session must not linger
+                    del self._sessions[sid]
+                self._c.inc("serving_kv_admission_rejects", job=self.job)
+                raise KVPoolExhausted(
+                    f"session {sid}: {tokens} tokens needs {need} blocks, "
+                    f"per-session cap is {self.max_blocks_per_session}")
+            grow = need - len(have)
+            if grow > len(self._free):
+                if not have:
+                    del self._sessions[sid]
+                self._c.inc("serving_kv_admission_rejects", job=self.job)
+                raise KVPoolExhausted(
+                    f"session {sid}: needs {grow} more blocks, "
+                    f"pool has {len(self._free)} free of {self.num_blocks}")
+            have.extend(self._free.popleft() for _ in range(grow))
+            return list(have)
+
+    def free_session(self, sid: int) -> int:
+        """Return every block the session owns to the free list (finish,
+        abandon, timeout, migration-source cleanup).  Unknown sids are a
+        no-op — frees must be idempotent under completion/abandon races.
+        Returns blocks freed."""
+        with self._lock:
+            blocks = self._sessions.pop(sid, None)
+            if not blocks:
+                return 0
+            self._free.extend(blocks)
+            return len(blocks)
+
+    def block_table(self, sid: int):
+        """``[max_blocks_per_session]`` int32 table, padded with the
+        out-of-range drop sentinel (``num_blocks``)."""
+        import numpy as np
+
+        table = np.full(self.max_blocks_per_session, self.num_blocks,
+                        np.int32)
+        with self._lock:
+            blocks = self._sessions.get(sid)
+            if blocks is None:
+                raise SessionUnknown(sid)
+            table[:len(blocks)] = blocks
+        return table
+
+    def set_cache(self, cache: dict) -> None:
+        """Store the donated-and-updated arrays back after a step."""
+        self.cache = cache
+
+    # -- evacuation (migration / handoff / rescue) ---------------------------
+
+    def export_session(self, sid: int, length: int) -> dict:
+        """Host copy of the session's K/V (``[L, length, kv, hd]`` per
+        K/V) — what a live migration or prefill→decode handoff ships."""
+        from edl_tpu.models.llama import gather_session_kv
+
+        return gather_session_kv(self.cache, self.session_blocks(sid),
+                                 int(length), self.block_size)
+
+    def import_session(self, sid: int, host_kv: dict) -> list[int]:
+        """Adopt an exported session: allocate blocks here and scatter
+        the host K/V in.  Raises :class:`KVPoolExhausted` (caller keeps
+        the host copy and may retry elsewhere — the handoff is not
+        destructive)."""
+        from edl_tpu.models.llama import scatter_session_kv
+
+        length = int(host_kv["k"].shape[1])
+        with self._lock:
+            if sid in self._sessions:
+                raise ValueError(f"session {sid} already resident")
+        blocks = self.ensure_capacity(sid, max(length, 1))
+        try:
+            self.cache = scatter_session_kv(self.cache, blocks, host_kv,
+                                            self.block_size)
+        except Exception:
+            self.free_session(sid)
+            raise
+        return blocks
+
+    def evacuate(self, lengths: dict[int, int]) -> dict[int, dict]:
+        """Export EVERY resident session (``sid → current token
+        count``) — the scale-down path: the replica's entire decode
+        state leaves as host arrays, to be re-imported on survivors
+        through the replan path.  Sessions stay allocated here until
+        :meth:`free_session`; a failed import elsewhere can retry."""
+        return {sid: self.export_session(sid, lengths[sid])
+                for sid in self.sessions() if sid in lengths}

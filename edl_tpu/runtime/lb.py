@@ -215,7 +215,7 @@ class _OutBlock:
 
     __slots__ = ("conn", "slot", "n", "remaining", "req_bytes", "t_sent",
                  "t_admit", "cell", "kind", "acc", "hedged", "trace_rec",
-                 "probe_up", "errors")
+                 "probe_up", "errors", "session")
 
     def __init__(self, conn, slot, n: int, req_bytes: bytes,
                  cell: _Cell, kind: str = "primary",
@@ -238,6 +238,8 @@ class _OutBlock:
         #: upstream name whose half-open breaker this dispatch probes
         self.probe_up: Optional[str] = None
         self.errors = 0               # 5xx / integrity hits credited here
+        #: decode-session id (X-EDL-Session) this block must stick to
+        self.session: Optional[str] = None
 
 
 class _UpstreamConn(asyncio.Protocol):
@@ -652,6 +654,14 @@ class LBApp:
         self._c.inc("lb_integrity_failures", 0, job=job)
         self._c.inc("lb_retry_budget_exhausted", 0, job=job)
         self._c.inc("lb_discovery_freezes", 0, job=job)
+        self._c.inc("lb_affinity_repins", 0, job=job)
+        #: session-id → upstream name (decode KV affinity).  Bounded
+        #: LRU: an abandoned session's pin ages out instead of leaking;
+        #: a re-arriving aged-out session just re-pins (the decode
+        #: fleet's handoff covers the cache move)
+        self._affinity: "collections.OrderedDict[str, str]" = (
+            collections.OrderedDict())
+        self._affinity_cap = 4096
         for to in _BRK_NAMES:
             self._c.inc("lb_breaker_transitions", 0, job=job, to=to)
 
@@ -838,6 +848,7 @@ class LBApp:
         blk = _OutBlock(conn, slot, n, raw, _Cell())
         blk.cell.trace = ctx
         blk.cell.nonce = nonce
+        blk.session = meta.session
         self.outstanding_rows += n
         self._dispatch(blk)
 
@@ -944,13 +955,15 @@ class LBApp:
             else:
                 conn.complete(conn.push_slot(1), RESP_404)
             return
-        if meta.method != "POST" or meta.path != "/predict":
+        if meta.method != "POST" or meta.path not in ("/predict",
+                                                      "/generate"):
             # NOT a transparent proxy for the replica admin surface:
             # /admin/* (stall/drain/activate/reload) on the public LB
             # endpoint would hand any client the drill controls
             conn.complete(conn.push_slot(1), RESP_404)
             return
-        # /predict (JSON included) forwards verbatim
+        # /predict and /generate (JSON included) forward verbatim;
+        # /generate blocks additionally carry session affinity
         self.handle_raw_block(conn, raw, 1, meta)
 
     def on_conn_lost(self, conn: HttpConn) -> None:
@@ -994,10 +1007,36 @@ class LBApp:
                 best, best_load = up, load
         return best
 
-    def _dispatch(self, blk: _OutBlock, exclude=None) -> None:
+    def _pick_affine(self, blk: _OutBlock, exclude=None
+                     ) -> Optional[_Upstream]:
+        """Session affinity: a block carrying ``X-EDL-Session`` sticks
+        to the replica holding its KV cache.  A dead/unroutable pin
+        falls back to least-outstanding and RE-PINS — the decode
+        fleet's rescue (re-prefill / KV handoff) makes the new replica
+        correct, the repin makes it sticky again."""
+        sid = blk.session
+        if sid is None:
+            return self._pick(exclude)
+        pinned = self._affinity.get(sid)
+        if pinned is not None:
+            up = self.upstreams.get(pinned)
+            if up is not None and up.routable() and up is not exclude:
+                self._affinity.move_to_end(sid)
+                return up
         up = self._pick(exclude)
+        if up is not None:
+            if pinned is not None and pinned != up.name:
+                self._c.inc("lb_affinity_repins", job=self.job)
+            self._affinity[sid] = up.name
+            self._affinity.move_to_end(sid)
+            while len(self._affinity) > self._affinity_cap:
+                self._affinity.popitem(last=False)
+        return up
+
+    def _dispatch(self, blk: _OutBlock, exclude=None) -> None:
+        up = self._pick_affine(blk, exclude)
         if up is None and exclude is not None:
-            up = self._pick(None)  # better a busy twin than nothing
+            up = self._pick_affine(blk, None)  # busy twin over nothing
         if up is None:
             self._parked.append(
                 (blk.t_admit + self.request_timeout_s, blk))
@@ -1199,6 +1238,7 @@ class LBApp:
             resend = _OutBlock(blk.conn, blk.slot, blk.n, resend_bytes,
                                blk.cell, kind="rescue",
                                t_admit=blk.t_admit)
+            resend.session = blk.session  # affinity re-pins on rescue
             self._dispatch(resend, exclude=conn.up)
         if blocks:
             log.info("upstream connection lost; blocks rescued",
@@ -1292,6 +1332,7 @@ class LBApp:
                                           hedge_bytes, blk.cell,
                                           kind="hedge",
                                           t_admit=blk.t_admit)
+                        hedge.session = blk.session
                         hedge.hedged = True
                         self._c.inc("lb_hedges_fired", blk.n, job=self.job)
                         target.requests += blk.n

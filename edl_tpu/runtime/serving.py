@@ -654,6 +654,14 @@ class FleetStats:
     replicas_ready: int = 0
     replicas_active: int = 0
     requests_windowed: int = 0
+    # decode-serving extension (DecodeFleet.stats / FleetView): zeros
+    # for stateless fleets, so every consumer stays shape-compatible
+    ttft_p99_ms: float = 0.0
+    tpot_p50_ms: float = 0.0
+    decode_tps: float = 0.0
+    sessions: int = 0
+    kv_blocks_used: int = 0
+    kv_blocks_total: int = 0
 
 
 class ServingFleet:
@@ -1230,6 +1238,1280 @@ class _WeightWatcher(threading.Thread):
     def stop(self) -> None:
         self._halt.set()
         self.join(timeout=5)
+
+
+# -- autoregressive decode serving (token-level continuous batching) ---------
+#
+# Everything above batches STATELESS single-shot forwards.  Real LLM
+# traffic is prefill + iterative decode with per-request KV state — the
+# Orca idiom the continuous-batching docstring cites, now made real
+# (ROADMAP #2; doc/serving.md §autoregressive serving):
+#
+# * sessions join and leave the running decode batch at every iteration
+#   (slot-packed into the fixed compiled shape, so load never
+#   recompiles; a finished sequence frees its slot immediately);
+# * prompt prefill is CHUNKED and interleaved against decode under a
+#   TPOT-protecting budget, picked by weighted fair queueing across the
+#   PR 13 priority classes (which until now could only shed);
+# * each session's K/V lives in the replica's paged
+#   :class:`~edl_tpu.runtime.kvcache.KVBlockPool` — first-class elastic
+#   state: a fleet scale-down EVACUATES it through the host and
+#   re-imports on survivors, so a resize is a latency blip, never a
+#   dropped session;
+# * prefill/decode disaggregate as two replica ROLES: a prefill replica
+#   computes the prompt's K/V + first token, then hands the cache off
+#   to the decode replica that owns the session from then on (the LB's
+#   session affinity keeps decode iterations on that replica).
+#
+# Scrape names: ``edl_serving_ttft_seconds`` / ``edl_serving_tpot_seconds``
+# (histograms, :data:`~edl_tpu.observability.metrics.SERVING_TTFT_BUCKETS`
+# / ``SERVING_TPOT_BUCKETS``, labeled ``priority=``, zero-pre-registered),
+# ``edl_serving_decode_tokens_total`` / ``edl_serving_prefill_chunks_total``
+# / ``edl_serving_sessions_total{outcome=}`` /
+# ``edl_serving_session_migrations_total`` /
+# ``edl_serving_ttft_slo_violations_total`` /
+# ``edl_serving_tpot_slo_violations_total`` (counters),
+# ``edl_serving_sessions_active`` (gauge) and the KV-pool gauges
+# (kvcache.py).
+
+#: session lifecycle states
+S_QUEUED = "queued"
+S_PREFILL = "prefill"
+S_DECODING = "decoding"
+S_DONE = "done"
+S_FAILED = "failed"
+
+#: priority classes — the PR 13 front-door classes, now first-class in
+#: the batcher (weighted fair queueing + per-class TTFT/TPOT SLOs)
+PRI_HIGH, PRI_NORMAL, PRI_LOW = 0, 1, 2
+PRI_NAMES = {PRI_HIGH: "high", PRI_NORMAL: "normal", PRI_LOW: "low"}
+#: WFQ service weights per class (share of prefill bandwidth under
+#: contention; decode is round-robin — every live slot decodes every
+#: iteration, so fairness pressure is all in prefill admission)
+DEFAULT_WFQ_WEIGHTS = {PRI_HIGH: 4.0, PRI_NORMAL: 2.0, PRI_LOW: 1.0}
+
+
+class SessionDropped(RuntimeError):
+    """The session's replica died without a possible handoff, or a
+    forced stop abandoned it — always surfaced typed, never a hang."""
+
+
+class DecodeSession:
+    """One autoregressive request: prompt in, tokens streamed out.
+
+    The session object is the STABLE identity across its whole life —
+    prefill on one replica, handoff, decode on another, migration
+    through a resize: waiters hold this object and its events; replicas
+    only borrow it.  ``cached`` counts KV positions written for it on
+    its current replica (= the absolute position the next fed token
+    takes)."""
+
+    def __init__(self, prompt, max_new_tokens: int,
+                 priority: int = PRI_NORMAL, id: int = 0,
+                 trace_id: Optional[str] = None) -> None:
+        self.prompt = [int(t) for t in prompt]
+        if not self.prompt:
+            raise ValueError("empty prompt")
+        self.max_new_tokens = max(int(max_new_tokens), 1)
+        self.priority = int(priority)
+        self.id = id
+        self.trace_id = trace_id
+        self.generated: list[int] = []
+        self.state = S_QUEUED
+        self.cached = 0
+        self.replica: Optional[str] = None
+        self.slot: Optional[int] = None
+        self.migrations = 0
+        self.t_submit = time.perf_counter()
+        self.t_first_token = 0.0
+        self.t_last_token = 0.0
+        self.t_done = 0.0
+        self.error: Optional[BaseException] = None
+        self._first = threading.Event()
+        self._done = threading.Event()
+        self._vfinish = 0.0  # WFQ virtual finish time (scheduler-owned)
+        self.on_token: Optional[Callable[["DecodeSession", int], None]] = None
+        #: fires exactly once on finish OR fail (after the terminal
+        #: state is readable) — the front door's completion hook
+        self.on_done: Optional[Callable[["DecodeSession"], None]] = None
+
+    # -- the waiter surface --------------------------------------------------
+
+    def wait(self, timeout: Optional[float] = None) -> list[int]:
+        if not self._done.wait(timeout):
+            raise TimeoutError(f"session {self.id} incomplete "
+                               f"after {timeout}s")
+        if self.error is not None:
+            raise self.error
+        return list(self.generated)
+
+    def wait_first_token(self, timeout: Optional[float] = None) -> int:
+        if not self._first.wait(timeout):
+            raise TimeoutError(f"session {self.id} no first token "
+                               f"in {timeout}s")
+        if self.error is not None:
+            raise self.error
+        return self.generated[0]
+
+    @property
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    @property
+    def ttft_s(self) -> float:
+        return max(self.t_first_token - self.t_submit, 0.0)
+
+    @property
+    def tpot_s(self) -> float:
+        """Mean inter-token time over the generated tail (excludes
+        TTFT — TPOT is the decode-side objective)."""
+        n = len(self.generated)
+        if n < 2 or self.t_last_token <= self.t_first_token:
+            return 0.0
+        return (self.t_last_token - self.t_first_token) / (n - 1)
+
+    # -- replica-side transitions -------------------------------------------
+
+    def resume_tokens(self) -> list[int]:
+        """Tokens whose K/V a (re)prefill must cover: the prompt plus
+        every generated token except the newest (the newest is the next
+        decode input, not cache history).  A fresh session is just its
+        prompt."""
+        if not self.generated:
+            return list(self.prompt)
+        return self.prompt + self.generated[:-1]
+
+    def emit(self, token: int) -> None:
+        now = time.perf_counter()
+        self.generated.append(int(token))
+        self.t_last_token = now
+        if not self._first.is_set():
+            self.t_first_token = now
+            self._first.set()
+        if self.on_token is not None:
+            try:
+                self.on_token(self, int(token))
+            except Exception:
+                log.warn("session on_token callback failed", session=self.id)
+
+    def finish(self) -> None:
+        self.state = S_DONE
+        self.t_done = time.perf_counter()
+        self._done.set()
+        self._notify_done()
+
+    def fail(self, exc: BaseException) -> None:
+        self.state = S_FAILED
+        self.error = exc
+        self.t_done = time.perf_counter()
+        self._first.set()
+        self._done.set()
+        self._notify_done()
+
+    def _notify_done(self) -> None:
+        cb, self.on_done = self.on_done, None
+        if cb is not None:
+            try:
+                cb(self)
+            except Exception:
+                log.warn("session on_done callback failed",
+                         session=self.id)
+
+
+class TokenScheduler:
+    """Iteration-level scheduling policy: WHO prefills next (weighted
+    fair queueing across priority classes) and WHEN prefill may run at
+    all (a TPOT-protecting interleave budget against the running decode
+    batch).
+
+    WFQ is start-time fair queueing over prefill service: admitting a
+    session stamps it a virtual finish ``F = max(V, F_class) +
+    prompt_tokens / weight``; the pending session with the smallest F
+    prefills next, and V advances to it.  High-weight classes drain
+    proportionally faster under contention; an idle class's backlog
+    never starves (F grows with service received, not wall time).
+
+    The interleave budget: at most one prefill chunk per
+    ``decode_per_prefill`` decode iterations while any session is
+    decoding — prefill work stretches TPOT for every running session,
+    so it is rationed, not greedy.  With no decode running, prefill has
+    the replica to itself (TTFT-optimal)."""
+
+    def __init__(self, weights: Optional[dict] = None,
+                 decode_per_prefill: int = 2) -> None:
+        self.weights = dict(DEFAULT_WFQ_WEIGHTS)
+        if weights:
+            self.weights.update(weights)
+        self.decode_per_prefill = max(int(decode_per_prefill), 1)
+        self._vtime = 0.0
+        self._class_finish = {p: 0.0 for p in self.weights}
+        self._decode_since_prefill = 0
+
+    def stamp(self, sess: DecodeSession) -> None:
+        """Assign the WFQ virtual finish at admission."""
+        w = self.weights.get(sess.priority,
+                             self.weights.get(PRI_NORMAL, 1.0))
+        start = max(self._vtime, self._class_finish.get(sess.priority, 0.0))
+        sess._vfinish = start + len(sess.resume_tokens()) / max(w, 1e-9)
+        self._class_finish[sess.priority] = sess._vfinish
+
+    def pick_prefill(self, pending: Sequence[DecodeSession]
+                     ) -> Optional[DecodeSession]:
+        if not pending:
+            return None
+        sess = min(pending, key=lambda s: (s._vfinish, s.id))
+        self._vtime = max(self._vtime, sess._vfinish)
+        return sess
+
+    def allow_prefill(self, decoding: int, prefill_pending: int) -> bool:
+        if prefill_pending == 0:
+            return False
+        if decoding == 0:
+            return True
+        return self._decode_since_prefill >= self.decode_per_prefill
+
+    def note_decode(self) -> None:
+        self._decode_since_prefill += 1
+
+    def note_prefill(self) -> None:
+        self._decode_since_prefill = 0
+
+
+def _ttft_hist():
+    from edl_tpu.observability.metrics import SERVING_TTFT_BUCKETS
+
+    return get_registry().histogram(
+        "serving_ttft_seconds",
+        help="time to first token (submit to first emit)",
+        buckets=SERVING_TTFT_BUCKETS)
+
+
+def _tpot_hist():
+    from edl_tpu.observability.metrics import SERVING_TPOT_BUCKETS
+
+    return get_registry().histogram(
+        "serving_tpot_seconds",
+        help="per-output-token time (decode inter-token interval)",
+        buckets=SERVING_TPOT_BUCKETS)
+
+
+class DecodeReplica:
+    """One token-level model server: a fixed-slot decode batch over an
+    AOT-compiled cached step, continuously re-packed every iteration.
+
+    Each loop iteration, in order: (1) apply a pending weight swap
+    (ITERATION BOUNDARY — live sessions' caches are untouched; decode
+    continues on the new weights next step); (2) admit queued sessions
+    into free slots, reserving their FULL KV capacity up front (bounded
+    admission: a session that fits never OOMs mid-decode); (3) run
+    either one prefill chunk (the scheduler's WFQ pick, under the TPOT
+    interleave budget) or one decode step over every live slot.  A
+    sequence that finishes frees its slot and its KV blocks before the
+    next iteration packs.
+
+    ``role="prefill"`` replicas stop at the first token: they emit it,
+    export the session's cache, and hand the session to
+    ``on_handoff(sess, host_kv)`` — the disaggregated front half."""
+
+    def __init__(self, name: str, params: Any, cfg, *,
+                 job: str = "job", role: str = "decode",
+                 slots: int = 4, prefill_chunk: int = 16,
+                 kv_blocks: int = 64, kv_block_size: int = 16,
+                 max_blocks_per_session: int = 8,
+                 eos_id: Optional[int] = None,
+                 scheduler: Optional[TokenScheduler] = None,
+                 ttft_slo_ms: float = 0.0, tpot_slo_ms: float = 0.0,
+                 on_handoff: Optional[Callable] = None,
+                 on_session_done: Optional[Callable] = None,
+                 ledger=None) -> None:
+        from edl_tpu.runtime.kvcache import KVBlockPool
+
+        self.name = name
+        self.cfg = cfg
+        self.job = job
+        self.role = role
+        self.slots = max(int(slots), 1)
+        self.prefill_chunk = max(int(prefill_chunk), 1)
+        self.eos_id = eos_id
+        self.ttft_slo_ms = float(ttft_slo_ms)
+        self.tpot_slo_ms = float(tpot_slo_ms)
+        self.sched = scheduler or TokenScheduler()
+        self.on_handoff = on_handoff
+        self.on_session_done = on_session_done
+        self.ledger = ledger
+        self.pool = KVBlockPool(cfg, kv_blocks, kv_block_size,
+                                max_blocks_per_session, job=job,
+                                replica=name)
+        self.params = params
+        self.state = BUILDING
+        self.generation = 0
+        self.iterations = 0
+        self.decode_iterations = 0
+        self.prefill_chunks = 0
+        self.tokens_emitted = 0
+        self._slots: list[Optional[DecodeSession]] = [None] * self.slots
+        self._queue: "collections.deque[DecodeSession]" = collections.deque()
+        #: (sid, blocks, host_kv) scatters awaiting this loop's next
+        #: iteration boundary — the loop owns all cache-array mutation
+        #: (donation makes cross-thread scatters use-after-donate races)
+        self._pending_imports: "collections.deque[tuple]" = \
+            collections.deque()
+        self._cond = threading.Condition()
+        self._pending_weights: Optional[tuple[Any, int]] = None
+        self._swap_applied = threading.Event()
+        self._built = threading.Event()
+        self._quiesced = threading.Event()
+        self._resume = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._ttft = _ttft_hist()
+        self._tpot = _tpot_hist()
+        self._counters = get_counters()
+        # zero-pre-registration: every per-class TTFT/TPOT series (and
+        # the token counters) exists from scrape #1
+        for pri in PRI_NAMES.values():
+            self._ttft.touch(job=job, priority=pri)
+            self._tpot.touch(job=job, priority=pri)
+            self._counters.inc("serving_ttft_slo_violations", 0, job=job,
+                              priority=pri)
+            self._counters.inc("serving_tpot_slo_violations", 0, job=job,
+                              priority=pri)
+        self._counters.inc("serving_decode_tokens", 0, job=job)
+        self._counters.inc("serving_prefill_chunks", 0, job=job)
+        for outcome in ("done", "failed", "migrated", "handed_off"):
+            self._counters.inc("serving_sessions", 0, job=job,
+                              outcome=outcome)
+        get_registry().gauge_fn(
+            "serving_sessions_active", self.sessions_active,
+            help="sessions resident (slots + admission queue)",
+            job=job, replica=name)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "DecodeReplica":
+        self._thread = threading.Thread(target=self._run,
+                                        name=f"decode-{self.name}",
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def wait_ready(self, timeout_s: float = 120.0) -> bool:
+        return self._built.wait(timeout_s) and self.state != STOPPED
+
+    def _run(self) -> None:
+        t0 = time.perf_counter()
+        try:
+            self._warmup()
+        except Exception as exc:
+            log.error("decode replica build failed", replica=self.name,
+                      error=str(exc)[:200])
+            self.state = STOPPED
+            self._built.set()
+            self._fail_all(exc)
+            return
+        with self._cond:
+            if self.state == BUILDING:
+                self.state = READY
+        self._built.set()
+        get_tracer().instant(
+            "decode_replica_ready", category="serving", replica=self.name,
+            role=self.role,
+            build_ms=round((time.perf_counter() - t0) * 1000, 1))
+        log.info("decode replica ready", replica=self.name, role=self.role,
+                 build_ms=round((time.perf_counter() - t0) * 1000, 1))
+        self._loop()
+
+    def _warmup(self) -> None:
+        """AOT the two fixed-shape entry points (decode batch + prefill
+        chunk) against a scratch cache — the ready gate's compile, off
+        the traffic path exactly like the single-shot replicas."""
+        import jax
+        import numpy as np
+
+        from edl_tpu.models import llama
+
+        cfg = self.cfg
+        maxb = self.pool.max_blocks_per_session
+        nb = self.pool.num_blocks
+        scratch = llama.init_cache(cfg, nb, self.pool.block_size)
+        dead_tables = np.full((self.slots, maxb), nb, np.int32)
+        logits, scratch = llama.decode_step(
+            self.params, scratch,
+            jax.numpy.zeros((self.slots,), "int32"),
+            jax.numpy.zeros((self.slots,), "int32"),
+            jax.numpy.asarray(dead_tables),
+            jax.numpy.zeros((self.slots,), bool), cfg)
+        jax.block_until_ready(logits)
+        logits, scratch = llama.prefill(
+            self.params, scratch,
+            jax.numpy.zeros((self.prefill_chunk,), "int32"),
+            jax.numpy.asarray(dead_tables[0]),
+            jax.numpy.asarray(0, "int32"),
+            jax.numpy.asarray(0, "int32"), cfg)
+        jax.block_until_ready(logits)
+        del scratch  # the pool's real cache stays zeroed and un-donated
+
+    def stop(self, drain: bool = True, timeout_s: float = 30.0) -> bool:
+        """``drain=True`` finishes every resident session first (the
+        graceful path); ``drain=False`` is the SIGKILL drill — resident
+        sessions are failed typed (:class:`SessionDropped`) unless a
+        fleet rescues them first."""
+        with self._cond:
+            self.state = DRAINING if drain else STOPPED
+            self._resume.set()  # a quiesced loop must wake to exit
+            self._cond.notify_all()
+        t = self._thread
+        if t is not None:
+            t.join(timeout_s)
+        with self._cond:
+            self.state = STOPPED
+            self._cond.notify_all()
+        self._fail_all(SessionDropped(
+            f"decode replica {self.name} stopped"))
+        return t is None or not t.is_alive()
+
+    def _fail_all(self, exc: BaseException) -> None:
+        victims: list[DecodeSession] = []
+        with self._cond:
+            while self._queue:
+                victims.append(self._queue.popleft())
+            for i, sess in enumerate(self._slots):
+                if sess is not None:
+                    victims.append(sess)
+                    self._slots[i] = None
+        for sess in victims:
+            self.pool.free_session(sess.id)
+            self._counters.inc("serving_sessions", job=self.job,
+                              outcome="failed")
+            sess.fail(exc)
+            if self.on_session_done is not None:
+                self.on_session_done(sess)
+
+    # -- admission -----------------------------------------------------------
+
+    def can_admit(self, prompt_len: int, max_new: int) -> bool:
+        """Bounded-admission probe: would this session's FULL KV
+        reservation fit the pool right now (counting what's queued
+        ahead of it)?"""
+        with self._cond:
+            queued = sum(
+                self.pool._blocks_for(len(s.resume_tokens())
+                                      + s.max_new_tokens)
+                for s in self._queue)
+        need = self.pool._blocks_for(int(prompt_len) + int(max_new))
+        return (need + queued <= self.pool.blocks_free()
+                and need <= self.pool.max_blocks_per_session)
+
+    def submit(self, sess: DecodeSession) -> None:
+        with self._cond:
+            if self.state == STOPPED:
+                raise SessionDropped(f"replica {self.name} is stopped")
+            sess.replica = self.name
+            self._queue.append(sess)
+            self._cond.notify_all()
+
+    def sessions_active(self) -> int:
+        with self._cond:
+            return (len(self._queue)
+                    + sum(1 for s in self._slots if s is not None))
+
+    def sessions_resident(self) -> list[DecodeSession]:
+        with self._cond:
+            return ([s for s in self._slots if s is not None]
+                    + list(self._queue))
+
+    def routable(self) -> bool:
+        return self.state == READY
+
+    # -- weight swaps (iteration-boundary, cache-preserving) -----------------
+
+    def swap_weights(self, params: Any, generation: int,
+                     timeout_s: float = 30.0) -> bool:
+        """Hand the loop new weights, applied at the next ITERATION
+        boundary.  Unlike the stateless replicas there is nothing to
+        drain: live sessions keep their KV caches across the swap and
+        decode their next token on the new weights — the rolling-reload
+        contract for stateful serving."""
+        self._swap_applied.clear()
+        with self._cond:
+            if self.state == STOPPED:
+                return False
+            self._pending_weights = (params, generation)
+            self._cond.notify_all()
+        return self._swap_applied.wait(timeout_s)
+
+    def _maybe_swap(self) -> None:
+        with self._cond:
+            pending, self._pending_weights = self._pending_weights, None
+        if pending is None:
+            return
+        params, generation = pending
+        self.params = params
+        self.generation = generation
+        self._swap_applied.set()
+        self._counters.inc("serving_reloads", job=self.job)
+        get_tracer().instant(
+            "decode_weights_reloaded", category="serving",
+            replica=self.name, generation=generation,
+            live_sessions=self.sessions_active())
+
+    # -- quiesce / evacuate (the resize + handoff machinery) -----------------
+
+    def quiesce(self, timeout_s: float = 30.0) -> bool:
+        """Park the loop at the next iteration boundary.  While parked,
+        the caller owns the replica's state — exports, imports, weight
+        pokes — then :meth:`resume` (or a stop) releases it.  The unit
+        the replan-path evacuation is built on."""
+        with self._cond:
+            if self.state == STOPPED:
+                return False
+            self._quiesced.clear()
+            self._resume.clear()
+            self._quiesce_req = True
+            self._cond.notify_all()
+        return self._quiesced.wait(timeout_s)
+
+    def resume(self) -> None:
+        with self._cond:
+            self._quiesce_req = False
+            self._resume.set()
+            self._cond.notify_all()
+
+    _quiesce_req = False
+
+    def _drain_imports(self) -> None:
+        """Apply deferred KV scatters.  Runs on the loop thread at an
+        iteration boundary — or on a controller thread while the loop
+        is provably parked (quiesced/stopped); those are the only
+        moments cache-array mutation is race-free against donation."""
+        from edl_tpu.models.llama import scatter_session_kv
+
+        while True:
+            with self._cond:
+                if not self._pending_imports:
+                    return
+                sid, blocks, host_kv = self._pending_imports.popleft()
+            if sid not in self.pool.sessions():
+                continue  # freed (failed/stopped) before the scatter
+            self.pool.set_cache(scatter_session_kv(
+                self.pool.cache, blocks, host_kv, self.pool.block_size))
+
+    def export_all(self) -> list[tuple[DecodeSession, Optional[dict]]]:
+        """Evacuate every resident session (call quiesced): returns
+        ``(session, host_kv-or-None)`` — None for sessions still queued
+        (no cache yet; they re-prefill wherever they land).  Slots and
+        blocks are freed here; the session objects travel."""
+        self._drain_imports()  # loop is parked; adopt stragglers first
+        out: list[tuple[DecodeSession, Optional[dict]]] = []
+        with self._cond:
+            resident = [s for s in self._slots if s is not None]
+            queued = list(self._queue)
+            self._queue.clear()
+            self._slots = [None] * self.slots
+        for sess in resident:
+            kv = None
+            if sess.cached > 0:
+                kv = self.pool.export_session(sess.id, sess.cached)
+            self.pool.free_session(sess.id)
+            sess.slot = None
+            out.append((sess, kv))
+        for sess in queued:
+            self.pool.free_session(sess.id)  # idempotent no-op usually
+            out.append((sess, None))
+        return out
+
+    def import_session(self, sess: DecodeSession,
+                       host_kv: Optional[dict]) -> None:
+        """Adopt a session (call quiesced, or pre-start): with
+        ``host_kv`` its cache lands in this pool and decode resumes at
+        the next iteration; without, it re-enters prefill (covering
+        prompt + already-generated tokens, emitting nothing twice)."""
+        from edl_tpu.runtime.kvcache import KVPoolExhausted
+
+        total = len(sess.resume_tokens()) + sess.max_new_tokens
+        if host_kv is not None:
+            length = int(host_kv["k"].shape[1])
+            # reserve the FULL span synchronously — the typed failure
+            # (retriable on another replica, host_kv intact) happens
+            # here; the cache scatter itself is deferred to this
+            # replica's loop at its next iteration boundary, because
+            # the loop donates the cache arrays into every step and a
+            # cross-thread scatter races that donation
+            try:
+                blocks = self.pool.ensure_capacity(sess.id, total)
+            except KVPoolExhausted:
+                self.pool.free_session(sess.id)
+                raise
+            sess.cached = length
+            sess.state = S_DECODING
+            # a handed-off prompt-only cache still needs its first token
+            # fed; generated[-1] is always the next decode input
+            assert sess.generated, "handoff before first token"
+        else:
+            sess.cached = 0
+            sess.state = S_QUEUED
+        sess.replica = self.name
+        sess.slot = None
+        sess.migrations += 1
+        with self._cond:
+            if self.state == STOPPED:
+                self.pool.free_session(sess.id)
+                raise SessionDropped(
+                    f"replica {self.name} stopped mid-import")
+            if host_kv is not None:
+                self._pending_imports.append((sess.id, blocks, host_kv))
+            self._queue.append(sess)
+            self._cond.notify_all()
+        self._counters.inc("serving_session_migrations", job=self.job)
+
+    # -- the iteration loop --------------------------------------------------
+
+    def _admit_locked(self) -> None:
+        """Move queued sessions into free slots, reserving full KV
+        capacity.  A session whose reservation cannot fit stays queued
+        (bounded admission — it retries every iteration as blocks
+        free); one whose reservation can NEVER fit fails typed."""
+        from edl_tpu.runtime.kvcache import KVPoolExhausted
+
+        for i in range(self.slots):
+            if self._slots[i] is not None or not self._queue:
+                continue
+            sess = self._queue[0]
+            total = len(sess.resume_tokens()) + sess.max_new_tokens
+            if self.pool._blocks_for(total) > self.pool.max_blocks_per_session:
+                self._queue.popleft()
+                sess.fail(KVPoolExhausted(
+                    f"session {sess.id}: {total} tokens exceed the "
+                    f"per-session KV cap"))
+                self._counters.inc("serving_sessions", job=self.job,
+                                  outcome="failed")
+                continue
+            try:
+                self.pool.ensure_capacity(sess.id, total)
+            except KVPoolExhausted:
+                break  # pool full now; head-of-line retries next iter
+            self._queue.popleft()
+            sess.slot = i
+            if sess.state in (S_QUEUED, S_PREFILL):
+                sess.state = S_PREFILL
+                self.sched.stamp(sess)
+            self._slots[i] = sess
+
+    def _park_for_work(self) -> bool:
+        """Wait until there is something to do (or quiesce/stop).
+        Returns False when the loop must exit."""
+        with self._cond:
+            while True:
+                if self.state == STOPPED:
+                    return False
+                if self._quiesce_req:
+                    self._quiesced.set()
+                    self._cond.release()
+                    try:
+                        self._resume.wait()
+                    finally:
+                        self._cond.acquire()
+                    continue
+                have_work = (self._queue or self._pending_imports
+                             or any(s is not None for s in self._slots)
+                             or self._pending_weights is not None)
+                if self.state == DRAINING and not have_work:
+                    return False
+                if have_work:
+                    return True
+                self._cond.wait(0.05)
+
+    def _loop(self) -> None:
+        import jax
+        import numpy as np
+
+        from edl_tpu.models import llama
+
+        while True:
+            if not self._park_for_work():
+                return
+            self._maybe_swap()
+            self._drain_imports()
+            with self._cond:
+                self._admit_locked()
+                prefilling = [s for s in self._slots
+                              if s is not None and s.state == S_PREFILL]
+                decoding = [s for s in self._slots
+                            if s is not None and s.state == S_DECODING]
+            if not prefilling and not decoding:
+                # queued sessions couldn't admit (pool full) — park
+                # briefly rather than spin; frees wake admissions
+                time.sleep(0.001)
+                continue
+            self.iterations += 1
+            try:
+                if self.sched.allow_prefill(len(decoding), len(prefilling)):
+                    sess = self.sched.pick_prefill(prefilling)
+                    self.sched.note_prefill()
+                    self._prefill_one(sess, llama, jax, np)
+                else:
+                    self.sched.note_decode()
+                    self._decode_all(decoding, llama, jax, np)
+            except Exception as exc:
+                log.error("decode iteration failed", replica=self.name,
+                          error=str(exc)[:200])
+                self._fail_all(exc)
+                with self._cond:
+                    if self.state not in (STOPPED,):
+                        self.state = STOPPED
+                return
+
+    def _prefill_one(self, sess: DecodeSession, llama, jax, np) -> None:
+        """Advance one session's prefill by one fixed-size chunk; on the
+        final chunk, emit the first token (unless this is a rescue
+        re-prefill of already-emitted history) and transition."""
+        tokens = sess.resume_tokens()
+        start = sess.cached
+        remaining = len(tokens) - start
+        n = min(remaining, self.prefill_chunk)
+        chunk = np.zeros(self.prefill_chunk, np.int32)
+        chunk[:n] = tokens[start:start + n]
+        table = self.pool.block_table(sess.id)
+        logits, cache = llama.prefill(
+            self.params, self.pool.cache, jax.numpy.asarray(chunk),
+            jax.numpy.asarray(table),
+            jax.numpy.asarray(start, "int32"),
+            jax.numpy.asarray(n, "int32"), self.cfg)
+        self.pool.set_cache(cache)
+        sess.cached = start + n
+        self.prefill_chunks += 1
+        self._counters.inc("serving_prefill_chunks", job=self.job)
+        if self.ledger is not None:
+            try:
+                self.ledger.add_tokens(n)
+            except Exception:
+                pass
+        if sess.cached < len(tokens):
+            return  # more chunks to go; scheduler re-picks
+        pri = PRI_NAMES.get(sess.priority, "normal")
+        if not sess.generated:
+            # fresh prompt: the final row's logits seed generation
+            row = np.asarray(logits[n - 1])
+            first = int(row.argmax())
+            sess.emit(first)
+            self.tokens_emitted += 1
+            self._counters.inc("serving_decode_tokens", job=self.job)
+            self._ttft.observe(sess.ttft_s, job=self.job, priority=pri)
+            if self.ttft_slo_ms and sess.ttft_s * 1e3 > self.ttft_slo_ms:
+                self._counters.inc("serving_ttft_slo_violations",
+                                  job=self.job, priority=pri)
+            if self._check_finished(sess):
+                return
+        sess.state = S_DECODING
+        if self.role == "prefill" and self.on_handoff is not None:
+            self._handoff(sess)
+
+    def _handoff(self, sess: DecodeSession) -> None:
+        """Disaggregation's seam: export the prefilled cache, free the
+        slot, hand the session to the fleet's decode tier."""
+        kv = self.pool.export_session(sess.id, sess.cached)
+        with self._cond:
+            if sess.slot is not None:
+                self._slots[sess.slot] = None
+            sess.slot = None
+        self.pool.free_session(sess.id)
+        self._counters.inc("serving_sessions", job=self.job,
+                          outcome="handed_off")
+        self.on_handoff(sess, kv)
+
+    def _decode_all(self, decoding: list[DecodeSession], llama, jax,
+                    np) -> None:
+        t0 = time.perf_counter()
+        S = self.slots
+        nb = self.pool.num_blocks
+        maxb = self.pool.max_blocks_per_session
+        toks = np.zeros(S, np.int32)
+        poss = np.zeros(S, np.int32)
+        live = np.zeros(S, bool)
+        tables = np.full((S, maxb), nb, np.int32)
+        for sess in decoding:
+            i = sess.slot
+            toks[i] = sess.generated[-1]
+            poss[i] = sess.cached
+            live[i] = True
+            tables[i] = self.pool.block_table(sess.id)
+        logits, cache = llama.decode_step(
+            self.params, self.pool.cache, jax.numpy.asarray(toks),
+            jax.numpy.asarray(poss), jax.numpy.asarray(tables),
+            jax.numpy.asarray(live), self.cfg)
+        self.pool.set_cache(cache)
+        rows = np.asarray(logits)
+        t1 = time.perf_counter()
+        self.decode_iterations += 1
+        for sess in decoding:
+            prev_emit = sess.t_last_token
+            tok = int(rows[sess.slot].argmax())
+            sess.cached += 1
+            sess.emit(tok)
+            self.tokens_emitted += 1
+            self._counters.inc("serving_decode_tokens", job=self.job)
+            pri = PRI_NAMES.get(sess.priority, "normal")
+            itt = max(sess.t_last_token - prev_emit, 0.0)
+            self._tpot.observe(itt, job=self.job, priority=pri)
+            if self.tpot_slo_ms and itt * 1e3 > self.tpot_slo_ms:
+                self._counters.inc("serving_tpot_slo_violations",
+                                  job=self.job, priority=pri)
+            if self.ledger is not None:
+                try:
+                    self.ledger.add_tokens(1)
+                except Exception:
+                    pass
+            self._check_finished(sess)
+        del t0, t1
+
+    def _check_finished(self, sess: DecodeSession) -> bool:
+        """Finished sequences free their slot (and blocks) IMMEDIATELY
+        — the next iteration's admission packs a waiting session into
+        it."""
+        hit_eos = (self.eos_id is not None and sess.generated
+                   and sess.generated[-1] == self.eos_id)
+        if len(sess.generated) < sess.max_new_tokens and not hit_eos:
+            return False
+        with self._cond:
+            if sess.slot is not None:
+                self._slots[sess.slot] = None
+            sess.slot = None
+            self._cond.notify_all()
+        self.pool.free_session(sess.id)
+        sess.finish()
+        self._counters.inc("serving_sessions", job=self.job,
+                          outcome="done")
+        if self.on_session_done is not None:
+            self.on_session_done(sess)
+        return True
+
+
+class DecodeFleet:
+    """The autoregressive replica set: role-aware routing (prefill tier
+    → decode tier handoff when disaggregated), session affinity (a
+    session's decode iterations always hit the replica holding its
+    cache), elastic scale with LIVE KV evacuation (a resize drops zero
+    sessions), rolling cache-preserving weight reloads, and rescue on
+    replica death (sessions re-prefill their known history elsewhere —
+    handed off or failed TYPED, never hung).
+
+    ``roles`` maps role → replica count, e.g. ``{"decode": 2}`` (the
+    aggregated default) or ``{"prefill": 1, "decode": 2}``
+    (disaggregated: prompts prefill on the front tier, caches hand off
+    to the decode tier that owns the session thereafter)."""
+
+    def __init__(self, params: Any, cfg, *, job: str = "job",
+                 roles: Optional[dict] = None, slots: int = 4,
+                 prefill_chunk: int = 16, kv_blocks: int = 64,
+                 kv_block_size: int = 16, max_blocks_per_session: int = 8,
+                 eos_id: Optional[int] = None,
+                 ttft_slo_ms: float = 0.0, tpot_slo_ms: float = 0.0,
+                 wfq_weights: Optional[dict] = None,
+                 decode_per_prefill: int = 2,
+                 max_queued_sessions: int = 64,
+                 kv=None, ledger=None, window: int = 4096) -> None:
+        self.cfg = cfg
+        self.job = job
+        self.roles = dict(roles or {"decode": 1})
+        if self.roles.get("decode", 0) < 1:
+            raise ValueError("DecodeFleet needs >=1 decode replica")
+        self._rep_kw = dict(
+            slots=slots, prefill_chunk=prefill_chunk, kv_blocks=kv_blocks,
+            kv_block_size=kv_block_size,
+            max_blocks_per_session=max_blocks_per_session, eos_id=eos_id,
+            ttft_slo_ms=ttft_slo_ms, tpot_slo_ms=tpot_slo_ms)
+        self._wfq_weights = dict(wfq_weights) if wfq_weights else None
+        self._decode_per_prefill = int(decode_per_prefill)
+        self.max_queued_sessions = int(max_queued_sessions)
+        self._kv = kv
+        self._ledger = ledger
+        self._gen_params = params
+        self.generation = 0
+        self._lock = threading.RLock()
+        self._ids = itertools.count(1)
+        self._replicas: list[DecodeReplica] = []
+        self._rep_seq = itertools.count()
+        self.sessions_submitted = 0
+        self.sessions_completed = 0
+        self.sessions_failed = 0
+        self.migrations = 0
+        self._counters = get_counters()
+        #: rolling TTFT / inter-token completions for windowed stats
+        self._ttft_window: "collections.deque[tuple[float, float, int]]" \
+            = collections.deque(maxlen=max(int(window), 16))
+        self._tok_window: "collections.deque[float]" = collections.deque(
+            maxlen=max(int(window), 16))
+        self._watcher: Optional[_WeightWatcher] = None
+        for role, n in self.roles.items():
+            for _ in range(n):
+                self._replicas.append(self._new_replica(role))
+        for r in self._replicas:
+            r.wait_ready()
+
+    # -- replica construction ------------------------------------------------
+
+    def _new_replica(self, role: str) -> DecodeReplica:
+        name = f"{self.job}/{role[0]}{next(self._rep_seq)}"
+        r = DecodeReplica(
+            name, self._gen_params, self.cfg, job=self.job, role=role,
+            scheduler=TokenScheduler(self._wfq_weights,
+                                     self._decode_per_prefill),
+            on_handoff=self._adopt_handoff if role == "prefill" else None,
+            on_session_done=self._record_done, ledger=self._ledger,
+            **self._rep_kw)
+        r.generation = self.generation
+        return r.start()
+
+    def _role_replicas(self, role: str) -> list[DecodeReplica]:
+        with self._lock:
+            return [r for r in self._replicas
+                    if r.role == role and r.state != STOPPED]
+
+    # -- routing / admission -------------------------------------------------
+
+    def submit(self, prompt, max_new_tokens: int,
+               priority: int = PRI_NORMAL,
+               trace_id: Optional[str] = None,
+               on_done: Optional[Callable] = None,
+               on_token: Optional[Callable] = None) -> DecodeSession:
+        """Admit one session.  Bounded: when no target replica can hold
+        the session's full KV reservation and its queue is at the cap,
+        raises :class:`~edl_tpu.runtime.kvcache.KVPoolExhausted` (the
+        front door's 429) — load backpressures, it never OOMs.
+        Callbacks must be wired HERE (not after): a fast session can
+        complete before the caller's next statement runs."""
+        from edl_tpu.runtime.kvcache import KVPoolExhausted
+
+        sess = DecodeSession(prompt, max_new_tokens, priority=priority,
+                             id=next(self._ids), trace_id=trace_id)
+        sess.on_done = on_done
+        sess.on_token = on_token
+        # a session that can NEVER fit (full reservation beyond the
+        # per-session cap) rejects at the door, not after queueing
+        bs = self._rep_kw["kv_block_size"]
+        need = -(-(len(sess.prompt) + sess.max_new_tokens) // bs)
+        if need > self._rep_kw["max_blocks_per_session"]:
+            self._counters.inc("serving_kv_admission_rejects",
+                              job=self.job)
+            raise KVPoolExhausted(
+                f"session needs {need} blocks, per-session cap is "
+                f"{self._rep_kw['max_blocks_per_session']}")
+        tier = (self._role_replicas("prefill")
+                or self._role_replicas("decode"))
+        ready = [r for r in tier if r.routable()] or tier
+        if not ready:
+            raise SessionDropped(f"fleet {self.job} has no replicas")
+        fits = [r for r in ready
+                if r.can_admit(len(sess.prompt), sess.max_new_tokens)]
+        if not fits:
+            lightest = min(ready, key=lambda r: r.sessions_active())
+            if lightest.sessions_active() >= self.max_queued_sessions:
+                self._counters.inc("serving_kv_admission_rejects",
+                                  job=self.job)
+                raise KVPoolExhausted(
+                    f"fleet {self.job}: no replica can admit "
+                    f"{len(sess.prompt)}+{sess.max_new_tokens} tokens")
+            fits = [lightest]  # queue it; blocks free as sessions end
+        target = min(fits, key=lambda r: r.sessions_active())
+        target.submit(sess)
+        self.sessions_submitted += 1
+        return sess
+
+    def _adopt_handoff(self, sess: DecodeSession, host_kv: dict) -> None:
+        """A prefill replica finished a prompt: land the cache on the
+        decode tier (session affinity starts here).  Runs on the
+        prefill replica's loop thread; imports into a pool that a
+        decode loop is reading concurrently are safe because scatter
+        builds NEW cache arrays (functional update) targeting free
+        blocks only."""
+        from edl_tpu.runtime.kvcache import KVPoolExhausted
+
+        decode_tier = [r for r in self._role_replicas("decode")
+                       if r.routable()]
+        decode_tier.sort(key=lambda r: r.sessions_active())
+        for r in decode_tier:
+            try:
+                r.import_session(sess, host_kv)
+                self.migrations += 1
+                return
+            except KVPoolExhausted:
+                continue
+        # no decode capacity: fall back to re-prefill wherever admission
+        # frees first (queued, cacheless) rather than failing a session
+        # we already spent prefill on
+        if decode_tier:
+            decode_tier[0].import_session(sess, None)
+            self.migrations += 1
+            return
+        sess.fail(SessionDropped(
+            f"fleet {self.job}: no decode tier for handoff"))
+
+    def _record_done(self, sess: DecodeSession) -> None:
+        with self._lock:
+            if sess.error is None:
+                self.sessions_completed += 1
+                self._ttft_window.append(
+                    (sess.t_done, sess.ttft_s, sess.priority))
+                if sess.tpot_s > 0:
+                    self._tok_window.append(sess.tpot_s)
+            else:
+                self.sessions_failed += 1
+
+    # -- elastic scale with live KV evacuation -------------------------------
+
+    def scale_to(self, target: int, wait_ready_s: float = 120.0) -> int:
+        """Resize the DECODE tier.  Growing builds (and warms) new
+        replicas behind the ready gate.  Shrinking is the tentpole
+        guarantee: each victim quiesces at an iteration boundary, its
+        whole session set EVACUATES through the host (the replan path's
+        evacuation idiom applied to KV state), survivors adopt every
+        session — cache intact where it fits, re-prefill where it
+        doesn't — and ZERO sessions drop."""
+        target = max(int(target), 1)
+        grown: list[DecodeReplica] = []
+        victims: list[DecodeReplica] = []
+        with self._lock:
+            decode = [r for r in self._replicas
+                      if r.role == "decode" and r.state != STOPPED]
+            while len(decode) + len(grown) < target:
+                grown.append(self._new_replica("decode"))
+            n_victims = len(decode) - target
+            if n_victims > 0:
+                victims = decode[-n_victims:]
+            self._replicas.extend(grown)
+        for r in grown:
+            r.wait_ready(wait_ready_s)
+            if self.generation and r.state != STOPPED:
+                r.swap_weights(self._gen_params, self.generation)
+        for victim in victims:
+            self._evacuate(victim)
+        with self._lock:
+            for v in victims:
+                if v in self._replicas:
+                    self._replicas.remove(v)
+            return len([r for r in self._replicas if r.role == "decode"])
+
+    def _evacuate(self, victim: DecodeReplica) -> None:
+        t0 = time.perf_counter()
+        victim.quiesce()
+        moved = victim.export_all()
+        survivors = [r for r in self._role_replicas("decode")
+                     if r is not victim and r.routable()]
+        for sess, kv in moved:
+            placed = False
+            for r in sorted(survivors, key=lambda r: r.sessions_active()):
+                from edl_tpu.runtime.kvcache import KVPoolExhausted
+
+                try:
+                    r.import_session(sess, kv)
+                    placed = True
+                    break
+                except KVPoolExhausted:
+                    continue
+            if not placed and survivors:
+                # cache didn't fit anywhere: ship the session without it
+                # (re-prefill of known history — slower, never dropped)
+                sorted(survivors,
+                       key=lambda r: r.sessions_active())[0] \
+                    .import_session(sess, None)
+                placed = True
+            if not placed:
+                sess.fail(SessionDropped(
+                    f"fleet {self.job}: scale-down with no survivor"))
+                with self._lock:
+                    self.sessions_failed += 1
+                continue
+            with self._lock:
+                self.migrations += 1
+        victim.stop(drain=False)  # empty by construction
+        get_tracer().instant(
+            "decode_fleet_evacuated", category="serving", job=self.job,
+            replica=victim.name, sessions=len(moved),
+            evac_ms=round((time.perf_counter() - t0) * 1000, 1))
+        log.info("decode replica evacuated", replica=victim.name,
+                 sessions=len(moved),
+                 evac_ms=round((time.perf_counter() - t0) * 1000, 1))
+
+    def kill_replica(self, name: str) -> int:
+        """The SIGKILL drill: the replica vanishes WITHOUT evacuation
+        (its device cache is gone).  Resident sessions are rescued by
+        re-prefilling their known history (prompt + generated tokens)
+        on survivors — deterministic greedy decode makes the
+        continuation token-identical — or failed typed when no
+        survivor exists.  Returns sessions rescued."""
+        with self._lock:
+            victim = next((r for r in self._replicas if r.name == name),
+                          None)
+            if victim is None:
+                raise KeyError(name)
+            self._replicas.remove(victim)
+        resident = victim.sessions_resident()
+        # sever: the dead replica's loop must not race the rescue
+        with victim._cond:
+            victim._queue.clear()
+            victim._slots = [None] * victim.slots
+            victim.state = STOPPED
+            victim._resume.set()
+            victim._cond.notify_all()
+        if victim._thread is not None:
+            victim._thread.join(10.0)
+        survivors = [r for r in self._role_replicas(victim.role)
+                     or self._role_replicas("decode") if r.routable()]
+        rescued = 0
+        for sess in resident:
+            if survivors:
+                target = min(survivors, key=lambda r: r.sessions_active())
+                target.import_session(sess, None)  # cache died with it
+                rescued += 1
+                with self._lock:
+                    self.migrations += 1
+            else:
+                sess.fail(SessionDropped(
+                    f"replica {name} died with no survivor"))
+                with self._lock:
+                    self.sessions_failed += 1
+        return rescued
+
+    # -- rolling reloads (cache-preserving; the watch_lineage fix) -----------
+
+    def rolling_reload(self, params: Any, generation: int) -> int:
+        """Swap every replica to ``generation`` one at a time, each at
+        its own ITERATION BOUNDARY, with every in-flight session's KV
+        cache preserved — the stateful-serving reload contract.  (The
+        stateless fleet's reload waits for its queue to drain; decode
+        sessions are minutes long and must NOT be drained — regression:
+        tests/test_decode.py::test_rolling_reload_live_decode.)"""
+        self._gen_params = params
+        swapped = 0
+        with self._lock:
+            replicas = list(self._replicas)
+        for r in replicas:
+            if r.state == STOPPED:
+                continue
+            if r.swap_weights(params, generation):
+                swapped += 1
+        self.generation = generation
+        if self._kv is not None:
+            try:
+                self._kv.kv_set(SERVING_GEN_KEY.format(job=self.job),
+                                str(generation).encode())
+            except Exception as exc:
+                log.warn("decode generation publish failed", job=self.job,
+                         error=str(exc)[:120])
+        log.info("decode rolling reload complete", job=self.job,
+                 generation=generation, replicas=swapped)
+        return swapped
+
+    def reload_from_lineage(self, checkpointer) -> Optional[int]:
+        """Roll onto the newest VERIFIED generation (same lineage
+        contract as the stateless fleet: unverified/forged generations
+        never ship; restores that landed elsewhere are refused)."""
+        import jax
+
+        refresh = getattr(checkpointer, "refresh", None)
+        if refresh is not None:
+            refresh()
+        step = checkpointer.latest_verified_step()
+        if step is None or step <= self.generation:
+            return None
+        verified_fn = getattr(checkpointer, "manifest_verified", None)
+        if verified_fn is not None and verified_fn(step) is False:
+            log.warn("decode reload SKIPPED unverified generation",
+                     job=self.job, generation=step)
+            get_counters().inc("serving_reload_skipped_unverified")
+            return None
+        template = {"params": jax.device_get(self._gen_params)}
+        restored = checkpointer.restore(template, step=step)
+        landed = getattr(checkpointer, "last_restored_step", step)
+        if landed is not None and landed != step:
+            log.warn("decode reload SKIPPED generation that failed "
+                     "verification at restore", job=self.job,
+                     generation=step, landed=landed)
+            get_counters().inc("serving_reload_skipped_unverified")
+            return None
+        self.rolling_reload(restored["params"], step)
+        return step
+
+    def watch_lineage(self, checkpointer, poll_s: float = 5.0,
+                      scan_backstop: int = 1) -> "_WeightWatcher":
+        """The deployed reload driver — the same watcher the stateless
+        fleet runs (KVWAITNE long-poll + lineage-scan backstop), now
+        driving the cache-preserving :meth:`rolling_reload`."""
+        self._watcher = _WeightWatcher(self, checkpointer, poll_s,
+                                       scan_backstop=scan_backstop)
+        self._watcher.start()
+        return self._watcher
+
+    # -- observation ---------------------------------------------------------
+
+    def replicas_active(self, role: Optional[str] = None) -> int:
+        with self._lock:
+            return sum(1 for r in self._replicas
+                       if r.state != STOPPED
+                       and (role is None or r.role == role))
+
+    def sessions_active(self) -> int:
+        with self._lock:
+            return sum(r.sessions_active() for r in self._replicas)
+
+    def kv_blocks(self) -> tuple[int, int]:
+        with self._lock:
+            used = sum(r.pool.blocks_used() for r in self._replicas)
+            total = sum(r.pool.num_blocks for r in self._replicas)
+        return used, total
+
+    def kv_bytes(self) -> int:
+        """Pool residency — what a resize plan must reserve
+        (``choose_shape(reserved_bytes_per_device=...)``) and the
+        goodput memory view accounts."""
+        with self._lock:
+            return sum(r.pool.total_bytes() for r in self._replicas)
+
+    def stats(self, window_s: float = 10.0) -> FleetStats:
+        """Windowed decode rollup in the FleetStats shape the scaler
+        consumes — TTFT p99 over recent completions, decode tok/s from
+        replica token counters' windowed emissions."""
+        now = time.perf_counter()
+        with self._lock:
+            ttfts = [(t, v) for t, v, _ in self._ttft_window
+                     if now - t <= window_s]
+            tpots = list(self._tok_window)
+            replicas = list(self._replicas)
+        toks = sum(r.tokens_emitted for r in replicas)
+        if not hasattr(self, "_tok_mark"):
+            self._tok_mark = (now, toks)
+        mark_t, mark_n = self._tok_mark
+        span = max(now - mark_t, 1e-3)
+        decode_tps = (toks - mark_n) / span if span >= 0.2 else 0.0
+        if span > window_s:
+            self._tok_mark = (now, toks)
+        if ttfts:
+            vals = np.sort(np.asarray([v for _, v in ttfts]))
+            ttft_p99 = float(vals[int(0.99 * (len(vals) - 1))]) * 1e3
+        else:
+            ttft_p99 = 0.0
+        tpot_p50 = (float(np.median(np.asarray(tpots))) * 1e3
+                    if tpots else 0.0)
+        used, total = self.kv_blocks()
+        return FleetStats(
+            p50_ms=tpot_p50, p99_ms=ttft_p99,
+            qps=round(decode_tps, 2),
+            queue_depth=sum(len(r._queue) for r in replicas),
+            replicas_ready=sum(1 for r in replicas if r.routable()),
+            replicas_active=len(replicas),
+            requests_windowed=len(ttfts),
+            ttft_p99_ms=round(ttft_p99, 3),
+            tpot_p50_ms=round(tpot_p50, 4),
+            decode_tps=round(decode_tps, 2),
+            sessions=self.sessions_active(),
+            kv_blocks_used=used, kv_blocks_total=total)
+
+    def stop(self, drain: bool = True) -> None:
+        if self._watcher is not None:
+            self._watcher.stop()
+        with self._lock:
+            replicas, self._replicas = list(self._replicas), []
+        for r in replicas:
+            r.stop(drain=drain)
 
 
 # -- traffic generation (bench/CI/test harness) ------------------------------

@@ -639,6 +639,15 @@ class ServingScaler:
             backlog = stats.queue_depth / max(s.max_batch_size, 1)
             want = current + max(1, min(int(backlog / max(current, 1)),
                                         current))
+        ttft = getattr(stats, "ttft_p99_ms", 0.0)
+        slo_ttft = getattr(s, "slo_ttft_ms", 0.0)
+        if slo_ttft and ttft > slo_ttft:
+            # decode fleet breaching its first-token objective: prefill
+            # is starved behind decode — same proportional response
+            backlog = stats.queue_depth / max(
+                getattr(s, "decode_slots", 1) or 1, 1)
+            want = max(want, current + max(
+                1, min(int(backlog / max(current, 1)), current)))
         if s.target_qps_per_replica:
             import math
 
@@ -650,7 +659,10 @@ class ServingScaler:
             fits_after = (not s.target_qps_per_replica
                           or stats.qps <= s.target_qps_per_replica
                           * (current - 1))
+            ttft_ok = (not slo_ttft
+                       or ttft < slo_ttft * self.shrink_headroom)
             if (current > lo and stats.queue_depth == 0 and fits_after
+                    and ttft_ok
                     and (not s.slo_p99_ms
                          or stats.p99_ms < s.slo_p99_ms
                          * self.shrink_headroom)):

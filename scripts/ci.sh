@@ -649,6 +649,64 @@ print("serving smoke OK:", {k: tally[k] for k in
       "prewarm_hits", fleet.prewarm_hits, "generation", fleet.generation)
 EOF
 
+echo "== decode smoke (token-level batching through a live 2->1 scale-down)"
+# Autoregressive tripwire (doc/serving.md §autoregressive serving):
+# sessions decode against a 2-replica DecodeFleet with a paged KV pool,
+# the fleet scales 2→1 MID-DECODE (every live session's K/V evacuates to
+# the survivor), zero dropped sessions, every continuation bitwise-equal
+# to the full-context greedy reference, and the edl_serving_ttft/tpot/
+# kv_* series green under the strict parser.
+JAX_PLATFORMS=cpu python - <<'EOF'
+import numpy as np
+
+from edl_tpu.models.transformer import TINY, apply, init
+from edl_tpu.observability.metrics import get_registry, parse_exposition
+from edl_tpu.runtime.serving import DecodeFleet
+
+params = init(__import__("jax").random.PRNGKey(0), TINY)
+
+def ref_decode(prompt, n):
+    toks, out = list(prompt), []
+    for _ in range(n):
+        logits = apply(params, np.asarray([toks], np.int32), TINY)
+        t = int(np.asarray(logits[0, -1]).argmax())
+        out.append(t); toks.append(t)
+    return out
+
+rng = np.random.default_rng(5)
+ps = [rng.integers(1, 255, size=int(rng.integers(3, 10))).tolist()
+      for _ in range(6)]
+fleet = DecodeFleet(params, TINY, job="ci/decode", roles={"decode": 2},
+                    slots=3, prefill_chunk=8, kv_blocks=48,
+                    kv_block_size=8, max_blocks_per_session=8)
+try:
+    ss = [fleet.submit(p, max_new_tokens=24) for p in ps]
+    for s in ss[:3]:
+        s.wait_first_token(60)     # demonstrably mid-decode...
+    fleet.scale_to(1)              # ...when the fleet shrinks LIVE
+    outs = [s.wait(120) for s in ss]
+finally:
+    fleet.stop(drain=False)
+assert fleet.sessions_failed == 0, "scale-down dropped sessions"
+assert fleet.sessions_completed == len(ps)
+assert fleet.migrations >= 1, "shrink never migrated a session"
+for p, o in zip(ps, outs):
+    assert o == ref_decode(p, 24), "migrated continuation diverged"
+assert fleet.kv_blocks()[0] == 0, "finished sessions leaked KV blocks"
+series = parse_exposition(get_registry().render())  # strict grammar or die
+assert any(k.startswith("edl_serving_ttft_seconds_bucket")
+           and 'job="ci/decode"' in k for k in series), "no TTFT series"
+assert any(k.startswith("edl_serving_tpot_seconds_bucket")
+           and 'job="ci/decode"' in k for k in series), "no TPOT series"
+assert any(k.startswith("edl_serving_kv_blocks_total")
+           and 'job="ci/decode"' in k for k in series), "no KV gauges"
+assert series.get('edl_serving_kv_admission_rejects_total'
+                  '{job="ci/decode"}', -1) == 0
+print("decode smoke OK:", {"sessions": fleet.sessions_completed,
+                           "migrations": fleet.migrations,
+                           "dropped": fleet.sessions_failed})
+EOF
+
 echo "== scrape-plane smoke (HA pair + serving fleet under the MetricsScraper)"
 # The fleet scrape plane end-to-end (doc/observability.md §scrape-plane):
 # an HA coordinator pair and a live serving fleet are discovered/scraped

@@ -1,0 +1,209 @@
+"""Paged KV-cache pool (doc/serving.md §autoregressive serving): block
+allocation, bounded admission (typed 429, never OOM), fragmentation-free
+reuse under churn, abandon/timeout frees, export/import migration, and
+the occupancy gauges the scrape plane reads."""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+import pytest
+
+from edl_tpu.models import llama
+from edl_tpu.models.transformer import TINY
+from edl_tpu.observability.metrics import MetricsRegistry
+from edl_tpu.runtime.kvcache import (
+    KVBlockPool,
+    KVPoolExhausted,
+    SessionUnknown,
+)
+
+
+def make_pool(num_blocks=8, block_size=4, cap=4, **kw):
+    kw.setdefault("registry", MetricsRegistry())
+    return KVBlockPool(TINY, num_blocks, block_size, cap, job="t/kv", **kw)
+
+
+class TestAllocation:
+    def test_lazy_growth_by_block(self):
+        pool = make_pool()
+        assert pool.ensure_capacity(1, 3) == pool.session_blocks(1)
+        assert len(pool.session_blocks(1)) == 1  # 3 tokens, bs=4
+        pool.ensure_capacity(1, 5)
+        assert len(pool.session_blocks(1)) == 2
+        # idempotent: capacity already covered allocates nothing
+        pool.ensure_capacity(1, 5)
+        assert len(pool.session_blocks(1)) == 2
+        assert pool.blocks_used() == 2
+
+    def test_exhaustion_is_typed_never_oom(self):
+        pool = make_pool(num_blocks=4, cap=8)
+        pool.ensure_capacity(1, 16)  # all 4 blocks
+        with pytest.raises(KVPoolExhausted):
+            pool.ensure_capacity(2, 1)
+        # bounded admission: the failed session holds nothing
+        with pytest.raises(SessionUnknown):
+            pool.session_blocks(2)
+        assert pool.blocks_free() == 0
+
+    def test_failed_growth_keeps_existing_blocks(self):
+        pool = make_pool(num_blocks=3, cap=8)
+        pool.ensure_capacity(1, 8)   # 2 blocks
+        pool.ensure_capacity(2, 4)   # last block
+        with pytest.raises(KVPoolExhausted):
+            pool.ensure_capacity(1, 16)  # wants 2 more, none free
+        # the session's prior allocation survives the failed growth
+        assert len(pool.session_blocks(1)) == 2
+
+    def test_per_session_cap(self):
+        pool = make_pool(num_blocks=8, cap=2)
+        with pytest.raises(KVPoolExhausted):
+            pool.ensure_capacity(1, 100)
+        assert pool.blocks_used() == 0
+
+    def test_can_admit_probe(self):
+        pool = make_pool(num_blocks=4, cap=4)
+        assert pool.can_admit(16)
+        assert not pool.can_admit(17)  # needs 5 blocks > pool
+        pool.ensure_capacity(1, 12)
+        assert pool.can_admit(4)
+        assert not pool.can_admit(8)
+
+
+class TestChurn:
+    def test_fragmentation_free_reuse(self):
+        """Blocks freed by interleaved session churn serve any later
+        session — a block list need not be contiguous, so external
+        fragmentation cannot exist."""
+        pool = make_pool(num_blocks=8, cap=8)
+        for sid in range(4):
+            pool.ensure_capacity(sid, 8)  # 2 blocks each → full
+        assert pool.blocks_free() == 0
+        # free the even sessions: holes at non-adjacent positions
+        pool.free_session(0)
+        pool.free_session(2)
+        got = pool.ensure_capacity(9, 16)  # 4 blocks spanning the holes
+        assert len(got) == 4
+        assert pool.blocks_free() == 0
+        # churn loop: repeated alloc/free never degrades capacity
+        for i in range(20):
+            pool.free_session(9 if i == 0 else 100 + i - 1)
+            pool.ensure_capacity(100 + i, 16)
+        assert pool.blocks_used() == 8
+
+    def test_abandon_frees_idempotently(self):
+        pool = make_pool()
+        pool.ensure_capacity(7, 10)
+        n = pool.free_session(7)
+        assert n == 3 and pool.blocks_used() == 0
+        assert pool.free_session(7) == 0  # double-free is a no-op
+        assert pool.free_session(999) == 0  # unknown sid is a no-op
+
+    def test_block_table_sentinel_padding(self):
+        pool = make_pool(num_blocks=8, block_size=4, cap=4)
+        pool.ensure_capacity(3, 6)  # 2 blocks
+        table = pool.block_table(3)
+        assert table.shape == (4,)
+        assert list(table[:2]) == pool.session_blocks(3)
+        # padding rows carry the out-of-range drop sentinel
+        assert all(t == 8 for t in table[2:])
+        with pytest.raises(SessionUnknown):
+            pool.block_table(4)
+
+
+class TestMigration:
+    def test_export_import_roundtrip_bitwise(self):
+        src = make_pool(num_blocks=8, block_size=4, cap=4)
+        dst = make_pool(num_blocks=8, block_size=4, cap=4)
+        params = llama.init(jax.random.PRNGKey(0), TINY)
+        toks = np.asarray([3, 5, 7, 11, 13, 17], np.int32)
+        blocks = src.ensure_capacity(1, len(toks))
+        logits, cache = llama.prefill(
+            params, src.cache, jax.numpy.asarray(toks),
+            jax.numpy.asarray(src.block_table(1)),
+            jax.numpy.asarray(0, "int32"),
+            jax.numpy.asarray(len(toks), "int32"), TINY)
+        src.set_cache(cache)
+        host = src.export_session(1, len(toks))
+        assert host["k"].shape[1] == len(toks)
+        # occupy dst block 0 first so the import lands non-contiguously
+        dst.ensure_capacity(99, 2)
+        dst.import_session(1, host)
+        back = dst.export_session(1, len(toks))
+        np.testing.assert_array_equal(host["k"], back["k"])
+        np.testing.assert_array_equal(host["v"], back["v"])
+        assert src.blocks_used() == len(blocks)  # source kept until freed
+        src.free_session(1)
+
+    def test_import_into_full_pool_is_retriable(self):
+        src = make_pool(num_blocks=4, block_size=4, cap=4)
+        dst = make_pool(num_blocks=3, block_size=4, cap=4)
+        src.ensure_capacity(1, 12)
+        host = src.export_session(1, 12)
+        dst.ensure_capacity(50, 8)  # fill destination
+        with pytest.raises(KVPoolExhausted):
+            dst.import_session(1, host)
+        # nothing leaked at the destination; host copy intact → retry
+        assert 1 not in dst.sessions()
+        dst.free_session(50)
+        assert len(dst.import_session(1, host)) == 3
+
+    def test_import_duplicate_refused(self):
+        src = make_pool()
+        src.ensure_capacity(1, 4)
+        host = src.export_session(1, 4)
+        dst = make_pool()
+        dst.import_session(1, host)
+        with pytest.raises(ValueError):
+            dst.import_session(1, host)
+
+    def test_evacuate_exports_everything(self):
+        pool = make_pool(num_blocks=8, cap=4)
+        pool.ensure_capacity(1, 4)
+        pool.ensure_capacity(2, 8)
+        out = pool.evacuate({1: 4, 2: 8})
+        assert set(out) == {1, 2}
+        assert out[2]["k"].shape[1] == 8
+        # evacuation is non-destructive until the caller frees
+        assert pool.blocks_used() == 3
+
+
+class TestAccounting:
+    def test_bytes_accounting_matches_cache(self):
+        pool = make_pool(num_blocks=8, block_size=4)
+        expect = llama.cache_bytes(TINY, 8, 4)
+        assert pool.total_bytes() == expect
+        assert pool.bytes_per_block * 8 == expect
+        pool.ensure_capacity(1, 8)
+        assert pool.used_bytes() == 2 * pool.bytes_per_block
+
+    def test_gauges_registered_and_live(self):
+        reg = MetricsRegistry()
+        pool = KVBlockPool(TINY, 8, 4, 4, job="t/kv", replica="r0",
+                           registry=reg)
+        pool.ensure_capacity(1, 10)
+        text = reg.render()
+        assert 'edl_serving_kv_blocks_used{job="t/kv",replica="r0"} 3' \
+            in text
+        assert 'edl_serving_kv_blocks_total{job="t/kv",replica="r0"} 8' \
+            in text
+
+    def test_reserved_bytes_tighten_replan_filter(self):
+        """The pool's residency must shrink what the resize planner
+        thinks fits — a plan blessed while ignoring KV bytes OOMs on
+        first decode."""
+        from edl_tpu.parallel.replan import propose_shape
+
+        # 100B state, 100B/device budget: pure dp fits with no
+        # reservation; reserving pool bytes forces state into fsdp
+        loose = propose_shape(8, state_bytes=100,
+                              max_bytes_per_device=100)
+        assert loose.fsdp == 1 and loose.dp == 8
+        tight = propose_shape(8, state_bytes=100,
+                              max_bytes_per_device=100,
+                              reserved_bytes_per_device=60)
+        assert tight.fsdp >= 3  # ceil(100/fsdp) + 60 <= 100 → fsdp >= 3
+        exact = propose_shape(8, state_bytes=100,
+                              max_bytes_per_device=100,
+                              reserved_bytes_per_device=75)
+        assert exact.fsdp == 4
