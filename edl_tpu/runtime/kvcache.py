@@ -115,6 +115,12 @@ class KVBlockPool:
                 raise SessionUnknown(sid)
             return list(self._sessions[sid])
 
+    def blocks_held(self, sid: int) -> int:
+        """Blocks currently owned by ``sid`` — 0 for unknown sessions
+        (an admission probe must not raise on a not-yet-resident sid)."""
+        with self._lock:
+            return len(self._sessions.get(sid, ()))
+
     @property
     def bytes_per_block(self) -> int:
         from edl_tpu.models.llama import cache_bytes
@@ -151,28 +157,31 @@ class KVBlockPool:
         Raises :class:`KVPoolExhausted` — with the session's existing
         blocks UNTOUCHED — when the pool or the per-session cap cannot
         cover it."""
-        need = self._blocks_for(tokens)
         with self._lock:
-            have = self._sessions.setdefault(sid, [])
-            if need <= len(have):
-                return list(have)
-            if need > self.max_blocks_per_session:
-                if not have:  # a failed NEW session must not linger
-                    del self._sessions[sid]
-                self._c.inc("serving_kv_admission_rejects", job=self.job)
-                raise KVPoolExhausted(
-                    f"session {sid}: {tokens} tokens needs {need} blocks, "
-                    f"per-session cap is {self.max_blocks_per_session}")
-            grow = need - len(have)
-            if grow > len(self._free):
-                if not have:
-                    del self._sessions[sid]
-                self._c.inc("serving_kv_admission_rejects", job=self.job)
-                raise KVPoolExhausted(
-                    f"session {sid}: needs {grow} more blocks, "
-                    f"pool has {len(self._free)} free of {self.num_blocks}")
-            have.extend(self._free.popleft() for _ in range(grow))
+            return self._ensure_capacity_locked(sid, tokens)
+
+    def _ensure_capacity_locked(self, sid: int, tokens: int) -> list[int]:
+        need = self._blocks_for(tokens)
+        have = self._sessions.setdefault(sid, [])
+        if need <= len(have):
             return list(have)
+        if need > self.max_blocks_per_session:
+            if not have:  # a failed NEW session must not linger
+                del self._sessions[sid]
+            self._c.inc("serving_kv_admission_rejects", job=self.job)
+            raise KVPoolExhausted(
+                f"session {sid}: {tokens} tokens needs {need} blocks, "
+                f"per-session cap is {self.max_blocks_per_session}")
+        grow = need - len(have)
+        if grow > len(self._free):
+            if not have:
+                del self._sessions[sid]
+            self._c.inc("serving_kv_admission_rejects", job=self.job)
+            raise KVPoolExhausted(
+                f"session {sid}: needs {grow} more blocks, "
+                f"pool has {len(self._free)} free of {self.num_blocks}")
+        have.extend(self._free.popleft() for _ in range(grow))
+        return list(have)
 
     def free_session(self, sid: int) -> int:
         """Return every block the session owns to the free list (finish,
@@ -222,10 +231,13 @@ class KVBlockPool:
         from edl_tpu.models.llama import scatter_session_kv
 
         length = int(host_kv["k"].shape[1])
+        # residency check and allocation under ONE lock hold: two
+        # concurrent imports of the same sid must not both pass the
+        # duplicate guard and interleave their allocations
         with self._lock:
             if sid in self._sessions:
                 raise ValueError(f"session {sid} already resident")
-        blocks = self.ensure_capacity(sid, max(length, 1))
+            blocks = self._ensure_capacity_locked(sid, max(length, 1))
         try:
             self.cache = scatter_session_kv(self.cache, blocks, host_kv,
                                             self.block_size)

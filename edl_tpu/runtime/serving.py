@@ -1691,11 +1691,15 @@ class DecodeReplica:
     def can_admit(self, prompt_len: int, max_new: int) -> bool:
         """Bounded-admission probe: would this session's FULL KV
         reservation fit the pool right now (counting what's queued
-        ahead of it)?"""
+        ahead of it)?  A queued session that already holds pool blocks
+        (imported with its cache — handoff or evacuation) only counts
+        for the blocks it still lacks; its reservation already left
+        ``blocks_free``."""
         with self._cond:
             queued = sum(
-                self.pool._blocks_for(len(s.resume_tokens())
-                                      + s.max_new_tokens)
+                max(self.pool._blocks_for(len(s.resume_tokens())
+                                          + s.max_new_tokens)
+                    - self.pool.blocks_held(s.id), 0)
                 for s in self._queue)
         need = self.pool._blocks_for(int(prompt_len) + int(max_new))
         return (need + queued <= self.pool.blocks_free()
@@ -1822,9 +1826,11 @@ class DecodeReplica:
     def import_session(self, sess: DecodeSession,
                        host_kv: Optional[dict]) -> None:
         """Adopt a session (call quiesced, or pre-start): with
-        ``host_kv`` its cache lands in this pool and decode resumes at
-        the next iteration; without, it re-enters prefill (covering
-        prompt + already-generated tokens, emitting nothing twice)."""
+        ``host_kv`` its cache lands in this pool and the session
+        resumes where it left off — decode, or the remaining chunks of
+        a prefill caught mid-flight by a resize; without, it re-enters
+        prefill (covering prompt + already-generated tokens, emitting
+        nothing twice)."""
         from edl_tpu.runtime.kvcache import KVPoolExhausted
 
         total = len(sess.resume_tokens()) + sess.max_new_tokens
@@ -1842,10 +1848,17 @@ class DecodeReplica:
                 self.pool.free_session(sess.id)
                 raise
             sess.cached = length
-            sess.state = S_DECODING
-            # a handed-off prompt-only cache still needs its first token
-            # fed; generated[-1] is always the next decode input
-            assert sess.generated, "handoff before first token"
+            if sess.generated and length >= len(sess.resume_tokens()):
+                # a handed-off prompt-only cache still needs its first
+                # token fed; generated[-1] is always the next decode
+                # input
+                sess.state = S_DECODING
+            else:
+                # evacuated mid-chunked-prefill (cache covers a prompt
+                # prefix, no token emitted yet) — resume prefill at
+                # ``cached`` rather than decoding over unwritten
+                # history; the prefill work already done still travels
+                sess.state = S_PREFILL
         else:
             sess.cached = 0
             sess.state = S_QUEUED
@@ -1869,16 +1882,24 @@ class DecodeReplica:
         """Move queued sessions into free slots, reserving full KV
         capacity.  A session whose reservation cannot fit stays queued
         (bounded admission — it retries every iteration as blocks
-        free); one whose reservation can NEVER fit fails typed."""
+        free); one whose reservation can NEVER fit fails typed.
+        Sessions whose imported cache has a scatter still pending are
+        NOT admitted — slotting one before :meth:`_drain_imports`
+        applies its K/V would decode over unwritten blocks; they wait
+        (at most one iteration) for the scatter to land."""
         from edl_tpu.runtime.kvcache import KVPoolExhausted
 
+        pending = {sid for sid, _, _ in self._pending_imports}
         for i in range(self.slots):
-            if self._slots[i] is not None or not self._queue:
+            if self._slots[i] is not None:
                 continue
-            sess = self._queue[0]
+            sess = next((s for s in self._queue if s.id not in pending),
+                        None)
+            if sess is None:
+                break  # nothing admissible until the next drain
             total = len(sess.resume_tokens()) + sess.max_new_tokens
             if self.pool._blocks_for(total) > self.pool.max_blocks_per_session:
-                self._queue.popleft()
+                self._queue.remove(sess)
                 sess.fail(KVPoolExhausted(
                     f"session {sess.id}: {total} tokens exceed the "
                     f"per-session KV cap"))
@@ -1889,7 +1910,7 @@ class DecodeReplica:
                 self.pool.ensure_capacity(sess.id, total)
             except KVPoolExhausted:
                 break  # pool full now; head-of-line retries next iter
-            self._queue.popleft()
+            self._queue.remove(sess)
             sess.slot = i
             if sess.state in (S_QUEUED, S_PREFILL):
                 sess.state = S_PREFILL

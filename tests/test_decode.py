@@ -21,6 +21,8 @@ from edl_tpu.runtime.serving import (
     PRI_HIGH,
     PRI_LOW,
     PRI_NORMAL,
+    S_DECODING,
+    S_PREFILL,
     DecodeFleet,
     DecodeSession,
     SessionDropped,
@@ -235,7 +237,130 @@ class TestLiveResize:
         finally:
             fleet.stop()
 
-    def test_evacuation_overflow_falls_back_to_recompute(self):
+    def test_mid_prefill_export_resumes_prefill(self):
+        """REVIEW regression: a session evacuated while its prompt is
+        mid-chunked-prefill (cached > 0, no token emitted) must travel
+        its partial cache and resume PREFILL on the adopter — the old
+        import path forced S_DECODING and tripped over the empty
+        ``generated`` history, dropping the session from scale_to."""
+        fleet = make_fleet(roles={"decode": 2}, prefill_chunk=2,
+                           kv_block_size=4, kv_blocks=64,
+                           max_blocks_per_session=32)
+        try:
+            src, dst = [r for r in fleet._replicas
+                        if r.role == "decode"]
+            p = RNG.integers(1, 255, size=100).tolist()  # 50 chunks
+            sess = None
+            deadline = time.time() + 120
+            for attempt in range(3):
+                cand = DecodeSession(p, 4, id=90_000 + attempt)
+                src.submit(cand)
+                # wait (without parking the loop) for the first prefill
+                # chunk to land, then quiesce: 50 chunks leave a wide
+                # window to park with a partial prompt cache
+                while (time.time() < deadline and not cand.generated
+                       and cand.cached == 0):
+                    time.sleep(0.0001)
+                assert src.quiesce(30)
+                if cand.cached > 0 and not cand.generated:
+                    sess = cand  # parked with a partial prompt cache
+                    break
+                src.resume()  # overshot the prefill window: retry
+                cand.wait(60)
+            assert sess is not None, "never parked mid-prefill"
+            moved = src.export_all()
+            src.resume()
+            (m, kv), = moved
+            assert m is sess and kv is not None
+            assert kv["k"].shape[1] == sess.cached < len(p)
+            dst.import_session(sess, kv)
+            assert sess.state == S_PREFILL  # NOT decode over nothing
+            assert sess.wait(120) == ref_decode(p, 4)
+            assert fleet.sessions_failed == 0
+        finally:
+            fleet.stop()
+
+    def test_scale_down_during_prefill_zero_drops(self):
+        """REVIEW regression, end-to-end: scale_to while prompts are
+        still prefilling (no first token awaited) drops nothing and
+        every continuation still matches the reference."""
+        fleet = make_fleet(roles={"decode": 2}, prefill_chunk=2,
+                           kv_block_size=4, kv_blocks=128,
+                           max_blocks_per_session=32)
+        try:
+            ps = prompts(4, 40, 80)
+            ss = [fleet.submit(p, max_new_tokens=4) for p in ps]
+            assert fleet.scale_to(1) == 1  # mid-prefill for most
+            for p, s in zip(ps, ss):
+                assert s.wait(180) == ref_decode(p, 4)
+            assert fleet.sessions_failed == 0
+            assert fleet.sessions_completed == len(ps)
+        finally:
+            fleet.stop()
+
+    def test_admission_defers_until_scatter_applied(self):
+        """REVIEW regression: a session imported with its cache must
+        not be slotted before its deferred K/V scatter lands —
+        admission skips sids with a pending import, and the drain at
+        the next iteration boundary releases them."""
+        fleet = make_fleet(roles={"decode": 2}, kv_blocks=8,
+                           kv_block_size=8, max_blocks_per_session=8)
+        try:
+            src, dst = [r for r in fleet._replicas
+                        if r.role == "decode"]
+            p = RNG.integers(1, 255, size=30).tolist()
+            sess = DecodeSession(p, 2, id=91_000)
+            src.submit(sess)
+            sess.wait_first_token(60)
+            assert src.quiesce(30)
+            (m, kv), = src.export_all()
+            src.resume()
+            assert m is sess and kv is not None
+            assert dst.quiesce(30)
+            dst.import_session(sess, kv)
+            assert sess.state == S_DECODING
+            with dst._cond:
+                dst._admit_locked()
+            # scatter still pending: the session must NOT hold a slot
+            assert sess.slot is None and sess in dst._queue
+            dst._drain_imports()  # loop provably parked (quiesced)
+            with dst._cond:
+                dst._admit_locked()
+            assert sess.slot is not None
+            dst.resume()
+            assert sess.wait(60) == ref_decode(p, 2)
+            assert fleet.sessions_failed == 0
+        finally:
+            fleet.stop()
+
+    def test_can_admit_skips_already_reserved_imports(self):
+        """REVIEW regression: a queued session that already owns its
+        pool blocks (imported with cache) must not ALSO count its full
+        reservation toward queued demand — the double count made fleet
+        admission over-conservative after migrations/handoffs."""
+        fleet = make_fleet(roles={"decode": 2}, kv_blocks=8,
+                           kv_block_size=8, max_blocks_per_session=8)
+        try:
+            src, dst = [r for r in fleet._replicas
+                        if r.role == "decode"]
+            p = RNG.integers(1, 255, size=30).tolist()  # 32-tok span
+            sess = DecodeSession(p, 2, id=92_000)
+            src.submit(sess)
+            sess.wait_first_token(60)
+            assert src.quiesce(30)
+            (m, kv), = src.export_all()
+            src.resume()
+            assert dst.quiesce(30)
+            dst.import_session(sess, kv)  # 4 blocks reserved, queued
+            assert dst.pool.blocks_free() == 4
+            # an identical 4-block session fits the remaining half of
+            # the pool; the old probe summed the import's 4 blocks on
+            # top of its reservation and refused
+            assert dst.can_admit(30, 2)
+            dst.resume()
+            assert sess.wait(60) == ref_decode(p, 2)
+        finally:
+            fleet.stop()
         """A survivor too full to adopt the cache still adopts the
         SESSION (re-prefill of known history) — capacity pressure
         degrades latency, never correctness."""

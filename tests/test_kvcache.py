@@ -157,6 +157,36 @@ class TestMigration:
         with pytest.raises(ValueError):
             dst.import_session(1, host)
 
+    def test_import_duplicate_race_atomic(self):
+        """REVIEW regression: the residency guard and the allocation
+        run under ONE lock hold — racing imports of the same sid admit
+        exactly one winner and leak no blocks (the old split check let
+        every racer pass the guard and share an allocation)."""
+        import threading
+
+        src = make_pool(num_blocks=8, block_size=4, cap=4)
+        src.ensure_capacity(1, 8)
+        host = src.export_session(1, 8)
+        dst = make_pool(num_blocks=8, block_size=4, cap=4)
+        results: list = []
+        barrier = threading.Barrier(4)
+
+        def race():
+            barrier.wait()
+            try:
+                dst.import_session(1, host)
+                results.append("ok")
+            except ValueError:
+                results.append("dup")
+
+        threads = [threading.Thread(target=race) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert sorted(results) == ["dup", "dup", "dup", "ok"]
+        assert dst.blocks_used() == 2  # exactly one session's blocks
+
     def test_evacuate_exports_everything(self):
         pool = make_pool(num_blocks=8, cap=4)
         pool.ensure_capacity(1, 4)
