@@ -35,7 +35,7 @@ slots/padded rows write with out-of-range block ids under
 from __future__ import annotations
 
 from functools import partial
-from typing import Any
+from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
@@ -57,25 +57,52 @@ from edl_tpu.ops.embedding import embed_lookup
 
 
 def init_cache(cfg: TransformerConfig, num_blocks: int,
-               block_size: int) -> dict:
+               block_size: int, quantize: Optional[str] = None,
+               shardings: Optional[dict] = None) -> dict:
     """The paged KV pool's device arrays: ``{"k", "v"}``, each
     ``[n_layers, num_blocks, block_size, n_kv_heads, head_dim]`` in the
     model's compute dtype.  Block 0 is a block like any other — the
     *allocator* decides ownership; out-of-range ids are the drop
-    sentinel."""
+    sentinel.
+
+    ``quantize="int8"`` stores K/V as int8 with per-row scales
+    (``k_scale``/``v_scale``, ``[n_layers, num_blocks, block_size]``
+    float32 — one scale per cached token row per block), halving
+    residency vs bf16 at a small dequant cost in the step.
+
+    ``shardings`` maps array name → :class:`jax.sharding.NamedSharding`
+    for a device-sharded pool (heads or pages sharded over a live
+    mesh); unlisted arrays stay unsharded."""
     shape = (cfg.n_layers, num_blocks, block_size,
              cfg.n_kv_heads, cfg.head_dim)
-    return {"k": jnp.zeros(shape, cfg.dtype),
-            "v": jnp.zeros(shape, cfg.dtype)}
+    if quantize not in (None, "int8"):
+        raise ValueError(f"unknown KV quantize mode {quantize!r}")
+    if quantize == "int8":
+        cache = {"k": jnp.zeros(shape, jnp.int8),
+                 "v": jnp.zeros(shape, jnp.int8),
+                 "k_scale": jnp.zeros(shape[:3], jnp.float32),
+                 "v_scale": jnp.zeros(shape[:3], jnp.float32)}
+    else:
+        cache = {"k": jnp.zeros(shape, cfg.dtype),
+                 "v": jnp.zeros(shape, cfg.dtype)}
+    if shardings:
+        cache = {name: (jax.device_put(arr, shardings[name])
+                        if name in shardings else arr)
+                 for name, arr in cache.items()}
+    return cache
 
 
 def cache_bytes(cfg: TransformerConfig, num_blocks: int,
-                block_size: int) -> int:
+                block_size: int, quantize: Optional[str] = None) -> int:
     """Resident bytes of :func:`init_cache`'s arrays — what the memory
     filter and the goodput ledger account alongside params."""
+    cells = (cfg.n_layers * num_blocks * block_size
+             * cfg.n_kv_heads * cfg.head_dim)
+    if quantize == "int8":
+        # int8 payload + one f32 scale per cached token row
+        return 2 * (cells + 4 * cfg.n_layers * num_blocks * block_size)
     itemsize = jnp.dtype(cfg.dtype).itemsize
-    return (2 * cfg.n_layers * num_blocks * block_size
-            * cfg.n_kv_heads * cfg.head_dim * itemsize)
+    return 2 * cells * itemsize
 
 
 # -- shared attention over a paged context -----------------------------------
@@ -129,9 +156,12 @@ def _forward_rows(params: dict, cache: dict, tokens: jax.Array,
     dt = cfg.dtype
     num_blocks = cache["k"].shape[1]
     block_size = cache["k"].shape[2]
+    quant = "k_scale" in cache  # int8 pool: per-row scales ride along
     x = embed_lookup(params["embed"], tokens[None, :],
                      one_hot=cfg.one_hot_embed, dtype=dt)[0]  # [rows, d]
     new_k, new_v = cache["k"], cache["v"]
+    new_ks = cache.get("k_scale")
+    new_vs = cache.get("v_scale")
     for li, p in enumerate(params["layers"]):
         h, kvh, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
         xn = rms_norm(x, p["attn_norm"], cfg.norm_eps)
@@ -144,12 +174,29 @@ def _forward_rows(params: dict, cache: dict, tokens: jax.Array,
         # the query attends to itself through the cache — one code path
         # for prefill and decode.  Dead/padded rows carry blk ==
         # num_blocks and drop.
-        new_k = new_k.at[li, write_blk, write_off].set(k, mode="drop")
-        new_v = new_v.at[li, write_blk, write_off].set(v, mode="drop")
-        # gather each row's paged context: [rows, maxb, bs, kv, hd] →
-        # flat [rows, maxb*bs, kv, hd]; flat index == absolute position
-        ctx_k = new_k[li][block_tables]
-        ctx_v = new_v[li][block_tables]
+        if quant:
+            kq, ks = _quantize_rows(k)
+            vq, vs = _quantize_rows(v)
+            new_k = new_k.at[li, write_blk, write_off].set(kq, mode="drop")
+            new_v = new_v.at[li, write_blk, write_off].set(vq, mode="drop")
+            new_ks = new_ks.at[li, write_blk, write_off].set(
+                ks, mode="drop")
+            new_vs = new_vs.at[li, write_blk, write_off].set(
+                vs, mode="drop")
+            # dequantized gather: [rows, maxb, bs, kv, hd] int8 scaled
+            # by [rows, maxb, bs] back to float context
+            ctx_k = (new_k[li][block_tables].astype(jnp.float32)
+                     * new_ks[li][block_tables][..., None, None])
+            ctx_v = (new_v[li][block_tables].astype(jnp.float32)
+                     * new_vs[li][block_tables][..., None, None])
+        else:
+            new_k = new_k.at[li, write_blk, write_off].set(k, mode="drop")
+            new_v = new_v.at[li, write_blk, write_off].set(v, mode="drop")
+            # gather each row's paged context: [rows, maxb, bs, kv, hd]
+            # → flat [rows, maxb*bs, kv, hd]; flat index == absolute
+            # token position
+            ctx_k = new_k[li][block_tables]
+            ctx_v = new_v[li][block_tables]
         rows = ctx_k.shape[0]
         ctx_k = ctx_k.reshape(rows, -1, kvh, hd)
         ctx_v = ctx_v.reshape(rows, -1, kvh, hd)
@@ -162,7 +209,23 @@ def _forward_rows(params: dict, cache: dict, tokens: jax.Array,
     del num_blocks, block_size  # shapes only; documented above
     x = rms_norm(x, params["norm"], cfg.norm_eps)
     logits = (x @ params["lm_head"].astype(dt)).astype(jnp.float32)
-    return logits, {"k": new_k, "v": new_v}
+    out = {"k": new_k, "v": new_v}
+    if quant:
+        out["k_scale"] = new_ks
+        out["v_scale"] = new_vs
+    return logits, out
+
+
+def _quantize_rows(x: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Symmetric int8 per-row quantization: x ``[rows, kv, hd]`` →
+    (int8 values, float32 scales ``[rows]``).  One scale per cached
+    token row — rescaling never touches neighbours, so appends into a
+    shared block stay independent."""
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=(1, 2))
+    scale = jnp.maximum(amax / 127.0, 1e-12)
+    q = jnp.clip(jnp.round(x.astype(jnp.float32) / scale[:, None, None]),
+                 -127, 127).astype(jnp.int8)
+    return q, scale
 
 
 def _write_indices(positions: jax.Array, block_tables: jax.Array,
@@ -220,6 +283,37 @@ def prefill(params: dict, cache: dict, tokens: jax.Array,
                          blk, off, cfg)
 
 
+@partial(jax.jit, static_argnames=("cfg",), donate_argnames=("cache",))
+def verify_step(params: dict, cache: dict, tokens: jax.Array,
+                positions: jax.Array, n_tokens: jax.Array,
+                block_tables: jax.Array, cfg: TransformerConfig
+                ) -> tuple[jax.Array, dict]:
+    """One speculative **verify** iteration: up to ``K`` tokens per slot
+    in a single batched forward (doc/serving.md §decode-v2).
+
+    tokens ``[slots, K]`` int32 — row 0 is the slot's last emitted
+    token (what a plain decode step would feed), rows 1..K-1 are
+    self-drafted candidates; positions ``[slots]`` is the absolute
+    position of row 0; n_tokens ``[slots]`` counts valid rows (0 = dead
+    slot — nothing written).  Returns logits ``[slots, K, vocab]``
+    (row ``j`` = next-token logits after consuming tokens ``0..j``) and
+    the updated cache.  The caller's STRICT accept rule makes the
+    emitted continuation bitwise-equal to single-token greedy decode;
+    K/V written for rejected rows sits beyond the accepted frontier and
+    is overwritten by the next fed token before any query can attend to
+    it."""
+    S, K = tokens.shape
+    offs = jnp.arange(K, dtype=jnp.int32)
+    flat_pos = (positions[:, None] + offs[None, :]).reshape(-1)
+    live = (offs[None, :] < n_tokens[:, None]).reshape(-1)
+    tables = jnp.repeat(block_tables, K, axis=0)  # [S*K, maxb]
+    nb, bs = cache["k"].shape[1], cache["k"].shape[2]
+    blk, off = _write_indices(flat_pos, tables, live, nb, bs)
+    logits, cache = _forward_rows(params, cache, tokens.reshape(-1),
+                                  flat_pos, tables, blk, off, cfg)
+    return logits.reshape(S, K, -1), cache
+
+
 # -- host-side helpers (migration / handoff) ---------------------------------
 
 
@@ -227,28 +321,69 @@ def gather_session_kv(cache: dict, block_ids, length: int,
                       block_size: int) -> dict[str, Any]:
     """Host copy of one session's K/V, flattened to ``[L, length, kv,
     hd]`` — the unit a live migration / prefill→decode handoff ships.
-    ``block_ids`` is the session's logical-order block list."""
+    ``block_ids`` is the session's logical-order block list.  Quantized
+    pools export DEQUANTIZED float32 — the payload is portable across
+    pools with different storage modes."""
     import numpy as np
 
+    quant = "k_scale" in cache
     out = {}
     for name in ("k", "v"):
         arr = np.asarray(jax.device_get(cache[name][:, list(block_ids)]))
+        if quant:
+            scale = np.asarray(jax.device_get(
+                cache[name + "_scale"][:, list(block_ids)]))
+            arr = arr.astype(np.float32) * scale[..., None, None]
         L, nb, bs = arr.shape[0], arr.shape[1], arr.shape[2]
         flat = arr.reshape(L, nb * bs, arr.shape[3], arr.shape[4])
         out[name] = flat[:, :length].copy()
     return out
 
 
+# -- device-side helpers (D2D migration: no host roundtrip) ------------------
+
+
+def gather_session_kv_device(cache: dict, block_ids) -> dict[str, Any]:
+    """Device-resident blocked copy of one session's K/V (every cache
+    array sliced to ``[L, n_blocks, ...]``) — the D2D migration payload.
+    The gather materializes NEW arrays, so the source pool may free the
+    blocks (or keep decoding) immediately after."""
+    ids = jnp.asarray(list(block_ids), jnp.int32)
+    return {name: cache[name][:, ids] for name in cache}
+
+
+def scatter_session_kv_device(cache: dict, block_ids,
+                              payload: dict) -> dict:
+    """Write a :func:`gather_session_kv_device` payload into (another)
+    cache's freshly allocated blocks, entirely on device.  Requires the
+    same storage mode on both sides (the host path converts between
+    modes); layout mismatch raises before anything lands."""
+    if set(payload) != set(cache):
+        raise ValueError(
+            f"D2D payload layout {sorted(payload)} != cache layout "
+            f"{sorted(cache)} (quantization modes differ)")
+    n = payload["k"].shape[1]
+    assert len(block_ids) >= n, (len(block_ids), n)
+    ids = jnp.asarray(list(block_ids[:n]), jnp.int32)
+    for name in payload:
+        cache[name] = cache[name].at[:, ids].set(
+            payload[name].astype(cache[name].dtype))
+    return cache
+
+
 def scatter_session_kv(cache: dict, block_ids, host_kv: dict,
                        block_size: int) -> dict:
     """Write a :func:`gather_session_kv` payload into freshly allocated
     blocks of (another) cache — the receive half of migration/handoff.
+    A quantized destination re-quantizes the float payload row-wise.
     Returns the updated cache arrays."""
     import numpy as np
 
+    quant = "k_scale" in cache
     length = host_kv["k"].shape[1]
     n_need = -(-length // block_size)
     assert len(block_ids) >= n_need, (len(block_ids), length, block_size)
+    ids = jnp.asarray(list(block_ids[:n_need]), jnp.int32)
     for name in ("k", "v"):
         flat = np.asarray(host_kv[name])
         L = flat.shape[0]
@@ -257,9 +392,23 @@ def scatter_session_kv(cache: dict, block_ids, host_kv: dict,
             flat = np.concatenate(
                 [flat, np.zeros((L, pad) + flat.shape[2:], flat.dtype)],
                 axis=1)
-        blocked = flat.reshape(L, n_need, block_size,
-                               flat.shape[2], flat.shape[3])
-        ids = jnp.asarray(list(block_ids[:n_need]), jnp.int32)
-        cache[name] = cache[name].at[:, ids].set(
-            jnp.asarray(blocked, cache[name].dtype))
+        if quant:
+            f32 = flat.astype(np.float32)
+            amax = np.max(np.abs(f32), axis=(2, 3))  # [L, tokens]
+            scale = np.maximum(amax / 127.0, 1e-12)
+            qrows = np.clip(np.round(f32 / scale[..., None, None]),
+                            -127, 127).astype(np.int8)
+            blocked = qrows.reshape(L, n_need, block_size,
+                                    flat.shape[2], flat.shape[3])
+            sblocked = scale.astype(np.float32).reshape(
+                L, n_need, block_size)
+            cache[name] = cache[name].at[:, ids].set(
+                jnp.asarray(blocked, cache[name].dtype))
+            cache[name + "_scale"] = cache[name + "_scale"].at[:, ids] \
+                .set(jnp.asarray(sblocked, jnp.float32))
+        else:
+            blocked = flat.reshape(L, n_need, block_size,
+                                   flat.shape[2], flat.shape[3])
+            cache[name] = cache[name].at[:, ids].set(
+                jnp.asarray(blocked, cache[name].dtype))
     return cache

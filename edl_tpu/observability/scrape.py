@@ -975,6 +975,11 @@ class FleetView:
         sessions = s.latest("edl_serving_sessions_active", labels) or 0
         kv_used = s.latest("edl_serving_kv_blocks_used", labels) or 0
         kv_total = s.latest("edl_serving_kv_blocks_total", labels) or 0
+        # PR 19 extension: chip-normalized throughput and the windowed
+        # speculative-decode acceptance rate (accepted/drafted deltas)
+        chips = s.latest("edl_serving_chips", labels) or 0
+        drafted = s.delta("edl_decode_spec_drafted_total", w, labels)
+        accepted = s.delta("edl_decode_spec_accepted_total", w, labels)
         return FleetStats(
             p50_ms=round((p50 or 0.0) * 1000.0, 3),
             p99_ms=round((p99 or 0.0) * 1000.0, 3),
@@ -984,7 +989,11 @@ class FleetView:
             ttft_p99_ms=round((ttft or 0.0) * 1000.0, 3),
             tpot_p50_ms=round((tpot or 0.0) * 1000.0, 4),
             decode_tps=round(tps, 2), sessions=int(sessions),
-            kv_blocks_used=int(kv_used), kv_blocks_total=int(kv_total))
+            kv_blocks_used=int(kv_used), kv_blocks_total=int(kv_total),
+            chips=int(chips),
+            tok_s_per_chip=round(tps / chips, 2) if chips else 0.0,
+            spec_accept_rate=round(accepted / drafted, 4) if drafted
+            else 0.0)
 
     def stats_for(self, uid: str):
         """The :class:`ServingScaler` seam: ``stats_for=view.stats_for``
@@ -1067,6 +1076,9 @@ class FleetView:
                 "decode_tps": st.decode_tps,
                 "sessions": st.sessions,
                 "kv_blocks": f"{st.kv_blocks_used}/{st.kv_blocks_total}",
+                "chips": st.chips,
+                "tok_s_per_chip": st.tok_s_per_chip,
+                "spec_accept_rate": st.spec_accept_rate,
             }
             gp = goodput.get(job)
             if gp:
@@ -1362,18 +1374,22 @@ def render_fleet_dashboard(view: FleetView,
     if snap["jobs"]:
         lines.append("")
         rows = [("JOB", "QPS", "P50ms", "P99ms", "TTFTp99", "TOK/S",
-                 "SESSIONS", "KV", "QUEUE", "REPLICAS", "GOODPUT",
-                 "SLOWEST-TRACE")]
+                 "TOK/S/CHIP", "SPEC%", "SESSIONS", "KV", "QUEUE",
+                 "REPLICAS", "GOODPUT", "SLOWEST-TRACE")]
         for job, j in sorted(snap["jobs"].items()):
             gp = j.get("goodput")
             slow = j.get("slowest_trace")
             kv = j.get("kv_blocks", "0/0")
+            spec = j.get("spec_accept_rate", 0.0)
             rows.append((job, f"{j['qps']:g}", f"{j['p50_ms']:g}",
                          f"{j['p99_ms']:g}",
                          (f"{j.get('ttft_p99_ms', 0):g}ms"
                           if j.get("ttft_p99_ms") else "-"),
                          (f"{j.get('decode_tps', 0):g}"
                           if j.get("decode_tps") else "-"),
+                         (f"{j.get('tok_s_per_chip', 0):g}"
+                          if j.get("tok_s_per_chip") else "-"),
+                         f"{spec:.1%}" if spec else "-",
                          str(j.get("sessions", 0)),
                          kv if kv != "0/0" else "-",
                          str(j["queue"]), j["replicas"],

@@ -1579,6 +1579,10 @@ class FleetApp:
                 data = (b"HTTP/1.1 200 OK\r\n"
                         b"Content-Type: application/json\r\n"
                         + f"X-EDL-Session: {sess.id}\r\n".encode()
+                        # the session is terminal with this response
+                        # (EOS or max_new): an affinity-keeping LB must
+                        # evict its pin, not wait for LRU pressure
+                        + b"X-EDL-Session-Done: 1\r\n"
                         + (f"X-EDL-Trace-Id: {meta.trace_id}\r\n".encode()
                            if meta.trace_id else b"")
                         + (f"X-EDL-Block-Nonce: {meta.nonce}\r\n".encode()

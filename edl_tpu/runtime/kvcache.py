@@ -1,6 +1,6 @@
 """Elastic paged KV-cache pool — per-request decode state as
 first-class elastic state (ROADMAP #2; doc/serving.md §autoregressive
-serving).
+serving, §decode-v2).
 
 The decode path's working set is not params: it is each live session's
 K/V history, growing a token at a time and dying with the session.  The
@@ -12,6 +12,13 @@ vLLM insight, applied to the elastic substrate:
   There is no external fragmentation by construction — any free block
   serves any session — and a finished/abandoned session's blocks return
   to the free list immediately.
+* **Refcounted sharing.**  Blocks carry refcounts: sessions with a
+  common prompt prefix SHARE the sealed (full) blocks covering it
+  (admitting without re-prefilling them — the prefix cache), and a
+  forked session shares its parent's whole chain copy-on-write.  A
+  block a writer doesn't exclusively own is CoW-copied on the first
+  divergent write; sealed blocks whose last owner left are retained in
+  a reclaimable LRU so later identical prompts still hit.
 * **Bounded admission.**  Allocation failure is a typed
   :class:`KVPoolExhausted` (the serving layer's 429), never an OOM: the
   pool size is fixed at replica build, so load shows up as admission
@@ -20,16 +27,25 @@ vLLM insight, applied to the elastic substrate:
   :func:`~edl_tpu.parallel.replan.choose_shape`'s memory filter must
   reserve (its ``reserved_bytes_per_device``) and what the goodput
   ledger's memory view sees — a resize plan that ignores KV residency
-  blesses layouts that OOM on first decode.
-* **Evacuation.**  :meth:`export_session` / :meth:`import_session` ship
-  a session's K/V through the host — the unit of live migration (a
-  scale-down's replan path drains *state*, not sessions), of
-  prefill→decode handoff between replica roles, and of the
-  replica-death rescue.
+  blesses layouts that OOM on first decode.  A device-sharded pool
+  (``devices=``) reports :meth:`reserved_bytes_per_device` /
+  :meth:`per_device_used_bytes` so the filter accounts occupancy where
+  it actually lives.
+* **Evacuation.**  The D2D path (:meth:`export_session_device` →
+  :meth:`import_session_device`) moves a session's blocks device-to-
+  device through the same :func:`~edl_tpu.parallel.replan.plan_reshard`
+  accounting the trainer resize uses — ``bytes_ici`` vs ``bytes_host``
+  recorded per migration.  :meth:`export_session` /
+  :meth:`import_session` (host roundtrip) remain as the fallback and
+  the cross-storage-mode converter.
 
 Scrape names: ``edl_serving_kv_blocks_used`` /
-``edl_serving_kv_blocks_total`` (gauges, labeled ``job=``/``replica=``),
-``edl_serving_kv_admission_rejects_total`` (counter).
+``edl_serving_kv_blocks_total`` / ``edl_serving_kv_blocks_cached``
+(gauges, labeled ``job=``/``replica=``),
+``edl_serving_kv_admission_rejects_total`` /
+``edl_kv_prefix_hits_total`` / ``edl_kv_prefix_tokens_saved_total`` /
+``edl_kv_cow_copies_total`` /
+``edl_kv_migration_bytes_total{path="ici"|"host"}`` (counters).
 """
 
 from __future__ import annotations
@@ -54,6 +70,71 @@ class SessionUnknown(KeyError):
     """The pool holds no blocks for this session id."""
 
 
+class KVDevicePayload:
+    """A D2D migration in flight: one session's blocked cache arrays,
+    already gathered OFF the source pool (new device arrays — the
+    source may free/decode immediately) and placed onto the destination
+    pool's sharding.  Carries the :class:`~edl_tpu.parallel.replan
+    .ReshardPlan` accounting for the move."""
+
+    __slots__ = ("arrays", "length", "quantize", "plan")
+
+    def __init__(self, arrays: dict, length: int,
+                 quantize: Optional[str], plan=None) -> None:
+        self.arrays = arrays
+        self.length = int(length)
+        self.quantize = quantize
+        self.plan = plan
+
+    @property
+    def nbytes(self) -> int:
+        return sum(int(a.nbytes) for a in self.arrays.values())
+
+
+def _named_view(a):
+    """A :class:`NamedSharding` view of an array's placement so every
+    migration — sharded pool or plain single-device — routes through
+    the same :func:`plan_reshard` accounting (which reads mesh device
+    maps, not sharding subclasses)."""
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    sh = a.sharding
+    if isinstance(sh, NamedSharding):
+        return sh
+    devs = sorted(a.devices(), key=lambda d: d.id)
+    return NamedSharding(Mesh(np.asarray(devs), ("kvmig",)), P())
+
+
+def payload_to_host(payload: KVDevicePayload, block_size: int,
+                    job: str = "job") -> dict:
+    """Flatten a D2D payload into the host-roundtrip format
+    (dequantized ``{"k","v"}`` of ``[L, length, kv, hd]``) — the
+    fallback when no survivor can take the payload device-to-device.
+    Accounted as ``path="host"`` migration bytes."""
+    import numpy as np
+
+    k = np.asarray(payload.arrays["k"], np.float32)  # [L, n, bs, kv, hd]
+    v = np.asarray(payload.arrays["v"], np.float32)
+    if payload.quantize == "int8":
+        ks = np.asarray(payload.arrays["k_scale"], np.float32)
+        vs = np.asarray(payload.arrays["v_scale"], np.float32)
+        k = k * ks[..., None, None]
+        v = v * vs[..., None, None]
+    L, n = k.shape[0], k.shape[1]
+    out = {
+        "k": np.ascontiguousarray(
+            k.reshape(L, n * block_size, *k.shape[3:])[:, :payload.length]),
+        "v": np.ascontiguousarray(
+            v.reshape(L, n * block_size, *v.shape[3:])[:, :payload.length]),
+    }
+    get_counters().inc("kv_migration_bytes",
+                       sum(int(a.nbytes) for a in out.values()),
+                       job=job, path="host")
+    return out
+
+
 class KVBlockPool:
     """Block allocator + accounting over one replica's paged device
     cache.  Thread-safe: the serve loop allocates/frees while admission
@@ -62,11 +143,19 @@ class KVBlockPool:
     The pool OWNS the cache arrays (``self.cache``) because functional
     updates replace them: the serve loop passes ``pool.cache`` into the
     jitted step and stores the donated result back via
-    :meth:`set_cache`."""
+    :meth:`set_cache`.
+
+    ``devices`` shards the block storage over a 1-axis mesh: K/V heads
+    when they divide the device count (the tensor-parallel layout),
+    else pages (contiguous block ranges per device).  Block *tables*
+    stay host/device-local int32 — only the storage is distributed.
+    ``quantize="int8"`` stores blocks as int8 with per-row scales
+    (doc/serving.md §decode-v2)."""
 
     def __init__(self, cfg, num_blocks: int, block_size: int,
                  max_blocks_per_session: int, *, job: str = "job",
-                 replica: str = "", registry=None) -> None:
+                 replica: str = "", registry=None,
+                 devices=None, quantize: Optional[str] = None) -> None:
         from edl_tpu.models import llama
         from edl_tpu.observability.metrics import get_registry
 
@@ -76,10 +165,25 @@ class KVBlockPool:
         self.max_blocks_per_session = int(max_blocks_per_session)
         self.job = job
         self.replica = replica
-        self.cache = llama.init_cache(cfg, self.num_blocks, self.block_size)
+        self.quantize = quantize
+        self.devices = list(devices) if devices else None
+        self.mesh = None
+        self.shard_axis = None  # "heads" | "pages" | "replicated" | None
+        self.shardings = self._build_shardings()
+        self.cache = llama.init_cache(cfg, self.num_blocks,
+                                      self.block_size, quantize=quantize,
+                                      shardings=self.shardings)
         self._free: "collections.deque[int]" = collections.deque(
             range(self.num_blocks))
         self._sessions: dict[int, list[int]] = {}
+        #: block id → owner count (present only while > 0)
+        self._ref: dict[int, int] = {}
+        #: sealed-prefix chain key → block id, and its reverse
+        self._prefix_index: dict[int, int] = {}
+        self._block_key: dict[int, int] = {}
+        #: refcount-0 blocks still sealed in the index — reclaimable LRU
+        self._cached_free: "collections.OrderedDict[int, None]" = \
+            collections.OrderedDict()
         self._lock = threading.Lock()
         self._c = get_counters()
         reg = registry if registry is not None else get_registry()
@@ -91,19 +195,83 @@ class KVBlockPool:
                      **labels)
         reg.gauge_fn("serving_kv_blocks_total", lambda: self.num_blocks,
                      help="KV pool capacity in blocks", **labels)
-        # zero-pre-registration: the strict parser sees the reject
-        # counter from scrape #1, before the first full pool
+        reg.gauge_fn("serving_kv_blocks_cached", self.blocks_cached,
+                     help="sealed prefix blocks retained reclaimable",
+                     **labels)
+        # zero-pre-registration: the strict parser sees every series
+        # from scrape #1, before the first hit/copy/migration
         self._c.inc("serving_kv_admission_rejects", 0, job=job)
+        self._c.inc("kv_prefix_hits", 0, job=job)
+        self._c.inc("kv_prefix_tokens_saved", 0, job=job)
+        self._c.inc("kv_cow_copies", 0, job=job)
+        for path in ("ici", "host"):
+            self._c.inc("kv_migration_bytes", 0, job=job, path=path)
+
+    # -- sharded layout ------------------------------------------------------
+
+    def _build_shardings(self) -> Optional[dict]:
+        if not self.devices:
+            return None
+        import numpy as np
+        from jax.sharding import Mesh, NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        self.mesh = Mesh(np.asarray(self.devices), ("kv",))
+        n = len(self.devices)
+        if n > 1 and self.cfg.n_kv_heads % n == 0:
+            self.shard_axis = "heads"
+            spec, sspec = P(None, None, None, "kv", None), P()
+        elif n > 1 and self.num_blocks % n == 0:
+            self.shard_axis = "pages"
+            spec, sspec = P(None, "kv", None, None, None), P(None, "kv")
+        else:
+            self.shard_axis = "replicated" if n > 1 else None
+            spec, sspec = P(), P()
+        out = {"k": NamedSharding(self.mesh, spec),
+               "v": NamedSharding(self.mesh, spec)}
+        if self.quantize == "int8":
+            out["k_scale"] = NamedSharding(self.mesh, sspec)
+            out["v_scale"] = NamedSharding(self.mesh, sspec)
+        return out
+
+    def payload_shardings(self, n_blocks: int) -> Optional[dict]:
+        """NamedShardings for a ``[L, n_blocks, ...]`` blocked payload
+        landing in THIS pool — what a D2D import places onto before its
+        deferred scatter.  Heads-sharded pools keep the payload heads-
+        sharded; pages-sharded pools replicate it (an arbitrary block
+        subset has no aligned page split)."""
+        if self.mesh is None:
+            return None
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        spec = (P(None, None, None, "kv", None)
+                if self.shard_axis == "heads" else P())
+        out = {"k": NamedSharding(self.mesh, spec),
+               "v": NamedSharding(self.mesh, spec)}
+        if self.quantize == "int8":
+            out["k_scale"] = NamedSharding(self.mesh, P())
+            out["v_scale"] = NamedSharding(self.mesh, P())
+        return out
 
     # -- observation ---------------------------------------------------------
 
     def blocks_used(self) -> int:
+        """Blocks owned by at least one session (shared blocks count
+        once — occupancy is distinct residency, not sum of tables)."""
         with self._lock:
-            return self.num_blocks - len(self._free)
+            return (self.num_blocks - len(self._free)
+                    - len(self._cached_free))
 
     def blocks_free(self) -> int:
+        """Allocatable blocks: truly free plus reclaimable sealed
+        blocks (the prefix cache yields under pressure)."""
         with self._lock:
-            return len(self._free)
+            return len(self._free) + len(self._cached_free)
+
+    def blocks_cached(self) -> int:
+        with self._lock:
+            return len(self._cached_free)
 
     def sessions(self) -> list[int]:
         with self._lock:
@@ -121,21 +289,49 @@ class KVBlockPool:
         with self._lock:
             return len(self._sessions.get(sid, ()))
 
+    def block_refcount(self, block: int) -> int:
+        with self._lock:
+            return self._ref.get(block, 0)
+
     @property
     def bytes_per_block(self) -> int:
         from edl_tpu.models.llama import cache_bytes
 
-        return cache_bytes(self.cfg, 1, self.block_size)
+        return cache_bytes(self.cfg, 1, self.block_size, self.quantize)
 
     def total_bytes(self) -> int:
         """Resident bytes of the whole pool — the reservation the
         resize memory filter and the goodput memory view account."""
         from edl_tpu.models.llama import cache_bytes
 
-        return cache_bytes(self.cfg, self.num_blocks, self.block_size)
+        return cache_bytes(self.cfg, self.num_blocks, self.block_size,
+                           self.quantize)
 
     def used_bytes(self) -> int:
         return self.blocks_used() * self.bytes_per_block
+
+    def reserved_bytes_per_device(self) -> int:
+        """Per-device share of the pool's residency — what
+        :func:`~edl_tpu.parallel.replan.choose_shape`'s
+        ``reserved_bytes_per_device`` must carry for THIS pool.  An
+        unsharded pool reserves everything on its one device."""
+        n = len(self.devices) if self.devices else 1
+        return -(-self.total_bytes() // n)
+
+    def per_device_used_bytes(self) -> dict[int, int]:
+        """Occupancy by device index: heads-sharded blocks split evenly
+        across every device; pages-sharded blocks land whole on the
+        device owning their page range."""
+        n = len(self.devices) if self.devices else 1
+        if self.shard_axis != "pages":
+            share = self.used_bytes() // n
+            return {i: share for i in range(n)}
+        per = self.num_blocks // n
+        out = {i: 0 for i in range(n)}
+        with self._lock:
+            for b in self._ref:
+                out[min(b // per, n - 1)] += self.bytes_per_block
+        return out
 
     # -- admission / growth --------------------------------------------------
 
@@ -147,8 +343,49 @@ class KVBlockPool:
         succeed right now?  The router's bounded-admission probe."""
         need = self._blocks_for(tokens)
         with self._lock:
-            return (need <= len(self._free)
+            return (need <= len(self._free) + len(self._cached_free)
                     and need <= self.max_blocks_per_session)
+
+    def _alloc_locked(self, n: int) -> list[int]:
+        """Pop ``n`` fresh blocks (refcount 1 each): truly-free first,
+        then reclaim sealed LRU blocks, purging their index entries."""
+        got: list[int] = []
+        for _ in range(n):
+            if self._free:
+                b = self._free.popleft()
+            elif self._cached_free:
+                b, _ = self._cached_free.popitem(last=False)
+                key = self._block_key.pop(b, None)
+                if key is not None and self._prefix_index.get(key) == b:
+                    del self._prefix_index[key]
+            else:  # caller checked; defensive
+                for g in got:
+                    self._free.append(g)
+                    del self._ref[g]
+                raise KVPoolExhausted("pool empty mid-allocation")
+            self._ref[b] = 1
+            got.append(b)
+        return got
+
+    def _incref_locked(self, b: int) -> None:
+        r = self._ref.get(b, 0)
+        if r == 0:
+            # resurrect a sealed reclaimable block
+            self._cached_free.pop(b, None)
+        self._ref[b] = r + 1
+
+    def _decref_locked(self, b: int) -> None:
+        r = self._ref.get(b, 0) - 1
+        if r > 0:
+            self._ref[b] = r
+            return
+        self._ref.pop(b, None)
+        key = self._block_key.get(b)
+        if key is not None and self._prefix_index.get(key) == b:
+            self._cached_free[b] = None  # sealed: retain reclaimable
+        else:
+            self._block_key.pop(b, None)
+            self._free.append(b)
 
     def ensure_capacity(self, sid: int, tokens: int) -> list[int]:
         """Grow session ``sid``'s block list to cover ``tokens`` total
@@ -173,26 +410,30 @@ class KVBlockPool:
                 f"session {sid}: {tokens} tokens needs {need} blocks, "
                 f"per-session cap is {self.max_blocks_per_session}")
         grow = need - len(have)
-        if grow > len(self._free):
+        if grow > len(self._free) + len(self._cached_free):
             if not have:
                 del self._sessions[sid]
             self._c.inc("serving_kv_admission_rejects", job=self.job)
             raise KVPoolExhausted(
                 f"session {sid}: needs {grow} more blocks, "
-                f"pool has {len(self._free)} free of {self.num_blocks}")
-        have.extend(self._free.popleft() for _ in range(grow))
+                f"pool has {len(self._free) + len(self._cached_free)} "
+                f"free of {self.num_blocks}")
+        have.extend(self._alloc_locked(grow))
         return list(have)
 
     def free_session(self, sid: int) -> int:
-        """Return every block the session owns to the free list (finish,
-        abandon, timeout, migration-source cleanup).  Unknown sids are a
-        no-op — frees must be idempotent under completion/abandon races.
-        Returns blocks freed."""
+        """Drop the session's ownership of every block it holds
+        (finish, abandon, timeout, migration-source cleanup).  Shared
+        blocks only decref; exclusively-owned ones return to the free
+        list (sealed ones to the reclaimable prefix cache).  Unknown
+        sids are a no-op — frees must be idempotent under
+        completion/abandon races.  Returns blocks released."""
         with self._lock:
             blocks = self._sessions.pop(sid, None)
             if not blocks:
                 return 0
-            self._free.extend(blocks)
+            for b in blocks:
+                self._decref_locked(b)
             return len(blocks)
 
     def block_table(self, sid: int):
@@ -213,15 +454,184 @@ class KVBlockPool:
         """Store the donated-and-updated arrays back after a step."""
         self.cache = cache
 
+    # -- prefix sharing / copy-on-write (doc/serving.md §decode-v2) ----------
+
+    def _chain_keys(self, tokens):
+        """(chain key, tokens covered) per FULL block of ``tokens`` —
+        the key hashes the whole prefix up to that boundary, so a hit
+        at block i implies every earlier block matched too."""
+        h = 0
+        bs = self.block_size
+        for i in range(len(tokens) // bs):
+            h = hash((h, tuple(tokens[i * bs:(i + 1) * bs])))
+            yield h, (i + 1) * bs
+
+    def match_prefix(self, tokens) -> int:
+        """Tokens a :meth:`admit_with_prefix` of this prompt would
+        adopt from sealed blocks right now (probe only)."""
+        tokens = [int(t) for t in tokens]
+        cap = max(((len(tokens) - 1) // self.block_size)
+                  * self.block_size, 0)
+        covered = 0
+        with self._lock:
+            for key, cov in self._chain_keys(tokens):
+                if cov > cap or key not in self._prefix_index:
+                    break
+                covered = cov
+        return covered
+
+    def admit_with_prefix(self, sid: int, tokens,
+                          total_tokens: int) -> tuple[list[int], int]:
+        """Admit a NEW session, adopting every sealed block whose chain
+        key matches the prompt's prefix (refcount++, no re-prefill) and
+        allocating fresh exclusive blocks for the rest of the FULL
+        reservation.  At least the prompt's final token is always left
+        to prefill (its logits seed generation).  Returns (block list,
+        tokens covered by adopted blocks).  Atomic: on
+        :class:`KVPoolExhausted` nothing is attached."""
+        tokens = [int(t) for t in tokens]
+        need = self._blocks_for(total_tokens)
+        cap = max(((len(tokens) - 1) // self.block_size)
+                  * self.block_size, 0)
+        with self._lock:
+            if sid in self._sessions:
+                raise ValueError(f"session {sid} already resident")
+            if need > self.max_blocks_per_session:
+                self._c.inc("serving_kv_admission_rejects", job=self.job)
+                raise KVPoolExhausted(
+                    f"session {sid}: {total_tokens} tokens needs {need} "
+                    f"blocks, per-session cap is "
+                    f"{self.max_blocks_per_session}")
+            shared: list[int] = []
+            covered = 0
+            for key, cov in self._chain_keys(tokens):
+                if cov > cap:
+                    break
+                b = self._prefix_index.get(key)
+                if b is None:
+                    break
+                shared.append(b)
+                covered = cov
+            fresh_needed = need - len(shared)
+            # adopted blocks that are currently reclaimable shrink the
+            # allocatable pool once adopted — count them
+            reclaimable_adopted = sum(
+                1 for b in shared if b in self._cached_free)
+            if fresh_needed > (len(self._free) + len(self._cached_free)
+                               - reclaimable_adopted):
+                self._c.inc("serving_kv_admission_rejects", job=self.job)
+                raise KVPoolExhausted(
+                    f"session {sid}: needs {fresh_needed} fresh blocks "
+                    f"beyond {len(shared)} shared")
+            for b in shared:
+                self._incref_locked(b)
+            blocks = shared + self._alloc_locked(fresh_needed)
+            self._sessions[sid] = blocks
+            if covered:
+                self._c.inc("kv_prefix_hits", job=self.job)
+                self._c.inc("kv_prefix_tokens_saved", covered,
+                            job=self.job)
+            return list(blocks), covered
+
+    def register_prefix(self, sid: int, tokens) -> int:
+        """Seal the session's FULL prompt blocks into the prefix index
+        (called once the prompt's prefill completed — their content is
+        final; decode writes only land past the prompt).  Returns newly
+        registered blocks."""
+        tokens = [int(t) for t in tokens]
+        added = 0
+        with self._lock:
+            blocks = self._sessions.get(sid)
+            if blocks is None:
+                return 0
+            for key, cov in self._chain_keys(tokens):
+                i = cov // self.block_size - 1
+                if i >= len(blocks):
+                    break
+                if key in self._prefix_index:
+                    continue
+                b = blocks[i]
+                if b in self._block_key:
+                    continue  # already seals a different chain
+                self._prefix_index[key] = b
+                self._block_key[b] = key
+                added += 1
+        return added
+
+    def fork_session(self, src: int, dst: int) -> list[int]:
+        """Clone ``src``'s whole block chain into a new session ``dst``
+        copy-on-write (refcount++ on every block, the partial tail
+        included) — parallel sampling's substrate and the general CoW
+        path: the first divergent write by either side copies just the
+        written block (:meth:`make_writable`)."""
+        with self._lock:
+            if dst in self._sessions:
+                raise ValueError(f"session {dst} already resident")
+            blocks = self._sessions.get(src)
+            if blocks is None:
+                raise SessionUnknown(src)
+            for b in blocks:
+                self._incref_locked(b)
+            self._sessions[dst] = list(blocks)
+            return list(blocks)
+
+    def make_writable(self, sid: int, start_pos: int,
+                      end_pos: int) -> int:
+        """Copy-on-write guard for an upcoming write of positions
+        ``[start_pos, end_pos)``: any covered block the session does
+        not exclusively own (shared, or sealed in the prefix index) is
+        replaced by a fresh device-copied block.  MUST run on the
+        thread that owns cache mutation (the replica loop, or a
+        controller holding the quiesce) — the copy rewrites
+        ``self.cache``.  Returns CoW copies made."""
+        if end_pos <= start_pos:
+            return 0
+        lo = start_pos // self.block_size
+        hi = (end_pos - 1) // self.block_size
+        copies = []
+        with self._lock:
+            blocks = self._sessions.get(sid)
+            if blocks is None:
+                raise SessionUnknown(sid)
+            for i in range(lo, min(hi + 1, len(blocks))):
+                b = blocks[i]
+                exclusive = (self._ref.get(b, 0) == 1
+                             and b not in self._block_key)
+                if exclusive:
+                    continue
+                nb = self._alloc_locked(1)[0]
+                copies.append((b, nb))
+                blocks[i] = nb
+                self._decref_locked(b)
+        if not copies:
+            return 0
+        import jax.numpy as jnp
+
+        cache = self.cache
+        src_ids = jnp.asarray([s for s, _ in copies], jnp.int32)
+        dst_ids = jnp.asarray([d for _, d in copies], jnp.int32)
+        for name in cache:
+            cache[name] = cache[name].at[:, dst_ids].set(
+                cache[name][:, src_ids])
+        self.cache = cache
+        self._c.inc("kv_cow_copies", len(copies), job=self.job)
+        return len(copies)
+
     # -- evacuation (migration / handoff / rescue) ---------------------------
 
     def export_session(self, sid: int, length: int) -> dict:
         """Host copy of the session's K/V (``[L, length, kv, hd]`` per
-        K/V) — what a live migration or prefill→decode handoff ships."""
+        K/V, dequantized) — the fallback migration payload and the
+        cross-storage-mode converter.  Accounted as ``path="host"``
+        migration bytes."""
         from edl_tpu.models.llama import gather_session_kv
 
-        return gather_session_kv(self.cache, self.session_blocks(sid),
-                                 int(length), self.block_size)
+        out = gather_session_kv(self.cache, self.session_blocks(sid),
+                                int(length), self.block_size)
+        self._c.inc("kv_migration_bytes",
+                    sum(int(a.nbytes) for a in out.values()),
+                    job=self.job, path="host")
+        return out
 
     def import_session(self, sid: int, host_kv: dict) -> list[int]:
         """Adopt an exported session: allocate blocks here and scatter
@@ -246,11 +656,106 @@ class KVBlockPool:
             raise
         return blocks
 
+    def export_session_device(self, sid: int, length: int
+                              ) -> KVDevicePayload:
+        """Blocked DEVICE copy of the session (no host roundtrip) — the
+        D2D migration payload.  The gather materializes new arrays, so
+        the source can free the blocks immediately after.  Only blocks
+        covering ``length`` ship: the tail of the session's full-span
+        reservation is unwritten and re-grows at the importer."""
+        from edl_tpu.models.llama import gather_session_kv_device
+
+        blocks = self.session_blocks(sid)
+        covering = -(-max(int(length), 1) // self.block_size)
+        arrays = gather_session_kv_device(self.cache,
+                                          blocks[:covering])
+        return KVDevicePayload(arrays, length, self.quantize)
+
+    def reserve_import_device(self, sid: int,
+                              payload: KVDevicePayload) -> list[int]:
+        """First half of a D2D import: duplicate-guard + FULL block
+        reservation under one lock hold, then place the payload onto
+        this pool's sharding with the :func:`plan_reshard` accounting
+        (``path="ici"`` bytes).  The cache scatter itself is the
+        caller's to defer to its loop's iteration boundary
+        (:meth:`apply_import_device`).  Raises
+        :class:`KVPoolExhausted` / :class:`ValueError` with nothing
+        held."""
+        import jax
+
+        from edl_tpu.parallel.replan import plan_reshard
+
+        if payload.quantize != self.quantize:
+            raise ValueError(
+                f"D2D import needs matching storage modes "
+                f"(src={payload.quantize!r}, dst={self.quantize!r})")
+        n = int(payload.arrays["k"].shape[1])
+        with self._lock:
+            if sid in self._sessions:
+                raise ValueError(f"session {sid} already resident")
+            if n > self.max_blocks_per_session:
+                self._c.inc("serving_kv_admission_rejects", job=self.job)
+                raise KVPoolExhausted(
+                    f"session {sid}: {n} blocks over per-session cap")
+            if n > len(self._free) + len(self._cached_free):
+                self._c.inc("serving_kv_admission_rejects", job=self.job)
+                raise KVPoolExhausted(
+                    f"session {sid}: needs {n} blocks, "
+                    f"{len(self._free) + len(self._cached_free)} free")
+            self._sessions[sid] = self._alloc_locked(n)
+            blocks = list(self._sessions[sid])
+        try:
+            dst_sh = self.payload_shardings(n)
+            if dst_sh is None:
+                dev = next(iter(
+                    payload.arrays["k"].devices()), None)
+                placed = payload.arrays
+                if dev is not None and self._default_device() != dev:
+                    placed = {name: jax.device_put(
+                        a, self._default_device())
+                        for name, a in payload.arrays.items()}
+            else:
+                placed = {name: jax.device_put(a, dst_sh[name])
+                          for name, a in payload.arrays.items()}
+            payload.plan = plan_reshard(
+                {n_: jax.ShapeDtypeStruct(a.shape, a.dtype)
+                 for n_, a in payload.arrays.items()},
+                {n_: _named_view(a) for n_, a in payload.arrays.items()},
+                {n_: _named_view(placed[n_]) for n_ in payload.arrays})
+            payload.arrays = placed
+            # counter = session payload bytes migrated over this path
+            # (mirrors the host counter); replication fan-out onto the
+            # destination mesh stays visible in payload.plan.bytes_moved
+            self._c.inc("kv_migration_bytes",
+                        int(payload.plan.bytes_total), job=self.job,
+                        path="ici")
+        except Exception:
+            self.free_session(sid)
+            raise
+        return blocks
+
+    def _default_device(self):
+        import jax
+
+        return (self.devices[0] if self.devices
+                else jax.devices()[0])
+
+    def apply_import_device(self, sid: int, blocks: list,
+                            payload: KVDevicePayload) -> None:
+        """Second half of a D2D import: the on-device blocked scatter.
+        MUST run where cache mutation is race-free (the owning loop at
+        an iteration boundary, or quiesced)."""
+        from edl_tpu.models.llama import scatter_session_kv_device
+
+        self.cache = scatter_session_kv_device(self.cache, blocks,
+                                               payload.arrays)
+
     def evacuate(self, lengths: dict[int, int]) -> dict[int, dict]:
         """Export EVERY resident session (``sid → current token
-        count``) — the scale-down path: the replica's entire decode
-        state leaves as host arrays, to be re-imported on survivors
-        through the replan path.  Sessions stay allocated here until
-        :meth:`free_session`; a failed import elsewhere can retry."""
+        count``) — the host-path scale-down: the replica's entire
+        decode state leaves as host arrays, to be re-imported on
+        survivors through the replan path.  Sessions stay allocated
+        here until :meth:`free_session`; a failed import elsewhere can
+        retry."""
         return {sid: self.export_session(sid, lengths[sid])
                 for sid in self.sessions() if sid in lengths}

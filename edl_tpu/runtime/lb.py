@@ -655,6 +655,7 @@ class LBApp:
         self._c.inc("lb_retry_budget_exhausted", 0, job=job)
         self._c.inc("lb_discovery_freezes", 0, job=job)
         self._c.inc("lb_affinity_repins", 0, job=job)
+        self._c.inc("lb_affinity_evictions", 0, job=job)
         #: session-id → upstream name (decode KV affinity).  Bounded
         #: LRU: an abandoned session's pin ages out instead of leaking;
         #: a re-arriving aged-out session just re-pins (the decode
@@ -1033,6 +1034,23 @@ class LBApp:
                 self._affinity.popitem(last=False)
         return up
 
+    def _maybe_evict_affinity(self, blk: _OutBlock) -> None:
+        """Drop a session's affinity pin the moment its session ENDS —
+        a terminal response (``X-EDL-Session-Done`` from the front
+        door's /generate completion) or a 5xx failure — instead of
+        waiting for LRU-cap pressure.  A long-lived LB otherwise keeps
+        stale pins that can route a reused session id straight at a
+        drained upstream."""
+        ended = blk.errors > 0
+        if not ended and blk.acc:
+            first = blk.acc[0]
+            head_end = first.find(b"\r\n\r\n")
+            if head_end >= 0:
+                ended = (b"\r\nx-edl-session-done:"
+                         in first[:head_end + 4].lower())
+        if ended and self._affinity.pop(blk.session, None) is not None:
+            self._c.inc("lb_affinity_evictions", job=self.job)
+
     def _dispatch(self, blk: _OutBlock, exclude=None) -> None:
         up = self._pick_affine(blk, exclude)
         if up is None and exclude is not None:
@@ -1084,6 +1102,8 @@ class LBApp:
                                     "discarded" if duel else "late")
             return
         blk.cell.done = True
+        if blk.session is not None:
+            self._maybe_evict_affinity(blk)
         now = time.perf_counter()
         lat = now - blk.t_sent
         self._record_lat(lat)

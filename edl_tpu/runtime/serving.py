@@ -662,6 +662,11 @@ class FleetStats:
     sessions: int = 0
     kv_blocks_used: int = 0
     kv_blocks_total: int = 0
+    # multi-chip speculative decode (PR 19): chip-normalized
+    # throughput and the draft acceptance rate (0 when spec is off)
+    chips: int = 0
+    tok_s_per_chip: float = 0.0
+    spec_accept_rate: float = 0.0
 
 
 class ServingFleet:
@@ -1435,14 +1440,32 @@ class TokenScheduler:
     ``decode_per_prefill`` decode iterations while any session is
     decoding — prefill work stretches TPOT for every running session,
     so it is rationed, not greedy.  With no decode running, prefill has
-    the replica to itself (TTFT-optimal)."""
+    the replica to itself (TTFT-optimal).
+
+    With ``tpot_budget_ms`` set, the interleave is ADAPTIVE: the loop
+    feeds measured decode-iteration and prefill-chunk durations in
+    (EWMA-smoothed) and the effective spacing becomes
+    ``ceil(prefill_ms / (tpot_budget_ms - decode_ms))`` — a prefill
+    chunk's stall amortized over enough decode iterations that
+    per-token latency stays inside the budget.  A slow host (decode
+    already near/over budget) rations prefill hard instead of blowing
+    TPOT; a fast host lets prefill run nearly every iteration instead
+    of starving TTFT behind a fixed count tuned elsewhere.  Until both
+    EWMAs have a sample (or with no budget), the static count
+    applies."""
 
     def __init__(self, weights: Optional[dict] = None,
-                 decode_per_prefill: int = 2) -> None:
+                 decode_per_prefill: int = 2,
+                 tpot_budget_ms: float = 0.0,
+                 ewma_alpha: float = 0.2) -> None:
         self.weights = dict(DEFAULT_WFQ_WEIGHTS)
         if weights:
             self.weights.update(weights)
         self.decode_per_prefill = max(int(decode_per_prefill), 1)
+        self.tpot_budget_ms = float(tpot_budget_ms)
+        self._alpha = min(max(float(ewma_alpha), 0.01), 1.0)
+        self._decode_ms: Optional[float] = None
+        self._prefill_ms: Optional[float] = None
         self._vtime = 0.0
         self._class_finish = {p: 0.0 for p in self.weights}
         self._decode_since_prefill = 0
@@ -1468,13 +1491,35 @@ class TokenScheduler:
             return False
         if decoding == 0:
             return True
-        return self._decode_since_prefill >= self.decode_per_prefill
+        return (self._decode_since_prefill
+                >= self.effective_decode_per_prefill())
 
-    def note_decode(self) -> None:
+    def effective_decode_per_prefill(self) -> int:
+        """The live interleave spacing: the static count until the
+        adaptive budget has samples, then the TPOT-headroom derivation
+        (clamped to [1, 64] — even a hopeless budget must not starve
+        prefill forever)."""
+        if (self.tpot_budget_ms <= 0.0 or self._decode_ms is None
+                or self._prefill_ms is None):
+            return self.decode_per_prefill
+        headroom = self.tpot_budget_ms - self._decode_ms
+        if headroom <= 0.0:
+            return 64
+        return min(max(int(-(-self._prefill_ms // headroom)), 1), 64)
+
+    def note_decode(self, ms: Optional[float] = None) -> None:
         self._decode_since_prefill += 1
+        if ms is not None:
+            self._decode_ms = (float(ms) if self._decode_ms is None
+                               else self._alpha * float(ms)
+                               + (1 - self._alpha) * self._decode_ms)
 
-    def note_prefill(self) -> None:
+    def note_prefill(self, ms: Optional[float] = None) -> None:
         self._decode_since_prefill = 0
+        if ms is not None:
+            self._prefill_ms = (float(ms) if self._prefill_ms is None
+                                else self._alpha * float(ms)
+                                + (1 - self._alpha) * self._prefill_ms)
 
 
 def _ttft_hist():
@@ -1521,6 +1566,8 @@ class DecodeReplica:
                  eos_id: Optional[int] = None,
                  scheduler: Optional[TokenScheduler] = None,
                  ttft_slo_ms: float = 0.0, tpot_slo_ms: float = 0.0,
+                 spec_tokens: int = 0, spec_ngram: int = 3,
+                 devices=None, kv_quantize: Optional[str] = None,
                  on_handoff: Optional[Callable] = None,
                  on_session_done: Optional[Callable] = None,
                  ledger=None) -> None:
@@ -1535,13 +1582,20 @@ class DecodeReplica:
         self.eos_id = eos_id
         self.ttft_slo_ms = float(ttft_slo_ms)
         self.tpot_slo_ms = float(tpot_slo_ms)
+        #: tokens fed per speculative verify step (1 real + K-1
+        #: drafts); < 2 means single-token decode
+        self.spec_tokens = int(spec_tokens)
+        self.spec_ngram = max(int(spec_ngram), 1)
+        self.spec_drafted = 0
+        self.spec_accepted = 0
         self.sched = scheduler or TokenScheduler()
         self.on_handoff = on_handoff
         self.on_session_done = on_session_done
         self.ledger = ledger
         self.pool = KVBlockPool(cfg, kv_blocks, kv_block_size,
                                 max_blocks_per_session, job=job,
-                                replica=name)
+                                replica=name, devices=devices,
+                                quantize=kv_quantize)
         self.params = params
         self.state = BUILDING
         self.generation = 0
@@ -1577,6 +1631,18 @@ class DecodeReplica:
                               priority=pri)
         self._counters.inc("serving_decode_tokens", 0, job=job)
         self._counters.inc("serving_prefill_chunks", 0, job=job)
+        self._counters.inc("decode_spec_steps", 0, job=job)
+        self._spec_hist = get_registry().histogram(
+            "decode_spec_accepted_per_step",
+            help="draft tokens accepted per speculative verify step",
+            buckets=[0, 1, 2, 3, 4, 6, 8, 12, 16])
+        for pri in PRI_NAMES.values():
+            self._counters.inc("decode_spec_drafted", 0, job=job,
+                              priority=pri)
+            self._counters.inc("decode_spec_accepted", 0, job=job,
+                              priority=pri)
+            if self.spec_tokens >= 2:
+                self._spec_hist.touch(job=job, priority=pri)
         for outcome in ("done", "failed", "migrated", "handed_off"):
             self._counters.inc("serving_sessions", 0, job=job,
                               outcome=outcome)
@@ -1632,7 +1698,12 @@ class DecodeReplica:
         cfg = self.cfg
         maxb = self.pool.max_blocks_per_session
         nb = self.pool.num_blocks
-        scratch = llama.init_cache(cfg, nb, self.pool.block_size)
+        # the scratch must mirror the real pool's storage mode
+        # (quantization dtype + sharding) or the AOT here compiles a
+        # signature the first real step would miss
+        scratch = llama.init_cache(cfg, nb, self.pool.block_size,
+                                   quantize=self.pool.quantize,
+                                   shardings=self.pool.shardings)
         dead_tables = np.full((self.slots, maxb), nb, np.int32)
         logits, scratch = llama.decode_step(
             self.params, scratch,
@@ -1641,6 +1712,14 @@ class DecodeReplica:
             jax.numpy.asarray(dead_tables),
             jax.numpy.zeros((self.slots,), bool), cfg)
         jax.block_until_ready(logits)
+        if self.spec_tokens >= 2:
+            logits, scratch = llama.verify_step(
+                self.params, scratch,
+                jax.numpy.zeros((self.slots, self.spec_tokens), "int32"),
+                jax.numpy.zeros((self.slots,), "int32"),
+                jax.numpy.zeros((self.slots,), "int32"),
+                jax.numpy.asarray(dead_tables), cfg)
+            jax.block_until_ready(logits)
         logits, scratch = llama.prefill(
             self.params, scratch,
             jax.numpy.zeros((self.prefill_chunk,), "int32"),
@@ -1783,29 +1862,38 @@ class DecodeReplica:
     _quiesce_req = False
 
     def _drain_imports(self) -> None:
-        """Apply deferred KV scatters.  Runs on the loop thread at an
-        iteration boundary — or on a controller thread while the loop
-        is provably parked (quiesced/stopped); those are the only
-        moments cache-array mutation is race-free against donation."""
+        """Apply deferred KV scatters — host payloads and D2D device
+        payloads alike.  Runs on the loop thread at an iteration
+        boundary — or on a controller thread while the loop is provably
+        parked (quiesced/stopped); those are the only moments
+        cache-array mutation is race-free against donation."""
         from edl_tpu.models.llama import scatter_session_kv
+        from edl_tpu.runtime.kvcache import KVDevicePayload
 
         while True:
             with self._cond:
                 if not self._pending_imports:
                     return
-                sid, blocks, host_kv = self._pending_imports.popleft()
+                sid, blocks, kv = self._pending_imports.popleft()
             if sid not in self.pool.sessions():
                 continue  # freed (failed/stopped) before the scatter
-            self.pool.set_cache(scatter_session_kv(
-                self.pool.cache, blocks, host_kv, self.pool.block_size))
+            if isinstance(kv, KVDevicePayload):
+                self.pool.apply_import_device(sid, blocks, kv)
+            else:
+                self.pool.set_cache(scatter_session_kv(
+                    self.pool.cache, blocks, kv, self.pool.block_size))
 
-    def export_all(self) -> list[tuple[DecodeSession, Optional[dict]]]:
+    def export_all(self, device: bool = False
+                   ) -> list[tuple[DecodeSession, Optional[Any]]]:
         """Evacuate every resident session (call quiesced): returns
-        ``(session, host_kv-or-None)`` — None for sessions still queued
-        (no cache yet; they re-prefill wherever they land).  Slots and
-        blocks are freed here; the session objects travel."""
+        ``(session, payload-or-None)`` — None for sessions still queued
+        (no cache yet; they re-prefill wherever they land).  With
+        ``device=True`` payloads are blocked
+        :class:`~edl_tpu.runtime.kvcache.KVDevicePayload` device copies
+        (the D2D path — no host roundtrip); otherwise host arrays.
+        Slots and blocks are freed here; the session objects travel."""
         self._drain_imports()  # loop is parked; adopt stragglers first
-        out: list[tuple[DecodeSession, Optional[dict]]] = []
+        out: list[tuple[DecodeSession, Optional[Any]]] = []
         with self._cond:
             resident = [s for s in self._slots if s is not None]
             queued = list(self._queue)
@@ -1814,7 +1902,9 @@ class DecodeReplica:
         for sess in resident:
             kv = None
             if sess.cached > 0:
-                kv = self.pool.export_session(sess.id, sess.cached)
+                kv = (self.pool.export_session_device(sess.id, sess.cached)
+                      if device
+                      else self.pool.export_session(sess.id, sess.cached))
             self.pool.free_session(sess.id)
             sess.slot = None
             out.append((sess, kv))
@@ -1876,6 +1966,45 @@ class DecodeReplica:
             self._cond.notify_all()
         self._counters.inc("serving_session_migrations", job=self.job)
 
+    def import_session_device(self, sess: DecodeSession,
+                              payload) -> None:
+        """Adopt a D2D-evacuated session: the payload's blocks reserve
+        (plus the rest of the full span — bounded admission) and place
+        onto this pool's sharding NOW, with the
+        :func:`~edl_tpu.parallel.replan.plan_reshard` accounting; the
+        on-device scatter defers to this loop's next iteration boundary
+        exactly like the host path.  Raises typed
+        (:class:`~edl_tpu.runtime.kvcache.KVPoolExhausted`, or
+        ``ValueError`` on a storage-mode mismatch) with nothing held —
+        the caller retries another survivor or falls back to host."""
+        from edl_tpu.runtime.kvcache import KVPoolExhausted
+
+        total = len(sess.resume_tokens()) + sess.max_new_tokens
+        blocks = self.pool.reserve_import_device(sess.id, payload)
+        try:
+            self.pool.ensure_capacity(sess.id, total)
+        except KVPoolExhausted:
+            self.pool.free_session(sess.id)
+            raise
+        sess.cached = payload.length
+        if (sess.generated
+                and payload.length >= len(sess.resume_tokens())):
+            sess.state = S_DECODING
+        else:
+            sess.state = S_PREFILL  # caught mid-prefill; resume at cached
+        sess.replica = self.name
+        sess.slot = None
+        sess.migrations += 1
+        with self._cond:
+            if self.state == STOPPED:
+                self.pool.free_session(sess.id)
+                raise SessionDropped(
+                    f"replica {self.name} stopped mid-import")
+            self._pending_imports.append((sess.id, blocks, payload))
+            self._queue.append(sess)
+            self._cond.notify_all()
+        self._counters.inc("serving_session_migrations", job=self.job)
+
     # -- the iteration loop --------------------------------------------------
 
     def _admit_locked(self) -> None:
@@ -1907,7 +2036,15 @@ class DecodeReplica:
                                   outcome="failed")
                 continue
             try:
-                self.pool.ensure_capacity(sess.id, total)
+                if (sess.cached == 0 and not sess.generated
+                        and not self.pool.blocks_held(sess.id)):
+                    # fresh prompt: adopt sealed prefix-cache blocks —
+                    # prefill resumes past what they already cover
+                    _, covered = self.pool.admit_with_prefix(
+                        sess.id, sess.prompt, total)
+                    sess.cached = covered
+                else:
+                    self.pool.ensure_capacity(sess.id, total)
             except KVPoolExhausted:
                 break  # pool full now; head-of-line retries next iter
             self._queue.remove(sess)
@@ -1967,11 +2104,18 @@ class DecodeReplica:
             try:
                 if self.sched.allow_prefill(len(decoding), len(prefilling)):
                     sess = self.sched.pick_prefill(prefilling)
-                    self.sched.note_prefill()
+                    t0 = time.perf_counter()
                     self._prefill_one(sess, llama, jax, np)
+                    self.sched.note_prefill(
+                        (time.perf_counter() - t0) * 1e3)
                 else:
-                    self.sched.note_decode()
-                    self._decode_all(decoding, llama, jax, np)
+                    t0 = time.perf_counter()
+                    if self.spec_tokens >= 2:
+                        self._decode_all_spec(decoding, llama, jax, np)
+                    else:
+                        self._decode_all(decoding, llama, jax, np)
+                    self.sched.note_decode(
+                        (time.perf_counter() - t0) * 1e3)
             except Exception as exc:
                 log.error("decode iteration failed", replica=self.name,
                           error=str(exc)[:200])
@@ -2008,6 +2152,10 @@ class DecodeReplica:
                 pass
         if sess.cached < len(tokens):
             return  # more chunks to go; scheduler re-picks
+        # the prompt's K/V is final from here on (decode writes land
+        # past it) — seal its full blocks into the prefix cache so
+        # later sessions sharing the prompt admit without re-prefill
+        self.pool.register_prefix(sess.id, sess.prompt)
         pri = PRI_NAMES.get(sess.priority, "normal")
         if not sess.generated:
             # fresh prompt: the final row's logits seed generation
@@ -2084,6 +2232,110 @@ class DecodeReplica:
             self._check_finished(sess)
         del t0, t1
 
+    def _draft(self, sess: DecodeSession, k: int) -> list[int]:
+        """Self-drafting by prompt lookup: find the most recent PRIOR
+        occurrence of the context's trailing ``spec_ngram``-gram and
+        propose the tokens that followed it.  Free (no model call), and
+        strong exactly where speculation pays — extractive/repetitive
+        continuations.  No match → no drafts (the verify step degrades
+        to single-token decode)."""
+        if k <= 0:
+            return []
+        ctx = sess.prompt + sess.generated
+        g = min(self.spec_ngram, len(ctx) - 1)
+        if g < 1:
+            return []
+        tail = ctx[-g:]
+        # among prior occurrences prefer the one with the LONGEST
+        # available continuation (the most recent one overlaps the tail
+        # inside a periodic run and yields a single follower)
+        best: list[int] = []
+        for i in range(len(ctx) - g - 1, -1, -1):
+            if ctx[i:i + g] == tail:
+                cand = [int(t) for t in ctx[i + g:i + g + k]]
+                if len(cand) > len(best):
+                    best = cand
+                if len(best) == k:
+                    break
+        return best
+
+    def _decode_all_spec(self, decoding: list[DecodeSession], llama,
+                         jax, np) -> None:
+        """One speculative multi-token iteration: each slot feeds its
+        real next token plus up to ``spec_tokens - 1`` drafts through
+        ONE batched verify step, then accepts with the strict greedy
+        rule — draft ``d_{j+1}`` stands iff it equals the argmax the
+        model produced having consumed everything before it.  Accepted
+        tokens are EXACTLY what single-token greedy decode would have
+        emitted, so continuations stay bitwise-identical; a rejected
+        position's K/V is garbage past the accepted frontier and is
+        overwritten by the actually-fed token before any query attends
+        that far."""
+        K = self.spec_tokens
+        S = self.slots
+        nb = self.pool.num_blocks
+        maxb = self.pool.max_blocks_per_session
+        toks = np.zeros((S, K), np.int32)
+        poss = np.zeros(S, np.int32)
+        nts = np.zeros(S, np.int32)
+        tables = np.full((S, maxb), nb, np.int32)
+        feeds: dict[int, list[int]] = {}
+        for sess in decoding:
+            i = sess.slot
+            remaining = max(sess.max_new_tokens - len(sess.generated), 1)
+            limit = min(K, remaining)
+            feed = ([sess.generated[-1]]
+                    + self._draft(sess, limit - 1))[:limit]
+            feeds[sess.id] = feed
+            toks[i, :len(feed)] = feed
+            poss[i] = sess.cached
+            nts[i] = len(feed)
+            tables[i] = self.pool.block_table(sess.id)
+        logits, cache = llama.verify_step(
+            self.params, self.pool.cache, jax.numpy.asarray(toks),
+            jax.numpy.asarray(poss), jax.numpy.asarray(nts),
+            jax.numpy.asarray(tables), self.cfg)
+        self.pool.set_cache(cache)
+        rows = np.asarray(logits)  # [S, K, vocab]
+        self.decode_iterations += 1
+        self._counters.inc("decode_spec_steps", job=self.job)
+        for sess in decoding:
+            feed = feeds[sess.id]
+            n = len(feed)
+            outs = rows[sess.slot]
+            emitted = [int(outs[0].argmax())]
+            while (len(emitted) < n
+                   and feed[len(emitted)] == emitted[-1]):
+                emitted.append(int(outs[len(emitted)].argmax()))
+            accepted = len(emitted) - 1  # drafts that survived
+            pri = PRI_NAMES.get(sess.priority, "normal")
+            self._counters.inc("decode_spec_drafted", n - 1,
+                              job=self.job, priority=pri)
+            self._counters.inc("decode_spec_accepted", accepted,
+                              job=self.job, priority=pri)
+            self._spec_hist.observe(accepted, job=self.job, priority=pri)
+            self.spec_drafted += n - 1
+            self.spec_accepted += accepted
+            # the valid K/V frontier: feed[0..accepted] are real history
+            sess.cached += accepted + 1
+            for tok in emitted:
+                prev_emit = sess.t_last_token
+                sess.emit(tok)
+                self.tokens_emitted += 1
+                self._counters.inc("serving_decode_tokens", job=self.job)
+                itt = max(sess.t_last_token - prev_emit, 0.0)
+                self._tpot.observe(itt, job=self.job, priority=pri)
+                if self.tpot_slo_ms and itt * 1e3 > self.tpot_slo_ms:
+                    self._counters.inc("serving_tpot_slo_violations",
+                                      job=self.job, priority=pri)
+                if self.ledger is not None:
+                    try:
+                        self.ledger.add_tokens(1)
+                    except Exception:
+                        pass
+                if self._check_finished(sess):
+                    break  # EOS/max_new truncates the accepted tail
+
     def _check_finished(self, sess: DecodeSession) -> bool:
         """Finished sequences free their slot (and blocks) IMMEDIATELY
         — the next iteration's admission packs a waiting session into
@@ -2128,6 +2380,10 @@ class DecodeFleet:
                  ttft_slo_ms: float = 0.0, tpot_slo_ms: float = 0.0,
                  wfq_weights: Optional[dict] = None,
                  decode_per_prefill: int = 2,
+                 tpot_budget_ms: float = 0.0,
+                 spec_tokens: int = 0, spec_ngram: int = 3,
+                 devices_per_replica: int = 0,
+                 kv_quantize: Optional[str] = None,
                  max_queued_sessions: int = 64,
                  kv=None, ledger=None, window: int = 4096) -> None:
         self.cfg = cfg
@@ -2139,9 +2395,13 @@ class DecodeFleet:
             slots=slots, prefill_chunk=prefill_chunk, kv_blocks=kv_blocks,
             kv_block_size=kv_block_size,
             max_blocks_per_session=max_blocks_per_session, eos_id=eos_id,
-            ttft_slo_ms=ttft_slo_ms, tpot_slo_ms=tpot_slo_ms)
+            ttft_slo_ms=ttft_slo_ms, tpot_slo_ms=tpot_slo_ms,
+            spec_tokens=spec_tokens, spec_ngram=spec_ngram,
+            kv_quantize=kv_quantize)
+        self.devices_per_replica = int(devices_per_replica)
         self._wfq_weights = dict(wfq_weights) if wfq_weights else None
         self._decode_per_prefill = int(decode_per_prefill)
+        self._tpot_budget_ms = float(tpot_budget_ms)
         self.max_queued_sessions = int(max_queued_sessions)
         self._kv = kv
         self._ledger = ledger
@@ -2155,6 +2415,12 @@ class DecodeFleet:
         self.sessions_completed = 0
         self.sessions_failed = 0
         self.migrations = 0
+        #: measured migration-byte ledger across every evacuation —
+        #: D2D payload bytes vs what the host roundtrip for the SAME
+        #: sessions would have moved (trimmed copy out + back)
+        self.migration_bytes_d2d = 0
+        self.migration_bytes_host = 0
+        self.migration_bytes_host_roundtrip_baseline = 0
         self._counters = get_counters()
         #: rolling TTFT / inter-token completions for windowed stats
         self._ttft_window: "collections.deque[tuple[float, float, int]]" \
@@ -2162,6 +2428,10 @@ class DecodeFleet:
         self._tok_window: "collections.deque[float]" = collections.deque(
             maxlen=max(int(window), 16))
         self._watcher: Optional[_WeightWatcher] = None
+        get_registry().gauge_fn(
+            "serving_chips", self.chips,
+            help="accelerator chips backing this decode fleet",
+            job=job)
         for role, n in self.roles.items():
             for _ in range(n):
                 self._replicas.append(self._new_replica(role))
@@ -2171,11 +2441,24 @@ class DecodeFleet:
     # -- replica construction ------------------------------------------------
 
     def _new_replica(self, role: str) -> DecodeReplica:
-        name = f"{self.job}/{role[0]}{next(self._rep_seq)}"
+        idx = next(self._rep_seq)
+        name = f"{self.job}/{role[0]}{idx}"
+        devices = None
+        if self.devices_per_replica > 0:
+            import jax
+
+            devs = jax.devices()
+            d = self.devices_per_replica
+            # cyclic slices: replica idx owns d consecutive chips; on
+            # hosts with fewer chips than replicas×d, slices wrap (CPU
+            # test topologies) rather than refuse to build
+            devices = [devs[(idx * d + j) % len(devs)] for j in range(d)]
         r = DecodeReplica(
             name, self._gen_params, self.cfg, job=self.job, role=role,
+            devices=devices,
             scheduler=TokenScheduler(self._wfq_weights,
-                                     self._decode_per_prefill),
+                                     self._decode_per_prefill,
+                                     tpot_budget_ms=self._tpot_budget_ms),
             on_handoff=self._adopt_handoff if role == "prefill" else None,
             on_session_done=self._record_done, ledger=self._ledger,
             **self._rep_kw)
@@ -2216,26 +2499,37 @@ class DecodeFleet:
             raise KVPoolExhausted(
                 f"session needs {need} blocks, per-session cap is "
                 f"{self._rep_kw['max_blocks_per_session']}")
-        tier = (self._role_replicas("prefill")
-                or self._role_replicas("decode"))
-        ready = [r for r in tier if r.routable()] or tier
-        if not ready:
-            raise SessionDropped(f"fleet {self.job} has no replicas")
-        fits = [r for r in ready
-                if r.can_admit(len(sess.prompt), sess.max_new_tokens)]
-        if not fits:
-            lightest = min(ready, key=lambda r: r.sessions_active())
-            if lightest.sessions_active() >= self.max_queued_sessions:
-                self._counters.inc("serving_kv_admission_rejects",
-                                  job=self.job)
-                raise KVPoolExhausted(
-                    f"fleet {self.job}: no replica can admit "
-                    f"{len(sess.prompt)}+{sess.max_new_tokens} tokens")
-            fits = [lightest]  # queue it; blocks free as sessions end
-        target = min(fits, key=lambda r: r.sessions_active())
-        target.submit(sess)
-        self.sessions_submitted += 1
-        return sess
+        for _attempt in range(3):
+            tier = (self._role_replicas("prefill")
+                    or self._role_replicas("decode"))
+            ready = [r for r in tier if r.routable()] or tier
+            if not ready:
+                raise SessionDropped(f"fleet {self.job} has no replicas")
+            fits = [r for r in ready
+                    if r.can_admit(len(sess.prompt),
+                                   sess.max_new_tokens)]
+            if not fits:
+                lightest = min(ready, key=lambda r: r.sessions_active())
+                if lightest.sessions_active() >= self.max_queued_sessions:
+                    self._counters.inc("serving_kv_admission_rejects",
+                                      job=self.job)
+                    raise KVPoolExhausted(
+                        f"fleet {self.job}: no replica can admit "
+                        f"{len(sess.prompt)}+{sess.max_new_tokens} "
+                        "tokens")
+                fits = [lightest]  # queue it; blocks free as they end
+            target = min(fits, key=lambda r: r.sessions_active())
+            try:
+                target.submit(sess)
+            except SessionDropped:
+                # the replica stopped between the pick and the enqueue
+                # (a scale-down racing admission): re-route instead of
+                # surfacing a drop the fleet could have absorbed
+                continue
+            self.sessions_submitted += 1
+            return sess
+        raise SessionDropped(
+            f"fleet {self.job}: no stable replica accepted the session")
 
     def _adopt_handoff(self, sess: DecodeSession, host_kv: dict) -> None:
         """A prefill replica finished a prompt: land the cache on the
@@ -2298,6 +2592,15 @@ class DecodeFleet:
             n_victims = len(decode) - target
             if n_victims > 0:
                 victims = decode[-n_victims:]
+                # flip victims off the routable set under the fleet
+                # lock, BEFORE evacuation: an open-loop submit racing
+                # the scale-down must not route a session at a replica
+                # whose state is about to leave (it would be failed by
+                # the final stop instead of migrated)
+                for v in victims:
+                    with v._cond:
+                        if v.state == READY:
+                            v.state = DRAINING
             self._replicas.extend(grown)
         for r in grown:
             r.wait_ready(wait_ready_s)
@@ -2312,44 +2615,99 @@ class DecodeFleet:
             return len([r for r in self._replicas if r.role == "decode"])
 
     def _evacuate(self, victim: DecodeReplica) -> None:
+        """Scale-down evacuation, D2D-first: each session's blocked
+        cache leaves the victim as a device payload and lands on a
+        survivor through the :func:`plan_reshard`-accounted
+        device-to-device path (``kv_migration_bytes{path="ici"}``).
+        The host roundtrip survives only as the fallback — survivor
+        pools with a mismatched storage mode or no room for the
+        payload's block layout (``path="host"``), then cacheless
+        re-prefill, then (no survivors at all) a typed failure."""
+        from edl_tpu.runtime.kvcache import (
+            KVPoolExhausted,
+            payload_to_host,
+        )
+
         t0 = time.perf_counter()
         victim.quiesce()
-        moved = victim.export_all()
+        moved = victim.export_all(device=True)
         survivors = [r for r in self._role_replicas("decode")
                      if r is not victim and r.routable()]
-        for sess, kv in moved:
-            placed = False
-            for r in sorted(survivors, key=lambda r: r.sessions_active()):
-                from edl_tpu.runtime.kvcache import KVPoolExhausted
 
-                try:
-                    r.import_session(sess, kv)
-                    placed = True
-                    break
-                except KVPoolExhausted:
-                    continue
+        def _place(sess, payload):
+            placed = False
+            via_d2d = via_host = False
+            d2d_nbytes = trimmed = 0
+            ranked = sorted(survivors,
+                            key=lambda r: r.sessions_active())
+            if payload is not None:
+                d2d_nbytes = payload.nbytes
+                k = payload.arrays["k"]
+                # what the host path would ship for THIS session: the
+                # trimmed dequantized [L, length, kv, hd] f32 pair,
+                # once off-device and once back on
+                trimmed = (2 * int(k.shape[0]) * int(payload.length)
+                           * int(k.shape[3]) * int(k.shape[4]) * 4)
+                for r in ranked:
+                    try:
+                        r.import_session_device(sess, payload)
+                        placed = via_d2d = True
+                        break
+                    except (KVPoolExhausted, ValueError):
+                        continue
+                if not placed and survivors:
+                    host_kv = payload_to_host(
+                        payload, victim.pool.block_size, job=self.job)
+                    for r in ranked:
+                        try:
+                            r.import_session(sess, host_kv)
+                            placed = via_host = True
+                            break
+                        except KVPoolExhausted:
+                            continue
             if not placed and survivors:
                 # cache didn't fit anywhere: ship the session without it
                 # (re-prefill of known history — slower, never dropped)
-                sorted(survivors,
-                       key=lambda r: r.sessions_active())[0] \
-                    .import_session(sess, None)
+                ranked[0].import_session(sess, None)
                 placed = True
             if not placed:
                 sess.fail(SessionDropped(
                     f"fleet {self.job}: scale-down with no survivor"))
                 with self._lock:
                     self.sessions_failed += 1
-                continue
+                return
             with self._lock:
                 self.migrations += 1
+                if payload is not None:
+                    self.migration_bytes_host_roundtrip_baseline += \
+                        2 * trimmed
+                    if via_d2d:
+                        self.migration_bytes_d2d += d2d_nbytes
+                    elif via_host:
+                        self.migration_bytes_host += 2 * trimmed
+
+        for sess, payload in moved:
+            _place(sess, payload)
+        # straggler sweep: a submit that passed the routable() check
+        # before the DRAINING flip may have enqueued AFTER export_all
+        # snapshotted the queue — re-export (cacheless, still queued)
+        # until the replica is verifiably empty, so the final stop
+        # never fails a live session
+        n_moved = len(moved)
+        while True:
+            late = victim.export_all(device=True)
+            if not late:
+                break
+            for sess, payload in late:
+                _place(sess, payload)
+            n_moved += len(late)
         victim.stop(drain=False)  # empty by construction
         get_tracer().instant(
             "decode_fleet_evacuated", category="serving", job=self.job,
-            replica=victim.name, sessions=len(moved),
+            replica=victim.name, sessions=n_moved,
             evac_ms=round((time.perf_counter() - t0) * 1000, 1))
         log.info("decode replica evacuated", replica=victim.name,
-                 sessions=len(moved),
+                 sessions=n_moved,
                  evac_ms=round((time.perf_counter() - t0) * 1000, 1))
 
     def kill_replica(self, name: str) -> int:
@@ -2487,6 +2845,25 @@ class DecodeFleet:
         with self._lock:
             return sum(r.pool.total_bytes() for r in self._replicas)
 
+    def kv_reserved_bytes_per_device(self) -> int:
+        """Worst-case per-device KV residency across the fleet — the
+        value to pass as ``choose_shape(reserved_bytes_per_device=...)``
+        when planning a layout that must coexist with these pools.  A
+        sharded pool reserves its per-device share; an unsharded pool
+        reserves everything on its one device."""
+        with self._lock:
+            return max((r.pool.reserved_bytes_per_device()
+                        for r in self._replicas
+                        if r.state != STOPPED), default=0)
+
+    def chips(self) -> int:
+        """Accelerator chips currently backing active replicas — the
+        denominator of tok/s-per-chip."""
+        with self._lock:
+            return sum(
+                len(r.pool.devices) if r.pool.devices else 1
+                for r in self._replicas if r.state != STOPPED)
+
     def stats(self, window_s: float = 10.0) -> FleetStats:
         """Windowed decode rollup in the FleetStats shape the scaler
         consumes — TTFT p99 over recent completions, decode tok/s from
@@ -2513,6 +2890,9 @@ class DecodeFleet:
         tpot_p50 = (float(np.median(np.asarray(tpots))) * 1e3
                     if tpots else 0.0)
         used, total = self.kv_blocks()
+        chips = self.chips()
+        drafted = sum(r.spec_drafted for r in replicas)
+        accepted = sum(r.spec_accepted for r in replicas)
         return FleetStats(
             p50_ms=tpot_p50, p99_ms=ttft_p99,
             qps=round(decode_tps, 2),
@@ -2524,7 +2904,11 @@ class DecodeFleet:
             tpot_p50_ms=round(tpot_p50, 4),
             decode_tps=round(decode_tps, 2),
             sessions=self.sessions_active(),
-            kv_blocks_used=used, kv_blocks_total=total)
+            kv_blocks_used=used, kv_blocks_total=total,
+            chips=chips,
+            tok_s_per_chip=round(decode_tps / max(chips, 1), 2),
+            spec_accept_rate=round(accepted / drafted, 4) if drafted
+            else 0.0)
 
     def stop(self, drain: bool = True) -> None:
         if self._watcher is not None:

@@ -649,13 +649,17 @@ print("serving smoke OK:", {k: tally[k] for k in
       "prewarm_hits", fleet.prewarm_hits, "generation", fleet.generation)
 EOF
 
-echo "== decode smoke (token-level batching through a live 2->1 scale-down)"
-# Autoregressive tripwire (doc/serving.md §autoregressive serving):
-# sessions decode against a 2-replica DecodeFleet with a paged KV pool,
-# the fleet scales 2→1 MID-DECODE (every live session's K/V evacuates to
-# the survivor), zero dropped sessions, every continuation bitwise-equal
-# to the full-context greedy reference, and the edl_serving_ttft/tpot/
-# kv_* series green under the strict parser.
+echo "== decode smoke (speculative batching through a live 2->1 scale-down)"
+# Autoregressive tripwire (doc/serving.md §autoregressive serving +
+# §decode-v2): sessions decode SPECULATIVELY (self-drafted multi-token
+# verify steps, strictly lossless) against a 2-replica DecodeFleet with
+# a paged KV pool, the fleet scales 2→1 MID-DECODE (every live
+# session's K/V evacuates to the survivor), zero dropped sessions,
+# every continuation bitwise-equal to the full-context greedy
+# reference, an identical re-admitted prompt adopts its sealed prefix
+# blocks without re-prefill, and the edl_serving_ttft/tpot/kv_* +
+# edl_decode_spec_*/edl_kv_prefix_* series green under the strict
+# parser.
 JAX_PLATFORMS=cpu python - <<'EOF'
 import numpy as np
 
@@ -676,19 +680,26 @@ def ref_decode(prompt, n):
 rng = np.random.default_rng(5)
 ps = [rng.integers(1, 255, size=int(rng.integers(3, 10))).tolist()
       for _ in range(6)]
+ps += [[11, 4, 11, 4, 11, 4, 11, 4]] * 2  # periodic: drafts accept
 fleet = DecodeFleet(params, TINY, job="ci/decode", roles={"decode": 2},
                     slots=3, prefill_chunk=8, kv_blocks=48,
-                    kv_block_size=8, max_blocks_per_session=8)
+                    kv_block_size=8, max_blocks_per_session=8,
+                    spec_tokens=4, spec_ngram=3)
 try:
     ss = [fleet.submit(p, max_new_tokens=24) for p in ps]
     for s in ss[:3]:
         s.wait_first_token(60)     # demonstrably mid-decode...
     fleet.scale_to(1)              # ...when the fleet shrinks LIVE
     outs = [s.wait(120) for s in ss]
+    # prefix sharing: the same 24-token prompt twice — the second
+    # admission adopts the first's sealed blocks, no re-prefill
+    pp = list(range(7, 31))
+    pa = fleet.submit(pp, max_new_tokens=8).wait(60)
+    pb = fleet.submit(pp, max_new_tokens=8).wait(60)
 finally:
     fleet.stop(drain=False)
 assert fleet.sessions_failed == 0, "scale-down dropped sessions"
-assert fleet.sessions_completed == len(ps)
+assert fleet.sessions_completed == len(ps) + 2
 assert fleet.migrations >= 1, "shrink never migrated a session"
 for p, o in zip(ps, outs):
     assert o == ref_decode(p, 24), "migrated continuation diverged"
@@ -702,9 +713,24 @@ assert any(k.startswith("edl_serving_kv_blocks_total")
            and 'job="ci/decode"' in k for k in series), "no KV gauges"
 assert series.get('edl_serving_kv_admission_rejects_total'
                   '{job="ci/decode"}', -1) == 0
+assert pa == pb == ref_decode(pp, 8), "prefix-shared continuation diverged"
+spec_ok = sum(v for k, v in series.items()
+              if k.startswith("edl_decode_spec_accepted_total")
+              and 'job="ci/decode"' in k)
+assert spec_ok > 0, "speculative decode never accepted a draft"
+hits = sum(v for k, v in series.items()
+           if k.startswith("edl_kv_prefix_hits_total")
+           and 'job="ci/decode"' in k)
+assert hits >= 1, "re-admitted prompt never hit the prefix cache"
+saved = sum(v for k, v in series.items()
+            if k.startswith("edl_kv_prefix_tokens_saved_total")
+            and 'job="ci/decode"' in k)
+assert saved >= 8, "prefix hit saved no prefill tokens"
 print("decode smoke OK:", {"sessions": fleet.sessions_completed,
                            "migrations": fleet.migrations,
-                           "dropped": fleet.sessions_failed})
+                           "dropped": fleet.sessions_failed,
+                           "spec_accepted": int(spec_ok),
+                           "prefix_hits": int(hits)})
 EOF
 
 echo "== scrape-plane smoke (HA pair + serving fleet under the MetricsScraper)"

@@ -344,12 +344,21 @@ class TestLiveResize:
             src, dst = [r for r in fleet._replicas
                         if r.role == "decode"]
             p = RNG.integers(1, 255, size=30).tolist()  # 32-tok span
-            sess = DecodeSession(p, 2, id=92_000)
-            src.submit(sess)
-            sess.wait_first_token(60)
-            assert src.quiesce(30)
-            (m, kv), = src.export_all()
-            src.resume()
+            moved = None
+            for attempt in range(3):
+                sess = DecodeSession(p, 2, id=92_000 + attempt)
+                src.submit(sess)
+                sess.wait_first_token(60)
+                assert src.quiesce(30)
+                moved = src.export_all()
+                src.resume()
+                if moved:
+                    break
+                # the last token landed before the park and the session
+                # completed — nothing resident to export; retry
+                sess.wait(60)
+            assert moved, "session never parked mid-decode"
+            (m, kv), = moved
             assert dst.quiesce(30)
             dst.import_session(sess, kv)  # 4 blocks reserved, queued
             assert dst.pool.blocks_free() == 4

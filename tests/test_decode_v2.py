@@ -1,0 +1,547 @@
+"""Decode v2 (doc/serving.md §decode-v2): speculative multi-token
+steps (lossless vs single-token greedy), block-level prefix sharing
+with copy-on-write, int8 KV quantization, sharded KV pools with
+per-device accounting, D2D scale-down evacuation, the adaptive
+chunked-prefill budget, LB affinity eviction on session end, and a
+randomized churn property sweep over the block pool."""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from edl_tpu.models import llama
+from edl_tpu.models.transformer import TINY, apply, init
+from edl_tpu.observability.metrics import (
+    MetricsRegistry,
+    get_registry,
+    parse_exposition,
+)
+from edl_tpu.runtime.kvcache import KVBlockPool, KVPoolExhausted
+from edl_tpu.runtime.serving import DecodeFleet, TokenScheduler
+
+PARAMS = init(jax.random.PRNGKey(0), TINY)
+_REF_CACHE: dict = {}
+
+#: a prompt whose greedy continuation is a long single-token run —
+#: the self-drafting n-gram drafter's best case (and the bench's)
+PERIODIC = [11, 4, 11, 4, 11, 4, 11, 4]
+
+
+def ref_decode(prompt, n):
+    key = (tuple(prompt), n)
+    if key not in _REF_CACHE:
+        toks = list(prompt)
+        out = []
+        for _ in range(n):
+            logits = apply(PARAMS, np.asarray([toks], np.int32), TINY)
+            t = int(np.asarray(logits[0, -1]).argmax())
+            out.append(t)
+            toks.append(t)
+        _REF_CACHE[key] = out
+    return _REF_CACHE[key]
+
+
+def make_fleet(**kw) -> DecodeFleet:
+    kw.setdefault("job", "t/decode2")
+    kw.setdefault("roles", {"decode": 1})
+    kw.setdefault("slots", 4)
+    kw.setdefault("prefill_chunk", 8)
+    kw.setdefault("kv_blocks", 48)
+    kw.setdefault("kv_block_size", 8)
+    kw.setdefault("max_blocks_per_session", 8)
+    return DecodeFleet(PARAMS, TINY, **kw)
+
+
+def make_pool(num_blocks=16, block_size=8, cap=8, job="t/kv2",
+              **kw) -> KVBlockPool:
+    kw.setdefault("registry", MetricsRegistry())
+    return KVBlockPool(TINY, num_blocks, block_size, cap, job=job, **kw)
+
+
+def counter_sum(name: str, job: str, match: str = "") -> float:
+    """Sum of a global-registry counter across label sets for ``job``
+    (job names are unique per test, so absolutes are deltas)."""
+    series = parse_exposition(get_registry().render())
+    return sum(v for k, v in series.items()
+               if k.startswith(name) and f'job="{job}"' in k
+               and match in k)
+
+
+def pool_prefill(pool: KVBlockPool, sid: int, toks: list) -> None:
+    """Run a real prefill through the pool's cache for one session."""
+    import jax.numpy as jnp
+
+    pool.ensure_capacity(sid, len(toks))
+    _, cache = llama.prefill(
+        PARAMS, pool.cache, jnp.asarray(list(toks), "int32"),
+        jnp.asarray(pool.block_table(sid)), jnp.asarray(0, "int32"),
+        jnp.asarray(len(toks), "int32"), TINY)
+    pool.set_cache(cache)
+
+
+# -- speculative decode -------------------------------------------------------
+
+
+class TestSpeculativeDecode:
+    def test_lossless_vs_single_token_greedy(self):
+        """THE spec-decode contract: continuations are bitwise-equal
+        with speculation on and off, draftable and chaotic prompts
+        alike — and both match the full-context reference."""
+        ps = [PERIODIC, [5, 9, 17, 33], [200, 3, 77, 4, 11, 4],
+              list(PERIODIC) + [7]]
+        outs = {}
+        for k in (0, 4):
+            fl = make_fleet(job=f"t/spec-lossless{k}", spec_tokens=k,
+                            spec_ngram=3)
+            try:
+                ss = [fl.submit(list(p), max_new_tokens=10) for p in ps]
+                outs[k] = [s.wait(120) for s in ss]
+            finally:
+                fl.stop(drain=False)
+        assert outs[4] == outs[0]
+        assert outs[0] == [ref_decode(p, 10) for p in ps]
+
+    def test_acceptance_counters(self):
+        fl = make_fleet(job="t/spec-counters", spec_tokens=4,
+                        spec_ngram=3)
+        try:
+            ss = [fl.submit(list(PERIODIC), max_new_tokens=12)
+                  for _ in range(3)]
+            for s in ss:
+                s.wait(120)
+            rep = fl._replicas[0]
+            assert rep.spec_drafted > 0
+            assert 0 < rep.spec_accepted <= rep.spec_drafted
+        finally:
+            fl.stop(drain=False)
+        assert counter_sum("edl_decode_spec_accepted_total",
+                           "t/spec-counters") > 0
+        assert (counter_sum("edl_decode_spec_drafted_total",
+                            "t/spec-counters")
+                >= counter_sum("edl_decode_spec_accepted_total",
+                               "t/spec-counters"))
+
+    def test_eos_mid_draft_truncates_identically(self):
+        """EOS landing inside an accepted draft window must cut the
+        continuation exactly where single-token greedy would."""
+        eos = ref_decode(PERIODIC, 1)[0]  # first continuation token
+        outs = {}
+        for k in (0, 4):
+            fl = make_fleet(job=f"t/spec-eos{k}", spec_tokens=k,
+                            spec_ngram=3, eos_id=eos)
+            try:
+                outs[k] = fl.submit(list(PERIODIC),
+                                    max_new_tokens=8).wait(120)
+            finally:
+                fl.stop(drain=False)
+        assert outs[4] == outs[0]
+        assert len(outs[0]) < 8  # EOS actually truncated
+
+
+# -- prefix sharing / CoW -----------------------------------------------------
+
+
+class TestPrefixSharing:
+    def test_pool_admit_with_prefix_adopts_sealed_blocks(self):
+        pool = make_pool()
+        toks = list(range(1, 25))  # 24 tokens = 3 full blocks of 8
+        pool_prefill(pool, 1, toks)
+        assert pool.register_prefix(1, toks) > 0
+        blocks, covered = pool.admit_with_prefix(2, toks, 32)
+        # the final prompt token is always left to prefill, so exactly
+        # the first two sealed blocks (16 tokens) are adopted
+        assert covered == 16
+        shared = pool.session_blocks(1)[:2]
+        assert pool.session_blocks(2)[:2] == shared
+        assert all(pool.block_refcount(b) == 2 for b in shared)
+        assert blocks == pool.session_blocks(2)
+
+    def test_fleet_prefix_hit_skips_reprefill_and_stays_stable(self):
+        job = "t/prefix-fleet"
+        fl = make_fleet(job=job, kv_blocks=64,
+                        max_blocks_per_session=8)
+        p = list(range(7, 31))  # 24 tokens
+        try:
+            first = fl.submit(list(p), max_new_tokens=8).wait(120)
+            again = fl.submit(list(p), max_new_tokens=8).wait(120)
+        finally:
+            fl.stop(drain=False)
+        assert again == first == ref_decode(p, 8)
+        assert counter_sum("edl_kv_prefix_hits_total", job) >= 1
+        assert counter_sum("edl_kv_prefix_tokens_saved_total",
+                           job) >= 8
+
+    def test_fork_session_cow_preserves_and_diverges(self):
+        pool = make_pool(job="t/kv2-cow")
+        toks = list(range(3, 15))  # 12 tokens: one full + one partial
+        pool_prefill(pool, 1, toks)
+        src = pool.export_session(1, len(toks))
+        assert pool.fork_session(1, 2) == pool.session_blocks(1)
+        assert all(pool.block_refcount(b) == 2
+                   for b in pool.session_blocks(1))
+        # CoW guard before dst writes past the shared tail: every
+        # covered shared block is replaced by a private copy
+        copied = pool.make_writable(2, 8, len(toks))
+        assert copied == 1
+        assert (pool.session_blocks(2)[1]
+                != pool.session_blocks(1)[1])
+        assert pool.block_refcount(pool.session_blocks(1)[1]) == 1
+        assert counter_sum("edl_kv_cow_copies_total", "t/kv2-cow") == 1
+        # both sides still read the SAME prefill content
+        for sid in (1, 2):
+            got = pool.export_session(sid, len(toks))
+            for name in ("k", "v"):
+                np.testing.assert_array_equal(
+                    np.asarray(got[name]), np.asarray(src[name]))
+
+
+# -- int8 KV quantization -----------------------------------------------------
+
+
+class TestQuantizedPool:
+    def test_int8_roundtrip_bounded_error_and_smaller_pool(self):
+        fp = make_pool()
+        q8 = make_pool(quantize="int8")
+        toks = list(range(1, 13))
+        pool_prefill(fp, 1, toks)
+        pool_prefill(q8, 1, toks)
+        ref = fp.export_session(1, len(toks))
+        got = q8.export_session(1, len(toks))
+        for name in ("k", "v"):
+            r = np.asarray(ref[name], np.float32)
+            g = np.asarray(got[name], np.float32)
+            # layer 0 sees the exact symmetric per-row int8 error:
+            # |err| <= 0.5 * amax/127 per token row
+            bound = (np.abs(r[0]).max(axis=(-1, -2), keepdims=True)
+                     / 127.0) * 0.5 + 1e-6
+            assert (np.abs(r[0] - g[0]) <= bound).all()
+            # deeper layers compound (their inputs already carry the
+            # quantized attention readback) — loose envelope only
+            assert np.abs(r - g).max() <= 0.05 * np.abs(r).max()
+        assert q8.total_bytes() < 0.5 * fp.total_bytes()
+
+    def test_d2d_import_rejects_storage_mode_mismatch(self):
+        fp = make_pool()
+        q8 = make_pool(quantize="int8")
+        toks = list(range(1, 10))
+        pool_prefill(fp, 1, toks)
+        payload = fp.export_session_device(1, len(toks))
+        with pytest.raises(ValueError, match="storage modes"):
+            q8.reserve_import_device(7, payload)
+        assert 7 not in q8.sessions()
+
+
+# -- sharded pools ------------------------------------------------------------
+
+
+@pytest.mark.skipif(len(jax.devices()) < 2, reason="needs >=2 devices")
+class TestShardedPool:
+    def test_heads_sharded_fleet_matches_reference(self):
+        fl = make_fleet(job="t/shard-fleet", devices_per_replica=2)
+        try:
+            pool = fl._replicas[0].pool
+            assert len(pool.devices) == 2
+            assert pool.shard_axis == "heads"  # n_kv_heads 2 % 2 == 0
+            ps = [[5, 9, 17, 33], list(PERIODIC)]
+            ss = [fl.submit(list(p), max_new_tokens=8) for p in ps]
+            assert [s.wait(120) for s in ss] \
+                == [ref_decode(p, 8) for p in ps]
+        finally:
+            fl.stop(drain=False)
+
+    def test_per_device_accounting_sums_and_reserves(self):
+        pool = make_pool(devices=jax.devices()[:2])
+        pool.ensure_capacity(1, 20)  # 3 blocks
+        per = pool.per_device_used_bytes()
+        assert set(per) == {0, 1}
+        assert sum(per.values()) == pool.used_bytes()
+        assert pool.reserved_bytes_per_device() \
+            == -(-pool.total_bytes() // 2)
+
+    @pytest.mark.skipif(len(jax.devices()) < 3,
+                        reason="needs >=3 devices")
+    def test_pages_sharding_when_heads_do_not_divide(self):
+        # n_kv_heads 2 % 3 != 0 but 15 blocks % 3 == 0 → pages
+        pool = make_pool(num_blocks=15, devices=jax.devices()[:3])
+        assert pool.shard_axis == "pages"
+        pool.ensure_capacity(1, 20)
+        per = pool.per_device_used_bytes()
+        assert sum(per.values()) == pool.used_bytes()
+
+
+# -- D2D migration ------------------------------------------------------------
+
+
+class TestD2DMigration:
+    def test_pool_roundtrip_bitwise_with_ici_accounting(self):
+        src = make_pool()
+        dst = make_pool(job="t/kv2-d2d")
+        toks = list(range(1, 19))
+        pool_prefill(src, 1, toks)
+        ref = src.export_session(1, len(toks))
+        payload = src.export_session_device(1, len(toks))
+        blocks = dst.reserve_import_device(1, payload)
+        dst.apply_import_device(1, blocks, payload)
+        got = dst.export_session(1, len(toks))
+        for name in ("k", "v"):
+            np.testing.assert_array_equal(np.asarray(got[name]),
+                                          np.asarray(ref[name]))
+        assert counter_sum("edl_kv_migration_bytes_total",
+                           "t/kv2-d2d", 'path="ici"') > 0
+
+    def test_fleet_scale_down_migrates_d2d_zero_drops(self):
+        fl = make_fleet(job="t/d2d-fleet", roles={"decode": 2},
+                        kv_blocks=64)
+        ps = [[9, 8, 7, 6], [1, 2, 3], list(PERIODIC), [44, 45]]
+        try:
+            ss = [fl.submit(list(p), max_new_tokens=48) for p in ps]
+            # let every session decode past its prefill first: queued
+            # (cacheless) sessions would migrate without a D2D payload
+            deadline = time.time() + 60
+            while (time.time() < deadline
+                   and not all(s.ttft_s > 0 for s in ss)):
+                time.sleep(0.01)
+            assert fl.scale_to(1) == 1  # mid-decode: sessions migrate
+            outs = [s.wait(240) for s in ss]
+        finally:
+            fl.stop(drain=False)
+        assert outs == [ref_decode(p, 48) for p in ps]
+        assert fl.sessions_failed == 0
+        assert fl.migrations >= 1
+        assert fl.migration_bytes_d2d > 0
+        assert fl.migration_bytes_host == 0
+        assert (fl.migration_bytes_d2d
+                <= fl.migration_bytes_host_roundtrip_baseline)
+
+
+# -- adaptive chunked-prefill budget ------------------------------------------
+
+
+class TestAdaptiveScheduler:
+    def test_cold_and_budgetless_fall_back_to_static(self):
+        assert TokenScheduler(
+            decode_per_prefill=3).effective_decode_per_prefill() == 3
+        ts = TokenScheduler(decode_per_prefill=3, tpot_budget_ms=10.0)
+        ts.note_decode(5.0)  # prefill EWMA still empty → static
+        assert ts.effective_decode_per_prefill() == 3
+        ts2 = TokenScheduler(decode_per_prefill=5)  # no budget at all
+        ts2.note_decode(100.0)
+        ts2.note_prefill(100.0)
+        assert ts2.effective_decode_per_prefill() == 5
+
+    def test_slow_decode_rations_prefill_hard(self):
+        ts = TokenScheduler(decode_per_prefill=2, tpot_budget_ms=10.0)
+        ts.note_decode(9.5)
+        ts.note_prefill(5.0)
+        # headroom 0.5ms → a 5ms chunk amortizes over 10 iterations
+        assert ts.effective_decode_per_prefill() == 10
+        ts.note_prefill(None)  # reset interleave count only
+        for _ in range(9):
+            ts.note_decode()
+            assert not ts.allow_prefill(decoding=1, prefill_pending=1)
+        ts.note_decode()
+        assert ts.allow_prefill(decoding=1, prefill_pending=1)
+
+    def test_fast_decode_lets_prefill_run_every_iteration(self):
+        ts = TokenScheduler(decode_per_prefill=4, tpot_budget_ms=10.0)
+        ts.note_decode(1.0)
+        ts.note_prefill(0.5)
+        assert ts.effective_decode_per_prefill() == 1
+
+    def test_no_headroom_clamps_to_ceiling(self):
+        ts = TokenScheduler(decode_per_prefill=2, tpot_budget_ms=10.0)
+        ts.note_decode(12.0)
+        ts.note_prefill(5.0)
+        assert ts.effective_decode_per_prefill() == 64
+
+
+# -- LB affinity eviction on session end --------------------------------------
+
+
+class TestLBAffinityEviction:
+    def _lb_with_pin(self, job):
+        from edl_tpu.runtime.lb import LBApp, _Cell, _OutBlock
+
+        lb = LBApp(job=job)
+
+        class FakeUp:
+            name = "only"
+
+            def routable(self):
+                return True
+
+            def outstanding(self):
+                return 0
+
+        lb.upstreams = {"only": FakeUp()}
+        blk = _OutBlock(None, None, 1, b"", _Cell())
+        blk.session = "s1"
+        lb._pick_affine(blk)
+        assert "s1" in lb._affinity
+        return lb, blk
+
+    def test_session_done_header_evicts_pin(self):
+        lb, blk = self._lb_with_pin("t/affev-done")
+        blk.acc = [b"HTTP/1.1 200 OK\r\nContent-Length: 2\r\n"
+                   b"X-EDL-Session-Done: 1\r\n\r\nok"]
+        lb._maybe_evict_affinity(blk)
+        assert "s1" not in lb._affinity
+        assert counter_sum("edl_lb_affinity_evictions_total",
+                           "t/affev-done") == 1
+
+    def test_error_response_evicts_pin(self):
+        lb, blk = self._lb_with_pin("t/affev-err")
+        blk.errors = 1
+        lb._maybe_evict_affinity(blk)
+        assert "s1" not in lb._affinity
+
+    def test_mid_session_response_keeps_pin(self):
+        lb, blk = self._lb_with_pin("t/affev-keep")
+        blk.acc = [b"HTTP/1.1 200 OK\r\nContent-Length: 2\r\n\r\nok"]
+        lb._maybe_evict_affinity(blk)
+        assert "s1" in lb._affinity
+
+
+# -- fleet reserved bytes feed the resize planner -----------------------------
+
+
+class TestFleetReservedBytes:
+    def test_reserved_bytes_surface_matches_pools(self):
+        fl = make_fleet(job="t/reserved")
+        try:
+            pool = fl._replicas[0].pool
+            assert fl.kv_reserved_bytes_per_device() \
+                == pool.reserved_bytes_per_device() > 0
+        finally:
+            fl.stop(drain=False)
+
+
+# -- randomized churn property sweep ------------------------------------------
+
+
+class TestChurnProperty:
+    def test_500_op_churn_conserves_blocks_and_refcounts(self):
+        """admit / extend / prefix-share / fork / CoW / migrate / free
+        for 500+ randomized ops: no leaked blocks, refcounts conserve,
+        and the occupancy gauge tracks distinct per-session residency
+        the whole way."""
+        reg = MetricsRegistry()
+        pool = make_pool(num_blocks=24, block_size=4, cap=6,
+                         registry=reg, replica="r0")
+        rng = np.random.default_rng(19)
+        lengths: dict[int, int] = {}   # sid → token count
+        prompts: dict[int, list] = {}  # sid → registered-prefix tokens
+        next_sid = [1]
+
+        def check_invariants():
+            distinct = set()
+            refsum = 0
+            for sid in list(lengths):
+                bs = pool.session_blocks(sid)
+                distinct.update(bs)
+                refsum += len(bs)
+            assert pool.blocks_used() == len(distinct)
+            assert sum(pool.block_refcount(b)
+                       for b in range(pool.num_blocks)) == refsum
+            assert (f'edl_serving_kv_blocks_used'
+                    f'{{job="t/kv2",replica="r0"}} {len(distinct)}'
+                    in reg.render())
+
+        def op_admit():
+            sid = next_sid[0]
+            next_sid[0] += 1
+            n = int(rng.integers(2, 13))
+            try:
+                pool.ensure_capacity(sid, n)
+            except KVPoolExhausted:
+                return
+            lengths[sid] = n
+
+        def op_extend():
+            if not lengths:
+                return
+            sid = int(rng.choice(list(lengths)))
+            n = lengths[sid] + int(rng.integers(1, 5))
+            try:
+                pool.ensure_capacity(sid, n)
+            except KVPoolExhausted:
+                return
+            lengths[sid] = n
+
+        def op_share():
+            if not lengths:
+                return
+            src = int(rng.choice(list(lengths)))
+            if src not in prompts:
+                toks = [int(t) for t in
+                        rng.integers(1, 255, size=lengths[src])]
+                pool.register_prefix(src, toks)
+                prompts[src] = toks
+                return
+            sid = next_sid[0]
+            next_sid[0] += 1
+            try:
+                pool.admit_with_prefix(sid, prompts[src],
+                                       len(prompts[src])
+                                       + int(rng.integers(1, 5)))
+            except KVPoolExhausted:
+                return
+            lengths[sid] = len(prompts[src])
+
+        def op_fork():
+            if not lengths:
+                return
+            src = int(rng.choice(list(lengths)))
+            sid = next_sid[0]
+            next_sid[0] += 1
+            pool.fork_session(src, sid)
+            lengths[sid] = lengths[src]
+
+        def op_cow():
+            if not lengths:
+                return
+            sid = int(rng.choice(list(lengths)))
+            end = lengths[sid]
+            try:
+                pool.make_writable(sid, max(end - 3, 0), end)
+            except KVPoolExhausted:
+                return
+
+        def op_migrate():
+            if not lengths:
+                return
+            sid = int(rng.choice(list(lengths)))
+            payload = pool.export_session_device(sid, lengths[sid])
+            pool.free_session(sid)
+            n = lengths.pop(sid)
+            prompts.pop(sid, None)
+            try:
+                blocks = pool.reserve_import_device(sid, payload)
+            except KVPoolExhausted:
+                return
+            pool.apply_import_device(sid, blocks, payload)
+            lengths[sid] = n
+
+        def op_free():
+            if not lengths:
+                return
+            sid = int(rng.choice(list(lengths)))
+            pool.free_session(sid)
+            del lengths[sid]
+            prompts.pop(sid, None)
+
+        ops = [op_admit, op_admit, op_extend, op_share, op_fork,
+               op_cow, op_migrate, op_free, op_free]
+        for i in range(520):
+            ops[int(rng.integers(len(ops)))]()
+            if i % 40 == 0:
+                check_invariants()
+        for sid in list(lengths):
+            pool.free_session(sid)
+            del lengths[sid]
+        check_invariants()
+        assert pool.blocks_used() == 0
