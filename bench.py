@@ -3466,6 +3466,150 @@ def goodput_leg() -> dict:
     }
 
 
+def calibration_leg() -> dict:
+    """Calibration plane measured (doc/observability.md §calibration
+    plane): with the process ledger armed against an HA coordinator
+    pair, run the reparallel-style dp×fsdp resize walk (the planned
+    bytes_ici at nominal fabric rate vs the measured reshard wall), a
+    speculative DecodeFleet through a live 2→1 D2D evacuation between
+    distinct devices, and a goodput-curve re-record — then report
+    per-predictor error_pct p50/p99 + running factors, and prove the
+    factor records survive a primary SIGKILL: readable from the
+    promoted standby, which keeps accepting new samples."""
+    import signal
+    import tempfile
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    import optax
+
+    from edl_tpu.coord import CoordClient, native_available, spawn_ha_pair
+    from edl_tpu.models import mlp
+    from edl_tpu.models.transformer import TINY
+    from edl_tpu.models.transformer import init as transformer_init
+    from edl_tpu.observability import calib
+    from edl_tpu.observability.calib import (
+        CalibrationFactors, CalibrationLedger, load_factors,
+        nominal_transfer_seconds)
+    from edl_tpu.observability.goodput import CurveStore
+    from edl_tpu.parallel.mesh import MeshShape, MeshSpec
+    from edl_tpu.runtime.elastic import ElasticTrainer
+    from edl_tpu.runtime.serving import DecodeFleet
+
+    if not native_available():
+        return {"error": "no native coordinator core"}
+    JOB = "bench/calib"
+    tmp = tempfile.mkdtemp(prefix="edl-bench-calib-")
+    pr, sb = spawn_ha_pair(tmp, repl_lease_ms=1000)
+    client = CoordClient("127.0.0.1", pr.port, timeout=2.0,
+                         reconnect_window_s=12.0, promote_grace_s=0.2,
+                         endpoints=[("127.0.0.1", sb.port)])
+    led = calib.set_process_calib(
+        CalibrationLedger(job=JOB, coord=client))
+    try:
+        # 1. resize walk: every hop pairs the nominal-bandwidth transfer
+        # price of the planned bytes with the measured reshard wall
+        rng = np.random.default_rng(0)
+        y = rng.integers(0, 4, size=512).astype(np.int32)
+        x = rng.normal(size=(512, 16)).astype(np.float32)
+        params = mlp.init(jax.random.key(0), [16, 64, 4])
+        t = ElasticTrainer(mlp.loss_fn, params, optax.adam(1e-2),
+                           spec=MeshSpec(dp=-1), param_sharding="fsdp",
+                           initial_world_size=4)
+        t.step((x[:64], y[:64]))
+        predicted_s, measured_s, measured_gbps = [], [], []
+        for shape in (MeshShape(dp=2, fsdp=2), MeshShape(dp=4),
+                      MeshShape(dp=2, fsdp=2)):
+            assert t.resize(shape), f"resize to {shape.describe()} failed"
+            evt = t.resize_events[-1]
+            predicted_s.append(round(nominal_transfer_seconds(
+                evt["bytes_ici"], evt["bytes_dcn"],
+                host=evt["transfer"] == "host"), 9))
+            measured_s.append(round(evt["reshard_ms"] / 1000.0, 6))
+            measured_gbps.append(evt["reshard_gbps"])
+            t.step((x[:64], y[:64]))
+
+        # 2. decode D2D evacuation + speculative decode: the fleet
+        # shrinks 2→1 mid-decode, every live session's K/V migrates
+        tparams = transformer_init(jax.random.PRNGKey(0), TINY)
+        prng = np.random.default_rng(7)
+        ps = [prng.integers(1, 255,
+                            size=int(prng.integers(4, 10))).tolist()
+              for _ in range(4)]
+        ps += [[11, 4, 11, 4, 11, 4, 11, 4]] * 2  # periodic: drafts hit
+        fleet = DecodeFleet(tparams, TINY, job=JOB, roles={"decode": 2},
+                            slots=3, prefill_chunk=8, kv_blocks=48,
+                            kv_block_size=8, max_blocks_per_session=8,
+                            spec_tokens=4, spec_ngram=3,
+                            devices_per_replica=1)
+        try:
+            ss = [fleet.submit(p, max_new_tokens=16) for p in ps]
+            for s in ss[:2]:
+                s.wait_first_token(60)
+            fleet.scale_to(1)
+            for s in ss:
+                s.wait(120)
+        finally:
+            fleet.stop(drain=False)
+        assert fleet.sessions_failed == 0, "evacuation dropped sessions"
+        migrations = fleet.migrations
+
+        # 3. goodput curve: repeated windows at a measured size pair the
+        # curve's prediction against each realized tok/s
+        store = CurveStore(client, JOB)
+        for tok_s in (1000.0, 950.0, 990.0):
+            store.record(2, tok_s)
+
+        core = ("reshard_seconds", "kv_move_seconds", "spec_accept",
+                "goodput_curve")
+        snap = led.snapshot()["predictors"]
+        for pred in core:
+            assert snap.get(pred, {}).get("samples", 0) >= 1, (pred, snap)
+
+        # 4. the HA acceptance: SIGKILL the primary — the factor records
+        # must read back from the promoted standby, and the promoted
+        # primary must keep accepting samples
+        pr.process.send_signal(signal.SIGKILL)
+        pr.process.wait(timeout=10)
+        survived = load_factors(client, JOB)
+        promoted = (client.host, client.port) == ("127.0.0.1", sb.port)
+        store.record(2, 980.0)  # a post-failover sample still lands
+        cf = CalibrationFactors(client, JOB, min_samples=1)
+        factor_from_standby = cf.factor("goodput_curve")
+
+        snap = led.snapshot()["predictors"]
+        per_pred = {p: {"samples": st["samples"],
+                        "factor": st["factor"],
+                        "error_pct_p50": st["error_pct_p50"],
+                        "error_pct_p99": st["error_pct_p99"]}
+                    for p, st in sorted(snap.items())}
+        return {
+            "predictors_calibrated": len(per_pred),
+            "per_predictor": per_pred,
+            "calib_error_pct_p50": {p: per_pred[p]["error_pct_p50"]
+                                    for p in core},
+            "calib_error_pct_p99": {p: per_pred[p]["error_pct_p99"]
+                                    for p in core},
+            # the bytes_ici audit: what replan.py priced the move at vs
+            # the wall the reshard took (and the effective GB/s)
+            "reshard_predicted_s": predicted_s,
+            "reshard_measured_s": measured_s,
+            "reshard_measured_gbps": measured_gbps,
+            "decode_migrations": migrations,
+            "factors_survived_failover": bool(
+                promoted and set(survived) >= set(core)),
+            "factors_on_standby": sorted(survived),
+            "goodput_factor_from_standby": factor_from_standby,
+        }
+    finally:
+        calib.set_process_calib(None)
+        client.close()
+        pr.stop()
+        sb.stop()
+
+
 def determinism_leg() -> dict:
     """Accuracy-consistent elasticity, measured: the same seeded job run
     twice — a control that never resizes and a run resized 4→2→8
@@ -4115,6 +4259,16 @@ def main() -> None:
                    "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
                    "PALLAS_AXON_POOL_IPS": ""})
 
+    # calibration plane: every cost model's predicted-vs-measured audit
+    # through a resize walk + D2D decode evacuation, with the factor
+    # records surviving a coordinator-primary SIGKILL (CPU mesh — it is
+    # an honesty/accounting number, not throughput)
+    calibration = _run_leg(
+        "calibration", timeout_s=420,
+        extra_env={"JAX_PLATFORMS": "cpu",
+                   "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+                   "PALLAS_AXON_POOL_IPS": ""})
+
     # accuracy-consistent elasticity: resized 4→2→8 (+ kill + restore)
     # vs unresized control — measured loss divergence + exactly-once
     # row accounting (CPU mesh — it is a semantics number)
@@ -4221,6 +4375,7 @@ def main() -> None:
                    "reparallel": reparallel, "reform": reform,
                    "coord_ha": coord_ha, "coord_scale": coord_scale,
                    "goodput": goodput_r, "sched_sim": sched_sim,
+                   "calibration": calibration,
                    "determinism": determinism, "sdc": sdc,
                    "serving": serving,
                    "decode_serving": decode_serving,
@@ -4298,6 +4453,23 @@ def main() -> None:
             goodput_r.get("marginal_tok_s_per_chip_at_4"),
         "goodput_curve_survived_failover":
             goodput_r.get("curve_survived_failover"),
+        # calibration plane (doc/observability.md §calibration plane):
+        # how honest every cost model's predictions were — per-predictor
+        # windowed error quantiles, the reshard bytes_ici audit
+        # (predicted transfer seconds at nominal fabric rate vs the
+        # measured wall), and the HA property that the factor records
+        # survive a coordinator-primary kill
+        "calib_predictors": calibration.get("predictors_calibrated"),
+        "calib_error_pct_p50": calibration.get("calib_error_pct_p50"),
+        "calib_error_pct_p99": calibration.get("calib_error_pct_p99"),
+        "calib_reshard_predicted_s":
+            calibration.get("reshard_predicted_s"),
+        "calib_reshard_measured_s":
+            calibration.get("reshard_measured_s"),
+        "calib_reshard_measured_gbps":
+            calibration.get("reshard_measured_gbps"),
+        "calib_factors_survived_failover":
+            calibration.get("factors_survived_failover"),
         # goodput-driven multi-tenant scheduling (ROADMAP #1): the
         # fleet-scale sim's comparison of the marginal objective vs the
         # count-based baseline through the REAL planner — uplift must
@@ -4508,6 +4680,8 @@ if __name__ == "__main__":
             out = chaos_serving_leg()
         elif leg == "reparallel":
             out = reparallel_leg()
+        elif leg == "calibration":
+            out = calibration_leg()
         elif leg == "determinism":
             out = determinism_leg()
         elif leg == "sdc":

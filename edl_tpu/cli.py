@@ -401,6 +401,48 @@ def cmd_fleet(args) -> int:
     return 3 if firing and args.check else 0
 
 
+def cmd_calib(args) -> int:
+    """Per-predictor calibration dashboard off the scrape plane: every
+    cost model's running measured/predicted factor, sample count and
+    windowed error-pct quantiles, plus firing calibration_drift alerts.
+    Same sweep discipline as ``fleet``; ``--check`` exits 3 while any
+    predictor's drift alert is firing."""
+    from edl_tpu.observability.scrape import (
+        AlertEngine, CalibrationDriftRule, FleetView,
+        render_calib_dashboard,
+    )
+
+    scraper = _build_scraper(args)
+    if scraper is None:
+        print("error: no scrape source — pass --scrape-targets and/or "
+              "--scrape-coord", file=sys.stderr)
+        return 2
+    view = FleetView(scraper, window_s=args.window)
+    engine = AlertEngine(view, rules=[CalibrationDriftRule()],
+                         flight_dir=args.flight_dir or None)
+    try:
+        if args.watch:
+            while True:
+                scraper.sweep()
+                engine.evaluate()
+                print("\033[2J\033[H", end="")  # clear + home
+                print(render_calib_dashboard(view, engine))
+                time.sleep(args.scrape_interval)
+        # full-interval naps between sweeps, same reason as cmd_fleet:
+        # targets are due-gated on the interval
+        for i in range(max(int(args.sweeps), 1)):
+            scraper.sweep()
+            if i < args.sweeps - 1:
+                time.sleep(args.scrape_interval)
+        engine.evaluate()
+        print(render_calib_dashboard(view, engine))
+    except KeyboardInterrupt:
+        pass
+    finally:
+        scraper.stop()
+    return 3 if engine.firing() and args.check else 0
+
+
 def cmd_trace(args) -> int:
     """Stitch one trace id's spans across every tier that recorded them
     (LB origin → front door → batcher; serving fleet phases) and render
@@ -583,6 +625,22 @@ def build_parser() -> argparse.ArgumentParser:
     c.add_argument("--check", action="store_true",
                    help="exit 3 if any alert is firing (CI/cron probes)")
     c.set_defaults(fn=cmd_fleet)
+
+    c = sub.add_parser("calib", help="per-predictor calibration "
+                                     "dashboard (measured/predicted "
+                                     "factors + drift alerts)")
+    _add_scrape_flags(c)
+    c.add_argument("--window", type=float, default=10.0,
+                   help="rollup window for error-pct quantiles (seconds)")
+    c.add_argument("--sweeps", type=int, default=3,
+                   help="one-shot mode: sweeps before rendering")
+    c.add_argument("--watch", action="store_true",
+                   help="repaint every --scrape-interval until ^C")
+    c.add_argument("--flight-dir", default="",
+                   help="dump a flight record when drift fires")
+    c.add_argument("--check", action="store_true",
+                   help="exit 3 if calibration drift is firing")
+    c.set_defaults(fn=cmd_calib)
 
     c = sub.add_parser("trace", help="render one request's stitched "
                                      "cross-process span tree by trace "

@@ -42,6 +42,10 @@ JOB_KV_PREFIXES = (
     # cross-checks (runtime/sdc.py); quarantine markers are per-WORKER
     # like evict/ and deliberately not swept with the job
     "sdc-fp/",
+    # per-predictor calibration factors (``calib/<job>/<predictor>``,
+    # observability/calib.py) — a resubmitted job must re-measure, not
+    # inherit a dead job's corrections
+    "calib/",
 )
 
 

@@ -2,6 +2,10 @@
 (shared registry + Prometheus /metrics), correlated span tracing, and
 the flight recorder."""
 
+from edl_tpu.observability.calib import (
+    CalibrationFactors, CalibrationLedger, get_process_calib,
+    load_factor, load_factors, set_process_calib,
+)
 from edl_tpu.observability.collector import (
     Collector, Counters, JobInfo, Sample, get_counters,
 )
@@ -15,8 +19,9 @@ from edl_tpu.observability.metrics import (
     dump_flight_record, get_registry, iter_samples, parse_exposition,
 )
 from edl_tpu.observability.scrape import (
-    AlertEngine, AlertRule, BurnRateRule, ConservationRule, FleetView,
-    GoodputCollapseRule, MetricsScraper, ScrapeTarget, TargetDownRule,
+    AlertEngine, AlertRule, BurnRateRule, CalibrationDriftRule,
+    ConservationRule, FleetView, GoodputCollapseRule, MetricsScraper,
+    ScrapeTarget, TargetDownRule, render_calib_dashboard,
     render_fleet_dashboard,
 )
 from edl_tpu.observability.tracing import (
@@ -24,14 +29,17 @@ from edl_tpu.observability.tracing import (
     set_trace_id,
 )
 
-__all__ = ["AlertEngine", "AlertRule", "BurnRateRule", "Collector",
+__all__ = ["AlertEngine", "AlertRule", "BurnRateRule",
+           "CalibrationDriftRule", "CalibrationFactors",
+           "CalibrationLedger", "Collector",
            "ConservationRule", "Counter", "Counters", "CurveStore",
            "ExpositionError", "FleetView", "Gauge", "GoodputCollapseRule",
            "GoodputLedger", "Histogram", "JobInfo", "MetricsRegistry",
            "MetricsScraper", "Sample", "ScalingCurve", "ScrapeTarget",
            "TargetDownRule", "Tracer", "current_trace_id",
            "dump_flight_record", "get_counters", "get_logger",
-           "get_process_ledger", "get_registry", "get_tracer",
-           "iter_samples", "new_trace_id", "parse_exposition",
-           "profile_step", "render_fleet_dashboard",
-           "set_process_ledger", "set_trace_id"]
+           "get_process_calib", "get_process_ledger", "get_registry",
+           "get_tracer", "iter_samples", "load_factor", "load_factors",
+           "new_trace_id", "parse_exposition", "profile_step",
+           "render_calib_dashboard", "render_fleet_dashboard",
+           "set_process_calib", "set_process_ledger", "set_trace_id"]

@@ -592,6 +592,17 @@ class CurveStore:
                shape: str = "", mfu_pct: Optional[float] = None,
                max_samples: Optional[int] = None) -> None:
         """Fold a steady-state sample in, persist, refresh the gauges."""
+        # calibration (best-effort, no-op unarmed): what the curve
+        # PREDICTED this world size delivers — the number the goodput
+        # planner granted chips on — vs the steady-state window now
+        # measured at that size, paired BEFORE the sample folds in
+        pred = self.curve.tokens_per_second(world_size)
+        if pred is not None:
+            from edl_tpu.observability import calib
+
+            calib.record("goodput_curve", pred, tokens_per_second,
+                         unit="tok/s", job=self.job,
+                         world_size=world_size)
         self.curve.observe(world_size, tokens_per_second, shape=shape,
                            mfu_pct=mfu_pct, max_samples=max_samples)
         self._coord.kv_set(self.key, self.curve.to_json().encode())

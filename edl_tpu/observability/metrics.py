@@ -848,6 +848,16 @@ def _dump_flight_record_locked(dir_path, reason, slug, stamp, extra,
             doc["goodput"] = ledger.snapshot()
     except Exception:
         pass
+    # likewise the calibration ledger: the post-mortem for a drift alert
+    # includes exactly which predictor lied and by how much
+    try:
+        from edl_tpu.observability.calib import get_process_calib
+
+        calib = get_process_calib()
+        if calib is not None:
+            doc["calibration"] = calib.snapshot()
+    except Exception:
+        pass
     fd, tmp = tempfile.mkstemp(dir=dir_path, prefix=".flightrec-")
     with os.fdopen(fd, "w") as f:
         json.dump(doc, f)

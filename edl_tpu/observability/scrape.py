@@ -980,6 +980,11 @@ class FleetView:
         chips = s.latest("edl_serving_chips", labels) or 0
         drafted = s.delta("edl_decode_spec_drafted_total", w, labels)
         accepted = s.delta("edl_decode_spec_accepted_total", w, labels)
+        # prefix-share hit rate: windowed index hits over windowed
+        # session completions (the closest scrapeable admission proxy —
+        # in steady state every admitted session also completes)
+        prefix_hits = s.delta("edl_kv_prefix_hits_total", w, labels)
+        sessions_done = s.delta("edl_serving_sessions_total", w, labels)
         return FleetStats(
             p50_ms=round((p50 or 0.0) * 1000.0, 3),
             p99_ms=round((p99 or 0.0) * 1000.0, 3),
@@ -993,7 +998,9 @@ class FleetView:
             chips=int(chips),
             tok_s_per_chip=round(tps / chips, 2) if chips else 0.0,
             spec_accept_rate=round(accepted / drafted, 4) if drafted
-            else 0.0)
+            else 0.0,
+            prefix_hit_rate=round(prefix_hits / sessions_done, 4)
+            if sessions_done else 0.0)
 
     def stats_for(self, uid: str):
         """The :class:`ServingScaler` seam: ``stats_for=view.stats_for``
@@ -1061,6 +1068,39 @@ class FleetView:
             "primaries": s.latest("edl_coord_role_primary", agg="sum"),
         }
 
+    # -- calibration ---------------------------------------------------------
+
+    def calibration_summary(self) -> dict[str, dict[str, dict]]:
+        """Per-(job, predictor) calibration rollup from the scraped
+        ``edl_calibration_*`` series: the running measured/predicted
+        factor, total samples, and windowed error-pct quantiles — the
+        dashboard's "which cost model is lying" table."""
+        s = self.scraper
+        out: dict[str, dict[str, dict]] = {}
+        jobs = s.label_values("edl_calibration_factor", "job")
+        preds = s.label_values("edl_calibration_factor", "predictor")
+        for job in jobs:
+            for pred in preds:
+                labels = {"job": job, "predictor": pred}
+                factor = s.latest("edl_calibration_factor", labels,
+                                  agg="max")
+                if factor is None:
+                    continue  # this (job, predictor) pair never fired
+                n = s.latest("edl_calibration_samples_total", labels,
+                             agg="sum") or 0
+                p50 = s.histogram_quantile("edl_calibration_error_pct",
+                                           0.50, self.window_s, labels)
+                p99 = s.histogram_quantile("edl_calibration_error_pct",
+                                           0.99, self.window_s, labels)
+                out.setdefault(job, {})[pred] = {
+                    "factor": round(factor, 4), "samples": int(n),
+                    "error_pct_p50": (round(p50, 2) if p50 is not None
+                                      else None),
+                    "error_pct_p99": (round(p99, 2) if p99 is not None
+                                      else None),
+                }
+        return out
+
     def snapshot(self) -> dict:
         """Everything the dashboard renders, in one dict."""
         per_job = {}
@@ -1079,6 +1119,10 @@ class FleetView:
                 "chips": st.chips,
                 "tok_s_per_chip": st.tok_s_per_chip,
                 "spec_accept_rate": st.spec_accept_rate,
+                "kv_pct": (round(100.0 * st.kv_blocks_used
+                                 / st.kv_blocks_total, 1)
+                           if st.kv_blocks_total else 0.0),
+                "prefix_hit_rate": st.prefix_hit_rate,
             }
             gp = goodput.get(job)
             if gp:
@@ -1097,6 +1141,7 @@ class FleetView:
                       "replicas_active": fleet.replicas_active},
             "jobs": per_job,
             "goodput": goodput,
+            "calibration": self.calibration_summary(),
             "coord": self.coord_summary(),
             "targets": self.scraper.target_states(),
         }
@@ -1254,9 +1299,63 @@ class ConservationRule(AlertRule):
         return out
 
 
+class CalibrationDriftRule(AlertRule):
+    """A predictor whose running measured/predicted factor sat outside
+    ``[band_lo, band_hi]`` for ``windows`` CONSECUTIVE evaluations has a
+    cost model that is systematically lying — every decision priced on
+    it (resize grants, interleave budgets, scale plans) inherits the
+    bias.  Consecutive-window gating keeps one noisy sample (a cold
+    cache, a straggling host) from paging anyone; the factor is already
+    EWMA-smoothed underneath."""
+
+    def __init__(self, band_lo: float = 0.5, band_hi: float = 2.0,
+                 windows: int = 3, min_samples: int = 3) -> None:
+        self.band_lo = float(band_lo)
+        self.band_hi = float(band_hi)
+        self.windows = max(int(windows), 1)
+        self.min_samples = int(min_samples)
+        #: (job, predictor) → consecutive out-of-band evaluations
+        self._out: dict[tuple, int] = {}
+
+    def evaluate(self, view: FleetView) -> list[Alert]:
+        s = view.scraper
+        out: list[Alert] = []
+        seen: set[tuple] = set()
+        for job in s.label_values("edl_calibration_factor", "job"):
+            for pred in s.label_values("edl_calibration_factor",
+                                       "predictor"):
+                labels = {"job": job, "predictor": pred}
+                factor = s.latest("edl_calibration_factor", labels,
+                                  agg="max")
+                if factor is None:
+                    continue  # absent (job, predictor) combination
+                n = s.latest("edl_calibration_samples_total", labels,
+                             agg="sum") or 0
+                key = (job, pred)
+                seen.add(key)
+                outside = (n >= self.min_samples
+                           and not (self.band_lo <= factor
+                                    <= self.band_hi))
+                streak = self._out.get(key, 0) + 1 if outside else 0
+                self._out[key] = streak
+                out.append(Alert(
+                    rule="calibration_drift", labels=labels,
+                    firing=streak >= self.windows,
+                    value=round(factor, 4),
+                    detail=f"factor {factor:.2f} outside "
+                           f"[{self.band_lo:g}, {self.band_hi:g}] "
+                           f"for {streak} evaluation(s) "
+                           f"({int(n)} samples)"))
+        # a predictor whose series vanished (job GC'd) drops its streak
+        for key in list(self._out):
+            if key not in seen:
+                del self._out[key]
+        return out
+
+
 def default_rules() -> list[AlertRule]:
     return [BurnRateRule(), GoodputCollapseRule(), TargetDownRule(),
-            ConservationRule()]
+            ConservationRule(), CalibrationDriftRule()]
 
 
 class AlertEngine:
@@ -1374,13 +1473,16 @@ def render_fleet_dashboard(view: FleetView,
     if snap["jobs"]:
         lines.append("")
         rows = [("JOB", "QPS", "P50ms", "P99ms", "TTFTp99", "TOK/S",
-                 "TOK/S/CHIP", "SPEC%", "SESSIONS", "KV", "QUEUE",
-                 "REPLICAS", "GOODPUT", "SLOWEST-TRACE")]
+                 "TOK/S/CHIP", "SPEC%", "SESSIONS", "KV", "KV%",
+                 "PREFIX%", "QUEUE", "REPLICAS", "GOODPUT",
+                 "SLOWEST-TRACE")]
         for job, j in sorted(snap["jobs"].items()):
             gp = j.get("goodput")
             slow = j.get("slowest_trace")
             kv = j.get("kv_blocks", "0/0")
             spec = j.get("spec_accept_rate", 0.0)
+            kv_pct = j.get("kv_pct", 0.0)
+            pfx = j.get("prefix_hit_rate", 0.0)
             rows.append((job, f"{j['qps']:g}", f"{j['p50_ms']:g}",
                          f"{j['p99_ms']:g}",
                          (f"{j.get('ttft_p99_ms', 0):g}ms"
@@ -1392,6 +1494,8 @@ def render_fleet_dashboard(view: FleetView,
                          f"{spec:.1%}" if spec else "-",
                          str(j.get("sessions", 0)),
                          kv if kv != "0/0" else "-",
+                         f"{kv_pct:g}%" if kv_pct else "-",
+                         f"{pfx:.1%}" if pfx else "-",
                          str(j["queue"]), j["replicas"],
                          f"{gp:.2%}" if gp is not None else "-",
                          (f"{slow['latency_ms']:g}ms@{slow['trace_id']}"
@@ -1411,6 +1515,25 @@ def render_fleet_dashboard(view: FleetView,
                 f"{f'{frac:.2%}' if frac is not None else '-'}"
                 f"  world={g.get('world_size', '-')}"
                 f"  conservation_err={g.get('conservation_error_pct', '-')}%")
+    calib = snap.get("calibration") or {}
+    if calib:
+        lines.append("")
+        lines.append("CALIBRATION (factor = measured/predicted)")
+        crows = [("  JOB", "PREDICTOR", "FACTOR", "SAMPLES",
+                  "ERR%p50", "ERR%p99")]
+        for job, preds in sorted(calib.items()):
+            for pred, c in sorted(preds.items()):
+                crows.append((
+                    f"  {job}", pred, f"{c['factor']:g}",
+                    str(c["samples"]),
+                    (f"{c['error_pct_p50']:g}"
+                     if c["error_pct_p50"] is not None else "-"),
+                    (f"{c['error_pct_p99']:g}"
+                     if c["error_pct_p99"] is not None else "-")))
+        cw = [max(len(r[i]) for r in crows)
+              for i in range(len(crows[0]))]
+        lines += ["  ".join(c.ljust(w) for c, w in zip(r, cw)).rstrip()
+                  for r in crows]
     coord = snap["coord"]
     if coord.get("epoch") is not None or coord.get("members") is not None:
         lines.append("")
@@ -1436,4 +1559,50 @@ def render_fleet_dashboard(view: FleetView,
                 lines.append(f"  !! {a.rule}{{{lbl}}}  {a.detail}")
         else:
             lines.append("ALERTS: none firing")
+    return "\n".join(lines)
+
+
+def render_calib_dashboard(view: FleetView,
+                           engine: Optional[AlertEngine] = None) -> str:
+    """The ``edl-tpu calib`` verb's body: one row per (job, predictor)
+    — running measured/predicted factor, sample count, windowed
+    error-pct quantiles, and an in-band marker matching the drift
+    rule's default band — plus any firing calibration_drift alerts."""
+    calib = view.calibration_summary()
+    lines: list[str] = []
+    lines.append(f"CALIBRATION  (factor = measured/predicted, "
+                 f"window {view.window_s:g}s)")
+    if not calib:
+        lines.append("  no calibration series scraped "
+                     "(no armed ledger has recorded a sample)")
+    else:
+        rows = [("  JOB", "PREDICTOR", "FACTOR", "SAMPLES", "ERR%p50",
+                 "ERR%p99", "BAND")]
+        for job, preds in sorted(calib.items()):
+            for pred, c in sorted(preds.items()):
+                in_band = 0.5 <= c["factor"] <= 2.0
+                rows.append((
+                    f"  {job}", pred, f"{c['factor']:g}",
+                    str(c["samples"]),
+                    (f"{c['error_pct_p50']:g}"
+                     if c["error_pct_p50"] is not None else "-"),
+                    (f"{c['error_pct_p99']:g}"
+                     if c["error_pct_p99"] is not None else "-"),
+                    "ok" if in_band else "DRIFT"))
+        widths = [max(len(r[i]) for r in rows)
+                  for i in range(len(rows[0]))]
+        lines += ["  ".join(c.ljust(w) for c, w in zip(r, widths)).rstrip()
+                  for r in rows]
+    if engine is not None:
+        firing = [a for a in engine.firing()
+                  if a.rule == "calibration_drift"]
+        lines.append("")
+        if firing:
+            lines.append(f"CALIBRATION DRIFT FIRING ({len(firing)})")
+            for a in firing:
+                lbl = ",".join(f"{k}={v}" for k, v in
+                               sorted(a.labels.items()))
+                lines.append(f"  !! {a.rule}{{{lbl}}}  {a.detail}")
+        else:
+            lines.append("DRIFT: none firing")
     return "\n".join(lines)

@@ -259,6 +259,7 @@ def choose_shape(
     max_bytes_per_device: Optional[int] = None,
     base: Optional[MeshShape] = None,
     reserved_bytes_per_device: int = 0,
+    calibration=None,
 ) -> tuple[MeshShape, ReshardPlan]:
     """Pick the minimal-transfer axis assignment for an unconstrained
     resize to ``n_devices``.
@@ -275,7 +276,30 @@ def choose_shape(
     HBM exactly like params, and a plan that ignores it blesses layouts
     that OOM on the first decode after the resize.  Ties prefer the
     dp-dominant split (cheapest steady-state collectives: one grad
-    all-reduce, no param all-gathers)."""
+    all-reduce, no param all-gathers).
+
+    ``calibration`` (opt-in, the calibration plane's read-back hook) is
+    a :class:`~edl_tpu.observability.calib.CalibrationFactors`-shaped
+    object (``factor(predictor) -> float``) or a plain callable; when
+    supplied, candidates rank by PREDICTED RESHARD SECONDS — each
+    plan's per-path bytes over the nominal fabric bandwidth, scaled by
+    the persisted ``reshard_seconds`` measured/predicted factor —
+    instead of raw ``bytes_moved``, so a DCN-heavy split that moves
+    fewer bytes over a far slower path stops winning on byte count."""
+    est_seconds = None
+    if calibration is not None:
+        from edl_tpu.observability.calib import nominal_transfer_seconds
+
+        try:
+            f = float(calibration.factor("reshard_seconds")
+                      if hasattr(calibration, "factor")
+                      else calibration("reshard_seconds"))
+        except Exception:
+            f = 1.0
+        if not f > 0.0:
+            f = 1.0
+        est_seconds = lambda p: nominal_transfer_seconds(  # noqa: E731
+            p.bytes_ici, p.bytes_dcn) * f
     cands = list(candidates) if candidates is not None else candidate_shapes(
         n_devices, base=base)
     scored: list[tuple[tuple, MeshShape, ReshardPlan]] = []
@@ -285,7 +309,11 @@ def choose_shape(
         new_sh = tree_shardings(mesh, tree, sharding_kind)
         plan = plan_reshard(tree, old_shardings, new_sh,
                             old_shape=None, new_shape=shape)
-        rank = (plan.bytes_moved, -shape.dp, shape.key())
+        if est_seconds is not None:
+            rank = (est_seconds(plan), plan.bytes_moved, -shape.dp,
+                    shape.key())
+        else:
+            rank = (plan.bytes_moved, -shape.dp, shape.key())
         if (max_bytes_per_device is not None
                 and plan.max_device_bytes + reserved_bytes_per_device
                 > max_bytes_per_device):

@@ -338,10 +338,25 @@ class ElasticTrainer:
             (evt["replan_ms"] + evt["reshard_ms"]) / 1000.0,
             world_size=old_world)
         goodput.set_world_size(shape.size)
+        # calibration (best-effort; no-op without a process ledger):
+        # what replan.py PRICED the move at — planned bytes over the
+        # nominal per-path bandwidth — vs the reshard wall it actually
+        # took.  The resulting factor is the measured GB/s correction
+        # per transfer path (ROADMAP #1's bytes_ici-vs-reality audit).
+        from edl_tpu.observability import calib
+
+        calib.record(
+            "reshard_seconds",
+            calib.nominal_transfer_seconds(
+                evt["bytes_ici"], evt["bytes_dcn"],
+                host=evt["transfer"] == "host"),
+            evt["reshard_ms"] / 1000.0, unit="s",
+            path=evt["transfer"], shape=evt["shape"])
         log.info("mesh resized", world_size=shape.size,
                  shape=evt["shape"], replan_ms=evt["replan_ms"],
                  compile_ms=evt["compile_ms"], reshard_ms=evt["reshard_ms"],
                  bytes_moved=evt["bytes_moved"],
+                 reshard_gbps=evt["reshard_gbps"],
                  prewarm_hit=evt["prewarm_hit"], step=self.state.step)
         return True
 
@@ -878,6 +893,7 @@ class ElasticTrainer:
                                     bundle.opt_shardings)
             transfer = "host"
         t3 = time.perf_counter()
+        reshard_s = t3 - t2
         self._last_split = {
             # bundle-acquisition wall time: ~0 on a cache hit, the full
             # compile when built inline, the residual wait when a resize
@@ -887,10 +903,17 @@ class ElasticTrainer:
             "reshard_ms": round((t3 - t2) * 1000, 2),
             "prewarm_hit": bool(cached and bundle.source == "prewarm"),
             "shape": shape.describe(),
+            # the bytes_* fields are PLAN-DERIVED PREDICTIONS (replan.py
+            # prices the move on abstract shapes before it happens) —
+            # reshard_gbps is the only measured rate here: predicted
+            # bytes over the measured reshard wall, i.e. the effective
+            # bandwidth the move actually achieved on this path
             "bytes_moved": plan.bytes_moved,
             "bytes_ici": plan.bytes_ici,
             "bytes_dcn": plan.bytes_dcn,
             "bytes_naive": plan.bytes_naive,
+            "reshard_gbps": (round(plan.bytes_moved / reshard_s / 1e9, 3)
+                             if reshard_s > 0 else 0.0),
             "transfer": transfer,
         }
         return bundle, new_params, new_opt

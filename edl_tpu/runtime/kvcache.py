@@ -52,6 +52,7 @@ from __future__ import annotations
 
 import collections
 import threading
+import time
 from typing import Optional
 
 from edl_tpu.observability.collector import get_counters
@@ -704,7 +705,10 @@ class KVBlockPool:
                     f"{len(self._free) + len(self._cached_free)} free")
             self._sessions[sid] = self._alloc_locked(n)
             blocks = list(self._sessions[sid])
+        from edl_tpu.observability import calib
+
         try:
+            t0 = time.perf_counter()
             dst_sh = self.payload_shardings(n)
             if dst_sh is None:
                 dev = next(iter(
@@ -717,6 +721,12 @@ class KVBlockPool:
             else:
                 placed = {name: jax.device_put(a, dst_sh[name])
                           for name, a in payload.arrays.items()}
+            if calib.get_process_calib() is not None:
+                # only when calibration is armed: drain the async
+                # transfer so the wall below is the MOVE, not the
+                # dispatch.  The unarmed hot path stays fully async.
+                jax.block_until_ready(list(placed.values()))
+            move_s = time.perf_counter() - t0
             payload.plan = plan_reshard(
                 {n_: jax.ShapeDtypeStruct(a.shape, a.dtype)
                  for n_, a in payload.arrays.items()},
@@ -729,6 +739,14 @@ class KVBlockPool:
             self._c.inc("kv_migration_bytes",
                         int(payload.plan.bytes_total), job=self.job,
                         path="ici")
+            # calibration: the per-move bytes the plan priced (at the
+            # nominal ICI/DCN rate) vs the measured placement wall —
+            # the D2D-evacuation half of ROADMAP #1's bandwidth audit
+            calib.record(
+                "kv_move_seconds",
+                calib.nominal_transfer_seconds(payload.plan.bytes_ici,
+                                               payload.plan.bytes_dcn),
+                move_s, unit="s", job=self.job)
         except Exception:
             self.free_session(sid)
             raise

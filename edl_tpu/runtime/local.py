@@ -52,7 +52,12 @@ class RunReport:
     #: took to compute and how many bytes it said must move — the
     #: evidence that a shape change beat the gather-scatter bound
     resize_replan_ms: list[float] = field(default_factory=list)
+    #: plan-derived PREDICTION (replan.py priced it before the move);
+    #: resize_gbps below is the measured counterpart
     resize_bytes_moved: list[int] = field(default_factory=list)
+    #: measured per-resize transfer rate: planned bytes over the reshard
+    #: wall — the effective GB/s the move achieved, not a plan output
+    resize_gbps: list[float] = field(default_factory=list)
     prewarm_hits: int = 0
     #: steps spent training on the OLD world while the new world's bundle
     #: was still compiling (deferred resize — the zero-stall alternative
@@ -264,6 +269,7 @@ class LocalElasticJob:
                     report.resize_reshard_ms.append(evt["reshard_ms"])
                     report.resize_replan_ms.append(evt["replan_ms"])
                     report.resize_bytes_moved.append(evt["bytes_moved"])
+                    report.resize_gbps.append(evt.get("reshard_gbps", 0.0))
                     report.prewarm_hits += int(evt["prewarm_hit"])
                 if ok and self.prewarm_neighbors:
                     # next hop along the grow/shrink trace, compiled now
@@ -334,6 +340,7 @@ class LocalElasticJob:
             report.resize_reshard_ms.append(evt["reshard_ms"])
             report.resize_replan_ms.append(evt["replan_ms"])
             report.resize_bytes_moved.append(evt["bytes_moved"])
+            report.resize_gbps.append(evt.get("reshard_gbps", 0.0))
             report.prewarm_hits += int(evt["prewarm_hit"])
         #: the exactly-once evidence rides along for callers that know
         #: they ran virtually (rows_duplicated()/rows_missing())

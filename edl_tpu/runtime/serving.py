@@ -55,6 +55,7 @@ from typing import Any, Callable, Optional, Sequence
 
 import numpy as np
 
+from edl_tpu.observability import calib
 from edl_tpu.observability.collector import get_counters
 from edl_tpu.observability.logging import get_logger
 from edl_tpu.observability.metrics import SERVING_LATENCY_BUCKETS, get_registry
@@ -667,6 +668,9 @@ class FleetStats:
     chips: int = 0
     tok_s_per_chip: float = 0.0
     spec_accept_rate: float = 0.0
+    #: windowed prefix-share hit rate: prefix-index hits per session
+    #: admission (0 when sharing is off or the fleet is stateless)
+    prefix_hit_rate: float = 0.0
 
 
 class ServingFleet:
@@ -1507,6 +1511,17 @@ class TokenScheduler:
             return 64
         return min(max(int(-(-self._prefill_ms // headroom)), 1), 64)
 
+    def predicted_decode_ms(self) -> Optional[float]:
+        """The decode-iteration EWMA the interleave budget prices with
+        — read BEFORE note_decode() folds a new measurement in, it is
+        the scheduler's prediction for that iteration (the calibration
+        plane pairs the two)."""
+        return self._decode_ms
+
+    def predicted_prefill_ms(self) -> Optional[float]:
+        """Prefill-chunk counterpart of :meth:`predicted_decode_ms`."""
+        return self._prefill_ms
+
     def note_decode(self, ms: Optional[float] = None) -> None:
         self._decode_since_prefill += 1
         if ms is not None:
@@ -1588,6 +1603,11 @@ class DecodeReplica:
         self.spec_ngram = max(int(spec_ngram), 1)
         self.spec_drafted = 0
         self.spec_accepted = 0
+        #: the drafter's running acceptance prediction: EWMA of tokens
+        #: emitted per verify step (accepted drafts + the one guaranteed
+        #: real token, so it is never zero and ratios stay defined) —
+        #: what the calibration plane audits against realized accepts
+        self.spec_accept_ewma: Optional[float] = None
         self.sched = scheduler or TokenScheduler()
         self.on_handoff = on_handoff
         self.on_session_done = on_session_done
@@ -2104,18 +2124,29 @@ class DecodeReplica:
             try:
                 if self.sched.allow_prefill(len(decoding), len(prefilling)):
                     sess = self.sched.pick_prefill(prefilling)
+                    pred_ms = self.sched.predicted_prefill_ms()
                     t0 = time.perf_counter()
                     self._prefill_one(sess, llama, jax, np)
-                    self.sched.note_prefill(
-                        (time.perf_counter() - t0) * 1e3)
+                    ms = (time.perf_counter() - t0) * 1e3
+                    self.sched.note_prefill(ms)
+                    # calibration: the EWMA the interleave budget just
+                    # priced this chunk at vs what the chunk took (None
+                    # until the first sample — nothing to audit yet)
+                    if pred_ms is not None:
+                        calib.record("interleave_prefill_ms", pred_ms,
+                                     ms, unit="ms", job=self.job)
                 else:
+                    pred_ms = self.sched.predicted_decode_ms()
                     t0 = time.perf_counter()
                     if self.spec_tokens >= 2:
                         self._decode_all_spec(decoding, llama, jax, np)
                     else:
                         self._decode_all(decoding, llama, jax, np)
-                    self.sched.note_decode(
-                        (time.perf_counter() - t0) * 1e3)
+                    ms = (time.perf_counter() - t0) * 1e3
+                    self.sched.note_decode(ms)
+                    if pred_ms is not None:
+                        calib.record("interleave_decode_ms", pred_ms,
+                                     ms, unit="ms", job=self.job)
             except Exception as exc:
                 log.error("decode iteration failed", replica=self.name,
                           error=str(exc)[:200])
@@ -2299,6 +2330,7 @@ class DecodeReplica:
         rows = np.asarray(logits)  # [S, K, vocab]
         self.decode_iterations += 1
         self._counters.inc("decode_spec_steps", job=self.job)
+        step_emitted = 0
         for sess in decoding:
             feed = feeds[sess.id]
             n = len(feed)
@@ -2316,6 +2348,7 @@ class DecodeReplica:
             self._spec_hist.observe(accepted, job=self.job, priority=pri)
             self.spec_drafted += n - 1
             self.spec_accepted += accepted
+            step_emitted += accepted + 1
             # the valid K/V frontier: feed[0..accepted] are real history
             sess.cached += accepted + 1
             for tok in emitted:
@@ -2335,6 +2368,17 @@ class DecodeReplica:
                         pass
                 if self._check_finished(sess):
                     break  # EOS/max_new truncates the accepted tail
+        # calibration: the drafter's acceptance EWMA (what the replica
+        # believed a verify step was worth before paying for it) vs this
+        # step's realized mean emitted tokens per session
+        realized = step_emitted / max(len(decoding), 1)
+        if self.spec_accept_ewma is not None:
+            calib.record("spec_accept", self.spec_accept_ewma, realized,
+                         unit="tokens/step", job=self.job)
+        self.spec_accept_ewma = (realized
+                                 if self.spec_accept_ewma is None
+                                 else 0.2 * realized
+                                 + 0.8 * self.spec_accept_ewma)
 
     def _check_finished(self, sess: DecodeSession) -> bool:
         """Finished sequences free their slot (and blocks) IMMEDIATELY

@@ -579,6 +579,14 @@ class ServingScaler:
         self._last_change: dict[str, float] = {}
         self._targets: dict[str, int] = {}
         self.plan_history: list[dict] = []
+        #: uid → the last plan's post-scale PREDICTION ({target, t,
+        #: pred_qps, pred_p99}), resolved against the realized window
+        #: once the fleet has settled at the target (calibration plane)
+        self._pending_calib: dict[str, dict] = {}
+        #: how long after a plan the fleet must sit at the target before
+        #: its window counts as the plan's realized outcome (p99 windows
+        #: need post-resize requests, not the breach that triggered it)
+        self.calib_settle_s = 2 * loop_seconds
         #: fires (uid, target_replicas) the moment a plan is decided,
         #: BEFORE actuation — wire to ServingFleet.hint (in-process) or
         #: to whatever warms pods in a deployment.  Exceptions are
@@ -611,6 +619,7 @@ class ServingScaler:
         self._last_change.pop(job.full_name, None)
         self._targets.pop(job.full_name, None)
         self._curve_stores.pop(job.full_name, None)
+        self._pending_calib.pop(job.full_name, None)
         self.observe_only.discard(job.full_name)
         from edl_tpu.observability.metrics import get_registry
 
@@ -686,6 +695,7 @@ class ServingScaler:
                     continue
             current = self._current(uid, job, stats)
             self._record_capacity(uid, job, stats, current)
+            self._resolve_calib(uid, stats, current, now)
             target = self.decide(job, stats, current)
             if target is None:
                 continue
@@ -754,6 +764,36 @@ class ServingScaler:
             log.warn("serving capacity curve record failed", job=uid,
                      error=str(exc)[:200])
 
+    def _resolve_calib(self, uid: str, stats, current: int,
+                       now: float) -> None:
+        """Close the loop on the last plan's prediction: once the fleet
+        has SETTLED at the planned target (settle window elapsed, a
+        realized request window exists), pair the plan's predicted
+        post-scale qps/p99 with what the window measured.  A superseded
+        or never-reached target resolves to nothing — a prediction
+        scored against a different fleet size calibrates nothing."""
+        pend = self._pending_calib.get(uid)
+        if pend is None or stats is None:
+            return
+        age = now - pend["t"]
+        if age < self.calib_settle_s:
+            return
+        if current != pend["target"] or age > 20 * self.calib_settle_s:
+            self._pending_calib.pop(uid, None)
+            return
+        if stats.requests_windowed == 0:
+            return  # settled but idle: keep waiting for a real window
+        from edl_tpu.observability import calib
+
+        if pend.get("pred_qps"):
+            calib.record("serving_scale_qps", pend["pred_qps"],
+                         getattr(stats, "qps", 0.0), unit="qps", job=uid)
+        if pend.get("pred_p99"):
+            calib.record("serving_scale_p99", pend["pred_p99"],
+                         getattr(stats, "p99_ms", 0.0), unit="ms",
+                         job=uid)
+        self._pending_calib.pop(uid, None)
+
     def _current(self, uid: str, job, stats) -> int:
         if stats is not None and getattr(stats, "replicas_active", 0):
             return stats.replicas_active
@@ -780,6 +820,24 @@ class ServingScaler:
             "job": uid, "from": current, "target": target,
             "p99_ms": getattr(stats, "p99_ms", None),
             "qps": getattr(stats, "qps", None)})
+        # calibration: stash what this plan PREDICTS the post-scale
+        # window looks like.  Post-scale qps: the measured capacity
+        # curve at the target when growing into known capacity, else
+        # demand carryover (a resize does not change offered load).
+        # Post-scale p99: the SLO the plan promises to restore (that IS
+        # the scaler's latency model).  Resolved by _resolve_calib.
+        pred_qps = None
+        store = self._curve_stores.get(uid)
+        if store is not None and target > current:
+            try:
+                pred_qps = store.curve.tokens_per_second(target)
+            except Exception:
+                pred_qps = None
+        if not pred_qps:
+            pred_qps = getattr(stats, "qps", None)
+        self._pending_calib[uid] = {
+            "target": target, "t": now, "pred_qps": pred_qps,
+            "pred_p99": job.spec.slo_p99_ms or None}
         get_counters().inc("autoscaler_serving_plans", direction=direction)
         get_registry().gauge(
             "serving_target_replicas",

@@ -473,13 +473,19 @@ class GoodputPlan:
 
 
 def _step_marginal(curve, n_to: int, chips_per_instance: int,
-                   prior: float) -> float:
+                   prior: float, calib_factor: float = 1.0) -> float:
     """Price one up-step ending at ``n_to`` instances: the curve's
     marginal tok/s per chip read at the nearest measured size (the slope
     of the last measured step rules beyond the measured range — linear
     extrapolation; the smallest measured point's average rules below
     it), normalized by this job's chips per instance.  No curve → the
-    optimistic prior."""
+    optimistic prior.
+
+    ``calib_factor`` is the calibration plane's measured/predicted
+    correction for curve-derived predictions (the ``goodput_curve``
+    factor): it scales ONLY the measured branch — the optimistic prior
+    is a deliberate exploration bonus, not a curve prediction, and
+    correcting it would just rename the prior."""
     if curve is None:
         return prior
     try:
@@ -491,7 +497,7 @@ def _step_marginal(curve, n_to: int, chips_per_instance: int,
         return prior
     if m is None:
         return prior
-    return m / max(chips_per_instance, 1)
+    return m / max(chips_per_instance, 1) * calib_factor
 
 
 def scale_all_jobs_goodput(
@@ -501,6 +507,7 @@ def scale_all_jobs_goodput(
     curves: Optional[Callable[[str], object]] = None,
     optimistic_prior: float = OPTIMISTIC_PRIOR,
     rebalance_headroom: float = REBALANCE_HEADROOM,
+    calibration=None,
 ) -> GoodputPlan:
     """The marginal-goodput allocator: grant (and reclaim) chips by
     descending measured marginal-throughput-per-chip, under priorities,
@@ -537,6 +544,13 @@ def scale_all_jobs_goodput(
     Degraded mode: when NO job resolves a measured curve there is
     nothing to price by, and the plan falls back to
     :func:`scale_all_jobs_dry_run` bit-for-bit (``mode="degraded"``).
+
+    ``calibration`` (opt-in, the calibration plane's read-back hook) is
+    a :class:`~edl_tpu.observability.calib.CalibrationFactors`-shaped
+    object (``factor(predictor) -> float``) or a plain callable; when
+    supplied, curve-derived marginals are scaled by the persisted
+    ``goodput_curve`` measured/predicted factor, so an optimistic curve
+    (factor < 1) stops over-granting before the curve itself re-learns.
     """
     jobs = list(jobs)
     resolved: dict[str, object] = {}
@@ -587,12 +601,26 @@ def scale_all_jobs_goodput(
     # takes the curve's lock and walks its cells
     _price_cache: dict[tuple[str, int], float] = {}
 
+    # the read-back factor is resolved ONCE per plan (one KV-backed
+    # lookup, not one per candidate re-price) and degrades to neutral
+    calib_factor = 1.0
+    if calibration is not None:
+        try:
+            calib_factor = float(
+                calibration.factor("goodput_curve")
+                if hasattr(calibration, "factor")
+                else calibration("goodput_curve"))
+        except Exception:
+            calib_factor = 1.0
+        if not calib_factor > 0.0:
+            calib_factor = 1.0
+
     def step_marginal(j: PlannedJob, n_to: int) -> float:
         key = (j.uid, n_to)
         m = _price_cache.get(key)
         if m is None:
             m = _step_marginal(resolved[j.uid], n_to, j.tpu_chip_limit(),
-                               optimistic_prior)
+                               optimistic_prior, calib_factor)
             _price_cache[key] = m
         return m
 

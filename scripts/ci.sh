@@ -733,6 +733,160 @@ print("decode smoke OK:", {"sessions": fleet.sessions_completed,
                            "prefix_hits": int(hits)})
 EOF
 
+echo "== calib smoke (predicted-vs-measured ledger across the cost models)"
+# Calibration plane end-to-end (doc/observability.md §calibration
+# plane): with the process ledger armed against a coordinator, a
+# dp→fsdp trainer resize, a speculative DecodeFleet scaled 2→1
+# mid-decode (KV evacuation between distinct devices), a goodput-curve
+# re-record and a settled serving scale plan must each land ≥1
+# predicted-vs-measured sample on their predictor; every
+# edl_calibration_* series passes the strict parser; the factor
+# records read back from coordinator KV (calib/<job>/<predictor>) and
+# through the CalibrationFactors hook; the drift alert stays QUIET
+# (consecutive-window + min-sample gating — the negative control);
+# and `edl-tpu calib` renders a non-empty dashboard off a live
+# /metrics endpoint.
+JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=4" \
+python - <<'EOF'
+import contextlib, io
+
+import jax, numpy as np, optax
+
+from edl_tpu import cli
+from edl_tpu.api.types import ServingJob, ServingSpec
+from edl_tpu.coord import PyCoordService
+from edl_tpu.models import mlp
+from edl_tpu.models.transformer import TINY, init
+from edl_tpu.observability import calib
+from edl_tpu.observability.calib import (CalibrationFactors,
+                                         CalibrationLedger, load_factors)
+from edl_tpu.observability.goodput import CurveStore
+from edl_tpu.observability.health import serve_health
+from edl_tpu.observability.metrics import get_registry, parse_exposition
+from edl_tpu.parallel.mesh import MeshShape, MeshSpec
+from edl_tpu.runtime.elastic import ElasticTrainer
+from edl_tpu.runtime.serving import DecodeFleet, FleetStats
+from edl_tpu.scheduler.autoscaler import ServingScaler
+
+JOB = "ci/calib"
+kv = PyCoordService()
+led = calib.set_process_calib(CalibrationLedger(job=JOB, coord=kv))
+try:
+    # 1. trainer resize, dp2 -> dp2xfsdp2: the reshard_seconds predictor
+    #    (nominal-bandwidth transfer price vs the measured reshard wall)
+    params = mlp.init(jax.random.key(0), [16, 32, 4])
+    tr = ElasticTrainer(mlp.loss_fn, params, optax.adam(1e-2),
+                        spec=MeshSpec(dp=-1), initial_world_size=2)
+    rng = np.random.default_rng(0)
+    batch = (rng.normal(size=(64, 16)).astype(np.float32),
+             rng.integers(0, 4, 64).astype(np.int32))
+    tr.step(batch)
+    assert tr.resize(MeshShape(dp=2, fsdp=2)), "dp->fsdp resize failed"
+    tr.step(batch)
+    assert led.sample_count("reshard_seconds") >= 1, led.snapshot()
+
+    # 2. decode-evacuation drill: speculative sessions through a live
+    #    2->1 shrink -- kv_move_seconds, spec_accept and the interleave
+    #    budget predictors all fire on the way
+    tparams = init(jax.random.PRNGKey(0), TINY)
+    prng = np.random.default_rng(7)
+    ps = [prng.integers(1, 255, size=int(prng.integers(4, 10))).tolist()
+          for _ in range(4)]
+    ps += [[11, 4, 11, 4, 11, 4, 11, 4]] * 2   # periodic: drafts accept
+    fleet = DecodeFleet(tparams, TINY, job=JOB, roles={"decode": 2},
+                        slots=3, prefill_chunk=8, kv_blocks=48,
+                        kv_block_size=8, max_blocks_per_session=8,
+                        spec_tokens=4, spec_ngram=3,
+                        devices_per_replica=1)
+    try:
+        ss = [fleet.submit(p, max_new_tokens=16) for p in ps]
+        for s in ss[:2]:
+            s.wait_first_token(60)     # mid-decode...
+        fleet.scale_to(1)              # ...KV evacuates to the survivor
+        for s in ss:
+            s.wait(120)
+    finally:
+        fleet.stop(drain=False)
+    assert fleet.sessions_failed == 0, "scale-down dropped sessions"
+    assert fleet.migrations >= 1, "shrink never migrated a session"
+    for pred in ("kv_move_seconds", "spec_accept",
+                 "interleave_decode_ms", "interleave_prefill_ms"):
+        assert led.sample_count(pred) >= 1, (pred, led.snapshot())
+
+    # 3. goodput curve: the second window at a measured size pairs the
+    #    curve's prediction against the realized tok/s
+    store = CurveStore(kv, JOB)
+    store.record(2, 1000.0)
+    store.record(2, 950.0)
+    assert led.sample_count("goodput_curve") >= 1
+
+    # 4. serving scale plan, settled at target: the stashed qps/p99
+    #    predictions resolve against the realized window
+    clock = [100.0]
+    stats = {"default/svc": FleetStats(
+        p50_ms=30.0, p99_ms=80.0, qps=10.0, queue_depth=0,
+        replicas_ready=2, replicas_active=2, requests_windowed=20)}
+    sc = ServingScaler(stats_for=lambda uid: stats[uid],
+                       actuate=lambda uid, n: None,
+                       clock=lambda: clock[0])
+    sc.on_add(ServingJob(name="svc", spec=ServingSpec(
+        min_replicas=1, max_replicas=8, slo_p99_ms=50.0)))
+    assert sc.tick() == {"default/svc": 3}  # breach -> plan to 3
+    stats["default/svc"] = FleetStats(
+        p50_ms=10.0, p99_ms=30.0, qps=12.0, queue_depth=0,
+        replicas_ready=3, replicas_active=3, requests_windowed=25)
+    clock[0] += sc.calib_settle_s + 1.0
+    sc.tick()
+    assert led.sample_count("serving_scale_qps") >= 1
+    assert led.sample_count("serving_scale_p99") >= 1
+finally:
+    calib.set_process_calib(None)
+
+# every instrumented predictor landed, and the whole exposition holds
+# under the strict parser
+series = parse_exposition(get_registry().render())
+PREDICTORS = ("reshard_seconds", "kv_move_seconds", "spec_accept",
+              "interleave_decode_ms", "interleave_prefill_ms",
+              "serving_scale_qps", "serving_scale_p99", "goodput_curve")
+for pred in PREDICTORS:
+    assert any(k.startswith("edl_calibration_samples_total")
+               and f'predictor="{pred}"' in k
+               for k in series), f"no scraped series for {pred}"
+    assert any(k.startswith("edl_calibration_factor")
+               and f'predictor="{pred}"' in k
+               for k in series), f"no factor gauge for {pred}"
+
+# factor records persisted under calib/<job>/<predictor> and readable
+# through the opt-in CalibrationFactors hook
+docs = load_factors(kv, JOB)
+for pred in ("reshard_seconds", "kv_move_seconds"):
+    assert pred in docs and docs[pred]["factor"] > 0, sorted(docs)
+facs = CalibrationFactors(kv, JOB, min_samples=1)
+assert facs.factor("reshard_seconds") > 0
+
+# `edl-tpu calib` off a live /metrics endpoint: non-empty dashboard,
+# and --check exits 0 -- the drift rule's consecutive-window gating
+# keeps one noisy window from paging (the negative control)
+srv = serve_health(0, {}, host="127.0.0.1")
+buf = io.StringIO()
+try:
+    port = srv.server_address[1]
+    with contextlib.redirect_stdout(buf):
+        rc = cli.main(["calib", "--scrape-targets", f"127.0.0.1:{port}",
+                       "--sweeps", "1", "--check"])
+finally:
+    srv.shutdown()
+out = buf.getvalue()
+assert rc == 0, f"calib --check paged on a healthy fleet:\n{out}"
+for pred in ("reshard_seconds", "kv_move_seconds", "goodput_curve"):
+    assert pred in out, out
+assert "DRIFT: none firing" in out, out
+snap = led.snapshot()["predictors"]
+print("calib smoke OK:", {p: (snap[p]["samples"],
+                              round(snap[p]["factor"], 2))
+                          for p in PREDICTORS})
+EOF
+
 echo "== scrape-plane smoke (HA pair + serving fleet under the MetricsScraper)"
 # The fleet scrape plane end-to-end (doc/observability.md §scrape-plane):
 # an HA coordinator pair and a live serving fleet are discovered/scraped

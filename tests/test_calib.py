@@ -1,0 +1,627 @@
+"""Calibration plane tests (edl_tpu/observability/calib.py).
+
+Covers the ledger core (EWMA factor, bounded sample rings,
+zero-prediction accounting, strict exposition of every
+``edl_calibration_*`` series), KV persistence + the job-GC sweep of
+``calib/``, the CalibrationFactors read-back hook (caching, clamps,
+min-sample gating, dead-coordinator neutrality), the opt-in calibrated
+paths in ``choose_shape`` and the goodput allocator, the drift alert
+rule fire/resolve cycle, the dashboard/CLI rendering, the cheap
+instrumentation sites (trainer resize, scaler plan resolution, goodput
+curve), and the HA failover acceptance property (factors readable from
+a promoted standby after a primary SIGKILL).  The heavy decode-plane
+predictors (kv_move_seconds, spec_accept, interleave_*) are exercised
+end-to-end by the CI calib smoke and the bench calibration leg.
+"""
+
+from __future__ import annotations
+
+import signal
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from edl_tpu.observability import calib
+from edl_tpu.observability.calib import (
+    CalibrationFactors,
+    CalibrationLedger,
+    load_factor,
+    load_factors,
+    nominal_transfer_seconds,
+    set_process_calib,
+)
+from edl_tpu.observability.metrics import MetricsRegistry, parse_exposition
+from edl_tpu.observability.scrape import (
+    AlertEngine,
+    CalibrationDriftRule,
+    FleetView,
+    default_rules,
+    render_calib_dashboard,
+    render_fleet_dashboard,
+)
+from tests.test_scrape import make_scraper
+
+
+@pytest.fixture(autouse=True)
+def _no_process_ledger():
+    """Every test starts and ends with the process ledger disarmed —
+    an armed ledger left behind would leak records into whichever test
+    resizes a trainer next."""
+    set_process_calib(None)
+    yield
+    set_process_calib(None)
+
+
+def ledger(**kw):
+    kw.setdefault("job", "ns/job")
+    kw.setdefault("registry", MetricsRegistry())
+    return CalibrationLedger(**kw)
+
+
+# ---------------------------------------------------------------------------
+# ledger core
+# ---------------------------------------------------------------------------
+
+
+def test_record_pairs_prediction_with_measurement():
+    led = ledger()
+    err = led.record("reshard_seconds", 2.0, 3.0, unit="s")
+    assert err == pytest.approx(50.0)
+    assert led.factor("reshard_seconds") == pytest.approx(1.5)
+    assert led.sample_count("reshard_seconds") == 1
+    # second sample moves the EWMA alpha of the way toward its factor
+    led.record("reshard_seconds", 2.0, 2.0)
+    assert led.factor("reshard_seconds") == pytest.approx(
+        0.1 * 1.0 + 0.9 * 1.5)
+    snap = led.snapshot()["predictors"]["reshard_seconds"]
+    assert snap["samples"] == 2 and snap["unit"] == "s"
+    assert snap["last_predicted"] == 2.0 and snap["last_measured"] == 2.0
+    assert snap["error_pct_p50"] in (0.0, 50.0)  # exact over the ring
+    assert led.predictors() == ["reshard_seconds"]
+    assert led.factor("never_recorded") is None
+
+
+def test_sample_ring_is_bounded_but_counters_are_not():
+    led = ledger(ring_size=4)
+    for i in range(10):
+        led.record("p", 1.0, 1.0 + i)  # error i*100%
+    assert led.sample_count("p") == 10
+    ring = led.samples("p")
+    assert len(ring) == 4
+    # the ring holds the RECENT pairs (measured 7..10)
+    assert [m for _, m, _ in ring] == [7.0, 8.0, 9.0, 10.0]
+    # quantiles answer over the ring window, not lifetime
+    assert led.error_pct_quantile("p", 0.0) == pytest.approx(600.0)
+    assert led.error_pct_quantile("p", 0.99) == pytest.approx(900.0)
+    assert led.error_pct_quantile("q", 0.5) is None
+
+
+def test_zero_predictions_counted_never_divided():
+    led = ledger()
+    assert led.record("p", 0.0, 5.0) is None
+    assert led.record("p", -1.0, 5.0) is None
+    assert led.record("p", float("nan"), 5.0) is None
+    assert led.record("p", 1.0, float("nan")) is None
+    assert led.factor("p") is None and led.sample_count("p") == 0
+    snap = led.snapshot()["predictors"]["p"]
+    assert snap["zero_predictions"] == 4 and snap["factor"] is None
+    # a later honest prediction still calibrates
+    assert led.record("p", 1.0, 2.0) == pytest.approx(100.0)
+    assert led.factor("p") == pytest.approx(2.0)
+
+
+def test_exposition_is_strictly_parseable_with_all_series():
+    reg = MetricsRegistry()
+    led = ledger(registry=reg)
+    led.record("reshard_seconds", 1.0, 2.0, unit="s")
+    led.record("goodput_curve", 100.0, 90.0, unit="tok/s")
+    led.record("goodput_curve", 0.0, 90.0)  # zero-prediction
+    text = reg.render()
+    series = parse_exposition(text)  # strict parse: raises on violations
+    names = {key.split("{", 1)[0] for key in series}
+    assert "edl_calibration_samples_total" in names
+    assert "edl_calibration_factor" in names
+    assert "edl_calibration_error_pct_bucket" in names
+    assert "edl_calibration_zero_predictions_total" in names
+    assert ('edl_calibration_factor{job="ns/job",'
+            'predictor="reshard_seconds"} 2') in text
+    assert ('edl_calibration_zero_predictions_total{job="ns/job",'
+            'predictor="goodput_curve"} 1') in text
+
+
+def test_process_ledger_helpers_are_safe_unarmed_and_armed():
+    # unarmed: the module helper is a strict no-op
+    calib.record("p", 1.0, 2.0)
+    assert calib.get_process_calib() is None
+    led = set_process_calib(ledger())
+    assert calib.get_process_calib() is led
+    calib.record("p", 1.0, 2.0)
+    assert led.sample_count("p") == 1
+    # a bad pair must never raise out of an instrumented hot path
+    calib.record("p", "not-a-number", 2.0)
+    assert led.sample_count("p") == 1
+
+
+def test_nominal_transfer_seconds_prices_each_path():
+    assert nominal_transfer_seconds(90e9) == pytest.approx(1.0)
+    assert nominal_transfer_seconds(0.0, 6.25e9) == pytest.approx(1.0)
+    # host fallback: both byte counts ride the host fabric
+    assert nominal_transfer_seconds(4e9, 4e9, host=True) == pytest.approx(
+        1.0)
+    assert nominal_transfer_seconds(0.0) == 0.0
+
+
+# ---------------------------------------------------------------------------
+# KV persistence + GC + read-back
+# ---------------------------------------------------------------------------
+
+
+def test_factor_record_roundtrip_on_py_backend():
+    from edl_tpu.coord import PyCoordService
+
+    svc = PyCoordService()
+    led = ledger(coord=svc)
+    led.record("reshard_seconds", 1.0, 2.0, unit="s", path="ici")
+    assert svc.kv_get("calib/ns/job/reshard_seconds") is not None
+    doc = load_factor(svc, "ns/job", "reshard_seconds")
+    assert doc["factor"] == pytest.approx(2.0)
+    assert doc["n"] == 1 and doc["unit"] == "s"
+    assert doc["labels"] == {"path": "ici"}
+    assert load_factor(svc, "ns/job", "nope") is None
+    led.record("kv_move_seconds", 1.0, 1.5)
+    assert set(load_factors(svc, "ns/job")) == {"reshard_seconds",
+                                                "kv_move_seconds"}
+    assert load_factors(svc, "other/job") == {}
+
+
+def test_calib_prefix_swept_on_job_deletion():
+    from edl_tpu.coord import PyCoordService
+    from edl_tpu.coord.gc import JOB_KV_PREFIXES, gc_job_kv
+
+    assert "calib/" in JOB_KV_PREFIXES
+    svc = PyCoordService()
+    doomed = ledger(job="ns/doomed", coord=svc)
+    doomed.record("reshard_seconds", 1.0, 2.0)
+    doomed.record("goodput_curve", 10.0, 9.0)
+    sibling = ledger(job="ns/doomedx", coord=svc)  # prefix-adjacent uid
+    sibling.record("reshard_seconds", 1.0, 2.0)
+    removed = gc_job_kv(svc, "ns/doomed")
+    assert removed == 2
+    assert load_factors(svc, "ns/doomed") == {}
+    # the adjacent job's record survives — the sweep is uid-exact
+    assert set(load_factors(svc, "ns/doomedx")) == {"reshard_seconds"}
+
+
+def test_factors_readback_caches_gates_and_clamps():
+    from edl_tpu.coord import PyCoordService
+
+    svc = PyCoordService()
+    led = ledger(job="j", coord=svc)
+    for _ in range(3):
+        led.record("honest", 1.0, 3.0)
+    led.record("thin", 1.0, 5.0)  # one sample only
+    for _ in range(3):
+        led.record("wild", 1.0, 1000.0)
+    clock = [0.0]
+    cf = CalibrationFactors(svc, "j", refresh_s=10.0,
+                            clock=lambda: clock[0])
+    assert cf.factor("honest") == pytest.approx(3.0)
+    assert cf.scale("honest", 10.0) == pytest.approx(30.0)
+    # below min_samples and unknown predictors answer neutral
+    assert cf.factor("thin") == 1.0
+    assert cf.factor("missing") == 1.0
+    # a wild record clamps instead of multiplying estimates by 1000
+    assert cf.factor("wild") == 20.0
+    # the cache holds inside refresh_s: new KV state is invisible...
+    for _ in range(3):
+        led.record("late", 1.0, 2.0)
+    assert cf.factor("late") == 1.0
+    clock[0] = 11.0  # ...and one refresh later it is
+    assert cf.factor("late") == pytest.approx(2.0)
+
+
+def test_factors_readback_neutral_on_dead_coordinator():
+    class Dead:
+        def kv_keys(self, prefix):
+            raise ConnectionError("coordinator unreachable")
+
+        def kv_get(self, key):
+            raise ConnectionError("coordinator unreachable")
+
+    cf = CalibrationFactors(Dead(), "j")
+    assert cf.factor("anything") == 1.0
+    assert cf.scale("anything", 7.0) == 7.0
+
+
+# ---------------------------------------------------------------------------
+# the opt-in calibrated estimate paths
+# ---------------------------------------------------------------------------
+
+
+def test_choose_shape_accepts_calibration_and_stays_neutral_at_one():
+    from edl_tpu.parallel.mesh import make_mesh, tree_shardings
+    from edl_tpu.parallel.replan import choose_shape
+
+    devs = jax.devices()[:4]
+    tree = {"w": jnp.zeros((16, 32)), "b": jnp.zeros((4,))}
+    from edl_tpu.parallel.mesh import MeshShape
+
+    mesh = make_mesh(4, MeshShape(dp=4).to_spec(), devices=devs)
+    sh0 = tree_shardings(mesh, tree, "fsdp")
+    base_shape, base_plan = choose_shape(tree, sh0, 4, devs, "fsdp")
+    asked: list[str] = []
+
+    def factors(predictor):
+        asked.append(predictor)
+        return 1.0
+
+    shape, plan = choose_shape(tree, sh0, 4, devs, "fsdp",
+                               calibration=factors)
+    # a neutral factor must not change the choice, and the hook reads
+    # the reshard_seconds predictor (the factor the trainer records)
+    assert shape == base_shape and plan.bytes_moved == base_plan.bytes_moved
+    assert asked == ["reshard_seconds"]
+
+    def broken(predictor):
+        raise RuntimeError("kv down")
+
+    shape2, _ = choose_shape(tree, sh0, 4, devs, "fsdp",
+                             calibration=broken)
+    assert shape2 == base_shape  # exception degrades to neutral
+
+    class FactorsShaped:
+        def factor(self, predictor):
+            asked.append(f"obj:{predictor}")
+            return 1.0
+
+    shape3, _ = choose_shape(tree, sh0, 4, devs, "fsdp",
+                             calibration=FactorsShaped())
+    assert shape3 == base_shape
+    assert asked[-1] == "obj:reshard_seconds"
+
+
+def test_goodput_step_marginal_scales_only_the_measured_branch():
+    from edl_tpu.observability.goodput import ScalingCurve
+    from edl_tpu.scheduler.planner import _step_marginal
+
+    c = ScalingCurve()
+    c.observe(2, 100.0)
+    c.observe(4, 180.0)
+    assert _step_marginal(c, 4, 1, 0.0) == pytest.approx(40.0)
+    assert _step_marginal(c, 4, 1, 0.0, calib_factor=0.5) == \
+        pytest.approx(20.0)
+    # the optimistic prior is an exploration bonus, not a curve
+    # prediction: the factor must not rename it
+    assert _step_marginal(None, 4, 1, 123.0, calib_factor=0.5) == 123.0
+    assert _step_marginal(ScalingCurve(), 4, 1, 77.0,
+                          calib_factor=0.5) == 77.0
+
+
+def test_goodput_allocator_threads_the_calibration_factor():
+    from tests.test_sched_goodput import curve, curves_for, \
+        one_domain_cluster, priced_job
+
+    from edl_tpu.scheduler.planner import scale_all_jobs_goodput
+
+    def jobs():
+        return [priced_job("a", 1, 0, 4, 0)]
+
+    cv = curves_for({"default/a": curve({1: 100.0, 2: 200.0, 4: 400.0})})
+    base = scale_all_jobs_goodput(jobs(), one_domain_cluster(1, 4), 1.0,
+                                  curves=cv)
+    assert base.marginals["default/a"] == pytest.approx(100.0)
+    scaled = scale_all_jobs_goodput(
+        jobs(), one_domain_cluster(1, 4), 1.0, curves=cv,
+        calibration=lambda p: 0.5)
+    # same grants (one uncontended job), but the marginal that PRICED
+    # them carries the measured correction
+    assert scaled.diff == base.diff
+    assert scaled.marginals["default/a"] == pytest.approx(50.0)
+
+    # a raising / non-positive calibration source degrades to neutral
+    def broken(p):
+        raise RuntimeError("kv down")
+
+    neutral = scale_all_jobs_goodput(jobs(), one_domain_cluster(1, 4),
+                                     1.0, curves=cv, calibration=broken)
+    assert neutral.marginals == base.marginals
+    zero = scale_all_jobs_goodput(jobs(), one_domain_cluster(1, 4), 1.0,
+                                  curves=cv, calibration=lambda p: 0.0)
+    assert zero.marginals == base.marginals
+
+
+# ---------------------------------------------------------------------------
+# instrumentation sites (the cheap ones; decode plane rides the CI smoke)
+# ---------------------------------------------------------------------------
+
+
+def test_trainer_resize_records_reshard_calibration():
+    from tests.test_prewarm import batch, make_trainer
+
+    led = set_process_calib(ledger(job="t/resize"))
+    tr = make_trainer()
+    tr.step(batch())
+    assert tr.resize(4)
+    evt = tr.resize_events[-1]
+    # the measured rate rides the event next to the plan-derived bytes
+    assert "reshard_gbps" in evt and evt["reshard_gbps"] >= 0.0
+    assert led.sample_count("reshard_seconds") == 1
+    assert led.factor("reshard_seconds") > 0.0
+    _, _, err = led.samples("reshard_seconds")[0]
+    assert err >= 0.0
+
+
+def test_run_report_carries_measured_resize_gbps_field():
+    from edl_tpu.runtime.local import RunReport
+
+    assert RunReport().resize_gbps == []
+
+
+def test_curve_store_records_goodput_curve_predictor():
+    from edl_tpu.coord import PyCoordService
+    from edl_tpu.observability.goodput import CurveStore
+
+    led = set_process_calib(ledger(job="g/job"))
+    store = CurveStore(PyCoordService(), "g/job",
+                       registry=MetricsRegistry())
+    store.record(4, 1000.0)  # no prior prediction at ws=4: nothing pairs
+    assert led.sample_count("goodput_curve") == 0
+    store.record(4, 900.0)  # the curve predicted 1000 here
+    assert led.sample_count("goodput_curve") == 1
+    pred, measured, err = led.samples("goodput_curve")[0]
+    assert (pred, measured) == (1000.0, 900.0)
+    assert err == pytest.approx(10.0)
+
+
+def test_token_scheduler_exposes_its_interleave_predictions():
+    from edl_tpu.runtime.serving import TokenScheduler
+
+    sched = TokenScheduler()
+    assert sched.predicted_decode_ms() is None  # no sample: no prediction
+    assert sched.predicted_prefill_ms() is None
+    sched.note_decode(10.0)
+    sched.note_prefill(40.0)
+    assert sched.predicted_decode_ms() == pytest.approx(10.0)
+    assert sched.predicted_prefill_ms() == pytest.approx(40.0)
+
+
+def test_serving_scaler_resolves_plan_predictions_after_settle():
+    from edl_tpu.api.types import ServingJob, ServingSpec
+    from edl_tpu.runtime.serving import FleetStats
+    from edl_tpu.scheduler.autoscaler import ServingScaler
+
+    led = set_process_calib(ledger(job="default/svc"))
+    clock = [100.0]
+    stats = {"default/svc": FleetStats(
+        p50_ms=30.0, p99_ms=80.0, qps=10.0, queue_depth=0,
+        replicas_ready=2, replicas_active=2, requests_windowed=20)}
+    sc = ServingScaler(stats_for=lambda uid: stats[uid],
+                       actuate=lambda uid, n: None,
+                       clock=lambda: clock[0])
+    sc.on_add(ServingJob(name="svc", spec=ServingSpec(
+        min_replicas=1, max_replicas=8, slo_p99_ms=50.0)))
+    assert sc.tick() == {"default/svc": 3}  # breach → plan to 3
+    assert led.sample_count("serving_scale_qps") == 0  # not settled yet
+    # fleet settles AT the target with a realized window: the plan's
+    # predicted qps/p99 pair with what the window measured
+    stats["default/svc"] = FleetStats(
+        p50_ms=10.0, p99_ms=30.0, qps=12.0, queue_depth=0,
+        replicas_ready=3, replicas_active=3, requests_windowed=25)
+    clock[0] += sc.calib_settle_s + 1.0
+    sc.tick()
+    assert led.sample_count("serving_scale_qps") == 1
+    assert led.sample_count("serving_scale_p99") == 1
+    qp, qm, _ = led.samples("serving_scale_qps")[0]
+    assert (qp, qm) == (10.0, 12.0)  # demand carryover vs realized
+    pp, pm, _ = led.samples("serving_scale_p99")[0]
+    assert (pp, pm) == (50.0, 30.0)  # the SLO the plan promised
+    # the pending resolves exactly once
+    clock[0] += sc.calib_settle_s + 1.0
+    sc.tick()
+    assert led.sample_count("serving_scale_qps") == 1
+
+
+def test_serving_scaler_drops_superseded_predictions():
+    from edl_tpu.api.types import ServingJob, ServingSpec
+    from edl_tpu.runtime.serving import FleetStats
+    from edl_tpu.scheduler.autoscaler import ServingScaler
+
+    led = set_process_calib(ledger(job="default/svc"))
+    clock = [100.0]
+    stats = {"default/svc": FleetStats(
+        p50_ms=30.0, p99_ms=80.0, qps=10.0, queue_depth=0,
+        replicas_ready=2, replicas_active=2, requests_windowed=20)}
+    sc = ServingScaler(stats_for=lambda uid: stats[uid],
+                       actuate=lambda uid, n: None,
+                       clock=lambda: clock[0])
+    sc.on_add(ServingJob(name="svc", spec=ServingSpec(
+        min_replicas=1, max_replicas=8, slo_p99_ms=50.0)))
+    assert sc.tick() == {"default/svc": 3}
+    # the fleet never reaches the target (stuck at 2, now healthy):
+    # the prediction is scored against nothing
+    stats["default/svc"] = FleetStats(
+        p50_ms=10.0, p99_ms=30.0, qps=10.0, queue_depth=0,
+        replicas_ready=2, replicas_active=2, requests_windowed=20)
+    clock[0] += sc.calib_settle_s + 1.0
+    sc.tick()
+    assert led.sample_count("serving_scale_qps") == 0
+    assert led.sample_count("serving_scale_p99") == 0
+
+
+# ---------------------------------------------------------------------------
+# scrape plane: summary, drift rule, dashboards
+# ---------------------------------------------------------------------------
+
+
+def _scraped_ledger_view(windows=1):
+    """A FleetView over a scraped registry fed by a real ledger, with
+    enough sweeps for windowed quantiles to have deltas."""
+    reg = MetricsRegistry()
+    led = CalibrationLedger(job="j", registry=reg)
+    s, clock = make_scraper({"t": reg.render})
+    s.sweep()
+    clock.advance(1.0)
+    led.record("reshard_seconds", 1.0, 1.5, unit="s")
+    led.record("goodput_curve", 100.0, 95.0, unit="tok/s")
+    s.sweep()
+    return FleetView(s, window_s=10.0), led, s, clock
+
+
+def test_fleetview_calibration_summary_rolls_up_per_predictor():
+    view, led, _, _ = _scraped_ledger_view()
+    summary = view.calibration_summary()
+    assert set(summary) == {"j"}
+    assert set(summary["j"]) == {"reshard_seconds", "goodput_curve"}
+    rs = summary["j"]["reshard_seconds"]
+    assert rs["factor"] == pytest.approx(1.5)
+    assert rs["samples"] == 1
+    assert rs["error_pct_p50"] is not None  # windowed deltas exist
+    # and the full snapshot carries the table for the dashboard
+    assert view.snapshot()["calibration"]["j"]["goodput_curve"][
+        "factor"] == pytest.approx(0.95)
+
+
+def test_calibration_drift_rule_fires_after_consecutive_windows():
+    reg = MetricsRegistry()
+    g = reg.gauge("calibration_factor")
+    n = reg.counter("calibration_samples")
+    g.set(5.0, job="j", predictor="p")
+    n.inc(10, job="j", predictor="p")
+    s, clock = make_scraper({"t": reg.render})
+    s.sweep()
+    view = FleetView(s, window_s=10.0)
+    engine = AlertEngine(view, rules=[CalibrationDriftRule(windows=3)],
+                         registry=MetricsRegistry())
+    engine.evaluate()
+    engine.evaluate()
+    assert engine.firing() == []  # 2 consecutive windows: not yet
+    engine.evaluate()
+    firing = engine.firing()
+    assert [a.rule for a in firing] == ["calibration_drift"]
+    assert firing[0].labels == {"job": "j", "predictor": "p"}
+    # the factor returns to band: the streak resets and the alert
+    # resolves on the next evaluation
+    g.set(1.2, job="j", predictor="p")
+    clock.advance(1.0)
+    s.sweep()
+    engine.evaluate()
+    assert engine.firing() == []
+
+
+def test_calibration_drift_needs_min_samples():
+    reg = MetricsRegistry()
+    reg.gauge("calibration_factor").set(9.0, job="j", predictor="p")
+    reg.counter("calibration_samples").inc(2, job="j", predictor="p")
+    s, _ = make_scraper({"t": reg.render})
+    s.sweep()
+    engine = AlertEngine(FleetView(s),
+                         rules=[CalibrationDriftRule(windows=1,
+                                                     min_samples=3)],
+                         registry=MetricsRegistry())
+    engine.evaluate()
+    assert engine.firing() == []  # 2 samples: too thin to page anyone
+
+
+def test_drift_rule_ships_in_default_rules():
+    assert any(isinstance(r, CalibrationDriftRule)
+               for r in default_rules())
+
+
+def test_calib_dashboard_renders_factors_and_drift():
+    view, _, s, clock = _scraped_ledger_view()
+    engine = AlertEngine(view, rules=[CalibrationDriftRule(windows=1)],
+                         registry=MetricsRegistry())
+    engine.evaluate()
+    out = render_calib_dashboard(view, engine)
+    assert "reshard_seconds" in out and "goodput_curve" in out
+    assert "1.5" in out and "ok" in out
+    assert "DRIFT: none firing" in out
+    # the fleet dashboard carries the same table as a section
+    assert "CALIBRATION" in render_fleet_dashboard(view, engine)
+    # an out-of-band predictor renders as DRIFT and the firing alert
+    # is listed once the rule trips
+    view2_reg = MetricsRegistry()
+    led2 = CalibrationLedger(job="j2", registry=view2_reg)
+    for _ in range(3):
+        led2.record("kv_move_seconds", 1.0, 10.0)
+    s2, _ = make_scraper({"t": view2_reg.render})
+    s2.sweep()
+    view2 = FleetView(s2)
+    engine2 = AlertEngine(view2, rules=[CalibrationDriftRule(windows=1)],
+                          registry=MetricsRegistry())
+    engine2.evaluate()
+    out2 = render_calib_dashboard(view2, engine2)
+    assert "CALIBRATION DRIFT FIRING (1)" in out2
+    assert "kv_move_seconds" in out2
+
+
+def test_calib_dashboard_empty_view_degrades_gracefully():
+    reg = MetricsRegistry()
+    s, _ = make_scraper({"t": reg.render})
+    s.sweep()
+    out = render_calib_dashboard(FleetView(s))
+    assert "no calibration series scraped" in out
+
+
+def test_cli_calib_verb_renders_scraped_factors(capsys):
+    from edl_tpu import cli
+    from edl_tpu.observability.health import serve_health
+
+    reg = MetricsRegistry()
+    led = CalibrationLedger(job="cli/job", registry=reg)
+    led.record("reshard_seconds", 1.0, 1.4, unit="s")
+    srv = serve_health(0, {}, host="127.0.0.1", registry=reg)
+    try:
+        port = srv.server_address[1]
+        rc = cli.main(["calib", "--scrape-targets", f"127.0.0.1:{port}",
+                       "--sweeps", "1", "--check"])
+    finally:
+        srv.shutdown()
+    out = capsys.readouterr().out
+    assert rc == 0  # in-band factor: --check stays green
+    assert "reshard_seconds" in out and "cli/job" in out
+    assert "1.4" in out
+
+
+# ---------------------------------------------------------------------------
+# HA: factors survive a coordinator-primary SIGKILL
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.multihost
+def test_factors_survive_primary_failover(tmp_path):
+    """The acceptance property: factor records written against the HA
+    pair's primary are readable from the promoted standby after a
+    SIGKILL, and the promoted primary accepts new samples (same harness
+    as the goodput curve's failover pin)."""
+    from edl_tpu.coord import CoordClient, native_available, spawn_ha_pair
+
+    if not native_available():
+        pytest.skip("no native coordinator core")
+    pr, sb = spawn_ha_pair(str(tmp_path), repl_lease_ms=1000)
+    c = CoordClient("127.0.0.1", pr.port, timeout=2.0,
+                    reconnect_window_s=12.0, promote_grace_s=0.2,
+                    endpoints=[("127.0.0.1", sb.port)])
+    try:
+        led = CalibrationLedger(job="ha/job", coord=c,
+                                registry=MetricsRegistry())
+        led.record("reshard_seconds", 1.0, 2.0, unit="s")
+        led.record("goodput_curve", 100.0, 90.0, unit="tok/s")
+        pr.process.send_signal(signal.SIGKILL)
+        pr.process.wait(timeout=10)
+        # the next read transparently fails over and promotes
+        survived = load_factors(c, "ha/job")
+        assert (c.host, c.port) == ("127.0.0.1", sb.port)
+        assert set(survived) == {"reshard_seconds", "goodput_curve"}
+        assert survived["reshard_seconds"]["factor"] == pytest.approx(2.0)
+        # the promoted primary keeps accepting samples, and the
+        # read-back hook prices from the survivor
+        led.record("reshard_seconds", 1.0, 2.0)
+        led.record("reshard_seconds", 1.0, 2.0)
+        cf = CalibrationFactors(c, "ha/job", min_samples=3)
+        assert cf.factor("reshard_seconds") == pytest.approx(2.0)
+    finally:
+        c.close()
+        pr.stop()
+        sb.stop()
